@@ -1,0 +1,22 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+Brand-new JAX/XLA/Pallas re-design with the capabilities of Deeplearning4j
+(reference repo surveyed in SURVEY.md).  User surface mirrors the reference's
+config-driven API (NeuralNetConfiguration builder → MultiLayerNetwork /
+ComputationGraph) while the execution model is idiomatic TPU: one jitted XLA
+program per train step, pytree params, mesh-sharded scale-out.
+"""
+
+__version__ = "0.1.0"
+
+from .nn.conf.input_type import InputType
+from .nn.conf.multi_layer import (MultiLayerConfiguration,
+                                  NeuralNetConfiguration)
+from .nn.multilayer import MultiLayerNetwork
+
+__all__ = [
+    "InputType",
+    "MultiLayerConfiguration",
+    "NeuralNetConfiguration",
+    "MultiLayerNetwork",
+]

@@ -1,0 +1,152 @@
+"""Activation functions.
+
+TPU-native analogue of the reference's activation registry (DL4J exposes an
+``Activation`` enum resolved to ``IActivation`` math objects; see
+``deeplearning4j-nn/.../nn/conf/layers/BaseLayer`` usage and the nd4j activation
+classes referenced by ``nn/conf/NeuralNetConfiguration.java``).  Here each
+activation is a pure JAX function usable inside ``jax.jit`` — XLA fuses these
+into the surrounding matmul/conv, which is the TPU replacement for libnd4j's
+hand-written elementwise kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_REGISTRY: Dict[str, Callable[[Array], Array]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+    return deco
+
+
+def get(name) -> Callable[[Array], Array]:
+    """Resolve an activation by name (case-insensitive). Callables pass through."""
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Available: {sorted(_REGISTRY)}") from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register("identity")
+@register("linear")
+def identity(x):
+    return x
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("relu6")
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+@register("leakyrelu")
+def leakyrelu(x):
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@register("elu")
+def elu(x):
+    return jax.nn.elu(x)
+
+
+@register("selu")
+def selu(x):
+    return jax.nn.selu(x)
+
+
+@register("gelu")
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("rationaltanh")
+def rationaltanh(x):
+    # tanh approximation: 1.7159 * tanh(2x/3), clipped to [-1,1] range behaviour
+    a = 1.7159 * jnp.tanh(2.0 * x / 3.0)
+    return jnp.clip(a, -1.0, 1.0)
+
+
+@register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@register("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("logsoftmax")
+def logsoftmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("cube")
+def cube(x):
+    return x ** 3
+
+
+@register("swish")
+@register("silu")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("rrelu")
+def rrelu(x):
+    # deterministic midpoint variant (train-time randomized slope averaged)
+    return jnp.where(x >= 0, x, x * (1.0 / 8.0 + 1.0 / 3.0) / 2.0)
+
+
+@register("thresholdedrelu")
+def thresholdedrelu(x):
+    return jnp.where(x > 1.0, x, 0.0)

@@ -1,0 +1,74 @@
+"""Parameter constraints, applied after each update step.
+
+Analogue of ``nn/conf/constraint/``: MaxNormConstraint, MinMaxNormConstraint,
+NonNegativeConstraint, UnitNormConstraint.  Applied inside the jitted train
+step right after the optimizer update (reference applies them in
+``StochasticGradientDescent.optimize()`` :98 via ``applyConstraints``).
+
+Norms are computed over all axes except the output-unit axis (last), matching
+the reference's per-output-neuron norm semantics for dense/conv kernels.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+
+_EPS = 1e-8
+
+
+def _unit_norms(w):
+    if w.ndim <= 1:
+        return jnp.abs(w)
+    axes = tuple(range(w.ndim - 1))
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+@dataclass
+class LayerConstraint:
+    apply_to_weights: bool = True
+    apply_to_biases: bool = False
+
+    def apply(self, param):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@register_serde
+@dataclass
+class MaxNormConstraint(LayerConstraint):
+    max_norm: float = 2.0
+
+    def apply(self, param):
+        n = _unit_norms(param)
+        scale = jnp.minimum(1.0, self.max_norm / (n + _EPS))
+        return param * scale
+
+
+@register_serde
+@dataclass
+class MinMaxNormConstraint(LayerConstraint):
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def apply(self, param):
+        n = _unit_norms(param)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1 - self.rate) * n
+        return param * (target / (n + _EPS))
+
+
+@register_serde
+@dataclass
+class NonNegativeConstraint(LayerConstraint):
+    def apply(self, param):
+        return jnp.maximum(param, 0.0)
+
+
+@register_serde
+@dataclass
+class UnitNormConstraint(LayerConstraint):
+    def apply(self, param):
+        return param / (_unit_norms(param) + _EPS)

@@ -1,0 +1,121 @@
+"""Sampling distributions for WeightInit.DISTRIBUTION.
+
+Analogue of the reference's ``nn/conf/distribution/`` package (Normal, Uniform,
+Binomial, LogNormal, TruncatedNormal, Orthogonal, Constant) as serializable
+dataclasses with a ``sample`` method over a JAX PRNG key.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Type
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+
+_DIST_REGISTRY: Dict[str, Type["Distribution"]] = {}
+
+
+def register_distribution(cls):
+    _DIST_REGISTRY[cls.__name__] = cls
+    return register_serde(cls)
+
+
+@dataclass
+class Distribution:
+    def sample(self, key, shape):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@dist"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _DIST_REGISTRY[d.pop("@dist")]
+        return cls(**d)
+
+
+@register_distribution
+@dataclass
+class NormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape):
+        return self.mean + self.std * jax.random.normal(key, shape)
+
+
+@register_distribution
+@dataclass
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, key, shape):
+        return jax.random.uniform(key, shape, minval=self.lower, maxval=self.upper)
+
+
+@register_distribution
+@dataclass
+class BinomialDistribution(Distribution):
+    trials: int = 1
+    prob: float = 0.5
+
+    def sample(self, key, shape):
+        return jnp.sum(
+            jax.random.bernoulli(key, self.prob, (self.trials,) + tuple(shape)).astype(jnp.float32),
+            axis=0)
+
+
+@register_distribution
+@dataclass
+class LogNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape):
+        return jnp.exp(self.mean + self.std * jax.random.normal(key, shape))
+
+
+@register_distribution
+@dataclass
+class TruncatedNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape):
+        return self.mean + self.std * jax.random.truncated_normal(key, -2.0, 2.0, shape)
+
+
+@register_distribution
+@dataclass
+class OrthogonalDistribution(Distribution):
+    gain: float = 1.0
+
+    def sample(self, key, shape):
+        if len(shape) < 2:
+            raise ValueError("orthogonal requires >=2d shape")
+        rows = shape[0]
+        cols = 1
+        for d in shape[1:]:
+            cols *= d
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return self.gain * q[:rows, :cols].reshape(shape)
+
+
+@register_distribution
+@dataclass
+class ConstantDistribution(Distribution):
+    value: float = 0.0
+
+    def sample(self, key, shape):
+        return jnp.full(shape, self.value)

@@ -1,0 +1,113 @@
+"""Dropout and weight-noise configurations.
+
+Analogue of ``nn/conf/dropout/`` (Dropout, AlphaDropout, GaussianDropout,
+GaussianNoise) and ``nn/conf/weightnoise/`` (DropConnect, WeightNoise).
+All are pure functions of a PRNG key — train-time only, identity at inference,
+matching reference semantics (``IDropout.applyDropout``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from .distribution import Distribution
+
+
+@dataclass
+class IDropout:
+    def apply(self, key, x, iteration=0):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@register_serde
+@dataclass
+class Dropout(IDropout):
+    """Inverted dropout with retain probability p (reference Dropout.java)."""
+    p: float = 0.5  # probability of *retaining* a unit, as in DL4J
+
+    def apply(self, key, x, iteration=0):
+        keep = jax.random.bernoulli(key, self.p, x.shape)
+        return jnp.where(keep, x / self.p, 0.0)
+
+
+@register_serde
+@dataclass
+class GaussianDropout(IDropout):
+    rate: float = 0.5
+
+    def apply(self, key, x, iteration=0):
+        std = jnp.sqrt(self.rate / (1.0 - self.rate))
+        return x * (1.0 + std * jax.random.normal(key, x.shape))
+
+
+@register_serde
+@dataclass
+class GaussianNoise(IDropout):
+    stddev: float = 0.1
+
+    def apply(self, key, x, iteration=0):
+        return x + self.stddev * jax.random.normal(key, x.shape)
+
+
+@register_serde
+@dataclass
+class AlphaDropout(IDropout):
+    """SELU-compatible dropout (reference AlphaDropout.java)."""
+    p: float = 0.95
+    alpha: float = -1.7580993408473766  # -alpha*lambda of SELU
+
+    def apply(self, key, x, iteration=0):
+        p = self.p
+        a = (p + self.alpha ** 2 * p * (1 - p)) ** -0.5
+        b = -a * (1 - p) * self.alpha
+        keep = jax.random.bernoulli(key, p, x.shape)
+        return a * jnp.where(keep, x, self.alpha) + b
+
+
+def resolve(d) -> Optional[IDropout]:
+    """Accept None, float retain-prob (DL4J style), or IDropout."""
+    if d is None:
+        return None
+    if isinstance(d, IDropout):
+        return d
+    p = float(d)
+    if p <= 0.0 or p >= 1.0:
+        return None
+    return Dropout(p)
+
+
+# ---- weight noise (applied to params, not activations) ----------------------
+
+@dataclass
+class IWeightNoise:
+    def apply(self, key, param, iteration=0):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@register_serde
+@dataclass
+class DropConnect(IWeightNoise):
+    """Randomly zero weights during training (reference DropConnect.java)."""
+    p: float = 0.5  # retain probability
+
+    def apply(self, key, param, iteration=0):
+        keep = jax.random.bernoulli(key, self.p, param.shape)
+        return jnp.where(keep, param / self.p, 0.0)
+
+
+@register_serde
+@dataclass
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative noise from a distribution."""
+    distribution: Optional[Distribution] = None
+    additive: bool = True
+
+    def apply(self, key, param, iteration=0):
+        from .distribution import NormalDistribution
+        dist = self.distribution or NormalDistribution(0.0, 0.01)
+        noise = dist.sample(key, param.shape)
+        return param + noise if self.additive else param * noise

@@ -1,0 +1,254 @@
+"""Network configuration: global defaults + sequential layer stack.
+
+Analogue of ``nn/conf/NeuralNetConfiguration.java:78`` (Builder + ListBuilder)
+and ``nn/conf/MultiLayerConfiguration.java:55``.  The builder resolves, at
+configuration time: global-default inheritance into each layer, static shape
+inference via InputType, automatic preprocessor insertion between layer
+families, and n_in inference — all before a single array exists, exactly as
+the reference does, which also guarantees jit-compatible static shapes.
+
+JSON/YAML round-trip via utils.serde mirrors ``toJson/fromJson``
+(``MultiLayerConfiguration.java:120,138``).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ...utils import serde
+from ...utils.serde import register_serde
+from .input_type import InputType
+from .preprocessors import (CnnFlatToCnnPreProcessor, CnnToFeedForwardPreProcessor,
+                            CnnToRnnPreProcessor, FeedForwardToRnnPreProcessor,
+                            InputPreProcessor, RnnToCnnPreProcessor,
+                            RnnToFeedForwardPreProcessor)
+from ..layers.base import BaseLayerConf, LayerConf
+
+
+def _auto_preprocessor(prev: InputType, layer: LayerConf) -> Optional[InputPreProcessor]:
+    """Insert a reshape adapter when layer families change
+    (reference ``nn/conf/layers/InputTypeUtil.java`` + per-layer
+    getPreProcessorForInputType)."""
+    want = getattr(layer, "INPUT_KIND", "any")
+    if want == "any" or prev.kind == want:
+        return None
+    if want == "ff":
+        if prev.kind == "cnn":
+            return CnnToFeedForwardPreProcessor(prev.height, prev.width, prev.channels)
+        if prev.kind == "cnnflat":
+            return None  # already flat
+        if prev.kind == "rnn":
+            return RnnToFeedForwardPreProcessor()
+    elif want == "cnn":
+        if prev.kind == "cnnflat":
+            return CnnFlatToCnnPreProcessor(prev.height, prev.width, prev.channels)
+        if prev.kind == "ff":
+            raise ValueError(
+                f"cannot infer CNN dims from FF input for layer '{layer.name}'; "
+                "add an explicit FeedForwardToCnnPreProcessor")
+    elif want == "rnn":
+        if prev.kind == "ff":
+            return FeedForwardToRnnPreProcessor()
+        if prev.kind == "cnn":
+            return CnnToRnnPreProcessor(prev.height, prev.width, prev.channels)
+    raise ValueError(
+        f"no automatic preprocessor from {prev.kind} input to '{want}' layer "
+        f"'{layer.name}'")
+
+
+@register_serde
+@dataclass
+class MultiLayerConfiguration:
+    layers: List[LayerConf] = field(default_factory=list)
+    input_type: Optional[InputType] = None
+    # int-keyed dict serializes with str keys in json; normalize on access
+    input_preprocessors: Dict[str, InputPreProcessor] = field(default_factory=dict)
+    backprop_type: str = "standard"           # "standard" | "tbptt"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    defaults: Dict[str, Any] = field(default_factory=dict)
+    seed: int = 12345
+    # resolved by build():
+    layer_input_types: List[InputType] = field(default_factory=list)
+
+    # ---- serde --------------------------------------------------------------
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        conf = serde.from_json(s)
+        assert isinstance(conf, MultiLayerConfiguration)
+        return conf
+
+    def to_yaml(self) -> str:
+        return serde.to_yaml(self)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        return serde.from_yaml(s)
+
+    # ---- shape resolution ---------------------------------------------------
+    def preprocessor(self, i: int) -> Optional[InputPreProcessor]:
+        return self.input_preprocessors.get(str(i))
+
+    def resolve(self) -> None:
+        """Apply defaults, insert preprocessors, infer n_in, record itypes."""
+        for lc in self.layers:
+            if isinstance(lc, BaseLayerConf):
+                lc.apply_global_defaults(self.defaults)
+        self.layer_input_types = []
+        itype = self.input_type
+        for i, lc in enumerate(self.layers):
+            if itype is not None:
+                if str(i) not in self.input_preprocessors:
+                    pp = _auto_preprocessor(itype, lc)
+                    if pp is not None:
+                        self.input_preprocessors[str(i)] = pp
+                pp = self.preprocessor(i)
+                if pp is not None:
+                    itype = pp.output_type(itype)
+                lc.set_n_in(itype, override=False)
+                self.layer_input_types.append(itype)
+                itype = lc.output_type(itype)
+            else:
+                # no declared input type (reference: user sets nIn explicitly);
+                # chain output types forward once a layer determines its own.
+                self.layer_input_types.append(None)
+                try:
+                    itype = lc.output_type(itype)
+                except Exception:
+                    itype = None
+
+
+class ListBuilder:
+    """Fluent layer-stack builder (reference NeuralNetConfiguration.ListBuilder)."""
+
+    def __init__(self, defaults: Dict[str, Any], seed: int):
+        self._defaults = defaults
+        self._seed = seed
+        self._layers: List[LayerConf] = []
+        self._input_type: Optional[InputType] = None
+        self._preprocessors: Dict[str, InputPreProcessor] = {}
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, conf: LayerConf, index: Optional[int] = None) -> "ListBuilder":
+        if conf.name is None:
+            conf.name = f"layer{len(self._layers)}"
+        self._layers.append(conf)
+        return self
+
+    def set_input_type(self, itype: InputType) -> "ListBuilder":
+        self._input_type = itype
+        return self
+
+    def input_pre_processor(self, index: int, pp: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[str(index)] = pp
+        return self
+
+    def backprop_type(self, t: str, fwd: int = 20, back: int = 20) -> "ListBuilder":
+        self._backprop_type = t
+        self._tbptt_fwd = fwd
+        self._tbptt_back = back
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        conf = MultiLayerConfiguration(
+            layers=self._layers,
+            input_type=self._input_type,
+            input_preprocessors=self._preprocessors,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            defaults=dict(self._defaults),
+            seed=self._seed,
+        )
+        conf.resolve()
+        return conf
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()`` fluent API."""
+
+    class Builder:
+        def __init__(self):
+            self._defaults: Dict[str, Any] = {}
+            self._seed = 12345
+
+        # global defaults — each maps onto the same-named reference builder call
+        def seed(self, s: int):
+            self._seed = int(s)
+            return self
+
+        def activation(self, a):
+            self._defaults["activation"] = a
+            return self
+
+        def weight_init(self, w, dist=None):
+            self._defaults["weight_init"] = w
+            if dist is not None:
+                self._defaults["weight_dist"] = dist
+            return self
+
+        def bias_init(self, b: float):
+            self._defaults["bias_init"] = float(b)
+            return self
+
+        def updater(self, u):
+            self._defaults["updater"] = u
+            return self
+
+        def bias_updater(self, u):
+            self._defaults["bias_updater"] = u
+            return self
+
+        def l1(self, v: float):
+            self._defaults["l1"] = float(v)
+            return self
+
+        def l2(self, v: float):
+            self._defaults["l2"] = float(v)
+            return self
+
+        def l1_bias(self, v: float):
+            self._defaults["l1_bias"] = float(v)
+            return self
+
+        def l2_bias(self, v: float):
+            self._defaults["l2_bias"] = float(v)
+            return self
+
+        def dropout(self, d):
+            self._defaults["dropout"] = d
+            return self
+
+        def weight_noise(self, wn):
+            self._defaults["weight_noise"] = wn
+            return self
+
+        def constraints(self, cs):
+            self._defaults["constraints"] = cs
+            return self
+
+        def gradient_normalization(self, gn, threshold: float = 1.0):
+            self._defaults["gradient_normalization"] = gn
+            self._defaults["gradient_normalization_threshold"] = float(threshold)
+            return self
+
+        def dtype(self, dt: str):
+            self._defaults["dtype"] = dt
+            return self
+
+        def list(self) -> ListBuilder:
+            return ListBuilder(self._defaults, self._seed)
+
+        def graph_builder(self):
+            from .computation_graph import GraphBuilder
+            return GraphBuilder(self._defaults, self._seed)
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration.Builder":
+        return NeuralNetConfiguration.Builder()

@@ -1,0 +1,144 @@
+"""Input preprocessors — reshape adapters between layer families.
+
+Analogue of ``nn/conf/preprocessor/`` (CnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, RnnToCnnPreProcessor, …).  In the reference these
+implement explicit backprop; here they are pure reshapes/transposes that JAX
+differentiates through automatically (and XLA folds into layout assignment —
+free on TPU).
+
+Layout notes: images are NHWC (TPU-native; the reference is NCHW) and time
+series are [batch, time, features] (the reference is [batch, features, time]).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from .input_type import InputType
+
+
+@dataclass
+class InputPreProcessor:
+    def pre_process(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, itype: InputType) -> InputType:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask, itype: InputType):
+        return mask
+
+
+@register_serde
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(itype.height * itype.width * itype.channels)
+
+
+@register_serde
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_serde
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[b*t, f] -> [b, t, f] is not statically known; reference instead maps
+    [b, f] -> [b, 1, f] when used directly, and inside MLN handles the 2d<->3d
+    flattening around dense layers in RNN nets. We implement the reference's
+    actual contract: reshape flattened time-distributed activations back to 3d.
+    """
+    timesteps: int = -1
+
+    def pre_process(self, x, mask=None):
+        if self.timesteps > 0:
+            return x.reshape(-1, self.timesteps, x.shape[-1])
+        return x[:, None, :]
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(itype.size, self.timesteps)
+
+
+@register_serde
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[b, t, f] -> [b*t, f] (time-distributed dense, reference semantics)."""
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(itype.size)
+
+    def feed_forward_mask(self, mask, itype):
+        if mask is None:
+            return None
+        return mask.reshape(-1)
+
+
+@register_serde
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: int = -1
+
+    def pre_process(self, x, mask=None):
+        flat = x.reshape(x.shape[0], -1)
+        if self.timesteps > 0:
+            return flat.reshape(-1, self.timesteps, flat.shape[-1] )
+        return flat[:, None, :]
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(itype.height * itype.width * itype.channels,
+                                   self.timesteps)
+
+
+@register_serde
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_serde
+@dataclass
+class CnnFlatToCnnPreProcessor(InputPreProcessor):
+    """Flattened image rows -> NHWC (reference: input type CNNFlat handling)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)

@@ -1,0 +1,139 @@
+"""Learning-rate (and generally hyperparameter) schedules.
+
+Analogue of the reference's ``nn/conf/LearningRatePolicy.java`` + nd4j
+``ISchedule`` family (Step, Poly, Exponential, Inverse, Sigmoid, Cycle, Map).
+Each schedule is a serializable dataclass with ``value(iteration, epoch)``;
+``as_optax`` converts to an optax-compatible ``fn(count)`` for use inside the
+jitted update (schedules are computed on-device from the step counter, so the
+whole update stays one fused XLA program).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+
+
+@dataclass
+class Schedule:
+    def value(self, iteration, epoch=0):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def as_optax(self):
+        return lambda count: self.value(count)
+
+
+@register_serde
+@dataclass
+class FixedSchedule(Schedule):
+    value_: float = 0.001
+
+    def value(self, iteration, epoch=0):
+        return self.value_
+
+
+@register_serde
+@dataclass
+class StepSchedule(Schedule):
+    """lr * decay_rate^floor(iter / step)."""
+    initial_value: float = 0.001
+    decay_rate: float = 0.1
+    step: float = 1000.0
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value * self.decay_rate ** jnp.floor(iteration / self.step)
+
+
+@register_serde
+@dataclass
+class ExponentialSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.99
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value * self.gamma ** iteration
+
+
+@register_serde
+@dataclass
+class InverseSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.001
+    power: float = 2.0
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value / (1 + self.gamma * iteration) ** self.power
+
+
+@register_serde
+@dataclass
+class PolySchedule(Schedule):
+    initial_value: float = 0.001
+    power: float = 2.0
+    max_iter: int = 10000
+
+    def value(self, iteration, epoch=0):
+        frac = jnp.clip(iteration / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1 - frac) ** self.power
+
+
+@register_serde
+@dataclass
+class SigmoidSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.01
+    step_size: int = 1000
+
+    def value(self, iteration, epoch=0):
+        return self.initial_value / (1 + jnp.exp(self.gamma * (iteration - self.step_size)))
+
+
+@register_serde
+@dataclass
+class MapSchedule(Schedule):
+    """Piecewise-constant by iteration: {0: lr0, 1000: lr1, ...}."""
+    values: Dict[int, float] = field(default_factory=dict)
+
+    def value(self, iteration, epoch=0):
+        keys = sorted(int(k) for k in self.values)
+        out = jnp.asarray(self.values[keys[0]] if keys else 0.0)
+        for k in keys:
+            out = jnp.where(iteration >= k, self.values[k], out)
+        return out
+
+
+@register_serde
+@dataclass
+class CycleSchedule(Schedule):
+    """1cycle-style: warm up to max then anneal; simplified triangular cycle."""
+    initial_value: float = 1e-4
+    max_value: float = 1e-2
+    cycle_length: int = 1000
+    annealing_cycles: int = 0
+    annealing_decay: float = 0.1
+
+    def value(self, iteration, epoch=0):
+        pos = (iteration % self.cycle_length) / max(self.cycle_length - 1, 1)
+        tri = jnp.where(pos < 0.5, pos * 2, (1 - pos) * 2)
+        return self.initial_value + (self.max_value - self.initial_value) * tri
+
+
+@register_serde
+@dataclass
+class WarmupSchedule(Schedule):
+    """Linear warmup into a wrapped schedule (transformer-era extension)."""
+    warmup_iters: int = 100
+    target: float = 1e-3
+
+    def value(self, iteration, epoch=0):
+        return self.target * jnp.clip(iteration / max(self.warmup_iters, 1), 0.0, 1.0)
+
+
+def resolve(lr) -> Schedule:
+    """Accept float or Schedule; return a Schedule."""
+    if isinstance(lr, Schedule):
+        return lr
+    return FixedSchedule(float(lr))

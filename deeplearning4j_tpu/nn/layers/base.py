@@ -1,0 +1,199 @@
+"""Layer base classes.
+
+The reference splits layer *configuration* (``nn/conf/layers/``) from layer
+*implementation* (``nn/layers/``), with params held as views into one flat
+array (``nn/api/Layer.java:38``, ``nn/params/DefaultParamInitializer``).  The
+TPU-native design collapses the two: a layer IS a serializable config dataclass
+with two pure functions —
+
+    init(key, input_type)  -> {"params": {...}, "state": {...}}
+    apply(variables, x, *, train, key, mask, state) -> (y, new_state)
+
+Params live in a pytree (XLA manages placement/donation — the flat view's job),
+``state`` carries non-trained arrays (batch-norm running stats, reference
+``nn/layers/normalization/BatchNormalization.java`` global mean/var).  All
+``apply`` bodies are jit-traceable: no data-dependent Python control flow.
+
+Common hyperparameters mirror the reference's ``BaseLayer`` config: activation,
+weight_init (+distribution), l1/l2 (weights and bias separately), per-layer
+updater override, dropout, weight noise, constraints.  ``None`` means "inherit
+the network-level default" (resolved by the network builder, as DL4J's
+``NeuralNetConfiguration.Builder`` does).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import activations as _act
+from ..conf.constraints import LayerConstraint
+from ..conf.distribution import Distribution
+from ..conf.dropout import IDropout, IWeightNoise, resolve as _resolve_dropout
+from ..conf.input_type import InputType
+from ..conf.updaters import UpdaterConf
+from ..weights import init_weights
+
+Array = jax.Array
+Variables = Dict[str, Dict[str, Array]]
+
+# Global-default-able fields and their fallback values (mirrors
+# NeuralNetConfiguration.Builder's defaults applied to each layer).
+INHERITED_DEFAULTS = {
+    "activation": "identity",
+    "weight_init": "xavier",
+    "weight_dist": None,
+    "bias_init": 0.0,
+    "l1": 0.0,
+    "l2": 0.0,
+    "l1_bias": 0.0,
+    "l2_bias": 0.0,
+    "updater": None,
+    "bias_updater": None,
+    "dropout": None,
+    "weight_noise": None,
+    "constraints": None,
+    "dtype": "float32",
+    "gradient_normalization": None,
+    "gradient_normalization_threshold": 1.0,
+}
+
+
+@dataclass
+class LayerConf:
+    """Root of the layer-config hierarchy (reference ``nn/conf/layers/Layer``)."""
+    name: Optional[str] = None
+
+    # ---- to be overridden ---------------------------------------------------
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        """Infer input size from the previous layer's output type."""
+
+    def init(self, key: jax.Array, itype: InputType) -> Variables:
+        return {"params": {}, "state": {}}
+
+    def apply(self, variables: Variables, x: Array, *, train: bool = False,
+              key: Optional[jax.Array] = None, mask: Optional[Array] = None
+              ) -> Tuple[Array, Dict[str, Array]]:
+        raise NotImplementedError
+
+    # ---- generic helpers ----------------------------------------------------
+    def has_params(self) -> bool:
+        return False
+
+    def n_params(self, itype: InputType) -> int:
+        sizes = 0
+        v = self.init(jax.random.PRNGKey(0), itype)
+        for p in jax.tree_util.tree_leaves(v.get("params", {})):
+            sizes += p.size
+        return sizes
+
+    def regularization_score(self, params: Dict[str, Array]) -> Array:
+        return jnp.zeros(())
+
+    def feed_forward_mask(self, mask: Optional[Array], itype: InputType
+                          ) -> Optional[Array]:
+        """Propagate a mask through this layer (reference Layer.java:282)."""
+        return mask
+
+
+@dataclass
+class BaseLayerConf(LayerConf):
+    """Layers with weights (reference ``nn/conf/layers/BaseLayer``)."""
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    weight_dist: Optional[Distribution] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    updater: Optional[UpdaterConf] = None
+    bias_updater: Optional[UpdaterConf] = None
+    dropout: Optional[Any] = None          # float retain-prob or IDropout
+    weight_noise: Optional[IWeightNoise] = None
+    constraints: Optional[List[LayerConstraint]] = None
+    dtype: Optional[str] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    _BIAS_PARAMS = ("b", "gamma", "beta", "mean", "var")  # bias-like (no l2 by default)
+
+    # ---- defaults resolution -----------------------------------------------
+    def apply_global_defaults(self, defaults: Dict[str, Any]) -> None:
+        """Fill None fields from network-level defaults (builder semantics)."""
+        my_fields = {f.name for f in dataclasses.fields(self)}
+        for k, fallback in INHERITED_DEFAULTS.items():
+            if k not in my_fields:
+                continue
+            if getattr(self, k, None) is None:
+                setattr(self, k, defaults.get(k, fallback))
+
+    def resolved(self, name, fallback=None):
+        v = getattr(self, name, None)
+        if v is None:
+            v = INHERITED_DEFAULTS.get(name, fallback)
+        if v is None:
+            v = fallback
+        return v
+
+    # ---- helpers ------------------------------------------------------------
+    def has_params(self) -> bool:
+        return True
+
+    @property
+    def act_fn(self):
+        return _act.get(self.resolved("activation", "identity"))
+
+    def _dtype(self):
+        return jnp.dtype(self.resolved("dtype", "float32"))
+
+    def make_weight(self, key, shape):
+        return init_weights(key, shape, self.resolved("weight_init", "xavier"),
+                            self.weight_dist, self._dtype())
+
+    def make_bias(self, shape):
+        return jnp.full(shape, self.resolved("bias_init", 0.0), self._dtype())
+
+    def maybe_dropout_input(self, key, x, train: bool):
+        """Reference semantics: dropout is applied to the layer *input*."""
+        d = _resolve_dropout(self.dropout)
+        if train and d is not None and key is not None:
+            return d.apply(key, x)
+        return x
+
+    def maybe_noise_weights(self, key, params: Dict[str, Array], train: bool):
+        wn = self.weight_noise
+        if train and wn is not None and key is not None:
+            out = dict(params)
+            for i, (k, v) in enumerate(sorted(params.items())):
+                if k not in self._BIAS_PARAMS:
+                    out[k] = wn.apply(jax.random.fold_in(key, i), v)
+            return out
+        return params
+
+    def regularization_score(self, params: Dict[str, Array]) -> Array:
+        l1 = float(self.resolved("l1", 0.0) or 0.0)
+        l2 = float(self.resolved("l2", 0.0) or 0.0)
+        l1b = float(self.resolved("l1_bias", 0.0) or 0.0)
+        l2b = float(self.resolved("l2_bias", 0.0) or 0.0)
+        score = jnp.zeros(())
+        for k, v in params.items():
+            is_bias = k in self._BIAS_PARAMS
+            a1, a2 = (l1b, l2b) if is_bias else (l1, l2)
+            if a1:
+                score = score + a1 * jnp.sum(jnp.abs(v))
+            if a2:
+                score = score + 0.5 * a2 * jnp.sum(v * v)
+        return score
+
+
+def split_key(key, n):
+    if key is None:
+        return [None] * n
+    return list(jax.random.split(key, n))

@@ -1,0 +1,157 @@
+"""Feed-forward layers: Dense, Output, Loss, Activation, Dropout, Embedding.
+
+Reference: ``nn/layers/feedforward/dense/DenseLayer.java``,
+``nn/conf/layers/{DenseLayer,OutputLayer,LossLayer,ActivationLayer,
+DropoutLayer,EmbeddingLayer}``.  The matmul runs in the layer's dtype
+(bfloat16-ready) and XLA fuses bias+activation into it — the MXU path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from .. import losses as _losses
+from ..conf.input_type import InputType
+from .base import BaseLayerConf, LayerConf
+
+
+@register_serde
+@dataclass
+class DenseLayer(BaseLayerConf):
+    INPUT_KIND = "ff"
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    # ---- shape inference ----------------------------------------------------
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            if itype.kind not in ("ff", "cnnflat"):
+                raise ValueError(
+                    f"layer '{self.name}': dense layer expects FF input, got {itype}")
+            self.n_in = itype.flat_size() if itype.kind == "cnnflat" else itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    # ---- runtime ------------------------------------------------------------
+    def init(self, key, itype):
+        if self.n_in <= 0 or self.n_out <= 0:
+            raise ValueError(
+                f"layer '{self.name}': n_in={self.n_in}, n_out={self.n_out} — "
+                "set n_in explicitly or declare the network input type "
+                "(set_input_type) so it can be inferred")
+        params = {"W": self.make_weight(key, (self.n_in, self.n_out))}
+        if self.has_bias:
+            params["b"] = self.make_bias((self.n_out,))
+        return {"params": params, "state": {}}
+
+    def pre_output(self, variables, x, *, train=False, key=None):
+        params = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        z = self.pre_output(variables, x, train=train, key=key)
+        return self.act_fn(z), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (reference ``nn/conf/layers/OutputLayer``)."""
+    loss: str = "mcxent"
+
+    def compute_loss(self, variables, x, labels, *, train=False, key=None,
+                     mask=None, average=True):
+        z = self.pre_output(variables, x, train=train, key=key)
+        act = self.resolved("activation", "identity")
+        return _losses.get(self.loss)(labels, z, act, mask)
+
+
+@register_serde
+@dataclass
+class LossLayer(BaseLayerConf):
+    """Loss-only head, no params (reference ``nn/conf/layers/LossLayer``)."""
+    loss: str = "mse"
+
+    def has_params(self):
+        return False
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return self.act_fn(x), variables.get("state", {})
+
+    def compute_loss(self, variables, x, labels, *, train=False, key=None,
+                     mask=None, average=True):
+        act = self.resolved("activation", "identity")
+        return _losses.get(self.loss)(labels, x, act, mask)
+
+
+@register_serde
+@dataclass
+class ActivationLayer(BaseLayerConf):
+    def has_params(self):
+        return False
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return self.act_fn(x), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class DropoutLayer(BaseLayerConf):
+    """Standalone dropout (reference ``nn/conf/layers/DropoutLayer``)."""
+
+    def has_params(self):
+        return False
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return self.maybe_dropout_input(key, self.act_fn(x), train), \
+            variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class EmbeddingLayer(BaseLayerConf):
+    """Index → vector lookup (reference ``nn/conf/layers/EmbeddingLayer``).
+
+    Input: integer indices [batch] or one-hot [batch, n_in]; output
+    [batch, n_out].  Lookup is a gather — on TPU this stays on-device and
+    differentiates to a scatter-add, replacing the reference's row-view
+    update trick.
+    """
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            self.n_in = itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        params = {"W": self.make_weight(key, (self.n_in, self.n_out))}
+        if self.has_bias:
+            params["b"] = self.make_bias((self.n_out,))
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        params = variables["params"]
+        if x.ndim == 2 and x.shape[-1] == self.n_in and self.n_in > 1:
+            idx = jnp.argmax(x, axis=-1)  # one-hot input
+        else:
+            idx = x.reshape(x.shape[0]).astype(jnp.int32)
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self.act_fn(z), variables.get("state", {})

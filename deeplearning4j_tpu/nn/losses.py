@@ -1,0 +1,193 @@
+"""Loss functions.
+
+Covers the reference's ILossFunction set (nd4j ``LossFunctions.LossFunction``
+used throughout ``nn/conf/layers/OutputLayer``): MSE, MAE (L1), XENT (binary
+cross-entropy), MCXENT (multi-class cross-entropy), NEGATIVELOGLIKELIHOOD,
+SQUARED_LOSS, HINGE, SQUARED_HINGE, KL_DIVERGENCE, POISSON, COSINE_PROXIMITY,
+MEAN_ABSOLUTE_PERCENTAGE_ERROR, MEAN_SQUARED_LOGARITHMIC_ERROR, L2, L1,
+SPARSE_MCXENT, plus FMEASURE approximation and WASSERSTEIN.
+
+Each loss is ``fn(labels, preoutput, activation_fn, mask) -> scalar`` computing
+the *mean over examples* of the per-example score (summed over output units),
+matching the reference's score aggregation (``BaseOutputLayer.computeScore``
+sums per-example then averages over minibatch). Losses consume *pre-activation*
+output and apply the activation internally so that fused, numerically-stable
+softmax/sigmoid cross-entropy forms can be used — the TPU-friendly equivalent
+of the reference's ``ILossFunction.computeGradient`` hand-derived fused grads.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import activations
+
+Array = jax.Array
+
+_EPS = 1e-7
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+    return deco
+
+
+def get(name) -> Callable:
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(_REGISTRY)}") from None
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _apply_mask_and_mean(per_unit: Array, mask: Optional[Array]) -> Array:
+    """Sum per-unit scores over feature axes, average over (masked) examples.
+
+    per_unit has shape [batch, ...features]. mask broadcasts against it (e.g.
+    [batch] or [batch, 1] per-example masks, or full per-unit masks).
+    """
+    if mask is not None:
+        mask = mask.astype(per_unit.dtype)
+        while mask.ndim < per_unit.ndim:
+            mask = mask[..., None]
+        per_unit = per_unit * mask
+        per_example = per_unit.reshape(per_unit.shape[0], -1).sum(axis=1)
+        # average over number of *included* examples: count rows with any mask on
+        m = mask.reshape(mask.shape[0], -1).max(axis=1)
+        denom = jnp.maximum(m.sum(), 1.0)
+        return per_example.sum() / denom
+    per_example = per_unit.reshape(per_unit.shape[0], -1).sum(axis=1)
+    return per_example.mean()
+
+
+@register("mse")
+@register("squared_loss")
+def mse(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean((out - labels) ** 2, mask)
+
+
+@register("l2")
+def l2(labels, preout, activation="identity", mask=None):
+    return mse(labels, preout, activation, mask)
+
+
+@register("mae")
+@register("l1")
+def mae(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean(jnp.abs(out - labels), mask)
+
+
+@register("mape")
+@register("mean_absolute_percentage_error")
+def mape(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean(100.0 * jnp.abs((out - labels) / (labels + _EPS)), mask)
+
+
+@register("msle")
+@register("mean_squared_logarithmic_error")
+def msle(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean(
+        (jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS))) ** 2,
+        mask)
+
+
+@register("xent")
+def xent(labels, preout, activation="sigmoid", mask=None):
+    """Binary cross-entropy. Fused stable form when activation is sigmoid."""
+    if (isinstance(activation, str) and activation.lower() == "sigmoid"):
+        # log(1+exp(-|x|)) formulation
+        per = jnp.maximum(preout, 0) - preout * labels + jnp.log1p(jnp.exp(-jnp.abs(preout)))
+    else:
+        out = jnp.clip(activations.get(activation)(preout), _EPS, 1 - _EPS)
+        per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
+    return _apply_mask_and_mean(per, mask)
+
+
+@register("mcxent")
+@register("negativeloglikelihood")
+def mcxent(labels, preout, activation="softmax", mask=None):
+    """Multi-class cross-entropy; fused log-softmax when activation is softmax."""
+    if isinstance(activation, str) and activation.lower() == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+        per = -(labels * logp)
+    else:
+        out = jnp.clip(activations.get(activation)(preout), _EPS, 1.0)
+        per = -(labels * jnp.log(out))
+    return _apply_mask_and_mean(per, mask)
+
+
+@register("sparse_mcxent")
+def sparse_mcxent(labels, preout, activation="softmax", mask=None):
+    """labels are integer class indices [batch, ...]."""
+    logp = jax.nn.log_softmax(preout, axis=-1)
+    per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return _apply_mask_and_mean(per[..., None], mask)
+
+
+@register("hinge")
+def hinge(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    # labels in {-1, +1} (reference converts 0/1)
+    lab = jnp.where(labels > 0, 1.0, -1.0)
+    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out), mask)
+
+
+@register("squared_hinge")
+def squared_hinge(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    lab = jnp.where(labels > 0, 1.0, -1.0)
+    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out) ** 2, mask)
+
+
+@register("kl_divergence")
+@register("kld")
+def kld(labels, preout, activation="softmax", mask=None):
+    out = jnp.clip(activations.get(activation)(preout), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    return _apply_mask_and_mean(lab * (jnp.log(lab) - jnp.log(out)), mask)
+
+
+@register("poisson")
+def poisson(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask)
+
+
+@register("cosine_proximity")
+def cosine_proximity(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    num = jnp.sum(labels * out, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + _EPS
+    return _apply_mask_and_mean((-num / den)[..., None], mask)
+
+
+@register("wasserstein")
+def wasserstein(labels, preout, activation="identity", mask=None):
+    out = activations.get(activation)(preout)
+    return _apply_mask_and_mean(labels * out, mask)
+
+
+@register("fmeasure")
+def fmeasure(labels, preout, activation="sigmoid", mask=None):
+    """Differentiable soft-F_beta loss (beta=1), reference LossFMeasure."""
+    out = activations.get(activation)(preout)
+    tp = jnp.sum(labels * out)
+    fp = jnp.sum((1 - labels) * out)
+    fn = jnp.sum(labels * (1 - out))
+    f1 = (2 * tp) / jnp.maximum(2 * tp + fp + fn, _EPS)
+    return 1.0 - f1

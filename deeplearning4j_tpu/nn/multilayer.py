@@ -1,0 +1,446 @@
+"""MultiLayerNetwork — sequential-stack network runtime.
+
+TPU-native re-design of ``nn/multilayer/MultiLayerNetwork.java:90``.  Where the
+reference drives per-layer Java loops (``feedForwardToLayer`` :903,
+``calcBackpropGradients`` :1282) with params as views into one flat array, the
+TPU design traces the whole forward+backward+update into ONE jitted XLA
+program:
+
+  - forward:   python loop over layer confs, unrolled at trace time (static)
+  - backward:  ``jax.value_and_grad`` over the whole stack (replaces the
+               hand-written backpropGradient chain)
+  - update:    optax transforms fused into the same program; buffer donation
+               gives in-place semantics (the flat param view's job)
+  - gradient normalization (``BaseMultiLayerUpdater.preApply`` :318) and
+    constraints run inside the same program.
+
+Param pytree layout: ``{"layer_0": {...}, "layer_1": {...}}`` keyed by position,
+so checkpoints are stable under layer renames (the reference's flat
+``coefficients.bin`` role is played by the serialized pytree; see
+utils/model_serializer.py).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .conf.multi_layer import MultiLayerConfiguration
+from .conf.schedules import resolve as resolve_schedule
+from .conf.updaters import Sgd, UpdaterConf
+from .layers.base import BaseLayerConf
+from ..train.listeners import TrainingListener
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# gradient normalization (reference BaseMultiLayerUpdater.preApply :318)
+# ---------------------------------------------------------------------------
+
+def apply_gradient_normalization(mode: Optional[str], threshold: float, grads):
+    if not mode or mode == "none":
+        return grads
+    mode = mode.lower()
+    leaves = jax.tree_util.tree_leaves(grads)
+    if mode == "renormalizel2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        return jax.tree_util.tree_map(lambda g: g / (norm + 1e-8), grads)
+    if mode == "renormalizel2perparamtype":
+        return jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-8), grads)
+    if mode == "clipelementwiseabsolutevalue":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == "clipl2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = jnp.minimum(1.0, threshold / (norm + 1e-8))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == "clipl2perparamtype":
+        def clip(g):
+            n = jnp.linalg.norm(g.reshape(-1))
+            return g * jnp.minimum(1.0, threshold / (n + 1e-8))
+        return jax.tree_util.tree_map(clip, grads)
+    raise ValueError(f"unknown gradient normalization '{mode}'")
+
+
+class MultiLayerNetwork:
+    """Sequential network: init → fit/output/score/evaluate."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        conf.resolve()
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {}
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self.last_batch_size = 0
+        self.listeners: List[TrainingListener] = []
+        self._score = float("nan")
+        self._tx = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "MultiLayerNetwork":
+        key = jax.random.PRNGKey(self.conf.seed)
+        self.params, self.state = {}, {}
+        for i, lc in enumerate(self.layers):
+            key, sub = jax.random.split(key)
+            itype = self.conf.layer_input_types[i] if self.conf.layer_input_types else None
+            v = lc.init(sub, itype)
+            self.params[f"layer_{i}"] = v.get("params", {})
+            self.state[f"layer_{i}"] = v.get("state", {})
+        self._tx = self._build_tx()
+        self.opt_state = self._tx.init(self.params)
+        return self
+
+    def _default_updater(self) -> UpdaterConf:
+        u = self.conf.defaults.get("updater")
+        return u if u is not None else Sgd(learning_rate=0.1)
+
+    def _build_tx(self) -> optax.GradientTransformation:
+        """One optax transform; per-layer overrides via multi_transform
+        (the reference's per-UpdaterBlock machinery,
+        ``nn/updater/BaseMultiLayerUpdater.java:64-138``)."""
+        default_u = self._default_updater()
+        has_override = any(
+            isinstance(lc, BaseLayerConf) and (lc.updater is not None or
+                                               lc.bias_updater is not None)
+            for lc in self.layers)
+        if not has_override:
+            return default_u.to_optax()
+
+        transforms = {"default": default_u.to_optax()}
+        labels = {}
+        for i, lc in enumerate(self.layers):
+            lname = f"layer_{i}"
+            layer_params = self.params.get(lname, {})
+            lu = getattr(lc, "updater", None) or default_u
+            bu = getattr(lc, "bias_updater", None)
+            wl = f"{lname}/w"
+            transforms[wl] = lu.to_optax()
+            lab = {}
+            for pname in layer_params:
+                if bu is not None and pname in BaseLayerConf._BIAS_PARAMS:
+                    bl = f"{lname}/b"
+                    transforms[bl] = bu.to_optax()
+                    lab[pname] = bl
+                else:
+                    lab[pname] = wl
+            labels[lname] = lab
+        return optax.multi_transform(transforms, labels)
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, state, x, *, train: bool, key, mask=None,
+                 to_layer: Optional[int] = None, collect: bool = False):
+        """Trace the stack; returns (final_activation_or_list, new_state)."""
+        n = len(self.layers) if to_layer is None else to_layer
+        new_state = dict(state)
+        acts = []
+        h = x
+        for i in range(n):
+            lc = self.layers[i]
+            pp = self.conf.preprocessor(i)
+            if pp is not None:
+                h = pp.pre_process(h, mask)
+                if mask is not None:
+                    itype = self.conf.layer_input_types[i] if self.conf.layer_input_types else None
+                    mask = pp.feed_forward_mask(mask, itype)
+            lkey = jax.random.fold_in(key, i) if key is not None else None
+            variables = {"params": params.get(f"layer_{i}", {}),
+                         "state": state.get(f"layer_{i}", {})}
+            h, lstate = lc.apply(variables, h, train=train, key=lkey, mask=mask)
+            new_state[f"layer_{i}"] = lstate
+            if mask is not None:
+                mask = lc.feed_forward_mask(mask, None)
+            if collect:
+                acts.append(h)
+        return (acts if collect else h), new_state
+
+    def _loss(self, params, state, x, y, *, train: bool, key, mask=None,
+              label_mask=None):
+        """Forward to last layer's loss + regularization (reference
+        computeGradientAndScore, MultiLayerNetwork.java:2206)."""
+        n = len(self.layers)
+        h, new_state = self._forward(params, state, x, train=train, key=key,
+                                     mask=mask, to_layer=n - 1)
+        out_conf = self.layers[-1]
+        if not hasattr(out_conf, "compute_loss"):
+            raise ValueError(
+                f"last layer '{out_conf.name}' is not an output layer")
+        pp = self.conf.preprocessor(n - 1)
+        if pp is not None:
+            h = pp.pre_process(h, mask)
+        lkey = jax.random.fold_in(key, n - 1) if key is not None else None
+        variables = {"params": params.get(f"layer_{n-1}", {}),
+                     "state": state.get(f"layer_{n-1}", {})}
+        loss = out_conf.compute_loss(variables, h, y, train=train, key=lkey,
+                                     mask=label_mask)
+        reg = jnp.zeros(())
+        for i, lc in enumerate(self.layers):
+            lp = params.get(f"layer_{i}", {})
+            if lp:
+                reg = reg + lc.regularization_score(lp)
+        return loss + reg, new_state
+
+    # ---------------------------------------------------------- public API
+    def output(self, x, train: bool = False) -> Array:
+        """Forward pass (reference ``output(INDArray, train)``). train=True
+        keeps stochastic regularization (dropout) active — MC-dropout style."""
+        if train:
+            fn = self._get_jitted("output_train")
+            self._rng, key = jax.random.split(self._rng)
+            y, _ = fn(self.params, self.state, jnp.asarray(x), key)
+        else:
+            fn = self._get_jitted("output")
+            y, _ = fn(self.params, self.state, jnp.asarray(x))
+        return y
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """All layer activations (reference ``feedForward``)."""
+        acts, _ = self._forward(self.params, self.state, jnp.asarray(x),
+                                train=train, key=None, collect=True)
+        return acts
+
+    def score(self, dataset=None, x=None, y=None) -> float:
+        """Loss on a dataset (reference ``score(DataSet)``)."""
+        if dataset is not None:
+            x, y, _, _ = self._normalize_batch(dataset)
+        fn = self._get_jitted("score")
+        loss, _ = fn(self.params, self.state, jnp.asarray(x), jnp.asarray(y))
+        return float(loss)
+
+    def _get_jitted(self, kind: str):
+        if kind in self._jit_cache:
+            return self._jit_cache[kind]
+        if kind == "output":
+            @jax.jit
+            def fn(params, state, x):
+                return self._forward(params, state, x, train=False, key=None)
+        elif kind == "output_train":
+            @jax.jit
+            def fn(params, state, x, key):
+                return self._forward(params, state, x, train=True, key=key)
+        elif kind == "score":
+            @jax.jit
+            def fn(params, state, x, y):
+                return self._loss(params, state, x, y, train=False, key=None)
+        elif kind == "train_step":
+            fn = self._make_train_step()
+        else:
+            raise KeyError(kind)
+        self._jit_cache[kind] = fn
+        return fn
+
+    def _make_train_step(self):
+        gn_mode = self.conf.defaults.get("gradient_normalization")
+        gn_thr = float(self.conf.defaults.get("gradient_normalization_threshold", 1.0))
+        tx = self._tx
+
+        def step(params, state, opt_state, key, x, y, mask, label_mask):
+            def loss_fn(p):
+                return self._loss(p, state, x, y, train=True, key=key,
+                                  mask=mask, label_mask=label_mask)
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            # per-layer preApply: a layer's own setting REPLACES the global one
+            # (reference semantics — normalization configured per layer conf)
+            for i, lc in enumerate(self.layers):
+                m = getattr(lc, "gradient_normalization", None) or gn_mode
+                if m:
+                    t = getattr(lc, "gradient_normalization_threshold", None)
+                    t = float(t) if t is not None and getattr(
+                        lc, "gradient_normalization", None) else gn_thr
+                    grads[f"layer_{i}"] = apply_gradient_normalization(
+                        m, t, grads[f"layer_{i}"])
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # constraints (reference applyConstraints after step)
+            for i, lc in enumerate(self.layers):
+                cs = getattr(lc, "constraints", None)
+                if cs:
+                    lname = f"layer_{i}"
+                    lp = dict(new_params[lname])
+                    for c in cs:
+                        for pname in lp:
+                            is_bias = pname in BaseLayerConf._BIAS_PARAMS
+                            if (is_bias and c.apply_to_biases) or \
+                               (not is_bias and c.apply_to_weights):
+                                lp[pname] = c.apply(lp[pname])
+                    new_params[lname] = lp
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data=None, labels=None, *, epochs: int = 1,
+            mask=None, label_mask=None) -> "MultiLayerNetwork":
+        """Train. ``data`` may be (x, y) arrays or an iterable of batches
+        (the DataSetIterator role)."""
+        from ..data.dataset import DataSet
+        if self.params == {}:
+            self.init()
+        if labels is not None:
+            batches_factory = lambda: [(data, labels, mask, label_mask)]
+        elif isinstance(data, DataSet):
+            batches_factory = lambda: [self._normalize_batch(data)]
+        elif hasattr(data, "reset") or hasattr(data, "__iter__"):
+            if not hasattr(data, "reset") and epochs > 1 and iter(data) is data:
+                # bare generator: can't be re-iterated per epoch; materialize
+                data = [self._normalize_batch(b) for b in data]
+                batches_factory = lambda: data
+            else:
+                src = data
+
+                def batches_factory():
+                    if hasattr(src, "reset"):
+                        src.reset()
+                    for b in src:
+                        yield self._normalize_batch(b)
+        else:
+            raise ValueError("fit() needs (x, y) or an iterator")
+
+        step_fn = self._get_jitted("train_step")
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self)
+            for batch in batches_factory():
+                x, y, m, lm = batch
+                self.last_batch_size = int(getattr(x, "shape", (0,))[0])
+                if self.conf.backprop_type == "tbptt" and \
+                        getattr(x, "ndim", 2) == 3 and \
+                        x.shape[1] > self.conf.tbptt_fwd_length:
+                    self._fit_tbptt(step_fn, x, y, m, lm)
+                    continue
+                self._rng, key = jax.random.split(self._rng)
+                self.params, self.state, self.opt_state, loss = step_fn(
+                    self.params, self.state, self.opt_state, key,
+                    jnp.asarray(x), jnp.asarray(y),
+                    None if m is None else jnp.asarray(m),
+                    None if lm is None else jnp.asarray(lm))
+                self._score = float(loss)
+                self.iteration += 1
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
+            for lst in self.listeners:
+                lst.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_tbptt(self, step_fn, x, y, mask, label_mask):
+        """Truncated BPTT: split the time axis into tbptt_fwd_length chunks
+        (reference ``doTruncatedBPTT``, MultiLayerNetwork.java:1393).
+
+        Note: chunk boundaries do not carry RNN state in this round (reference
+        carries rnnTimeStep state between chunks) — matches behaviour for
+        stateless-per-chunk training.
+        """
+        L = self.conf.tbptt_fwd_length
+        T = x.shape[1]
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            xm = None if mask is None else jnp.asarray(mask)[:, sl]
+            ym = None if label_mask is None else jnp.asarray(label_mask)[:, sl]
+            yc = jnp.asarray(y)[:, sl] if getattr(y, "ndim", 2) == 3 else jnp.asarray(y)
+            self._rng, key = jax.random.split(self._rng)
+            self.params, self.state, self.opt_state, loss = step_fn(
+                self.params, self.state, self.opt_state, key,
+                jnp.asarray(x)[:, sl], yc, xm, ym)
+            self._score = float(loss)
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, self.epoch)
+
+    @staticmethod
+    def _normalize_batch(b):
+        if isinstance(b, (tuple, list)):
+            if len(b) == 2:
+                return b[0], b[1], None, None
+            if len(b) == 4:
+                return tuple(b)
+        if hasattr(b, "features"):
+            return (b.features, b.labels,
+                    getattr(b, "features_mask", None),
+                    getattr(b, "labels_mask", None))
+        raise ValueError(f"cannot interpret batch of type {type(b)}")
+
+    # ------------------------------------------------------------- queries
+    def get_score(self) -> float:
+        return self._score
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def params_flat(self) -> np.ndarray:
+        """Flat param vector — serialization/compat view, NOT a runtime
+        invariant (see SURVEY §7 'hardest parts')."""
+        leaves = []
+        for i in range(len(self.layers)):
+            lp = self.params.get(f"layer_{i}", {})
+            for name in sorted(lp):
+                leaves.append(np.asarray(lp[name]).reshape(-1))
+        return np.concatenate(leaves) if leaves else np.zeros(0, np.float32)
+
+    def evaluate(self, iterator_or_x, y=None):
+        from ..evaluation.classification import Evaluation
+        ev = Evaluation()
+        for x, yy in self._eval_batches(iterator_or_x, y):
+            ev.eval(np.asarray(yy), np.asarray(self.output(x)))
+        return ev
+
+    def evaluate_regression(self, iterator_or_x, y=None):
+        from ..evaluation.regression import RegressionEvaluation
+        ev = RegressionEvaluation()
+        for x, yy in self._eval_batches(iterator_or_x, y):
+            ev.eval(np.asarray(yy), np.asarray(self.output(x)))
+        return ev
+
+    def evaluate_roc(self, iterator_or_x, y=None, threshold_steps: int = 0):
+        from ..evaluation.roc import ROC
+        ev = ROC(threshold_steps)
+        for x, yy in self._eval_batches(iterator_or_x, y):
+            ev.eval(np.asarray(yy), np.asarray(self.output(x)))
+        return ev
+
+    def _eval_batches(self, it, y):
+        if y is not None:
+            yield it, y
+            return
+        if hasattr(it, "reset"):
+            it.reset()
+        for b in it:
+            x, yy, _, _ = self._normalize_batch(b)
+            yield x, yy
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        other = MultiLayerNetwork(copy.deepcopy(self.conf))
+        # REAL copies: the jitted train step donates the original's buffers
+        # (donate_argnums), so aliasing them would leave the clone holding
+        # deleted arrays after the original trains.
+        copy_tree = lambda t: jax.tree_util.tree_map(lambda a: jnp.array(a), t)
+        other.params = copy_tree(self.params)
+        other.state = copy_tree(self.state)
+        other._tx = other._build_tx()
+        if self.opt_state is not None:
+            other.opt_state = copy_tree(self.opt_state)
+        else:
+            other.init()
+        other.iteration = self.iteration
+        other.epoch = self.epoch
+        return other

@@ -1,0 +1,95 @@
+"""Weight initialization.
+
+Mirrors the reference's ``WeightInit`` scheme set
+(``deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/weights/WeightInit.java:68``
+and ``WeightInitUtil.java``) as pure functions over ``jax.random`` keys.
+Fan-in/fan-out semantics follow the reference: for a dense kernel of shape
+``(nin, nout)`` fan_in = nin, fan_out = nout; for conv kernels
+``(kh, kw, cin, cout)`` fan_in = kh*kw*cin, fan_out = kh*kw*cout.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .conf.distribution import Distribution
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1.0, 1.0
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    # conv kernels: spatial dims first, then (cin, cout) — NHWC/HWIO layout
+    receptive = 1.0
+    for d in shape[:-2]:
+        receptive *= d
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], scheme: str,
+                 distribution: Optional[Distribution] = None,
+                 dtype=jnp.float32) -> jax.Array:
+    """Create a weight array using a named scheme.
+
+    Supported schemes (reference ``WeightInit.java:68``): zero, ones, constant?,
+    sigmoid_uniform, normal (a.k.a. xavier_fan_in), lecun_normal, lecun_uniform,
+    uniform, xavier, xavier_uniform, xavier_fan_in, xavier_legacy, relu,
+    relu_uniform, identity, var_scaling_*, distribution.
+    """
+    scheme = scheme.lower()
+    fan_in, fan_out = _fans(shape)
+    shape = tuple(shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("identity init requires square 2d shape, got %s" % (shape,))
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a Distribution")
+        return distribution.sample(key, shape).astype(dtype)
+    if scheme == "sigmoid_uniform":
+        r = 4.0 * jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme in ("normal", "xavier_fan_in", "lecun_normal"):
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)
+    if scheme == "lecun_uniform":
+        r = jnp.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "uniform":
+        r = jnp.sqrt(1.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "xavier":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == "xavier_uniform":
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == "xavier_legacy":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(shape[0] + shape[-1])
+    if scheme == "relu":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+    if scheme == "relu_uniform":
+        r = jnp.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme.startswith("var_scaling"):
+        # var_scaling_{normal|uniform}_{fan_in|fan_out|fan_avg}
+        parts = scheme.split("_")
+        mode = "_".join(parts[3:]) or "fan_in"
+        dist = parts[2] if len(parts) > 2 else "normal"
+        n = {"fan": fan_in, "fan_in": fan_in, "fan_out": fan_out,
+             "fan_avg": (fan_in + fan_out) / 2.0}.get(mode, fan_in)
+        if dist == "uniform":
+            r = jnp.sqrt(3.0 / n)
+            return jax.random.uniform(key, shape, dtype, -r, r)
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(n)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

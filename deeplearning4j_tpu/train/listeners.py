@@ -1,0 +1,153 @@
+"""Training listeners (callbacks).
+
+Analogue of ``optimize/api/IterationListener.java`` / ``TrainingListener.java``
+and the impls in ``optimize/listeners/``: ScoreIterationListener,
+PerformanceListener, EvaluativeListener, CollectScoresIterationListener,
+TimeIterationListener, SleepyTrainingListener, ComposableIterationListener.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger("deeplearning4j_tpu.train")
+
+
+class TrainingListener:
+    """Base callback; all hooks optional (reference TrainingListener.java)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.get_score())
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput: samples/sec, batches/sec
+    (reference ``optimize/listeners/PerformanceListener.java:19,48-96``)."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False,
+                 batch_size_fn: Optional[Callable] = None):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self.batch_size_fn = batch_size_fn
+        self._last_time = None
+        self._last_iter = 0
+        self.samples_per_sec = float("nan")
+        self.batches_per_sec = float("nan")
+        self.last_batch_size = 0
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.time()
+        if self.batch_size_fn is not None:
+            self.last_batch_size = self.batch_size_fn(model)
+        else:
+            self.last_batch_size = getattr(model, "last_batch_size", 0)
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = max(now - self._last_time, 1e-9)
+            iters = iteration - self._last_iter
+            self.batches_per_sec = iters / dt
+            if self.last_batch_size:
+                self.samples_per_sec = self.last_batch_size * iters / dt
+            msg = (f"iteration {iteration}; iterations/sec: "
+                   f"{self.batches_per_sec:.3f}; samples/sec: {self.samples_per_sec:.3f}")
+            if self.report_score:
+                msg += f"; score: {model.get_score()}"
+            log.info(msg)
+        if iteration % self.frequency == 0:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect (iteration, score) pairs (reference CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.get_score()))
+
+
+class TimeIterationListener(TrainingListener):
+    """Estimate remaining time (reference TimeIterationListener)."""
+
+    def __init__(self, iteration_count: int, frequency: int = 50):
+        self.iteration_count = iteration_count
+        self.frequency = max(1, frequency)
+        self.start = time.time()
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.time() - self.start
+            remaining = elapsed / iteration * (self.iteration_count - iteration)
+            log.info("Remaining time: %d min %d sec", remaining // 60, remaining % 60)
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Throttle training (reference SleepyTrainingListener) — debugging aid."""
+
+    def __init__(self, timer_iteration_ms: float = 0.0, timer_epoch_ms: float = 0.0):
+        self.timer_iteration_ms = timer_iteration_ms
+        self.timer_epoch_ms = timer_epoch_ms
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.timer_iteration_ms > 0:
+            time.sleep(self.timer_iteration_ms / 1000.0)
+
+    def on_epoch_end(self, model):
+        if self.timer_epoch_ms > 0:
+            time.sleep(self.timer_epoch_ms / 1000.0)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodically evaluate on a held-out iterator (reference EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int = 100, print_report: bool = True):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.print_report = print_report
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.last_evaluation = model.evaluate(self.iterator)
+            if self.print_report:
+                log.info("Evaluation at iteration %d:\n%s", iteration,
+                         self.last_evaluation.stats())
+
+
+class ComposableIterationListener(TrainingListener):
+    def __init__(self, *listeners):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, epoch):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, epoch)

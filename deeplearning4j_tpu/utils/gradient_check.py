@@ -1,0 +1,104 @@
+"""Numerical gradient checking — the framework's primary correctness oracle.
+
+Analogue of ``gradientcheck/GradientCheckUtil.java:112`` (central-difference
+loop :207-222): compare analytic gradients (here ``jax.grad`` over the whole
+network loss) against central differences in float64, with per-parameter
+relative-error thresholds.  Used by the test suite exactly as the reference's
+13 gradient-check suites use GradientCheckUtil.
+
+Runs in float64 (enable via ``jax.config.update('jax_enable_x64', True)`` in
+the test conftest) on small nets — same recipe as the reference (double
+precision, exact thresholds).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(net, x, y, *, epsilon: float = 1e-6,
+                    max_rel_error: float = 1e-3, min_abs_error: float = 1e-8,
+                    mask=None, label_mask=None, print_results: bool = False,
+                    subset: Optional[int] = None, seed: int = 12345) -> bool:
+    """Check d(loss)/d(params) for a MultiLayerNetwork (or compatible).
+
+    subset: if set, check only this many randomly-chosen parameters per layer
+    (the reference checks all params of small nets; subset keeps big nets fast).
+    """
+    if not net.params:
+        net.init()
+    params = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float64), net.params)
+    state = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float64) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        net.state)
+    x = jnp.asarray(x, jnp.float64)
+    y = jnp.asarray(y, jnp.float64)
+
+    @jax.jit
+    def loss_fn(p):
+        # train=False: dropout/noise off; BN uses batch stats only if training,
+        # reference gradient checks also disable stochastic regularization.
+        loss, _ = net._loss(p, state, x, y, train=False, key=None,
+                            mask=mask, label_mask=label_mask)
+        return loss
+
+    analytic = jax.grad(loss_fn)(params)
+    return _check_gradients_impl(loss_fn, params, analytic, epsilon,
+                                 max_rel_error, min_abs_error, print_results,
+                                 subset, seed)
+
+
+def _check_gradients_impl(loss_fn, params, analytic, epsilon, max_rel_error,
+                          min_abs_error, print_results, subset, seed) -> bool:
+    flat_params, treedef = jax.tree_util.tree_flatten(params)
+    flat_grads = jax.tree_util.tree_leaves(analytic)
+    paths = [jax.tree_util.keystr(kp)
+             for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0]]
+    rng = np.random.default_rng(seed)
+    fails = 0
+    checked = 0
+    max_err_seen = 0.0
+
+    arrays = [np.asarray(p, np.float64) for p in flat_params]
+
+    def loss_at(li, idx, delta):
+        a = arrays[li].copy()
+        a.reshape(-1)[idx] += delta
+        leaves = list(flat_params)
+        leaves[li] = jnp.asarray(a)
+        return float(loss_fn(jax.tree_util.tree_unflatten(treedef, leaves)))
+
+    for li, (pa, ga) in enumerate(zip(arrays, flat_grads)):
+        ga_flat = np.asarray(ga, np.float64).reshape(-1)
+        n = pa.size
+        if subset is not None and n > subset:
+            indices = rng.choice(n, subset, replace=False)
+        else:
+            indices = np.arange(n)
+        for idx in indices:
+            plus = loss_at(li, idx, epsilon)
+            minus = loss_at(li, idx, -epsilon)
+            numeric = (plus - minus) / (2 * epsilon)
+            a = ga_flat[idx]
+            abs_err = abs(a - numeric)
+            denom = abs(a) + abs(numeric)
+            rel_err = abs_err / denom if denom > 0 else 0.0
+            checked += 1
+            if rel_err > max_err_seen:
+                max_err_seen = rel_err
+            if rel_err > max_rel_error and abs_err > min_abs_error:
+                fails += 1
+                if print_results:
+                    print(f"FAIL param {paths[li]}[{idx}]: analytic={a:.8e} "
+                          f"numeric={numeric:.8e} relErr={rel_err:.4e}")
+            elif print_results:
+                print(f"ok   param {paths[li]}[{idx}]: analytic={a:.8e} "
+                      f"numeric={numeric:.8e} relErr={rel_err:.4e}")
+    if print_results or fails:
+        print(f"gradient check: {checked - fails}/{checked} passed "
+              f"(max rel err {max_err_seen:.4e})")
+    return fails == 0

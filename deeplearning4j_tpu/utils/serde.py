@@ -1,0 +1,100 @@
+"""JSON/YAML serialization for config dataclasses.
+
+Plays the role Jackson plays in the reference (``nn/conf/serde/``,
+``MultiLayerConfiguration.toJson/fromJson`` at
+``nn/conf/MultiLayerConfiguration.java:120,138``): every config object
+round-trips through plain JSON with an ``@class`` tag, and deserialization is
+version-tolerant — unknown fields are dropped with a warning rather than
+failing, mirroring the reference's legacy-format deserializers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Dict, Type
+
+log = logging.getLogger(__name__)
+
+_CLASS_REGISTRY: Dict[str, Type] = {}
+
+
+def register_serde(cls):
+    """Class decorator: make a dataclass JSON round-trippable by @class tag."""
+    _CLASS_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def lookup_class(name: str):
+    return _CLASS_REGISTRY.get(name)
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Recursively convert registered dataclasses / containers to JSON-able."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: to_jsonable(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d = {"@class": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = to_jsonable(getattr(obj, f.name))
+        return d
+    # numpy / jax scalars
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"cannot serialize {type(obj)}")
+
+
+def from_jsonable(d: Any) -> Any:
+    """Inverse of to_jsonable. Unknown fields are ignored (version tolerance)."""
+    if isinstance(d, list):
+        return [from_jsonable(v) for v in d]
+    if isinstance(d, dict):
+        if "@class" in d:
+            name = d["@class"]
+            cls = _CLASS_REGISTRY.get(name)
+            if cls is None:
+                raise ValueError(f"unknown @class '{name}' in config json")
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {}
+            for k, v in d.items():
+                if k == "@class":
+                    continue
+                if k not in field_names:
+                    log.warning("dropping unknown field %s.%s during deserialization",
+                                name, k)
+                    continue
+                kwargs[k] = from_jsonable(v)
+            obj = cls(**kwargs)
+            return obj
+        return {k: from_jsonable(v) for k, v in d.items()}
+    return d
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps(to_jsonable(obj), indent=indent)
+
+
+def from_json(s: str) -> Any:
+    return from_jsonable(json.loads(s))
+
+
+def to_yaml(obj: Any) -> str:
+    try:
+        import yaml
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("pyyaml not available") from e
+    return yaml.safe_dump(to_jsonable(obj), sort_keys=False)
+
+
+def from_yaml(s: str) -> Any:
+    try:
+        import yaml
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("pyyaml not available") from e
+    return from_jsonable(yaml.safe_load(s))

@@ -1,0 +1,22 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual devices (multi-chip sharding validated without
+TPU hardware — same technique the driver's dryrun uses) and float64 enabled
+for gradient checks (the reference's oracle also runs in double precision,
+``gradientcheck/GradientCheckUtil.java``).
+
+NOTE: this environment preloads an 'axon' TPU PJRT hook via sitecustomize
+which snapshots JAX_PLATFORMS at interpreter start; os.environ changes are too
+late, so the platform MUST be forced via jax.config.update — otherwise the
+first jax op dials the TPU relay (slow/hanging when wedged).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

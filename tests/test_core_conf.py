@@ -1,0 +1,142 @@
+"""Core config system tests: serde round-trip, shape inference, defaults.
+
+Mirrors reference test intent: config JSON round-trip
+(MultiLayerConfiguration.toJson/fromJson) and InputType shape inference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn import activations, losses
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Sgd, by_name
+from deeplearning4j_tpu.nn.conf.schedules import (ExponentialSchedule,
+                                                  StepSchedule)
+from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer, OutputLayer)
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+def build_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(Adam(learning_rate=1e-3))
+            .weight_init("xavier")
+            .l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_out=20, activation="relu"))
+            .layer(DenseLayer(n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_shape_inference_and_defaults():
+    conf = build_conf()
+    assert conf.layers[0].n_in == 4
+    assert conf.layers[1].n_in == 20
+    assert conf.layers[2].n_in == 10
+    # global default inherited
+    assert conf.layers[0].l2 == 1e-4
+    assert isinstance(conf.defaults["updater"], Adam)
+
+
+def test_json_roundtrip():
+    conf = build_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert len(conf2.layers) == 3
+    assert conf2.layers[0].n_in == 4
+    assert conf2.layers[2].loss == "mcxent"
+    assert conf2.seed == 42
+    # round-trip idempotent
+    assert conf2.to_json() == js
+
+
+def test_yaml_roundtrip():
+    conf = build_conf()
+    y = conf.to_yaml()
+    conf2 = MultiLayerConfiguration.from_yaml(y)
+    assert conf2.layers[1].n_out == 10
+
+
+def test_unknown_field_tolerated():
+    import json
+    conf = build_conf()
+    d = json.loads(conf.to_json())
+    d["layers"][0]["brand_new_field"] = 123
+    conf2 = MultiLayerConfiguration.from_json(json.dumps(d))
+    assert conf2.layers[0].n_out == 20
+
+
+def test_num_params():
+    conf = build_conf()
+    net = MultiLayerNetwork(conf).init()
+    assert net.num_params() == (4 * 20 + 20) + (20 * 10 + 10) + (10 * 3 + 3)
+
+
+def test_activations_registry():
+    x = jnp.linspace(-2, 2, 11)
+    for name in activations.names():
+        y = activations.get(name)(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+    assert float(activations.get("relu")(jnp.asarray(-1.0))) == 0.0
+
+
+def test_weight_init_schemes():
+    key = jax.random.PRNGKey(0)
+    for scheme in ["xavier", "xavier_uniform", "relu", "relu_uniform", "uniform",
+                   "lecun_normal", "lecun_uniform", "normal", "zero", "ones",
+                   "sigmoid_uniform", "var_scaling_normal_fan_avg"]:
+        w = init_weights(key, (50, 40), scheme)
+        assert w.shape == (50, 40)
+    assert float(jnp.sum(init_weights(key, (5, 5), "zero"))) == 0.0
+    ident = init_weights(key, (4, 4), "identity")
+    assert np.allclose(np.asarray(ident), np.eye(4))
+    # xavier variance approx 2/(fan_in+fan_out)
+    w = init_weights(key, (500, 300), "xavier")
+    assert abs(float(jnp.var(w)) - 2.0 / 800) < 5e-4
+
+
+def test_updater_by_name():
+    for name in ["sgd", "adam", "adamax", "adadelta", "nesterovs", "nadam",
+                 "adagrad", "rmsprop", "none", "amsgrad"]:
+        u = by_name(name, learning_rate=0.01)
+        tx = u.to_optax()
+        assert tx is not None
+
+
+def test_schedules():
+    s = StepSchedule(initial_value=0.1, decay_rate=0.5, step=10)
+    assert float(s.value(0)) == pytest.approx(0.1)
+    assert float(s.value(10)) == pytest.approx(0.05)
+    e = ExponentialSchedule(initial_value=1.0, gamma=0.9)
+    assert float(e.value(2)) == pytest.approx(0.81)
+
+
+def test_losses_registry():
+    key = jax.random.PRNGKey(3)
+    pre = jax.random.normal(key, (8, 5))
+    lab_onehot = jax.nn.one_hot(jnp.arange(8) % 5, 5)
+    for name in ["mse", "mae", "xent", "mcxent", "hinge", "squared_hinge",
+                 "kl_divergence", "poisson", "cosine_proximity", "mape", "msle"]:
+        act = "sigmoid" if name in ("xent",) else "softmax" if name in (
+            "mcxent", "kl_divergence") else "sigmoid" if name in ("poisson", "msle") else "identity"
+        v = losses.get(name)(lab_onehot, pre, act)
+        assert jnp.isfinite(v), name
+    # fused mcxent == explicit form
+    explicit = float(jnp.mean(-jnp.sum(lab_onehot * jnp.log(jax.nn.softmax(pre)), axis=1)))
+    fused = float(losses.get("mcxent")(lab_onehot, pre, "softmax"))
+    assert fused == pytest.approx(explicit, rel=1e-5)
+
+
+def test_input_type():
+    it = InputType.convolutional(28, 28, 1)
+    assert it.flat_size() == 784
+    assert it.shape(32) == (32, 28, 28, 1)
+    r = InputType.recurrent(10, 5)
+    assert r.shape(4) == (4, 5, 10)
+    x = jnp.zeros((2, 28, 28, 3))
+    assert InputType.infer(x).kind == "cnn"

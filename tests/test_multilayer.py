@@ -1,0 +1,209 @@
+"""MultiLayerNetwork end-to-end: training reduces loss, evaluation works,
+gradient checks pass (the reference's primary correctness oracle).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.data.dataset import INDArrayDataSetIterator
+from deeplearning4j_tpu.data.mnist import IrisDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Nesterovs, NoOp, Sgd
+from deeplearning4j_tpu.nn.layers.feedforward import (ActivationLayer,
+                                                      DenseLayer,
+                                                      DropoutLayer,
+                                                      EmbeddingLayer,
+                                                      LossLayer, OutputLayer)
+from deeplearning4j_tpu.train.listeners import (CollectScoresIterationListener,
+                                                ScoreIterationListener)
+from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+
+def iris_net(updater=None, seed=42, **defaults):
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or Adam(learning_rate=0.02)))
+    conf = (b.list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_fit_reduces_score_iris():
+    net = iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    ds = next(iter(it))
+    s0 = net.score(x=ds.features, y=ds.labels)
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    net.fit(it, epochs=60)
+    s1 = net.score(x=ds.features, y=ds.labels)
+    assert s1 < s0 * 0.5
+    assert len(collector.scores) > 0
+
+
+def test_evaluate_iris_accuracy():
+    net = iris_net()
+    it = IrisDataSetIterator(batch_size=150)
+    net.fit(it, epochs=120)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9
+    assert 0.0 <= ev.f1() <= 1.0
+    assert "Accuracy" in ev.stats()
+
+
+def test_mnist_mlp_learns():
+    train = MnistDataSetIterator(batch_size=128, train=True, num_examples=2048)
+    test = MnistDataSetIterator(batch_size=256, train=False, num_examples=512)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(train, epochs=3)
+    ev = net.evaluate(test)
+    assert ev.accuracy() > 0.6  # synthetic blobs are easy; real MNIST also passes
+
+
+def test_output_shape_and_softmax():
+    net = iris_net()
+    x = np.random.default_rng(0).standard_normal((7, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (7, 3)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_gradient_check_dense_mcxent():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Sgd(learning_rate=0.1))
+            .dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 5))
+    y = np.eye(3)[rng.integers(0, 3, 4)]
+    assert check_gradients(net, x, y)
+
+
+@pytest.mark.parametrize("loss,act,out_dim", [
+    ("mse", "identity", 4),
+    ("mae", "tanh", 3),
+    ("xent", "sigmoid", 2),
+    ("hinge", "identity", 1),
+])
+def test_gradient_check_losses(loss, act, out_dim):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Sgd(learning_rate=0.1))
+            .dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=5, activation="sigmoid"))
+            .layer(OutputLayer(n_out=out_dim, activation=act, loss=loss))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, 3))
+    if loss in ("xent", "hinge"):
+        y = rng.integers(0, 2, (5, out_dim)).astype(float)
+    else:
+        y = rng.standard_normal((5, out_dim))
+    assert check_gradients(net, x, y)
+
+
+def test_gradient_check_with_l1_l2():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Sgd(learning_rate=0.1))
+            .l1(0.01).l2(0.02)
+            .dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 3)) * 2
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    assert check_gradients(net, x, y)
+
+
+def test_per_layer_updater_override():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(learning_rate=0.01))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu",
+                              updater=Nesterovs(learning_rate=0.05)))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                               updater=NoOp()))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    w_out_before = np.asarray(net.params["layer_1"]["W"]).copy()
+    w_hid_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    it = IrisDataSetIterator(batch_size=150)
+    net.fit(it, epochs=2)
+    # NoOp layer frozen, other layer trained
+    assert np.allclose(np.asarray(net.params["layer_1"]["W"]), w_out_before)
+    assert not np.allclose(np.asarray(net.params["layer_0"]["W"]), w_hid_before)
+
+
+def test_dropout_and_activation_layers():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Sgd(learning_rate=0.05))
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(ActivationLayer(activation="relu"))
+            .layer(DropoutLayer(dropout=0.5))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(batch_size=50)
+    net.fit(it, epochs=3)
+    out = np.asarray(net.output(np.zeros((2, 4), np.float32)))
+    assert out.shape == (2, 3)
+
+
+def test_embedding_layer():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .updater(Adam(learning_rate=0.05))
+            .list()
+            .layer(EmbeddingLayer(n_in=20, n_out=8))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 20, (16, 1)).astype(np.int32)
+    y = np.eye(4)[idx[:, 0] % 4]
+    s0 = net.score(x=idx, y=y)
+    for _ in range(60):
+        net.fit(idx, y)
+    assert net.score(x=idx, y=y) < s0 * 0.5
+
+
+def test_clone_independent():
+    net = iris_net()
+    clone = net.clone()
+    it = IrisDataSetIterator(batch_size=150)
+    net.fit(it, epochs=2)
+    # clone untouched by training the original
+    assert not np.allclose(np.asarray(net.params["layer_0"]["W"]),
+                           np.asarray(clone.params["layer_0"]["W"]))
