@@ -1,0 +1,57 @@
+"""Benchmark entry point for the driver.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures the flagship training-step throughput on whatever accelerator JAX
+sees (the driver runs this on one real TPU chip).  The reference publishes no
+absolute numbers (BASELINE.md), so ``vs_baseline`` is reported against the
+north-star proxy: examples/sec of the same jitted step, with 1.0 meaning the
+recorded round-0 CPU-reference figure (none yet → vs_baseline echoes value/
+BASELINE_EXAMPLES_PER_SEC when that constant is set, else 1.0).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Recorded once a prior round has produced a number to compare against.
+BASELINE_EXAMPLES_PER_SEC = None
+
+
+def build_model():
+    """Flagship bench model — upgraded as the zoo grows."""
+    from deeplearning4j_tpu.models import available_bench_model
+    return available_bench_model()
+
+
+def main():
+    model, batch = build_model()
+    x, y = batch
+    model.fit(x, y)  # compile + first step
+    step = model._get_jitted("train_step")
+
+    n_iter = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        model._rng, key = jax.random.split(model._rng)
+        model.params, model.state, model.opt_state, loss = step(
+            model.params, model.state, model.opt_state, key,
+            jnp.asarray(x), jnp.asarray(y), None, None)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = n_iter * x.shape[0] / dt
+    vs = (examples_per_sec / BASELINE_EXAMPLES_PER_SEC
+          if BASELINE_EXAMPLES_PER_SEC else 1.0)
+    print(json.dumps({
+        "metric": "train_examples_per_sec",
+        "value": round(float(examples_per_sec), 2),
+        "unit": "examples/sec",
+        "vs_baseline": round(float(vs), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

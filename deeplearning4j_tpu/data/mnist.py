@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .dataset import DataSet, DataSetIterator
+from .dataset import DataSet, DataSetIterator, INDArrayDataSetIterator
 
 MNIST_NUM_EXAMPLES = 60000
 MNIST_NUM_TEST = 10000
@@ -86,10 +86,11 @@ def _synthetic(train: bool, seed: int = 123) -> Tuple[np.ndarray, np.ndarray]:
     return images.clip(0, 255).astype(np.uint8), labels.astype(np.uint8)
 
 
-class MnistDataSetIterator(DataSetIterator):
+class MnistDataSetIterator(INDArrayDataSetIterator):
     """Reference-compatible MNIST iterator: features [batch, 784] in [0,1],
     labels one-hot [batch, 10] (``MnistDataSetIterator.java`` binarize=False
-    default)."""
+    default).  Batch slicing/shuffling is inherited from
+    INDArrayDataSetIterator (partial final batch kept)."""
 
     def __init__(self, batch_size: int, train: bool = True,
                  num_examples: Optional[int] = None, binarize: bool = False,
@@ -105,33 +106,16 @@ class MnistDataSetIterator(DataSetIterator):
         feats = images.astype(np.float32) / 255.0
         if binarize:
             feats = (feats > 0.5).astype(np.float32)
-        self.features = feats.reshape(len(feats), -1) if flatten else feats[..., None]
-        self.labels = np.eye(10, dtype=np.float32)[labels.astype(np.int64)]
-        self.batch_size = batch_size
-        self.shuffle = shuffle
-        self.seed = seed
-        self._epoch = 0
-
-    def batch(self):
-        return self.batch_size
+        features = feats.reshape(len(feats), -1) if flatten else feats[..., None]
+        labels_1hot = np.eye(10, dtype=np.float32)[labels.astype(np.int64)]
+        super().__init__(features, labels_1hot, batch_size,
+                         shuffle=shuffle, seed=seed)
 
     def total_examples(self):
         return len(self.features)
 
-    def reset(self):
-        self._epoch += 1
 
-    def __iter__(self):
-        n = len(self.features)
-        idx = np.arange(n)
-        if self.shuffle:
-            np.random.default_rng(self.seed + self._epoch).shuffle(idx)
-        for i in range(0, n - n % self.batch_size, self.batch_size):
-            sl = idx[i:i + self.batch_size]
-            yield DataSet(self.features[sl], self.labels[sl])
-
-
-class IrisDataSetIterator(DataSetIterator):
+class IrisDataSetIterator(INDArrayDataSetIterator):
     """Iris (reference ``datasets/iterator/impl/IrisDataSetIterator.java``).
     The 150-example Fisher iris table is small enough to embed parametrically:
     we regenerate it from the canonical per-class Gaussian stats when the CSV
@@ -156,14 +140,6 @@ class IrisDataSetIterator(DataSetIterator):
                 for c in range(3)])
             labels = np.repeat(np.arange(3), per)
         order = np.random.default_rng(seed).permutation(len(feats))
-        self.features = feats[order].astype(np.float32)
-        self.labels = np.eye(3, dtype=np.float32)[labels[order]]
-        self.batch_size = batch_size
-
-    def batch(self):
-        return self.batch_size
-
-    def __iter__(self):
-        for i in range(0, len(self.features), self.batch_size):
-            yield DataSet(self.features[i:i + self.batch_size],
-                          self.labels[i:i + self.batch_size])
+        super().__init__(feats[order].astype(np.float32),
+                         np.eye(3, dtype=np.float32)[labels[order]],
+                         batch_size)

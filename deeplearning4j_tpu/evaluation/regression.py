@@ -37,9 +37,9 @@ class RegressionEvaluation:
         if labels.ndim == 3:
             labels = labels.reshape(-1, labels.shape[-1])
             predictions = predictions.reshape(-1, predictions.shape[-1])
-            if mask is not None:
-                keep = np.asarray(mask).reshape(-1) > 0
-                labels, predictions = labels[keep], predictions[keep]
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
         if labels.ndim == 1:
             labels = labels[:, None]
             predictions = predictions[:, None]

@@ -66,6 +66,10 @@ class ROC:
             # [P(class0), P(class1)] convention: positive = column 1
             labels = labels[:, 1]
             predictions = predictions[:, 1]
+        elif labels.ndim == 2 and labels.shape[-1] > 2:
+            raise ValueError(
+                f"ROC is binary-only (got {labels.shape[-1]} output columns); "
+                "use ROCMultiClass (reference eval/ROC.java throws likewise)")
         labels = labels.reshape(-1)
         predictions = predictions.reshape(-1)
         if mask is not None:

@@ -1,0 +1,27 @@
+"""Model zoo (reference ``deeplearning4j-zoo``) + bench/flagship selection."""
+import numpy as np
+
+
+def available_bench_model():
+    """Best available model+batch for bench.py — upgraded as the zoo grows."""
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.conf.input_type import InputType
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).activation("relu").weight_init("xavier")
+            .updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(DenseLayer(n_out=1024))
+            .layer(DenseLayer(n_out=1024))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    batch = 512
+    x = rng.standard_normal((batch, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    return model, (x, y)

@@ -136,9 +136,17 @@ class ListBuilder:
         self._tbptt_back = 20
 
     def layer(self, conf: LayerConf, index: Optional[int] = None) -> "ListBuilder":
+        """Append, or place at ``index`` (reference ListBuilder.layer(int, Layer)
+        semantics: set the layer at that position, padding is not allowed)."""
         if conf.name is None:
-            conf.name = f"layer{len(self._layers)}"
-        self._layers.append(conf)
+            conf.name = f"layer{index if index is not None else len(self._layers)}"
+        if index is None or index == len(self._layers):
+            self._layers.append(conf)
+        elif 0 <= index < len(self._layers):
+            self._layers[index] = conf
+        else:
+            raise ValueError(
+                f"layer index {index} out of range (have {len(self._layers)} layers)")
         return self
 
     def set_input_type(self, itype: InputType) -> "ListBuilder":

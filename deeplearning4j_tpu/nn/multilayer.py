@@ -205,9 +205,13 @@ class MultiLayerNetwork:
         return y
 
     def feed_forward(self, x, train: bool = False) -> List[Array]:
-        """All layer activations (reference ``feedForward``)."""
+        """All layer activations (reference ``feedForward``). train=True keeps
+        stochastic regularization active (fresh RNG draw per call)."""
+        key = None
+        if train:
+            self._rng, key = jax.random.split(self._rng)
         acts, _ = self._forward(self.params, self.state, jnp.asarray(x),
-                                train=train, key=None, collect=True)
+                                train=train, key=key, collect=True)
         return acts
 
     def score(self, dataset=None, x=None, y=None) -> float:
@@ -339,7 +343,10 @@ class MultiLayerNetwork:
 
         Note: chunk boundaries do not carry RNN state in this round (reference
         carries rnnTimeStep state between chunks) — matches behaviour for
-        stateless-per-chunk training.
+        stateless-per-chunk training.  ``tbptt_back_length`` is accepted for
+        config parity but the backward window always equals the forward chunk
+        (the reference's default fwd==back case); a shorter backward window is
+        meaningless until cross-chunk state carry lands.
         """
         L = self.conf.tbptt_fwd_length
         T = x.shape[1]

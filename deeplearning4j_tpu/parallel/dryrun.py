@@ -1,0 +1,35 @@
+"""Multi-chip dry run used by the driver (``__graft_entry__.dryrun_multichip``).
+
+Builds an n-device mesh, shards the FULL training step (forward+backward+
+optimizer update) with real dp×tp shardings, and executes one step on tiny
+shapes.  Upgraded alongside the flagship model.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run(n_devices: int) -> None:
+    import jax
+
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(jax.devices())} "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+
+    from ..models import available_bench_model
+    from .mesh import make_mesh
+    from .wrapper import ParallelWrapper, megatron_dense_rule
+
+    tp = 2 if n_devices % 2 == 0 else 1
+    mesh = make_mesh(n_devices, tp=tp)
+
+    model, _ = available_bench_model()
+    rng = np.random.default_rng(0)
+    batch = max(8, n_devices)
+    x = rng.standard_normal((batch, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+
+    pw = ParallelWrapper(model, mesh, param_rule=megatron_dense_rule(model.params))
+    pw.fit(x, y)
+    assert np.isfinite(model.get_score()), "dry-run step produced non-finite loss"
