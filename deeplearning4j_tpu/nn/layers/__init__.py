@@ -1,0 +1,18 @@
+"""Layer configs/implementations (reference ``nn/conf/layers`` + ``nn/layers``)."""
+from .base import BaseLayerConf, LayerConf
+from .convolution import (Convolution1DLayer, ConvolutionLayer,
+                          Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
+                          Upsampling2D, ZeroPaddingLayer)
+from .feedforward import (ActivationLayer, DenseLayer, DropoutLayer,
+                          EmbeddingLayer, LossLayer, OutputLayer)
+from .normalization import BatchNormalization, LocalResponseNormalization
+from .pooling import GlobalPoolingLayer
+
+__all__ = [
+    "ActivationLayer", "BaseLayerConf", "BatchNormalization",
+    "Convolution1DLayer", "ConvolutionLayer", "DenseLayer", "DropoutLayer",
+    "EmbeddingLayer", "GlobalPoolingLayer", "LayerConf",
+    "LocalResponseNormalization", "LossLayer", "OutputLayer",
+    "Subsampling1DLayer", "SubsamplingLayer", "Upsampling1D", "Upsampling2D",
+    "ZeroPaddingLayer",
+]

@@ -1,0 +1,346 @@
+"""Convolution-family layers: Conv2D/Conv1D, pooling, padding, upsampling.
+
+Reference: ``nn/layers/convolution/ConvolutionLayer.java:53`` (im2col path +
+cuDNN helper hook), ``nn/conf/layers/{ConvolutionLayer,Convolution1DLayer,
+SubsamplingLayer,ZeroPaddingLayer,Upsampling2D}``, shape math in
+``util/ConvolutionUtils.java``.
+
+TPU-native design: no im2col and no helper plug-ins — ``lax.conv_general_dilated``
+IS the MXU fast path (XLA lowers it straight onto the systolic array), and
+``lax.reduce_window`` is the pooling primitive.  Layout is NHWC / HWIO
+(channel-minor = MXU lanes); the reference's NCHW is not supported on purpose.
+
+Convolution modes (reference ``nn/conf/ConvolutionMode.java``):
+  truncate — VALID padding, silently floor()ing leftover pixels (DL4J default)
+  strict   — VALID, but config-time error if the input doesn't tile exactly
+  same     — SAME padding, output = ceil(in/stride)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import BaseLayerConf, LayerConf
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def conv_output_size(size: int, k: int, s: int, p: int, d: int, mode: str,
+                     what: str = "input") -> int:
+    """Reference ``ConvolutionUtils.getOutputSize``."""
+    eff_k = k + (k - 1) * (d - 1)
+    if mode == "same":
+        return -(-size // s)  # ceil
+    out = (size + 2 * p - eff_k) // s + 1
+    if mode == "strict" and (size + 2 * p - eff_k) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.strict: {what} size {size} (+2*{p} pad) does not "
+            f"tile with kernel {k} (dilation {d}) stride {s}; use mode='truncate' "
+            "or 'same', or fix the sizes (reference ConvolutionUtils message)")
+    if out < 1:
+        raise ValueError(
+            f"{what} size {size} too small for kernel {k} stride {s} pad {p}")
+    return out
+
+
+def _conv_padding(mode: str, pad: Tuple[int, int]):
+    if mode == "same":
+        return "SAME"
+    return [(pad[0], pad[0]), (pad[1], pad[1])]
+
+
+@register_serde
+@dataclass
+class ConvolutionLayer(BaseLayerConf):
+    """2D convolution (reference ``nn/conf/layers/ConvolutionLayer``).
+
+    Params: W [kh, kw, c_in, c_out] (HWIO), b [c_out].
+    Input/output: NHWC.
+    """
+    INPUT_KIND = "cnn"
+
+    n_in: int = 0                 # input channels (inferred)
+    n_out: int = 0                # output channels
+    kernel_size: Sequence[int] = (5, 5)
+    stride: Sequence[int] = (1, 1)
+    padding: Sequence[int] = (0, 0)
+    dilation: Sequence[int] = (1, 1)
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            if itype.kind != "cnn":
+                raise ValueError(
+                    f"layer '{self.name}': conv layer expects CNN input, got {itype}")
+            self.n_in = itype.channels
+
+    def output_type(self, itype: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
+        oh = conv_output_size(itype.height, kh, sh, ph, dh,
+                              self.convolution_mode, f"layer '{self.name}' height")
+        ow = conv_output_size(itype.width, kw, sw, pw, dw,
+                              self.convolution_mode, f"layer '{self.name}' width")
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        if self.n_in <= 0 or self.n_out <= 0:
+            raise ValueError(
+                f"layer '{self.name}': n_in={self.n_in}, n_out={self.n_out} — "
+                "declare the network input type or set n_in explicitly")
+        kh, kw = _pair(self.kernel_size)
+        # fan-in/fan-out for init match the reference's conv param initializer
+        params = {"W": self.make_weight(key, (kh, kw, self.n_in, self.n_out))}
+        if self.has_bias:
+            params["b"] = self.make_bias((self.n_out,))
+        return {"params": params, "state": {}}
+
+    def _conv(self, x, w):
+        return lax.conv_general_dilated(
+            x, w,
+            window_strides=_pair(self.stride),
+            padding=_conv_padding(self.convolution_mode, _pair(self.padding)),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        params = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        z = self._conv(x.astype(params["W"].dtype), params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        return self.act_fn(z), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class Convolution1DLayer(BaseLayerConf):
+    """1D (temporal) convolution over RNN-format input [b, t, f]
+    (reference ``nn/conf/layers/Convolution1DLayer``)."""
+    INPUT_KIND = "rnn"
+
+    n_in: int = 0
+    n_out: int = 0
+    kernel_size: int = 5
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            self.n_in = itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        t = itype.timesteps
+        if t is not None and t > 0:
+            t = conv_output_size(t, self.kernel_size, self.stride, self.padding,
+                                 self.dilation, self.convolution_mode,
+                                 f"layer '{self.name}' time")
+        return InputType.recurrent(self.n_out, t if t else -1)
+
+    def init(self, key, itype):
+        if self.n_in <= 0 or self.n_out <= 0:
+            raise ValueError(f"layer '{self.name}': n_in/n_out unset")
+        params = {"W": self.make_weight(key, (self.kernel_size, self.n_in, self.n_out))}
+        if self.has_bias:
+            params["b"] = self.make_bias((self.n_out,))
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        params = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        pad = ("SAME" if self.convolution_mode == "same"
+               else [(self.padding, self.padding)])
+        z = lax.conv_general_dilated(
+            x.astype(params["W"].dtype), params["W"],
+            window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self.act_fn(z), variables.get("state", {})
+
+    def feed_forward_mask(self, mask, itype):
+        if mask is None or (self.stride == 1 and
+                            self.convolution_mode == "same"):
+            return mask
+        return None  # time length changed; mask no longer aligned
+
+
+@register_serde
+@dataclass
+class SubsamplingLayer(LayerConf):
+    """Spatial pooling (reference ``nn/conf/layers/SubsamplingLayer``):
+    MAX / AVG / SUM / PNORM over kernel windows, NHWC."""
+    INPUT_KIND = "cnn"
+
+    pooling_type: str = "max"     # max | avg | sum | pnorm
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: Sequence[int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+    eps: float = 1e-8
+
+    def output_type(self, itype: InputType) -> InputType:
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        oh = conv_output_size(itype.height, kh, sh, ph, 1,
+                              self.convolution_mode, f"layer '{self.name}' height")
+        ow = conv_output_size(itype.width, kw, sw, pw, 1,
+                              self.convolution_mode, f"layer '{self.name}' width")
+        return InputType.convolutional(oh, ow, itype.channels)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.convolution_mode == "same":
+            pads = "SAME"
+        else:
+            pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            if pt == "avg":
+                y = y / (kh * kw)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pads)
+            y = (y + self.eps) ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type '{self.pooling_type}'")
+        return y, variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class Subsampling1DLayer(LayerConf):
+    """Temporal pooling over [b, t, f] (reference Subsampling1DLayer)."""
+    INPUT_KIND = "rnn"
+
+    pooling_type: str = "max"
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+    eps: float = 1e-8
+
+    def output_type(self, itype: InputType) -> InputType:
+        t = itype.timesteps
+        if t is not None and t > 0:
+            t = conv_output_size(t, self.kernel_size, self.stride, self.padding,
+                                 1, self.convolution_mode, f"layer '{self.name}' time")
+        return InputType.recurrent(itype.size, t if t else -1)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        dims = (1, self.kernel_size, 1)
+        strides = (1, self.stride, 1)
+        if self.convolution_mode == "same":
+            pads = "SAME"
+        else:
+            pads = ((0, 0), (self.padding, self.padding), (0, 0))
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        elif pt in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+            if pt == "avg":
+                y = y / self.kernel_size
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pads)
+            y = (y + self.eps) ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type '{self.pooling_type}'")
+        return y, variables.get("state", {})
+
+    def feed_forward_mask(self, mask, itype):
+        if mask is None or (self.stride == 1 and
+                            self.convolution_mode == "same"):
+            return mask  # time axis unchanged — mask still aligned
+        return None
+
+
+@register_serde
+@dataclass
+class ZeroPaddingLayer(LayerConf):
+    """Spatial zero padding (reference ``nn/conf/layers/ZeroPaddingLayer``).
+    padding = (top, bottom, left, right) or (h, w)."""
+    INPUT_KIND = "cnn"
+
+    padding: Sequence[int] = (1, 1, 1, 1)
+
+    def _pads(self):
+        p = tuple(int(v) for v in self.padding)
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        if len(p) == 4:
+            return p
+        raise ValueError("padding must be (h, w) or (top, bottom, left, right)")
+
+    def output_type(self, itype: InputType) -> InputType:
+        t, b, l, r = self._pads()
+        return InputType.convolutional(itype.height + t + b,
+                                       itype.width + l + r, itype.channels)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        t, b, l, r = self._pads()
+        y = jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+        return y, variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class Upsampling2D(LayerConf):
+    """Nearest-neighbour upsampling (reference ``nn/conf/layers/Upsampling2D``)."""
+    INPUT_KIND = "cnn"
+
+    size: Sequence[int] = (2, 2)
+
+    def output_type(self, itype: InputType) -> InputType:
+        sh, sw = _pair(self.size)
+        return InputType.convolutional(itype.height * sh, itype.width * sw,
+                                       itype.channels)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class Upsampling1D(LayerConf):
+    """Temporal upsampling over [b, t, f]."""
+    INPUT_KIND = "rnn"
+
+    size: int = 2
+
+    def output_type(self, itype: InputType) -> InputType:
+        t = itype.timesteps
+        return InputType.recurrent(itype.size, t * self.size if t and t > 0 else -1)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), variables.get("state", {})

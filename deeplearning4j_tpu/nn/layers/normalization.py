@@ -1,0 +1,113 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference: ``nn/layers/normalization/BatchNormalization.java:41`` (+ cuDNN
+helper hook :55-65) and ``LocalResponseNormalization.java``.
+
+TPU-native: batch statistics are plain jnp reductions XLA fuses into the
+surrounding program (the cuDNN helper tier is unnecessary); running mean/var
+live in the layer's ``state`` pytree and are updated functionally — the new
+state is returned from ``apply`` and threaded by the network, replacing the
+reference's in-place global-stats mutation.  Under data parallelism the batch
+axis is sharded, so XLA computes *cross-replica* batch stats automatically
+when the reduction spans the mesh — sync batch-norm for free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import BaseLayerConf, LayerConf
+
+
+@register_serde
+@dataclass
+class BatchNormalization(BaseLayerConf):
+    """Batch norm over the channel/feature axis (NHWC: reduce N,H,W).
+
+    state: mean, var (running estimates, reference "global" stats).
+    params: gamma, beta (unless lock_gamma_beta).
+    decay matches the reference's exponential moving average semantics
+    (``BatchNormalization.java`` decay default 0.9).
+    """
+    INPUT_KIND = "any"  # works on ff [b,f] and cnn [b,h,w,c]
+
+    n_out: int = 0               # feature/channel count (inferred)
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_out == 0 or override:
+            self.n_out = itype.channels if itype.kind == "cnn" else itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def init(self, key, itype):
+        if self.n_out <= 0:
+            raise ValueError(
+                f"layer '{self.name}': feature count unknown — declare input type")
+        f = self.n_out
+        dt = self._dtype()
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((f,), self.gamma_init, dt),
+                      "beta": jnp.full((f,), self.beta_init, dt)}
+        state = {"mean": jnp.zeros((f,), dt), "var": jnp.ones((f,), dt)}
+        return {"params": params, "state": state}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        params, state = variables["params"], variables["state"]
+        axes = tuple(range(x.ndim - 1))  # all but channel-minor
+        if train and self.is_minibatch:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        return self.act_fn(xhat), new_state
+
+
+@register_serde
+@dataclass
+class LocalResponseNormalization(LayerConf):
+    """Across-channel LRN (reference
+    ``nn/layers/normalization/LocalResponseNormalization.java``):
+    y = x / (k + alpha * sum_{j in window} x_j^2)^beta, window of n channels.
+    """
+    INPUT_KIND = "cnn"
+
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+    n: int = 5
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        half = self.n // 2
+        sq = x * x
+        # channel-window running sum via reduce_window on the minor axis
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)))
+        y = x / jnp.power(self.k + self.alpha * summed, self.beta)
+        return y, variables.get("state", {})

@@ -1,0 +1,79 @@
+"""Global pooling (reference ``nn/layers/pooling/GlobalPoolingLayer.java``).
+
+Pools CNN activations [b, h, w, c] -> [b, c] or RNN activations
+[b, t, f] -> [b, f], with mask-aware reductions for variable-length time
+series (reference ``util/MaskedReductionUtil.java``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import LayerConf
+
+
+@register_serde
+@dataclass
+class GlobalPoolingLayer(LayerConf):
+    pooling_type: str = "max"    # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == "cnn":
+            return InputType.feed_forward(itype.channels)
+        if itype.kind == "rnn":
+            return InputType.feed_forward(itype.size)
+        if itype.kind == "cnn3d":
+            return InputType.feed_forward(itype.channels)
+        raise ValueError(f"global pooling over {itype.kind} input")
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        if x.ndim == 4:
+            axes = (1, 2)
+        elif x.ndim == 3:
+            axes = (1,)
+        elif x.ndim == 5:
+            axes = (1, 2, 3)
+        else:
+            raise ValueError(f"global pooling needs 3/4/5-d input, got {x.ndim}d")
+        pt = self.pooling_type.lower()
+
+        if mask is not None and x.ndim == 3:
+            # masked time reduction (reference MaskedReductionUtil)
+            m = mask.astype(x.dtype)
+            while m.ndim < x.ndim:
+                m = m[..., None]
+            if pt == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=axes)
+            elif pt == "sum":
+                y = jnp.sum(x * m, axis=axes)
+            elif pt == "avg":
+                y = jnp.sum(x * m, axis=axes) / jnp.maximum(
+                    jnp.sum(m, axis=axes), 1e-8)
+            elif pt == "pnorm":
+                p = float(self.pnorm)
+                y = jnp.sum(jnp.abs(x * m) ** p, axis=axes) ** (1.0 / p)
+            else:
+                raise ValueError(f"unknown pooling type '{self.pooling_type}'")
+            return y, variables.get("state", {})
+
+        if pt == "max":
+            y = jnp.max(x, axis=axes)
+        elif pt == "avg":
+            y = jnp.mean(x, axis=axes)
+        elif pt == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(f"unknown pooling type '{self.pooling_type}'")
+        return y, variables.get("state", {})
+
+    def feed_forward_mask(self, mask, itype):
+        return None  # time dimension is gone after global pooling

@@ -26,7 +26,8 @@ def run(n_devices: int) -> None:
 
     model, _ = available_bench_model()
     rng = np.random.default_rng(0)
-    batch = max(8, n_devices)
+    dp = n_devices // tp
+    batch = dp * 8  # divisible by the data axis (sharding requires it)
     x = rng.standard_normal((batch, 784), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
 

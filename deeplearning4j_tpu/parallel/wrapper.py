@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, batch_spec, make_mesh
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh, shard_batch
 
 
 def _param_specs(params, rule: Optional[Callable[[str, str, Any], P]]):
@@ -76,10 +76,24 @@ class ParallelWrapper:
                                           self.param_shardings)
         repl = NamedSharding(mesh, P())
         m.state = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), m.state)
-        # optimizer state mirrors the param sharding where shapes match
-        def opt_put(leaf):
-            return jax.device_put(leaf, repl)
-        m.opt_state = jax.tree_util.tree_map(opt_put, m.opt_state)
+        # optimizer state: subtrees shaped like params (optax mu/nu/trace...)
+        # get the param sharding; everything else (counts) is replicated
+        param_treedef = jax.tree_util.tree_structure(m.params)
+
+        def place_opt(o):
+            if jax.tree_util.tree_structure(o) == param_treedef:
+                return jax.tree_util.tree_map(jax.device_put, o, self.param_shardings)
+            if isinstance(o, tuple) and hasattr(o, "_fields"):  # NamedTuple state
+                return type(o)(*[place_opt(c) for c in o])
+            if isinstance(o, tuple):
+                return tuple(place_opt(c) for c in o)
+            if isinstance(o, list):
+                return [place_opt(c) for c in o]
+            if isinstance(o, dict):
+                return {k: place_opt(v) for k, v in o.items()}
+            return jax.device_put(o, repl)
+
+        m.opt_state = place_opt(m.opt_state)
 
     def _get_step(self):
         if self._step is None:
@@ -87,18 +101,32 @@ class ParallelWrapper:
         return self._step
 
     # ------------------------------------------------------------------
-    def fit(self, data=None, labels=None, **kw):
-        """Shard each batch over the mesh then run the jitted SPMD step."""
+    def fit(self, data=None, labels=None, *, epochs: int = 1,
+            mask=None, label_mask=None):
+        """Shard each batch over the mesh then run the jitted SPMD step.
+        Same contract as ``MultiLayerNetwork.fit``: (x, y) arrays or an
+        iterable/iterator of batches, optional masks, multiple epochs."""
         m, mesh = self.model, self.mesh
-        put = lambda a: (None if a is None else jax.device_put(
-            jnp.asarray(a), NamedSharding(mesh, batch_spec(np.ndim(a)))))
+        put = lambda a: (None if a is None else shard_batch(mesh, jnp.asarray(a)))
         if labels is not None:
-            batches = [(data, labels, None, None)]
+            batches_factory = lambda: [(data, labels, mask, label_mask)]
+        elif hasattr(data, "reset") or hasattr(data, "__iter__"):
+            src = data
+            if not hasattr(src, "reset") and epochs > 1 and iter(src) is src:
+                src = [m._normalize_batch(b) for b in src]
+
+            def batches_factory():
+                if hasattr(src, "reset"):
+                    src.reset()
+                for b in src:
+                    yield m._normalize_batch(b)
         else:
-            batches = (m._normalize_batch(b) for b in data)
+            raise ValueError("fit() needs (x, y) or an iterator")
         step = self._get_step()
-        with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") else _null():
-            for x, y, mk, lmk in batches:
+        for _ in range(epochs):
+            for lst in m.listeners:
+                lst.on_epoch_start(m)
+            for x, y, mk, lmk in batches_factory():
                 m._rng, key = jax.random.split(m._rng)
                 m.params, m.state, m.opt_state, loss = step(
                     m.params, m.state, m.opt_state, key,
@@ -107,17 +135,12 @@ class ParallelWrapper:
                 m.iteration += 1
                 for lst in m.listeners:
                     lst.iteration_done(m, m.iteration, m.epoch)
+            for lst in m.listeners:
+                lst.on_epoch_end(m)
+            m.epoch += 1
         return self
 
     def average_params(self):
         """No-op: SPMD keeps replicas exact (reference averageModelsParams
         exists because its replicas drift; ours cannot)."""
         return self.model.params
-
-
-class _null:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        return False

@@ -1,0 +1,190 @@
+"""CNN-stack tests: shapes, modes, gradient checks, MNIST end-to-end.
+
+Mirrors the reference's ``gradientcheck/CNNGradientCheckTest`` /
+``BNGradientCheckTest`` strategy: tiny double-precision nets, central-difference
+oracle via utils.gradient_check.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerConfiguration,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.layers import (ActivationLayer, BatchNormalization,
+                                          Convolution1DLayer, ConvolutionLayer,
+                                          DenseLayer, GlobalPoolingLayer,
+                                          LocalResponseNormalization,
+                                          OutputLayer, Subsampling1DLayer,
+                                          SubsamplingLayer, Upsampling2D,
+                                          ZeroPaddingLayer)
+from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(np.float64)
+
+
+def _onehot(classes, n, seed=1):
+    rng = np.random.default_rng(seed)
+    return np.eye(classes)[rng.integers(0, classes, n)]
+
+
+def _build(layers, itype, seed=7, updater=None):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .activation("tanh").weight_init("xavier"))
+    if updater:
+        b = b.updater(updater)
+    lb = b.list()
+    for l in layers:
+        lb.layer(l)
+    return MultiLayerNetwork(lb.set_input_type(itype).build()).init()
+
+
+# ---------------------------------------------------------------- shapes
+
+def test_conv_output_shapes_truncate_and_same():
+    net = _build([ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(2, 2)),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                 InputType.convolutional(9, 9, 2))
+    # truncate: floor((9-3)/2)+1 = 4
+    assert net.conf.layer_input_types[1].kind == "ff"
+    y = net.output(_rand((5, 9, 9, 2)))
+    assert y.shape == (5, 2)
+
+    net2 = _build([ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(2, 2),
+                                    convolution_mode="same"),
+                   OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                  InputType.convolutional(9, 9, 2))
+    t = net2.conf.layers[0].output_type(InputType.convolutional(9, 9, 2))
+    assert (t.height, t.width) == (5, 5)  # ceil(9/2)
+
+
+def test_strict_mode_raises_on_nonexact_fit():
+    with pytest.raises(ValueError, match="strict"):
+        _build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(2, 2),
+                                 convolution_mode="strict"),
+                OutputLayer(n_out=2, loss="mcxent")],
+               InputType.convolutional(9, 9, 2))
+
+
+def test_zeropad_upsample_shapes():
+    net = _build([ZeroPaddingLayer(padding=(1, 2, 3, 4)),
+                  Upsampling2D(size=(2, 2)),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                 InputType.convolutional(4, 4, 1))
+    t0 = net.conf.layers[0].output_type(InputType.convolutional(4, 4, 1))
+    assert (t0.height, t0.width) == (7, 11)
+    y = net.output(_rand((2, 4, 4, 1)))
+    assert y.shape == (2, 2)
+
+
+def test_pooling_variants_values():
+    import jax.numpy as jnp
+    x = np.arange(16, dtype=np.float64).reshape(1, 4, 4, 1)
+    for pt, expect00 in (("max", 5.0), ("avg", 2.5), ("sum", 10.0)):
+        layer = SubsamplingLayer(pooling_type=pt, kernel_size=(2, 2), stride=(2, 2))
+        y, _ = layer.apply({"params": {}, "state": {}}, jnp.asarray(x))
+        assert y.shape == (1, 2, 2, 1)
+        assert np.isclose(float(y[0, 0, 0, 0]), expect00), pt
+
+
+# ---------------------------------------------------------- gradient checks
+
+def test_gradient_check_conv_pool_dense():
+    net = _build([ConvolutionLayer(n_out=2, kernel_size=(2, 2)),
+                  SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                   stride=(2, 2)),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                 InputType.convolutional(5, 5, 1))
+    x, y = _rand((4, 5, 5, 1)), _onehot(3, 4)
+    assert check_gradients(net, x, y, print_results=False)
+
+
+def test_gradient_check_avg_pnorm_pooling():
+    for pt in ("avg", "pnorm"):
+        net = _build([ConvolutionLayer(n_out=2, kernel_size=(2, 2)),
+                      SubsamplingLayer(pooling_type=pt, kernel_size=(2, 2),
+                                       stride=(1, 1)),
+                      OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                     InputType.convolutional(4, 4, 1))
+        x, y = _rand((3, 4, 4, 1)), _onehot(2, 3)
+        assert check_gradients(net, x, y), pt
+
+
+def test_gradient_check_batchnorm_dense():
+    net = _build([DenseLayer(n_out=4),
+                  BatchNormalization(),
+                  OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+                 InputType.feed_forward(5))
+    x, y = _rand((6, 5)), _onehot(3, 6)
+    assert check_gradients(net, x, y)
+
+
+def test_gradient_check_batchnorm_cnn_and_lrn():
+    net = _build([ConvolutionLayer(n_out=2, kernel_size=(2, 2)),
+                  BatchNormalization(),
+                  LocalResponseNormalization(n=3),
+                  GlobalPoolingLayer(pooling_type="avg"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                 InputType.convolutional(4, 4, 1))
+    x, y = _rand((3, 4, 4, 1)), _onehot(2, 3)
+    assert check_gradients(net, x, y)
+
+
+def test_gradient_check_conv1d_pool1d():
+    net = _build([Convolution1DLayer(n_out=3, kernel_size=2),
+                  Subsampling1DLayer(pooling_type="max", kernel_size=2, stride=2),
+                  GlobalPoolingLayer(pooling_type="max"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                 InputType.recurrent(3, 8))
+    x, y = _rand((2, 8, 3)), _onehot(2, 2)
+    assert check_gradients(net, x, y)
+
+
+# ------------------------------------------------------------ BN semantics
+
+def test_batchnorm_running_stats_update_and_inference():
+    net = _build([BatchNormalization(decay=0.5),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+                 InputType.feed_forward(3), updater=Sgd(learning_rate=0.0))
+    x = _rand((32, 3), seed=3) * 2.0 + 1.0
+    y = _onehot(2, 32)
+    m0 = np.array(net.state["layer_0"]["mean"])
+    net.fit(x, y)
+    m1 = np.array(net.state["layer_0"]["mean"])
+    assert not np.allclose(m0, m1), "running mean should move during training"
+    # inference uses running stats: two different batches give same normalization
+    out1 = net.output(x[:4])
+    out2 = net.output(x[:4])
+    assert np.allclose(out1, out2)
+
+
+def test_global_pooling_masked_avg():
+    import jax.numpy as jnp
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = np.ones((2, 4, 3))
+    x[:, 2:, :] = 99.0  # masked-out steps
+    mask = np.array([[1, 1, 0, 0], [1, 1, 0, 0]], dtype=np.float64)
+    y, _ = layer.apply({"params": {}, "state": {}}, jnp.asarray(x),
+                       mask=jnp.asarray(mask))
+    assert np.allclose(np.asarray(y), 1.0)
+
+
+# ------------------------------------------------------------- end-to-end
+
+def test_lenet_style_mnist_training():
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    it = MnistDataSetIterator(batch_size=64, num_examples=512, flatten=False)
+    net = _build(
+        [ConvolutionLayer(n_out=4, kernel_size=(5, 5), stride=(2, 2),
+                          activation="relu"),
+         SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+         DenseLayer(n_out=16, activation="relu"),
+         OutputLayer(n_out=10, activation="softmax", loss="mcxent")],
+        InputType.convolutional(28, 28, 1), updater=Adam(learning_rate=1e-2))
+    s0 = net.score(x=it.features[:64], y=it.labels[:64])
+    net.fit(it, epochs=15)
+    s1 = net.score(x=it.features[:64], y=it.labels[:64])
+    assert s1 < s0 * 0.7, (s0, s1)
+    acc = net.evaluate(it).accuracy()
+    assert acc > 0.8, acc
