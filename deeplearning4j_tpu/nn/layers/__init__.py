@@ -7,12 +7,15 @@ from .feedforward import (ActivationLayer, DenseLayer, DropoutLayer,
                           EmbeddingLayer, LossLayer, OutputLayer)
 from .normalization import BatchNormalization, LocalResponseNormalization
 from .pooling import GlobalPoolingLayer
+from .recurrent import (Bidirectional, GravesBidirectionalLSTM, GravesLSTM,
+                        LastTimeStep, LSTM, RnnOutputLayer, SimpleRnn)
 
 __all__ = [
-    "ActivationLayer", "BaseLayerConf", "BatchNormalization",
+    "ActivationLayer", "BaseLayerConf", "BatchNormalization", "Bidirectional",
     "Convolution1DLayer", "ConvolutionLayer", "DenseLayer", "DropoutLayer",
-    "EmbeddingLayer", "GlobalPoolingLayer", "LayerConf",
-    "LocalResponseNormalization", "LossLayer", "OutputLayer",
+    "EmbeddingLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
+    "GravesLSTM", "LastTimeStep", "LayerConf", "LocalResponseNormalization",
+    "LossLayer", "LSTM", "OutputLayer", "RnnOutputLayer", "SimpleRnn",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling1D", "Upsampling2D",
     "ZeroPaddingLayer",
 ]

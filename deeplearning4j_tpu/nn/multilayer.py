@@ -87,6 +87,8 @@ class MultiLayerNetwork:
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
+        self._rnn_carries = None
+        self._rnn_carry_batch = -1
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -140,8 +142,16 @@ class MultiLayerNetwork:
 
     # -------------------------------------------------------------- forward
     def _forward(self, params, state, x, *, train: bool, key, mask=None,
-                 to_layer: Optional[int] = None, collect: bool = False):
-        """Trace the stack; returns (final_activation_or_list, new_state)."""
+                 to_layer: Optional[int] = None, collect: bool = False,
+                 carries: Optional[Dict[str, Any]] = None,
+                 return_mask: bool = False):
+        """Trace the stack; returns (final_activation_or_list, new_state).
+
+        carries: optional dict of recurrent-layer carries keyed ``layer_i``
+        (tBPTT chunk state / rnnTimeStep streaming state). When given, a dict
+        of the same shape is written back into ``carries`` (callers pass a
+        mutable dict and read the updated entries).
+        """
         n = len(self.layers) if to_layer is None else to_layer
         new_state = dict(state)
         acts = []
@@ -157,21 +167,34 @@ class MultiLayerNetwork:
             lkey = jax.random.fold_in(key, i) if key is not None else None
             variables = {"params": params.get(f"layer_{i}", {}),
                          "state": state.get(f"layer_{i}", {})}
-            h, lstate = lc.apply(variables, h, train=train, key=lkey, mask=mask)
-            new_state[f"layer_{i}"] = lstate
+            lname = f"layer_{i}"
+            if carries is not None and getattr(lc, "HAS_CARRY", False):
+                h, new_carry = lc.apply_with_carry(
+                    variables, h, carries.get(lname), train=train, key=lkey,
+                    mask=mask)
+                carries[lname] = new_carry
+                lstate = variables.get("state", {})
+            else:
+                h, lstate = lc.apply(variables, h, train=train, key=lkey,
+                                     mask=mask)
+            new_state[lname] = lstate
             if mask is not None:
                 mask = lc.feed_forward_mask(mask, None)
             if collect:
                 acts.append(h)
-        return (acts if collect else h), new_state
+        out = acts if collect else h
+        if return_mask:
+            return out, new_state, mask
+        return out, new_state
 
     def _loss(self, params, state, x, y, *, train: bool, key, mask=None,
-              label_mask=None):
+              label_mask=None, carries=None):
         """Forward to last layer's loss + regularization (reference
         computeGradientAndScore, MultiLayerNetwork.java:2206)."""
         n = len(self.layers)
-        h, new_state = self._forward(params, state, x, train=train, key=key,
-                                     mask=mask, to_layer=n - 1)
+        h, new_state, pmask = self._forward(
+            params, state, x, train=train, key=key, mask=mask,
+            to_layer=n - 1, carries=carries, return_mask=True)
         out_conf = self.layers[-1]
         if not hasattr(out_conf, "compute_loss"):
             raise ValueError(
@@ -182,8 +205,12 @@ class MultiLayerNetwork:
         lkey = jax.random.fold_in(key, n - 1) if key is not None else None
         variables = {"params": params.get(f"layer_{n-1}", {}),
                      "state": state.get(f"layer_{n-1}", {})}
+        # label mask defaults to the PROPAGATED feature mask (reference
+        # per-timestep masking when labelsMask is absent; a LastTimeStep/
+        # global-pooling layer consumes the time axis and nulls the mask)
+        lm = label_mask if label_mask is not None else pmask
         loss = out_conf.compute_loss(variables, h, y, train=train, key=lkey,
-                                     mask=label_mask)
+                                     mask=lm)
         reg = jnp.zeros(())
         for i, lc in enumerate(self.layers):
             lp = params.get(f"layer_{i}", {})
@@ -239,21 +266,41 @@ class MultiLayerNetwork:
                 return self._loss(params, state, x, y, train=False, key=None)
         elif kind == "train_step":
             fn = self._make_train_step()
+        elif kind == "train_step_carry":
+            fn = self._make_train_step(with_carry=True)
+        elif kind == "rnn_time_step":
+            @jax.jit
+            def fn(params, state, x, carries):
+                carries = dict(carries)
+                y, _ = self._forward(params, state, x, train=False, key=None,
+                                     carries=carries)
+                return y, carries
         else:
             raise KeyError(kind)
         self._jit_cache[kind] = fn
         return fn
 
-    def _make_train_step(self):
+    def _make_train_step(self, with_carry: bool = False):
         gn_mode = self.conf.defaults.get("gradient_normalization")
         gn_thr = float(self.conf.defaults.get("gradient_normalization_threshold", 1.0))
         tx = self._tx
 
-        def step(params, state, opt_state, key, x, y, mask, label_mask):
+        def step(params, state, opt_state, key, x, y, mask, label_mask,
+                 carries=None):
             def loss_fn(p):
-                return self._loss(p, state, x, y, train=True, key=key,
-                                  mask=mask, label_mask=label_mask)
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                if with_carry:
+                    # carry state flows INTO the chunk; gradients do not flow
+                    # back across the chunk boundary (tBPTT truncation).
+                    cs = dict(jax.tree_util.tree_map(jax.lax.stop_gradient, carries))
+                    loss, new_state = self._loss(p, state, x, y, train=True,
+                                                 key=key, mask=mask,
+                                                 label_mask=label_mask, carries=cs)
+                    return loss, (new_state, cs)
+                loss, new_state = self._loss(p, state, x, y, train=True, key=key,
+                                             mask=mask, label_mask=label_mask)
+                return loss, (new_state, None)
+            (loss, (new_state, new_carries)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
             # per-layer preApply: a layer's own setting REPLACES the global one
             # (reference semantics — normalization configured per layer conf)
             for i, lc in enumerate(self.layers):
@@ -279,6 +326,8 @@ class MultiLayerNetwork:
                                (not is_bias and c.apply_to_weights):
                                 lp[pname] = c.apply(lp[pname])
                     new_params[lname] = lp
+            if with_carry:
+                return new_params, new_state, new_opt, loss, new_carries
             return new_params, new_state, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -338,31 +387,78 @@ class MultiLayerNetwork:
         return self
 
     def _fit_tbptt(self, step_fn, x, y, mask, label_mask):
-        """Truncated BPTT: split the time axis into tbptt_fwd_length chunks
-        (reference ``doTruncatedBPTT``, MultiLayerNetwork.java:1393).
-
-        Note: chunk boundaries do not carry RNN state in this round (reference
-        carries rnnTimeStep state between chunks) — matches behaviour for
-        stateless-per-chunk training.  ``tbptt_back_length`` is accepted for
-        config parity but the backward window always equals the forward chunk
-        (the reference's default fwd==back case); a shorter backward window is
-        meaningless until cross-chunk state carry lands.
+        """Truncated BPTT (reference ``doTruncatedBPTT``,
+        MultiLayerNetwork.java:1393): split the time axis into
+        tbptt_fwd_length chunks; recurrent state (h, c) carries across chunk
+        boundaries with gradients stopped at each boundary — so the backward
+        window equals the forward chunk, the reference's default fwd==back
+        configuration.  ``tbptt_back_length`` is accepted for config parity.
         """
+        del step_fn  # tbptt uses the carry-aware step
+        step = self._get_jitted("train_step_carry")
         L = self.conf.tbptt_fwd_length
         T = x.shape[1]
+        batch = x.shape[0]
+        carries = self._init_carries(batch)
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
             xm = None if mask is None else jnp.asarray(mask)[:, sl]
             ym = None if label_mask is None else jnp.asarray(label_mask)[:, sl]
             yc = jnp.asarray(y)[:, sl] if getattr(y, "ndim", 2) == 3 else jnp.asarray(y)
             self._rng, key = jax.random.split(self._rng)
-            self.params, self.state, self.opt_state, loss = step_fn(
+            self.params, self.state, self.opt_state, loss, carries = step(
                 self.params, self.state, self.opt_state, key,
-                jnp.asarray(x)[:, sl], yc, xm, ym)
+                jnp.asarray(x)[:, sl], yc, xm, ym, carries)
             self._score = float(loss)
             self.iteration += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration, self.epoch)
+
+    def _init_carries(self, batch: int):
+        """Zero carries for every recurrent layer (keyed ``layer_i``)."""
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        carries = {}
+        for i, lc in enumerate(self.layers):
+            if getattr(lc, "HAS_CARRY", False):
+                carries[f"layer_{i}"] = lc.init_carry(batch, dtype)
+        return carries
+
+    # ------------------------------------------------------ stateful RNN API
+    def rnn_time_step(self, x) -> Array:
+        """Streaming inference with persistent recurrent state (reference
+        ``rnnTimeStep``, MultiLayerNetwork.java:2690).  x: [b, t, f] or
+        [b, f] (single step).  State persists across calls until
+        ``rnn_clear_previous_state``."""
+        from .layers.recurrent import Bidirectional
+        if any(isinstance(lc, Bidirectional) for lc in self.layers):
+            raise ValueError(
+                "rnn_time_step does not support bidirectional layers — the "
+                "backward pass needs the full sequence (reference throws "
+                "likewise)")
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if getattr(self, "_rnn_carries", None) is None or \
+                self._rnn_carry_batch != x.shape[0]:
+            self._rnn_carries = self._init_carries(x.shape[0])
+            self._rnn_carry_batch = x.shape[0]
+        fn = self._get_jitted("rnn_time_step")
+        y, self._rnn_carries = fn(self.params, self.state, x, self._rnn_carries)
+        return y[:, 0] if squeeze and y.ndim == 3 else y
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+        self._rnn_carry_batch = -1
+
+    def rnn_get_previous_state(self, layer: int):
+        c = getattr(self, "_rnn_carries", None)
+        return None if c is None else c.get(f"layer_{layer}")
+
+    def rnn_set_previous_state(self, layer: int, state) -> None:
+        if getattr(self, "_rnn_carries", None) is None:
+            raise ValueError("no rnn state yet — call rnn_time_step first")
+        self._rnn_carries[f"layer_{layer}"] = state
 
     @staticmethod
     def _normalize_batch(b):
