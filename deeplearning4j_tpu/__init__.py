@@ -12,9 +12,13 @@ __version__ = "0.1.0"
 from .nn.conf.input_type import InputType
 from .nn.conf.multi_layer import (MultiLayerConfiguration,
                                   NeuralNetConfiguration)
+from .nn.conf.computation_graph import ComputationGraphConfiguration
+from .nn.computation_graph import ComputationGraph
 from .nn.multilayer import MultiLayerNetwork
 
 __all__ = [
+    "ComputationGraph",
+    "ComputationGraphConfiguration",
     "InputType",
     "MultiLayerConfiguration",
     "NeuralNetConfiguration",

@@ -1,0 +1,123 @@
+"""Shared training-step machinery for MultiLayerNetwork and ComputationGraph.
+
+One copy of the updater-block construction (reference
+``nn/updater/BaseMultiLayerUpdater.java:64-138`` builds per-block updaters for
+MLN and ``nn/updater/graph/ComputationGraphUpdater.java`` for graphs — same
+logic there too), gradient-normalization pre-apply (:318) and constraint
+application, keyed by a ``name -> layer-conf`` map that both network types
+produce.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .layers.base import BaseLayerConf, LayerConf
+
+
+def hyperparam_conf(lc: Optional[LayerConf]) -> Optional[BaseLayerConf]:
+    """The conf that carries hyperparams (updater/constraints/normalization):
+    wrappers (Bidirectional, LastTimeStep, FrozenLayer) delegate to the layer
+    they wrap."""
+    seen = set()
+    while lc is not None and id(lc) not in seen:
+        seen.add(id(lc))
+        if isinstance(lc, BaseLayerConf):
+            return lc
+        inner = getattr(lc, "underlying", None) or getattr(lc, "fwd", None) \
+            or getattr(lc, "layer", None)
+        lc = inner
+    return None
+
+
+def apply_gradient_normalization(mode: Optional[str], threshold: float, grads):
+    """Reference BaseMultiLayerUpdater.preApply :318."""
+    if not mode or mode == "none":
+        return grads
+    mode = mode.lower()
+    leaves = jax.tree_util.tree_leaves(grads)
+    if mode == "renormalizel2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        return jax.tree_util.tree_map(lambda g: g / (norm + 1e-8), grads)
+    if mode == "renormalizel2perparamtype":
+        return jax.tree_util.tree_map(
+            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-8), grads)
+    if mode == "clipelementwiseabsolutevalue":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == "clipl2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+        scale = jnp.minimum(1.0, threshold / (norm + 1e-8))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if mode == "clipl2perparamtype":
+        def clip(g):
+            n = jnp.linalg.norm(g.reshape(-1))
+            return g * jnp.minimum(1.0, threshold / (n + 1e-8))
+        return jax.tree_util.tree_map(clip, grads)
+    raise ValueError(f"unknown gradient normalization '{mode}'")
+
+
+def build_tx(default_u, confs: Dict[str, Optional[LayerConf]],
+             params: Dict[str, Any]) -> optax.GradientTransformation:
+    """One optax transform; per-layer/bias overrides via multi_transform."""
+    resolved = {name: hyperparam_conf(lc) for name, lc in confs.items()}
+    has_override = any(
+        lc is not None and (lc.updater is not None or lc.bias_updater is not None)
+        for lc in resolved.values())
+    if not has_override:
+        return default_u.to_optax()
+    transforms = {"default": default_u.to_optax()}
+    labels = {}
+    for name, pgroup in params.items():
+        lc = resolved.get(name)
+        if lc is None or (lc.updater is None and lc.bias_updater is None):
+            labels[name] = {p: "default" for p in pgroup}
+            continue
+        lu = lc.updater or default_u
+        bu = lc.bias_updater
+        wl = f"{name}/w"
+        transforms[wl] = lu.to_optax()
+        lab = {}
+        for pname in pgroup:
+            if bu is not None and pname in BaseLayerConf._BIAS_PARAMS:
+                bl = f"{name}/b"
+                transforms[bl] = bu.to_optax()
+                lab[pname] = bl
+            else:
+                lab[pname] = wl
+        labels[name] = lab
+    return optax.multi_transform(transforms, labels)
+
+
+def apply_gradient_norm_all(grads, confs: Dict[str, Optional[LayerConf]],
+                            gn_mode, gn_thr):
+    """Per-group preApply; a layer's own setting REPLACES the global one."""
+    for name, lc in confs.items():
+        hc = hyperparam_conf(lc)
+        own = getattr(hc, "gradient_normalization", None)
+        m = own or gn_mode
+        if m and grads.get(name):
+            t = getattr(hc, "gradient_normalization_threshold", None)
+            t = float(t) if t is not None and own else gn_thr
+            grads[name] = apply_gradient_normalization(m, t, grads[name])
+    return grads
+
+
+def apply_constraints_all(params, confs: Dict[str, Optional[LayerConf]]):
+    """Reference applyConstraints after each step."""
+    for name, lc in confs.items():
+        hc = hyperparam_conf(lc)
+        cs = getattr(hc, "constraints", None)
+        if cs and params.get(name):
+            pgroup = dict(params[name])
+            for c in cs:
+                for pname in pgroup:
+                    is_bias = pname in BaseLayerConf._BIAS_PARAMS
+                    if (is_bias and c.apply_to_biases) or \
+                       (not is_bias and c.apply_to_weights):
+                        pgroup[pname] = c.apply(pgroup[pname])
+            params[name] = pgroup
+    return params

@@ -1,0 +1,399 @@
+"""ComputationGraph — DAG network runtime.
+
+TPU-native re-design of ``nn/graph/ComputationGraph.java:87``: the reference
+walks the topological order per call, managing workspaces and hand-accumulated
+fan-in epsilons; here the whole DAG (forward + loss + backward + update) is
+traced once into a single jitted XLA program.  Fan-in gradient accumulation is
+what jax.grad does by construction; workspace reuse is XLA's buffer allocator
+plus argument donation.
+
+Multi-input / multi-output: ``fit`` takes a MultiDataSet-shaped batch
+(features list, labels list, optional masks); the loss is the sum over output
+layers (reference computeGradientAndScore, ComputationGraph.java:1310-1320).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ._common import (apply_constraints_all, apply_gradient_norm_all,
+                      build_tx)
+from .conf.computation_graph import (ComputationGraphConfiguration,
+                                     GraphVertexConf, LayerVertex)
+from .conf.updaters import Sgd, UpdaterConf
+from .layers.base import BaseLayerConf
+from ..train.listeners import TrainingListener
+
+Array = jax.Array
+
+
+def _as_list(x) -> List:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class ComputationGraph:
+    """DAG network: init → fit/output/score/evaluate."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        conf.resolve()
+        self.conf = conf
+        self.params: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {}
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self.last_batch_size = 0
+        self.listeners: List[TrainingListener] = []
+        self._score = float("nan")
+        self._tx = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "ComputationGraph":
+        key = jax.random.PRNGKey(self.conf.seed)
+        self.params, self.state = {}, {}
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            key, sub = jax.random.split(key)
+            itypes = self.conf.vertex_input_types.get(name, [None])
+            out = v.init(sub, itypes)
+            self.params[name] = out.get("params", {})
+            self.state[name] = out.get("state", {})
+        self._tx = self._build_tx()
+        self.opt_state = self._tx.init(self.params)
+        return self
+
+    def _default_updater(self) -> UpdaterConf:
+        u = self.conf.defaults.get("updater")
+        return u if u is not None else Sgd(learning_rate=0.1)
+
+    def _layer_conf_map(self):
+        return {name: getattr(v, "layer", None)
+                for name, v in self.conf.vertices.items()}
+
+    def _build_tx(self) -> optax.GradientTransformation:
+        return build_tx(self._default_updater(), self._layer_conf_map(),
+                        self.params)
+
+    # -------------------------------------------------------------- forward
+    def _forward(self, params, state, inputs: List[Array], *, train: bool,
+                 key, masks: Optional[List[Optional[Array]]] = None,
+                 exclude_outputs: bool = False):
+        """Walk the static topological order; returns (acts, new_state, masks).
+
+        acts: dict vertex-name -> activation (plus network inputs).
+        """
+        conf = self.conf
+        acts: Dict[str, Array] = {}
+        mask_of: Dict[str, Optional[Array]] = {}
+        for i, n in enumerate(conf.network_inputs):
+            acts[n] = inputs[i]
+            mask_of[n] = masks[i] if masks else None
+        new_state = dict(state)
+        # output vertices whose activation nothing consumes can be skipped
+        # when the caller only needs pre-output activations for the loss
+        consumed = {src for ins in conf.vertex_inputs.values() for src in ins}
+        for vi, name in enumerate(conf.topological_order):
+            v = conf.vertices[name]
+            if exclude_outputs and name in conf.network_outputs and \
+                    name not in consumed and isinstance(v, LayerVertex) and \
+                    hasattr(v.layer, "compute_loss"):
+                continue
+            ins = conf.vertex_inputs[name]
+            xs = [acts[s] for s in ins]
+            ms = [mask_of.get(s) for s in ins]
+            # LastTimeStepVertex keys sequence length off a *named* input mask
+            mi = getattr(v, "mask_input", None)
+            if mi:
+                ms = [mask_of.get(mi)] + ms[1:]
+            lkey = jax.random.fold_in(key, vi) if key is not None else None
+            variables = {"params": params.get(name, {}),
+                         "state": state.get(name, {})}
+            y, lstate = v.apply(variables, xs, train=train, key=lkey, masks=ms)
+            acts[name] = y
+            new_state[name] = lstate
+            mask_of[name] = v.feed_forward_mask(ms, xs)
+        return acts, new_state, mask_of
+
+    def _loss(self, params, state, inputs, labels, *, train: bool, key,
+              masks=None, label_masks=None):
+        conf = self.conf
+        acts, new_state, mask_of = self._forward(
+            params, state, inputs, train=train, key=key, masks=masks,
+            exclude_outputs=True)
+        total = jnp.zeros(())
+        for oi, name in enumerate(conf.network_outputs):
+            v = conf.vertices[name]
+            if not (isinstance(v, LayerVertex) and
+                    hasattr(v.layer, "compute_loss")):
+                raise ValueError(
+                    f"network output '{name}' is not an output layer vertex")
+            src = conf.vertex_inputs[name][0]
+            h = acts[src]
+            lm = None
+            if label_masks is not None and oi < len(label_masks):
+                lm = label_masks[oi]
+            if lm is None:
+                lm = mask_of.get(src)
+            lkey = (jax.random.fold_in(key, 10_000 + oi)
+                    if key is not None else None)
+            variables = {"params": params.get(name, {}),
+                         "state": state.get(name, {})}
+            total = total + v.compute_loss(variables, h, labels[oi],
+                                           train=train, key=lkey, mask=lm)
+        reg = jnp.zeros(())
+        for name, v in conf.vertices.items():
+            lp = params.get(name, {})
+            if lp:
+                reg = reg + v.regularization_score(lp)
+        return total + reg, new_state
+
+    # ---------------------------------------------------------- public API
+    def output(self, *inputs, train: bool = False):
+        """Activations of the network outputs (reference ``output(...)``).
+        Returns a single array if one output, else a list."""
+        xs = [jnp.asarray(x) for x in inputs]
+        if train:
+            self._rng, key = jax.random.split(self._rng)
+            fn = self._get_jitted("output_train")
+            ys = fn(self.params, self.state, xs, key)
+        else:
+            fn = self._get_jitted("output")
+            ys = fn(self.params, self.state, xs)
+        return ys[0] if len(ys) == 1 else list(ys)
+
+    def output_single(self, *inputs, train: bool = False) -> Array:
+        y = self.output(*inputs, train=train)
+        if isinstance(y, list):
+            raise ValueError("output_single on a multi-output graph")
+        return y
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, Array]:
+        """All vertex activations keyed by vertex name."""
+        xs = [jnp.asarray(x) for x in inputs]
+        key = None
+        if train:
+            self._rng, key = jax.random.split(self._rng)
+        acts, _, _ = self._forward(self.params, self.state, xs, train=train,
+                                   key=key)
+        return acts
+
+    def score(self, dataset=None, inputs=None, labels=None) -> float:
+        if dataset is not None:
+            inputs, labels, _, _ = self._normalize_batch(dataset)
+        inputs = [jnp.asarray(x) for x in _as_list(inputs)]
+        labels = [jnp.asarray(y) for y in _as_list(labels)]
+        fn = self._get_jitted("score")
+        loss, _ = fn(self.params, self.state, inputs, labels)
+        return float(loss)
+
+    def _get_jitted(self, kind: str):
+        if kind in self._jit_cache:
+            return self._jit_cache[kind]
+        outs = self.conf.network_outputs
+        if kind == "output":
+            @jax.jit
+            def fn(params, state, xs):
+                acts, _, _ = self._forward(params, state, xs, train=False,
+                                           key=None)
+                return [acts[o] for o in outs]
+        elif kind == "output_train":
+            @jax.jit
+            def fn(params, state, xs, key):
+                acts, _, _ = self._forward(params, state, xs, train=True,
+                                           key=key)
+                return [acts[o] for o in outs]
+        elif kind == "score":
+            @jax.jit
+            def fn(params, state, xs, ys):
+                return self._loss(params, state, xs, ys, train=False, key=None)
+        elif kind == "train_step":
+            fn = self._make_train_step()
+        else:
+            raise KeyError(kind)
+        self._jit_cache[kind] = fn
+        return fn
+
+    def _make_train_step(self):
+        gn_mode = self.conf.defaults.get("gradient_normalization")
+        gn_thr = float(self.conf.defaults.get(
+            "gradient_normalization_threshold", 1.0))
+        tx = self._tx
+
+        def step(params, state, opt_state, key, xs, ys, masks, label_masks):
+            def loss_fn(p):
+                loss, new_state = self._loss(p, state, xs, ys, train=True,
+                                             key=key, masks=masks,
+                                             label_masks=label_masks)
+                return loss, new_state
+            (loss, new_state), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            confs = self._layer_conf_map()
+            grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_params = apply_constraints_all(new_params, confs)
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data=None, labels=None, *, epochs: int = 1,
+            masks=None, label_masks=None) -> "ComputationGraph":
+        """Train.  ``data`` may be (inputs, labels) (each an array or list of
+        arrays) or an iterable of MultiDataSet-shaped batches."""
+        if self.params == {}:
+            self.init()
+        if labels is not None:
+            one = (_as_list(data), _as_list(labels), masks, label_masks)
+            batches_factory = lambda: [one]
+        elif isinstance(data, tuple) and len(data) in (2, 4):
+            # fit((inputs, labels)) single-batch form — a tuple is NOT an
+            # iterator of batches
+            batches_factory = lambda: [self._normalize_batch(data)]
+        elif hasattr(data, "reset") or hasattr(data, "__iter__"):
+            if not hasattr(data, "reset") and epochs > 1 and iter(data) is data:
+                data = [self._normalize_batch(b) for b in data]
+                batches_factory = lambda: data
+            else:
+                src = data
+
+                def batches_factory():
+                    if hasattr(src, "reset"):
+                        src.reset()
+                    for b in src:
+                        yield self._normalize_batch(b)
+        else:
+            raise ValueError("fit() needs (inputs, labels) or an iterator")
+
+        step_fn = self._get_jitted("train_step")
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self)
+            for batch in batches_factory():
+                xs, ys, ms, lms = batch
+                xs = [jnp.asarray(x) for x in xs]
+                ys = [jnp.asarray(y) for y in ys]
+                ms = None if ms is None else [
+                    None if m is None else jnp.asarray(m) for m in _as_list(ms)]
+                lms = None if lms is None else [
+                    None if m is None else jnp.asarray(m) for m in _as_list(lms)]
+                self.last_batch_size = int(xs[0].shape[0])
+                self._rng, key = jax.random.split(self._rng)
+                self.params, self.state, self.opt_state, loss = step_fn(
+                    self.params, self.state, self.opt_state, key, xs, ys, ms,
+                    lms)
+                self._score = float(loss)
+                self.iteration += 1
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
+            for lst in self.listeners:
+                lst.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    @staticmethod
+    def _normalize_batch(b):
+        if isinstance(b, (tuple, list)):
+            if len(b) == 2:
+                return _as_list(b[0]), _as_list(b[1]), None, None
+            if len(b) == 4:
+                return (_as_list(b[0]), _as_list(b[1]),
+                        None if b[2] is None else _as_list(b[2]),
+                        None if b[3] is None else _as_list(b[3]))
+        if hasattr(b, "features"):
+            fm = getattr(b, "features_mask", None)
+            lm = getattr(b, "labels_mask", None)
+            return (_as_list(b.features), _as_list(b.labels),
+                    None if fm is None else _as_list(fm),
+                    None if lm is None else _as_list(lm))
+        raise ValueError(f"cannot interpret batch of type {type(b)}")
+
+    # ------------------------------------------------------------- queries
+    def get_score(self) -> float:
+        return self._score
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def evaluate(self, iterator_or_x, y=None):
+        from ..evaluation.classification import Evaluation
+        ev = Evaluation()
+        for xs, yy in self._eval_batches(iterator_or_x, y):
+            out = self.output(*xs)
+            if isinstance(out, list):
+                out = out[0]
+            ev.eval(np.asarray(yy), np.asarray(out))
+        return ev
+
+    def _eval_batches(self, it, y):
+        if y is not None:
+            yield _as_list(it), _as_list(y)[0]
+            return
+        if hasattr(it, "reset"):
+            it.reset()
+        for b in it:
+            xs, ys, _, _ = self._normalize_batch(b)
+            yield xs, ys[0]
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def clone(self) -> "ComputationGraph":
+        import copy
+        other = ComputationGraph(copy.deepcopy(self.conf))
+        copy_tree = lambda t: jax.tree_util.tree_map(lambda a: jnp.array(a), t)
+        other.params = copy_tree(self.params)
+        other.state = copy_tree(self.state)
+        other._tx = other._build_tx()
+        if self.opt_state is not None:
+            other.opt_state = copy_tree(self.opt_state)
+        else:
+            other.init()
+        other.iteration = self.iteration
+        other.epoch = self.epoch
+        return other
+
+
+def check_graph_gradients(net: ComputationGraph, inputs, labels, *,
+                          epsilon: float = 1e-6, max_rel_error: float = 1e-3,
+                          min_abs_error: float = 1e-8, masks=None,
+                          label_masks=None, print_results: bool = False,
+                          subset: Optional[int] = None, seed: int = 12345
+                          ) -> bool:
+    """GradientCheckUtil for graphs (reference checkGradients CG variant)."""
+    from ..utils.gradient_check import _check_gradients_impl
+    if not net.params:
+        net.init()
+    params = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float64), net.params)
+    state = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float64)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, net.state)
+    xs = [jnp.asarray(x, jnp.float64) for x in _as_list(inputs)]
+    ys = [jnp.asarray(y, jnp.float64) for y in _as_list(labels)]
+
+    @jax.jit
+    def loss_fn(p):
+        loss, _ = net._loss(p, state, xs, ys, train=False, key=None,
+                            masks=masks, label_masks=label_masks)
+        return loss
+
+    analytic = jax.grad(loss_fn)(params)
+    return _check_gradients_impl(loss_fn, params, analytic, epsilon,
+                                 max_rel_error, min_abs_error, print_results,
+                                 subset, seed)

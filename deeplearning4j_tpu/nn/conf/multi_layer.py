@@ -96,7 +96,9 @@ class MultiLayerConfiguration:
     def resolve(self) -> None:
         """Apply defaults, insert preprocessors, infer n_in, record itypes."""
         for lc in self.layers:
-            if isinstance(lc, BaseLayerConf):
+            # duck-typed: wrappers (Bidirectional, LastTimeStep, Frozen)
+            # delegate defaults to the layer they wrap
+            if hasattr(lc, "apply_global_defaults"):
                 lc.apply_global_defaults(self.defaults)
         self.layer_input_types = []
         itype = self.input_type

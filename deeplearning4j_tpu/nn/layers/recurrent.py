@@ -340,7 +340,10 @@ class LastTimeStep(LayerConf):
         y, new_carry = self.underlying.apply_with_carry(
             variables, x, carry, train=train, key=key, mask=mask)
         if mask is not None:
-            idx = jnp.maximum(jnp.sum(mask > 0, axis=1) - 1, 0).astype(jnp.int32)
+            # last NONZERO index (not count-1): robust to non-contiguous masks,
+            # matching LastTimeStepVertex semantics
+            idx = (mask.shape[1] - 1 -
+                   jnp.argmax(mask[:, ::-1] > 0, axis=1)).astype(jnp.int32)
             out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0]
         else:
             out = y[:, -1]
@@ -371,7 +374,10 @@ class LastTimeStep(LayerConf):
                                          mask=mask)
         if mask is not None:
             # last unmasked step per example
-            idx = jnp.maximum(jnp.sum(mask > 0, axis=1) - 1, 0).astype(jnp.int32)
+            # last NONZERO index (not count-1): robust to non-contiguous masks,
+            # matching LastTimeStepVertex semantics
+            idx = (mask.shape[1] - 1 -
+                   jnp.argmax(mask[:, ::-1] > 0, axis=1)).astype(jnp.int32)
             out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0]
         else:
             out = y[:, -1]

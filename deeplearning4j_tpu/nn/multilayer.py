@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ._common import (apply_constraints_all, apply_gradient_norm_all,
+                      apply_gradient_normalization, build_tx)
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
@@ -37,36 +39,6 @@ from .layers.base import BaseLayerConf
 from ..train.listeners import TrainingListener
 
 Array = jax.Array
-
-
-# ---------------------------------------------------------------------------
-# gradient normalization (reference BaseMultiLayerUpdater.preApply :318)
-# ---------------------------------------------------------------------------
-
-def apply_gradient_normalization(mode: Optional[str], threshold: float, grads):
-    if not mode or mode == "none":
-        return grads
-    mode = mode.lower()
-    leaves = jax.tree_util.tree_leaves(grads)
-    if mode == "renormalizel2perlayer":
-        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
-        return jax.tree_util.tree_map(lambda g: g / (norm + 1e-8), grads)
-    if mode == "renormalizel2perparamtype":
-        return jax.tree_util.tree_map(
-            lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-8), grads)
-    if mode == "clipelementwiseabsolutevalue":
-        return jax.tree_util.tree_map(
-            lambda g: jnp.clip(g, -threshold, threshold), grads)
-    if mode == "clipl2perlayer":
-        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
-        scale = jnp.minimum(1.0, threshold / (norm + 1e-8))
-        return jax.tree_util.tree_map(lambda g: g * scale, grads)
-    if mode == "clipl2perparamtype":
-        def clip(g):
-            n = jnp.linalg.norm(g.reshape(-1))
-            return g * jnp.minimum(1.0, threshold / (n + 1e-8))
-        return jax.tree_util.tree_map(clip, grads)
-    raise ValueError(f"unknown gradient normalization '{mode}'")
 
 
 class MultiLayerNetwork:
@@ -108,37 +80,15 @@ class MultiLayerNetwork:
         u = self.conf.defaults.get("updater")
         return u if u is not None else Sgd(learning_rate=0.1)
 
+    def _layer_conf_map(self):
+        return {f"layer_{i}": lc for i, lc in enumerate(self.layers)}
+
     def _build_tx(self) -> optax.GradientTransformation:
         """One optax transform; per-layer overrides via multi_transform
         (the reference's per-UpdaterBlock machinery,
         ``nn/updater/BaseMultiLayerUpdater.java:64-138``)."""
-        default_u = self._default_updater()
-        has_override = any(
-            isinstance(lc, BaseLayerConf) and (lc.updater is not None or
-                                               lc.bias_updater is not None)
-            for lc in self.layers)
-        if not has_override:
-            return default_u.to_optax()
-
-        transforms = {"default": default_u.to_optax()}
-        labels = {}
-        for i, lc in enumerate(self.layers):
-            lname = f"layer_{i}"
-            layer_params = self.params.get(lname, {})
-            lu = getattr(lc, "updater", None) or default_u
-            bu = getattr(lc, "bias_updater", None)
-            wl = f"{lname}/w"
-            transforms[wl] = lu.to_optax()
-            lab = {}
-            for pname in layer_params:
-                if bu is not None and pname in BaseLayerConf._BIAS_PARAMS:
-                    bl = f"{lname}/b"
-                    transforms[bl] = bu.to_optax()
-                    lab[pname] = bl
-                else:
-                    lab[pname] = wl
-            labels[lname] = lab
-        return optax.multi_transform(transforms, labels)
+        return build_tx(self._default_updater(), self._layer_conf_map(),
+                        self.params)
 
     # -------------------------------------------------------------- forward
     def _forward(self, params, state, x, *, train: bool, key, mask=None,
@@ -301,31 +251,11 @@ class MultiLayerNetwork:
                 return loss, (new_state, None)
             (loss, (new_state, new_carries)), grads = \
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
-            # per-layer preApply: a layer's own setting REPLACES the global one
-            # (reference semantics — normalization configured per layer conf)
-            for i, lc in enumerate(self.layers):
-                m = getattr(lc, "gradient_normalization", None) or gn_mode
-                if m:
-                    t = getattr(lc, "gradient_normalization_threshold", None)
-                    t = float(t) if t is not None and getattr(
-                        lc, "gradient_normalization", None) else gn_thr
-                    grads[f"layer_{i}"] = apply_gradient_normalization(
-                        m, t, grads[f"layer_{i}"])
+            confs = self._layer_conf_map()
+            grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            # constraints (reference applyConstraints after step)
-            for i, lc in enumerate(self.layers):
-                cs = getattr(lc, "constraints", None)
-                if cs:
-                    lname = f"layer_{i}"
-                    lp = dict(new_params[lname])
-                    for c in cs:
-                        for pname in lp:
-                            is_bias = pname in BaseLayerConf._BIAS_PARAMS
-                            if (is_bias and c.apply_to_biases) or \
-                               (not is_bias and c.apply_to_weights):
-                                lp[pname] = c.apply(lp[pname])
-                    new_params[lname] = lp
+            new_params = apply_constraints_all(new_params, confs)
             if with_carry:
                 return new_params, new_state, new_opt, loss, new_carries
             return new_params, new_state, new_opt, loss
@@ -342,6 +272,9 @@ class MultiLayerNetwork:
         if labels is not None:
             batches_factory = lambda: [(data, labels, mask, label_mask)]
         elif isinstance(data, DataSet):
+            batches_factory = lambda: [self._normalize_batch(data)]
+        elif isinstance(data, tuple) and len(data) in (2, 4):
+            # fit((x, y)) single-batch form — must not be iterated as batches
             batches_factory = lambda: [self._normalize_batch(data)]
         elif hasattr(data, "reset") or hasattr(data, "__iter__"):
             if not hasattr(data, "reset") and epochs > 1 and iter(data) is data:

@@ -140,3 +140,24 @@ def test_input_type():
     assert r.shape(4) == (4, 5, 10)
     x = jnp.zeros((2, 28, 28, 3))
     assert InputType.infer(x).kind == "cnn"
+
+
+def test_global_defaults_reach_wrapped_layers():
+    """Review regression: Bidirectional/LastTimeStep wrappers must receive
+    network-level defaults (l2, weight_init) on their inner layer."""
+    from deeplearning4j_tpu import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import (Bidirectional,
+                                                        LastTimeStep, LSTM)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).l2(0.5).weight_init("uniform")
+            .list()
+            .layer(Bidirectional(fwd=LSTM(n_out=3)))
+            .layer(LastTimeStep(underlying=LSTM(n_out=3)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 5))
+            .build())
+    bi, lts, out = conf.layers
+    assert bi.fwd.l2 == 0.5 and bi.fwd.weight_init == "uniform"
+    assert lts.underlying.l2 == 0.5
+    assert out.l2 == 0.5

@@ -1,0 +1,222 @@
+"""ComputationGraph: DAG construction, vertex ops, training, gradient checks
+(reference test model: ``gradientcheck/GradientCheckTestsComputationGraph`` +
+``nn/graph`` behavior tests).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.computation_graph import check_graph_gradients
+from deeplearning4j_tpu.nn.conf.computation_graph import (
+    ComputationGraphConfiguration, DuplicateToTimeSeriesVertex,
+    ElementWiseVertex, L2NormalizeVertex, L2Vertex, LastTimeStepVertex,
+    MergeVertex, PreprocessorVertex, ReshapeVertex, ScaleVertex, ShiftVertex,
+    StackVertex, SubsetVertex, UnstackVertex)
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+
+
+def simple_graph(seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(learning_rate=0.02))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_out=12, activation="tanh"), "in")
+            .add_layer("d1", DenseLayer(n_out=12, activation="tanh"), "d0")
+            .add_vertex("skip", ElementWiseVertex(op="add"), "d0", "d1")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "skip")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _toy(n=60, fin=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, fin)).astype(np.float64)
+    labels = rng.integers(0, classes, n)
+    y = np.eye(classes)[labels]
+    return x, y
+
+
+def test_graph_fit_reduces_score():
+    net = simple_graph()
+    x, y = _toy()
+    s0 = net.score(inputs=x, labels=y)
+    net.fit(x, y, epochs=120)
+    assert net.score(inputs=x, labels=y) < s0 * 0.5
+
+
+def test_graph_gradient_check_skip_connection():
+    net = simple_graph()
+    x, y = _toy(n=12)
+    assert check_graph_gradients(net, x, y)
+
+
+def test_graph_multi_input_merge():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Sgd(learning_rate=0.1))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=8, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=8, activation="tanh"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "m")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(5))
+            .build())
+    # merged feature size = 8 + 8
+    assert conf.vertex_output_type("m").size == 16
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    xa = rng.standard_normal((10, 3))
+    xb = rng.standard_normal((10, 5))
+    y = np.eye(2)[rng.integers(0, 2, 10)]
+    out = net.output(xa, xb)
+    assert out.shape == (10, 2)
+    assert check_graph_gradients(net, [xa, xb], y)
+
+
+def test_graph_multi_output():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("trunk", DenseLayer(n_out=10, activation="relu"), "in")
+            .add_layer("out1", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "trunk")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "trunk")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((20, 4))
+    y1 = np.eye(3)[rng.integers(0, 3, 20)]
+    y2 = rng.standard_normal((20, 1))
+    s0 = net.score(inputs=[x], labels=[y1, y2])
+    net.fit([x], [y1, y2], epochs=60)
+    assert net.score(inputs=[x], labels=[y1, y2]) < s0
+    o1, o2 = net.output(x)
+    assert o1.shape == (20, 3) and o2.shape == (20, 1)
+    assert check_graph_gradients(net, [x], [y1, y2])
+
+
+def test_vertex_ops_numerics():
+    """Scale/Shift/Subset/L2Normalize/Reshape/Stack/Unstack exact numerics."""
+    b = (NeuralNetConfiguration.builder().seed(0).graph_builder()
+         .add_inputs("in")
+         .add_vertex("scale", ScaleVertex(scale_factor=2.0), "in")
+         .add_vertex("shift", ShiftVertex(shift_factor=1.0), "scale")
+         .add_vertex("sub", SubsetVertex(from_idx=1, to_idx=2), "shift")
+         .add_vertex("norm", L2NormalizeVertex(), "sub")
+         .add_layer("out", OutputLayer(n_out=2, activation="identity",
+                                       loss="mse"), "norm")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4)))
+    net = ComputationGraph(b.build()).init()
+    x = np.array([[1.0, 2.0, 3.0, 4.0]])
+    acts = net.feed_forward(x)
+    np.testing.assert_allclose(np.asarray(acts["scale"]), [[2, 4, 6, 8]])
+    np.testing.assert_allclose(np.asarray(acts["shift"]), [[3, 5, 7, 9]])
+    np.testing.assert_allclose(np.asarray(acts["sub"]), [[5, 7]])
+    n = np.sqrt(25 + 49)
+    np.testing.assert_allclose(np.asarray(acts["norm"]), [[5 / n, 7 / n]],
+                               rtol=1e-6)
+
+
+def test_stack_unstack_roundtrip():
+    b = (NeuralNetConfiguration.builder().seed(0).graph_builder()
+         .add_inputs("a", "b")
+         .add_vertex("stack", StackVertex(), "a", "b")
+         .add_layer("shared", DenseLayer(n_out=6, activation="tanh"), "stack")
+         .add_vertex("ua", UnstackVertex(from_idx=0, stack_size=2), "shared")
+         .add_vertex("ub", UnstackVertex(from_idx=1, stack_size=2), "shared")
+         .add_vertex("l2", L2Vertex(), "ua", "ub")
+         .add_layer("out", OutputLayer(n_out=1, activation="sigmoid",
+                                       loss="xent"), "l2")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4),
+                          InputType.feed_forward(4)))
+    net = ComputationGraph(b.build()).init()
+    rng = np.random.default_rng(9)
+    xa = rng.standard_normal((6, 4))
+    xb = rng.standard_normal((6, 4))
+    acts = net.feed_forward(xa, xb)
+    assert acts["stack"].shape == (12, 4)
+    assert acts["ua"].shape == (6, 6) and acts["ub"].shape == (6, 6)
+    # siamese distance: same input pair → zero-ish distance (eps floor)
+    acts_same = net.feed_forward(xa, xa)
+    assert float(np.max(np.asarray(acts_same["l2"]))) < 1e-3
+    y = np.eye(2)[rng.integers(0, 2, 6)][:, :1]
+    assert check_graph_gradients(net, [xa, xb], y)
+
+
+def test_seq2seq_vertices():
+    """Encoder→LastTimeStep→DuplicateToTimeSeries→decoder (reference
+    rnn vertex pattern for seq2seq)."""
+    T = 5
+    b = (NeuralNetConfiguration.builder().seed(11)
+         .updater(Adam(learning_rate=0.02)).graph_builder()
+         .add_inputs("seq")
+         .add_layer("enc", LSTM(n_out=8, activation="tanh"), "seq")
+         .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "enc")
+         .add_vertex("dup", DuplicateToTimeSeriesVertex(ts_input="seq"),
+                     "last", "seq")
+         .add_layer("dec", LSTM(n_out=8, activation="tanh"), "dup")
+         .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "dec")
+         .set_outputs("out")
+         .set_input_types(InputType.recurrent(4, T)))
+    net = ComputationGraph(b.build()).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, T, 4))
+    y = np.eye(3)[rng.integers(0, 3, (4, T))]
+    out = net.output(x)
+    assert out.shape == (4, T, 3)
+    assert check_graph_gradients(net, x, y, subset=40)
+    # masked: last vertex picks last unmasked step
+    mask = np.ones((4, T)); mask[0, 3:] = 0
+    acts_m = net.feed_forward(x)  # unmasked reference
+    s0 = net.score(inputs=[x], labels=[y])
+    net.fit([x], [y], masks=[mask], epochs=3)  # trains without error
+    assert np.isfinite(net.get_score())
+
+
+def test_graph_json_roundtrip():
+    net = simple_graph()
+    js = net.conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(js)
+    assert conf2.topological_order == net.conf.topological_order
+    assert set(conf2.vertices) == set(net.conf.vertices)
+    net2 = ComputationGraph(conf2).init()
+    x, y = _toy(n=8)
+    # same seed → same init → same outputs
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-6)
+
+
+def test_graph_evaluate():
+    net = simple_graph()
+    x, y = _toy(n=90)
+    net.fit(x, y, epochs=150)
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.7
+
+
+def test_cycle_detection():
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    b = (GraphBuilder()
+         .add_inputs("in")
+         .add_vertex("a", ScaleVertex(scale_factor=1.0), "b")
+         .add_vertex("b", ScaleVertex(scale_factor=1.0), "a")
+         .set_outputs("b"))
+    with pytest.raises(ValueError, match="cycle"):
+        b.build()
