@@ -1,0 +1,22 @@
+"""Early stopping (reference ``deeplearning4j-nn/.../earlystopping/``)."""
+from .config import EarlyStoppingConfiguration
+from .result import EarlyStoppingResult
+from .savers import InMemoryModelSaver, LocalFileModelSaver
+from .scorecalc import (AccuracyScoreCalculator, DataSetLossCalculator)
+from .terminations import (BestScoreEpochTerminationCondition,
+                           InvalidScoreIterationTerminationCondition,
+                           MaxEpochsTerminationCondition,
+                           MaxScoreIterationTerminationCondition,
+                           MaxTimeIterationTerminationCondition,
+                           ScoreImprovementEpochTerminationCondition)
+from .trainer import EarlyStoppingTrainer
+
+__all__ = [
+    "AccuracyScoreCalculator", "BestScoreEpochTerminationCondition",
+    "DataSetLossCalculator", "EarlyStoppingConfiguration",
+    "EarlyStoppingResult", "EarlyStoppingTrainer", "InMemoryModelSaver",
+    "InvalidScoreIterationTerminationCondition", "LocalFileModelSaver",
+    "MaxEpochsTerminationCondition", "MaxScoreIterationTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+]

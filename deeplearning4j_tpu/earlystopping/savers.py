@@ -1,0 +1,51 @@
+"""Model savers (reference ``earlystopping/saver/``)."""
+from __future__ import annotations
+
+import os
+
+from ..utils import model_serializer
+
+
+class InMemoryModelSaver:
+    """Keep clones in memory (reference ``InMemoryModelSaver.java``)."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score):
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score):
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """Zip checkpoints on disk (reference ``LocalFileModelSaver.java``)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, kind):
+        return os.path.join(self.directory, f"{kind}Model.zip")
+
+    def save_best_model(self, net, score):
+        model_serializer.write_model(net, self._path("best"))
+
+    def save_latest_model(self, net, score):
+        model_serializer.write_model(net, self._path("latest"))
+
+    def get_best_model(self):
+        p = self._path("best")
+        return model_serializer.restore_model(p) if os.path.exists(p) else None
+
+    def get_latest_model(self):
+        p = self._path("latest")
+        return model_serializer.restore_model(p) if os.path.exists(p) else None

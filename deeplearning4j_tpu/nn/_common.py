@@ -60,19 +60,29 @@ def apply_gradient_normalization(mode: Optional[str], threshold: float, grads):
     raise ValueError(f"unknown gradient normalization '{mode}'")
 
 
+def is_frozen(lc: Optional[LayerConf]) -> bool:
+    return bool(getattr(lc, "FROZEN", False))
+
+
 def build_tx(default_u, confs: Dict[str, Optional[LayerConf]],
              params: Dict[str, Any]) -> optax.GradientTransformation:
-    """One optax transform; per-layer/bias overrides via multi_transform."""
+    """One optax transform; per-layer/bias overrides via multi_transform.
+    Frozen groups get ``optax.set_to_zero`` (no update, no updater state)."""
     resolved = {name: hyperparam_conf(lc) for name, lc in confs.items()}
+    frozen = {name for name, lc in confs.items() if is_frozen(lc)}
     has_override = any(
         lc is not None and (lc.updater is not None or lc.bias_updater is not None)
-        for lc in resolved.values())
-    if not has_override:
+        for name, lc in resolved.items() if name not in frozen)
+    if not has_override and not frozen:
         return default_u.to_optax()
-    transforms = {"default": default_u.to_optax()}
+    transforms = {"default": default_u.to_optax(),
+                  "frozen": optax.set_to_zero()}
     labels = {}
     for name, pgroup in params.items():
         lc = resolved.get(name)
+        if name in frozen:
+            labels[name] = {p: "frozen" for p in pgroup}
+            continue
         if lc is None or (lc.updater is None and lc.bias_updater is None):
             labels[name] = {p: "default" for p in pgroup}
             continue

@@ -259,6 +259,9 @@ class ComputationGraph:
             # fit((inputs, labels)) single-batch form — a tuple is NOT an
             # iterator of batches
             batches_factory = lambda: [self._normalize_batch(data)]
+        elif hasattr(data, "features"):
+            # a single DataSet/MultiDataSet IS one batch, not a batch iterator
+            batches_factory = lambda: [self._normalize_batch(data)]
         elif hasattr(data, "reset") or hasattr(data, "__iter__"):
             if not hasattr(data, "reset") and epochs > 1 and iter(data) is data:
                 data = [self._normalize_batch(b) for b in data]

@@ -1,0 +1,84 @@
+"""Misc layer wrappers — FrozenLayer.
+
+Reference ``nn/layers/FrozenLayer.java`` + ``nn/conf/layers/misc/FrozenLayer.java``:
+a wrapper that runs the underlying layer's forward pass but never updates its
+params.  Functional JAX version: ``stop_gradient`` on the wrapped params inside
+``apply`` (gradients are structurally zero), and the updater machinery
+additionally labels frozen groups with ``optax.set_to_zero`` so no updater
+state is carried for them.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import LayerConf
+
+
+@register_serde
+@dataclass
+class FrozenLayer(LayerConf):
+    """Freeze the wrapped layer's params (training no-op, inference normal)."""
+    underlying: Optional[LayerConf] = None
+
+    FROZEN = True
+
+    @property
+    def HAS_CARRY(self):
+        return getattr(self.underlying, "HAS_CARRY", False)
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return self.underlying.init_carry(batch, dtype)
+
+    def apply_with_carry(self, variables, x, carry, *, train=False, key=None,
+                         mask=None):
+        variables = self._frozen_vars(variables)
+        return self.underlying.apply_with_carry(variables, x, carry,
+                                                train=train, key=key, mask=mask)
+
+    def _frozen_vars(self, variables):
+        return {"params": jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                                 variables.get("params", {})),
+                "state": variables.get("state", {})}
+
+    def has_params(self):
+        return self.underlying.has_params()
+
+    def apply_global_defaults(self, defaults):
+        if hasattr(self.underlying, "apply_global_defaults"):
+            self.underlying.apply_global_defaults(defaults)
+
+    def set_n_in(self, itype, override=False):
+        self.underlying.set_n_in(itype, override)
+
+    def output_type(self, itype: InputType) -> InputType:
+        return self.underlying.output_type(itype)
+
+    def init(self, key, itype):
+        return self.underlying.init(key, itype)
+
+    def regularization_score(self, params):
+        # frozen params don't contribute to the loss (their l1/l2 is constant
+        # w.r.t. training and would only shift the reported score)
+        return jnp.zeros(())
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        # train=False for the wrapped layer: a frozen layer behaves in
+        # inference mode (no dropout; BN uses global stats) — reference
+        # FrozenLayer delegates with training disabled
+        return self.underlying.apply(self._frozen_vars(variables), x,
+                                     train=False, key=key, mask=mask)
+
+    def compute_loss(self, variables, x, labels, *, train=False, key=None,
+                     mask=None):
+        return self.underlying.compute_loss(self._frozen_vars(variables), x,
+                                            labels, train=False, key=key,
+                                            mask=mask)
+
+    def feed_forward_mask(self, mask, itype):
+        return self.underlying.feed_forward_mask(mask, itype)

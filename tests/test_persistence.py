@@ -1,0 +1,298 @@
+"""ModelSerializer round-trip exactness, early stopping, transfer learning,
+frozen layers (reference test model: regressiontest/ + earlystopping/ +
+nn transfer-learning suites).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (ComputationGraph, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Nesterovs
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+from deeplearning4j_tpu.nn.transfer_learning import (TransferLearning,
+                                                     TransferLearningHelper)
+from deeplearning4j_tpu.utils import model_serializer
+
+
+def iris_net(updater=None, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Adam(learning_rate=0.02))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iris_batch():
+    it = IrisDataSetIterator(batch_size=150)
+    ds = next(iter(it))
+    return np.asarray(ds.features), np.asarray(ds.labels)
+
+
+# ---------------------------------------------------------------- serializer
+
+def test_save_restore_exact_inference(tmp_path):
+    net = iris_net()
+    x, y = _iris_batch()
+    net.fit(x, y, epochs=10)
+    p = str(tmp_path / "model.zip")
+    model_serializer.write_model(net, p)
+    net2 = model_serializer.restore_multi_layer_network(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-7)
+    assert net2.iteration == net.iteration
+
+
+def test_save_restore_exact_resume(tmp_path):
+    """Updater state round-trip makes resume EXACT (reference saveUpdater)."""
+    x, y = _iris_batch()
+    net = iris_net(updater=Nesterovs(learning_rate=0.05, momentum=0.9))
+    net.fit(x, y, epochs=5)
+    p = str(tmp_path / "ckpt.zip")
+    model_serializer.write_model(net, p, save_updater=True)
+
+    restored = model_serializer.restore_multi_layer_network(p)
+    # continue both nets one step — must match bit-for-bit-ish (momentum
+    # buffers restored; only rng for dropout could differ, none here)
+    net._rng = restored._rng  # align rng streams
+    net.fit(x, y)
+    restored.fit(x, y)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(restored.output(x)), rtol=1e-7)
+
+
+def test_save_restore_graph(tmp_path):
+    from deeplearning4j_tpu.nn.conf.computation_graph import ElementWiseVertex
+    g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(learning_rate=0.02))
+         .graph_builder().add_inputs("in")
+         .add_layer("d0", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "d0")
+         .add_vertex("sum", ElementWiseVertex(op="add"), "d0", "d1")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "sum")
+         .set_outputs("out").set_input_types(InputType.feed_forward(4))
+         .build())
+    net = ComputationGraph(g).init()
+    x, y = _iris_batch()
+    net.fit(x, y, epochs=3)
+    p = str(tmp_path / "graph.zip")
+    model_serializer.write_model(net, p)
+    net2 = model_serializer.restore_computation_graph(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-7)
+    # generic loader guesses the class (ModelGuesser role)
+    net3 = model_serializer.restore_model(p)
+    assert isinstance(net3, ComputationGraph)
+    with pytest.raises(ValueError, match="not a"):
+        model_serializer.restore_multi_layer_network(p)
+
+
+# ------------------------------------------------------------ early stopping
+
+def test_early_stopping_max_epochs():
+    net = iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    conf = (EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(IrisDataSetIterator(batch_size=150)))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+            .model_saver(InMemoryModelSaver())
+            .build())
+    result = EarlyStoppingTrainer(conf, net, it).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs == 8
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 8
+    # best score should beat the first epoch's
+    assert result.best_model_score <= result.score_vs_epoch[0]
+
+
+def test_early_stopping_score_improvement_patience():
+    net = iris_net(updater=Adam(learning_rate=0.05))
+    it = IrisDataSetIterator(batch_size=150)
+    conf = (EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(IrisDataSetIterator(batch_size=150)))
+            .epoch_termination_conditions(
+                MaxEpochsTerminationCondition(500),
+                ScoreImprovementEpochTerminationCondition(5, 1e-4))
+            .build())
+    result = EarlyStoppingTrainer(conf, net, it).fit()
+    assert result.total_epochs < 500  # patience fired before the cap
+
+
+def test_early_stopping_divergence_guard():
+    net = iris_net(updater=Adam(learning_rate=0.02))
+    it = IrisDataSetIterator(batch_size=50)
+    conf = (EarlyStoppingConfiguration.builder()
+            .iteration_termination_conditions(
+                MaxScoreIterationTerminationCondition(1e-6))  # absurdly low → fires
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .build())
+    result = EarlyStoppingTrainer(conf, net, it).fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    net = iris_net()
+    it = IrisDataSetIterator(batch_size=50)
+    saver = LocalFileModelSaver(str(tmp_path))
+    conf = (EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(IrisDataSetIterator(batch_size=150)))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .model_saver(saver).save_last_model()
+            .build())
+    EarlyStoppingTrainer(conf, net, it).fit()
+    assert os.path.exists(str(tmp_path / "bestModel.zip"))
+    assert os.path.exists(str(tmp_path / "latestModel.zip"))
+    best = saver.get_best_model()
+    x, y = _iris_batch()
+    assert best.evaluate(x, y).accuracy() > 0.3
+
+
+# --------------------------------------------------------- transfer learning
+
+def test_frozen_layer_params_do_not_move():
+    net = iris_net()
+    x, y = _iris_batch()
+    tl = (TransferLearning.Builder(net)
+          .set_feature_extractor(0)
+          .build())
+    w0_before = np.asarray(tl.params["layer_0"]["W"]).copy()
+    w1_before = np.asarray(tl.params["layer_1"]["W"]).copy()
+    tl.fit(x, y, epochs=5)
+    np.testing.assert_array_equal(np.asarray(tl.params["layer_0"]["W"]),
+                                  w0_before)  # frozen
+    assert np.abs(np.asarray(tl.params["layer_1"]["W"]) - w1_before).max() > 0
+
+
+def test_transfer_learning_replace_output():
+    net = iris_net()
+    x, y = _iris_batch()
+    net.fit(x, y, epochs=30)
+    # keep features, new 5-class head
+    tl = (TransferLearning.Builder(net)
+          .fine_tune_configuration(updater=Adam(learning_rate=0.01))
+          .set_feature_extractor(1)
+          .remove_output_layer()
+          .add_layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+          .build())
+    assert tl.output(x).shape == (150, 5)
+    # retained layer params are the trained ones
+    np.testing.assert_allclose(np.asarray(tl.params["layer_0"]["W"]),
+                               np.asarray(net.params["layer_0"]["W"]))
+    y5 = np.eye(5)[np.random.default_rng(0).integers(0, 5, 150)]
+    s0 = tl.score(x=x, y=y5)
+    tl.fit(x, y5, epochs=20)
+    assert tl.score(x=x, y=y5) < s0
+
+
+def test_transfer_learning_nout_replace():
+    net = iris_net()
+    tl = (TransferLearning.Builder(net)
+          .n_out_replace(1, 20)  # widen middle layer; output re-inits
+          .build())
+    x, y = _iris_batch()
+    assert tl.params["layer_1"]["W"].shape[1] == 20
+    assert tl.params["layer_2"]["W"].shape[0] == 20
+    assert tl.output(x).shape == (150, 3)
+    assert np.isfinite(tl.score(x=x, y=y))
+
+
+def test_transfer_learning_helper_featurize():
+    net = iris_net()
+    x, y = _iris_batch()
+    net.fit(x, y, epochs=10)
+    frozen = (TransferLearning.Builder(net).set_feature_extractor(0).build())
+    helper = TransferLearningHelper(frozen)
+    feats = helper.featurize(x)
+    assert np.asarray(feats).shape == (150, 16)
+    w0 = np.asarray(frozen.params["layer_0"]["W"]).copy()
+    helper.fit_featurized(feats, y, epochs=10)
+    np.testing.assert_array_equal(np.asarray(frozen.params["layer_0"]["W"]), w0)
+    assert frozen.evaluate(x, y).accuracy() > 0.5
+
+
+def test_graph_transfer_learning():
+    g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(learning_rate=0.02))
+         .graph_builder().add_inputs("in")
+         .add_layer("d0", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d0")
+         .set_outputs("out").set_input_types(InputType.feed_forward(4))
+         .build())
+    net = ComputationGraph(g).init()
+    x, y = _iris_batch()
+    net.fit(x, y, epochs=10)
+    tl = (TransferLearning.GraphBuilder(net)
+          .set_feature_extractor("d0")
+          .remove_vertex_and_connections("out")
+          .add_layer("newout", OutputLayer(n_out=2, activation="softmax",
+                                           loss="mcxent"), "d0")
+          .set_outputs("newout")
+          .build())
+    np.testing.assert_allclose(np.asarray(tl.params["d0"]["W"]),
+                               np.asarray(net.params["d0"]["W"]))
+    y2 = np.eye(2)[np.random.default_rng(1).integers(0, 2, 150)]
+    w_before = np.asarray(tl.params["d0"]["W"]).copy()
+    tl.fit(x, y2, epochs=5)
+    np.testing.assert_array_equal(np.asarray(tl.params["d0"]["W"]), w_before)
+    assert tl.output(x).shape == (150, 2)
+
+
+def test_frozen_layer_serde(tmp_path):
+    net = iris_net()
+    tl = TransferLearning.Builder(net).set_feature_extractor(0).build()
+    p = str(tmp_path / "frozen.zip")
+    model_serializer.write_model(tl, p)
+    net2 = model_serializer.restore_multi_layer_network(p)
+    assert isinstance(net2.conf.layers[0], FrozenLayer)
+    x, _ = _iris_batch()
+    np.testing.assert_allclose(np.asarray(tl.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-7)
+
+
+def test_save_restore_bidirectional(tmp_path):
+    """Review regression: nested param groups (Bidirectional fwd/bwd) must
+    survive the npz round-trip."""
+    from deeplearning4j_tpu.nn.layers.recurrent import (Bidirectional,
+                                                        LastTimeStep, LSTM)
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(Bidirectional(fwd=LSTM(n_out=6)))
+            .layer(LastTimeStep(underlying=LSTM(n_out=6)))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 7)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).standard_normal((4, 7, 3))
+    p = str(tmp_path / "bi.zip")
+    model_serializer.write_model(net, p)
+    net2 = model_serializer.restore_multi_layer_network(p)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-7)
+
+
+def test_graph_fit_dataset_batch():
+    """Review regression: cg.fit(DataSet) treats it as ONE batch."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(learning_rate=0.02))
+         .graph_builder().add_inputs("in")
+         .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d")
+         .set_outputs("out").set_input_types(InputType.feed_forward(4))
+         .build())
+    net = ComputationGraph(g).init()
+    x, y = _iris_batch()
+    net.fit(DataSet(x, y))
+    assert np.isfinite(net.get_score())
