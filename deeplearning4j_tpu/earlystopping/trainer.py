@@ -41,8 +41,8 @@ class EarlyStoppingTrainer:
             if hasattr(self.train_iterator, "reset"):
                 self.train_iterator.reset()
             for batch in self.train_iterator:
-                self.net.fit(batch)
-                last = self.net.get_score()
+                # fit_batch: no epoch bookkeeping — this loop owns epochs
+                last = self.net.fit_batch(batch)
                 for c in conf.iteration_terminations:
                     if c.terminate(last):
                         it_terminated = c

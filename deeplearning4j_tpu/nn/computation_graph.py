@@ -246,6 +246,28 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def fit_batch(self, batch) -> float:
+        """One train step on one batch WITHOUT epoch bookkeeping (used by
+        EarlyStoppingTrainer, which owns the epoch loop)."""
+        if self.params == {}:
+            self.init()
+        xs, ys, ms, lms = self._normalize_batch(batch)
+        xs = [jnp.asarray(x) for x in xs]
+        ys = [jnp.asarray(y) for y in ys]
+        ms = None if ms is None else [
+            None if m is None else jnp.asarray(m) for m in ms]
+        lms = None if lms is None else [
+            None if m is None else jnp.asarray(m) for m in lms]
+        step_fn = self._get_jitted("train_step")
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.state, self.opt_state, loss = step_fn(
+            self.params, self.state, self.opt_state, key, xs, ys, ms, lms)
+        self._score = float(loss)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return self._score
+
     def fit(self, data=None, labels=None, *, epochs: int = 1,
             masks=None, label_masks=None) -> "ComputationGraph":
         """Train.  ``data`` may be (inputs, labels) (each an array or list of
@@ -376,8 +398,8 @@ def check_graph_gradients(net: ComputationGraph, inputs, labels, *,
                           epsilon: float = 1e-6, max_rel_error: float = 1e-3,
                           min_abs_error: float = 1e-8, masks=None,
                           label_masks=None, print_results: bool = False,
-                          subset: Optional[int] = None, seed: int = 12345
-                          ) -> bool:
+                          subset: Optional[int] = None, seed: int = 12345,
+                          exclude: tuple = ("centers",)) -> bool:
     """GradientCheckUtil for graphs (reference checkGradients CG variant)."""
     from ..utils.gradient_check import _check_gradients_impl
     if not net.params:
@@ -399,4 +421,4 @@ def check_graph_gradients(net: ComputationGraph, inputs, labels, *,
     analytic = jax.grad(loss_fn)(params)
     return _check_gradients_impl(loss_fn, params, analytic, epsilon,
                                  max_rel_error, min_abs_error, print_results,
-                                 subset, seed)
+                                 subset, seed, exclude)

@@ -1,0 +1,191 @@
+"""Reconstruction distributions for the variational autoencoder.
+
+Reference ``nn/conf/layers/variational/``: ``ReconstructionDistribution``
+implementations (Bernoulli, Gaussian, Exponential, Composite,
+LossFunctionWrapper).  Each maps a slice of the decoder pre-output to
+p(x|z): ``dist_params_size`` says how many pre-output units a data dimension
+needs, ``neg_log_prob`` scores data under the distribution, ``sample``/
+``mean`` generate (reference ``generateAtMeanGivenZ``/``generateRandomGivenZ``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from .. import activations as _act
+from .. import losses as _losses
+
+Array = jax.Array
+_EPS = 1e-7
+
+
+@dataclass
+class ReconstructionDistribution:
+    def dist_params_size(self, data_size: int) -> int:
+        return data_size
+
+    def neg_log_prob(self, x: Array, preout: Array, average: bool = True) -> Array:
+        raise NotImplementedError
+
+    def mean(self, preout: Array) -> Array:
+        raise NotImplementedError
+
+    def sample(self, key, preout: Array) -> Array:
+        raise NotImplementedError
+
+    def has_loss_function(self) -> bool:
+        return False
+
+
+@register_serde
+@dataclass
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """p(x|z) = Bernoulli(sigmoid(preout)) (reference
+    ``BernoulliReconstructionDistribution.java``)."""
+    activation: str = "sigmoid"
+
+    def neg_log_prob(self, x, preout, average=True):
+        p = _act.get(self.activation)(preout)
+        p = jnp.clip(p, _EPS, 1 - _EPS)
+        ll = x * jnp.log(p) + (1 - x) * jnp.log(1 - p)
+        per_ex = -jnp.sum(ll, axis=-1)
+        return jnp.mean(per_ex) if average else jnp.sum(per_ex)
+
+    def mean(self, preout):
+        return _act.get(self.activation)(preout)
+
+    def sample(self, key, preout):
+        return jax.random.bernoulli(
+            key, self.mean(preout)).astype(preout.dtype)
+
+
+@register_serde
+@dataclass
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """p(x|z) = N(mu, sigma^2); preout packs [mu, log sigma^2] (reference
+    ``GaussianReconstructionDistribution.java`` — 2 params per dimension)."""
+    activation: str = "identity"
+
+    def dist_params_size(self, data_size: int) -> int:
+        return 2 * data_size
+
+    def _split(self, preout):
+        n = preout.shape[-1] // 2
+        mu = _act.get(self.activation)(preout[..., :n])
+        log_var = preout[..., n:]
+        return mu, log_var
+
+    def neg_log_prob(self, x, preout, average=True):
+        mu, log_var = self._split(preout)
+        log_var = jnp.clip(log_var, -20.0, 20.0)
+        var = jnp.exp(log_var)
+        ll = -0.5 * (jnp.log(2 * jnp.pi) + log_var + (x - mu) ** 2 / var)
+        per_ex = -jnp.sum(ll, axis=-1)
+        return jnp.mean(per_ex) if average else jnp.sum(per_ex)
+
+    def mean(self, preout):
+        return self._split(preout)[0]
+
+    def sample(self, key, preout):
+        mu, log_var = self._split(preout)
+        std = jnp.exp(0.5 * jnp.clip(log_var, -20.0, 20.0))
+        return mu + std * jax.random.normal(key, mu.shape, mu.dtype)
+
+
+@register_serde
+@dataclass
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """p(x|z) = Exp(lambda = exp(preout)) — reference
+    ``ExponentialReconstructionDistribution.java`` parameterizes via
+    gamma = log(lambda)."""
+    activation: str = "identity"
+
+    def neg_log_prob(self, x, preout, average=True):
+        gamma = _act.get(self.activation)(preout)
+        gamma = jnp.clip(gamma, -20.0, 20.0)
+        lam = jnp.exp(gamma)
+        ll = gamma - lam * x
+        per_ex = -jnp.sum(ll, axis=-1)
+        return jnp.mean(per_ex) if average else jnp.sum(per_ex)
+
+    def mean(self, preout):
+        return jnp.exp(-jnp.clip(_act.get(self.activation)(preout), -20.0, 20.0))
+
+    def sample(self, key, preout):
+        u = jax.random.uniform(key, preout.shape, preout.dtype, _EPS, 1.0)
+        return -jnp.log(u) * self.mean(preout)
+
+
+@register_serde
+@dataclass
+class CompositeReconstructionDistribution(ReconstructionDistribution):
+    """Different distributions over slices of the data vector (reference
+    ``CompositeReconstructionDistribution.java``).  ``components`` is a list
+    of (data_size, distribution)."""
+    components: List[Any] = field(default_factory=list)
+
+    def add(self, data_size: int, dist) -> "CompositeReconstructionDistribution":
+        self.components.append([int(data_size), dist])
+        return self
+
+    def dist_params_size(self, data_size: int) -> int:
+        total_data = sum(c[0] for c in self.components)
+        if data_size != total_data:
+            raise ValueError(
+                f"composite covers {total_data} dims, data has {data_size}")
+        return sum(c[1].dist_params_size(c[0]) for c in self.components)
+
+    def _slices(self):
+        xi = pi = 0
+        for size, dist in self.components:
+            psize = dist.dist_params_size(size)
+            yield (xi, xi + size), (pi, pi + psize), dist
+            xi += size
+            pi += psize
+
+    def neg_log_prob(self, x, preout, average=True):
+        total = jnp.zeros(())
+        for (x0, x1), (p0, p1), dist in self._slices():
+            total = total + dist.neg_log_prob(x[..., x0:x1],
+                                              preout[..., p0:p1], average)
+        return total
+
+    def mean(self, preout):
+        return jnp.concatenate([d.mean(preout[..., p0:p1])
+                                for (_, _), (p0, p1), d in self._slices()],
+                               axis=-1)
+
+    def sample(self, key, preout):
+        outs = []
+        for i, ((_, _), (p0, p1), d) in enumerate(self._slices()):
+            outs.append(d.sample(jax.random.fold_in(key, i),
+                                 preout[..., p0:p1]))
+        return jnp.concatenate(outs, axis=-1)
+
+
+@register_serde
+@dataclass
+class LossFunctionWrapper(ReconstructionDistribution):
+    """Plain loss as a pseudo-distribution (reference
+    ``LossFunctionWrapper.java`` — turns the VAE into a standard deep AE)."""
+    loss: str = "mse"
+    activation: str = "identity"
+
+    def has_loss_function(self) -> bool:
+        return True
+
+    def neg_log_prob(self, x, preout, average=True):
+        val = _losses.get(self.loss)(x, preout, self.activation, None)
+        if not average:
+            val = val * x.shape[0]
+        return val
+
+    def mean(self, preout):
+        return _act.get(self.activation)(preout)
+
+    def sample(self, key, preout):
+        return self.mean(preout)

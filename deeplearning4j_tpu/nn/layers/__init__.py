@@ -3,19 +3,23 @@ from .base import BaseLayerConf, LayerConf
 from .convolution import (Convolution1DLayer, ConvolutionLayer,
                           Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
                           Upsampling2D, ZeroPaddingLayer)
-from .feedforward import (ActivationLayer, DenseLayer, DropoutLayer,
-                          EmbeddingLayer, LossLayer, OutputLayer)
+from .feedforward import (ActivationLayer, CenterLossOutputLayer, DenseLayer,
+                          DropoutLayer, EmbeddingLayer, LossLayer, OutputLayer)
+from .misc import FrozenLayer
 from .normalization import BatchNormalization, LocalResponseNormalization
+from .objdetect import Yolo2OutputLayer
 from .pooling import GlobalPoolingLayer
+from .pretrain import AutoEncoder, RBM, VariationalAutoencoder
 from .recurrent import (Bidirectional, GravesBidirectionalLSTM, GravesLSTM,
                         LastTimeStep, LSTM, RnnOutputLayer, SimpleRnn)
 
 __all__ = [
-    "ActivationLayer", "BaseLayerConf", "BatchNormalization", "Bidirectional",
-    "Convolution1DLayer", "ConvolutionLayer", "DenseLayer", "DropoutLayer",
-    "EmbeddingLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
+    "ActivationLayer", "AutoEncoder", "BaseLayerConf", "BatchNormalization",
+    "Bidirectional", "CenterLossOutputLayer", "Convolution1DLayer",
+    "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
+    "FrozenLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
     "GravesLSTM", "LastTimeStep", "LayerConf", "LocalResponseNormalization",
-    "LossLayer", "LSTM", "OutputLayer", "RnnOutputLayer", "SimpleRnn",
+    "LossLayer", "LSTM", "OutputLayer", "RBM", "RnnOutputLayer", "SimpleRnn",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling1D", "Upsampling2D",
-    "ZeroPaddingLayer",
+    "VariationalAutoencoder", "Yolo2OutputLayer", "ZeroPaddingLayer",
 ]

@@ -79,6 +79,45 @@ class OutputLayer(DenseLayer):
 
 @register_serde
 @dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (reference
+    ``nn/layers/training/CenterLossOutputLayer.java`` / conf
+    ``CenterLossOutputLayer``): intra-class compactness term
+    λ/2·||f − c_y||².  Centers live as a param whose gradient is decoupled
+    from the feature gradient via stop_gradient — the α-rate moving-average
+    center update of the reference becomes plain SGD on the center term."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init(self, key, itype):
+        out = super().init(key, itype)
+        out["params"]["centers"] = jnp.zeros((self.n_out, self.n_in),
+                                             self._dtype())
+        return out
+
+    def regularization_score(self, params):
+        # centers are statistics, not weights — exclude from l1/l2
+        return super().regularization_score(
+            {k: v for k, v in params.items() if k != "centers"})
+
+    def compute_loss(self, variables, x, labels, *, train=False, key=None,
+                     mask=None, average=True):
+        base = super().compute_loss(variables, x, labels, train=train,
+                                    key=key, mask=mask, average=average)
+        centers = variables["params"]["centers"]
+        c_sel = labels @ centers                     # one-hot row-select
+        diff_f = x - jax.lax.stop_gradient(c_sel)    # pulls features to centers
+        diff_c = jax.lax.stop_gradient(x) - c_sel    # pulls centers to features
+        l_feat = 0.5 * self.lambda_ * jnp.mean(jnp.sum(diff_f ** 2, axis=-1))
+        l_cent = 0.5 * self.alpha * jnp.mean(jnp.sum(diff_c ** 2, axis=-1))
+        # value-neutral center update: contributes gradient (to centers only)
+        # but zero to the reported score — matching the reference, where the
+        # α-rate center update happens outside the loss
+        return base + l_feat + l_cent - jax.lax.stop_gradient(l_cent)
+
+
+@register_serde
+@dataclass
 class LossLayer(BaseLayerConf):
     """Loss-only head, no params (reference ``nn/conf/layers/LossLayer``)."""
     loss: str = "mse"

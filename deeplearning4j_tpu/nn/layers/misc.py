@@ -29,6 +29,10 @@ class FrozenLayer(LayerConf):
     FROZEN = True
 
     @property
+    def INPUT_KIND(self):  # auto-preprocessor insertion sees the real kind
+        return getattr(self.underlying, "INPUT_KIND", "any")
+
+    @property
     def HAS_CARRY(self):
         return getattr(self.underlying, "HAS_CARRY", False)
 

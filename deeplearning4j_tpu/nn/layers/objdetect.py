@@ -1,0 +1,166 @@
+"""Object detection — YOLOv2 output layer.
+
+Reference ``nn/layers/objdetect/Yolo2OutputLayer.java:67`` + conf
+``nn/conf/layers/objdetect/Yolo2OutputLayer``.  NHWC layout (TPU-native;
+the reference is NCHW):
+
+  network activations  [b, H, W, B*(5+C)]   per box: (tx, ty, tw, th, tconf)
+  labels               [b, H, W, 4+C]       (x1, y1, x2, y2) in GRID units
+                                            + one-hot class; all-zero class
+                                            vector ⇒ no object in that cell
+
+Loss (YOLOv2): responsible predictor = best-IoU box per object cell
+(selected under stop_gradient); position/size L2 on (sigmoid(xy)+cell,
+sqrt(wh)); confidence targets IoU for responsible boxes, 0 elsewhere
+(λ_noobj weighted); softmax cross-entropy over classes.  Everything is
+branch-free masking — jit/TPU friendly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import LayerConf
+
+Array = jax.Array
+
+
+@register_serde
+@dataclass
+class Yolo2OutputLayer(LayerConf):
+    """YOLOv2 detection head: no params, shapes the loss over conv features."""
+    boxes: List[List[float]] = field(default_factory=lambda: [[1.0, 1.0]])
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    INPUT_KIND = "cnn"
+
+    # ---- shape ----
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def has_params(self):
+        return False
+
+    def n_boxes(self):
+        return len(self.boxes)
+
+    def n_classes(self, channels: int) -> int:
+        return channels // self.n_boxes() - 5
+
+    def _split(self, x):
+        """[b,H,W,B*(5+C)] → xy [b,H,W,B,2], wh, conf [b,H,W,B], cls [b,H,W,B,C]."""
+        b, H, W, ch = x.shape
+        B = self.n_boxes()
+        C = self.n_classes(ch)
+        x = x.reshape(b, H, W, B, 5 + C)
+        return x[..., 0:2], x[..., 2:4], x[..., 4], x[..., 5:]
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        """Activated predictions: sigmoid(xy)+cell offset, priors*exp(wh),
+        sigmoid(conf), softmax(classes) — [b,H,W,B,5+C] in grid units."""
+        txy, twh, tconf, tcls = self._split(x)
+        b, H, W, B = tconf.shape
+        cell = self._cell_offsets(H, W, x.dtype)
+        priors = jnp.asarray(self.boxes, x.dtype)
+        xy = jax.nn.sigmoid(txy) + cell[None, :, :, None, :]
+        wh = priors[None, None, None, :, :] * jnp.exp(jnp.clip(twh, -10, 10))
+        conf = jax.nn.sigmoid(tconf)
+        cls = jax.nn.softmax(tcls, axis=-1)
+        out = jnp.concatenate(
+            [xy, wh, conf[..., None], cls], axis=-1)
+        return out, variables.get("state", {})
+
+    @staticmethod
+    def _cell_offsets(H, W, dtype):
+        gy, gx = jnp.meshgrid(jnp.arange(H, dtype=dtype),
+                              jnp.arange(W, dtype=dtype), indexing="ij")
+        return jnp.stack([gx, gy], axis=-1)  # [H,W,2] (x,y)
+
+    def compute_loss(self, variables, x, labels, *, train=False, key=None,
+                     mask=None, average=True):
+        txy, twh, tconf, tcls = self._split(x)
+        b, H, W, B = tconf.shape
+        dtype = x.dtype
+        cell = self._cell_offsets(H, W, dtype)
+        priors = jnp.asarray(self.boxes, dtype)
+
+        # predictions in grid units
+        pred_xy = jax.nn.sigmoid(txy) + cell[None, :, :, None, :]
+        pred_wh = priors[None, None, None, :, :] * jnp.exp(
+            jnp.clip(twh, -10, 10))
+        pred_conf = jax.nn.sigmoid(tconf)
+
+        # ground truth
+        gt_x1y1 = labels[..., 0:2]
+        gt_x2y2 = labels[..., 2:4]
+        gt_cls = labels[..., 4:]
+        obj = (jnp.sum(gt_cls, axis=-1) > 0).astype(dtype)      # [b,H,W]
+        gt_xy = 0.5 * (gt_x1y1 + gt_x2y2)
+        gt_wh = jnp.maximum(gt_x2y2 - gt_x1y1, 1e-6)
+
+        # IoU of each predictor box vs the cell's gt box  [b,H,W,B]
+        iou = self._iou(pred_xy, pred_wh, gt_xy[..., None, :],
+                        gt_wh[..., None, :])
+        best = jax.lax.stop_gradient(
+            jax.nn.one_hot(jnp.argmax(iou, axis=-1), B, dtype=dtype))
+        resp = best * obj[..., None]                            # [b,H,W,B]
+
+        # position/size loss on the responsible predictor
+        d_xy = jnp.sum((pred_xy - gt_xy[..., None, :]) ** 2, axis=-1)
+        d_wh = jnp.sum((jnp.sqrt(pred_wh) -
+                        jnp.sqrt(gt_wh[..., None, :])) ** 2, axis=-1)
+        loss_coord = jnp.sum(resp * (d_xy + d_wh))
+
+        # confidence: responsible → target IoU; others → 0 with λ_noobj
+        conf_tgt = jax.lax.stop_gradient(iou)
+        loss_conf = jnp.sum(resp * (pred_conf - conf_tgt) ** 2) + \
+            self.lambda_no_obj * jnp.sum((1 - resp) * pred_conf ** 2)
+
+        # class probabilities: softmax xent at object cells
+        logp = jax.nn.log_softmax(tcls, axis=-1)
+        cls_xent = -jnp.sum(gt_cls[..., None, :] * logp, axis=-1)  # [b,H,W,B]
+        loss_cls = jnp.sum(resp * cls_xent)
+
+        total = self.lambda_coord * loss_coord + loss_conf + loss_cls
+        return total / b if average else total
+
+    @staticmethod
+    def _iou(xy1, wh1, xy2, wh2):
+        min1, max1 = xy1 - wh1 / 2, xy1 + wh1 / 2
+        min2, max2 = xy2 - wh2 / 2, xy2 + wh2 / 2
+        inter = jnp.prod(jnp.clip(jnp.minimum(max1, max2) -
+                                  jnp.maximum(min1, min2), 0.0, None), axis=-1)
+        a1 = jnp.prod(wh1, axis=-1)
+        a2 = jnp.prod(wh2, axis=-1)
+        return inter / (a1 + a2 - inter + 1e-9)
+
+
+def get_predicted_objects(activated, threshold: float = 0.5):
+    """Decode [b,H,W,B,5+C] activated predictions into per-image detections
+    (reference ``YoloUtils.getPredictedObjects``): list over batch of
+    (x1, y1, x2, y2, confidence, class_index) arrays in grid units."""
+    import numpy as np
+    acts = np.asarray(activated)
+    out = []
+    for img in acts:
+        dets = []
+        H, W, B, _ = img.shape
+        for r in range(H):
+            for c in range(W):
+                for bi in range(B):
+                    p = img[r, c, bi]
+                    conf = p[4]
+                    if conf >= threshold:
+                        cx, cy, w, h = p[0], p[1], p[2], p[3]
+                        cls = int(np.argmax(p[5:]))
+                        dets.append((cx - w / 2, cy - h / 2,
+                                     cx + w / 2, cy + h / 2,
+                                     float(conf * p[5 + cls]), cls))
+        out.append(np.asarray(dets, dtype=np.float32).reshape(-1, 6))
+    return out

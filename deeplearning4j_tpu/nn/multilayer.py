@@ -356,6 +356,105 @@ class MultiLayerNetwork:
                 carries[f"layer_{i}"] = lc.init_carry(batch, dtype)
         return carries
 
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+        """Greedy layerwise unsupervised pretraining (reference
+        ``MultiLayerNetwork.pretrain(DataSetIterator)`` :1173): every
+        PRETRAINABLE layer (AutoEncoder/RBM/VAE) trains on the features
+        produced by the (already-pretrained) layers below it."""
+        if self.params == {}:
+            self.init()
+        for i, lc in enumerate(self.layers):
+            if getattr(lc, "PRETRAINABLE", False):
+                self.pretrain_layer(i, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, i: int, data, epochs: int = 1) -> None:
+        """Pretrain one layer (reference ``pretrainLayer``).  The prefix
+        0..i-1 runs inference-mode under the same jit; only layer i's params
+        receive gradients/updates."""
+        lc = self.layers[i]
+        if not getattr(lc, "PRETRAINABLE", False):
+            return
+        if self.params == {}:
+            self.init()
+        from ._common import hyperparam_conf
+        hc = hyperparam_conf(lc)
+        updater = (hc.updater if hc is not None and hc.updater is not None
+                   else self._default_updater())
+        tx = updater.to_optax()
+        lname = f"layer_{i}"
+        opt = tx.init(self.params[lname])
+        frozen = {k: v for k, v in self.params.items() if k != lname}
+
+        @jax.jit
+        def step(p_i, opt_state, key, x):
+            def loss_fn(pp):
+                feats = x
+                if i > 0:
+                    all_p = dict(frozen)
+                    all_p[lname] = pp
+                    feats, _ = self._forward(all_p, self.state, x,
+                                             train=False, key=None,
+                                             to_layer=i)
+                variables = {"params": pp,
+                             "state": self.state.get(lname, {})}
+                return lc.pretrain_loss(variables, feats, key=key, train=True)
+            loss, grads = jax.value_and_grad(loss_fn)(p_i)
+            updates, new_opt = tx.update(grads, opt_state, p_i)
+            return optax.apply_updates(p_i, updates), new_opt, loss
+
+        p_i = self.params[lname]
+        if epochs > 1 and not hasattr(data, "shape") and \
+                not isinstance(data, (tuple, list)) and \
+                not hasattr(data, "reset") and iter(data) is data:
+            data = list(data)  # bare generator: materialize for re-iteration
+        for _ in range(epochs):
+            for batch in self._pretrain_batches(data):
+                self._rng, key = jax.random.split(self._rng)
+                p_i, opt, loss = step(p_i, opt, key, jnp.asarray(batch))
+                self._score = float(loss)
+                self.iteration += 1
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration, self.epoch)
+        self.params[lname] = p_i
+        # rebuild optimizer state so supervised fine-tuning starts clean
+        self.opt_state = self._tx.init(self.params)
+
+    def _pretrain_batches(self, data):
+        if hasattr(data, "shape"):                      # bare feature array
+            yield data
+            return
+        if isinstance(data, tuple) and len(data) in (2, 4):
+            yield self._normalize_batch(data)[0]        # (x, y): features only
+            return
+        if hasattr(data, "features"):                   # single DataSet
+            yield self._normalize_batch(data)[0]
+            return
+        if hasattr(data, "reset"):
+            data.reset()
+        for b in data:
+            yield b if hasattr(b, "shape") else self._normalize_batch(b)[0]
+
+    def fit_batch(self, batch) -> float:
+        """One train step on one batch WITHOUT epoch bookkeeping (used by
+        EarlyStoppingTrainer, which owns the epoch loop)."""
+        if self.params == {}:
+            self.init()
+        x, y, m, lm = self._normalize_batch(batch)
+        step_fn = self._get_jitted("train_step")
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.state, self.opt_state, loss = step_fn(
+            self.params, self.state, self.opt_state, key,
+            jnp.asarray(x), jnp.asarray(y),
+            None if m is None else jnp.asarray(m),
+            None if lm is None else jnp.asarray(lm))
+        self._score = float(loss)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return self._score
+
     # ------------------------------------------------------ stateful RNN API
     def rnn_time_step(self, x) -> Array:
         """Streaming inference with persistent recurrent state (reference

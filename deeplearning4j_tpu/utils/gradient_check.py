@@ -22,7 +22,8 @@ import numpy as np
 def check_gradients(net, x, y, *, epsilon: float = 1e-6,
                     max_rel_error: float = 1e-3, min_abs_error: float = 1e-8,
                     mask=None, label_mask=None, print_results: bool = False,
-                    subset: Optional[int] = None, seed: int = 12345) -> bool:
+                    subset: Optional[int] = None, seed: int = 12345,
+                    exclude: tuple = ("centers",)) -> bool:
     """Check d(loss)/d(params) for a MultiLayerNetwork (or compatible).
 
     subset: if set, check only this many randomly-chosen parameters per layer
@@ -49,11 +50,12 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6,
     analytic = jax.grad(loss_fn)(params)
     return _check_gradients_impl(loss_fn, params, analytic, epsilon,
                                  max_rel_error, min_abs_error, print_results,
-                                 subset, seed)
+                                 subset, seed, exclude)
 
 
 def _check_gradients_impl(loss_fn, params, analytic, epsilon, max_rel_error,
-                          min_abs_error, print_results, subset, seed) -> bool:
+                          min_abs_error, print_results, subset, seed,
+                          exclude: tuple = ()) -> bool:
     flat_params, treedef = jax.tree_util.tree_flatten(params)
     flat_grads = jax.tree_util.tree_leaves(analytic)
     paths = [jax.tree_util.keystr(kp)
@@ -73,6 +75,11 @@ def _check_gradients_impl(loss_fn, params, analytic, epsilon, max_rel_error,
         return float(loss_fn(jax.tree_util.tree_unflatten(treedef, leaves)))
 
     for li, (pa, ga) in enumerate(zip(arrays, flat_grads)):
+        if any(e in paths[li] for e in exclude):
+            # statistics-like params (class centers ≙ reference "cL") are
+            # intentionally updated with decoupled/stop-gradient rules and
+            # are excluded from the oracle, as the reference excludes them
+            continue
         ga_flat = np.asarray(ga, np.float64).reshape(-1)
         n = pa.size
         if subset is not None and n > subset:

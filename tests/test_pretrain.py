@@ -1,0 +1,289 @@
+"""AutoEncoder / RBM / VAE pretraining, CenterLoss, YOLOv2
+(reference: VaeGradientCheckTests, YoloGradientCheckTests, RBM tests).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.conf.variational import (
+    BernoulliReconstructionDistribution, CompositeReconstructionDistribution,
+    GaussianReconstructionDistribution, LossFunctionWrapper)
+from deeplearning4j_tpu.nn.layers.feedforward import (CenterLossOutputLayer,
+                                                      DenseLayer, OutputLayer)
+from deeplearning4j_tpu.nn.layers.objdetect import (Yolo2OutputLayer,
+                                                    get_predicted_objects)
+from deeplearning4j_tpu.nn.layers.pretrain import (AutoEncoder, RBM,
+                                                   VariationalAutoencoder)
+from deeplearning4j_tpu.utils.gradient_check import (_check_gradients_impl,
+                                                     check_gradients)
+
+
+def _toy_x(n=32, f=8, seed=0, binary=False):
+    rng = np.random.default_rng(seed)
+    if binary:
+        return (rng.random((n, f)) > 0.5).astype(np.float64)
+    return rng.standard_normal((n, f))
+
+
+def _pretrain_grad_check(layer, x, key=None, **kw):
+    """Central-difference check of a layer's pretrain_loss."""
+    v = layer.init(jax.random.PRNGKey(3), None)
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64),
+                                    v["params"])
+    x = jnp.asarray(x, jnp.float64)
+
+    @jax.jit
+    def loss_fn(p):
+        return layer.pretrain_loss({"params": p, "state": {}}, x,
+                                   key=key, train=key is not None)
+
+    analytic = jax.grad(loss_fn)(params)
+    return _check_gradients_impl(loss_fn, params, analytic, 1e-6, 1e-3, 1e-8,
+                                 False, kw.get("subset"), 12345)
+
+
+# ------------------------------------------------------------- autoencoder
+
+def test_autoencoder_gradcheck():
+    ae = AutoEncoder(n_in=8, n_out=5, corruption_level=0.0,
+                     activation="sigmoid", visible_loss="mse",
+                     weight_init="xavier", bias_init=0.0, dtype="float64")
+    assert _pretrain_grad_check(ae, _toy_x())
+
+
+def test_autoencoder_sparsity_gradcheck():
+    ae = AutoEncoder(n_in=8, n_out=5, corruption_level=0.0, sparsity=0.1,
+                     activation="sigmoid", visible_loss="xent",
+                     weight_init="xavier", bias_init=0.0, dtype="float64")
+    assert _pretrain_grad_check(ae, _toy_x(binary=True))
+
+
+def test_autoencoder_pretrain_reduces_reconstruction():
+    x = _toy_x(n=100, f=10, binary=True)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01)).activation("sigmoid")
+            .list()
+            .layer(AutoEncoder(n_out=6, corruption_level=0.2,
+                               visible_loss="xent"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ae = net.layers[0]
+    v0 = {"params": net.params["layer_0"], "state": {}}
+    l0 = float(ae.pretrain_loss(v0, jnp.asarray(x), key=None, train=False))
+    net.pretrain(x, epochs=200)
+    v1 = {"params": net.params["layer_0"], "state": {}}
+    l1 = float(ae.pretrain_loss(v1, jnp.asarray(x), key=None, train=False))
+    assert l1 < l0 * 0.8
+
+
+# --------------------------------------------------------------------- rbm
+
+def test_rbm_pretrain_improves_free_energy_gap():
+    """After CD-1 training, data free energy should drop relative to noise."""
+    rng = np.random.default_rng(1)
+    # structured data: two prototype patterns + noise
+    protos = (rng.random((2, 12)) > 0.5).astype(np.float64)
+    x = protos[rng.integers(0, 2, 200)]
+    flip = rng.random(x.shape) < 0.05
+    x = np.where(flip, 1 - x, x)
+
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(learning_rate=0.05)).list()
+            .layer(RBM(n_out=8, k=1))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rbm = net.layers[0]
+    noise = (rng.random((200, 12)) > 0.5).astype(np.float64)
+
+    def gap(params):
+        fe_data = float(jnp.mean(rbm._free_energy(params, jnp.asarray(x))))
+        fe_noise = float(jnp.mean(rbm._free_energy(params, jnp.asarray(noise))))
+        return fe_data - fe_noise
+
+    g0 = gap(net.params["layer_0"])
+    net.pretrain(x, epochs=100)
+    g1 = gap(net.params["layer_0"])
+    assert g1 < g0  # data became more probable relative to noise
+
+
+# --------------------------------------------------------------------- vae
+
+@pytest.mark.parametrize("dist", [
+    BernoulliReconstructionDistribution(),
+    GaussianReconstructionDistribution(),
+    LossFunctionWrapper(loss="mse", activation="identity"),
+])
+def test_vae_gradcheck_distributions(dist):
+    vae = VariationalAutoencoder(
+        n_in=6, n_out=3, encoder_layer_sizes=[10], decoder_layer_sizes=[10],
+        reconstruction_distribution=dist, activation="tanh",
+        weight_init="xavier", bias_init=0.0, dtype="float64")
+    binary = isinstance(dist, BernoulliReconstructionDistribution)
+    x = _toy_x(n=10, f=6, binary=binary)
+    # deterministic ELBO (eps=0) for the numeric check
+    assert _pretrain_grad_check(vae, x, key=None, subset=30)
+
+
+def test_vae_composite_distribution():
+    comp = (CompositeReconstructionDistribution()
+            .add(4, BernoulliReconstructionDistribution())
+            .add(3, GaussianReconstructionDistribution()))
+    vae = VariationalAutoencoder(
+        n_in=7, n_out=3, encoder_layer_sizes=[8], decoder_layer_sizes=[8],
+        reconstruction_distribution=comp, activation="tanh",
+        weight_init="xavier", bias_init=0.0, dtype="float64")
+    x = np.concatenate([_toy_x(10, 4, binary=True), _toy_x(10, 3)], axis=1)
+    assert _pretrain_grad_check(vae, x, key=None, subset=30)
+
+
+def test_vae_pretrain_and_generate():
+    rng = np.random.default_rng(3)
+    x = (rng.random((200, 12)) > 0.7).astype(np.float64)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.005)).activation("tanh")
+            .list()
+            .layer(VariationalAutoencoder(n_out=4, encoder_layer_sizes=[16],
+                                          decoder_layer_sizes=[16]))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    vae = net.layers[0]
+    v = {"params": net.params["layer_0"], "state": {}}
+    l0 = float(vae.pretrain_loss(v, jnp.asarray(x), key=None, train=False))
+    net.pretrain(x, epochs=150)
+    v = {"params": net.params["layer_0"], "state": {}}
+    l1 = float(vae.pretrain_loss(v, jnp.asarray(x), key=None, train=False))
+    assert l1 < l0
+    # latent forward + generation APIs (VAE layer activation = q(z|x) mean)
+    z = net.feed_forward(x[:5])[0]
+    assert z.shape == (5, 4)
+    recon = vae.generate_at_mean_given_z(v, jnp.asarray(z))
+    assert recon.shape == (5, 12)
+    assert np.all(np.asarray(recon) >= 0) and np.all(np.asarray(recon) <= 1)
+    logp = vae.reconstruction_probability(v, jnp.asarray(x[:5]),
+                                          jax.random.PRNGKey(0), num_samples=3)
+    assert logp.shape == (5,)
+    assert np.all(np.isfinite(np.asarray(logp)))
+
+
+# -------------------------------------------------------------- center loss
+
+def test_center_loss_gradcheck_and_training():
+    net_conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(CenterLossOutputLayer(n_out=3, activation="softmax",
+                                             loss="mcxent", lambda_=0.01))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+    net = MultiLayerNetwork(net_conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((15, 4))
+    y = np.eye(3)[rng.integers(0, 3, 15)]
+    assert check_gradients(net, x, y)
+    s0 = net.score(x=x, y=y)
+    c_before = np.asarray(net.params["layer_1"]["centers"]).copy()
+    net.fit(x, y, epochs=80)
+    assert net.score(x=x, y=y) < s0
+    # centers moved toward class features
+    assert np.abs(np.asarray(net.params["layer_1"]["centers"]) -
+                  c_before).max() > 1e-4
+
+
+# --------------------------------------------------------------------- yolo
+
+def _yolo_setup(seed=0):
+    H = W = 4
+    B, C = 2, 3
+    boxes = [[1.0, 1.5], [2.0, 1.0]]
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((2, H, W, B * (5 + C)))
+    labels = np.zeros((2, H, W, 4 + C))
+    # one object per image
+    for img in range(2):
+        r, c = rng.integers(0, H), rng.integers(0, W)
+        cx, cy = c + 0.5, r + 0.3
+        w, h = 1.2, 0.8
+        labels[img, r, c, 0:4] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+        labels[img, r, c, 4 + rng.integers(0, C)] = 1.0
+    return Yolo2OutputLayer(boxes=boxes), x, labels
+
+
+def test_yolo_loss_gradcheck():
+    layer, x, labels = _yolo_setup()
+    x = jnp.asarray(x, jnp.float64)
+    labels = jnp.asarray(labels, jnp.float64)
+
+    @jax.jit
+    def loss_fn(p):
+        return layer.compute_loss({"params": {}, "state": {}}, p["x"], labels)
+
+    params = {"x": x}  # check grads w.r.t. the input activations
+    analytic = jax.grad(loss_fn)(params)
+    assert _check_gradients_impl(loss_fn, params, analytic, 1e-6, 1e-3, 1e-8,
+                                 False, 60, 0)
+
+
+def test_yolo_training_and_decode():
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    layer, x, labels = _yolo_setup()
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(ConvolutionLayer(n_out=2 * (5 + 3), kernel_size=(1, 1),
+                                    activation="identity"))
+            .layer(layer)
+            .set_input_type(InputType.convolutional(4, 4, 2 * (5 + 3)))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x=x, y=labels)
+    net.fit(x, labels, epochs=150)
+    s1 = net.score(x=x, y=labels)
+    assert s1 < s0 * 0.5
+    # net.output applies the yolo head → activated [b,H,W,B,5+C]
+    dets = get_predicted_objects(net.output(x), threshold=0.0)
+    assert len(dets) == 2
+    assert dets[0].shape[1] == 6
+
+
+def test_pretrain_tuple_uses_features_only():
+    """Review regression: pretrain((x, y)) must train on x only."""
+    x = _toy_x(n=30, f=10, binary=True)
+    y = np.eye(3)[np.random.default_rng(0).integers(0, 3, 30)]
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.01)).activation("sigmoid").list()
+            .layer(AutoEncoder(n_out=6, corruption_level=0.0))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.pretrain((x, y), epochs=2)  # would crash/corrupt if y were a batch
+    assert np.isfinite(net.get_score())
+
+
+def test_early_stopping_epoch_counting():
+    """Review regression: trainer epochs must not inflate net.epoch."""
+    from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+    from deeplearning4j_tpu.earlystopping import (
+        EarlyStoppingConfiguration, EarlyStoppingTrainer,
+        MaxEpochsTerminationCondition)
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Adam(learning_rate=0.02)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    es = (EarlyStoppingConfiguration.builder()
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+          .build())
+    # 3 batches/epoch; net.epoch must stay 0 (trainer owns epochs)
+    EarlyStoppingTrainer(es, net, IrisDataSetIterator(batch_size=50)).fit()
+    assert net.epoch == 0
+    assert net.iteration == 4 * 3
