@@ -10,6 +10,7 @@ recorded round-0 CPU-reference figure (none yet → vs_baseline echoes value/
 BASELINE_EXAMPLES_PER_SEC when that constant is set, else 1.0).
 """
 import json
+import os
 import time
 
 import jax
@@ -21,23 +22,34 @@ BASELINE_EXAMPLES_PER_SEC = None
 
 
 def build_model():
-    """Flagship bench model — upgraded as the zoo grows."""
+    """Flagship bench model: ResNet50 (BASELINE.md north star).  Shape
+    overridable via env for CPU smoke-testing the bench path."""
     from deeplearning4j_tpu.models import available_bench_model
-    return available_bench_model()
+    return available_bench_model(
+        batch=int(os.environ.get("DL4J_TPU_BENCH_BATCH", "32")),
+        image=int(os.environ.get("DL4J_TPU_BENCH_IMAGE", "224")))
 
 
 def main():
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
     model, batch = build_model()
     x, y = jnp.asarray(batch[0]), jnp.asarray(batch[1])  # on device, outside the timed loop
+    is_graph = isinstance(model, ComputationGraph)
     model.fit(x, y)  # compile + first step
     step = model._get_jitted("train_step")
+
+    def run_step(key):
+        if is_graph:
+            return step(model.params, model.state, model.opt_state, key,
+                        [x], [y], None, None)
+        return step(model.params, model.state, model.opt_state, key,
+                    x, y, None, None)
 
     n_iter = 20
     t0 = time.perf_counter()
     for _ in range(n_iter):
         model._rng, key = jax.random.split(model._rng)
-        model.params, model.state, model.opt_state, loss = step(
-            model.params, model.state, model.opt_state, key, x, y, None, None)
+        model.params, model.state, model.opt_state, loss = run_step(key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 

@@ -1,27 +1,34 @@
 """Model zoo (reference ``deeplearning4j-zoo``) + bench/flagship selection."""
 import numpy as np
 
+from .zoo import (ALL_MODELS, AlexNet, FaceNetNN4Small2, GoogLeNet,
+                  InceptionResNetV1, LeNet, ResNet50, SimpleCNN,
+                  TextGenerationLSTM, VGG16, VGG19, ZooModel)
 
-def available_bench_model():
-    """Best available model+batch for bench.py — upgraded as the zoo grows."""
-    from ..nn.conf.multi_layer import NeuralNetConfiguration
-    from ..nn.conf.updaters import Adam
-    from ..nn.conf.input_type import InputType
-    from ..nn.layers.feedforward import DenseLayer, OutputLayer
-    from ..nn.multilayer import MultiLayerNetwork
+__all__ = [
+    "ALL_MODELS", "AlexNet", "FaceNetNN4Small2", "GoogLeNet",
+    "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
+    "TextGenerationLSTM", "VGG16", "VGG19", "ZooModel",
+    "available_bench_model", "flagship_entry_model",
+]
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(42).activation("relu").weight_init("xavier")
-            .updater(Adam(learning_rate=1e-3))
-            .list()
-            .layer(DenseLayer(n_out=1024))
-            .layer(DenseLayer(n_out=1024))
-            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
-            .set_input_type(InputType.feed_forward(784))
-            .build())
-    model = MultiLayerNetwork(conf).init()
+
+def available_bench_model(batch: int = 32, image: int = 224):
+    """Flagship bench model: ResNet50-ImageNet (the BASELINE.md north-star
+    metric is ResNet50 examples/sec/chip).  Returns (model, (x, y))."""
+    model = ResNet50(num_classes=1000,
+                     input_shape=(image, image, 3)).init()
     rng = np.random.default_rng(0)
-    batch = 512
-    x = rng.standard_normal((batch, 784), dtype=np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    x = rng.standard_normal((batch, image, image, 3), dtype=np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    return model, (x, y)
+
+
+def flagship_entry_model():
+    """Small-shape flagship instance for the driver's single-chip compile
+    check (same architecture, quick compile)."""
+    model = ResNet50(num_classes=100, input_shape=(96, 96, 3)).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 96, 96, 3), dtype=np.float32)
+    y = np.eye(100, dtype=np.float32)[rng.integers(0, 100, 8)]
     return model, (x, y)

@@ -1,0 +1,483 @@
+"""Model zoo (reference ``deeplearning4j-zoo``): standard architectures built
+on the config DSL — LeNet, SimpleCNN, AlexNet, VGG16/19, ResNet50, GoogLeNet,
+InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM.
+
+Reference ``deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/``:
+``LeNet.java:35``, ``AlexNet.java``, ``VGG16.java``, ``ResNet50.java:33``
+(graph built in init :82), ``GoogLeNet.java``, ``InceptionResNetV1.java``,
+``FaceNetNN4Small2.java``, ``SimpleCNN.java``, ``TextGenerationLSTM.java:34``.
+
+Architectures are the canonical published ones, NHWC, sized by
+``(height, width, channels)`` so tests can instantiate miniature variants.
+Pretrained-weight download (reference ``ZooModel.initPretrained`` checksum
+fetch, ``ZooModel.java:40-81``) is gated on a local weights path — this
+environment has no egress.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+from ..nn.computation_graph import ComputationGraph
+from ..nn.conf.computation_graph import (ElementWiseVertex, GraphBuilder,
+                                         L2NormalizeVertex, MergeVertex)
+from ..nn.conf.input_type import InputType
+from ..nn.conf.multi_layer import NeuralNetConfiguration
+from ..nn.conf.updaters import Adam, Nesterovs, Sgd, UpdaterConf
+from ..nn.layers.convolution import (ConvolutionLayer, SubsamplingLayer,
+                                     ZeroPaddingLayer)
+from ..nn.layers.feedforward import (ActivationLayer, DenseLayer,
+                                     DropoutLayer, OutputLayer)
+from ..nn.layers.normalization import (BatchNormalization,
+                                       LocalResponseNormalization)
+from ..nn.layers.pooling import GlobalPoolingLayer
+from ..nn.layers.recurrent import LSTM, RnnOutputLayer
+
+
+def _conv_block(g: GraphBuilder, name: str, inp: str, n_out: int, kernel,
+                stride=(1, 1), act: Optional[str] = None,
+                mode: str = "same") -> str:
+    """Add a conv layer vertex; act=None inherits the builder default."""
+    g.add_layer(name, ConvolutionLayer(
+        n_out=n_out, kernel_size=kernel, stride=stride,
+        convolution_mode=mode, activation=act), inp)
+    return name
+
+
+def _inception_block(g: GraphBuilder, name: str, inp: str, c1: int, c3r: int,
+                     c3: int, c5r: int, c5: int, pp: int) -> str:
+    """GoogLeNet-style inception module: 1x1 / 3x3 / 5x5 / pool-proj merge."""
+    a = _conv_block(g, f"{name}_1x1", inp, c1, (1, 1))
+    b = _conv_block(g, f"{name}_3x3r", inp, c3r, (1, 1))
+    b = _conv_block(g, f"{name}_3x3", b, c3, (3, 3))
+    d = _conv_block(g, f"{name}_5x5r", inp, c5r, (1, 1))
+    d = _conv_block(g, f"{name}_5x5", d, c5, (5, 5))
+    g.add_layer(f"{name}_pool", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(1, 1),
+        convolution_mode="same"), inp)
+    p = _conv_block(g, f"{name}_poolproj", f"{name}_pool", pp, (1, 1))
+    g.add_vertex(name, MergeVertex(), a, b, d, p)
+    return name
+
+
+def _max_pool(g: GraphBuilder, name: str, inp: str, kernel=(3, 3),
+              stride=(2, 2)) -> str:
+    g.add_layer(name, SubsamplingLayer(
+        pooling_type="max", kernel_size=kernel, stride=stride,
+        convolution_mode="same"), inp)
+    return name
+
+
+@dataclass
+class ZooModel:
+    """Base zoo model (reference ``ZooModel.java``)."""
+    num_classes: int = 1000
+    seed: int = 123
+    input_shape: Tuple[int, int, int] = (224, 224, 3)   # (h, w, c)
+    updater: Optional[UpdaterConf] = None
+
+    def init(self):
+        raise NotImplementedError
+
+    def pretrained(self, weights_path: Optional[str] = None):
+        """Load pretrained weights from a local checkpoint zip (the
+        reference downloads + checksums; this environment has no egress)."""
+        path = weights_path or os.environ.get("DL4J_TPU_PRETRAINED_DIR")
+        if not path:
+            raise FileNotFoundError(
+                f"no pretrained weights available for "
+                f"{type(self).__name__}; pass weights_path or set "
+                "DL4J_TPU_PRETRAINED_DIR")
+        from ..utils import model_serializer
+        if os.path.isdir(path):
+            path = os.path.join(path, f"{type(self).__name__.lower()}.zip")
+        return model_serializer.restore_model(path)
+
+    def _builder(self):
+        b = NeuralNetConfiguration.builder().seed(self.seed)
+        return b
+
+
+@dataclass
+class LeNet(ZooModel):
+    """LeNet-5 (reference ``model/LeNet.java:35``)."""
+    num_classes: int = 10
+    input_shape: Tuple[int, int, int] = (28, 28, 1)
+
+    def init(self):
+        h, w, c = self.input_shape
+        conf = (self._builder()
+                .updater(self.updater or Nesterovs(learning_rate=0.01, momentum=0.9))
+                .activation("relu").weight_init("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                        stride=(1, 1), convolution_mode="same"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                        stride=(1, 1), convolution_mode="same"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=500))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class SimpleCNN(ZooModel):
+    """Compact CNN (reference ``model/SimpleCNN.java``)."""
+    num_classes: int = 10
+    input_shape: Tuple[int, int, int] = (48, 48, 3)
+
+    def init(self):
+        h, w, c = self.input_shape
+        conf = (self._builder()
+                .updater(self.updater or Adam(learning_rate=1e-3))
+                .activation("relu").weight_init("relu")
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalization())
+                .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                        stride=(2, 2)))
+                .layer(DropoutLayer(dropout=0.5))
+                .layer(DenseLayer(n_out=256))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class AlexNet(ZooModel):
+    """AlexNet (reference ``model/AlexNet.java`` — one-tower variant)."""
+
+    def init(self):
+        h, w, c = self.input_shape
+        conf = (self._builder()
+                .updater(self.updater or Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .activation("relu").weight_init("relu").l2(5e-4)
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                        stride=(4, 4), convolution_mode="same"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                        convolution_mode="same"))
+                .layer(LocalResponseNormalization())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                        stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+
+def _vgg_blocks(cfg):
+    """cfg: list of (num_convs, channels)."""
+    layers = []
+    for n, ch in cfg:
+        for _ in range(n):
+            layers.append(ConvolutionLayer(n_out=ch, kernel_size=(3, 3),
+                                           convolution_mode="same"))
+        layers.append(SubsamplingLayer(pooling_type="max",
+                                       kernel_size=(2, 2), stride=(2, 2)))
+    return layers
+
+
+@dataclass
+class VGG16(ZooModel):
+    """VGG-16 (reference ``model/VGG16.java``)."""
+    BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+    def init(self):
+        h, w, c = self.input_shape
+        b = (self._builder()
+             .updater(self.updater or Nesterovs(learning_rate=1e-2, momentum=0.9))
+             .activation("relu").weight_init("xavier")
+             .list())
+        for lyr in _vgg_blocks(self.BLOCKS):
+            b.layer(lyr)
+        b.layer(DenseLayer(n_out=4096, dropout=0.5))
+        b.layer(DenseLayer(n_out=4096, dropout=0.5))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss="mcxent"))
+        conf = b.set_input_type(InputType.convolutional(h, w, c)).build()
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+
+@dataclass
+class VGG19(VGG16):
+    """VGG-19 (reference ``model/VGG19.java``)."""
+    BLOCKS = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+@dataclass
+class ResNet50(ZooModel):
+    """ResNet-50 (reference ``model/ResNet50.java:33``, graph in init :82):
+    conv/identity bottleneck blocks as a ComputationGraph with ElementWise
+    residual adds."""
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        g = GraphBuilder(
+            {"activation": "relu", "weight_init": "relu",
+             "updater": self.updater or Nesterovs(learning_rate=1e-1, momentum=0.9)},
+            seed=self.seed)
+        g.add_inputs("in").set_input_types(InputType.convolutional(h, w, c))
+
+        def conv_bn(name, inp, n_out, kernel, stride=(1, 1), act="relu",
+                    mode="same"):
+            x = _conv_block(g, name, inp, n_out, kernel, stride,
+                            act="identity", mode=mode)
+            g.add_layer(f"{name}_bn", BatchNormalization(activation=act), x)
+            return f"{name}_bn"
+
+        def bottleneck(name, inp, filters, stride, project):
+            f1, f2, f3 = filters
+            x = conv_bn(f"{name}_a", inp, f1, (1, 1), stride)
+            x = conv_bn(f"{name}_b", x, f2, (3, 3))
+            x = conv_bn(f"{name}_c", x, f3, (1, 1), act="identity")
+            if project:
+                sc = conv_bn(f"{name}_sc", inp, f3, (1, 1), stride,
+                             act="identity")
+            else:
+                sc = inp
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+            g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                        f"{name}_add")
+            return f"{name}_out"
+
+        x = conv_bn("conv1", "in", 64, (7, 7), (2, 2))
+        x = _max_pool(g, "pool1", x)
+        stages = [(3, (64, 64, 256), (1, 1)),
+                  (4, (128, 128, 512), (2, 2)),
+                  (6, (256, 256, 1024), (2, 2)),
+                  (3, (512, 512, 2048), (2, 2))]
+        for si, (blocks, filters, stride) in enumerate(stages):
+            for bi in range(blocks):
+                x = bottleneck(f"s{si}b{bi}", x, filters,
+                               stride if bi == 0 else (1, 1), bi == 0)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax", loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+@dataclass
+class GoogLeNet(ZooModel):
+    """GoogLeNet / Inception-v1 (reference ``model/GoogLeNet.java``)."""
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        g = GraphBuilder(
+            {"activation": "relu", "weight_init": "relu",
+             "updater": self.updater or Adam(learning_rate=1e-3)},
+            seed=self.seed)
+        g.add_inputs("in").set_input_types(InputType.convolutional(h, w, c))
+
+        x = _conv_block(g, "conv1", "in", 64, (7, 7), (2, 2))
+        x = _max_pool(g, "pool1", x)
+        x = _conv_block(g, "conv2r", x, 64, (1, 1))
+        x = _conv_block(g, "conv2", x, 192, (3, 3))
+        x = _max_pool(g, "pool2", x)
+        x = _inception_block(g, "i3a", x, 64, 96, 128, 16, 32, 32)
+        x = _inception_block(g, "i3b", x, 128, 128, 192, 32, 96, 64)
+        x = _max_pool(g, "pool3", x)
+        x = _inception_block(g, "i4a", x, 192, 96, 208, 16, 48, 64)
+        x = _inception_block(g, "i4b", x, 160, 112, 224, 24, 64, 64)
+        x = _inception_block(g, "i4c", x, 128, 128, 256, 24, 64, 64)
+        x = _inception_block(g, "i4d", x, 112, 144, 288, 32, 64, 64)
+        x = _inception_block(g, "i4e", x, 256, 160, 320, 32, 128, 128)
+        x = _max_pool(g, "pool4", x)
+        x = _inception_block(g, "i5a", x, 256, 160, 320, 32, 128, 128)
+        x = _inception_block(g, "i5b", x, 384, 192, 384, 48, 128, 128)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("dropout", DropoutLayer(dropout=0.4), "avgpool")
+        g.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax", loss="mcxent"),
+                    "dropout")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+@dataclass
+class InceptionResNetV1(ZooModel):
+    """Inception-ResNet v1, compact faithful rendition (reference
+    ``model/InceptionResNetV1.java`` — stem + scaled residual inception
+    blocks A/B/C with reduction blocks)."""
+    num_classes: int = 1000
+    input_shape: Tuple[int, int, int] = (160, 160, 3)
+    blocks_a: int = 5
+    blocks_b: int = 10
+    blocks_c: int = 5
+    embedding_size: int = 128
+
+    def init(self) -> ComputationGraph:
+        h, w, c = self.input_shape
+        g = GraphBuilder(
+            {"activation": "relu", "weight_init": "relu",
+             "updater": self.updater or Adam(learning_rate=1e-3)},
+            seed=self.seed)
+        g.add_inputs("in").set_input_types(InputType.convolutional(h, w, c))
+
+        def conv(name, inp, n_out, kernel, stride=(1, 1), act="relu"):
+            return _conv_block(g, name, inp, n_out, kernel, stride, act=act)
+
+        def res_block(name, inp, branches, channels, scale=0.17):
+            """Scaled residual add: out = relu(in + scale*conv(concat(branches)))."""
+            outs = []
+            for i, spec in enumerate(branches):
+                x = inp
+                for j, (n_out, kernel) in enumerate(spec):
+                    x = conv(f"{name}_br{i}_{j}", x, n_out, kernel)
+                outs.append(x)
+            if len(outs) > 1:
+                g.add_vertex(f"{name}_cat", MergeVertex(), *outs)
+                cat = f"{name}_cat"
+            else:
+                cat = outs[0]
+            up = conv(f"{name}_up", cat, channels, (1, 1), act="identity")
+            from ..nn.conf.computation_graph import ScaleVertex
+            g.add_vertex(f"{name}_scale", ScaleVertex(scale_factor=scale), up)
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"),
+                         inp, f"{name}_scale")
+            g.add_layer(f"{name}", ActivationLayer(activation="relu"),
+                        f"{name}_add")
+            return name
+
+        # stem (compact)
+        x = conv("stem1", "in", 32, (3, 3), (2, 2))
+        x = conv("stem2", x, 64, (3, 3))
+        x = _max_pool(g, "stempool", x)
+        x = conv("stem3", x, 128, (3, 3), (2, 2))
+        x = conv("stem4", x, 256, (3, 3), (2, 2))
+        # inception-resnet-A blocks
+        for i in range(self.blocks_a):
+            x = res_block(f"a{i}", x,
+                          [[(32, (1, 1))],
+                           [(32, (1, 1)), (32, (3, 3))],
+                           [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]], 256)
+        x = conv("redA", x, 384, (3, 3), (2, 2))
+        for i in range(self.blocks_b):
+            x = res_block(f"b{i}", x,
+                          [[(128, (1, 1))],
+                           [(128, (1, 1)), (128, (1, 7)), (128, (7, 1))]],
+                          384, scale=0.10)
+        x = conv("redB", x, 512, (3, 3), (2, 2))
+        for i in range(self.blocks_c):
+            x = res_block(f"c{i}", x,
+                          [[(192, (1, 1))],
+                           [(192, (1, 1)), (192, (1, 3)), (192, (3, 1))]],
+                          512, scale=0.20)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"), "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax", loss="mcxent"),
+                    "embeddings")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+@dataclass
+class FaceNetNN4Small2(ZooModel):
+    """FaceNet NN4-small2 style embedding net (reference
+    ``model/FaceNetNN4Small2.java``): inception-style trunk → L2-normalized
+    embedding → center-loss softmax head."""
+    num_classes: int = 100
+    input_shape: Tuple[int, int, int] = (96, 96, 3)
+    embedding_size: int = 128
+
+    def init(self) -> ComputationGraph:
+        from ..nn.layers.feedforward import CenterLossOutputLayer
+        h, w, c = self.input_shape
+        g = GraphBuilder(
+            {"activation": "relu", "weight_init": "relu",
+             "updater": self.updater or Adam(learning_rate=1e-3)},
+            seed=self.seed)
+        g.add_inputs("in").set_input_types(InputType.convolutional(h, w, c))
+
+        x = _conv_block(g, "conv1", "in", 64, (7, 7), (2, 2))
+        x = _max_pool(g, "pool1", x)
+        x = _conv_block(g, "conv2", x, 192, (3, 3))
+        x = _max_pool(g, "pool2", x)
+        x = _inception_block(g, "i3a", x, 64, 96, 128, 16, 32, 32)
+        x = _inception_block(g, "i3b", x, 64, 96, 128, 32, 64, 64)
+        x = _max_pool(g, "pool3", x)
+        x = _inception_block(g, "i4a", x, 256, 96, 192, 32, 64, 128)
+        x = _inception_block(g, "i4e", x, 160, 112, 224, 24, 64, 128)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"),
+                    "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("out", CenterLossOutputLayer(
+            n_out=self.num_classes, activation="softmax", loss="mcxent",
+            alpha=0.9, lambda_=5e-3), "embeddings")
+        g.set_outputs("out")
+        return ComputationGraph(g.build()).init()
+
+
+@dataclass
+class TextGenerationLSTM(ZooModel):
+    """Char-level text generation LSTM (reference
+    ``model/TextGenerationLSTM.java:34``)."""
+    num_classes: int = 26          # vocab size
+    timesteps: int = 40
+    hidden: int = 256
+
+    def init(self):
+        conf = (self._builder()
+                .updater(self.updater or Adam(learning_rate=2e-3))
+                .weight_init("xavier")
+                .gradient_normalization("clipelementwiseabsolutevalue", 10.0)
+                .list()
+                .layer(LSTM(n_out=self.hidden, activation="tanh"))
+                .layer(LSTM(n_out=self.hidden, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=self.num_classes,
+                                      activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.num_classes,
+                                                    self.timesteps))
+                .build())
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+
+ALL_MODELS = [LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, GoogLeNet,
+              InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM]

@@ -246,18 +246,15 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit_batch(self, batch) -> float:
-        """One train step on one batch WITHOUT epoch bookkeeping (used by
-        EarlyStoppingTrainer, which owns the epoch loop)."""
-        if self.params == {}:
-            self.init()
-        xs, ys, ms, lms = self._normalize_batch(batch)
+    def _fit_one(self, xs, ys, ms, lms) -> float:
+        """One train step (shared by fit's inner loop and fit_batch)."""
         xs = [jnp.asarray(x) for x in xs]
         ys = [jnp.asarray(y) for y in ys]
         ms = None if ms is None else [
-            None if m is None else jnp.asarray(m) for m in ms]
+            None if m is None else jnp.asarray(m) for m in _as_list(ms)]
         lms = None if lms is None else [
-            None if m is None else jnp.asarray(m) for m in lms]
+            None if m is None else jnp.asarray(m) for m in _as_list(lms)]
+        self.last_batch_size = int(xs[0].shape[0])
         step_fn = self._get_jitted("train_step")
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss = step_fn(
@@ -267,6 +264,13 @@ class ComputationGraph:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self._score
+
+    def fit_batch(self, batch) -> float:
+        """One train step on one batch WITHOUT epoch bookkeeping (used by
+        EarlyStoppingTrainer, which owns the epoch loop)."""
+        if self.params == {}:
+            self.init()
+        return self._fit_one(*self._normalize_batch(batch))
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
             masks=None, label_masks=None) -> "ComputationGraph":
@@ -299,27 +303,11 @@ class ComputationGraph:
         else:
             raise ValueError("fit() needs (inputs, labels) or an iterator")
 
-        step_fn = self._get_jitted("train_step")
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
             for batch in batches_factory():
-                xs, ys, ms, lms = batch
-                xs = [jnp.asarray(x) for x in xs]
-                ys = [jnp.asarray(y) for y in ys]
-                ms = None if ms is None else [
-                    None if m is None else jnp.asarray(m) for m in _as_list(ms)]
-                lms = None if lms is None else [
-                    None if m is None else jnp.asarray(m) for m in _as_list(lms)]
-                self.last_batch_size = int(xs[0].shape[0])
-                self._rng, key = jax.random.split(self._rng)
-                self.params, self.state, self.opt_state, loss = step_fn(
-                    self.params, self.state, self.opt_state, key, xs, ys, ms,
-                    lms)
-                self._score = float(loss)
-                self.iteration += 1
-                for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration, self.epoch)
+                self._fit_one(*batch)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
             self.epoch += 1

@@ -108,8 +108,17 @@ class CenterLossOutputLayer(OutputLayer):
         c_sel = labels @ centers                     # one-hot row-select
         diff_f = x - jax.lax.stop_gradient(c_sel)    # pulls features to centers
         diff_c = jax.lax.stop_gradient(x) - c_sel    # pulls centers to features
-        l_feat = 0.5 * self.lambda_ * jnp.mean(jnp.sum(diff_f ** 2, axis=-1))
-        l_cent = 0.5 * self.alpha * jnp.mean(jnp.sum(diff_c ** 2, axis=-1))
+        per_f = jnp.sum(diff_f ** 2, axis=-1)
+        per_c = jnp.sum(diff_c ** 2, axis=-1)
+        if mask is not None:
+            w = mask.reshape(mask.shape[0], -1)[:, 0]  # per-example weight
+            denom = jnp.maximum(jnp.sum(w), 1.0)
+            mean_f = jnp.sum(w * per_f) / denom
+            mean_c = jnp.sum(w * per_c) / denom
+        else:
+            mean_f, mean_c = jnp.mean(per_f), jnp.mean(per_c)
+        l_feat = 0.5 * self.lambda_ * mean_f
+        l_cent = 0.5 * self.alpha * mean_c
         # value-neutral center update: contributes gradient (to centers only)
         # but zero to the reported score — matching the reference, where the
         # α-rate center update happens outside the loss

@@ -48,6 +48,7 @@ class AutoEncoder(BaseLayerConf):
     visible_loss: str = "mse"      # "mse" | "xent"
 
     PRETRAINABLE = True
+    INPUT_KIND = "ff"              # auto-insert CNN→FF preprocessor
 
     def set_n_in(self, itype, override=False):
         if self.n_in == 0 or override:
@@ -106,6 +107,7 @@ class RBM(BaseLayerConf):
     visible_unit: str = "binary"   # "binary" | "gaussian"
 
     PRETRAINABLE = True
+    INPUT_KIND = "ff"
 
     def set_n_in(self, itype, override=False):
         if self.n_in == 0 or override:
@@ -150,6 +152,12 @@ class RBM(BaseLayerConf):
         return v2
 
     def pretrain_loss(self, variables, x, *, key=None, train=True):
+        if self.hidden_unit != "binary":
+            raise ValueError(
+                "RBM CD-k pretraining implements binary hidden units only; "
+                "the free-energy objective below would not match "
+                f"hidden_unit='{self.hidden_unit}' (rectified units are "
+                "supported for forward feature extraction)")
         p = variables["params"]
         if key is None:
             key = jax.random.PRNGKey(0)
@@ -178,6 +186,7 @@ class VariationalAutoencoder(BaseLayerConf):
     num_samples: int = 1
 
     PRETRAINABLE = True
+    INPUT_KIND = "ff"
 
     def set_n_in(self, itype, override=False):
         if self.n_in == 0 or override:

@@ -304,16 +304,7 @@ class MultiLayerNetwork:
                         x.shape[1] > self.conf.tbptt_fwd_length:
                     self._fit_tbptt(step_fn, x, y, m, lm)
                     continue
-                self._rng, key = jax.random.split(self._rng)
-                self.params, self.state, self.opt_state, loss = step_fn(
-                    self.params, self.state, self.opt_state, key,
-                    jnp.asarray(x), jnp.asarray(y),
-                    None if m is None else jnp.asarray(m),
-                    None if lm is None else jnp.asarray(lm))
-                self._score = float(loss)
-                self.iteration += 1
-                for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration, self.epoch)
+                self._fit_one(x, y, m, lm)
             for lst in self.listeners:
                 lst.on_epoch_end(self)
             self.epoch += 1
@@ -407,7 +398,9 @@ class MultiLayerNetwork:
         p_i = self.params[lname]
         if epochs > 1 and not hasattr(data, "shape") and \
                 not isinstance(data, (tuple, list)) and \
-                not hasattr(data, "reset") and iter(data) is data:
+                not hasattr(data, "features") and \
+                not hasattr(data, "reset") and \
+                hasattr(data, "__iter__") and iter(data) is data:
             data = list(data)  # bare generator: materialize for re-iteration
         for _ in range(epochs):
             for batch in self._pretrain_batches(data):
@@ -425,7 +418,7 @@ class MultiLayerNetwork:
         if hasattr(data, "shape"):                      # bare feature array
             yield data
             return
-        if isinstance(data, tuple) and len(data) in (2, 4):
+        if isinstance(data, (tuple, list)) and len(data) in (2, 4):
             yield self._normalize_batch(data)[0]        # (x, y): features only
             return
         if hasattr(data, "features"):                   # single DataSet
@@ -436,12 +429,8 @@ class MultiLayerNetwork:
         for b in data:
             yield b if hasattr(b, "shape") else self._normalize_batch(b)[0]
 
-    def fit_batch(self, batch) -> float:
-        """One train step on one batch WITHOUT epoch bookkeeping (used by
-        EarlyStoppingTrainer, which owns the epoch loop)."""
-        if self.params == {}:
-            self.init()
-        x, y, m, lm = self._normalize_batch(batch)
+    def _fit_one(self, x, y, m, lm) -> float:
+        """One train step (shared by fit's inner loop and fit_batch)."""
         step_fn = self._get_jitted("train_step")
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss = step_fn(
@@ -454,6 +443,13 @@ class MultiLayerNetwork:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
         return self._score
+
+    def fit_batch(self, batch) -> float:
+        """One train step on one batch WITHOUT epoch bookkeeping (used by
+        EarlyStoppingTrainer, which owns the epoch loop)."""
+        if self.params == {}:
+            self.init()
+        return self._fit_one(*self._normalize_batch(batch))
 
     # ------------------------------------------------------ stateful RNN API
     def rnn_time_step(self, x) -> Array:

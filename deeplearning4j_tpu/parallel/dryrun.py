@@ -17,14 +17,27 @@ def run(n_devices: int) -> None:
             f"need {n_devices} devices, have {len(jax.devices())} "
             "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
 
-    from ..models import available_bench_model
+    from ..nn.conf.input_type import InputType
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
     from .mesh import make_mesh
     from .wrapper import ParallelWrapper, megatron_dense_rule
 
     tp = 2 if n_devices % 2 == 0 else 1
     mesh = make_mesh(n_devices, tp=tp)
 
-    model, _ = available_bench_model()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).activation("relu").weight_init("xavier")
+            .updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(DenseLayer(n_out=64))
+            .layer(DenseLayer(n_out=64))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    model = MultiLayerNetwork(conf).init()
     rng = np.random.default_rng(0)
     dp = n_devices // tp
     batch = dp * 8  # divisible by the data axis (sharding requires it)

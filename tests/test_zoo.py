@@ -1,0 +1,99 @@
+"""Model zoo smoke tests (reference zoo tests: instantiate + one
+fit/predict pass on miniature shapes — CPU-friendly).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (AlexNet, FaceNetNN4Small2, GoogLeNet,
+                                       InceptionResNetV1, LeNet, ResNet50,
+                                       SimpleCNN, TextGenerationLSTM, VGG16,
+                                       VGG19)
+
+
+def _img_batch(n, h, w, c, classes, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, h, w, c)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def test_lenet_train_step():
+    net = LeNet(num_classes=10, input_shape=(28, 28, 1)).init()
+    x, y = _img_batch(4, 28, 28, 1, 10)
+    s0 = net.score(x=x, y=y)
+    net.fit(x, y, epochs=3)
+    assert net.score(x=x, y=y) < s0
+    assert net.output(x).shape == (4, 10)
+
+
+def test_resnet50_small_train_step():
+    net = ResNet50(num_classes=5, input_shape=(32, 32, 3)).init()
+    x, y = _img_batch(2, 32, 32, 3, 5)
+    s0 = net.score(inputs=x, labels=y)
+    net.fit(x, y, epochs=2)
+    assert np.isfinite(net.get_score())
+    assert net.output(x).shape == (2, 5)
+    # bottleneck residual topology: 16 add vertices (3+4+6+3)
+    adds = [n for n in net.conf.vertices if n.endswith("_add")]
+    assert len(adds) == 16
+
+
+def test_simplecnn_forward():
+    net = SimpleCNN(num_classes=4, input_shape=(16, 16, 3)).init()
+    x, y = _img_batch(2, 16, 16, 3, 4)
+    assert net.output(x).shape == (2, 4)
+
+
+def test_alexnet_forward():
+    net = AlexNet(num_classes=7, input_shape=(64, 64, 3)).init()
+    x, _ = _img_batch(2, 64, 64, 3, 7)
+    assert net.output(x).shape == (2, 7)
+
+
+@pytest.mark.parametrize("cls,blocks", [(VGG16, 13), (VGG19, 16)])
+def test_vgg_forward(cls, blocks):
+    net = cls(num_classes=3, input_shape=(32, 32, 3)).init()
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    convs = [l for l in net.conf.layers if isinstance(l, ConvolutionLayer)]
+    assert len(convs) == blocks
+    x, _ = _img_batch(2, 32, 32, 3, 3)
+    assert net.output(x).shape == (2, 3)
+
+
+def test_googlenet_forward():
+    net = GoogLeNet(num_classes=6, input_shape=(32, 32, 3)).init()
+    x, _ = _img_batch(2, 32, 32, 3, 6)
+    assert net.output(x).shape == (2, 6)
+    # 9 inception modules
+    assert sum(1 for n in net.conf.vertices if n.startswith("i")
+               and "_" not in n) == 9
+
+
+def test_inception_resnet_v1_forward():
+    net = InceptionResNetV1(num_classes=5, input_shape=(64, 64, 3),
+                            blocks_a=1, blocks_b=1, blocks_c=1).init()
+    x, _ = _img_batch(2, 64, 64, 3, 5)
+    assert net.output(x).shape == (2, 5)
+
+
+def test_facenet_embeddings_normalized():
+    net = FaceNetNN4Small2(num_classes=5, input_shape=(32, 32, 3),
+                           embedding_size=16).init()
+    x, y = _img_batch(2, 32, 32, 3, 5)
+    acts = net.feed_forward(x)
+    emb = np.asarray(acts["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-4)
+    net.fit(x, y)  # center-loss head trains
+    assert np.isfinite(net.get_score())
+
+
+def test_text_generation_lstm():
+    net = TextGenerationLSTM(num_classes=12, timesteps=8, hidden=16).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 12, (4, 8))
+    x = np.eye(12, dtype=np.float32)[ids]
+    y = np.eye(12, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+    s0 = net.score(x=x, y=y)
+    net.fit(x, y, epochs=10)
+    assert net.score(x=x, y=y) < s0
+    assert net.output(x).shape == (4, 8, 12)
