@@ -16,7 +16,7 @@ environment has no egress.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
 from ..nn.computation_graph import ComputationGraph
@@ -24,9 +24,8 @@ from ..nn.conf.computation_graph import (ElementWiseVertex, GraphBuilder,
                                          L2NormalizeVertex, MergeVertex)
 from ..nn.conf.input_type import InputType
 from ..nn.conf.multi_layer import NeuralNetConfiguration
-from ..nn.conf.updaters import Adam, Nesterovs, Sgd, UpdaterConf
-from ..nn.layers.convolution import (ConvolutionLayer, SubsamplingLayer,
-                                     ZeroPaddingLayer)
+from ..nn.conf.updaters import Adam, Nesterovs, UpdaterConf
+from ..nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
 from ..nn.layers.feedforward import (ActivationLayer, DenseLayer,
                                      DropoutLayer, OutputLayer)
 from ..nn.layers.normalization import (BatchNormalization,

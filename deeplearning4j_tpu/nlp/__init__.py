@@ -1,0 +1,49 @@
+"""NLP: embeddings (Word2Vec/ParagraphVectors/GloVe), vocab, text pipeline.
+
+TPU-native re-design of reference ``deeplearning4j-nlp-parent`` (§2.5 of
+SURVEY.md): the SequenceVectors engine's native AggregateSkipGram/CBOW hot
+loop becomes jitted scatter-add batches; tokenization and vocab stay on the
+host.
+"""
+from .glove import Glove
+from .lookup_table import InMemoryLookupTable
+from .paragraph_vectors import ParagraphVectors
+from .sentence_iterator import (AggregatingSentenceIterator, BasicLineIterator,
+                                CollectionSentenceIterator,
+                                FileLabelAwareIterator, FileSentenceIterator,
+                                LabelAwareIterator, LabelledDocument,
+                                LabelsSource, LineSentenceIterator,
+                                MultipleEpochsSentenceIterator,
+                                SentenceIterator, SentenceIteratorConverter,
+                                SimpleLabelAwareIterator)
+from .sequence_vectors import SequenceVectors
+from .serializer import (read_binary, read_full_model, read_word_vectors,
+                         write_binary, write_full_model, write_word_vectors)
+from .tokenization import (CommonPreprocessor, DefaultTokenizer,
+                           DefaultTokenizerFactory, EndingPreProcessor,
+                           LowCasePreProcessor, NGramTokenizer,
+                           NGramTokenizerFactory, TokenPreProcess, Tokenizer,
+                           TokenizerFactory)
+from .vectorizer import BagOfWordsVectorizer, TfidfVectorizer
+from .vocab import (VocabCache, VocabConstructor, VocabWord, build_huffman,
+                    make_unigram_table, subsample_keep_prob)
+from .word2vec import Word2Vec
+from .word_vectors import WordVectors
+
+__all__ = [
+    "Glove", "InMemoryLookupTable", "ParagraphVectors", "SequenceVectors",
+    "Word2Vec", "WordVectors", "VocabCache", "VocabConstructor", "VocabWord",
+    "build_huffman", "make_unigram_table", "subsample_keep_prob",
+    "BagOfWordsVectorizer", "TfidfVectorizer",
+    "read_binary", "read_full_model", "read_word_vectors", "write_binary",
+    "write_full_model", "write_word_vectors",
+    "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
+    "EndingPreProcessor", "LowCasePreProcessor", "NGramTokenizer",
+    "NGramTokenizerFactory", "TokenPreProcess", "Tokenizer",
+    "TokenizerFactory",
+    "AggregatingSentenceIterator", "BasicLineIterator",
+    "CollectionSentenceIterator", "FileLabelAwareIterator",
+    "FileSentenceIterator", "LabelAwareIterator", "LabelledDocument",
+    "LabelsSource", "LineSentenceIterator", "MultipleEpochsSentenceIterator",
+    "SentenceIterator", "SentenceIteratorConverter", "SimpleLabelAwareIterator",
+]

@@ -1,0 +1,133 @@
+"""Batched embedding-update kernels: SkipGram, CBOW, GloVe steps.
+
+Reference ``models/embeddings/learning/impl/elements/{SkipGram,CBOW,GloVe}.java``.
+The reference batches ~4096 ``AggregateSkipGram`` native ops per executioner
+call (``SkipGram.java:271-283``); the TPU equivalent is ONE jitted step over a
+padded index batch: gather rows, sigmoid dot-products on the VPU, scatter-add
+updates (XLA lowers ``.at[].add`` with duplicate indices to a sorted segment
+sum — deterministic, unlike the reference's racy hogwild threads).
+
+Shapes (static under jit): B pairs, C max code length (HS), K negatives.
+Padded slots carry mask 0 → zero gradient → harmless scatter of zeros.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _sigmoid(x):
+    # word2vec clips activations to ±MAX_EXP=6 via its exp table; jnp.clip
+    # keeps the same saturation behavior without the table.
+    return jax.nn.sigmoid(jnp.clip(x, -6.0, 6.0))
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def skipgram_step(syn0, syn1, syn1neg, ctx, points, codes, code_mask,
+                  neg, neg_label, neg_mask, alpha):
+    """One batch of skip-gram pair updates.
+
+    ctx:(B,) input-word rows of syn0 to update; points/codes/code_mask:(B,C)
+    HS targets from the *center* word's Huffman path; neg:(B,K+1) rows of
+    syn1neg (col 0 = center word, label 1; rest sampled negatives, label 0).
+    Mirrors ``AggregateSkipGram`` semantics (SkipGram.java:271-283).
+    """
+    v = syn0[ctx]                                            # (B, D)
+    neu1e = jnp.zeros_like(v)
+
+    # hierarchical softmax
+    p = syn1[points]                                         # (B, C, D)
+    f = _sigmoid(jnp.einsum("bd,bcd->bc", v, p))
+    g = (1.0 - codes - f) * alpha * code_mask                # (B, C)
+    neu1e = neu1e + jnp.einsum("bc,bcd->bd", g, p)
+    syn1 = syn1.at[points].add(g[..., None] * v[:, None, :])
+
+    # negative sampling
+    n = syn1neg[neg]                                         # (B, K+1, D)
+    fn = _sigmoid(jnp.einsum("bd,bkd->bk", v, n))
+    gn = (neg_label - fn) * alpha * neg_mask                 # (B, K+1)
+    neu1e = neu1e + jnp.einsum("bk,bkd->bd", gn, n)
+    syn1neg = syn1neg.at[neg].add(gn[..., None] * v[:, None, :])
+
+    syn0 = syn0.at[ctx].add(neu1e)
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def cbow_step(syn0, syn1, syn1neg, ctx, ctx_mask, points, codes, code_mask,
+              neg, neg_label, neg_mask, alpha):
+    """One batch of CBOW window updates (``CBOW.java`` / ``AggregateCBOW``).
+
+    ctx:(B,W) window-word rows (mask-padded); the averaged context vector is
+    trained against the center word's HS path / negative samples, and the
+    full error vector is added to every context row (word2vec convention —
+    not divided by window size).  ParagraphVectors-DM reuses this with the
+    document-label row occupying one window slot.
+    """
+    v_ctx = syn0[ctx]                                        # (B, W, D)
+    denom = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
+    v = (v_ctx * ctx_mask[..., None]).sum(1) / denom         # (B, D)
+    neu1e = jnp.zeros_like(v)
+
+    p = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bcd->bc", v, p))
+    g = (1.0 - codes - f) * alpha * code_mask
+    neu1e = neu1e + jnp.einsum("bc,bcd->bd", g, p)
+    syn1 = syn1.at[points].add(g[..., None] * v[:, None, :])
+
+    n = syn1neg[neg]
+    fn = _sigmoid(jnp.einsum("bd,bkd->bk", v, n))
+    gn = (neg_label - fn) * alpha * neg_mask
+    neu1e = neu1e + jnp.einsum("bk,bkd->bd", gn, n)
+    syn1neg = syn1neg.at[neg].add(gn[..., None] * v[:, None, :])
+
+    syn0 = syn0.at[ctx].add(neu1e[:, None, :] * ctx_mask[..., None])
+    return syn0, syn1, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def infer_step(vec, syn1, syn1neg, points, codes, code_mask,
+               neg, neg_label, neg_mask, alpha):
+    """ParagraphVectors ``inferVector``: update ONLY the inference vector
+    against frozen output weights (reference ``SkipGram.iterateSample``
+    ``isInference`` branch, SkipGram.java:224)."""
+    B = points.shape[0]
+    v = jnp.broadcast_to(vec, (B, vec.shape[-1]))
+    p = syn1[points]
+    f = _sigmoid(jnp.einsum("bd,bcd->bc", v, p))
+    g = (1.0 - codes - f) * alpha * code_mask
+    neu1e = jnp.einsum("bc,bcd->bd", g, p)
+    n = syn1neg[neg]
+    fn = _sigmoid(jnp.einsum("bd,bkd->bk", v, n))
+    gn = (neg_label - fn) * alpha * neg_mask
+    neu1e = neu1e + jnp.einsum("bk,bkd->bd", gn, n)
+    return vec + neu1e.sum(0)
+
+
+@partial(jax.jit, donate_argnums=tuple(range(8)))
+def glove_step(w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, rows, cols, xij,
+               alpha, x_max, exponent):
+    """One AdaGrad batch on the GloVe weighted least-squares objective
+    (reference ``learning/impl/elements/GloVe.java`` iterateSample).
+
+    hw/hwc/hb/hbc are per-table AdaGrad accumulators (the reference keeps
+    nd4j ``AdaGrad`` state per lookup table); rows/cols index the main /
+    context tables, xij the cooccurrence counts.
+    """
+    wi, wj = w[rows], w_ctx[cols]                            # (B, D)
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + b_ctx[cols] - jnp.log(xij)
+    fdiff = jnp.where(xij > x_max, diff, (xij / x_max) ** exponent * diff)
+    gi = fdiff[:, None] * wj                                 # (B, D)
+    gj = fdiff[:, None] * wi
+    hw = hw.at[rows].add(gi * gi)
+    hwc = hwc.at[cols].add(gj * gj)
+    hb = hb.at[rows].add(fdiff * fdiff)
+    hbc = hbc.at[cols].add(fdiff * fdiff)
+    w = w.at[rows].add(-alpha * gi / jnp.sqrt(hw[rows] + 1e-8))
+    w_ctx = w_ctx.at[cols].add(-alpha * gj / jnp.sqrt(hwc[cols] + 1e-8))
+    b = b.at[rows].add(-alpha * fdiff / jnp.sqrt(hb[rows] + 1e-8))
+    b_ctx = b_ctx.at[cols].add(-alpha * fdiff / jnp.sqrt(hbc[cols] + 1e-8))
+    loss = 0.5 * jnp.sum(fdiff * diff)
+    return w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, loss

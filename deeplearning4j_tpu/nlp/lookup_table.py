@@ -1,0 +1,64 @@
+"""In-memory embedding lookup table.
+
+Reference ``models/embeddings/inmemory/InMemoryLookupTable.java:56``: holds
+``syn0`` (word vectors), ``syn1`` (hierarchical-softmax internal-node
+weights), ``syn1neg`` (negative-sampling output weights), the exp table and
+unigram table.  TPU version: jnp arrays resident in HBM; the exp table is
+unnecessary (XLA computes sigmoid on the VPU), the unigram table stays a
+host-side numpy array feeding the batcher.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import VocabCache, make_unigram_table
+
+
+class InMemoryLookupTable:
+    def __init__(self, vocab: VocabCache, vector_length: int,
+                 seed: int = 123, use_hs: bool = True, negative: float = 0.0,
+                 dtype=jnp.float32):
+        self.vocab = vocab
+        self.vector_length = vector_length
+        self.seed = seed
+        self.use_hs = use_hs
+        self.negative = negative
+        self.dtype = dtype
+        self.syn0: Optional[jnp.ndarray] = None
+        self.syn1: Optional[jnp.ndarray] = None
+        self.syn1neg: Optional[jnp.ndarray] = None
+        self.table: Optional[np.ndarray] = None
+
+    def reset_weights(self) -> None:
+        """syn0 ~ U(-0.5, 0.5)/dim, syn1* zero — the word2vec init
+        (reference ``InMemoryLookupTable.resetWeights``)."""
+        n, d = self.vocab.num_words(), self.vector_length
+        key = jax.random.PRNGKey(self.seed)
+        self.syn0 = ((jax.random.uniform(key, (n, d), dtype=jnp.float32) - 0.5)
+                     / d).astype(self.dtype)
+        if self.use_hs:
+            self.syn1 = jnp.zeros((n, d), dtype=self.dtype)
+        if self.negative > 0:
+            self.init_negative()
+
+    def init_negative(self) -> None:
+        n, d = self.vocab.num_words(), self.vector_length
+        self.syn1neg = jnp.zeros((n, d), dtype=self.dtype)
+        self.table = make_unigram_table(self.vocab)
+
+    # -- queries -------------------------------------------------------------
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def get_weights(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def set_weights(self, w) -> None:
+        self.syn0 = jnp.asarray(w, dtype=self.dtype)

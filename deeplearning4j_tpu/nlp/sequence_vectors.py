@@ -1,0 +1,251 @@
+"""SequenceVectors: the generic embedding-training engine.
+
+Reference ``models/sequencevectors/SequenceVectors.java:49`` — producer thread
+feeding ``VectorCalculationsThread`` workers that batch ~4096 native aggregate
+ops.  TPU redesign: the host loop turns token sequences into padded index
+batches (numpy) and a single jitted scatter-add step (elements.py) replaces
+the worker pool — device parallelism comes from the batch dimension, not
+threads, and updates are deterministic rather than hogwild.
+
+Learning algorithms are selected by name, mirroring the reference's pluggable
+``ElementsLearningAlgorithm`` (skipgram/cbow) and ``SequenceLearningAlgorithm``
+(dbow/dm) split.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .elements import cbow_step, infer_step, skipgram_step
+from .lookup_table import InMemoryLookupTable
+from .vocab import VocabCache, VocabConstructor, subsample_keep_prob
+from .word_vectors import WordVectors
+
+
+class _PairBatcher:
+    """Accumulates (ctx, center) training pairs into fixed-shape batches."""
+
+    def __init__(self, batch_size: int, code_len: int, negative: int,
+                 use_hs: bool):
+        self.B, self.C, self.K = batch_size, code_len, negative
+        self.use_hs = use_hs
+        self.ctx: List[int] = []
+        self.center: List[int] = []
+
+    def add(self, ctx: int, center: int) -> bool:
+        self.ctx.append(ctx)
+        self.center.append(center)
+        return len(self.ctx) >= self.B
+
+    def drain(self, vocab_words, table, rng, force=False):
+        if not self.ctx or (len(self.ctx) < self.B and not force):
+            return None
+        n = min(len(self.ctx), self.B)
+        ctx = np.zeros(self.B, dtype=np.int32)
+        ctx[:n] = self.ctx[:self.B]
+        center = np.zeros(self.B, dtype=np.int32)
+        center[:n] = self.center[:self.B]
+        batch = _label_arrays(center, n, self.B, self.C, self.K,
+                              vocab_words, table, rng, use_hs=self.use_hs)
+        self.ctx, self.center = self.ctx[self.B:], self.center[self.B:]
+        return (ctx,) + batch
+
+
+def _label_arrays(center, n, B, C, K, vocab_words, table, rng, use_hs=True):
+    """HS codes/points + negative samples for each batch row's center word.
+
+    Masks gate the two objectives independently, matching the reference's
+    ``isUseHierarchicSoftmax`` / ``negative > 0`` branches
+    (SkipGram.java:236-257): HS disabled → code_mask stays zero; negative
+    sampling disabled → neg_mask stays zero (including the positive column).
+    """
+    points = np.zeros((B, C), dtype=np.int32)
+    codes = np.zeros((B, C), dtype=np.float32)
+    code_mask = np.zeros((B, C), dtype=np.float32)
+    for r in range(n if use_hs else 0):
+        vw = vocab_words[center[r]]
+        L = min(len(vw.codes), C)
+        if L:
+            points[r, :L] = vw.points[:L]
+            codes[r, :L] = vw.codes[:L]
+            code_mask[r, :L] = 1.0
+    neg = np.zeros((B, K + 1), dtype=np.int32)
+    neg_label = np.zeros((B, K + 1), dtype=np.float32)
+    neg_mask = np.zeros((B, K + 1), dtype=np.float32)
+    neg[:, 0] = center
+    neg_label[:, 0] = 1.0
+    if K > 0 and table is not None and len(table):
+        neg_mask[:n, 0] = 1.0
+        samples = table[rng.integers(0, len(table), size=(B, K))]
+        neg[:, 1:] = samples
+        # resample-avoidance: the C code redraws when the sample hits the
+        # target; masking is equivalent under expectation
+        neg_mask[:n, 1:] = (samples[:n] != center[:n, None]).astype(np.float32)
+    return center, points, codes, code_mask, neg, neg_label, neg_mask
+
+
+class SequenceVectors(WordVectors):
+    """Trainer for element embeddings over token sequences."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
+                 negative: int = 5, use_hierarchic_softmax: bool = False,
+                 sampling: float = 0.0, min_word_frequency: int = 1,
+                 epochs: int = 1, batch_size: int = 512, seed: int = 123,
+                 elements_algorithm: str = "skipgram",
+                 max_code_length: int = 40):
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax or negative == 0
+        self.sampling = sampling
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.elements_algorithm = elements_algorithm
+        self.max_code_length = max_code_length
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+
+    # -- corpus hooks (overridden by Word2Vec / ParagraphVectors) ------------
+    def _sequences(self) -> Iterable[Sequence[str]]:
+        raise NotImplementedError
+
+    def _sequence_labels(self, seq_index: int) -> Sequence[str]:
+        return ()
+
+    # -- vocab + weights -----------------------------------------------------
+    def build_vocab(self, extra_labels: Sequence[str] = ()) -> None:
+        ctor = VocabConstructor(self.min_word_frequency)
+        self.vocab = ctor.build(self._sequences(), special_labels=extra_labels)
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.layer_size, seed=self.seed,
+            use_hs=self.use_hs, negative=self.negative)
+        self.lookup_table.reset_weights()
+
+    # -- training ------------------------------------------------------------
+    def fit(self) -> None:
+        if self.vocab is None:
+            self.build_vocab()
+        lt = self.lookup_table
+        rng = np.random.default_rng(self.seed)
+        vocab_words = self.vocab.vocab_words()
+        keep = subsample_keep_prob(self.vocab, self.sampling)
+        code_len = max((vw.code_length for vw in vocab_words), default=1)
+        code_len = min(max(code_len, 1), self.max_code_length)
+        total = max(self.vocab.total_word_count * self.epochs, 1)
+        seen = 0
+        syn0, syn1, syn1neg = lt.syn0, lt.syn1, lt.syn1neg
+        if syn1 is None:
+            syn1 = jnp.zeros_like(syn0)
+        if syn1neg is None:
+            syn1neg = jnp.zeros_like(syn0)
+        batcher = _PairBatcher(self.batch_size, code_len, self.negative,
+                               self.use_hs)
+        step = skipgram_step if self.elements_algorithm == "skipgram" else None
+
+        def flush(force=False):
+            nonlocal syn0, syn1, syn1neg
+            while True:
+                alpha = max(self.min_learning_rate,
+                            self.learning_rate * (1.0 - seen / total))
+                if step is not None:
+                    b = batcher.drain(vocab_words, lt.table, rng, force=force)
+                    if b is None:
+                        return
+                    ctx, _center, pts, cds, cm, neg, nl, nm = b
+                    syn0, syn1, syn1neg = step(
+                        syn0, syn1, syn1neg, jnp.asarray(ctx),
+                        jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(cm),
+                        jnp.asarray(neg), jnp.asarray(nl), jnp.asarray(nm),
+                        jnp.float32(alpha))
+                else:
+                    b = self._drain_cbow(vocab_words, lt.table, rng, force)
+                    if b is None:
+                        return
+                    ctxw, cmask, _center, pts, cds, cm, neg, nl, nm = b
+                    syn0, syn1, syn1neg = cbow_step(
+                        syn0, syn1, syn1neg, jnp.asarray(ctxw),
+                        jnp.asarray(cmask), jnp.asarray(pts), jnp.asarray(cds),
+                        jnp.asarray(cm), jnp.asarray(neg), jnp.asarray(nl),
+                        jnp.asarray(nm), jnp.float32(alpha))
+                if force and self._pending_empty(batcher):
+                    return
+
+        self._cbow_buf: List = []
+        for _epoch in range(self.epochs):
+            for seq_idx, seq in enumerate(self._sequences()):
+                idxs = [self.vocab.index_of(t) for t in seq]
+                idxs = np.array([i for i in idxs if i >= 0], dtype=np.int64)
+                if idxs.size == 0:
+                    continue
+                seen += int(idxs.size)
+                if self.sampling > 0:
+                    idxs = idxs[rng.random(idxs.size) < keep[idxs]]
+                if idxs.size < 1:
+                    continue
+                label_idxs = [self.vocab.index_of(l)
+                              for l in self._sequence_labels(seq_idx)]
+                label_idxs = [l for l in label_idxs if l >= 0]
+                self._emit_sequence(idxs, label_idxs, batcher, rng)
+                flush()
+        flush(force=True)
+        lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
+
+    def _pending_empty(self, batcher) -> bool:
+        if self.elements_algorithm == "skipgram":
+            return not batcher.ctx
+        return not self._cbow_buf
+
+    def _emit_sequence(self, idxs: np.ndarray, label_idxs: List[int],
+                       batcher: _PairBatcher, rng) -> None:
+        """Window-pair generation: skip-gram emits (context-row, center-label)
+        pairs with a reduced window b ~ U[0, window) exactly like the C
+        original (``SkipGram.skipGram``, SkipGram.java:200-221)."""
+        W = self.window
+        if self.elements_algorithm == "skipgram":
+            for i in range(len(idxs)):
+                b = int(rng.integers(0, W))
+                for j in range(i - W + b, i + W - b + 1):
+                    if j == i or j < 0 or j >= len(idxs):
+                        continue
+                    batcher.add(int(idxs[j]), int(idxs[i]))
+                for l in label_idxs:  # DBOW: label row learns to predict words
+                    batcher.add(l, int(idxs[i]))
+        else:  # cbow / dm
+            for i in range(len(idxs)):
+                b = int(rng.integers(0, W))
+                ctx = [int(idxs[j]) for j in range(i - W + b, i + W - b + 1)
+                       if j != i and 0 <= j < len(idxs)]
+                ctx += label_idxs  # DM: label participates in the average
+                if ctx:
+                    self._cbow_buf.append((ctx, int(idxs[i])))
+
+    def _drain_cbow(self, vocab_words, table, rng, force):
+        B = self.batch_size
+        if not self._cbow_buf or (len(self._cbow_buf) < B and not force):
+            return None
+        take = self._cbow_buf[:B]
+        self._cbow_buf = self._cbow_buf[B:]
+        n = len(take)
+        # fixed window width keeps the jitted step's shapes static across
+        # batches (one XLA compilation); overly long contexts are clipped
+        Wmax = 2 * self.window + 4
+        ctxw = np.zeros((B, Wmax), dtype=np.int32)
+        cmask = np.zeros((B, Wmax), dtype=np.float32)
+        center = np.zeros(B, dtype=np.int32)
+        for r, (c, t) in enumerate(take):
+            c = c[:Wmax]
+            ctxw[r, :len(c)] = c
+            cmask[r, :len(c)] = 1.0
+            center[r] = t
+        code_len = max((vw.code_length for vw in vocab_words), default=1)
+        code_len = min(max(code_len, 1), self.max_code_length)
+        rest = _label_arrays(center, n, B, code_len, self.negative,
+                             vocab_words, table, rng, use_hs=self.use_hs)
+        return (ctxw, cmask) + rest

@@ -1,0 +1,150 @@
+"""WordVectorSerializer: persistence formats for embedding models.
+
+Reference ``models/embeddings/loader/WordVectorSerializer.java`` (txt, the
+original word2vec C binary format, and a zip "full model" with vocab +
+weights + config).  Formats kept wire-compatible with the ecosystem:
+
+- ``write_word_vectors`` / ``read_word_vectors``: the gensim/word2vec .txt
+  format — header line ``<vocab> <dim>``, then ``word v1 v2 ...`` rows.
+- ``write_binary`` / ``read_binary``: word2vec C ``.bin`` (little-endian f32).
+- ``write_full_model`` / ``read_full_model``: zip of config.json +
+  vocab.json + syn0/syn1/syn1neg .npy — lossless round-trip incl. Huffman
+  codes and counts, so training can resume.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .sequence_vectors import SequenceVectors
+from .vocab import VocabCache, VocabWord
+from .word2vec import Word2Vec
+
+
+def write_word_vectors(model, path: str) -> None:
+    syn0 = np.asarray(model.lookup_table.syn0)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n")
+        for i in range(syn0.shape[0]):
+            vec = " ".join(f"{x:.6f}" for x in syn0[i])
+            f.write(f"{model.vocab.word_at_index(i)} {vec}\n")
+
+
+def read_word_vectors(path: str) -> Word2Vec:
+    with open(path, encoding="utf-8") as f:
+        header = f.readline().split()
+        n, d = int(header[0]), int(header[1])
+        vocab = VocabCache()
+        rows = np.zeros((n, d), dtype=np.float32)
+        for i in range(n):
+            parts = f.readline().rstrip("\n").split(" ")
+            vocab.add_token(VocabWord(parts[0]))
+            rows[i] = [float(x) for x in parts[1:d + 1]]
+    return _assemble(vocab, rows)
+
+
+def write_binary(model, path: str) -> None:
+    syn0 = np.asarray(model.lookup_table.syn0, dtype=np.float32)
+    with open(path, "wb") as f:
+        f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n".encode())
+        for i in range(syn0.shape[0]):
+            f.write(model.vocab.word_at_index(i).encode() + b" ")
+            f.write(syn0[i].tobytes())
+            f.write(b"\n")
+
+
+def read_binary(path: str) -> Word2Vec:
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        n, d = int(header[0]), int(header[1])
+        vocab = VocabCache()
+        rows = np.zeros((n, d), dtype=np.float32)
+        for i in range(n):
+            word = bytearray()
+            while True:
+                ch = f.read(1)
+                if ch in (b" ", b""):
+                    break
+                word.extend(ch)
+            rows[i] = np.frombuffer(f.read(4 * d), dtype="<f4")
+            f.read(1)  # trailing newline
+            vocab.add_token(VocabWord(word.decode()))
+    return _assemble(vocab, rows)
+
+
+def _assemble(vocab: VocabCache, rows: np.ndarray) -> Word2Vec:
+    model = Word2Vec(sentences=[], layer_size=rows.shape[1])
+    model.vocab = vocab
+    model.lookup_table = InMemoryLookupTable(vocab, rows.shape[1])
+    model.lookup_table.syn0 = jnp.asarray(rows)
+    return model
+
+
+def write_full_model(model: SequenceVectors, path: str) -> None:
+    lt = model.lookup_table
+    config = {
+        "layer_size": model.layer_size, "window": model.window,
+        "learning_rate": model.learning_rate,
+        "min_learning_rate": model.min_learning_rate,
+        "negative": model.negative, "use_hs": model.use_hs,
+        "sampling": model.sampling,
+        "min_word_frequency": model.min_word_frequency,
+        "epochs": model.epochs, "batch_size": model.batch_size,
+        "seed": model.seed, "elements_algorithm": model.elements_algorithm,
+        "total_word_count": model.vocab.total_word_count,
+    }
+    vocab_rows = [{"word": vw.word, "count": vw.count, "codes": vw.codes,
+                   "points": vw.points, "is_label": vw.is_label}
+                  for vw in model.vocab.vocab_words()]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(config))
+        z.writestr("vocab.json", json.dumps(vocab_rows))
+        for name in ("syn0", "syn1", "syn1neg"):
+            arr = getattr(lt, name)
+            if arr is not None:
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(arr))
+                z.writestr(f"{name}.npy", buf.getvalue())
+
+
+def read_full_model(path: str) -> Word2Vec:
+    with zipfile.ZipFile(path) as z:
+        config = json.loads(z.read("config.json"))
+        vocab_rows = json.loads(z.read("vocab.json"))
+        arrays = {}
+        for name in ("syn0", "syn1", "syn1neg"):
+            try:
+                arrays[name] = np.load(io.BytesIO(z.read(f"{name}.npy")))
+            except KeyError:
+                arrays[name] = None
+    total = config.pop("total_word_count", 0)
+    use_hs = config.pop("use_hs")
+    config["use_hierarchic_softmax"] = use_hs
+    model = Word2Vec(sentences=[], **config)
+    vocab = VocabCache()
+    for row in vocab_rows:
+        vw = VocabWord(row["word"], count=row["count"],
+                       is_label=row.get("is_label", False))
+        vw.codes, vw.points = row["codes"], row["points"]
+        vocab.add_token(vw)
+    vocab.total_word_count = total
+    model.vocab = vocab
+    lt = InMemoryLookupTable(vocab, config["layer_size"],
+                             seed=config["seed"], use_hs=use_hs,
+                             negative=config["negative"])
+    lt.syn0 = jnp.asarray(arrays["syn0"])
+    if arrays["syn1"] is not None:
+        lt.syn1 = jnp.asarray(arrays["syn1"])
+    if arrays["syn1neg"] is not None:
+        lt.syn1neg = jnp.asarray(arrays["syn1neg"])
+        from .vocab import make_unigram_table
+        lt.table = make_unigram_table(vocab)
+    model.lookup_table = lt
+    return model

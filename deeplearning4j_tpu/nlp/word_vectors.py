@@ -1,0 +1,75 @@
+"""WordVectors query API: similarity, nearest words, analogy arithmetic.
+
+Reference ``models/embeddings/wordvectors/WordVectors.java`` /
+``WordVectorsImpl.java`` (similarity, wordsNearest, wordsNearestSum).
+Nearest-neighbour queries run as one normalized matmul on device — the MXU
+does the whole vocab scan in a single op.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class WordVectors:
+    """Mixin over (vocab, lookup_table) — both set by the owning model."""
+
+    vocab = None          # VocabCache
+    lookup_table = None   # InMemoryLookupTable
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains_word(word)
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        return self.lookup_table.vector(word)
+
+    def get_word_vector_matrix(self, word: str):
+        return self.get_word_vector(word)
+
+    def _normed(self) -> np.ndarray:
+        w = np.asarray(self.lookup_table.syn0, dtype=np.float64)
+        norm = np.linalg.norm(w, axis=1, keepdims=True)
+        return w / np.maximum(norm, 1e-12)
+
+    def similarity(self, a: str, b: str) -> float:
+        """Cosine similarity (``WordVectorsImpl.similarity``)."""
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        va = va / max(np.linalg.norm(va), 1e-12)
+        vb = vb / max(np.linalg.norm(vb), 1e-12)
+        return float(np.dot(va, vb))
+
+    def words_nearest(self, positive, negative: Sequence[str] = (),
+                      top_n: int = 10) -> List[str]:
+        """Nearest words to positive − negative (analogy support,
+        ``WordVectorsImpl.wordsNearest``)."""
+        if isinstance(positive, str):
+            positive = [positive]
+        normed = self._normed()
+        query = np.zeros(normed.shape[1])
+        exclude = set()
+        for w in positive:
+            idx = self.vocab.index_of(w)
+            if idx >= 0:
+                query += normed[idx]
+                exclude.add(idx)
+        for w in negative:
+            idx = self.vocab.index_of(w)
+            if idx >= 0:
+                query -= normed[idx]
+                exclude.add(idx)
+        n = np.linalg.norm(query)
+        if n < 1e-12:
+            return []
+        sims = normed @ (query / n)
+        for idx in exclude:
+            sims[idx] = -np.inf
+        order = np.argsort(-sims)[:top_n]
+        return [self.vocab.word_at_index(int(i)) for i in order
+                if np.isfinite(sims[int(i)])]
+
+    def word_frequency(self, word: str) -> int:
+        return self.vocab.word_frequency(word)

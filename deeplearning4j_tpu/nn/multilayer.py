@@ -192,7 +192,10 @@ class MultiLayerNetwork:
         return acts
 
     def score(self, dataset=None, x=None, y=None) -> float:
-        """Loss on a dataset (reference ``score(DataSet)``)."""
+        """Loss on a dataset; with no arguments, the score of the most recent
+        training minibatch (reference ``score()`` / ``score(DataSet)``)."""
+        if dataset is None and x is None:
+            return self._score
         if dataset is not None:
             x, y, _, _ = self._normalize_batch(dataset)
         fn = self._get_jitted("score")
@@ -401,7 +404,10 @@ class MultiLayerNetwork:
                 not hasattr(data, "features") and \
                 not hasattr(data, "reset") and \
                 hasattr(data, "__iter__") and iter(data) is data:
-            data = list(data)  # bare generator: materialize for re-iteration
+            # bare generator: materialize for re-iteration.  A list is always
+            # a sequence of batches — only a TUPLE is a single (x, y) pair —
+            # so a 2-element generator doesn't collapse into a pair below.
+            data = list(data)
         for _ in range(epochs):
             for batch in self._pretrain_batches(data):
                 self._rng, key = jax.random.split(self._rng)
@@ -418,7 +424,7 @@ class MultiLayerNetwork:
         if hasattr(data, "shape"):                      # bare feature array
             yield data
             return
-        if isinstance(data, (tuple, list)) and len(data) in (2, 4):
+        if isinstance(data, tuple) and len(data) in (2, 4):
             yield self._normalize_batch(data)[0]        # (x, y): features only
             return
         if hasattr(data, "features"):                   # single DataSet

@@ -1,0 +1,258 @@
+"""NLP subsystem tests: tokenization, vocab/Huffman, Word2Vec/PV/GloVe
+training sanity, serialization round-trips, vectorizers.
+
+Mirrors reference test intents in
+``deeplearning4j-nlp/src/test/java/org/deeplearning4j/models/`` (Word2VecTests,
+ParagraphVectorsTest, GloveTest) and ``text/`` tokenizer tests, shrunk to
+synthetic corpora so CPU runs stay fast.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer, BasicLineIterator,
+                                    CollectionSentenceIterator,
+                                    CommonPreprocessor, DefaultTokenizerFactory,
+                                    Glove, LabelledDocument, NGramTokenizer,
+                                    ParagraphVectors, SimpleLabelAwareIterator,
+                                    TfidfVectorizer, VocabConstructor,
+                                    Word2Vec, build_huffman,
+                                    make_unigram_table, read_binary,
+                                    read_full_model, read_word_vectors,
+                                    write_binary, write_full_model,
+                                    write_word_vectors)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizer
+from deeplearning4j_tpu.nlp.vocab import VocabWord
+
+
+def synthetic_corpus(n=120, seed=7):
+    """Two topic clusters: animal words co-occur, tech words co-occur."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep"]
+    tech = ["cpu", "gpu", "tpu", "chip", "silicon"]
+    out = []
+    for _ in range(n):
+        pool = animals if rng.random() < 0.5 else tech
+        out.append(" ".join(rng.choice(pool, size=8)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tokenization
+# ---------------------------------------------------------------------------
+
+def test_default_tokenizer_and_preprocessor():
+    fac = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = fac.create("Hello, World! 123 foo-bar").get_tokens()
+    assert toks == ["hello", "world", "foo-bar"]
+
+
+def test_ngram_tokenizer():
+    base = DefaultTokenizer("a b c")
+    toks = NGramTokenizer(base, 1, 2).get_tokens()
+    assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+def test_sentence_iterators(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("first line\n\nsecond line\n")
+    assert list(BasicLineIterator(str(p))) == ["first line", "second line"]
+    it = CollectionSentenceIterator(["a", "b"], pre_processor=str.upper)
+    assert list(it) == ["A", "B"]
+    assert list(it) == ["A", "B"]  # restartable
+
+
+# ---------------------------------------------------------------------------
+# vocab / huffman / tables
+# ---------------------------------------------------------------------------
+
+def test_vocab_constructor_min_frequency():
+    seqs = [["a", "a", "b"], ["a", "c"]]
+    cache = VocabConstructor(min_word_frequency=2).build(seqs)
+    assert cache.contains_word("a") and not cache.contains_word("b")
+    assert cache.word_frequency("a") == 3
+    assert cache.index_of("a") == 0  # most frequent first
+
+
+def test_huffman_codes_prefix_free_and_frequency_ordered():
+    words = [VocabWord(w, count=c, index=i) for i, (w, c) in enumerate(
+        [("the", 100), ("of", 60), ("cat", 10), ("dog", 8), ("rare", 1)])]
+    build_huffman(words)
+    codes = ["".join(map(str, vw.codes)) for vw in words]
+    # prefix-free
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not b.startswith(a)
+    # frequent words get codes no longer than rare ones
+    assert len(words[0].codes) <= len(words[-1].codes)
+    # points index internal nodes (< V-1)
+    for vw in words:
+        assert all(0 <= p < len(words) - 1 for p in vw.points)
+        assert len(vw.points) == len(vw.codes)
+
+
+def test_unigram_table_proportions():
+    seqs = [["a"] * 80 + ["b"] * 20]
+    cache = VocabConstructor().build(seqs)
+    table = make_unigram_table(cache, table_size=10_000)
+    frac_a = (table == cache.index_of("a")).mean()
+    expected = 80 ** 0.75 / (80 ** 0.75 + 20 ** 0.75)
+    assert abs(frac_a - expected) < 0.02
+
+
+# ---------------------------------------------------------------------------
+# word2vec training
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("algo,hs", [("skipgram", False), ("cbow", False),
+                                     ("skipgram", True)])
+def test_word2vec_clusters_topics(algo, hs):
+    cbow = algo == "cbow"
+    w2v = Word2Vec(sentences=synthetic_corpus(), layer_size=24, window=3,
+                   negative=0 if hs else (6 if cbow else 4),
+                   use_hierarchic_softmax=hs,
+                   epochs=20 if cbow else 5, batch_size=256, seed=11,
+                   elements_algorithm=algo,
+                   learning_rate=0.025 if cbow else 0.05)
+    w2v.fit()
+    intra = w2v.similarity("cat", "dog")
+    inter = w2v.similarity("cat", "gpu")
+    assert intra > inter + 0.1, (algo, hs, intra, inter)
+    nearest = w2v.words_nearest("cpu", top_n=2)
+    assert set(nearest) <= {"gpu", "tpu", "chip", "silicon"}, nearest
+
+
+def test_word2vec_query_api():
+    w2v = Word2Vec(sentences=synthetic_corpus(40), layer_size=8, epochs=1,
+                   negative=2, seed=3)
+    w2v.fit()
+    assert w2v.has_word("cat") and not w2v.has_word("zebra")
+    assert w2v.get_word_vector("cat").shape == (8,)
+    assert np.isnan(w2v.similarity("cat", "zebra"))
+
+
+# ---------------------------------------------------------------------------
+# paragraph vectors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seq_algo", ["dbow", "dm"])
+def test_paragraph_vectors_label_separation(seq_algo):
+    rng = np.random.default_rng(5)
+    docs = []
+    for i in range(60):
+        pool = (["cat", "dog", "horse", "cow"] if i % 2 == 0
+                else ["cpu", "gpu", "tpu", "chip"])
+        docs.append(LabelledDocument(" ".join(rng.choice(pool, size=10)),
+                                     ["ANIMAL" if i % 2 == 0 else "TECH"]))
+    pv = ParagraphVectors(documents=docs, sequence_algorithm=seq_algo,
+                          layer_size=16, window=3, negative=3, epochs=3,
+                          batch_size=256, seed=9, learning_rate=0.05)
+    pv.fit()
+    assert set(pv.labels) == {"ANIMAL", "TECH"}
+    va = pv.get_label_vector("ANIMAL")
+    vt = pv.get_label_vector("TECH")
+    cat = pv.get_word_vector("cat")
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+    assert cos(cat, va) > cos(cat, vt)
+
+
+def test_paragraph_vectors_infer_vector():
+    docs = [LabelledDocument("cat dog cat dog cow", ["A"]),
+            LabelledDocument("cpu gpu tpu chip cpu", ["B"])] * 20
+    pv = ParagraphVectors(documents=docs, layer_size=12, negative=3,
+                          epochs=2, batch_size=128, seed=2)
+    pv.fit()
+    v = pv.infer_vector("cat dog cow")
+    assert v.shape == (12,) and np.isfinite(v).all()
+    # inferred animal text sits closer to A than B
+    assert (pv.similarity_to_label("cat dog cow cat dog", "A")
+            > pv.similarity_to_label("cat dog cow cat dog", "B"))
+
+
+# ---------------------------------------------------------------------------
+# glove
+# ---------------------------------------------------------------------------
+
+def test_glove_cooccurrence_counts():
+    g = Glove(sentences=["a b c"], window=2, symmetric=True)
+    g.vocab = VocabConstructor().build([["a", "b", "c"]])
+    cooc = g.count_cooccurrences()
+    ia, ib, ic = (g.vocab.index_of(x) for x in "abc")
+    assert cooc[(ib, ia)] == 1.0          # adjacent, distance 1
+    assert cooc[(ic, ia)] == 0.5          # distance 2 → weight 1/2
+    assert cooc[(ia, ib)] == cooc[(ib, ia)]  # symmetric
+
+
+def test_glove_trains_and_clusters():
+    g = Glove(sentences=synthetic_corpus(80), layer_size=16, window=3,
+              epochs=8, learning_rate=0.05, seed=13)
+    g.fit()
+    assert g.similarity("cat", "dog") > g.similarity("cat", "gpu")
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+def test_word_vector_txt_roundtrip(tmp_path):
+    w2v = Word2Vec(sentences=synthetic_corpus(30), layer_size=8, epochs=1,
+                   negative=2, seed=1)
+    w2v.fit()
+    p = str(tmp_path / "vecs.txt")
+    write_word_vectors(w2v, p)
+    loaded = read_word_vectors(p)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+
+
+def test_word_vector_binary_roundtrip(tmp_path):
+    w2v = Word2Vec(sentences=synthetic_corpus(30), layer_size=8, epochs=1,
+                   negative=2, seed=1)
+    w2v.fit()
+    p = str(tmp_path / "vecs.bin")
+    write_binary(w2v, p)
+    loaded = read_binary(p)
+    np.testing.assert_allclose(loaded.get_word_vector("dog"),
+                               w2v.get_word_vector("dog"), atol=1e-6)
+
+
+def test_full_model_roundtrip_resumes_training(tmp_path):
+    w2v = Word2Vec(sentences=synthetic_corpus(30), layer_size=8, epochs=1,
+                   negative=2, seed=1, use_hierarchic_softmax=True)
+    w2v.fit()
+    p = str(tmp_path / "model.zip")
+    write_full_model(w2v, p)
+    loaded = read_full_model(p)
+    np.testing.assert_allclose(np.asarray(loaded.lookup_table.syn0),
+                               np.asarray(w2v.lookup_table.syn0), atol=1e-6)
+    vw = loaded.vocab.word_for("cat")
+    assert vw.codes == w2v.vocab.word_for("cat").codes
+    # resume: training continues from the loaded state
+    loaded.sentence_iterator = CollectionSentenceIterator(synthetic_corpus(10))
+    loaded.fit()
+
+
+# ---------------------------------------------------------------------------
+# vectorizers
+# ---------------------------------------------------------------------------
+
+def test_bag_of_words():
+    docs = ["cat dog cat", "dog mouse"]
+    bow = BagOfWordsVectorizer().fit(docs)
+    m = bow.transform(docs)
+    assert m.shape == (2, 3)
+    assert m[0, bow.vocab.index_of("cat")] == 2.0
+    assert m[1, bow.vocab.index_of("cat")] == 0.0
+
+
+def test_tfidf_downweights_common_terms():
+    docs = ["cat dog", "cat mouse", "cat bird"]
+    tf = TfidfVectorizer().fit(docs)
+    m = tf.transform(docs)
+    assert m[0, tf.vocab.index_of("cat")] == pytest.approx(0.0)  # df=N → idf 0
+    assert m[0, tf.vocab.index_of("dog")] > 0
