@@ -1,0 +1,12 @@
+"""Graph embeddings: in-memory graph, walk iterators, DeepWalk.
+
+TPU-native re-design of reference ``deeplearning4j-graph`` (SURVEY.md §2.6).
+"""
+from .deepwalk import DeepWalk
+from .graph import (Edge, Graph, GraphWalkIterator, NoEdgeHandling,
+                    NoEdgesException, RandomWalkIterator, Vertex,
+                    WeightedRandomWalkIterator, load_edge_list)
+
+__all__ = ["DeepWalk", "Edge", "Graph", "GraphWalkIterator", "NoEdgeHandling",
+           "NoEdgesException", "RandomWalkIterator", "Vertex",
+           "WeightedRandomWalkIterator", "load_edge_list"]

@@ -1,0 +1,102 @@
+"""Graph subsystem tests: structure, walks, loaders, DeepWalk embeddings.
+
+Mirrors reference ``deeplearning4j-graph/src/test`` intents (TestGraph,
+RandomWalkIteratorTest, DeepWalkGradientCheck/TestDeepWalk) on small
+deterministic graphs.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (DeepWalk, Graph, NoEdgeHandling,
+                                      NoEdgesException, RandomWalkIterator,
+                                      WeightedRandomWalkIterator,
+                                      load_edge_list)
+
+
+def two_clique_graph():
+    """Two 5-cliques joined by a single bridge edge."""
+    g = Graph(10)
+    for base in (0, 5):
+        for i in range(base, base + 5):
+            for j in range(i + 1, base + 5):
+                g.add_edge(i, j)
+    g.add_edge(4, 5)
+    return g
+
+
+def test_graph_structure():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2, directed=True)
+    assert g.num_vertices() == 3
+    assert g.get_vertex_degree(0) == 1
+    assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+    assert g.get_connected_vertex_indices(2) == []  # directed edge 1->2
+
+
+def test_graph_no_multiple_edges():
+    g = Graph(2, allow_multiple_edges=False)
+    g.add_edge(0, 1)
+    g.add_edge(0, 1)
+    assert g.get_vertex_degree(0) == 1
+
+
+def test_random_walks_length_and_connectivity():
+    g = two_clique_graph()
+    it = RandomWalkIterator(g, walk_length=8, seed=1)
+    walks = list(it)
+    assert len(walks) == 10          # one walk per start vertex
+    for w in walks:
+        assert len(w) == 9           # start + walk_length steps
+        for a, b in zip(w, w[1:]):   # every hop follows an edge
+            assert b in g.get_connected_vertex_indices(a) or a == b
+
+
+def test_walk_disconnected_vertex_self_loop_and_exception():
+    g = Graph(2)  # no edges at all
+    walks = list(RandomWalkIterator(g, walk_length=3, seed=1))
+    assert all(len(set(w)) == 1 for w in walks)  # self-loops in place
+    it = RandomWalkIterator(
+        g, 3, no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+    with pytest.raises(NoEdgesException):
+        list(it)
+
+
+def test_weighted_walks_follow_heavy_edges():
+    g = Graph(3)
+    g.add_edge(0, 1, 1000.0)
+    g.add_edge(0, 2, 0.001)
+    it = WeightedRandomWalkIterator(g, walk_length=1, seed=7)
+    firsts = [w[1] for w in it if w[0] == 0]
+    assert firsts == [1]  # overwhelmingly follows the heavy edge
+
+
+def test_edge_list_loader(tmp_path):
+    p = tmp_path / "edges.csv"
+    p.write_text("# comment\n0,1\n1,2,3.5\n")
+    g = load_edge_list(str(p), weighted=True)
+    assert g.num_vertices() == 3
+    edges = g.get_edges_out(1)
+    assert {e.to for e in edges} == {0, 2}
+    assert any(e.weight == 3.5 for e in edges)
+
+
+def test_deepwalk_embeds_cliques_apart():
+    g = two_clique_graph()
+    dw = DeepWalk(vector_size=16, window_size=3, learning_rate=0.05,
+                  seed=3, batch_size=256, epochs=8)
+    dw.initialize(g)
+    assert dw.num_vertices() == 10
+    dw.fit(RandomWalkIterator(g, walk_length=20, seed=3))
+    intra = dw.similarity_vertices(0, 1)
+    inter = dw.similarity_vertices(0, 7)
+    assert intra > inter + 0.1, (intra, inter)
+    nearest = dw.vertices_nearest(2, top_n=3)
+    assert set(nearest) <= {0, 1, 3, 4, 5}, nearest
+
+
+def test_deepwalk_fit_graph_convenience():
+    g = two_clique_graph()
+    dw = DeepWalk(vector_size=8, epochs=2, seed=1)
+    dw.fit(g, walk_length=10)  # initialize + default iterator in one call
+    assert dw.get_vertex_vector(0).shape == (8,)
