@@ -1,0 +1,15 @@
+"""Data pipeline (reference ``deeplearning4j-core/.../datasets/`` +
+``deeplearning4j-nn/.../datasets/iterator/``)."""
+from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
+                      DataSetIterator, EarlyTerminationDataSetIterator,
+                      ExistingDataSetIterator, INDArrayDataSetIterator,
+                      MultipleEpochsIterator, SamplingDataSetIterator)
+from .mnist import IrisDataSetIterator, MnistDataSetIterator
+
+__all__ = [
+    "AsyncDataSetIterator", "BenchmarkDataSetIterator", "DataSet",
+    "DataSetIterator", "EarlyTerminationDataSetIterator",
+    "ExistingDataSetIterator", "INDArrayDataSetIterator",
+    "IrisDataSetIterator", "MnistDataSetIterator", "MultipleEpochsIterator",
+    "SamplingDataSetIterator",
+]

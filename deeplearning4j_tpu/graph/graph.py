@@ -158,6 +158,10 @@ class WeightedRandomWalkIterator(GraphWalkIterator):
     def _next_vertex(self, cur: int, rng) -> int:
         edges = self.graph.get_edges_out(cur)
         weights = np.array([e.weight for e in edges], dtype=np.float64)
+        if (weights < 0).any():
+            raise ValueError(
+                f"vertex {cur} has negative edge weights; weighted walks "
+                "need non-negative weights")
         s = weights.sum()
         if s <= 0:
             return edges[int(rng.integers(0, len(edges)))].to

@@ -92,7 +92,7 @@ def build_tx(default_u, confs: Dict[str, Optional[LayerConf]],
         transforms[wl] = lu.to_optax()
         lab = {}
         for pname in pgroup:
-            if bu is not None and pname in BaseLayerConf._BIAS_PARAMS:
+            if bu is not None and pname in lc._BIAS_PARAMS:
                 bl = f"{name}/b"
                 transforms[bl] = bu.to_optax()
                 lab[pname] = bl

@@ -1,4 +1,6 @@
 """Layer configs/implementations (reference ``nn/conf/layers`` + ``nn/layers``)."""
+from .attention import (LayerNormLayer, MultiHeadAttention,
+                        PositionalEncodingLayer, TransformerBlock)
 from .base import BaseLayerConf, LayerConf
 from .convolution import (Convolution1DLayer, ConvolutionLayer,
                           Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
@@ -18,8 +20,10 @@ __all__ = [
     "Bidirectional", "CenterLossOutputLayer", "Convolution1DLayer",
     "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
     "FrozenLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
-    "GravesLSTM", "LastTimeStep", "LayerConf", "LocalResponseNormalization",
-    "LossLayer", "LSTM", "OutputLayer", "RBM", "RnnOutputLayer", "SimpleRnn",
+    "GravesLSTM", "LastTimeStep", "LayerConf", "LayerNormLayer",
+    "LocalResponseNormalization", "LossLayer", "LSTM", "MultiHeadAttention",
+    "OutputLayer", "PositionalEncodingLayer", "RBM", "RnnOutputLayer",
+    "SimpleRnn", "TransformerBlock",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling1D", "Upsampling2D",
     "VariationalAutoencoder", "Yolo2OutputLayer", "ZeroPaddingLayer",
 ]

@@ -1,0 +1,248 @@
+"""Attention layer family: LayerNorm, MultiHeadAttention, TransformerBlock.
+
+No counterpart in the reference (pre-transformer, SURVEY.md §5) — this is the
+long-context capability the TPU build adds as first-class.  The layers follow
+the same config-dataclass contract as every other layer
+(``nn/layers/base.py``), so they compose with MultiLayerNetwork /
+ComputationGraph, serde, transfer learning, and the zoo.
+
+Attention impl tiers (select with ``attn_impl``):
+  'reference' — jnp SDPA (``ops.attention.sdpa_reference``), always correct.
+  'flash'     — pallas tiled kernel (``ops.flash_attention``), O(t) memory.
+  'ring'      — ring attention over the mesh 'seq' axis (inside shard_map).
+  'ulysses'   — all-to-all sequence parallelism (inside shard_map).
+  'auto'      — flash when unmasked + shapes tile, else reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import BaseLayerConf, LayerConf
+
+
+@register_serde
+@dataclass
+class LayerNormLayer(BaseLayerConf):
+    """Layer normalization over the feature axis (gamma/beta learned)."""
+    n_out: int = 0
+    eps: float = 1e-5
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_out == 0 or override:
+            self.n_out = itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def init(self, key, itype):
+        return {"params": {"gamma": jnp.ones((self.n_out,), self._dtype()),
+                           "beta": jnp.zeros((self.n_out,), self._dtype())},
+                "state": {}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        p = variables["params"]
+        y = _layer_norm(x, p["gamma"], p["beta"], self.eps)
+        return y, variables.get("state", {})
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
+                   interpret: bool = False):
+    """Dispatch [b,h,t,d] q/k/v to the selected attention implementation."""
+    from ...ops.attention import sdpa_reference
+    if impl in ("ring", "ulysses"):
+        from ...parallel.sequence import ring_self_attention, ulysses_attention
+        if mask is not None:
+            raise ValueError("sequence-parallel attention does not take "
+                             "key-padding masks (pad to shard boundary)")
+        fn = ring_self_attention if impl == "ring" else ulysses_attention
+        return fn(q, k, v, axis_name=seq_axis, causal=causal)
+    if impl == "flash" or (impl == "auto" and mask is None):
+        from ...ops.flash_attention import flash_attention
+        if mask is None:
+            return flash_attention(q, k, v, causal=causal, interpret=interpret)
+    return sdpa_reference(q, k, v, mask=mask, causal=causal)
+
+
+@register_serde
+@dataclass
+class MultiHeadAttention(BaseLayerConf):
+    """Multi-head self-attention over RNN-typed input [b, t, n_in].
+
+    Projections pack all heads into single [n_in, h*d] matmuls (MXU-shaped);
+    softmax statistics run in at least float32 even under bfloat16 params.
+    """
+    INPUT_KIND = "rnn"
+    _BIAS_PARAMS = ("bq", "bk", "bv", "bo")
+
+    n_in: int = 0
+    n_out: int = 0              # model/embed dim of the output projection
+    n_heads: int = 4
+    head_dim: int = 0           # default n_out // n_heads
+    causal: bool = False
+    attn_impl: str = "auto"     # reference|flash|ring|ulysses|auto
+    seq_axis: str = "seq"
+    has_bias: bool = True
+    attn_dropout: Optional[float] = None   # retain prob on attention output
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            if itype.kind != "rnn":
+                raise ValueError(f"layer '{self.name}': MultiHeadAttention "
+                                 f"expects RNN input, got {itype}")
+            self.n_in = itype.size
+        if self.n_out == 0:
+            self.n_out = self.n_in
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def _dims(self):
+        d = self.head_dim or max(1, self.n_out // self.n_heads)
+        return self.n_heads, d
+
+    def init(self, key, itype):
+        h, d = self._dims()
+        ks = jax.random.split(key, 4)
+        params = {
+            "Wq": self.make_weight(ks[0], (self.n_in, h * d)),
+            "Wk": self.make_weight(ks[1], (self.n_in, h * d)),
+            "Wv": self.make_weight(ks[2], (self.n_in, h * d)),
+            "Wo": self.make_weight(ks[3], (h * d, self.n_out)),
+        }
+        if self.has_bias:
+            params.update(bq=self.make_bias((h * d,)),
+                          bk=self.make_bias((h * d,)),
+                          bv=self.make_bias((h * d,)),
+                          bo=self.make_bias((self.n_out,)))
+        return {"params": params, "state": {}}
+
+    def _heads(self, x, p, w, b):
+        h, d = self._dims()
+        y = x @ p[w]
+        if self.has_bias:
+            y = y + p[b]
+        btime = y.shape[:-1]
+        return y.reshape(*btime, h, d).transpose(0, 2, 1, 3)   # [b,h,t,d]
+
+    def attend(self, p, x, *, train=False, key=None, mask=None):
+        """QKV projection → attention → output projection on [b,t,f] input."""
+        q = self._heads(x, p, "Wq", "bq")
+        k = self._heads(x, p, "Wk", "bk")
+        v = self._heads(x, p, "Wv", "bv")
+        o = _run_attention(q, k, v, impl=self.attn_impl, causal=self.causal,
+                           mask=mask, seq_axis=self.seq_axis)
+        b_, h, t, d = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b_, t, h * d)
+        y = o @ p["Wo"]
+        if self.has_bias:
+            y = y + p["bo"]
+        if train and self.attn_dropout and key is not None:
+            keep = self.attn_dropout
+            mask_d = jax.random.bernoulli(jax.random.fold_in(key, 7), keep,
+                                          y.shape)
+            y = jnp.where(mask_d, y / keep, 0.0)
+        return y
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        p = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        y = self.attend(p, x, train=train, key=key, mask=mask)
+        return self.act_fn(y), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class TransformerBlock(BaseLayerConf):
+    """Pre-norm transformer block: LN→MHA→residual, LN→MLP(GELU)→residual.
+
+    The attention half delegates to ``MultiHeadAttention`` (params carried
+    under a ``mha_`` prefix) so the two layers share one projection/head
+    implementation; ffn_mult sizes the hidden MLP.
+    """
+    INPUT_KIND = "rnn"
+    _BIAS_PARAMS = ("mha_bq", "mha_bk", "mha_bv", "mha_bo", "b1", "b2",
+                    "ln1_g", "ln1_b", "ln2_g", "ln2_b")
+
+    n_in: int = 0
+    n_heads: int = 4
+    ffn_mult: int = 4
+    causal: bool = True
+    attn_impl: str = "auto"
+    seq_axis: str = "seq"
+    eps: float = 1e-5
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            if itype.kind != "rnn":
+                raise ValueError(f"layer '{self.name}': TransformerBlock "
+                                 f"expects RNN input, got {itype}")
+            self.n_in = itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_in, itype.timesteps)
+
+    def _mha(self) -> MultiHeadAttention:
+        m = MultiHeadAttention(
+            n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
+            causal=self.causal, attn_impl=self.attn_impl,
+            seq_axis=self.seq_axis, activation="identity",
+            weight_init=self.weight_init, weight_dist=self.weight_dist,
+            bias_init=self.bias_init, dtype=self.dtype)
+        return m
+
+    def init(self, key, itype):
+        e = self.n_in
+        f = self.ffn_mult * e
+        k_mha, k1, k2 = jax.random.split(key, 3)
+        mha_vars = self._mha().init(k_mha, itype)
+        params = {f"mha_{k}": v for k, v in mha_vars["params"].items()}
+        params.update({
+            "W1": self.make_weight(k1, (e, f)), "b1": self.make_bias((f,)),
+            "W2": self.make_weight(k2, (f, e)), "b2": self.make_bias((e,)),
+            "ln1_g": jnp.ones((e,), self._dtype()),
+            "ln1_b": jnp.zeros((e,), self._dtype()),
+            "ln2_g": jnp.ones((e,), self._dtype()),
+            "ln2_b": jnp.zeros((e,), self._dtype()),
+        })
+        return {"params": params, "state": {}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        p = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        mha_p = {k[4:]: v for k, v in p.items() if k.startswith("mha_")}
+
+        xn = _layer_norm(x, p["ln1_g"], p["ln1_b"], self.eps)
+        x = x + self._mha().attend(mha_p, xn, train=train, key=key, mask=mask)
+
+        xn = _layer_norm(x, p["ln2_g"], p["ln2_b"], self.eps)
+        ff = jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+        return x + ff, variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class PositionalEncodingLayer(LayerConf):
+    """Sinusoidal positional encoding added to RNN-typed input (no params)."""
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        b, t, e = x.shape
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        i = jnp.arange(e, dtype=jnp.float32)[None, :]
+        angle = pos / jnp.power(10000.0, (2 * (i // 2)) / e)
+        pe = jnp.where(i % 2 == 0, jnp.sin(angle), jnp.cos(angle))
+        return x + pe.astype(x.dtype), variables.get("state", {})

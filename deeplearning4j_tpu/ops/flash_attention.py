@@ -1,0 +1,127 @@
+"""Pallas TPU flash-attention kernel — the "accelerator helper" tier.
+
+Role-parity with the reference's cuDNN helpers (``deeplearning4j-cuda/.../
+CudnnConvolutionHelper.java:54`` pattern: optional per-layer fast path,
+numerics-validated against the builtin fallback, cf. ``ValidateCudnnLSTM``).
+Here the fallback is ``ops.attention.sdpa_reference`` and the fast path is a
+tiled online-softmax kernel: O(t) memory instead of the O(t^2) score matrix,
+with [block_q × d] @ [d × block_k] matmuls shaped for the MXU and softmax
+statistics kept in VMEM scratch across the key-block grid dimension.
+
+Grid: (batch*heads, q_blocks, k_blocks) — the last dimension iterates
+innermost and sequentially on TPU, so scratch (m, l, acc) carries the running
+softmax state across k-blocks of one q-block.  float32 accumulation
+regardless of input dtype (bfloat16 inputs stay bfloat16 in HBM/VMEM).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, sdpa_reference
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: skip key blocks entirely above the diagonal.
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)            # [block_k, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+
+        m_prev = m_ref[:]                            # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
+        alpha = jnp.exp(m_prev - m_new)              # [block_q, 1]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Flash attention over [b, h, t, d] tensors.
+
+    Falls back to ``sdpa_reference`` when shapes don't tile (t or d too small
+    or not block-divisible) — same "checkSupported else fallback" contract as
+    ``CudnnLSTMHelper.checkSupported`` (``CudnnLSTMHelper.java:174-183``).
+    Key-padding masks are not supported here; masked batches use the fallback.
+    """
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    supported = (t_q % block_q == 0 and t_k % block_k == 0
+                 # head_dim must fill whole MXU lanes for the kernel's tiling
+                 and d % 64 == 0
+                 and (interpret or jax.default_backend() == "tpu"))
+    if not supported:
+        return sdpa_reference(q, k, v, causal=causal, scale=scale)
+    if scale is None:
+        scale = d ** -0.5
+
+    qr = q.reshape(b * h, t_q, d)
+    kr = k.reshape(b * h, t_k, d)
+    vr = v.reshape(b * h, t_k, d)
+    grid = (b * h, t_q // block_q, t_k // block_k)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t_q, d)
