@@ -125,7 +125,7 @@ def apply_constraints_all(params, confs: Dict[str, Optional[LayerConf]]):
             pgroup = dict(params[name])
             for c in cs:
                 for pname in pgroup:
-                    is_bias = pname in BaseLayerConf._BIAS_PARAMS
+                    is_bias = pname in hc._BIAS_PARAMS
                     if (is_bias and c.apply_to_biases) or \
                        (not is_bias and c.apply_to_weights):
                         pgroup[pname] = c.apply(pgroup[pname])

@@ -57,10 +57,16 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
 
 
+_ATTN_IMPLS = ("auto", "reference", "flash", "ring", "ulysses")
+
+
 def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
                    interpret: bool = False):
     """Dispatch [b,h,t,d] q/k/v to the selected attention implementation."""
     from ...ops.attention import sdpa_reference
+    if impl not in _ATTN_IMPLS:
+        raise ValueError(f"unknown attn_impl '{impl}'; expected one of "
+                         f"{_ATTN_IMPLS}")
     if impl in ("ring", "ulysses"):
         from ...parallel.sequence import ring_self_attention, ulysses_attention
         if mask is not None:

@@ -1,0 +1,146 @@
+"""Pipeline-parallelism tests: GPipe schedule vs sequential execution,
+forward AND gradient parity, plus a combined data×pipe×seq 3D-sharded
+transformer training step (the full long-context story on one mesh)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.pipeline import gpipe, stack_stage_params
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["W"] + params["b"])
+
+
+def _make_stages(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"W": jnp.asarray(rng.standard_normal((d, d)) * 0.3),
+             "b": jnp.asarray(rng.standard_normal(d) * 0.1)}
+            for _ in range(n)]
+
+
+def _sequential(stages, xs):
+    ys = []
+    for i in range(xs.shape[0]):
+        h = xs[i]
+        for p in stages:
+            h = _stage_fn(p, h)
+        ys.append(h)
+    return jnp.stack(ys)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 4), (4, 8), (8, 8)])
+def test_gpipe_matches_sequential(n_stages, n_micro):
+    d, mb = 6, 3
+    stages = _make_stages(n_stages, d)
+    stacked = stack_stage_params(stages)
+    xs = jnp.asarray(np.random.default_rng(1)
+                     .standard_normal((n_micro, mb, d)))
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pipe",))
+    fn = shard_map(functools.partial(gpipe, _stage_fn, axis_name="pipe"),
+                   mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P())
+    out = fn(stacked, xs)
+    ref = _sequential(stages, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_gpipe_gradients_match_sequential():
+    n_stages, n_micro, d, mb = 4, 4, 5, 2
+    stages = _make_stages(n_stages, d, seed=2)
+    stacked = stack_stage_params(stages)
+    xs = jnp.asarray(np.random.default_rng(3)
+                     .standard_normal((n_micro, mb, d)))
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pipe",))
+
+    def pipe_loss(stacked, xs):
+        ys = gpipe(_stage_fn, stacked, xs, axis_name="pipe")
+        return jnp.sum(ys ** 2)
+
+    grad_fn = shard_map(jax.grad(pipe_loss), mesh=mesh,
+                        in_specs=(P("pipe"), P()), out_specs=P("pipe"))
+    g_pipe = grad_fn(stacked, xs)
+
+    def seq_loss(stacked, xs):
+        ys = xs
+        for i in range(n_stages):
+            ys = _stage_fn(jax.tree.map(lambda p: p[i], stacked), ys)
+        return jnp.sum(ys ** 2)
+
+    g_seq = jax.grad(seq_loss)(stacked, xs)
+    for k in ("W", "b"):
+        np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                   np.asarray(g_seq[k]), atol=1e-6)
+
+
+def test_gpipe_rejects_too_few_microbatches():
+    stages = _make_stages(4, 4)
+    stacked = stack_stage_params(stages)
+    xs = jnp.zeros((2, 2, 4))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+    fn = shard_map(functools.partial(gpipe, _stage_fn, axis_name="pipe"),
+                   mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P())
+    with pytest.raises(ValueError, match="microbatches"):
+        fn(stacked, xs)
+
+
+def test_3d_transformer_training_step():
+    """data=2 × pipe=2 × seq=2 mesh: pipelined transformer blocks with ring
+    attention inside, DP gradient reduction — one full sharded train step,
+    loss finite and params move."""
+    from deeplearning4j_tpu.parallel.sequence import ring_self_attention
+
+    e, h, t, mb, n_micro, n_stage = 8, 2, 8, 4, 2, 2
+    d = e // h
+    rng = np.random.default_rng(7)
+
+    def block(params, x):  # pre-norm transformer block with ring attention
+        mu = jnp.mean(x, -1, keepdims=True)
+        xn = (x - mu) / jnp.sqrt(jnp.var(x, -1, keepdims=True) + 1e-5)
+        b_, tt = x.shape[0], x.shape[1]
+
+        def heads(y):
+            return y.reshape(b_, tt, h, d).transpose(0, 2, 1, 3)
+
+        q, k, v = (heads(xn @ params[w]) for w in ("Wq", "Wk", "Wv"))
+        o = ring_self_attention(q, k, v, axis_name="seq", causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b_, tt, h * d)
+        x = x + o @ params["Wo"]
+        return x + jax.nn.gelu(x @ params["W1"]) @ params["W2"]
+
+    def stage_params(seed):
+        r = np.random.default_rng(seed)
+        def w(*s):
+            return jnp.asarray(r.standard_normal(s) * 0.1)
+        return {"Wq": w(e, e), "Wk": w(e, e), "Wv": w(e, e), "Wo": w(e, e),
+                "W1": w(e, 2 * e), "W2": w(2 * e, e)}
+
+    stacked = stack_stage_params([stage_params(i) for i in range(n_stage)])
+    xs = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)))
+    ys = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)))
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "pipe", "seq"))
+
+    def train_step(stacked, xs, ys):
+        def loss_fn(stacked):
+            out = gpipe(block, stacked, xs, axis_name="pipe")
+            return jnp.mean((out - ys) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(stacked)
+        loss = jax.lax.pmean(loss, ("data", "seq"))
+        g = jax.lax.pmean(g, ("data", "seq"))
+        new = jax.tree.map(lambda p, gg: p - 0.1 * gg, stacked, g)
+        return loss, new
+
+    fn = shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P("pipe"), P(None, "data", "seq"), P(None, "data", "seq")),
+        out_specs=(P(), P("pipe")))
+    loss, new_params = fn(stacked, xs, ys)
+    assert np.isfinite(float(loss))
+    assert not np.allclose(np.asarray(new_params["Wq"]),
+                           np.asarray(stacked["Wq"]))
