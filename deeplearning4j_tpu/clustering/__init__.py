@@ -1,0 +1,11 @@
+"""Clustering & nearest neighbors (reference
+``deeplearning4j-nearestneighbors-parent/nearestneighbor-core`` +
+``deeplearning4j-core/.../plot/``): KMeans, VPTree/KDTree/brute-force kNN,
+SPTree, and t-SNE (exact jitted + Barnes-Hut)."""
+from .kmeans import ClusterSet, KMeans
+from .neighbors import BruteForceNN, KDTree, VPTree, pairwise_distance
+from .sptree import SPTree
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["KMeans", "ClusterSet", "BruteForceNN", "VPTree", "KDTree",
+           "pairwise_distance", "SPTree", "Tsne", "BarnesHutTsne"]

@@ -1,0 +1,108 @@
+"""KMeans clustering (reference ``clustering/kmeans/KMeansClustering.java`` +
+the generic strategy machinery in ``clustering/algorithm/BaseClusteringAlgorithm.java``).
+
+TPU-first: one Lloyd iteration is a distance Gram matrix (MXU matmul), an
+argmin, and a segment-sum — all fused under one ``jit``; the convergence
+check (distribution-variation threshold, reference
+``clustering/strategy/FixedClusterCountStrategy`` / ``ConvergenceCondition``)
+runs on host between jitted steps.  Empty clusters are re-seeded from the
+point farthest from its centroid (reference handles this by cluster-splitting
+in ``ClusterUtils.refreshClustersCenters``).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .neighbors import pairwise_distance
+
+__all__ = ["KMeans", "ClusterSet"]
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _lloyd_step(points, centers, metric: str):
+    d = pairwise_distance(points, centers, metric)          # [N,K]
+    assign = jnp.argmin(d, axis=1)                          # [N]
+    k = centers.shape[0]
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # [N,K]
+    counts = one_hot.sum(axis=0)                            # [K]
+    sums = one_hot.T @ points                               # [K,D]  (MXU)
+    new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+    # keep old center where a cluster went empty
+    new_centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+    cost = jnp.sum(jnp.min(d, axis=1))
+    # farthest point from its own centroid (used for empty-cluster reseed)
+    far = jnp.argmax(jnp.min(d, axis=1))
+    return new_centers, assign, counts, cost, far
+
+
+@dataclass
+class ClusterSet:
+    """Result of clustering: centers + assignment (reference ``ClusterSet``)."""
+    centers: np.ndarray
+    assignments: np.ndarray
+    cost: float
+    iterations: int
+
+    def nearest_cluster(self, points, metric: str = "euclidean") -> np.ndarray:
+        d = pairwise_distance(jnp.atleast_2d(jnp.asarray(points)),
+                              jnp.asarray(self.centers), metric)
+        return np.asarray(jnp.argmin(d, axis=1))
+
+
+class KMeans:
+    """Lloyd's algorithm with k-means++ init and empty-cluster reseeding."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 metric: str = "euclidean", tol: float = 1e-4,
+                 seed: int = 0, init: str = "kmeans++"):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.metric = metric
+        self.tol = tol
+        self.seed = seed
+        self.init = init
+
+    def _init_centers(self, points: np.ndarray, rng) -> np.ndarray:
+        n = len(points)
+        if self.init == "random":
+            return points[rng.choice(n, self.k, replace=False)]
+        # k-means++: iteratively sample proportional to squared distance
+        centers = [points[rng.integers(n)]]
+        d2 = None
+        for _ in range(1, self.k):
+            cur = np.asarray(pairwise_distance(
+                jnp.asarray(points), jnp.asarray(centers[-1:]), self.metric))[:, 0] ** 2
+            d2 = cur if d2 is None else np.minimum(d2, cur)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(points[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def fit(self, points) -> ClusterSet:
+        points_np = np.asarray(points, dtype=np.float32)
+        rng = np.random.default_rng(self.seed)
+        centers = jnp.asarray(self._init_centers(points_np, rng))
+        pts = jnp.asarray(points_np)
+        prev_cost = np.inf
+        assign = counts = None
+        it = 0
+        for it in range(1, self.max_iterations + 1):
+            centers, assign, counts, cost, far = _lloyd_step(pts, centers, self.metric)
+            counts_np = np.asarray(counts)
+            if (counts_np == 0).any():
+                centers_np = np.asarray(centers)
+                centers_np[np.flatnonzero(counts_np == 0)[0]] = points_np[int(far)]
+                centers = jnp.asarray(centers_np)
+                continue
+            cost = float(cost)
+            if abs(prev_cost - cost) <= self.tol * max(abs(prev_cost), 1.0):
+                prev_cost = cost
+                break
+            prev_cost = cost
+        return ClusterSet(np.asarray(centers), np.asarray(assign),
+                          prev_cost, it)

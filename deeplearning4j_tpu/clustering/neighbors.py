@@ -1,0 +1,200 @@
+"""Nearest-neighbor search.
+
+TPU-first design note: the reference's exact-NN structures (VPTree
+``clustering/vptree/VPTree.java:48``, KDTree ``clustering/kdtree/KDTree.java``)
+are pointer-chasing trees — the wrong shape for a systolic array.  On TPU the
+idiomatic exact-kNN is a *batched distance matmul* + ``lax.top_k``: the
+pairwise-distance Gram matrix rides the MXU and top-k is a fused XLA reduce.
+That is the default device path here (:class:`BruteForceNN`).  The tree
+structures are still provided (host-side, NumPy) because the serving tier
+(``NearestNeighborsServer``, reference
+``deeplearning4j-nearestneighbor-server/.../NearestNeighborsServer.java:44``)
+and Barnes-Hut t-SNE want cheap single-query exact search on CPU.
+"""
+from __future__ import annotations
+
+import functools
+import heapq
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BruteForceNN", "VPTree", "KDTree", "pairwise_distance"]
+
+
+def _norm_rows(x):
+    n = jnp.linalg.norm(x, axis=-1, keepdims=True)
+    return x / jnp.maximum(n, 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def pairwise_distance(queries, points, metric: str = "euclidean"):
+    """[Q,D] x [N,D] -> [Q,N] distances.  euclidean/cosine/manhattan/dot.
+
+    Euclidean uses the ||a||^2 - 2ab + ||b||^2 expansion so the cross term is
+    one MXU matmul instead of a [Q,N,D] broadcast (HBM-bound).
+    """
+    if metric == "euclidean":
+        q2 = jnp.sum(queries * queries, axis=-1)[:, None]
+        p2 = jnp.sum(points * points, axis=-1)[None, :]
+        cross = queries @ points.T
+        return jnp.sqrt(jnp.maximum(q2 - 2.0 * cross + p2, 0.0))
+    if metric == "cosine":
+        return 1.0 - _norm_rows(queries) @ _norm_rows(points).T
+    if metric == "manhattan":
+        return jnp.sum(jnp.abs(queries[:, None, :] - points[None, :, :]), axis=-1)
+    if metric == "dot":
+        return -(queries @ points.T)
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _knn(queries, points, k: int, metric: str):
+    d = pairwise_distance(queries, points, metric)
+    neg_d, idx = jax.lax.top_k(-d, k)
+    return -neg_d, idx
+
+
+class BruteForceNN:
+    """Exact kNN on device: distance Gram matrix (MXU) + ``lax.top_k``."""
+
+    def __init__(self, points, metric: str = "euclidean"):
+        self.points = jnp.asarray(points)
+        self.metric = metric
+
+    def query(self, queries, k: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (distances [Q,k], indices [Q,k])."""
+        queries = jnp.atleast_2d(jnp.asarray(queries))
+        d, i = _knn(queries, self.points, k, self.metric)
+        return np.asarray(d), np.asarray(i)
+
+
+def _host_dist(a: np.ndarray, b: np.ndarray, metric: str) -> np.ndarray:
+    if metric == "euclidean":
+        return np.linalg.norm(a - b, axis=-1)
+    if metric == "manhattan":
+        return np.sum(np.abs(a - b), axis=-1)
+    if metric == "cosine":
+        na = a / np.maximum(np.linalg.norm(a, axis=-1, keepdims=True), 1e-12)
+        nb = b / np.maximum(np.linalg.norm(b, axis=-1, keepdims=True), 1e-12)
+        return 1.0 - np.sum(na * nb, axis=-1)
+    raise ValueError(metric)
+
+
+class _VPNode:
+    __slots__ = ("index", "threshold", "inside", "outside")
+
+    def __init__(self, index, threshold, inside, outside):
+        self.index = index
+        self.threshold = threshold
+        self.inside = inside
+        self.outside = outside
+
+
+class VPTree:
+    """Vantage-point tree (reference ``clustering/vptree/VPTree.java:48``).
+
+    Host-side exact metric tree for the serving tier; median-split on the
+    distance to a randomly chosen vantage point.
+    """
+
+    def __init__(self, points, metric: str = "euclidean", seed: int = 0):
+        self.points = np.asarray(points, dtype=np.float64)
+        self.metric = metric
+        self._rng = np.random.default_rng(seed)
+        self.root = self._build(np.arange(len(self.points)))
+
+    def _build(self, idx: np.ndarray) -> Optional[_VPNode]:
+        if idx.size == 0:
+            return None
+        vp_pos = self._rng.integers(idx.size)
+        vp = idx[vp_pos]
+        rest = np.delete(idx, vp_pos)
+        if rest.size == 0:
+            return _VPNode(vp, 0.0, None, None)
+        d = _host_dist(self.points[rest], self.points[vp], self.metric)
+        med = float(np.median(d))
+        inside = rest[d <= med]
+        outside = rest[d > med]
+        return _VPNode(vp, med, self._build(inside), self._build(outside))
+
+    def query(self, point, k: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+        point = np.asarray(point, dtype=np.float64)
+        heap: List[Tuple[float, int]] = []  # max-heap via negated distance
+
+        def search(node: Optional[_VPNode]):
+            if node is None:
+                return
+            d = float(_host_dist(self.points[node.index], point, self.metric))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.index))
+            tau = -heap[0][0] if len(heap) == k else np.inf
+            if d < node.threshold:
+                search(node.inside)
+                if d + tau >= node.threshold:
+                    search(node.outside)
+            else:
+                search(node.outside)
+                if d - tau <= node.threshold:
+                    search(node.inside)
+
+        search(self.root)
+        order = sorted((-nd, i) for nd, i in heap)
+        return (np.array([d for d, _ in order]),
+                np.array([i for _, i in order], dtype=np.int64))
+
+
+class _KDNode:
+    __slots__ = ("index", "dim", "left", "right")
+
+    def __init__(self, index, dim, left, right):
+        self.index = index
+        self.dim = dim
+        self.left = left
+        self.right = right
+
+
+class KDTree:
+    """k-d tree (reference ``clustering/kdtree/KDTree.java``), euclidean."""
+
+    def __init__(self, points):
+        self.points = np.asarray(points, dtype=np.float64)
+        self.root = self._build(np.arange(len(self.points)), 0)
+
+    def _build(self, idx: np.ndarray, depth: int) -> Optional[_KDNode]:
+        if idx.size == 0:
+            return None
+        dim = depth % self.points.shape[1]
+        order = idx[np.argsort(self.points[idx, dim], kind="stable")]
+        mid = order.size // 2
+        return _KDNode(order[mid], dim,
+                       self._build(order[:mid], depth + 1),
+                       self._build(order[mid + 1:], depth + 1))
+
+    def query(self, point, k: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+        point = np.asarray(point, dtype=np.float64)
+        heap: List[Tuple[float, int]] = []
+
+        def search(node: Optional[_KDNode]):
+            if node is None:
+                return
+            d = float(np.linalg.norm(self.points[node.index] - point))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.index))
+            diff = point[node.dim] - self.points[node.index, node.dim]
+            near, far = (node.left, node.right) if diff <= 0 else (node.right, node.left)
+            search(near)
+            tau = -heap[0][0] if len(heap) == k else np.inf
+            if abs(diff) <= tau:
+                search(far)
+
+        search(self.root)
+        order = sorted((-nd, i) for nd, i in heap)
+        return (np.array([d for d, _ in order]),
+                np.array([i for _, i in order], dtype=np.int64))

@@ -74,10 +74,15 @@ def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
                              "key-padding masks (pad to shard boundary)")
         fn = ring_self_attention if impl == "ring" else ulysses_attention
         return fn(q, k, v, axis_name=seq_axis, causal=causal)
-    if impl == "flash" or (impl == "auto" and mask is None):
+    if impl == "flash":
+        if mask is not None:
+            raise ValueError("attn_impl='flash' does not take key-padding "
+                             "masks; use 'reference'/'auto' or pre-mask inputs")
         from ...ops.flash_attention import flash_attention
-        if mask is None:
-            return flash_attention(q, k, v, causal=causal, interpret=interpret)
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+    if impl == "auto" and mask is None:
+        from ...ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, interpret=interpret)
     return sdpa_reference(q, k, v, mask=mask, causal=causal)
 
 

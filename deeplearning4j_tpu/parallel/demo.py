@@ -1,0 +1,81 @@
+"""Shared 3D-parallel demo model: a pre-norm transformer block with ring
+attention, GPipe-stacked stages, and a DP-reduced SGD train step.
+
+Used by both the driver dry run (``dryrun.py``) and the pipeline test suite
+so the validated model and the dry-run model cannot drift apart.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pipeline import gpipe, stack_stage_params
+from .sequence import ring_self_attention
+
+__all__ = ["ring_transformer_block", "make_stage_params",
+           "make_pipelined_train_step", "build_demo_inputs"]
+
+
+def ring_transformer_block(params, x, *, n_heads: int, seq_axis: str = "seq"):
+    """Pre-norm block: LN → ring-attention (causal) → residual → gelu MLP."""
+    xn = (x - jnp.mean(x, -1, keepdims=True)) * jax.lax.rsqrt(
+        jnp.var(x, -1, keepdims=True) + 1e-5)
+    b, t, e = x.shape
+    d = e // n_heads
+
+    def heads(y):
+        return y.reshape(b, t, n_heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = (heads(xn @ params[w]) for w in ("Wq", "Wk", "Wv"))
+    o = ring_self_attention(q, k, v, axis_name=seq_axis, causal=True)
+    x = x + o.transpose(0, 2, 1, 3).reshape(b, t, e) @ params["Wo"]
+    return x + jax.nn.gelu(x @ params["W1"]) @ params["W2"]
+
+
+def make_stage_params(embed: int, seed: int, dtype=jnp.float32):
+    r = np.random.default_rng(seed)
+
+    def w(*s):
+        return jnp.asarray(r.standard_normal(s) * 0.1, dtype)
+
+    return {"Wq": w(embed, embed), "Wk": w(embed, embed),
+            "Wv": w(embed, embed), "Wo": w(embed, embed),
+            "W1": w(embed, 2 * embed), "W2": w(2 * embed, embed)}
+
+
+def build_demo_inputs(*, n_stages: int, embed: int, n_heads: int, seq_len: int,
+                      microbatch: int, n_micro: int, seed: int = 0,
+                      dtype=jnp.float32):
+    """Stacked stage params + [n_micro, mb, t, e] inputs/targets."""
+    rng = np.random.default_rng(seed)
+    stacked = stack_stage_params(
+        [make_stage_params(embed, i, dtype) for i in range(n_stages)])
+    xs = jnp.asarray(rng.standard_normal((n_micro, microbatch, seq_len, embed)),
+                     dtype)
+    ys = jnp.asarray(rng.standard_normal((n_micro, microbatch, seq_len, embed)),
+                     dtype)
+    return stacked, xs, ys
+
+
+def make_pipelined_train_step(*, n_heads: int, lr: float = 0.1,
+                              pipe_axis: str = "pipe",
+                              reduce_axes=("data", "seq")):
+    """shard_map body: GPipe forward, MSE loss, DP/SP gradient pmean, SGD."""
+
+    def block(params, x):
+        return ring_transformer_block(params, x, n_heads=n_heads,
+                                      seq_axis="seq")
+
+    def train_step(stacked, xs, ys):
+        def loss_fn(stacked):
+            out = gpipe(block, stacked, xs, axis_name=pipe_axis)
+            return jnp.mean((out - ys) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(stacked)
+        loss = jax.lax.pmean(loss, reduce_axes)
+        g = jax.lax.pmean(g, reduce_axes)
+        new = jax.tree.map(lambda p, gg: p - lr * gg, stacked, g)
+        return loss, new
+
+    return train_step

@@ -54,52 +54,21 @@ def run(n_devices: int) -> None:
 
 def _pipeline_seq_step(n_devices: int) -> None:
     """data×pipe×seq 3D-sharded transformer train step: GPipe microbatching
-    with ring attention inside each stage, DP gradient pmean, SGD update."""
+    with ring attention inside each stage, DP gradient pmean, SGD update.
+    Model + step come from ``demo.py`` (shared with the pipeline tests)."""
     import jax
-    import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from .pipeline import gpipe, stack_stage_params
-    from .sequence import ring_self_attention
+    from .demo import build_demo_inputs, make_pipelined_train_step
 
     dp, pp, sp = 2, 2, n_devices // 4
-    e, h, t, mb, n_micro = 8, 2, 4 * sp, 2 * dp, pp
-    d = e // h
-    rng = np.random.default_rng(0)
-
-    def block(params, x):
-        xn = (x - jnp.mean(x, -1, keepdims=True)) * jax.lax.rsqrt(
-            jnp.var(x, -1, keepdims=True) + 1e-5)
-        b_, tt = x.shape[0], x.shape[1]
-        q, k, v = (
-            (xn @ params[w]).reshape(b_, tt, h, d).transpose(0, 2, 1, 3)
-            for w in ("Wq", "Wk", "Wv"))
-        o = ring_self_attention(q, k, v, axis_name="seq", causal=True)
-        x = x + o.transpose(0, 2, 1, 3).reshape(b_, tt, h * d) @ params["Wo"]
-        return x + jax.nn.gelu(x @ params["W1"]) @ params["W2"]
-
-    def stage(seed):
-        r = np.random.default_rng(seed)
-        w = lambda *s: jnp.asarray(r.standard_normal(s) * 0.1, jnp.float32)
-        return {"Wq": w(e, e), "Wk": w(e, e), "Wv": w(e, e), "Wo": w(e, e),
-                "W1": w(e, 2 * e), "W2": w(2 * e, e)}
-
-    stacked = stack_stage_params([stage(i) for i in range(pp)])
-    xs = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)), jnp.float32)
-    ys = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)), jnp.float32)
+    stacked, xs, ys = build_demo_inputs(
+        n_stages=pp, embed=8, n_heads=2, seq_len=4 * sp, microbatch=2 * dp,
+        n_micro=pp)
     mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(dp, pp, sp),
                 ("data", "pipe", "seq"))
-
-    def train_step(stacked, xs, ys):
-        def loss_fn(stacked):
-            out = gpipe(block, stacked, xs, axis_name="pipe")
-            return jnp.mean((out - ys) ** 2)
-        loss, g = jax.value_and_grad(loss_fn)(stacked)
-        loss = jax.lax.pmean(loss, ("data", "seq"))
-        g = jax.lax.pmean(g, ("data", "seq"))
-        return loss, jax.tree.map(lambda p, gg: p - 0.1 * gg, stacked, g)
-
+    train_step = make_pipelined_train_step(n_heads=2)
     fn = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(P("pipe"), P(None, "data", "seq"), P(None, "data", "seq")),

@@ -1,0 +1,134 @@
+"""Clustering / nearest-neighbor / t-SNE tests (reference test model:
+``nearestneighbor-core/src/test/.../vptree/VpTreeNodeTest.java``,
+``clustering/kmeans/KMeansTest.java``, ``deeplearning4j-core`` t-SNE tests)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (BarnesHutTsne, BruteForceNN,
+                                           KDTree, KMeans, SPTree, Tsne,
+                                           VPTree, pairwise_distance)
+
+
+def _blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0.0, 0.0], [8.0, 8.0], [-8.0, 8.0]])
+    pts = np.concatenate([c + rng.standard_normal((n_per, 2)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts.astype(np.float32), labels
+
+
+class TestNeighbors:
+    def test_brute_force_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((100, 5)).astype(np.float32)
+        q = rng.standard_normal((7, 5)).astype(np.float32)
+        d, i = BruteForceNN(pts).query(q, k=3)
+        ref = np.linalg.norm(q[:, None, :] - pts[None, :, :], axis=-1)
+        ref_idx = np.argsort(ref, axis=1)[:, :3]
+        assert np.array_equal(i, ref_idx)
+        np.testing.assert_allclose(d, np.sort(ref, axis=1)[:, :3], rtol=1e-4)
+
+    @pytest.mark.parametrize("tree_cls", [VPTree, KDTree])
+    def test_trees_match_brute_force(self, tree_cls):
+        rng = np.random.default_rng(2)
+        pts = rng.standard_normal((200, 4))
+        tree = tree_cls(pts)
+        for qi in range(5):
+            q = rng.standard_normal(4)
+            d, i = tree.query(q, k=5)
+            ref = np.linalg.norm(pts - q, axis=1)
+            order = np.argsort(ref)[:5]
+            np.testing.assert_allclose(d, ref[order], rtol=1e-9)
+            assert set(i) == set(order)
+
+    def test_vptree_cosine(self):
+        rng = np.random.default_rng(3)
+        pts = rng.standard_normal((80, 6))
+        tree = VPTree(pts, metric="cosine")
+        q = rng.standard_normal(6)
+        d, i = tree.query(q, k=3)
+        nq = q / np.linalg.norm(q)
+        np_pts = pts / np.linalg.norm(pts, axis=1, keepdims=True)
+        ref = 1.0 - np_pts @ nq
+        assert set(i) == set(np.argsort(ref)[:3])
+
+    def test_pairwise_metrics(self):
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((5, 4)).astype(np.float32)
+        man = np.asarray(pairwise_distance(a, b, "manhattan"))
+        ref = np.sum(np.abs(a[:, None] - b[None]), axis=-1)
+        np.testing.assert_allclose(man, ref, rtol=1e-5)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels = _blobs()
+        cs = KMeans(k=3, seed=5).fit(pts)
+        assert cs.centers.shape == (3, 2)
+        # each true cluster maps to exactly one predicted cluster
+        mapping = [np.bincount(cs.assignments[labels == c], minlength=3).argmax()
+                   for c in range(3)]
+        assert len(set(mapping)) == 3
+        acc = np.mean([np.mean(cs.assignments[labels == c] == mapping[c])
+                       for c in range(3)])
+        assert acc > 0.95
+
+    def test_nearest_cluster(self):
+        pts, _ = _blobs()
+        cs = KMeans(k=3, seed=5).fit(pts)
+        pred = cs.nearest_cluster(np.array([[8.0, 8.0]], dtype=np.float32))
+        d = np.linalg.norm(cs.centers - np.array([8.0, 8.0]), axis=1)
+        assert pred[0] == np.argmin(d)
+
+    def test_cost_decreases_with_more_clusters(self):
+        pts, _ = _blobs()
+        c1 = KMeans(k=1, seed=0).fit(pts).cost
+        c3 = KMeans(k=3, seed=0).fit(pts).cost
+        assert c3 < c1
+
+
+class TestSPTree:
+    def test_aggregates(self):
+        rng = np.random.default_rng(6)
+        pts = rng.standard_normal((64, 2))
+        tree = SPTree(pts)
+        assert tree.root.count == 64
+        np.testing.assert_allclose(tree.root.cum_center, pts.mean(0), atol=1e-9)
+
+    def test_theta_zero_matches_exact_repulsion(self):
+        rng = np.random.default_rng(7)
+        pts = rng.standard_normal((40, 2))
+        tree = SPTree(pts)
+        i = 3
+        neg, z = tree.compute_non_edge_forces(i, theta=0.0)
+        diff = pts[i] - np.delete(pts, i, axis=0)
+        w = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        np.testing.assert_allclose(z, w.sum(), rtol=1e-8)
+        np.testing.assert_allclose(neg, (w[:, None] ** 2 * diff).sum(0), rtol=1e-8)
+
+
+class TestTsne:
+    def test_exact_separates_blobs(self):
+        pts, labels = _blobs(n_per=30)
+        y = Tsne(perplexity=10.0, max_iter=300, seed=0).fit(pts)
+        assert y.shape == (90, 2)
+        # embedded clusters should be separable: inter-centroid distance large
+        # relative to intra-cluster spread
+        cents = np.stack([y[labels == c].mean(0) for c in range(3)])
+        spread = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3))
+        dmin = min(np.linalg.norm(cents[a] - cents[b])
+                   for a in range(3) for b in range(a + 1, 3))
+        assert dmin > 2.0 * spread
+
+    def test_barnes_hut_separates_blobs(self):
+        pts, labels = _blobs(n_per=20)
+        y = BarnesHutTsne(theta=0.5, perplexity=8.0, max_iter=200, seed=0).fit(pts)
+        assert y.shape == (60, 2)
+        cents = np.stack([y[labels == c].mean(0) for c in range(3)])
+        spread = max(np.linalg.norm(y[labels == c] - cents[c], axis=1).mean()
+                     for c in range(3))
+        dmin = min(np.linalg.norm(cents[a] - cents[b])
+                   for a in range(3) for b in range(a + 1, 3))
+        assert dmin > 1.5 * spread

@@ -91,51 +91,17 @@ def test_gpipe_rejects_too_few_microbatches():
 def test_3d_transformer_training_step():
     """data=2 × pipe=2 × seq=2 mesh: pipelined transformer blocks with ring
     attention inside, DP gradient reduction — one full sharded train step,
-    loss finite and params move."""
-    from deeplearning4j_tpu.parallel.sequence import ring_self_attention
+    loss finite and params move.  Model/step shared with the driver dry run
+    (``parallel/demo.py``)."""
+    from deeplearning4j_tpu.parallel.demo import (build_demo_inputs,
+                                                  make_pipelined_train_step)
 
-    e, h, t, mb, n_micro, n_stage = 8, 2, 8, 4, 2, 2
-    d = e // h
-    rng = np.random.default_rng(7)
-
-    def block(params, x):  # pre-norm transformer block with ring attention
-        mu = jnp.mean(x, -1, keepdims=True)
-        xn = (x - mu) / jnp.sqrt(jnp.var(x, -1, keepdims=True) + 1e-5)
-        b_, tt = x.shape[0], x.shape[1]
-
-        def heads(y):
-            return y.reshape(b_, tt, h, d).transpose(0, 2, 1, 3)
-
-        q, k, v = (heads(xn @ params[w]) for w in ("Wq", "Wk", "Wv"))
-        o = ring_self_attention(q, k, v, axis_name="seq", causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(b_, tt, h * d)
-        x = x + o @ params["Wo"]
-        return x + jax.nn.gelu(x @ params["W1"]) @ params["W2"]
-
-    def stage_params(seed):
-        r = np.random.default_rng(seed)
-        def w(*s):
-            return jnp.asarray(r.standard_normal(s) * 0.1)
-        return {"Wq": w(e, e), "Wk": w(e, e), "Wv": w(e, e), "Wo": w(e, e),
-                "W1": w(e, 2 * e), "W2": w(2 * e, e)}
-
-    stacked = stack_stage_params([stage_params(i) for i in range(n_stage)])
-    xs = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)))
-    ys = jnp.asarray(rng.standard_normal((n_micro, mb, t, e)))
-
+    stacked, xs, ys = build_demo_inputs(
+        n_stages=2, embed=8, n_heads=2, seq_len=8, microbatch=4, n_micro=2,
+        seed=7, dtype=jnp.float64)
     mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
                 ("data", "pipe", "seq"))
-
-    def train_step(stacked, xs, ys):
-        def loss_fn(stacked):
-            out = gpipe(block, stacked, xs, axis_name="pipe")
-            return jnp.mean((out - ys) ** 2)
-        loss, g = jax.value_and_grad(loss_fn)(stacked)
-        loss = jax.lax.pmean(loss, ("data", "seq"))
-        g = jax.lax.pmean(g, ("data", "seq"))
-        new = jax.tree.map(lambda p, gg: p - 0.1 * gg, stacked, g)
-        return loss, new
-
+    train_step = make_pipelined_train_step(n_heads=2)
     fn = shard_map(
         train_step, mesh=mesh,
         in_specs=(P("pipe"), P(None, "data", "seq"), P(None, "data", "seq")),
