@@ -1,0 +1,9 @@
+"""Observability: stats collection → storage → web dashboard (reference
+``deeplearning4j-ui-parent``: StatsListener → StatsStorage → PlayUIServer)."""
+from .server import RemoteUIStatsStorageRouter, UIServer
+from .stats import StatsListener, StatsReport, array_stats
+from .storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
+
+__all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
+           "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
+           "RemoteUIStatsStorageRouter"]

@@ -1,0 +1,174 @@
+"""Training UI server (reference ``deeplearning4j-play/.../PlayUIServer.java:51``
++ ``ui/module/train/TrainModule.java`` overview/model/system pages and
+``ui/module/remote/RemoteReceiverModule.java`` for HTTP-posted stats).
+
+Python stdlib ``http.server`` on a daemon thread — no Play/netty dependency;
+the dashboard is a single self-contained HTML page (inline vanilla-JS canvas
+charts, no CDN assets: this environment and many TPU pods have no egress).
+
+Endpoints:
+  GET  /                      dashboard HTML
+  GET  /train/sessions        JSON list of session ids
+  GET  /train/<sid>/overview  JSON score/time/param-norm series
+  GET  /train/<sid>/model     JSON per-parameter stats of the latest record
+  GET  /train/<sid>/system    JSON memory series
+  POST /remote                accept a posted StatsReport JSON (remote router)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.request import Request, urlopen
+
+from .stats import StatsReport
+from .storage import InMemoryStatsStorage, StatsStorage
+
+__all__ = ["UIServer", "RemoteUIStatsStorageRouter"]
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>dl4j-tpu training UI</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h2{margin:8px 0} .chart{background:#fff;border:1px solid #ddd;margin:10px 0}
+#sessions{margin-bottom:12px}</style></head><body>
+<h2>dl4j-tpu training</h2>
+<div id="sessions"></div>
+<h3>Score vs iteration</h3><canvas id="score" class="chart" width="900" height="240"></canvas>
+<h3>Parameter L2 norms</h3><canvas id="norms" class="chart" width="900" height="240"></canvas>
+<h3>Iteration time (ms)</h3><canvas id="times" class="chart" width="900" height="160"></canvas>
+<script>
+let sid=null;
+function line(c,series,labels){const x=c.getContext('2d');x.clearRect(0,0,c.width,c.height);
+ const all=series.flat(); if(!all.length)return;
+ const mi=Math.min(...all),ma=Math.max(...all),r=(ma-mi)||1;
+ const colors=['#1565c0','#c62828','#2e7d32','#f9a825','#6a1b9a','#00838f'];
+ series.forEach((s,si)=>{x.beginPath();x.strokeStyle=colors[si%colors.length];
+  s.forEach((v,i)=>{const px=30+i*(c.width-40)/Math.max(s.length-1,1),
+   py=c.height-20-(v-mi)/r*(c.height-40); i?x.lineTo(px,py):x.moveTo(px,py);});
+  x.stroke();
+  if(labels&&labels[si]){x.fillStyle=colors[si%colors.length];
+   x.fillText(labels[si],40+110*si,12);}});
+ x.fillStyle='#333';x.fillText(ma.toPrecision(4),2,14);
+ x.fillText(mi.toPrecision(4),2,c.height-22);}
+async function refresh(){
+ const ss=await (await fetch('/train/sessions')).json();
+ document.getElementById('sessions').textContent='sessions: '+ss.join(', ');
+ if(!ss.length)return; if(!sid)sid=ss[ss.length-1];
+ const o=await (await fetch('/train/'+sid+'/overview')).json();
+ line(document.getElementById('score'),[o.scores]);
+ const names=Object.keys(o.param_norms).slice(0,6);
+ line(document.getElementById('norms'),names.map(n=>o.param_norms[n]),names);
+ line(document.getElementById('times'),[o.iter_times_ms]);}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    storage: StatsStorage = None  # set by UIServer
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if not parts:
+            page = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
+            return
+        if parts[0] != "train":
+            return self._json({"error": "not found"}, 404)
+        if len(parts) == 2 and parts[1] == "sessions":
+            return self._json(self.storage.list_session_ids())
+        if len(parts) == 3:
+            sid, what = parts[1], parts[2]
+            recs = self.storage.get_records(sid)
+            if what == "overview":
+                norms = {}
+                for r in recs:
+                    for name, st in r.param_stats.items():
+                        norms.setdefault(name, []).append(st.get("norm2"))
+                return self._json({
+                    "iterations": [r.iteration for r in recs],
+                    "scores": [r.score for r in recs],
+                    "iter_times_ms": [r.iter_time_ms for r in recs],
+                    "param_norms": norms})
+            if what == "model":
+                last = recs[-1] if recs else None
+                return self._json(last.to_dict() if last else {})
+            if what == "system":
+                return self._json({
+                    "iterations": [r.iteration for r in recs],
+                    "memory": [r.memory for r in recs]})
+        return self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        if self.path.rstrip("/") != "/remote":
+            return self._json({"error": "not found"}, 404)
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            report = StatsReport.from_dict(json.loads(self.rfile.read(n)))
+        except Exception as e:  # malformed post must not kill the server
+            return self._json({"error": str(e)}, 400)
+        self.storage.put_record(report)
+        return self._json({"ok": True})
+
+
+class UIServer:
+    """Attachable dashboard server (reference ``UIServer.getInstance()`` /
+    ``PlayUIServer``).  ``attach(storage)`` routes that storage's sessions."""
+
+    def __init__(self, port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        handler.storage = InMemoryStatsStorage()
+        self._handler = handler
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def storage(self) -> StatsStorage:
+        return self._handler.storage
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._handler.storage = storage
+
+    def start(self) -> "UIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class RemoteUIStatsStorageRouter:
+    """Client-side router POSTing records to a remote UIServer (reference
+    ``deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java``)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+
+    def put_record(self, report: StatsReport) -> None:
+        req = Request(self.url, data=json.dumps(report.to_dict()).encode(),
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.timeout) as resp:
+            resp.read()

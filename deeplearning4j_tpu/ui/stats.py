@@ -1,0 +1,150 @@
+"""Training stats collection (reference
+``ui-model/.../ui/stats/BaseStatsListener.java:44`` — ``iterationDone`` :286
+collects score, param/update histograms & mean-magnitudes, memory, GC and
+hardware info, SBE-encodes them into ``Persistable`` records).
+
+TPU-native spin: a single jitted reduction computes every per-parameter
+statistic (mean/std/min/max/norm + histogram) in one device pass — the
+histogramming rides XLA instead of host loops; only the final small stat
+pytree is pulled to host.  Records are compact JSON payloads framed by the
+storage layer (the SBE role is played by length-prefixed binary framing,
+``storage.py``).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..train.listeners import TrainingListener
+
+__all__ = ["StatsListener", "StatsReport", "array_stats"]
+
+_N_BINS = 20
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def _stats_one(x, bins: int = _N_BINS):
+    x = x.reshape(-1).astype(jnp.float32)
+    lo, hi = jnp.min(x), jnp.max(x)
+    width = jnp.maximum(hi - lo, 1e-12)
+    idx = jnp.clip(((x - lo) / width * bins).astype(jnp.int32), 0, bins - 1)
+    hist = jnp.zeros((bins,), jnp.int32).at[idx].add(1)
+    return {"mean": jnp.mean(x), "std": jnp.std(x), "min": lo, "max": hi,
+            "mean_magnitude": jnp.mean(jnp.abs(x)),
+            "norm2": jnp.linalg.norm(x), "hist": hist}
+
+
+def array_stats(x) -> Dict[str, Any]:
+    """Host dict of scalar stats + histogram for one array (one device pass)."""
+    s = _stats_one(jnp.asarray(x))
+    out = {k: float(v) for k, v in s.items() if k != "hist"}
+    out["hist"] = np.asarray(s["hist"]).tolist()
+    return out
+
+
+def _flatten_params(params, prefix="") -> Dict[str, Any]:
+    flat = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            flat.update(_flatten_params(v, f"{prefix}{k}/"))
+    else:
+        flat[prefix.rstrip("/")] = params
+    return flat
+
+
+@dataclass
+class StatsReport:
+    """One iteration's record (reference ``StatsReport``/``SbeStatsReport``)."""
+    session_id: str
+    worker_id: str
+    iteration: int
+    epoch: int
+    timestamp: float
+    score: float
+    iter_time_ms: float
+    param_stats: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    update_stats: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    memory: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StatsReport":
+        return cls(**d)
+
+
+def _memory_info() -> Dict[str, Any]:
+    mem: Dict[str, Any] = {}
+    try:
+        import resource
+        mem["host_rss_kb"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:
+        pass
+    try:
+        for i, dev in enumerate(jax.devices()):
+            st = getattr(dev, "memory_stats", lambda: None)()
+            if st:
+                mem[f"device{i}_bytes_in_use"] = st.get("bytes_in_use")
+                mem[f"device{i}_bytes_limit"] = st.get("bytes_limit")
+    except Exception:
+        pass
+    return mem
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a :class:`StatsStorage`-compatible
+    router (reference ``BaseStatsListener``).
+
+    ``update stats`` are parameter deltas between consecutive collected
+    iterations — the functional-update analogue of the reference's updater
+    output histograms.
+    """
+
+    def __init__(self, storage, session_id: Optional[str] = None,
+                 worker_id: str = "worker_0", frequency: int = 1,
+                 collect_histograms: bool = True, collect_memory: bool = True):
+        self.storage = storage
+        self.session_id = session_id or f"session_{int(time.time() * 1000)}"
+        self.worker_id = worker_id
+        self.frequency = max(1, frequency)
+        self.collect_histograms = collect_histograms
+        self.collect_memory = collect_memory
+        self._last_params: Optional[Dict[str, Any]] = None
+        self._last_time: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        now = time.time()
+        iter_ms = (now - self._last_time) * 1000.0 if self._last_time else 0.0
+        self._last_time = now
+        if iteration % self.frequency != 0:
+            return
+        flat = _flatten_params(model.params)
+        param_stats, update_stats = {}, {}
+        for name, arr in flat.items():
+            if not hasattr(arr, "reshape") or np.size(arr) == 0:
+                continue
+            param_stats[name] = array_stats(arr)
+            if not self.collect_histograms:
+                param_stats[name].pop("hist", None)
+            if self._last_params is not None and name in self._last_params:
+                delta = jnp.asarray(arr) - jnp.asarray(self._last_params[name])
+                update_stats[name] = array_stats(delta)
+                if not self.collect_histograms:
+                    update_stats[name].pop("hist", None)
+        # host copies: the jitted train step donates param buffers, so device
+        # references kept across iterations would be reading deleted arrays
+        self._last_params = {n: np.asarray(a) for n, a in flat.items()}
+        report = StatsReport(
+            session_id=self.session_id, worker_id=self.worker_id,
+            iteration=iteration, epoch=epoch, timestamp=now,
+            score=float(model.get_score()), iter_time_ms=iter_ms,
+            param_stats=param_stats, update_stats=update_stats,
+            memory=_memory_info() if self.collect_memory else {})
+        self.storage.put_record(report)

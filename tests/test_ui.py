@@ -1,0 +1,119 @@
+"""Stats/UI pipeline tests (reference test model: ``deeplearning4j-core``
+``ui/`` tests posting into ``InMemoryStatsStorage`` — no browser needed)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteUIStatsStorageRouter, StatsListener,
+                                   StatsReport, UIServer, array_stats)
+
+
+def _train_with(storage, epochs=3, session_id="s1"):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, session_id=session_id))
+    it = IrisDataSetIterator(batch_size=50)
+    for _ in range(epochs):
+        it.reset()
+        net.fit(it)
+    return net
+
+
+def test_array_stats_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((40, 7)).astype(np.float32)
+    s = array_stats(x)
+    assert s["mean"] == pytest.approx(float(x.mean()), abs=1e-5)
+    assert s["std"] == pytest.approx(float(x.std()), abs=1e-5)
+    assert s["norm2"] == pytest.approx(float(np.linalg.norm(x)), rel=1e-5)
+    assert sum(s["hist"]) == x.size
+    assert len(s["hist"]) == 20
+
+
+def test_stats_listener_collects():
+    storage = InMemoryStatsStorage()
+    _train_with(storage)
+    assert storage.list_session_ids() == ["s1"]
+    recs = storage.get_records("s1")
+    assert len(recs) == 9  # 3 epochs x 3 batches of 50
+    r = recs[-1]
+    assert np.isfinite(r.score)
+    assert "layer_0/W" in r.param_stats
+    assert "layer_0/W" in r.update_stats  # deltas from 2nd record on
+    # params actually moved
+    assert r.update_stats["layer_0/W"]["norm2"] > 0
+
+
+def test_file_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.bin")
+    storage = FileStatsStorage(path)
+    _train_with(storage, epochs=2, session_id="file_sess")
+    storage.close()
+    reopened = FileStatsStorage(path)
+    recs = reopened.get_records("file_sess")
+    assert len(recs) == 6
+    assert recs[0].param_stats["layer_0/W"]["hist"]
+    reopened.close()
+
+
+def test_ui_server_endpoints():
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0).start()
+    server.attach(storage)
+    try:
+        _train_with(storage, epochs=2, session_id="ui_sess")
+        base = f"http://127.0.0.1:{server.port}"
+        sessions = json.load(urllib.request.urlopen(f"{base}/train/sessions"))
+        assert sessions == ["ui_sess"]
+        o = json.load(urllib.request.urlopen(f"{base}/train/ui_sess/overview"))
+        assert len(o["scores"]) == 6
+        assert "layer_0/W" in o["param_norms"]
+        m = json.load(urllib.request.urlopen(f"{base}/train/ui_sess/model"))
+        assert m["iteration"] == o["iterations"][-1]
+        html = urllib.request.urlopen(base).read().decode()
+        assert "dl4j-tpu training" in html
+    finally:
+        server.stop()
+
+
+def test_remote_router_posts_to_server():
+    server = UIServer(port=0).start()
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+        report = StatsReport(session_id="remote_s", worker_id="w0",
+                             iteration=1, epoch=0, timestamp=0.0, score=1.5,
+                             iter_time_ms=10.0)
+        router.put_record(report)
+        recs = server.storage.get_records("remote_s")
+        assert len(recs) == 1 and recs[0].score == 1.5
+    finally:
+        server.stop()
+
+
+def test_remote_rejects_malformed():
+    server = UIServer(port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/remote", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
