@@ -65,9 +65,9 @@ class BruteForceNN:
         self.metric = metric
 
     def query(self, queries, k: int = 1) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (distances [Q,k], indices [Q,k])."""
+        """Returns (distances [Q,k], indices [Q,k]); k is clamped to N."""
         queries = jnp.atleast_2d(jnp.asarray(queries))
-        d, i = _knn(queries, self.points, k, self.metric)
+        d, i = _knn(queries, self.points, min(k, len(self.points)), self.metric)
         return np.asarray(d), np.asarray(i)
 
 

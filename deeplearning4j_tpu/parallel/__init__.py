@@ -1,12 +1,14 @@
 """Parallelism: mesh construction, DP/TP wrapper, GPipe pipeline,
 ring/Ulysses sequence parallelism (reference ``deeplearning4j-scaleout``)."""
+from .inference import InferenceMode, ParallelInference
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh, shard_batch
 from .pipeline import gpipe, stack_stage_params
 from .sequence import ring_self_attention, ulysses_attention
 from .wrapper import ParallelWrapper, megatron_dense_rule
 
 __all__ = [
-    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "ParallelWrapper", "gpipe",
-    "make_mesh", "megatron_dense_rule", "ring_self_attention", "shard_batch",
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "InferenceMode",
+    "ParallelInference", "ParallelWrapper", "gpipe", "make_mesh",
+    "megatron_dense_rule", "ring_self_attention", "shard_batch",
     "stack_stage_params", "ulysses_attention",
 ]

@@ -1,0 +1,143 @@
+"""Parallel inference (reference
+``deeplearning4j-scaleout/.../parallelism/ParallelInference.java:32`` +
+``inference/observers/BatchedInferenceObservable.java``).
+
+TPU-first rethink: the reference spawns N model replicas on N GPUs and
+round-robins requests; on TPU one jitted forward already saturates the chip,
+and replication is a mesh axis, not threads.  What survives is the *dynamic
+batching* idea — XLA compiles per shape, so serving variable singleton
+requests is bucketed into padded batches (compile-once buckets) and executed
+on a single dispatcher thread; caller threads block on futures.
+
+Modes (reference ``InferenceMode``):
+  INPLACE   — caller-thread synchronous forward (no queueing)
+  BATCHED   — requests queue; dispatcher coalesces up to ``max_batch_size``
+              items (waiting ``nano_wait``s for stragglers), pads to the
+              bucket size, runs ONE forward, scatters results
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ParallelInference", "InferenceMode"]
+
+
+class InferenceMode:
+    INPLACE = "INPLACE"
+    BATCHED = "BATCHED"
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class ParallelInference:
+    """Thread-safe inference front-end over one model.
+
+    ``output(x)`` accepts a single example ``[features...]`` or a batch
+    ``[n, features...]`` and returns the model output; in BATCHED mode
+    concurrent callers are coalesced into one padded device batch.
+    """
+
+    def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
+                 max_batch_size: int = 32, queue_limit: int = 256,
+                 nano_wait: float = 0.002,
+                 batch_buckets: Optional[Sequence[int]] = None):
+        self.model = model
+        self.mode = inference_mode
+        self.max_batch_size = max_batch_size
+        self.nano_wait = nano_wait
+        buckets = list(batch_buckets) if batch_buckets else [
+            b for b in (1, 2, 4, 8, 16, 32, 64, 128) if b < max_batch_size]
+        if max_batch_size not in buckets:
+            buckets.append(max_batch_size)  # top bucket must cover full batch
+        self.buckets = sorted(buckets)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        if self.mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True)
+            self._worker.start()
+
+    # ------------------------------------------------------------------ API
+    def output(self, x) -> np.ndarray:
+        x = np.asarray(x)
+        single = x.ndim == self._feature_ndim()
+        if self.mode == InferenceMode.INPLACE or self._shutdown.is_set():
+            out = np.asarray(self.model.output(x[None] if single else x))
+            return out[0] if single else out
+        batch = x[None] if single else x
+        futures = [self._submit(batch[i]) for i in range(len(batch))]
+        results = np.stack([f.result() for f in futures])
+        return results[0] if single else results
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._worker is not None:
+            self._queue.put(None)  # wake dispatcher
+            self._worker.join(timeout=5)
+        # fail any future still enqueued so its caller unblocks
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[1].set_exception(RuntimeError("ParallelInference shut down"))
+
+    # ------------------------------------------------------------ internals
+    def _feature_ndim(self) -> int:
+        try:
+            return len(self.model.conf.input_type.shape(-1)) - 1  # sans batch
+        except Exception:
+            return 1
+
+    def _submit(self, example: np.ndarray) -> Future:
+        f: Future = Future()
+        self._queue.put((example, f))
+        return f
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                continue
+            pending: List = [item]
+            # coalesce stragglers up to max batch
+            time.sleep(self.nano_wait)
+            while len(pending) < self.max_batch_size:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is not None:
+                    pending.append(nxt)
+            try:  # any failure (incl. ragged shapes) must not kill the loop
+                examples = np.stack([ex for ex, _ in pending])
+                n = len(examples)
+                b = _bucket(n, self.buckets)
+                if b > n:  # pad to bucket so XLA reuses the compiled executable
+                    pad = np.repeat(examples[-1:], b - n, axis=0)
+                    batch = np.concatenate([examples, pad])
+                else:
+                    batch = examples
+                out = np.asarray(self.model.output(batch))[:n]
+                for (_, fut), row in zip(pending, out):
+                    fut.set_result(row)
+            except Exception as e:
+                for _, fut in pending:
+                    if not fut.done():
+                        fut.set_exception(e)

@@ -1,0 +1,7 @@
+"""Serving tier (reference layer 9: nearest-neighbors REST server, streaming
+predict routes)."""
+from .inference_server import InferenceClient, InferenceServer
+from .nn_server import NearestNeighborsClient, NearestNeighborsServer
+
+__all__ = ["NearestNeighborsServer", "NearestNeighborsClient",
+           "InferenceServer", "InferenceClient"]

@@ -1,0 +1,133 @@
+"""Nearest-neighbors REST server + client (reference
+``deeplearning4j-nearestneighbor-server/.../NearestNeighborsServer.java:44``
+and ``client/NearestNeighborsClient.java``).
+
+stdlib ``http.server`` replaces the Play stack.  Index tier is pluggable:
+``BruteForceNN`` (device distance-matmul — the TPU-native default) or
+``VPTree`` (host metric tree, the reference's structure).
+
+Endpoints (reference routes):
+  POST /knn     {"ndarray": [...], "k": n}          query by raw vector
+  POST /knnindex {"index": i, "k": n}               query by stored row index
+  GET  /health
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.request import Request, urlopen
+
+import numpy as np
+
+from ..clustering.neighbors import BruteForceNN, VPTree
+
+__all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]
+
+
+class _NNHandler(BaseHTTPRequestHandler):
+    server_ref = None  # type: NearestNeighborsServer
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code=200):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        if self.path.rstrip("/") == "/health":
+            return self._json({"status": "ok",
+                               "points": len(self.server_ref.points)})
+        return self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            body = json.loads(self.rfile.read(n))
+        except Exception as e:
+            return self._json({"error": f"bad json: {e}"}, 400)
+        srv = self.server_ref
+        k = int(body.get("k", 1))
+        route = self.path.rstrip("/")
+        try:
+            if route == "/knn":
+                vec = np.asarray(body["ndarray"], dtype=np.float32)
+                dist, idx = srv.query(vec, k)
+            elif route == "/knnindex":
+                i = int(body["index"])
+                if not 0 <= i < len(srv.points):
+                    return self._json({"error": f"index {i} out of range"}, 400)
+                # k+1 then drop self (reference knn-by-index semantics)
+                dist, idx = srv.query(srv.points[i], k + 1)
+                keep = idx != i
+                dist, idx = dist[keep][:k], idx[keep][:k]
+            else:
+                return self._json({"error": "not found"}, 404)
+        except KeyError as e:
+            return self._json({"error": f"missing field {e}"}, 400)
+        except Exception as e:  # ragged vectors, k > N, ... -> client error
+            return self._json({"error": str(e)}, 400)
+        return self._json({"results": [
+            {"index": int(i), "distance": float(d)}
+            for d, i in zip(dist, idx)]})
+
+
+class NearestNeighborsServer:
+    """Serve kNN over a points matrix [N,D]."""
+
+    def __init__(self, points, port: int = 0, index: str = "brute",
+                 metric: str = "euclidean"):
+        self.points = np.asarray(points, dtype=np.float32)
+        if index == "brute":
+            self._index = BruteForceNN(self.points, metric=metric)
+            self.query = lambda v, k: tuple(
+                a[0] for a in self._index.query(v[None], k))
+        elif index == "vptree":
+            self._index = VPTree(self.points, metric=metric)
+            self.query = lambda v, k: self._index.query(v, k)
+        else:
+            raise ValueError(f"unknown index '{index}' (brute|vptree)")
+        handler = type("BoundNNHandler", (_NNHandler,), {"server_ref": self})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "NearestNeighborsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class NearestNeighborsClient:
+    """HTTP client (reference ``NearestNeighborsClient.java``)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, route: str, body: dict) -> dict:
+        req = Request(self.url + route, data=json.dumps(body).encode(),
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def knn(self, vector, k: int = 1) -> list:
+        return self._post("/knn", {"ndarray": np.asarray(vector).tolist(),
+                                   "k": k})["results"]
+
+    def knn_by_index(self, index: int, k: int = 1) -> list:
+        return self._post("/knnindex", {"index": index, "k": k})["results"]

@@ -1,0 +1,128 @@
+"""ParallelInference + serving-tier tests (reference test model:
+``parallelism/ParallelInferenceTest.java`` and the nearestneighbor-server
+suite)."""
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import BruteForceNN
+from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import InferenceMode, ParallelInference
+from deeplearning4j_tpu.serving import (InferenceClient, InferenceServer,
+                                        NearestNeighborsClient,
+                                        NearestNeighborsServer)
+
+
+def _iris_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(batch_size=50)
+    for _ in range(20):
+        it.reset()
+        net.fit(it)
+    return net
+
+
+@pytest.fixture(scope="module")
+def iris_net():
+    return _iris_net()
+
+
+class TestParallelInference:
+    def test_inplace_matches_model(self, iris_net):
+        pi = ParallelInference(iris_net, InferenceMode.INPLACE)
+        x = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+        np.testing.assert_allclose(pi.output(x), np.asarray(iris_net.output(x)),
+                                   rtol=1e-6)
+
+    def test_batched_matches_model(self, iris_net):
+        pi = ParallelInference(iris_net, InferenceMode.BATCHED,
+                               max_batch_size=8)
+        x = np.random.default_rng(1).standard_normal((6, 4)).astype(np.float32)
+        try:
+            out = pi.output(x)
+            np.testing.assert_allclose(out, np.asarray(iris_net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            # single-example shape convention
+            single = pi.output(x[0])
+            assert single.shape == (3,)
+        finally:
+            pi.shutdown()
+
+    def test_batched_concurrent_callers(self, iris_net):
+        pi = ParallelInference(iris_net, InferenceMode.BATCHED,
+                               max_batch_size=16)
+        x = np.random.default_rng(2).standard_normal((32, 4)).astype(np.float32)
+        expected = np.asarray(iris_net.output(x))
+        results = {}
+
+        def call(i):
+            results[i] = pi.output(x[i])
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(32)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            for i in range(32):
+                np.testing.assert_allclose(results[i], expected[i],
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            pi.shutdown()
+
+
+class TestNearestNeighborsServer:
+    @pytest.mark.parametrize("index", ["brute", "vptree"])
+    def test_knn_routes(self, index):
+        rng = np.random.default_rng(3)
+        pts = rng.standard_normal((50, 4)).astype(np.float32)
+        server = NearestNeighborsServer(pts, index=index).start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{server.port}")
+            res = client.knn(pts[7], k=3)
+            assert res[0]["index"] == 7 and res[0]["distance"] < 1e-5
+            _, expect = BruteForceNN(pts).query(pts[7:8], k=3)
+            assert {r["index"] for r in res} == set(int(i) for i in expect[0])
+            res_i = client.knn_by_index(7, k=3)
+            assert all(r["index"] != 7 for r in res_i)
+        finally:
+            server.stop()
+
+    def test_bad_requests(self):
+        pts = np.zeros((5, 2), dtype=np.float32)
+        server = NearestNeighborsServer(pts).start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{server.port}")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.knn_by_index(99, k=1)
+            assert ei.value.code == 400
+        finally:
+            server.stop()
+
+
+class TestInferenceServer:
+    def test_predict_roundtrip(self, iris_net):
+        server = InferenceServer(iris_net).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}")
+            x = np.random.default_rng(4).standard_normal((4, 4)).astype(np.float32)
+            out = client.predict(x)
+            np.testing.assert_allclose(out, np.asarray(iris_net.output(x)),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            server.stop()
