@@ -1,14 +1,27 @@
 """Parallelism: mesh construction, DP/TP wrapper, GPipe pipeline,
 ring/Ulysses sequence parallelism (reference ``deeplearning4j-scaleout``)."""
+from .accumulation import (EncodedGradientsAccumulator, EncodingHandler,
+                           bitmap_decode, bitmap_encode, threshold_decode,
+                           threshold_encode)
+from .distributed import (ElasticTrainer, global_device_mesh,
+                          initialize_distributed)
 from .inference import InferenceMode, ParallelInference
+from .master import (ParameterAveragingTrainingMaster,
+                     SharedGradientsTrainingMaster, TrainingMaster,
+                     tree_average)
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh, shard_batch
 from .pipeline import gpipe, stack_stage_params
 from .sequence import ring_self_attention, ulysses_attention
 from .wrapper import ParallelWrapper, megatron_dense_rule
 
 __all__ = [
-    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "InferenceMode",
-    "ParallelInference", "ParallelWrapper", "gpipe", "make_mesh",
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "ElasticTrainer",
+    "EncodedGradientsAccumulator", "EncodingHandler", "InferenceMode",
+    "ParallelInference", "ParallelWrapper",
+    "ParameterAveragingTrainingMaster", "SharedGradientsTrainingMaster",
+    "TrainingMaster", "bitmap_decode", "bitmap_encode",
+    "global_device_mesh", "gpipe", "initialize_distributed", "make_mesh",
     "megatron_dense_rule", "ring_self_attention", "shard_batch",
-    "stack_stage_params", "ulysses_attention",
+    "stack_stage_params", "threshold_decode", "threshold_encode",
+    "tree_average", "ulysses_attention",
 ]

@@ -1,0 +1,205 @@
+"""Quantized gradient sharing (reference
+``optimize/solvers/accumulation/``: ``GradientsAccumulator.java:12``,
+``EncodedGradientsAccumulator.java``, ``EncodingHandler.java:138-180`` —
+threshold/bitmap encoding with residual carry and adaptive threshold, and
+``FancyBlockingQueue.java`` multi-consumer broadcast).
+
+TPU-first framing: *within* a slice, dense all-reduce over ICI is strictly
+better than quantization — that path is ``ParallelWrapper``/``pjit`` and no
+accumulator is involved.  This module serves the reference's asynchronous
+role across the *DCN* boundary (multi-slice / multi-host gossip), where
+bandwidth is scarce and 1-bit-style compression pays.  Encode/decode are
+jitted device ops (the reference runs them as native libnd4j kernels).
+
+Encoding semantics (mirrors ``Nd4j.getExecutioner().thresholdEncode``):
+values with ``|g| >= t`` are transmitted as ``sign * t``; the remainder —
+including the clipped excess ``g - sign*t`` of transmitted values — stays in
+the sender's residual and re-accumulates into later rounds, so nothing is
+ever lost (just delayed).
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["threshold_encode", "threshold_decode", "bitmap_encode",
+           "bitmap_decode", "EncodingHandler", "EncodedGradientsAccumulator"]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _threshold_encode_flat(flat, threshold, k: int):
+    """Top-k thresholded sparsification.  Returns (idx[k], signs[k], count,
+    residual).  Entries beyond ``count`` are padding (idx == -1)."""
+    mags = jnp.abs(flat)
+    over = mags >= threshold
+    count = jnp.sum(over.astype(jnp.int32))
+    # rank by magnitude so a too-small k keeps the largest entries
+    vals, idx = jax.lax.top_k(jnp.where(over, mags, -1.0), k)
+    valid = vals > 0
+    take = jnp.minimum(count, k)
+    idx = jnp.where(valid, idx, -1)
+    signs = jnp.where(valid, jnp.sign(flat[jnp.where(idx >= 0, idx, 0)]), 0.0)
+    # residual: subtract the transmitted ±t at transmitted positions
+    delta = jnp.zeros_like(flat).at[jnp.where(idx >= 0, idx, 0)].add(
+        jnp.where(valid, signs * threshold, 0.0))
+    return idx, signs.astype(jnp.int8), take, flat - delta
+
+
+def threshold_encode(flat, threshold: float, max_elements: Optional[int] = None
+                     ) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    """Encode a flat float vector; returns (message, residual)."""
+    flat = jnp.asarray(flat)
+    k = int(max_elements or max(1, flat.size // 16))
+    idx, signs, count, residual = _threshold_encode_flat(
+        flat, jnp.asarray(threshold, flat.dtype), k)
+    n = int(count)
+    msg = {"kind": "threshold", "size": int(flat.size),
+           "threshold": float(threshold),
+           "idx": np.asarray(idx)[:n], "signs": np.asarray(signs)[:n]}
+    return msg, residual
+
+
+def threshold_decode(msg: Dict[str, Any]) -> jnp.ndarray:
+    out = np.zeros(msg["size"], np.float32)
+    out[msg["idx"]] = msg["signs"].astype(np.float32) * msg["threshold"]
+    return jnp.asarray(out)
+
+
+@jax.jit
+def _bitmap_encode_flat(flat, threshold):
+    """2-bit dense codes (0 none, 1 +t, 2 -t) packed 4/byte."""
+    codes = jnp.where(flat >= threshold, 1,
+                      jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint8)
+    residual = flat - jnp.where(codes == 1, threshold,
+                                jnp.where(codes == 2, -threshold, 0.0))
+    pad = (-codes.size) % 4
+    padded = jnp.concatenate([codes, jnp.zeros((pad,), jnp.uint8)])
+    quads = padded.reshape(-1, 4)
+    packed = (quads[:, 0] | (quads[:, 1] << 2) | (quads[:, 2] << 4)
+              | (quads[:, 3] << 6))
+    return packed, residual
+
+
+def bitmap_encode(flat, threshold: float) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    flat = jnp.asarray(flat)
+    packed, residual = _bitmap_encode_flat(
+        flat, jnp.asarray(threshold, flat.dtype))
+    return ({"kind": "bitmap", "size": int(flat.size),
+             "threshold": float(threshold), "packed": np.asarray(packed)},
+            residual)
+
+
+def bitmap_decode(msg: Dict[str, Any]) -> jnp.ndarray:
+    packed = msg["packed"]
+    quads = np.stack([(packed >> s) & 0x3 for s in (0, 2, 4, 6)], axis=1)
+    codes = quads.reshape(-1)[:msg["size"]]
+    t = msg["threshold"]
+    return jnp.asarray(np.where(codes == 1, t,
+                                np.where(codes == 2, -t, 0.0)).astype(np.float32))
+
+
+def decode(msg: Dict[str, Any]) -> jnp.ndarray:
+    return (threshold_decode if msg["kind"] == "threshold"
+            else bitmap_decode)(msg)
+
+
+class EncodingHandler:
+    """Adaptive-threshold encoder with residual carry (reference
+    ``EncodingHandler.java``: threshold selection + decay, and the
+    threshold-vs-bitmap switch at 1/16 density).
+
+    One handler per worker; ``encode_update`` takes the worker's raw gradient
+    pytree-flattened vector, adds the residual, and emits a message.
+    """
+
+    DENSITY_SWITCH = 1.0 / 16.0  # bitmap cheaper above this (2 bits/elem)
+
+    def __init__(self, initial_threshold: float = 1e-3,
+                 min_threshold: float = 1e-9, decay: float = 0.95,
+                 boost: float = 1.2, target_density: float = 1e-2):
+        self.threshold = initial_threshold
+        self.min_threshold = min_threshold
+        self.decay = decay
+        self.boost = boost
+        self.target_density = target_density
+        self.residual: Optional[jnp.ndarray] = None
+        self.last_density = 0.0
+
+    def encode_update(self, flat_grad) -> Dict[str, Any]:
+        flat = jnp.asarray(flat_grad)
+        if self.residual is not None:
+            flat = flat + self.residual
+        density = float(jnp.mean((jnp.abs(flat) >= self.threshold)
+                                 .astype(jnp.float32)))
+        self.last_density = density
+        if density > self.DENSITY_SWITCH:
+            msg, self.residual = bitmap_encode(flat, self.threshold)
+        else:
+            msg, self.residual = threshold_encode(flat, self.threshold)
+        # adapt: too sparse -> decay threshold; too dense -> boost
+        if density < self.target_density / 10.0:
+            self.threshold = max(self.threshold * self.decay,
+                                 self.min_threshold)
+        elif density > self.target_density * 10.0:
+            self.threshold *= self.boost
+        return msg
+
+
+class EncodedGradientsAccumulator:
+    """Decentralized multi-worker update exchange (reference
+    ``EncodedGradientsAccumulator.java`` + ``FancyBlockingQueue``): each
+    worker ``store_update``s its encoded gradient, which fans out to every
+    *other* worker's queue; workers drain with ``apply_updates`` before their
+    next local step.  No master, no barrier — stale updates are applied late,
+    residuals guarantee eventual delivery.
+    """
+
+    def __init__(self, n_workers: int, handler_factory=EncodingHandler,
+                 queue_limit: int = 64):
+        self.n_workers = n_workers
+        self.handlers = [handler_factory() for _ in range(n_workers)]
+        self.queues: List["queue.Queue"] = [queue.Queue(maxsize=queue_limit)
+                                            for _ in range(n_workers)]
+        self._lock = threading.Lock()
+        self.messages_sent = 0
+        self.bytes_sent = 0
+
+    @staticmethod
+    def _msg_bytes(msg: Dict[str, Any]) -> int:
+        if msg["kind"] == "threshold":
+            return msg["idx"].nbytes + msg["signs"].nbytes + 16
+        return msg["packed"].nbytes + 16
+
+    def store_update(self, worker_id: int, flat_grad) -> Dict[str, Any]:
+        """Encode this worker's gradient and broadcast to peers."""
+        msg = self.handlers[worker_id].encode_update(flat_grad)
+        with self._lock:
+            self.messages_sent += 1
+            self.bytes_sent += self._msg_bytes(msg)
+        for w in range(self.n_workers):
+            if w != worker_id:
+                self.queues[w].put(msg)
+        return msg
+
+    def apply_updates(self, worker_id: int, flat_params) -> jnp.ndarray:
+        """Drain this worker's queue; returns params + sum(decoded peers)."""
+        total = None
+        while True:
+            try:
+                msg = self.queues[worker_id].get_nowait()
+            except queue.Empty:
+                break
+            dec = decode(msg)
+            total = dec if total is None else total + dec
+        if total is None:
+            return jnp.asarray(flat_params)
+        return jnp.asarray(flat_params) + total
+
+    def has_anything(self, worker_id: int) -> bool:
+        return not self.queues[worker_id].empty()
