@@ -63,6 +63,7 @@ class ParallelInference:
         self.buckets = sorted(buckets)
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
+        self._submit_lock = threading.Lock()  # orders submits vs shutdown
         self._worker: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._dispatch_loop,
@@ -73,16 +74,21 @@ class ParallelInference:
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
         single = x.ndim == self._feature_ndim()
-        if self.mode == InferenceMode.INPLACE or self._shutdown.is_set():
-            out = np.asarray(self.model.output(x[None] if single else x))
-            return out[0] if single else out
         batch = x[None] if single else x
+        expected = self._feature_shape()
+        if expected is not None and tuple(batch.shape[1:]) != expected:
+            raise ValueError(f"expected feature shape {expected}, "
+                             f"got {tuple(batch.shape[1:])}")
+        if self.mode == InferenceMode.INPLACE or self._shutdown.is_set():
+            out = np.asarray(self.model.output(batch))
+            return out[0] if single else out
         futures = [self._submit(batch[i]) for i in range(len(batch))]
         results = np.stack([f.result() for f in futures])
         return results[0] if single else results
 
     def shutdown(self) -> None:
-        self._shutdown.set()
+        with self._submit_lock:  # no submit can now slip past the drain below
+            self._shutdown.set()
         if self._worker is not None:
             self._queue.put(None)  # wake dispatcher
             self._worker.join(timeout=5)
@@ -96,15 +102,22 @@ class ParallelInference:
                 item[1].set_exception(RuntimeError("ParallelInference shut down"))
 
     # ------------------------------------------------------------ internals
-    def _feature_ndim(self) -> int:
+    def _feature_shape(self):
         try:
-            return len(self.model.conf.input_type.shape(-1)) - 1  # sans batch
+            return tuple(self.model.conf.input_type.shape(-1)[1:])
         except Exception:
-            return 1
+            return None
+
+    def _feature_ndim(self) -> int:
+        shape = self._feature_shape()
+        return len(shape) if shape is not None else 1
 
     def _submit(self, example: np.ndarray) -> Future:
         f: Future = Future()
-        self._queue.put((example, f))
+        with self._submit_lock:
+            if self._shutdown.is_set():
+                raise RuntimeError("ParallelInference shut down")
+            self._queue.put((example, f))
         return f
 
     def _dispatch_loop(self) -> None:
@@ -116,8 +129,10 @@ class ParallelInference:
             if item is None:
                 continue
             pending: List = [item]
-            # coalesce stragglers up to max batch
-            time.sleep(self.nano_wait)
+            # coalesce stragglers up to max batch; skip the wait when a full
+            # batch is already queued (saturated server shouldn't pay latency)
+            if self._queue.qsize() < self.max_batch_size - 1 and self.nano_wait:
+                time.sleep(self.nano_wait)
             while len(pending) < self.max_batch_size:
                 try:
                     nxt = self._queue.get_nowait()
@@ -125,19 +140,29 @@ class ParallelInference:
                     break
                 if nxt is not None:
                     pending.append(nxt)
-            try:  # any failure (incl. ragged shapes) must not kill the loop
-                examples = np.stack([ex for ex, _ in pending])
-                n = len(examples)
-                b = _bucket(n, self.buckets)
-                if b > n:  # pad to bucket so XLA reuses the compiled executable
-                    pad = np.repeat(examples[-1:], b - n, axis=0)
-                    batch = np.concatenate([examples, pad])
-                else:
-                    batch = examples
-                out = np.asarray(self.model.output(batch))[:n]
-                for (_, fut), row in zip(pending, out):
-                    fut.set_result(row)
-            except Exception as e:
-                for _, fut in pending:
-                    if not fut.done():
-                        fut.set_exception(e)
+            # group by feature shape: one malformed request must not fail the
+            # innocent ones coalesced with it (shapes differ only when the
+            # model exposes no input_type for up-front validation)
+            groups: dict = {}
+            for ex, fut in pending:
+                groups.setdefault(tuple(np.shape(ex)), []).append((ex, fut))
+            for group in groups.values():
+                self._run_batch(group)
+
+    def _run_batch(self, pending: List) -> None:
+        try:  # any failure must not kill the dispatch loop
+            examples = np.stack([ex for ex, _ in pending])
+            n = len(examples)
+            b = _bucket(n, self.buckets)
+            if b > n:  # pad to bucket so XLA reuses the compiled executable
+                pad = np.repeat(examples[-1:], b - n, axis=0)
+                batch = np.concatenate([examples, pad])
+            else:
+                batch = examples
+            out = np.asarray(self.model.output(batch))[:n]
+            for (_, fut), row in zip(pending, out):
+                fut.set_result(row)
+        except Exception as e:
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,71 @@
+"""Shared HTTP plumbing for the serving tier: JSON request/response handler
+base, background-thread server lifecycle, and a JSON POST client."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.request import Request, urlopen
+
+__all__ = ["JsonHandler", "BackgroundHttpServer", "JsonClient"]
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST."""
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code: int = 200):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _read_json(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n))
+
+
+class BackgroundHttpServer:
+    """Owns a ThreadingHTTPServer on a daemon thread; binds the given handler
+    class with extra attributes (the per-instance state the handler needs)."""
+
+    def __init__(self, handler_base, port: int = 0, **handler_attrs):
+        handler = type(f"Bound{handler_base.__name__}", (handler_base,),
+                       dict(handler_attrs))
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "BackgroundHttpServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class JsonClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def post(self, route: str, body: dict) -> dict:
+        req = Request(self.url + route, data=json.dumps(body).encode(),
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def get(self, route: str) -> dict:
+        with urlopen(self.url + route, timeout=self.timeout) as resp:
+            return json.loads(resp.read())

@@ -8,32 +8,16 @@ Endpoints:
 """
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-from urllib.request import Request, urlopen
-
 import numpy as np
 
 from ..parallel.inference import InferenceMode, ParallelInference
+from ._http import BackgroundHttpServer, JsonClient, JsonHandler
 
 __all__ = ["InferenceServer", "InferenceClient"]
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _PredictHandler(JsonHandler):
     server_ref = None
-
-    def log_message(self, *a):
-        pass
-
-    def _json(self, obj, code=200):
-        payload = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
 
     def do_GET(self):
         if self.path.rstrip("/") == "/health":
@@ -43,14 +27,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path.rstrip("/") != "/predict":
             return self._json({"error": "not found"}, 404)
-        n = int(self.headers.get("Content-Length", 0))
         try:
-            body = json.loads(self.rfile.read(n))
-            x = np.asarray(body["data"], dtype=np.float32)
+            x = np.asarray(self._read_json()["data"], dtype=np.float32)
         except Exception as e:
             return self._json({"error": str(e)}, 400)
         try:
             out = self.server_ref.inference.output(x)
+        except ValueError as e:  # shape rejection -> client error
+            return self._json({"error": str(e)}, 400)
         except Exception as e:
             return self._json({"error": str(e)}, 500)
         return self._json({"output": np.asarray(out).tolist()})
@@ -62,36 +46,23 @@ class InferenceServer:
                  max_batch_size: int = 32):
         self.inference = ParallelInference(model, inference_mode,
                                            max_batch_size=max_batch_size)
-        handler = type("BoundPredictHandler", (_Handler,),
-                       {"server_ref": self})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
-        self._thread: Optional[threading.Thread] = None
+        self._server = BackgroundHttpServer(_PredictHandler, port,
+                                            server_ref=self)
 
     @property
     def port(self) -> int:
-        return self._httpd.server_address[1]
+        return self._server.port
 
     def start(self) -> "InferenceServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._server.start()
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.stop()
         self.inference.shutdown()
 
 
-class InferenceClient:
-    def __init__(self, url: str, timeout: float = 10.0):
-        self.url = url.rstrip("/")
-        self.timeout = timeout
-
+class InferenceClient(JsonClient):
     def predict(self, data) -> np.ndarray:
-        req = Request(self.url + "/predict",
-                      data=json.dumps(
-                          {"data": np.asarray(data).tolist()}).encode(),
-                      headers={"Content-Type": "application/json"})
-        with urlopen(req, timeout=self.timeout) as resp:
-            return np.asarray(json.loads(resp.read())["output"])
+        return np.asarray(self.post(
+            "/predict", {"data": np.asarray(data).tolist()})["output"])

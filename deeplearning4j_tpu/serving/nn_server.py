@@ -7,38 +7,22 @@ stdlib ``http.server`` replaces the Play stack.  Index tier is pluggable:
 ``VPTree`` (host metric tree, the reference's structure).
 
 Endpoints (reference routes):
-  POST /knn     {"ndarray": [...], "k": n}          query by raw vector
-  POST /knnindex {"index": i, "k": n}               query by stored row index
+  POST /knn      {"ndarray": [...], "k": n}          query by raw vector
+  POST /knnindex {"index": i, "k": n}                query by stored row index
   GET  /health
 """
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-from urllib.request import Request, urlopen
-
 import numpy as np
 
 from ..clustering.neighbors import BruteForceNN, VPTree
+from ._http import BackgroundHttpServer, JsonClient, JsonHandler
 
 __all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]
 
 
-class _NNHandler(BaseHTTPRequestHandler):
+class _NNHandler(JsonHandler):
     server_ref = None  # type: NearestNeighborsServer
-
-    def log_message(self, *a):
-        pass
-
-    def _json(self, obj, code=200):
-        payload = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
 
     def do_GET(self):
         if self.path.rstrip("/") == "/health":
@@ -47,9 +31,8 @@ class _NNHandler(BaseHTTPRequestHandler):
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
-        n = int(self.headers.get("Content-Length", 0))
         try:
-            body = json.loads(self.rfile.read(n))
+            body = self._read_json()
         except Exception as e:
             return self._json({"error": f"bad json: {e}"}, 400)
         srv = self.server_ref
@@ -93,41 +76,29 @@ class NearestNeighborsServer:
             self.query = lambda v, k: self._index.query(v, k)
         else:
             raise ValueError(f"unknown index '{index}' (brute|vptree)")
-        handler = type("BoundNNHandler", (_NNHandler,), {"server_ref": self})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
-        self._thread: Optional[threading.Thread] = None
+        self._server = BackgroundHttpServer(_NNHandler, port, server_ref=self)
 
     @property
     def port(self) -> int:
-        return self._httpd.server_address[1]
+        return self._server.port
 
     def start(self) -> "NearestNeighborsServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._server.start()
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.stop()
 
 
-class NearestNeighborsClient:
+class NearestNeighborsClient(JsonClient):
     """HTTP client (reference ``NearestNeighborsClient.java``)."""
 
     def __init__(self, url: str, timeout: float = 5.0):
-        self.url = url.rstrip("/")
-        self.timeout = timeout
-
-    def _post(self, route: str, body: dict) -> dict:
-        req = Request(self.url + route, data=json.dumps(body).encode(),
-                      headers={"Content-Type": "application/json"})
-        with urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        super().__init__(url, timeout)
 
     def knn(self, vector, k: int = 1) -> list:
-        return self._post("/knn", {"ndarray": np.asarray(vector).tolist(),
-                                   "k": k})["results"]
+        return self.post("/knn", {"ndarray": np.asarray(vector).tolist(),
+                                  "k": k})["results"]
 
     def knn_by_index(self, index: int, k: int = 1) -> list:
-        return self._post("/knnindex", {"index": index, "k": k})["results"]
+        return self.post("/knnindex", {"index": index, "k": k})["results"]
