@@ -1,0 +1,17 @@
+"""Evaluation suite (reference ``deeplearning4j-nn/.../eval/``): multi-class,
+binary multi-label, regression, ROC family, calibration, HTML export."""
+from .binary import EvaluationBinary
+from .calibration import (EvaluationCalibration, Histogram,
+                          ReliabilityDiagram)
+from .classification import ConfusionMatrix, Evaluation
+from .regression import RegressionEvaluation
+from .roc import ROC, PrecisionRecallCurve, ROCBinary, ROCMultiClass, RocCurve
+from .tools import (calibration_to_html, export_calibration_to_html,
+                    export_roc_charts_to_html, rocs_to_html)
+
+__all__ = ["Evaluation", "ConfusionMatrix", "EvaluationBinary",
+           "EvaluationCalibration", "Histogram", "ReliabilityDiagram",
+           "RegressionEvaluation", "ROC", "ROCBinary", "ROCMultiClass",
+           "RocCurve", "PrecisionRecallCurve", "rocs_to_html",
+           "calibration_to_html", "export_roc_charts_to_html",
+           "export_calibration_to_html"]

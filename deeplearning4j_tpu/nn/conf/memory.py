@@ -1,0 +1,123 @@
+"""Pre-training memory estimation (reference ``nn/conf/memory/``:
+``MemoryReport.java``, ``LayerMemoryReport.java``, ``NetworkMemoryReport.java``,
+``MemoryUseMode.java``).
+
+TPU framing: under jit there are no per-layer workspaces to model — the
+estimate covers the XLA-visible components: parameters, optimizer (updater)
+state, gradients (training), and per-layer activations, with the inference
+path assuming XLA's buffer reuse keeps only the widest two consecutive
+activations live.  Re-materialisation (``jax.checkpoint``) would shrink the
+training-activation term; the report states the un-remat ceiling.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .input_type import InputType
+
+__all__ = ["LayerMemoryReport", "NetworkMemoryReport", "MemoryUseMode"]
+
+
+class MemoryUseMode:
+    INFERENCE = "INFERENCE"
+    TRAINING = "TRAINING"
+
+
+def _elems(itype: InputType) -> int:
+    return int(np.prod([d for d in itype.shape(1)[1:]]))
+
+
+@dataclass
+class LayerMemoryReport:
+    """Per-layer estimate, in ELEMENTS (multiply by dtype width for bytes)."""
+    layer_name: str
+    layer_type: str
+    n_params: int
+    activation_elems_per_example: int
+    # updater state multiplier: sgd=0, momentum/rmsprop=1, adam=2 slots/param
+    updater_state_elems: int = 0
+
+    def total_training_elems(self, batch: int) -> int:
+        # params + grads + updater state + activations
+        return (self.n_params * 2 + self.updater_state_elems
+                + self.activation_elems_per_example * batch)
+
+    def total_inference_elems(self, batch: int) -> int:
+        return self.n_params + self.activation_elems_per_example * batch
+
+
+_UPDATER_SLOTS = {"Sgd": 0, "Nesterovs": 1, "Adam": 2, "AdamW": 2,
+                  "AdaMax": 2, "AdaGrad": 1, "AdaDelta": 2, "RmsProp": 1,
+                  "Nadam": 2, "AmsGrad": 3}
+
+
+@dataclass
+class NetworkMemoryReport:
+    """Whole-network roll-up (reference ``NetworkMemoryReport.java``)."""
+    layer_reports: List[LayerMemoryReport]
+    model_class: str
+    bytes_per_element: int = 4
+
+    @property
+    def total_params(self) -> int:
+        return sum(r.n_params for r in self.layer_reports)
+
+    def total_memory_bytes(self, batch: int,
+                           mode: str = MemoryUseMode.TRAINING) -> int:
+        if mode == MemoryUseMode.TRAINING:
+            elems = sum(r.total_training_elems(batch)
+                        for r in self.layer_reports)
+        else:
+            # params everywhere + the two widest consecutive activations
+            # (XLA reuses earlier buffers once consumed)
+            acts = [r.activation_elems_per_example for r in self.layer_reports]
+            peak_acts = max((acts[i] + acts[i + 1]
+                             for i in range(len(acts) - 1)),
+                            default=acts[0] if acts else 0)
+            elems = self.total_params + peak_acts * batch
+        return elems * self.bytes_per_element
+
+    def to_string(self, batch: int = 32) -> str:
+        lines = [f"Network memory report ({self.model_class}), "
+                 f"batch={batch}, {self.bytes_per_element}B/elem",
+                 f"{'layer':<24}{'type':<24}{'params':>12}{'act/ex':>12}"]
+        for r in self.layer_reports:
+            lines.append(f"{r.layer_name:<24}{r.layer_type:<24}"
+                         f"{r.n_params:>12}{r.activation_elems_per_example:>12}")
+        lines.append(f"total params: {self.total_params}")
+        for mode in (MemoryUseMode.INFERENCE, MemoryUseMode.TRAINING):
+            mb = self.total_memory_bytes(batch, mode) / 2**20
+            lines.append(f"estimated {mode.lower()} memory: {mb:.1f} MiB")
+        return "\n".join(lines)
+
+
+def _updater_slots(conf) -> int:
+    upd = conf.defaults.get("updater")
+    name = type(upd).__name__ if upd is not None else "Sgd"
+    return _UPDATER_SLOTS.get(name, 1)
+
+
+def memory_report(conf, model_class: str = "MultiLayerNetwork"
+                  ) -> NetworkMemoryReport:
+    """Build a report from a built MultiLayerConfiguration (needs
+    ``layer_input_types`` resolved — i.e. after ``.build()``)."""
+    if (not conf.layer_input_types
+            or any(t is None for t in conf.layer_input_types)):
+        raise ValueError("configuration has no resolved input types; "
+                         "build it with .set_input_type(...)")
+    slots = _updater_slots(conf)
+    reports = []
+    for i, layer in enumerate(conf.layers):
+        itype = conf.layer_input_types[i]
+        otype = layer.output_type(itype)
+        n_params = layer.n_params(itype) if layer.has_params() else 0
+        reports.append(LayerMemoryReport(
+            layer_name=layer.name or f"layer_{i}",
+            layer_type=type(layer).__name__,
+            n_params=n_params,
+            activation_elems_per_example=_elems(otype),
+            updater_state_elems=n_params * slots))
+    return NetworkMemoryReport(reports, model_class)

@@ -1,0 +1,189 @@
+"""Memory reports, ModelGuesser, and evaluation-extras tests (reference test
+model: ``eval/EvaluationBinaryTest``, ``eval/EvaluationCalibrationTest``,
+``nn/conf/memory`` usage, ``util/ModelGuesserTest``)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (ROC, EvaluationBinary,
+                                           EvaluationCalibration,
+                                           calibration_to_html, rocs_to_html)
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.memory import (MemoryUseMode, memory_report)
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.convolution import (ConvolutionLayer,
+                                                      SubsamplingLayer)
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils.model_guesser import (guess_format,
+                                                    load_model_guess)
+from deeplearning4j_tpu.utils.model_serializer import write_model
+
+
+class TestEvaluationBinary:
+    def test_counts_and_metrics(self):
+        labels = np.array([[1, 0], [1, 1], [0, 1], [0, 0]], dtype=float)
+        preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.9], [0.6, 0.1]])
+        ev = EvaluationBinary().eval(labels, preds)
+        # column 0: preds>=0.5 -> [1,1,0,1]; labels [1,1,0,0]
+        assert ev.tp[0] == 2 and ev.fp[0] == 1 and ev.tn[0] == 1 and ev.fn[0] == 0
+        # column 1: preds -> [0,0,1,0]; labels [0,1,1,0]
+        assert ev.tp[1] == 1 and ev.fn[1] == 1 and ev.tn[1] == 2
+        assert ev.precision(0) == pytest.approx(2 / 3)
+        assert ev.recall(0) == pytest.approx(1.0)
+        assert 0 < ev.average_f1() <= 1
+        assert "label_0" in ev.stats()
+
+    def test_per_label_thresholds_and_merge(self):
+        labels = np.array([[1], [0]], dtype=float)
+        preds = np.array([[0.4], [0.3]])
+        ev = EvaluationBinary(thresholds=[0.35]).eval(labels, preds)
+        assert ev.tp[0] == 1 and ev.tn[0] == 1
+        ev2 = EvaluationBinary(thresholds=[0.35]).eval(labels, preds)
+        ev.merge(ev2)
+        assert ev.tp[0] == 2
+
+    def test_2d_per_output_mask(self):
+        labels = np.array([[1, 0], [1, 1]], dtype=float)
+        preds = np.array([[0.9, 0.1], [0.8, 0.9]])
+        mask = np.array([[1, 0], [1, 1]], dtype=float)
+        ev = EvaluationBinary().eval(labels, preds, mask=mask)
+        assert list(ev.tp) == [2, 1]
+        assert ev.tp[0] + ev.fp[0] + ev.tn[0] + ev.fn[0] == 2
+        assert ev.tp[1] + ev.fp[1] + ev.tn[1] + ev.fn[1] == 1
+
+    def test_3d_per_output_mask(self):
+        labels = np.ones((1, 2, 2))
+        preds = np.full((1, 2, 2), 0.9)
+        mask = np.zeros((1, 2, 2))
+        mask[0, 0, 0] = 1  # only t=0, output 0 counts
+        ev = EvaluationBinary().eval(labels, preds, mask=mask)
+        assert list(ev.tp) == [1, 0]
+
+    def test_time_series_with_mask(self):
+        labels = np.zeros((2, 3, 1))
+        labels[0, 0, 0] = 1
+        preds = np.full((2, 3, 1), 0.9)
+        mask = np.array([[1, 1, 0], [0, 0, 0]], dtype=float)
+        ev = EvaluationBinary().eval(labels, preds, mask=mask)
+        assert ev.tp[0] + ev.fp[0] + ev.tn[0] + ev.fn[0] == 2  # only unmasked
+
+
+class TestCalibration:
+    def test_perfectly_calibrated(self):
+        rng = np.random.default_rng(0)
+        p = rng.uniform(0.05, 0.95, size=20000)
+        y = (rng.uniform(size=p.size) < p).astype(float)
+        # two-class softmax-style layout
+        labels = np.stack([1 - y, y], axis=1)
+        preds = np.stack([1 - p, p], axis=1)
+        cal = EvaluationCalibration(reliability_bins=10).eval(labels, preds)
+        assert cal.expected_calibration_error(1) < 0.03
+        d = cal.reliability_diagram(1)
+        ok = np.isfinite(d.fraction_positives)
+        np.testing.assert_allclose(d.mean_predicted_value[ok],
+                                   d.fraction_positives[ok], atol=0.1)
+
+    def test_overconfident_has_high_ece(self):
+        n = 4000
+        rng = np.random.default_rng(1)
+        p = np.full(n, 0.95)
+        y = (rng.uniform(size=n) < 0.6).astype(float)  # true rate 0.6
+        cal = EvaluationCalibration().eval(
+            np.stack([1 - y, y], 1), np.stack([1 - p, p], 1))
+        assert cal.expected_calibration_error(1) > 0.25
+
+    def test_histograms(self):
+        cal = EvaluationCalibration(histogram_bins=10)
+        cal.eval(np.array([[0, 1.0]]), np.array([[0.25, 0.75]]))
+        h = cal.probability_histogram(1)
+        assert h.bin_counts[7] == 1 and h.bin_counts.sum() == 1
+
+
+class TestHtmlExport:
+    def test_roc_and_calibration_html(self, tmp_path):
+        rng = np.random.default_rng(2)
+        y = rng.integers(0, 2, 500).astype(float)
+        p = np.clip(y * 0.6 + rng.uniform(size=500) * 0.4, 0, 1)
+        roc = ROC()
+        roc.eval(y.reshape(-1, 1), p.reshape(-1, 1))
+        html = rocs_to_html(roc)
+        assert "<svg" in html and "AUC=" in html
+        cal = EvaluationCalibration().eval(
+            np.stack([1 - y, y], 1), np.stack([1 - p, p], 1))
+        html2 = calibration_to_html(cal)
+        assert "Reliability" in html2 and "ECE=" in html2
+
+
+class TestMemoryReport:
+    def _conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(1).activation("relu").weight_init("xavier")
+                .updater(Adam(learning_rate=1e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=32))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+
+    def test_param_counts_match_model(self):
+        conf = self._conf()
+        report = memory_report(conf)
+        net = MultiLayerNetwork(conf).init()
+        assert report.total_params == net.num_params()
+
+    def test_training_exceeds_inference(self):
+        report = memory_report(self._conf())
+        tr = report.total_memory_bytes(32, MemoryUseMode.TRAINING)
+        inf = report.total_memory_bytes(32, MemoryUseMode.INFERENCE)
+        assert tr > inf > 0
+        s = report.to_string(32)
+        assert "total params" in s and "ConvolutionLayer" in s
+
+    def test_unbuilt_conf_raises(self):
+        from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+        with pytest.raises(ValueError, match="input types"):
+            memory_report(MultiLayerConfiguration())
+
+
+class TestModelGuesser:
+    def test_guesses_model_and_stats(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(learning_rate=1e-3)).list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        mpath = str(tmp_path / "m.zip")
+        write_model(net, mpath)
+        assert guess_format(mpath) == "multi_layer_network"
+        loaded = load_model_guess(mpath)
+        np.testing.assert_allclose(loaded.params_flat(), net.params_flat())
+        # stats log
+        from deeplearning4j_tpu.ui import FileStatsStorage
+        spath = str(tmp_path / "s.bin")
+        FileStatsStorage(spath).close()
+        assert guess_format(spath) == "stats_log"
+
+    def test_guesses_word_vectors(self, tmp_path):
+        path = str(tmp_path / "vec.txt")
+        with open(path, "w") as fh:
+            fh.write("2 3\nhello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+        assert guess_format(path) == "word_vectors"
+        wv = load_model_guess(path)
+        assert wv is not None
+
+    def test_unknown_raises(self, tmp_path):
+        p = str(tmp_path / "x.bin")
+        with open(p, "wb") as fh:
+            fh.write(b"\x00\x01\x02\x03garbage")
+        assert guess_format(p) == "unknown"
+        with pytest.raises(ValueError):
+            load_model_guess(p)
