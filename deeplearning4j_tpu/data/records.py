@@ -1,0 +1,209 @@
+"""Record readers + adapters (the DataVec bridge role: reference
+``datasets/datavec/RecordReaderDataSetIterator.java``,
+``SequenceRecordReaderDataSetIterator.java`` over DataVec's CSV readers).
+
+Record readers yield lists of float records; the iterators assemble them
+into DataSets (classification one-hot, regression passthrough, or sequence
+tensors with masking for ragged lengths).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from pathlib import Path
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet, DataSetIterator
+
+__all__ = ["CSVRecordReader", "CSVSequenceRecordReader",
+           "CollectionRecordReader", "RecordReaderDataSetIterator",
+           "SequenceRecordReaderDataSetIterator"]
+
+
+class RecordReader:
+    """Iterable of per-example records (list of floats)."""
+
+    def __iter__(self) -> Iterator[List[float]]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class CSVRecordReader(RecordReader):
+    """One record per CSV line (reference DataVec ``CSVRecordReader``)."""
+
+    def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ","):
+        self.path = path
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        with open(self.path, newline="") as fh:
+            reader = csv.reader(fh, delimiter=self.delimiter)
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [float(v) for v in row]
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference ``CollectionRecordReader``) — test tier."""
+
+    def __init__(self, records: Sequence[Sequence[float]]):
+        self.records = [list(map(float, r)) for r in records]
+
+    def __iter__(self):
+        return iter([list(r) for r in self.records])
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """One sequence per FILE in a directory (reference DataVec
+    ``CSVSequenceRecordReader``); yields [T, n_cols] float arrays."""
+
+    def __init__(self, directory: str, skip_lines: int = 0,
+                 delimiter: str = ",", glob: str = "*.csv"):
+        self.directory = directory
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.glob = glob
+
+    def __iter__(self):
+        for f in sorted(Path(self.directory).glob(self.glob)):
+            rows = []
+            with open(f, newline="") as fh:
+                for i, row in enumerate(csv.reader(fh, delimiter=self.delimiter)):
+                    if i < self.skip_lines or not row:
+                        continue
+                    rows.append([float(v) for v in row])
+            yield np.asarray(rows, dtype=np.float32)
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """records -> DataSets (reference
+    ``RecordReaderDataSetIterator.java``): ``label_index`` column becomes the
+    one-hot label (classification, ``n_classes`` set) or the regression
+    target range (``regression=True``, ``label_index_to`` inclusive)."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, n_classes: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_to: Optional[int] = None):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.n_classes = n_classes
+        self.regression = regression
+        self.label_index_to = label_index_to
+        if not regression and n_classes is None:
+            raise ValueError("classification needs n_classes "
+                             "(or pass regression=True)")
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def reset(self):
+        self.reader.reset()
+
+    def _split(self, rec: List[float]):
+        li = self.label_index if self.label_index >= 0 else len(rec) + self.label_index
+        if self.regression:
+            hi = (self.label_index_to if self.label_index_to is not None
+                  else li)
+            hi = hi if hi >= 0 else len(rec) + hi
+            label = rec[li:hi + 1]
+            feats = rec[:li] + rec[hi + 1:]
+        else:
+            label = [rec[li]]
+            feats = rec[:li] + rec[li + 1:]
+        return feats, label
+
+    def __iter__(self):
+        feats, labels = [], []
+        for rec in self.reader:
+            f, l = self._split(rec)
+            feats.append(f)
+            labels.append(l)
+            if len(feats) == self.batch_size:
+                yield self._make(feats, labels)
+                feats, labels = [], []
+        if feats:
+            yield self._make(feats, labels)
+
+    def _make(self, feats, labels):
+        x = np.asarray(feats, dtype=np.float32)
+        if self.regression:
+            y = np.asarray(labels, dtype=np.float32)
+        else:
+            idx = np.asarray(labels, dtype=np.int64).reshape(-1)
+            y = np.eye(self.n_classes, dtype=np.float32)[idx]
+        return DataSet(x, y)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Per-file sequences -> padded+masked RNN DataSets (reference
+    ``SequenceRecordReaderDataSetIterator`` ALIGN_END=False semantics:
+    sequences padded at the END, mask marks valid steps)."""
+
+    def __init__(self, features_reader: CSVSequenceRecordReader,
+                 labels_reader: Optional[CSVSequenceRecordReader],
+                 batch_size: int, n_classes: Optional[int] = None,
+                 regression: bool = False, label_index: int = -1):
+        self.features_reader = features_reader
+        self.labels_reader = labels_reader
+        self.batch_size = batch_size
+        self.n_classes = n_classes
+        self.regression = regression
+        self.label_index = label_index
+        if not regression and n_classes is None:
+            raise ValueError("classification needs n_classes "
+                             "(or pass regression=True)")
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def _pairs(self):
+        if self.labels_reader is not None:
+            yield from zip(iter(self.features_reader),
+                           iter(self.labels_reader))
+        else:  # label column inside the same sequence file
+            for seq in self.features_reader:
+                li = (self.label_index if self.label_index >= 0
+                      else seq.shape[1] + self.label_index)
+                lab = seq[:, li:li + 1]
+                feat = np.delete(seq, li, axis=1)
+                yield feat, lab
+
+    def __iter__(self):
+        buf = []
+        for pair in self._pairs():
+            buf.append(pair)
+            if len(buf) == self.batch_size:
+                yield self._make(buf)
+                buf = []
+        if buf:
+            yield self._make(buf)
+
+    def _make(self, pairs):
+        t_max = max(f.shape[0] for f, _ in pairs)
+        n = len(pairs)
+        nf = pairs[0][0].shape[1]
+        x = np.zeros((n, t_max, nf), np.float32)
+        mask = np.zeros((n, t_max), np.float32)
+        if self.regression:
+            nl = pairs[0][1].shape[1]
+            y = np.zeros((n, t_max, nl), np.float32)
+        else:
+            y = np.zeros((n, t_max, self.n_classes), np.float32)
+        for i, (f, l) in enumerate(pairs):
+            t = f.shape[0]
+            x[i, :t] = f
+            mask[i, :t] = 1.0
+            if self.regression:
+                y[i, :t] = l
+            else:
+                idx = np.asarray(l, dtype=np.int64).reshape(-1)
+                y[i, :t] = np.eye(self.n_classes, dtype=np.float32)[idx]
+        return DataSet(x, y, features_mask=mask, labels_mask=mask)

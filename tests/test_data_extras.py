@@ -1,0 +1,117 @@
+"""Dataset fetchers + record-reader tests (reference test model:
+``RecordReaderDataSetiteratorTest``, ``EmnistDataSetIteratorTest``)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.fetchers import (CifarDataSetIterator,
+                                              EmnistDataSetIterator,
+                                              TinyImageNetDataSetIterator)
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+
+
+class TestFetchers:
+    def test_emnist_shapes_and_variants(self):
+        it = EmnistDataSetIterator("letters", batch_size=32, train=True)
+        ds = next(iter(it))
+        assert ds.features.shape == (32, 784)
+        assert ds.labels.shape == (32, 26)
+        assert EmnistDataSetIterator.num_labels("byclass") == 62
+        with pytest.raises(ValueError, match="unknown EMNIST"):
+            EmnistDataSetIterator("nope", 8)
+
+    def test_cifar_shapes(self):
+        it = CifarDataSetIterator(batch_size=16, train=False, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (16, 32, 32, 3)
+        assert ds.labels.shape == (16, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+    def test_tiny_imagenet_shapes(self):
+        it = TinyImageNetDataSetIterator(batch_size=8, num_examples=32)
+        ds = next(iter(it))
+        assert ds.features.shape == (8, 64, 64, 3)
+        assert ds.labels.shape == (8, 200)
+
+
+class TestRecordReaders:
+    def test_csv_classification(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,2\n7.0,8.0,1\n")
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)),
+                                         batch_size=3, label_index=-1,
+                                         n_classes=3)
+        batches = list(it)
+        assert len(batches) == 2  # 3 + 1 partial
+        assert batches[0].features.shape == (3, 2)
+        np.testing.assert_array_equal(batches[0].labels[1],
+                                      [0, 1, 0])
+
+    def test_csv_regression_range(self):
+        rr = CollectionRecordReader([[1, 2, 10, 20], [3, 4, 30, 40]])
+        it = RecordReaderDataSetIterator(rr, batch_size=2, regression=True,
+                                         label_index=2, label_index_to=3)
+        ds = next(iter(it))
+        np.testing.assert_array_equal(ds.features, [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(ds.labels, [[10, 20], [30, 40]])
+
+    def test_classification_requires_classes(self):
+        with pytest.raises(ValueError, match="n_classes"):
+            RecordReaderDataSetIterator(CollectionRecordReader([]), 2)
+
+    def test_sequence_padding_and_mask(self, tmp_path):
+        (tmp_path / "a.csv").write_text("1,0\n2,1\n3,0\n")
+        (tmp_path / "b.csv").write_text("4,1\n")
+        rr = CSVSequenceRecordReader(str(tmp_path))
+        it = SequenceRecordReaderDataSetIterator(rr, None, batch_size=2,
+                                                 n_classes=2, label_index=-1)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 1)
+        assert ds.labels.shape == (2, 3, 2)
+        np.testing.assert_array_equal(ds.features_mask, [[1, 1, 1], [1, 0, 0]])
+        np.testing.assert_array_equal(ds.features[1, 0], [4])
+        np.testing.assert_array_equal(ds.labels[0, 1], [0, 1])
+
+    def test_sequence_separate_label_files(self, tmp_path):
+        fd = tmp_path / "f"
+        ld = tmp_path / "l"
+        fd.mkdir()
+        ld.mkdir()
+        (fd / "s0.csv").write_text("1,1\n2,2\n")
+        (ld / "s0.csv").write_text("0\n1\n")
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader(str(fd)), CSVSequenceRecordReader(str(ld)),
+            batch_size=1, n_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (1, 2, 2)
+        np.testing.assert_array_equal(ds.labels[0], [[1, 0], [0, 1]])
+
+    def test_trains_iris_csv_end_to_end(self, tmp_path):
+        # write iris-like CSV and train through the adapter
+        from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.updaters import Adam
+        from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                              OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        src = IrisDataSetIterator(batch_size=150)
+        ds = next(iter(src))
+        rows = np.concatenate(
+            [ds.features, np.argmax(ds.labels, 1, keepdims=True)], axis=1)
+        p = tmp_path / "iris.csv"
+        np.savetxt(p, rows, delimiter=",", fmt="%.5f")
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).activation("tanh").weight_init("xavier")
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(DenseLayer(n_out=10))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)),
+                                         batch_size=50, n_classes=3)
+        for _ in range(40):
+            net.fit(it)
+        assert net.evaluate(it).accuracy() > 0.9
