@@ -25,7 +25,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["ParallelInference", "InferenceMode"]
+__all__ = ["ParallelInference", "InferenceMode", "InvalidInputError"]
+
+
+class InvalidInputError(ValueError):
+    """Request rejected up front (wrong feature shape) — a *client* error,
+    distinguishable from ValueErrors raised inside the model forward."""
 
 
 class InferenceMode:
@@ -77,8 +82,8 @@ class ParallelInference:
         batch = x[None] if single else x
         expected = self._feature_shape()
         if expected is not None and tuple(batch.shape[1:]) != expected:
-            raise ValueError(f"expected feature shape {expected}, "
-                             f"got {tuple(batch.shape[1:])}")
+            raise InvalidInputError(f"expected feature shape {expected}, "
+                                    f"got {tuple(batch.shape[1:])}")
         if self.mode == InferenceMode.INPLACE or self._shutdown.is_set():
             out = np.asarray(self.model.output(batch))
             return out[0] if single else out
@@ -90,7 +95,10 @@ class ParallelInference:
         with self._submit_lock:  # no submit can now slip past the drain below
             self._shutdown.set()
         if self._worker is not None:
-            self._queue.put(None)  # wake dispatcher
+            try:
+                self._queue.put_nowait(None)  # wake dispatcher
+            except queue.Full:
+                pass  # dispatcher is draining; the flag alone stops it
             self._worker.join(timeout=5)
         # fail any future still enqueued so its caller unblocks
         while True:

@@ -10,8 +10,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..parallel.inference import InferenceMode, ParallelInference
-from ._http import BackgroundHttpServer, JsonClient, JsonHandler
+from ..parallel.inference import (InferenceMode, InvalidInputError,
+                                  ParallelInference)
+from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
 
 __all__ = ["InferenceServer", "InferenceClient"]
 
@@ -33,9 +34,9 @@ class _PredictHandler(JsonHandler):
             return self._json({"error": str(e)}, 400)
         try:
             out = self.server_ref.inference.output(x)
-        except ValueError as e:  # shape rejection -> client error
+        except InvalidInputError as e:  # up-front shape rejection only
             return self._json({"error": str(e)}, 400)
-        except Exception as e:
+        except Exception as e:  # model-side failures are server errors
             return self._json({"error": str(e)}, 500)
         return self._json({"output": np.asarray(out).tolist()})
 

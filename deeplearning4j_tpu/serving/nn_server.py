@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..clustering.neighbors import BruteForceNN, VPTree
-from ._http import BackgroundHttpServer, JsonClient, JsonHandler
+from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
 
 __all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]
 

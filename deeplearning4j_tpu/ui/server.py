@@ -17,11 +17,10 @@ Endpoints:
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.request import Request, urlopen
 
+from ..utils.http import BackgroundHttpServer, JsonHandler
 from .stats import StatsReport
 from .storage import InMemoryStatsStorage, StatsStorage
 
@@ -64,19 +63,8 @@ refresh();setInterval(refresh,2000);
 </script></body></html>"""
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandler):
     storage: StatsStorage = None  # set by UIServer
-
-    def log_message(self, *a):  # quiet
-        pass
-
-    def _json(self, obj, code=200):
-        payload = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
 
     def do_GET(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
@@ -117,9 +105,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path.rstrip("/") != "/remote":
             return self._json({"error": "not found"}, 404)
-        n = int(self.headers.get("Content-Length", 0))
         try:
-            report = StatsReport.from_dict(json.loads(self.rfile.read(n)))
+            report = StatsReport.from_dict(self._read_json())
         except Exception as e:  # malformed post must not kill the server
             return self._json({"error": str(e)}, 400)
         self.storage.put_record(report)
@@ -131,15 +118,13 @@ class UIServer:
     ``PlayUIServer``).  ``attach(storage)`` routes that storage's sessions."""
 
     def __init__(self, port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
-        handler.storage = InMemoryStatsStorage()
-        self._handler = handler
-        self._thread: Optional[threading.Thread] = None
+        self._server = BackgroundHttpServer(_Handler, port,
+                                            storage=InMemoryStatsStorage())
+        self._handler = self._server.httpd.RequestHandlerClass
 
     @property
     def port(self) -> int:
-        return self._httpd.server_address[1]
+        return self._server.port
 
     @property
     def storage(self) -> StatsStorage:
@@ -149,14 +134,11 @@ class UIServer:
         self._handler.storage = storage
 
     def start(self) -> "UIServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._server.start()
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.stop()
 
 
 class RemoteUIStatsStorageRouter:
