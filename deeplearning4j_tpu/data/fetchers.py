@@ -117,7 +117,10 @@ class CifarDataSetIterator(INDArrayDataSetIterator):
             images, labels = data
         if num_examples is not None:
             images, labels = images[:num_examples], labels[:num_examples]
-        feats = images.astype(np.float32) / 255.0
+        if images.dtype == np.float32:  # real corpus: already scaled by decode
+            feats = images
+        else:
+            feats = images.astype(np.float32) / 255.0
         onehot = np.eye(self.N_CLASSES, dtype=np.float32)[labels]
         super().__init__(feats, onehot, batch_size, shuffle=shuffle, seed=seed)
 
@@ -131,13 +134,14 @@ class CifarDataSetIterator(INDArrayDataSetIterator):
                  if train else [d / "test_batch.bin"])
         if not all(f.exists() for f in files):
             return None
+        from ..utils.native import decode_cifar
         images, labels = [], []
         for f in files:
-            raw = np.frombuffer(f.read_bytes(), dtype=np.uint8)
-            rec = raw.reshape(-1, 3073)
-            labels.append(rec[:, 0].astype(np.int64))
-            chw = rec[:, 1:].reshape(-1, 3, 32, 32)
-            images.append(chw.transpose(0, 2, 3, 1))  # NHWC
+            # native C++ decode (GIL-free CHW->NHWC transpose + 1/255 scale);
+            # already float32 in [0,1], so __init__ skips its own rescale
+            lab, img = decode_cifar(f.read_bytes())
+            labels.append(lab.astype(np.int64))
+            images.append(img)
         return np.concatenate(images), np.concatenate(labels)
 
 

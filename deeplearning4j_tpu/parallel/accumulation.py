@@ -122,16 +122,50 @@ class EncodingHandler:
 
     def __init__(self, initial_threshold: float = 1e-3,
                  min_threshold: float = 1e-9, decay: float = 0.95,
-                 boost: float = 1.2, target_density: float = 1e-2):
+                 boost: float = 1.2, target_density: float = 1e-2,
+                 backend: str = "device"):
         self.threshold = initial_threshold
         self.min_threshold = min_threshold
         self.decay = decay
         self.boost = boost
         self.target_density = target_density
+        if backend not in ("device", "host"):
+            raise ValueError("backend must be 'device' (jit) or 'host' "
+                             "(native C++ codec)")
+        self.backend = backend
         self.residual: Optional[jnp.ndarray] = None
         self.last_density = 0.0
 
+    def _encode_host(self, flat: np.ndarray) -> Dict[str, Any]:
+        """C++ codec path (``utils/native.py``): compress on host CPU right
+        before the NIC — the DCN deployment shape, no device round-trip."""
+        from ..utils.native import (bitmap_encode_native,
+                                    threshold_encode_native)
+        density = float(np.mean(np.abs(flat) >= self.threshold))
+        self.last_density = density
+        if density > self.DENSITY_SWITCH:
+            packed, residual = bitmap_encode_native(flat, self.threshold)
+            msg = {"kind": "bitmap", "size": int(flat.size),
+                   "threshold": float(self.threshold), "packed": packed}
+        else:
+            idx, signs, residual = threshold_encode_native(
+                flat, self.threshold, max(1, flat.size // 16))
+            msg = {"kind": "threshold", "size": int(flat.size),
+                   "threshold": float(self.threshold),
+                   "idx": idx, "signs": signs}
+        # stays numpy: the whole point of the host backend is no device
+        # round-trip for residual bookkeeping
+        self.residual = residual
+        return msg
+
     def encode_update(self, flat_grad) -> Dict[str, Any]:
+        if self.backend == "host":
+            flat = np.asarray(flat_grad, np.float32)
+            if self.residual is not None:
+                flat = flat + np.asarray(self.residual, np.float32)
+            msg = self._encode_host(flat)
+            self._adapt()
+            return msg
         flat = jnp.asarray(flat_grad)
         if self.residual is not None:
             flat = flat + self.residual
@@ -142,13 +176,16 @@ class EncodingHandler:
             msg, self.residual = bitmap_encode(flat, self.threshold)
         else:
             msg, self.residual = threshold_encode(flat, self.threshold)
-        # adapt: too sparse -> decay threshold; too dense -> boost
-        if density < self.target_density / 10.0:
+        self._adapt()
+        return msg
+
+    def _adapt(self) -> None:
+        """Too sparse -> decay threshold; too dense -> boost."""
+        if self.last_density < self.target_density / 10.0:
             self.threshold = max(self.threshold * self.decay,
                                  self.min_threshold)
-        elif density > self.target_density * 10.0:
+        elif self.last_density > self.target_density * 10.0:
             self.threshold *= self.boost
-        return msg
 
 
 class EncodedGradientsAccumulator:
