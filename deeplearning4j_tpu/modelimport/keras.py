@@ -1,0 +1,356 @@
+"""Keras HDF5 model import (reference ``deeplearning4j-modelimport``:
+``KerasModelImport.java:50-157`` entry points, ``KerasSequentialModel.java``,
+``KerasLayer.java:42`` registry of layer mappers).
+
+Reads a Keras 1.x/2.x ``model.save()`` HDF5 file with the pure-Python parser
+(``hdf5.py``), maps ``model_config`` onto our configuration DSL, builds a
+``MultiLayerNetwork``, and copies the weights in (transposing/reordering
+where conventions differ — e.g. Keras LSTM gate order i,f,c,o vs our
+i,f,o,g).  TF channel-last conventions are assumed (the DL4J importer's
+default for TF-backend files).
+
+Supported layers: Dense, Activation, Dropout, Flatten, Conv2D,
+MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D, BatchNormalization,
+LSTM, SimpleRNN, Embedding.  Unsupported layers raise
+``KerasImportError`` naming the layer class (reference
+``UnsupportedKerasConfigurationException``).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf.input_type import InputType
+from ..nn.conf.multi_layer import NeuralNetConfiguration
+from ..nn.conf.updaters import Sgd
+from ..nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+from ..nn.layers.feedforward import (ActivationLayer, DenseLayer,
+                                     DropoutLayer, EmbeddingLayer,
+                                     OutputLayer)
+from ..nn.layers.normalization import BatchNormalization
+from ..nn.layers.pooling import GlobalPoolingLayer
+from ..nn.layers.recurrent import LSTM, RnnOutputLayer, SimpleRnn
+from ..nn.multilayer import MultiLayerNetwork
+from .hdf5 import Hdf5File, Hdf5FormatError
+
+__all__ = ["KerasModelImport", "KerasImportError",
+           "import_keras_sequential_model"]
+
+
+class KerasImportError(ValueError):
+    pass
+
+
+_ACT_MAP = {
+    "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid",
+    "softmax": "softmax", "linear": "identity", "elu": "elu",
+    "selu": "selu", "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "gelu": "gelu",
+}
+
+
+def _act(name: Optional[str]) -> str:
+    if name is None:
+        return "identity"
+    if name not in _ACT_MAP:
+        raise KerasImportError(f"unsupported Keras activation '{name}'")
+    return _ACT_MAP[name]
+
+
+def _cfg(layer: Dict[str, Any]) -> Dict[str, Any]:
+    return layer.get("config", {})
+
+
+def _input_type_from(conf: Dict[str, Any]) -> Optional[InputType]:
+    shape = conf.get("batch_input_shape") or conf.get("batch_shape")
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:  # [timesteps, features]
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:  # [h, w, c] channels_last
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    raise KerasImportError(f"cannot map input shape {shape}")
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+class _LayerMap:
+    """One imported layer: our conf + a weight-copy function."""
+
+    def __init__(self, conf=None, copy=None):
+        self.conf = conf
+        self.copy = copy  # fn(keras_weights: dict[str, np.ndarray]) -> params
+
+
+def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool) -> _LayerMap:
+    name = conf.get("name")
+    if cls == "Dense":
+        act = _act(conf.get("activation"))
+        n_out = int(conf["units"] if "units" in conf else conf["output_dim"])
+        use_bias = conf.get("bias", conf.get("use_bias", True))
+        if is_last:
+            loss = "mcxent" if act == "softmax" else "mse"
+            lc = OutputLayer(name=name, n_out=n_out, activation=act,
+                             loss=loss, has_bias=use_bias)
+        else:
+            lc = DenseLayer(name=name, n_out=n_out, activation=act,
+                            has_bias=use_bias)
+
+        def copy(w):
+            out = {"W": w.get("kernel", w.get("W"))}
+            if use_bias:
+                out["b"] = w.get("bias", w.get("b"))
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls == "Activation":
+        return _LayerMap(ActivationLayer(name=name,
+                                         activation=_act(conf["activation"])),
+                         lambda w: {})
+    if cls == "Dropout":
+        rate = float(conf.get("rate", conf.get("p", 0.5)))
+        # Keras rate = drop probability; our dropout config keeps the
+        # reference's retain-probability convention
+        return _LayerMap(DropoutLayer(name=name, dropout=1.0 - rate),
+                         lambda w: {})
+    if cls == "Flatten":
+        return _LayerMap(None, None)  # handled by auto preprocessor insertion
+    if cls in ("Conv2D", "Convolution2D"):
+        n_out = int(conf.get("filters", conf.get("nb_filter", 0)))
+        if "kernel_size" in conf:
+            kernel = _pair(conf["kernel_size"])
+        else:  # Keras 1: nb_row / nb_col
+            kernel = (int(conf["nb_row"]), int(conf["nb_col"]))
+        stride = _pair(conf.get("strides", conf.get("subsample", (1, 1))))
+        padding = conf.get("padding", conf.get("border_mode", "valid"))
+        if padding not in ("valid", "same"):
+            raise KerasImportError(f"unsupported Conv2D padding '{padding}'")
+        lc = ConvolutionLayer(
+            name=name, n_out=n_out, kernel_size=kernel, stride=stride,
+            convolution_mode="same" if padding == "same" else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", conf.get("bias", True)))
+
+        def copy(w):
+            kernel_w = w.get("kernel", w.get("W"))
+            if kernel_w is not None and kernel_w.ndim != 4:
+                raise KerasImportError("Conv2D kernel must be 4-D (HWIO)")
+            out = {"W": kernel_w}  # TF HWIO == our [kh,kw,in,out]
+            if lc.has_bias:
+                out["b"] = w.get("bias", w.get("b"))
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        kernel = _pair(conf.get("pool_size", (2, 2)))
+        stride = _pair(conf.get("strides") or conf.get("pool_size", (2, 2)))
+        return _LayerMap(SubsamplingLayer(
+            name=name, kernel_size=kernel, stride=stride,
+            pooling_type="max" if cls.startswith("Max") else "avg"),
+            lambda w: {})
+    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
+        return _LayerMap(GlobalPoolingLayer(name=name, pooling_type="avg"),
+                         lambda w: {})
+    if cls == "BatchNormalization":
+        eps = float(conf.get("epsilon", 1e-3))
+        momentum = float(conf.get("momentum", 0.99))
+        lc = BatchNormalization(name=name, eps=eps, decay=momentum)
+
+        def copy(w):
+            out = {}
+            if "gamma" in w:
+                out["gamma"] = w["gamma"]
+            if "beta" in w:
+                out["beta"] = w["beta"]
+            # moving stats go to state, handled by caller via special keys
+            out["__state__"] = {
+                "mean": w.get("moving_mean", w.get("running_mean")),
+                "var": w.get("moving_variance", w.get("running_std")),
+            }
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls == "LSTM":
+        n_out = int(conf.get("units", conf.get("output_dim", 0)))
+        act = _act(conf.get("activation", "tanh"))
+        rec_act = conf.get("recurrent_activation",
+                           conf.get("inner_activation", "hard_sigmoid"))
+        lc = LSTM(name=name, n_out=n_out, activation=act,
+                  gate_activation=_act(rec_act))
+
+        def copy(w):
+            if "kernel" in w:  # Keras 2: fused [in,4h] with gate order ifco
+                k, rk, b = w["kernel"], w["recurrent_kernel"], w.get("bias")
+            else:  # Keras 1: per-gate matrices
+                k = np.concatenate([w["W_i"], w["W_f"], w["W_c"], w["W_o"]], 1)
+                rk = np.concatenate([w["U_i"], w["U_f"], w["U_c"], w["U_o"]], 1)
+                b = np.concatenate([w["b_i"], w["b_f"], w["b_c"], w["b_o"]])
+            h = n_out
+
+            def reorder(m):  # keras i,f,c,o -> ours i,f,o,g(=c)
+                blocks = [m[..., i * h:(i + 1) * h] for i in range(4)]
+                return np.concatenate(
+                    [blocks[0], blocks[1], blocks[3], blocks[2]], axis=-1)
+
+            out = {"W": reorder(k), "U": reorder(rk)}
+            out["b"] = (reorder(b.reshape(1, -1)).reshape(-1)
+                        if b is not None else np.zeros(4 * h, np.float32))
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls == "SimpleRNN":
+        n_out = int(conf.get("units", conf.get("output_dim", 0)))
+        lc = SimpleRnn(name=name, n_out=n_out,
+                       activation=_act(conf.get("activation", "tanh")))
+
+        def copy(w):
+            out = {"W": w.get("kernel", w.get("W")),
+                   "U": w.get("recurrent_kernel", w.get("U"))}
+            b = w.get("bias", w.get("b"))
+            out["b"] = b if b is not None else np.zeros(n_out, np.float32)
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls == "Embedding":
+        n_out = int(conf.get("output_dim"))
+        n_in = int(conf.get("input_dim"))
+        lc = EmbeddingLayer(name=name, n_in=n_in, n_out=n_out,
+                            activation="identity")
+        return _LayerMap(lc, lambda w: {
+            "W": w.get("embeddings", w.get("W"))})
+    raise KerasImportError(f"unsupported Keras layer class '{cls}' "
+                           "(reference KerasLayer registry)")
+
+
+def _layer_weight_groups(f: Hdf5File) -> Dict[str, Dict[str, np.ndarray]]:
+    """{layer_name: {short_weight_name: array}} from /model_weights (or the
+    root for weights-only files)."""
+    root = f["model_weights"] if "model_weights" in f.keys() else f
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    names = root.attrs.get("layer_names")
+    layer_names = ([n.decode() if isinstance(n, bytes) else n
+                    for n in list(names)]
+                   if names is not None else root.keys())
+    for lname in layer_names:
+        g = root[lname]
+        weights: Dict[str, np.ndarray] = {}
+        wnames = g.attrs.get("weight_names")
+        wlist = list(wnames) if wnames is not None else g.keys()
+        for wn in wlist:
+            if isinstance(wn, bytes):
+                wn = wn.decode()
+            try:  # Keras nests an inner scope group (layer/layer/kernel:0)…
+                ds = g[wn]
+            except KeyError:  # …weights-only layouts store datasets flat
+                ds = g[wn.split("/")[-1]]
+            short = wn.split("/")[-1].split(":")[0]
+            # Keras 1 style "dense_1_W" -> "W"
+            if short.startswith(lname + "_"):
+                short = short[len(lname) + 1:]
+            weights[short] = ds.read()
+        out[lname] = weights
+    return out
+
+
+def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
+    """Load a Keras Sequential ``model.save()`` file into a
+    MultiLayerNetwork (reference
+    ``KerasModelImport.importKerasSequentialModelAndWeights``)."""
+    f = Hdf5File(path_or_bytes)
+    raw = f.attrs.get("model_config")
+    if raw is None:
+        raise KerasImportError("no model_config attribute — is this a "
+                               "weights-only file? (use layer_weight_groups)")
+    config = json.loads(raw if isinstance(raw, str) else str(raw))
+    if config.get("class_name") != "Sequential":
+        raise KerasImportError(
+            f"not a Sequential model ({config.get('class_name')}); "
+            "functional-graph import is not yet supported")
+    layer_list = config["config"]
+    if isinstance(layer_list, dict):  # Keras 2.2+: {"name":..,"layers":[..]}
+        layer_list = layer_list["layers"]
+
+    itype = None
+    maps: List[_LayerMap] = []
+    mapped_names: List[str] = []
+    # find the last REAL layer (Flatten/InputLayer don't count)
+    real_idx = [i for i, l in enumerate(layer_list)
+                if l["class_name"] not in ("Flatten", "InputLayer")]
+    for i, l in enumerate(layer_list):
+        cls = l["class_name"]
+        conf = _cfg(l)
+        if itype is None:
+            it = _input_type_from(conf)
+            if it is not None:
+                itype = it
+        if cls == "InputLayer":
+            continue
+        lm = _map_layer(cls, conf, is_last=(real_idx and i == real_idx[-1]))
+        if lm.conf is None:  # Flatten
+            continue
+        maps.append(lm)
+        mapped_names.append(conf.get("name") or f"layer_{i}")
+    if itype is None:
+        raise KerasImportError("no batch_input_shape on the first layer")
+
+    builder = (NeuralNetConfiguration.builder()
+               .seed(12345)
+               .updater(Sgd(learning_rate=0.01))
+               .list())
+    for lm in maps:
+        builder.layer(lm.conf)
+    conf = builder.set_input_type(itype).build()
+    net = MultiLayerNetwork(conf).init()
+
+    groups = _layer_weight_groups(f)
+    for i, (lm, lname) in enumerate(zip(maps, mapped_names)):
+        w = groups.get(lname, {})
+        if lm.copy is None:
+            continue
+        params = lm.copy(w)
+        state_extra = params.pop("__state__", None)
+        target = net.params.get(f"layer_{i}", {})
+        for pname, val in params.items():
+            if val is None:
+                raise KerasImportError(
+                    f"layer {lname}: weight '{pname}' not found in the "
+                    "HDF5 file (layer group missing or dataset names "
+                    "unrecognized)")
+            val = np.asarray(val, np.float32)
+            if pname not in target:
+                raise KerasImportError(
+                    f"layer {lname}: param '{pname}' missing on our side")
+            if tuple(target[pname].shape) != tuple(val.shape):
+                raise KerasImportError(
+                    f"layer {lname}: shape mismatch for '{pname}': "
+                    f"keras {val.shape} vs ours {tuple(target[pname].shape)}")
+            target[pname] = val
+        if state_extra:
+            st = net.state.get(f"layer_{i}", {})
+            if state_extra.get("mean") is not None:
+                st["mean"] = np.asarray(state_extra["mean"], np.float32)
+            if state_extra.get("var") is not None:
+                st["var"] = np.asarray(state_extra["var"], np.float32)
+    # re-materialize as jax arrays
+    import jax.numpy as jnp
+    import jax
+    net.params = jax.tree_util.tree_map(jnp.asarray, net.params)
+    net.state = jax.tree_util.tree_map(jnp.asarray, net.state)
+    return net
+
+
+class KerasModelImport:
+    """Entry points (reference ``KerasModelImport.java``)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path) -> MultiLayerNetwork:
+        return import_keras_sequential_model(path)

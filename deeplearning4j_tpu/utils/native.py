@@ -37,23 +37,32 @@ _f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 
 def _compile() -> Optional[Path]:
-    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _SO
-    _BUILD_DIR.mkdir(exist_ok=True)
     # compile to a per-process temp name, then atomically publish: concurrent
-    # processes must never dlopen a half-written .so
-    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", str(tmp), str(_SRC)]
+    # processes must never dlopen a half-written .so.  ANY filesystem issue
+    # (source tree absent in a stripped install, read-only dir, no g++) must
+    # fall back to pure Python, never crash the caller.
+    tmp = None
     try:
+        if _SO.exists() and (not _SRC.exists()
+                             or _SO.stat().st_mtime >= _SRC.stat().st_mtime):
+            return _SO
+        if not _SRC.exists():
+            return None
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", str(tmp), str(_SRC)]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
     finally:
-        if tmp.exists():
-            tmp.unlink()
+        if tmp is not None and tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
