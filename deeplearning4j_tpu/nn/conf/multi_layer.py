@@ -252,6 +252,15 @@ class NeuralNetConfiguration:
             self._defaults["dtype"] = dt
             return self
 
+        def optimization_algo(self, algo: str, max_iterations: int = 100):
+            """Pick the solver (reference ``OptimizationAlgorithm``):
+            'sgd' (default, jitted minibatch path) or the legacy
+            full-batch methods 'lbfgs' / 'conjugate_gradient' /
+            'line_gradient_descent' (train/solvers.py)."""
+            self._defaults["optimization_algo"] = str(algo).lower()
+            self._defaults["max_iterations"] = int(max_iterations)
+            return self
+
         def list(self) -> ListBuilder:
             return ListBuilder(self._defaults, self._seed)
 

@@ -295,6 +295,25 @@ class MultiLayerNetwork:
         else:
             raise ValueError("fit() needs (x, y) or an iterator")
 
+        algo = self.conf.defaults.get("optimization_algo", "sgd")
+        if algo not in ("sgd", "stochastic_gradient_descent"):
+            # legacy full-batch solvers (reference Solver → LBFGS/CG/line
+            # search, StochasticGradientDescent.java:58 being the default)
+            from ..train.solvers import Solver
+            solver = Solver(self, algo, max_iterations=int(
+                self.conf.defaults.get("max_iterations", 100)))
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self)
+                for batch in batches_factory():
+                    x, y, m, lm = batch
+                    self.last_batch_size = int(getattr(x, "shape", (0,))[0])
+                    solver.optimize(x, y, mask=m, label_mask=lm)
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
+                self.epoch += 1
+            return self
+
         step_fn = self._get_jitted("train_step")
         for _ in range(epochs):
             for lst in self.listeners:

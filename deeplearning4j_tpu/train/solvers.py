@@ -1,0 +1,339 @@
+"""Legacy full-batch solvers — LBFGS / ConjugateGradient /
+LineGradientDescent with backtracking line search.
+
+Reference: ``optimize/Solver.java:43``, ``optimize/solvers/LBFGS.java``,
+``ConjugateGradient.java``, ``LineGradientDescent.java``,
+``BackTrackLineSearch.java``, ``optimize/stepfunctions/``,
+``optimize/terminations/``.
+
+TPU-native re-design: the reference mutates a flat param view from Java
+loops; here each solver iteration (direction + Armijo backtracking line
+search) is ONE jitted XLA program over the raveled param vector
+(`jax.flatten_util.ravel_pytree`).  The line search runs as a
+``lax.while_loop`` (no host round-trips per trial step); the L-BFGS
+two-loop recursion runs as ``lax.fori_loop`` over fixed circular (S, Y)
+memory buffers so the program has static shapes.  Loss is evaluated
+deterministically (train=False) — these are deterministic full-batch
+methods; stochastic regularization stays with the SGD path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+__all__ = ["Solver", "LineGradientDescent", "ConjugateGradient", "LBFGS",
+           "BackTrackLineSearch", "DefaultStepFunction",
+           "NegativeDefaultStepFunction", "EpsTermination",
+           "Norm2Termination", "ZeroDirectionTermination"]
+
+
+# --------------------------------------------------------- step functions
+class DefaultStepFunction:
+    """x_new = x + alpha * direction (reference DefaultStepFunction)."""
+    sign = 1.0
+
+
+class NegativeDefaultStepFunction:
+    """x_new = x - alpha * direction (reference NegativeDefaultStepFunction)."""
+    sign = -1.0
+
+
+# ---------------------------------------------------- termination conditions
+class EpsTermination:
+    """Stop when the score improvement falls below eps * tolerance
+    (reference ``optimize/terminations/EpsTermination.java``)."""
+
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1.0):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, cost_old: float, cost_new: float, g_norm: float
+                  ) -> bool:
+        return abs(cost_old - cost_new) < self.eps * self.tolerance
+
+
+class Norm2Termination:
+    """Stop when ||grad||_2 < gradient_norm threshold (reference
+    ``Norm2Termination.java``)."""
+
+    def __init__(self, gradient_norm: float = 1e-6):
+        self.gradient_norm = gradient_norm
+
+    def terminate(self, cost_old: float, cost_new: float, g_norm: float
+                  ) -> bool:
+        return g_norm < self.gradient_norm
+
+
+class ZeroDirectionTermination:
+    """Stop when the search direction is numerically zero (reference
+    ``ZeroDirection.java``)."""
+
+    def terminate(self, cost_old: float, cost_new: float, g_norm: float
+                  ) -> bool:
+        return g_norm == 0.0
+
+
+# --------------------------------------------------------- line search
+class BackTrackLineSearch:
+    """Armijo backtracking (reference ``BackTrackLineSearch.java``): shrink
+    alpha by ``rho`` until f(x + a·d) <= f(x) + c1·a·(g·d), as a
+    ``lax.while_loop`` inside the caller's jitted step."""
+
+    def __init__(self, c1: float = 1e-4, rho: float = 0.5,
+                 max_iterations: int = 20, min_step: float = 1e-12,
+                 initial_step: float = 1.0):
+        self.c1 = c1
+        self.rho = rho
+        self.max_iterations = max_iterations
+        self.min_step = min_step
+        self.initial_step = initial_step
+
+    def search(self, value_fn: Callable[[jax.Array], jax.Array],
+               x: jax.Array, f0: jax.Array, g: jax.Array,
+               direction: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Returns (alpha, f_new); traced (jit-safe)."""
+        gd = jnp.vdot(g, direction)
+        a0 = jnp.asarray(self.initial_step, x.dtype)
+        f_try = value_fn(x + a0 * direction)
+
+        def cond(carry):
+            alpha, f_new, n = carry
+            armijo_fail = ~(f_new <= f0 + self.c1 * alpha * gd)
+            finite_fail = ~jnp.isfinite(f_new)
+            return ((armijo_fail | finite_fail)
+                    & (n < self.max_iterations) & (alpha > self.min_step))
+
+        def body(carry):
+            alpha, _, n = carry
+            alpha = alpha * self.rho
+            return alpha, value_fn(x + alpha * direction), n + 1
+
+        alpha, f_new, _ = lax.while_loop(cond, body, (a0, f_try, 0))
+        # if even the smallest step failed, take no step at all
+        ok = (f_new <= f0) & jnp.isfinite(f_new)
+        return jnp.where(ok, alpha, 0.0), jnp.where(ok, f_new, f0)
+
+
+# ------------------------------------------------------------- solvers
+class _BaseFullBatchOptimizer:
+    """Shared driver: build flat loss/grad, run jitted iterations, write
+    params back (reference ``BaseOptimizer.gradientAndScore`` :171-187 +
+    per-algorithm ``optimize()``)."""
+
+    name = "base"
+
+    def __init__(self, max_iterations: int = 100,
+                 terminations: Optional[Sequence[Any]] = None,
+                 line_search: Optional[BackTrackLineSearch] = None,
+                 step_function: Any = None):
+        self.max_iterations = max_iterations
+        self.terminations = list(terminations) if terminations is not None \
+            else [EpsTermination(1e-10), Norm2Termination(1e-8)]
+        self.line_search = line_search or BackTrackLineSearch()
+        self.step_function = step_function or DefaultStepFunction()
+        self.score_history: List[float] = []
+
+    # subclass contract ----------------------------------------------------
+    def init_state(self, flat: jax.Array, g: jax.Array):
+        return ()
+
+    def direction(self, g: jax.Array, state) -> Tuple[jax.Array, Any]:
+        raise NotImplementedError
+
+    def post_step(self, state, x_old, x_new, g_old, g_new):
+        return state
+
+    # driver ---------------------------------------------------------------
+    def optimize(self, model, data, labels=None, mask=None,
+                 label_mask=None) -> float:
+        """Run up to max_iterations full-batch iterations on (x, y).
+        Returns the final score and updates ``model.params`` in place."""
+        x, y, m, lm = _normalize(model, data, labels, mask, label_mask)
+        flat0, unravel = ravel_pytree(model.params)
+        state_tree = model.state
+
+        def loss_flat(flat):
+            p = unravel(flat)
+            loss, _ = model._loss(p, state_tree, x, y, train=False, key=None,
+                                  mask=m, label_mask=lm)
+            return loss
+
+        value_and_grad = jax.value_and_grad(loss_flat)
+        sign = self.step_function.sign
+
+        @jax.jit
+        def step(flat, f, g, opt_state):
+            d, opt_state = self.direction(g, opt_state)
+            d = sign * d
+            alpha, f_new = self.line_search.search(loss_flat, flat, f, g, d)
+            flat_new = flat + alpha * d
+            f2, g_new = value_and_grad(flat_new)
+            opt_state = self.post_step(opt_state, flat, flat_new, g, g_new)
+            return flat_new, f2, g_new, opt_state
+
+        f, g = jax.jit(value_and_grad)(flat0)
+        flat = flat0
+        opt_state = self.init_state(flat0, g)
+        self.score_history = [float(f)]
+        for _ in range(self.max_iterations):
+            f_old = float(f)
+            flat, f, g, opt_state = step(flat, f, g, opt_state)
+            f_cur = float(f)
+            self.score_history.append(f_cur)
+            g_norm = float(jnp.linalg.norm(g))
+            if any(t.terminate(f_old, f_cur, g_norm)
+                   for t in self.terminations):
+                break
+        model.params = unravel(flat)
+        model._score = float(f)
+        for lst in getattr(model, "listeners", []):
+            model.iteration += 1
+            lst.iteration_done(model, model.iteration, model.epoch)
+        return float(f)
+
+
+class LineGradientDescent(_BaseFullBatchOptimizer):
+    """Steepest descent + line search (reference
+    ``optimize/solvers/LineGradientDescent.java``)."""
+
+    name = "line_gradient_descent"
+
+    def direction(self, g, state):
+        return -g, state
+
+
+class ConjugateGradient(_BaseFullBatchOptimizer):
+    """Nonlinear Polak-Ribiere(+) conjugate gradient with automatic restart
+    (reference ``optimize/solvers/ConjugateGradient.java``)."""
+
+    name = "conjugate_gradient"
+
+    def init_state(self, flat, g):
+        return (-g, g)  # (previous direction, previous gradient)
+
+    def direction(self, g, state):
+        d_prev, g_prev = state
+        beta = jnp.vdot(g, g - g_prev) / (jnp.vdot(g_prev, g_prev) + 1e-30)
+        beta = jnp.maximum(beta, 0.0)   # PR+ restart
+        d = -g + beta * d_prev
+        # restart to steepest descent if d is not a descent direction
+        d = jnp.where(jnp.vdot(d, g) < 0, d, -g)
+        return d, (d, g)
+
+    def post_step(self, state, x_old, x_new, g_old, g_new):
+        d, _ = state
+        return (d, g_old)
+
+
+class LBFGS(_BaseFullBatchOptimizer):
+    """Limited-memory BFGS (reference ``optimize/solvers/LBFGS.java``,
+    default memory m=10).  The two-loop recursion runs as ``lax.fori_loop``
+    over circular [m, n] S/Y buffers so the jitted program has static
+    shapes; unfilled slots are masked out."""
+
+    name = "lbfgs"
+
+    def __init__(self, max_iterations: int = 100, memory: int = 10, **kw):
+        super().__init__(max_iterations=max_iterations, **kw)
+        self.m = memory
+
+    def init_state(self, flat, g):
+        n = flat.shape[0]
+        m = self.m
+        z = jnp.zeros((m, n), flat.dtype)
+        return (z, z, jnp.zeros((m,), flat.dtype), jnp.zeros((), jnp.int32))
+
+    def direction(self, g, state):
+        S, Y, rho, count = state
+        m = self.m
+        valid_n = jnp.minimum(count, m)
+
+        def bwd(i, carry):
+            q, alphas = carry
+            idx = (count - 1 - i) % m
+            valid = i < valid_n
+            a = jnp.where(valid, rho[idx] * jnp.vdot(S[idx], q), 0.0)
+            q = q - a * Y[idx]
+            return q, alphas.at[idx].set(a)
+
+        q, alphas = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), g.dtype)))
+        latest = (count - 1) % m
+        yy = jnp.vdot(Y[latest], Y[latest])
+        gamma = jnp.where(count > 0,
+                          jnp.vdot(S[latest], Y[latest]) / (yy + 1e-30), 1.0)
+        r = gamma * q
+
+        def fwd(i, r):
+            idx = (count - valid_n + i) % m
+            valid = i < valid_n
+            b = rho[idx] * jnp.vdot(Y[idx], r)
+            return r + jnp.where(valid, alphas[idx] - b, 0.0) * S[idx]
+
+        r = lax.fori_loop(0, m, fwd, r)
+        d = -r
+        # safeguard: fall back to steepest descent on a non-descent direction
+        d = jnp.where(jnp.vdot(d, g) < 0, d, -g)
+        return d, state
+
+    def post_step(self, state, x_old, x_new, g_old, g_new):
+        S, Y, rho, count = state
+        s = x_new - x_old
+        yv = g_new - g_old
+        sy = jnp.vdot(s, yv)
+        slot = count % self.m
+        ok = sy > 1e-10       # curvature condition; skip the pair otherwise
+        S = jnp.where(ok, S.at[slot].set(s), S)
+        Y = jnp.where(ok, Y.at[slot].set(yv), Y)
+        rho = jnp.where(ok, rho.at[slot].set(1.0 / jnp.maximum(sy, 1e-30)),
+                        rho)
+        count = count + jnp.where(ok, 1, 0).astype(count.dtype)
+        return (S, Y, rho, count)
+
+
+_ALGOS = {
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """Facade mirroring ``optimize/Solver.java:43``: pick the optimizer from
+    the algorithm name and drive it.  ``sgd``/``stochastic_gradient_descent``
+    delegates to the network's own jitted minibatch path."""
+
+    def __init__(self, model, algorithm: str = "lbfgs",
+                 max_iterations: int = 100, **kw):
+        self.model = model
+        self.algorithm = algorithm.lower()
+        if self.algorithm in ("sgd", "stochastic_gradient_descent"):
+            self.optimizer = None
+        elif self.algorithm in _ALGOS:
+            self.optimizer = _ALGOS[self.algorithm](
+                max_iterations=max_iterations, **kw)
+        else:
+            raise ValueError(
+                f"unknown optimization algorithm '{algorithm}'; available: "
+                f"sgd, {', '.join(sorted(_ALGOS))}")
+
+    def optimize(self, data, labels=None, **kw) -> float:
+        if self.optimizer is None:
+            self.model.fit(data, labels)
+            return self.model.score()
+        return self.optimizer.optimize(self.model, data, labels, **kw)
+
+
+def _normalize(model, data, labels, mask, label_mask):
+    if labels is not None:
+        x, y, m, lm = data, labels, mask, label_mask
+    else:
+        x, y, m, lm = model._normalize_batch(data)
+        m = mask if mask is not None else m
+        lm = label_mask if label_mask is not None else lm
+    to = lambda a: None if a is None else jnp.asarray(a)
+    return to(x), to(y), to(m), to(lm)

@@ -1,0 +1,114 @@
+"""Legacy full-batch solvers (reference ``optimize/solvers/``: LBFGS,
+ConjugateGradient, LineGradientDescent, BackTrackLineSearch, terminations).
+Reference test model: ``deeplearning4j-core/src/test/.../optimizer/``."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Sgd
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.solvers import (BackTrackLineSearch,
+                                              ConjugateGradient, LBFGS,
+                                              EpsTermination,
+                                              LineGradientDescent,
+                                              Norm2Termination, Solver)
+
+
+def _toy_net(seed=3, n_in=4, n_out=3, hidden=8):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _toy_data(seed=0, n=60, n_in=4, n_cls=3):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    labels = (np.abs(x[:, 0]) + x[:, 1] > x[:, 2]).astype(int) + \
+        (x[:, 3] > 0.5).astype(int)
+    y = np.eye(n_cls, dtype=np.float32)[labels]
+    return x, y
+
+
+@pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient,
+                                 LBFGS])
+def test_full_batch_solvers_reduce_loss(cls):
+    net = _toy_net()
+    x, y = _toy_data()
+    s0 = net.score(x=x, y=y)
+    opt = cls(max_iterations=40)
+    s1 = opt.optimize(net, x, y)
+    assert s1 < 0.6 * s0, (cls.__name__, s0, s1)
+    # monotone non-increasing scores (line search guarantees no ascent)
+    h = opt.score_history
+    assert all(h[i + 1] <= h[i] + 1e-6 for i in range(len(h) - 1))
+
+
+def test_lbfgs_beats_steepest_descent():
+    """Curvature information must pay off on the same budget."""
+    xs, ys = _toy_data(seed=1)
+    net_a, net_b = _toy_net(seed=5), _toy_net(seed=5)
+    s_lgd = LineGradientDescent(max_iterations=25).optimize(net_a, xs, ys)
+    s_lbfgs = LBFGS(max_iterations=25).optimize(net_b, xs, ys)
+    assert s_lbfgs < s_lgd + 1e-6
+
+
+def test_lbfgs_converges_to_high_accuracy():
+    net = _toy_net()
+    x, y = _toy_data()
+    LBFGS(max_iterations=150,
+          terminations=[EpsTermination(1e-12)]).optimize(net, x, y)
+    acc = net.evaluate(x, y).accuracy()
+    assert acc > 0.95, acc
+
+
+def test_backtrack_line_search_armijo():
+    """On f(x)=||x||^2 from x0=[3,4] with d=-g the Armijo condition holds
+    and alpha stays in (0, 1]."""
+    ls = BackTrackLineSearch()
+    f = lambda v: jnp.vdot(v, v)
+    x0 = jnp.array([3.0, 4.0])
+    f0 = f(x0)
+    g = 2 * x0
+    d = -g
+    alpha, f_new = jax.jit(lambda: ls.search(f, x0, f0, g, d))()
+    alpha, f_new = float(alpha), float(f_new)
+    assert 0 < alpha <= 1.0
+    assert f_new <= float(f0) + 1e-4 * alpha * float(jnp.vdot(g, d)) + 1e-6
+
+
+def test_terminations():
+    assert EpsTermination(1e-4).terminate(1.0, 1.0 - 1e-6, 1.0)
+    assert not EpsTermination(1e-4).terminate(1.0, 0.9, 1.0)
+    assert Norm2Termination(1e-3).terminate(1.0, 0.5, 1e-5)
+    assert not Norm2Termination(1e-3).terminate(1.0, 0.5, 1.0)
+
+
+def test_solver_facade_and_unknown_algo():
+    net = _toy_net()
+    x, y = _toy_data()
+    s = Solver(net, "conjugate_gradient", max_iterations=15).optimize(x, y)
+    assert np.isfinite(s)
+    with pytest.raises(ValueError, match="available"):
+        Solver(net, "newton_raphson")
+
+
+def test_fit_dispatches_on_optimization_algo():
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater(Sgd(learning_rate=0.1))
+            .optimization_algo("lbfgs", max_iterations=60).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = _toy_data()
+    s0 = net.score(x=x, y=y)
+    net.fit(x, y)
+    assert net.score() < 0.5 * s0
