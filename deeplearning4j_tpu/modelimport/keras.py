@@ -36,7 +36,7 @@ from ..nn.multilayer import MultiLayerNetwork
 from .hdf5 import Hdf5File, Hdf5FormatError
 
 __all__ = ["KerasModelImport", "KerasImportError",
-           "import_keras_sequential_model"]
+           "import_keras_sequential_model", "import_keras_model"]
 
 
 class KerasImportError(ValueError):
@@ -312,13 +312,26 @@ def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
     net = MultiLayerNetwork(conf).init()
 
     groups = _layer_weight_groups(f)
-    for i, (lm, lname) in enumerate(zip(maps, mapped_names)):
-        w = groups.get(lname, {})
-        if lm.copy is None:
+    _copy_weights_into(groups, [
+        (lname, lm.copy, net.params.get(f"layer_{i}", {}),
+         net.state.setdefault(f"layer_{i}", {}))
+        for i, (lm, lname) in enumerate(zip(maps, mapped_names))])
+    # re-materialize as jax arrays
+    import jax.numpy as jnp
+    import jax
+    net.params = jax.tree_util.tree_map(jnp.asarray, net.params)
+    net.state = jax.tree_util.tree_map(jnp.asarray, net.state)
+    return net
+
+
+def _copy_weights_into(groups, items) -> None:
+    """Shared weight-copy loop.  items: (keras_name, copy_fn, target_params,
+    target_state) per mapped layer."""
+    for lname, copy_fn, target, st in items:
+        if copy_fn is None:
             continue
-        params = lm.copy(w)
+        params = copy_fn(groups.get(lname, {}))
         state_extra = params.pop("__state__", None)
-        target = net.params.get(f"layer_{i}", {})
         for pname, val in params.items():
             if val is None:
                 raise KerasImportError(
@@ -334,23 +347,119 @@ def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
                     f"layer {lname}: shape mismatch for '{pname}': "
                     f"keras {val.shape} vs ours {tuple(target[pname].shape)}")
             target[pname] = val
-        if state_extra:
-            st = net.state.get(f"layer_{i}", {})
+        if state_extra and st is not None:
             if state_extra.get("mean") is not None:
                 st["mean"] = np.asarray(state_extra["mean"], np.float32)
             if state_extra.get("var") is not None:
                 st["var"] = np.asarray(state_extra["var"], np.float32)
-    # re-materialize as jax arrays
-    import jax.numpy as jnp
+
+
+# Keras merge-layer class -> our graph vertex
+_MERGE_ELEMENTWISE = {"Add": "add", "Subtract": "subtract",
+                      "Multiply": "product", "Average": "average",
+                      "Maximum": "max"}
+# Keras 1 Merge(mode=...) -> op
+_MERGE_MODE = {"sum": "add", "mul": "product", "ave": "average",
+               "max": "max", "concat": None}
+
+
+def _inbound_names(layer: Dict[str, Any]) -> List[str]:
+    """First inbound node's source layer names (Keras 1 and 2 formats)."""
+    nodes = layer.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    node = nodes[0]
+    if isinstance(node, dict):  # Keras 3-style {"args": ...} unsupported
+        raise KerasImportError("unsupported inbound_nodes format (Keras 3)")
+    return [entry[0] for entry in node]
+
+
+def import_keras_model(path_or_bytes):
+    """Load a Keras functional ``Model`` save file into a ComputationGraph
+    (reference ``KerasModelImport.importKerasModelAndWeights`` →
+    ``KerasModel.java`` building a CG).  Sequential files are delegated to
+    :func:`import_keras_sequential_model`."""
+    from ..nn.conf.computation_graph import (ElementWiseVertex, GraphBuilder,
+                                             MergeVertex)
+    from ..nn.computation_graph import ComputationGraph
+
+    f = Hdf5File(path_or_bytes)
+    raw = f.attrs.get("model_config")
+    if raw is None:
+        raise KerasImportError("no model_config attribute in the file")
+    config = json.loads(raw if isinstance(raw, str) else str(raw))
+    cls_name = config.get("class_name")
+    if cls_name == "Sequential":
+        return import_keras_sequential_model(path_or_bytes)
+    if cls_name not in ("Model", "Functional"):
+        raise KerasImportError(f"unsupported model class '{cls_name}'")
+    cfg = config["config"]
+    layers = cfg["layers"]
+    out_names = [o[0] for o in cfg["output_layers"]]
+
+    g = GraphBuilder(defaults={"updater": Sgd(learning_rate=0.01)})
+    alias: Dict[str, str] = {}      # skipped layers forward to their input
+    copy_items: List[Tuple[str, Any]] = []
+    input_types: List[InputType] = []
+
+    def resolve(names: List[str]) -> List[str]:
+        return [alias.get(n, n) for n in names]
+
+    for l in layers:
+        cls = l["class_name"]
+        conf = _cfg(l)
+        name = l.get("name") or conf.get("name")
+        inbound = resolve(_inbound_names(l))
+        if cls == "InputLayer" or not inbound:
+            it = _input_type_from(conf)
+            if it is None:
+                raise KerasImportError(
+                    f"input layer '{name}' has no batch_input_shape")
+            g.add_inputs(name)
+            input_types.append(it)
+            continue
+        if cls in _MERGE_ELEMENTWISE:
+            g.add_vertex(name, ElementWiseVertex(op=_MERGE_ELEMENTWISE[cls]),
+                         *inbound)
+            continue
+        if cls in ("Concatenate", "Merge"):
+            mode = conf.get("mode", "concat")
+            if cls == "Concatenate" or _MERGE_MODE.get(mode) is None:
+                g.add_vertex(name, MergeVertex(), *inbound)
+            else:
+                g.add_vertex(name, ElementWiseVertex(op=_MERGE_MODE[mode]),
+                             *inbound)
+            continue
+        lm = _map_layer(cls, conf, is_last=name in out_names)
+        if lm.conf is None:  # Flatten: auto preprocessor handles reshapes
+            alias[name] = inbound[0]
+            continue
+        g.add_layer(name, lm.conf, *inbound)
+        copy_items.append((name, lm.copy))
+
+    conf_built = (g.set_outputs(*resolve(out_names))
+                  .set_input_types(*input_types).build())
+    net = ComputationGraph(conf_built).init()
+    groups = _layer_weight_groups(f)
+    _copy_weights_into(groups, [
+        (lname, copy_fn, net.params.get(lname, {}),
+         net.state.setdefault(lname, {}))
+        for lname, copy_fn in copy_items])
     import jax
+    import jax.numpy as jnp
     net.params = jax.tree_util.tree_map(jnp.asarray, net.params)
     net.state = jax.tree_util.tree_map(jnp.asarray, net.state)
     return net
 
 
 class KerasModelImport:
-    """Entry points (reference ``KerasModelImport.java``)."""
+    """Entry points (reference ``KerasModelImport.java:50-157``)."""
 
     @staticmethod
     def import_keras_sequential_model_and_weights(path) -> MultiLayerNetwork:
         return import_keras_sequential_model(path)
+
+    @staticmethod
+    def import_keras_model_and_weights(path):
+        """Functional (or Sequential) model → ComputationGraph (or MLN)."""
+        return import_keras_model(path)
