@@ -3,10 +3,11 @@
 TPU-native re-design of reference ``deeplearning4j-graph`` (SURVEY.md §2.6).
 """
 from .deepwalk import DeepWalk
+from .node2vec import Node2Vec, Node2VecWalkIterator
 from .graph import (Edge, Graph, GraphWalkIterator, NoEdgeHandling,
                     NoEdgesException, RandomWalkIterator, Vertex,
                     WeightedRandomWalkIterator, load_edge_list)
 
-__all__ = ["DeepWalk", "Edge", "Graph", "GraphWalkIterator", "NoEdgeHandling",
+__all__ = ["DeepWalk", "Node2Vec", "Node2VecWalkIterator", "Edge", "Graph", "GraphWalkIterator", "NoEdgeHandling",
            "NoEdgesException", "RandomWalkIterator", "Vertex",
            "WeightedRandomWalkIterator", "load_edge_list"]

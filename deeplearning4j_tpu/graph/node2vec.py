@@ -1,0 +1,109 @@
+"""Node2Vec: biased second-order random walks + skip-gram embeddings.
+
+Reference ``deeplearning4j-nlp-parent/.../models/node2vec/`` (Node2Vec atop
+the SequenceVectors engine).  The walk bias follows the node2vec paper
+(Grover & Leskovec 2016): from edge (t -> v), the unnormalized probability
+of stepping to x is
+
+    w(v,x)/p  if x == t            (return)
+    w(v,x)    if x adjacent to t   (BFS-ish)
+    w(v,x)/q  otherwise            (DFS-ish)
+
+Walk generation is host-side (feeds the vocab/batcher pipeline); training
+is DeepWalk's jitted hierarchical-softmax skip-gram step.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from .deepwalk import DeepWalk
+from .graph import Graph, GraphWalkIterator, NoEdgeHandling, NoEdgesException
+
+__all__ = ["Node2Vec", "Node2VecWalkIterator"]
+
+
+class Node2VecWalkIterator(GraphWalkIterator):
+    """Second-order biased walks (p = return parameter, q = in-out
+    parameter; p = q = 1 degenerates to RandomWalkIterator)."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0,
+                 no_edge_handling: str = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+                 seed: int = 123):
+        super().__init__(graph, walk_length, no_edge_handling, seed)
+        if p <= 0 or q <= 0:
+            raise ValueError(f"p and q must be positive, got p={p} q={q}")
+        self.p = float(p)
+        self.q = float(q)
+        # neighbor sets for the O(1) "is x adjacent to t" test
+        self._nbrs = [set(graph.get_connected_vertex_indices(i))
+                      for i in range(graph.num_vertices())]
+
+    def _step(self, prev: int, cur: int, rng) -> int:
+        edges = self.graph.get_edges_out(cur)
+        if len(edges) == 1:
+            return edges[0].to
+        w = np.empty(len(edges), np.float64)
+        prev_nbrs = self._nbrs[prev]
+        for i, e in enumerate(edges):
+            wt = e.weight
+            if e.to == prev:
+                w[i] = wt / self.p
+            elif e.to in prev_nbrs:
+                w[i] = wt
+            else:
+                w[i] = wt / self.q
+        s = w.sum()
+        if s <= 0:
+            return edges[int(rng.integers(0, len(edges)))].to
+        return edges[int(rng.choice(len(edges), p=w / s))].to
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng((self.seed, self._epoch))
+        self._epoch += 1
+        g = self.graph
+        for start in rng.permutation(g.num_vertices()):
+            cur = int(start)
+            walk = [cur]
+            prev = -1
+            for _ in range(self.walk_length):
+                deg = g.get_vertex_degree(cur)
+                if deg == 0:
+                    if self.no_edge_handling == \
+                            NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                        raise NoEdgesException(
+                            f"vertex {cur} has no edges mid-walk")
+                    walk.append(cur)
+                    continue
+                if prev < 0:  # first step: uniform/weight-proportional
+                    nxt = g.get_random_connected_vertex(cur, rng)
+                else:
+                    nxt = self._step(prev, cur, rng)
+                prev, cur = cur, int(nxt)
+                walk.append(cur)
+            yield walk
+
+
+class Node2Vec(DeepWalk):
+    """Node2Vec trainer: DeepWalk with p/q-biased walk generation
+    (reference ``models/node2vec/Node2Vec.java``)."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 p: float = 1.0, q: float = 1.0,
+                 learning_rate: float = 0.025, seed: int = 123,
+                 batch_size: int = 512, epochs: int = 1):
+        super().__init__(vector_size=vector_size, window_size=window_size,
+                         learning_rate=learning_rate, seed=seed,
+                         batch_size=batch_size, epochs=epochs)
+        self.p = p
+        self.q = q
+
+    def fit(self, walks=None, walk_length: int = 40) -> None:
+        if isinstance(walks, Graph):
+            if self.graph is None:
+                self.initialize(walks)
+            walks = Node2VecWalkIterator(walks, walk_length, p=self.p,
+                                         q=self.q, seed=self.seed)
+        super().fit(walks, walk_length)

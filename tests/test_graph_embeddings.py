@@ -100,3 +100,44 @@ def test_deepwalk_fit_graph_convenience():
     dw = DeepWalk(vector_size=8, epochs=2, seed=1)
     dw.fit(g, walk_length=10)  # initialize + default iterator in one call
     assert dw.get_vertex_vector(0).shape == (8,)
+
+
+def test_node2vec_walk_bias():
+    """With q >> 1 the walk stays local (BFS-like): steps to vertices not
+    adjacent to the previous vertex become rare."""
+    from deeplearning4j_tpu.graph import Node2VecWalkIterator
+    # barbell: clique {0,1,2}, bridge 2-3, clique {3,4,5}
+    g = Graph(6)
+    for a, b in [(0, 1), (0, 2), (1, 2), (2, 3), (3, 4), (3, 5), (4, 5)]:
+        g.add_edge(a, b)
+
+    def cross_rate(p, q, seed=5):
+        it = Node2VecWalkIterator(g, walk_length=30, p=p, q=q, seed=seed)
+        crossings = total = 0
+        for walk in it:
+            for a, b in zip(walk, walk[1:]):
+                total += 1
+                if {a, b} == {2, 3}:
+                    crossings += 1
+        return crossings / total
+
+    local = cross_rate(p=1.0, q=8.0)     # discourage exploration
+    explore = cross_rate(p=1.0, q=0.125)  # encourage exploration
+    assert explore > local, (explore, local)
+
+
+def test_node2vec_embeds_cliques_apart():
+    from deeplearning4j_tpu.graph import Node2Vec
+    g = two_clique_graph()
+    n2v = Node2Vec(vector_size=16, window_size=3, p=0.5, q=2.0,
+                   learning_rate=0.05, seed=3, batch_size=256, epochs=8)
+    n2v.fit(g, walk_length=20)
+    intra = n2v.similarity_vertices(0, 1)
+    inter = n2v.similarity_vertices(0, 7)
+    assert intra > inter + 0.1, (intra, inter)
+
+
+def test_node2vec_rejects_bad_params():
+    from deeplearning4j_tpu.graph import Node2VecWalkIterator
+    with pytest.raises(ValueError, match="positive"):
+        Node2VecWalkIterator(Graph(2), 5, p=0.0)
