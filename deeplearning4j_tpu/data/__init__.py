@@ -3,13 +3,18 @@
 from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       DataSetIterator, EarlyTerminationDataSetIterator,
                       ExistingDataSetIterator, INDArrayDataSetIterator,
-                      MultipleEpochsIterator, SamplingDataSetIterator)
+                      MovingWindowDataSetIterator, MultipleEpochsIterator,
+                      SamplingDataSetIterator)
+from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
+                       LFWDataSetIterator, TinyImageNetDataSetIterator)
 from .mnist import IrisDataSetIterator, MnistDataSetIterator
 
 __all__ = [
     "AsyncDataSetIterator", "BenchmarkDataSetIterator", "DataSet",
     "DataSetIterator", "EarlyTerminationDataSetIterator",
     "ExistingDataSetIterator", "INDArrayDataSetIterator",
-    "IrisDataSetIterator", "MnistDataSetIterator", "MultipleEpochsIterator",
-    "SamplingDataSetIterator",
+    "IrisDataSetIterator", "MnistDataSetIterator", "MovingWindowDataSetIterator",
+    "MultipleEpochsIterator", "SamplingDataSetIterator",
+    "CifarDataSetIterator", "EmnistDataSetIterator", "LFWDataSetIterator",
+    "TinyImageNetDataSetIterator",
 ]

@@ -214,6 +214,48 @@ class SamplingDataSetIterator(DataSetIterator):
             yield DataSet(self.dataset.features[sl], self.dataset.labels[sl])
 
 
+class MovingWindowDataSetIterator(DataSetIterator):
+    """Slide a (rows, cols) window over each image example, emitting one
+    sub-image example per window position with the source label (reference
+    ``MovingWindowBaseDataSetIterator`` + ``util/MovingWindowMatrix.java``).
+    Features [n, h, w] or [n, h, w, c]; stride defaults to the window size
+    (non-overlapping, the reference's behavior)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, window_rows: int,
+                 window_cols: int, stride_rows: Optional[int] = None,
+                 stride_cols: Optional[int] = None):
+        feats = np.asarray(dataset.features)
+        if feats.ndim not in (3, 4):
+            raise ValueError(
+                f"MovingWindow needs image features [n,h,w(,c)], got "
+                f"shape {feats.shape}")
+        labels = np.asarray(dataset.labels)
+        sr = stride_rows or window_rows
+        sc = stride_cols or window_cols
+        h, w = feats.shape[1], feats.shape[2]
+        if window_rows > h or window_cols > w:
+            raise ValueError(f"window ({window_rows},{window_cols}) exceeds "
+                             f"image ({h},{w})")
+        wins, labs = [], []
+        for r0 in range(0, h - window_rows + 1, sr):
+            for c0 in range(0, w - window_cols + 1, sc):
+                wins.append(feats[:, r0:r0 + window_rows,
+                                  c0:c0 + window_cols])
+                labs.append(labels)
+        self._inner = INDArrayDataSetIterator(
+            np.concatenate(wins), np.concatenate(labs), batch_size,
+            shuffle=False)
+
+    def batch(self):
+        return self._inner.batch()
+
+    def reset(self):
+        self._inner.reset()
+
+    def __iter__(self):
+        return iter(self._inner)
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference
     ``datasets/iterator/AsyncDataSetIterator.java:30`` + MagicQueue).  The

@@ -210,3 +210,55 @@ class TinyImageNetDataSetIterator(INDArrayDataSetIterator):
         if not images:
             return None
         return np.stack(images), np.asarray(labels, np.int64)
+
+
+class LFWDataSetIterator(INDArrayDataSetIterator):
+    """LFW faces (reference ``LFWDataSetIterator.java`` /
+    ``LFWDataFetcher``): person-labeled face images read from the standard
+    extracted layout under ``LFW_DIR`` (<person_name>/<img>.jpg), synthetic
+    otherwise.  Features NHWC [n, hw, hw, 3] in [0,1]; labels one-hot over
+    the ``num_labels`` most-photographed people."""
+
+    def __init__(self, batch_size: int, hw: int = 64, num_labels: int = 10,
+                 num_examples: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 6):
+        self.hw = hw
+        data = self._load_real(hw, num_labels, num_examples)
+        self.synthetic = data is None
+        if data is None:
+            n = num_examples or 1024
+            images, labels = _synthetic_images(n, hw, 3, num_labels, seed=21)
+        else:
+            images, labels = data
+        feats = images.astype(np.float32) / 255.0
+        onehot = np.eye(num_labels, dtype=np.float32)[labels]
+        super().__init__(feats, onehot, batch_size, shuffle=shuffle, seed=seed)
+
+    @staticmethod
+    def _load_real(hw: int, num_labels: int, num_examples: Optional[int]):
+        d = os.environ.get("LFW_DIR")
+        if not d or not Path(d).expanduser().is_dir():
+            return None
+        try:
+            from PIL import Image
+        except ImportError:
+            return None
+        root = Path(d).expanduser()
+        people = [(p, sorted(p.glob("*.jpg")))
+                  for p in sorted(root.iterdir()) if p.is_dir()]
+        people = [(p, fs) for p, fs in people if fs]
+        people.sort(key=lambda t: -len(t[1]))
+        people = people[:num_labels]
+        images, labels = [], []
+        for ci, (_, files) in enumerate(people):
+            for jp in files:
+                images.append(np.asarray(
+                    Image.open(jp).convert("RGB").resize((hw, hw))))
+                labels.append(ci)
+                if num_examples and len(images) >= num_examples:
+                    break
+            if num_examples and len(images) >= num_examples:
+                break
+        if not images:
+            return None
+        return np.stack(images), np.asarray(labels, np.int64)

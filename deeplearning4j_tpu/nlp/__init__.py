@@ -5,7 +5,10 @@ SURVEY.md): the SequenceVectors engine's native AggregateSkipGram/CBOW hot
 loop becomes jitted scatter-add batches; tokenization and vocab stay on the
 host.
 """
+from .cjk import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
+                  KoreanTokenizerFactory)
 from .glove import Glove
+from .inverted_index import InvertedIndex, KeywordExtractor
 from .lookup_table import InMemoryLookupTable
 from .paragraph_vectors import ParagraphVectors
 from .sentence_iterator import (AggregatingSentenceIterator, BasicLineIterator,
@@ -31,6 +34,8 @@ from .word2vec import Word2Vec
 from .word_vectors import WordVectors
 
 __all__ = [
+    "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+    "KoreanTokenizerFactory", "InvertedIndex", "KeywordExtractor",
     "Glove", "InMemoryLookupTable", "ParagraphVectors", "SequenceVectors",
     "Word2Vec", "WordVectors", "VocabCache", "VocabConstructor", "VocabWord",
     "build_huffman", "make_unigram_table", "subsample_keep_prob",

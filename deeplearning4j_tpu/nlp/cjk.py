@@ -1,0 +1,166 @@
+"""CJK tokenizer factories (Chinese / Japanese / Korean).
+
+Reference ``deeplearning4j-nlp-chinese`` (vendored ansj segmenter),
+``deeplearning4j-nlp-japanese`` (vendored kuromoji), and
+``deeplearning4j-nlp-korean`` TokenizerFactory wrappers.  The reference
+vendors full morphological analyzers (~20k LoC of dictionaries); the
+TPU build provides the same factory API over dictionary-less segmentation
+(per-character for Han, script-run for Japanese, whitespace+particle-strip
+for Korean) with an optional user dictionary for greedy longest-match —
+exact morphology can be plugged in by supplying a richer dictionary, the
+factory contract is what the pipeline depends on.
+"""
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Iterable, List, Optional, Sequence, Set
+
+from .tokenization import TokenPreProcess, Tokenizer, TokenizerFactory
+
+__all__ = ["ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+           "KoreanTokenizerFactory"]
+
+
+def _is_han(ch: str) -> bool:
+    return "一" <= ch <= "鿿" or "㐀" <= ch <= "䶿"
+
+
+def _is_hiragana(ch: str) -> bool:
+    return "぀" <= ch <= "ゟ"
+
+
+def _is_katakana(ch: str) -> bool:
+    return "゠" <= ch <= "ヿ"
+
+
+def _is_hangul(ch: str) -> bool:
+    return "가" <= ch <= "힯" or "ᄀ" <= ch <= "ᇿ"
+
+
+def _script(ch: str) -> str:
+    if _is_han(ch):
+        return "han"
+    if _is_hiragana(ch):
+        return "hira"
+    if _is_katakana(ch):
+        return "kata"
+    if _is_hangul(ch):
+        return "hangul"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+def _greedy_dict_segment(text: str, dictionary: Set[str],
+                         max_len: int) -> List[str]:
+    """Greedy longest-match over a user dictionary; single chars fall out
+    as themselves."""
+    out: List[str] = []
+    i = 0
+    n = len(text)
+    while i < n:
+        for ln in range(min(max_len, n - i), 1, -1):
+            if text[i:i + ln] in dictionary:
+                out.append(text[i:i + ln])
+                i += ln
+                break
+        else:
+            out.append(text[i])
+            i += 1
+    return out
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """Reference ``ChineseTokenizerFactory.java`` (ansj).  Han runs are
+    segmented per character, or by greedy longest-match when a
+    ``dictionary`` of known words is supplied; non-Han runs tokenize like
+    the default whitespace tokenizer."""
+
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
+                 dictionary: Optional[Iterable[str]] = None):
+        super().__init__(pre_processor)
+        self.dictionary: Set[str] = set(dictionary or ())
+        self._max_word = max((len(w) for w in self.dictionary), default=1)
+
+    def create(self, sentence: str) -> Tokenizer:
+        tokens: List[str] = []
+        run = ""
+        run_kind = None  # 'han' | 'other'
+
+        def flush():
+            nonlocal run
+            if not run:
+                return
+            if run_kind == "han":
+                if self.dictionary:
+                    tokens.extend(_greedy_dict_segment(
+                        run, self.dictionary, self._max_word))
+                else:
+                    tokens.extend(run)
+            else:
+                tokens.extend(run.split())
+            run = ""
+
+        for ch in sentence:
+            kind = "han" if _is_han(ch) else "other"
+            if kind != run_kind:
+                flush()
+                run_kind = kind
+            run += ch
+        flush()
+        return Tokenizer([t for t in tokens if t.strip()], self._pre)
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Reference ``JapaneseTokenizerFactory.java`` (kuromoji).  Segments on
+    script-run boundaries (kanji / hiragana / katakana / latin) — the
+    standard lightweight fallback; hiragana runs commonly carry particles
+    and inflections, so they stay separate tokens."""
+
+    def create(self, sentence: str) -> Tokenizer:
+        tokens: List[str] = []
+        run = ""
+        run_kind = None
+        for ch in sentence:
+            kind = _script(ch)
+            if kind != run_kind:
+                if run and run_kind not in ("space", "punct"):
+                    tokens.append(run)
+                run = ""
+                run_kind = kind
+            run += ch
+        if run and run_kind not in ("space", "punct"):
+            tokens.append(run)
+        return Tokenizer(tokens, self._pre)
+
+
+_KO_PARTICLES = ("은", "는", "이", "가", "을", "를", "의", "에", "에서",
+                 "으로", "로", "와", "과", "도", "만", "께서", "까지")
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Reference ``KoreanTokenizerFactory.java``.  Korean spaces between
+    words (eojeol); tokens are whitespace-split with trailing particles
+    (josa) optionally stripped."""
+
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
+                 strip_particles: bool = True):
+        super().__init__(pre_processor)
+        self.strip_particles = strip_particles
+
+    def create(self, sentence: str) -> Tokenizer:
+        words = re.findall(r"[\w가-힯]+", sentence)
+        if self.strip_particles:
+            out = []
+            for w in words:
+                for p in sorted(_KO_PARTICLES, key=len, reverse=True):
+                    if len(w) > len(p) and w.endswith(p) and \
+                            _is_hangul(w[0]):
+                        w = w[: -len(p)]
+                        break
+                out.append(w)
+            words = out
+        return Tokenizer(words, self._pre)

@@ -1,0 +1,92 @@
+"""Viterbi decoder — most-likely hidden-state path.
+
+Reference ``deeplearning4j-nn/.../util/Viterbi.java`` (max-product decoding
+over a label sequence).  TPU-native: the forward max-product recursion is a
+``lax.scan`` over time with backpointers collected on-device; the backtrace
+is a second (reversed) scan — one jitted program, no host loop.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Viterbi", "viterbi_decode"]
+
+
+@jax.jit
+def _decode(log_emissions: jax.Array, log_transitions: jax.Array,
+            log_prior: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """log_emissions [t, s]; log_transitions [s, s] (row=from, col=to);
+    log_prior [s].  Returns (path [t] int32, best_log_prob scalar)."""
+
+    def step(alpha, emit):
+        # alpha [s]: best log-prob ending in each state at t-1
+        scores = alpha[:, None] + log_transitions  # [from, to]
+        back = jnp.argmax(scores, axis=0)          # [to]
+        alpha = jnp.max(scores, axis=0) + emit
+        return alpha, back
+
+    alpha0 = log_prior + log_emissions[0]
+    alpha, backs = jax.lax.scan(step, alpha0, log_emissions[1:])
+    last = jnp.argmax(alpha)
+
+    def trace(state, back):
+        return back[state], state
+
+    first, rest = jax.lax.scan(trace, last, backs, reverse=True)
+    path = jnp.concatenate([first[None], rest]).astype(jnp.int32)
+    return path, alpha[last]
+
+
+def viterbi_decode(emissions, transitions, prior=None, log_space: bool = False
+                   ) -> Tuple[np.ndarray, float]:
+    """Decode one sequence.  emissions [t, s] (probabilities, or log-probs
+    with ``log_space=True``); transitions [s, s]; prior [s] (uniform when
+    omitted).  Returns (state path [t], log-probability of the path)."""
+    e = jnp.asarray(emissions, jnp.float32)
+    tr = jnp.asarray(transitions, jnp.float32)
+    s = e.shape[-1]
+    p = (jnp.full((s,), 1.0 / s, jnp.float32) if prior is None
+         else jnp.asarray(prior, jnp.float32))
+    if not log_space:
+        tiny = jnp.finfo(jnp.float32).tiny
+        e, tr, p = (jnp.log(jnp.maximum(x, tiny)) for x in (e, tr, p))
+    path, logp = _decode(e, tr, p)
+    return np.asarray(path), float(logp)
+
+
+class Viterbi:
+    """Stateful facade (reference ``Viterbi.java``): fix the label set and
+    transition structure once, decode many sequences (vmappable)."""
+
+    def __init__(self, possible_labels, transitions=None, prior=None):
+        self.labels = list(possible_labels)
+        n = len(self.labels)
+        if transitions is None:
+            # reference default: strong self-transition bias
+            transitions = np.full((n, n), 0.25 / max(n - 1, 1))
+            np.fill_diagonal(transitions, 0.75)
+        self.transitions = np.asarray(transitions, np.float32)
+        self.prior = prior
+        self._batched = jax.jit(jax.vmap(_decode, in_axes=(0, None, None)))
+
+    def decode(self, emissions) -> Tuple[np.ndarray, float]:
+        """[t, s] emissions → (labels [t], log-prob)."""
+        path, logp = viterbi_decode(emissions, self.transitions, self.prior)
+        return np.asarray([self.labels[i] for i in path]), logp
+
+    def decode_batch(self, emissions) -> Tuple[np.ndarray, np.ndarray]:
+        """[b, t, s] emissions → (paths [b, t] int32, log-probs [b])."""
+        e = jnp.log(jnp.maximum(jnp.asarray(emissions, jnp.float32),
+                                jnp.finfo(jnp.float32).tiny))
+        tr = jnp.log(jnp.maximum(jnp.asarray(self.transitions),
+                                 jnp.finfo(jnp.float32).tiny))
+        n = len(self.labels)
+        p = (jnp.full((n,), -np.log(n), jnp.float32) if self.prior is None
+             else jnp.log(jnp.maximum(jnp.asarray(self.prior, jnp.float32),
+                                      jnp.finfo(jnp.float32).tiny)))
+        paths, logps = self._batched(e, tr, p)
+        return np.asarray(paths), np.asarray(logps)

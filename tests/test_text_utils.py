@@ -1,0 +1,129 @@
+"""CJK tokenizers, inverted index / keyword extraction, Viterbi, moving
+window + LFW iterators (reference: deeplearning4j-nlp-chinese/-japanese/
+-korean factories, text/invertedindex, util/Viterbi.java,
+MovingWindowBaseDataSetIterator, LFWDataSetIterator)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (DataSet, LFWDataSetIterator,
+                                     MovingWindowDataSetIterator)
+from deeplearning4j_tpu.nlp import (ChineseTokenizerFactory, InvertedIndex,
+                                    JapaneseTokenizerFactory,
+                                    KeywordExtractor, KoreanTokenizerFactory)
+from deeplearning4j_tpu.utils.viterbi import Viterbi, viterbi_decode
+
+
+class TestCjkTokenizers:
+    def test_chinese_per_char(self):
+        toks = ChineseTokenizerFactory().create("我爱北京 hello").get_tokens()
+        assert toks == ["我", "爱", "北", "京", "hello"]
+
+    def test_chinese_dictionary_longest_match(self):
+        tf = ChineseTokenizerFactory(dictionary=["北京", "天安门"])
+        assert tf.create("我爱北京天安门").get_tokens() == \
+            ["我", "爱", "北京", "天安门"]
+
+    def test_japanese_script_runs(self):
+        toks = JapaneseTokenizerFactory().create(
+            "東京タワーへいく").get_tokens()
+        assert toks == ["東京", "タワー", "へいく"]
+
+    def test_korean_particle_strip(self):
+        toks = KoreanTokenizerFactory().create("나는 학교에 간다").get_tokens()
+        assert toks == ["나", "학교", "간다"]
+        raw = KoreanTokenizerFactory(strip_particles=False).create(
+            "나는 학교에 간다").get_tokens()
+        assert raw == ["나는", "학교에", "간다"]
+
+
+class TestInvertedIndex:
+    def _index(self):
+        ix = InvertedIndex()
+        ix.add_documents(["the quick brown fox",
+                          "the lazy dog",
+                          "quick quick dog"])
+        return ix
+
+    def test_postings_and_counts(self):
+        ix = self._index()
+        assert ix.num_documents() == 3
+        assert ix.total_words() == 10
+        assert ix.documents("quick") == [0, 2]
+        assert ix.term_frequency("quick", 2) == 2
+        assert ix.document_frequency("the") == 2
+        assert ix.positions("dog", 2) == [2]
+
+    def test_search_ranked(self):
+        ix = self._index()
+        assert ix.search("quick") == [2, 0]       # tf 2 beats tf 1
+        assert ix.search("quick", "dog") == [2]   # conjunctive
+        assert ix.search("missing") == []
+
+    def test_keywords(self):
+        ix = self._index()
+        kws = KeywordExtractor(ix).keywords(0, top_n=2)
+        words = [w for w, _ in kws]
+        # 'the' appears in 2/3 docs -> low idf; fox/brown are doc-specific
+        assert "the" not in words
+        assert set(words) <= {"quick", "brown", "fox"}
+        corpus = KeywordExtractor(ix).corpus_keywords(top_n=3)
+        assert all(s > 0 for _, s in corpus)
+
+
+class TestViterbi:
+    def test_decode_recovers_clean_path(self):
+        # 2 states, near-deterministic emissions
+        e = np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9], [0.2, 0.8]])
+        t = np.array([[0.7, 0.3], [0.3, 0.7]])
+        path, logp = viterbi_decode(e, t)
+        assert path.tolist() == [0, 0, 1, 1]
+        assert np.isfinite(logp) and logp < 0
+
+    def test_transition_bias_smooths_noise(self):
+        # a single noisy frame is overridden by sticky transitions
+        e = np.array([[0.9, 0.1], [0.45, 0.55], [0.9, 0.1], [0.9, 0.1]])
+        v = Viterbi([0, 1])  # default 0.75 self-transition
+        labels, _ = v.decode(e)
+        assert labels.tolist() == [0, 0, 0, 0]
+
+    def test_batch_decode_matches_single(self):
+        rng = np.random.default_rng(3)
+        e = rng.uniform(0.05, 1.0, (4, 7, 3))
+        e /= e.sum(-1, keepdims=True)
+        v = Viterbi(["a", "b", "c"])
+        paths, logps = v.decode_batch(e)
+        assert paths.shape == (4, 7)
+        for i in range(4):
+            single, lp = viterbi_decode(e[i], v.transitions)
+            np.testing.assert_array_equal(paths[i], single)
+            assert abs(lp - float(logps[i])) < 1e-4
+
+
+class TestMovingWindowAndLfw:
+    def test_moving_window_tiles(self):
+        feats = np.arange(2 * 4 * 4, dtype=np.float32).reshape(2, 4, 4)
+        labels = np.eye(2, dtype=np.float32)
+        it = MovingWindowDataSetIterator(DataSet(feats, labels), batch_size=8,
+                                         window_rows=2, window_cols=2)
+        batches = list(it)
+        x = np.concatenate([np.asarray(b.features) for b in batches])
+        y = np.concatenate([np.asarray(b.labels) for b in batches])
+        assert x.shape == (8, 2, 2)      # 4 windows x 2 examples
+        assert y.shape == (8, 2)
+        np.testing.assert_array_equal(x[0], feats[0, :2, :2])
+        np.testing.assert_array_equal(x[-1], feats[1, 2:, 2:])
+
+    def test_moving_window_rejects_flat(self):
+        with pytest.raises(ValueError, match="image features"):
+            MovingWindowDataSetIterator(
+                DataSet(np.zeros((2, 10)), np.zeros((2, 2))), 4, 2, 2)
+
+    def test_lfw_synthetic(self):
+        it = LFWDataSetIterator(batch_size=16, hw=32, num_labels=5,
+                                num_examples=64)
+        assert it.synthetic
+        b = next(iter(it))
+        assert np.asarray(b.features).shape == (16, 32, 32, 3)
+        assert np.asarray(b.labels).shape == (16, 5)
+        assert 0.0 <= float(np.asarray(b.features).min())
+        assert float(np.asarray(b.features).max()) <= 1.0
