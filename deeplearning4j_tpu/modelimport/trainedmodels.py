@@ -1,0 +1,99 @@
+"""Pretrained-model helpers: VGG16 preprocessing + ImageNet decoding.
+
+Reference ``deeplearning4j-modelimport/.../trainedmodels/`` —
+``TrainedModels.java`` (VGG16 / VGG16NOTOP enum with input preprocessing
+and prediction decoding) + ``util/imagenet_class_index``-style label table.
+This environment has no egress, so weights come from a user-supplied Keras
+HDF5 file (loaded through our importer) and labels from
+``IMAGENET_LABELS`` (one label per line, 1000 lines) with a ``class_<i>``
+fallback — decoding logic and preprocessing are fully functional either way.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["TrainedModels", "VGG16Helper", "ImageNetLabels"]
+
+# caffe-style channel means the VGG family was trained with (RGB order)
+VGG_MEAN_RGB = (123.68, 116.779, 103.939)
+
+
+class ImageNetLabels:
+    """1000-class label table (reference fetches a JSON index at runtime;
+    here: ``IMAGENET_LABELS`` file or positional fallback names)."""
+
+    def __init__(self, path: Optional[str] = None):
+        path = path or os.environ.get("IMAGENET_LABELS")
+        self._labels: List[str]
+        if path and Path(path).expanduser().exists():
+            lines = Path(path).expanduser().read_text(
+                encoding="utf-8").splitlines()
+            self._labels = [l.strip() for l in lines if l.strip()]
+        else:
+            self._labels = [f"class_{i}" for i in range(1000)]
+
+    def get_label(self, idx: int) -> str:
+        return self._labels[idx]
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def decode_predictions(self, probs, top: int = 5
+                           ) -> List[List[Tuple[str, float]]]:
+        """[b, 1000] probabilities → per-example [(label, prob)] top-k
+        (reference ``TrainedModels.VGG16.decodePredictions``)."""
+        p = np.asarray(probs)
+        if p.ndim == 1:
+            p = p[None]
+        out = []
+        for row in p:
+            idx = np.argsort(-row)[:top]
+            out.append([(self.get_label(int(i)), float(row[i]))
+                        for i in idx])
+        return out
+
+
+class VGG16Helper:
+    """Preprocess + predict + decode for VGG16 (reference
+    ``TrainedModels.VGG16``)."""
+
+    input_shape = (224, 224, 3)
+
+    def __init__(self, labels: Optional[ImageNetLabels] = None):
+        self.labels = labels or ImageNetLabels()
+
+    @staticmethod
+    def preprocess(images) -> np.ndarray:
+        """NHWC RGB uint8/float [0,255] → mean-subtracted float32 (the
+        caffe-style preprocessing VGG16 was trained with)."""
+        x = np.asarray(images, np.float32)
+        if x.ndim == 3:
+            x = x[None]
+        if x.max() <= 1.0 + 1e-6:
+            x = x * 255.0
+        return x - np.asarray(VGG_MEAN_RGB, np.float32)
+
+    def build_network(self, weights_path: Optional[str] = None):
+        """Fresh zoo VGG16, optionally loading Keras HDF5 weights through
+        the importer (no-egress stand-in for the reference's checksummed
+        download, ``ZooModel.java:40-81``)."""
+        if weights_path:
+            from .keras import import_keras_model
+            return import_keras_model(weights_path)
+        from ..models.zoo import VGG16
+        return VGG16().init()
+
+    def predict_and_decode(self, net, images, top: int = 5):
+        probs = net.output(self.preprocess(images))
+        if isinstance(probs, (list, tuple)):
+            probs = probs[0]
+        return self.labels.decode_predictions(np.asarray(probs), top=top)
+
+
+class TrainedModels:
+    """Enum-style access (reference ``TrainedModels.java``)."""
+    VGG16 = VGG16Helper()

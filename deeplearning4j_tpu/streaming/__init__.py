@@ -1,0 +1,18 @@
+"""Streaming: pub/sub of serialized arrays/DataSets + prediction routes.
+
+TPU-native re-design of reference ``dl4j-streaming`` (SURVEY.md §2.4):
+``NDArrayKafkaClient``/``NDArrayPublisher``/``NDArrayConsumer`` and the
+Camel routes (``CamelKafkaRouteBuilder``, ``DL4jServeRouteBuilder``).  Kafka
++ Camel are replaced by a broker abstraction with an in-process
+implementation and a TCP transport — same publish/subscribe/route API, no
+external infrastructure.
+"""
+from .broker import LocalMessageBroker, TcpMessageBroker
+from .codec import (deserialize_array, deserialize_dataset, serialize_array,
+                    serialize_dataset)
+from .ndarray_client import NDArrayConsumer, NDArrayPublisher
+from .routes import ServeRoute
+
+__all__ = ["LocalMessageBroker", "TcpMessageBroker", "NDArrayPublisher",
+           "NDArrayConsumer", "ServeRoute", "serialize_array",
+           "deserialize_array", "serialize_dataset", "deserialize_dataset"]

@@ -1,0 +1,196 @@
+"""Message brokers: in-process topics + a TCP transport.
+
+The Kafka stand-ins (reference wires ``CamelKafkaRouteBuilder`` to a real
+Kafka cluster).  ``LocalMessageBroker`` is thread-safe named topics with
+per-subscriber queues (fan-out, at-most-once like the reference's
+auto-commit consumer).  ``TcpMessageBroker`` serves the same API across
+processes over a length-prefixed socket protocol — the transport role
+Kafka plays, sized for test rigs and single-host pipelines.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["LocalMessageBroker", "TcpMessageBroker"]
+
+
+class _Subscription:
+    def __init__(self, maxsize: int):
+        self.q: "queue.Queue[bytes]" = queue.Queue(maxsize)
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        try:
+            return self.q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class LocalMessageBroker:
+    """Named topics; publish fans out to every subscriber's queue."""
+
+    def __init__(self, max_queue: int = 1024):
+        self.max_queue = max_queue
+        self._topics: Dict[str, List[_Subscription]] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._lock:
+            subs = list(self._topics.get(topic, ()))
+        for s in subs:
+            try:
+                s.q.put_nowait(payload)
+            except queue.Full:
+                # drop-oldest keeps slow consumers from stalling producers
+                try:
+                    s.q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    s.q.put_nowait(payload)
+                except queue.Full:
+                    pass
+
+    def subscribe(self, topic: str) -> _Subscription:
+        sub = _Subscription(self.max_queue)
+        with self._lock:
+            self._topics.setdefault(topic, []).append(sub)
+        return sub
+
+    def unsubscribe(self, topic: str, sub: _Subscription) -> None:
+        with self._lock:
+            subs = self._topics.get(topic, [])
+            if sub in subs:
+                subs.remove(sub)
+
+    def close(self) -> None:
+        with self._lock:
+            self._topics.clear()
+
+
+# --------------------------------------------------------------------- TCP
+# frame: op(1: 0=pub 1=sub) topic_len(2) topic payload_len(4) payload
+def _send_frame(sock: socket.socket, op: int, topic: str,
+                payload: bytes = b"") -> None:
+    t = topic.encode()
+    sock.sendall(struct.pack("<BH", op, len(t)) + t
+                 + struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpMessageBroker:
+    """Broker server + client in one class.  ``serve()`` starts the hub;
+    clients use ``publish``/``subscribe`` pointed at host:port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._local = LocalMessageBroker()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- server side ---------------------------------------------------------
+    def serve(self) -> "TcpMessageBroker":
+        broker = self._local
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                subs = []
+                try:
+                    while True:
+                        head = _recv_exact(sock, 3)
+                        if head is None:
+                            return
+                        op, tlen = struct.unpack("<BH", head)
+                        topic = _recv_exact(sock, tlen)
+                        plen_b = _recv_exact(sock, 4)
+                        if topic is None or plen_b is None:
+                            return
+                        payload = _recv_exact(
+                            sock, struct.unpack("<I", plen_b)[0])
+                        topic = topic.decode()
+                        if op == 0:
+                            broker.publish(topic, payload)
+                        elif op == 1:
+                            sub = broker.subscribe(topic)
+                            subs.append((topic, sub))
+                            t = threading.Thread(
+                                target=self._pump, args=(sock, sub),
+                                daemon=True)
+                            t.start()
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    for topic, sub in subs:
+                        broker.unsubscribe(topic, sub)
+
+            @staticmethod
+            def _pump(sock, sub):
+                try:
+                    while True:
+                        payload = sub.poll(timeout=1.0)
+                        if payload is None:
+                            continue
+                        sock.sendall(struct.pack("<I", len(payload)) + payload)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True   # handlers must not block interpreter exit
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._local.close()
+
+    # -- client side ---------------------------------------------------------
+    def publish(self, topic: str, payload: bytes) -> None:
+        with socket.create_connection((self.host, self.port), timeout=5) as s:
+            _send_frame(s, 0, topic, payload)
+
+    class _TcpSubscription:
+        def __init__(self, sock: socket.socket):
+            self._sock = sock
+
+        def poll(self, timeout: Optional[float] = None) -> Optional[bytes]:
+            self._sock.settimeout(timeout)
+            try:
+                head = _recv_exact(self._sock, 4)
+                if head is None:
+                    return None
+                return _recv_exact(self._sock,
+                                   struct.unpack("<I", head)[0])
+            except socket.timeout:
+                return None
+
+        def close(self):
+            self._sock.close()
+
+    def subscribe(self, topic: str) -> "_TcpSubscription":
+        s = socket.create_connection((self.host, self.port), timeout=5)
+        _send_frame(s, 1, topic)
+        return TcpMessageBroker._TcpSubscription(s)

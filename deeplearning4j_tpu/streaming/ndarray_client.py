@@ -1,0 +1,58 @@
+"""NDArray publisher/consumer over a broker.
+
+Reference ``dl4j-streaming/.../kafka/{NDArrayPublisher,NDArrayConsumer,
+NDArrayKafkaClient}.java`` — typed array pub/sub riding the codec frames.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .codec import deserialize_array, serialize_array
+
+__all__ = ["NDArrayPublisher", "NDArrayConsumer"]
+
+
+class NDArrayPublisher:
+    def __init__(self, broker, topic: str):
+        self.broker = broker
+        self.topic = topic
+
+    def publish(self, arr) -> None:
+        self.broker.publish(self.topic, serialize_array(arr))
+
+    def publish_all(self, arrays) -> None:
+        for a in arrays:
+            self.publish(a)
+
+
+class NDArrayConsumer:
+    def __init__(self, broker, topic: str):
+        self.broker = broker
+        self.topic = topic
+        self._sub = broker.subscribe(topic)
+
+    def get_array(self, timeout: Optional[float] = 5.0
+                  ) -> Optional[np.ndarray]:
+        payload = self._sub.poll(timeout=timeout)
+        if payload is None:
+            return None
+        arr, _ = deserialize_array(payload)
+        return arr
+
+    def get_arrays(self, n: int, timeout: Optional[float] = 5.0
+                   ) -> List[np.ndarray]:
+        out = []
+        for _ in range(n):
+            a = self.get_array(timeout=timeout)
+            if a is None:
+                break
+            out.append(a)
+        return out
+
+    def close(self) -> None:
+        if hasattr(self._sub, "close"):
+            self._sub.close()
+        elif hasattr(self.broker, "unsubscribe"):
+            self.broker.unsubscribe(self.topic, self._sub)

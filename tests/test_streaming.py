@@ -1,0 +1,123 @@
+"""Streaming pub/sub + serve routes (reference dl4j-streaming: Kafka
+NDArray clients, Camel serve route) and trained-model helpers."""
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.streaming import (LocalMessageBroker, NDArrayConsumer,
+                                          NDArrayPublisher, ServeRoute,
+                                          TcpMessageBroker, deserialize_array,
+                                          deserialize_dataset,
+                                          serialize_array, serialize_dataset)
+
+
+class TestCodec:
+    @pytest.mark.parametrize("dtype", ["float32", "float64", "int32",
+                                       "int64", "uint8", "bool"])
+    def test_array_roundtrip(self, dtype):
+        rng = np.random.default_rng(0)
+        arr = (rng.standard_normal((3, 4, 2)) * 10).astype(dtype)
+        out, off = deserialize_array(serialize_array(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_scalar_and_concat_frames(self):
+        a = np.float32(3.5).reshape(())
+        b = np.arange(4, dtype=np.int32)
+        data = serialize_array(a) + serialize_array(b)
+        x, off = deserialize_array(data)
+        y, _ = deserialize_array(data, off)
+        assert float(x) == 3.5
+        np.testing.assert_array_equal(y, b)
+
+    def test_dataset_roundtrip(self):
+        f = np.ones((2, 3), np.float32)
+        l = np.zeros((2, 2), np.float32)
+        fm = np.ones((2,), np.float32)
+        feats, labels, fmask, lmask = deserialize_dataset(
+            serialize_dataset(f, l, fm, None))
+        np.testing.assert_array_equal(feats, f)
+        np.testing.assert_array_equal(labels, l)
+        np.testing.assert_array_equal(fmask, fm)
+        assert lmask is None
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="magic"):
+            deserialize_array(b"XXXX1234")
+
+
+class TestLocalBroker:
+    def test_fanout_and_unsubscribe(self):
+        b = LocalMessageBroker()
+        s1, s2 = b.subscribe("t"), b.subscribe("t")
+        b.publish("t", b"m1")
+        assert s1.poll(0.5) == b"m1" and s2.poll(0.5) == b"m1"
+        b.unsubscribe("t", s2)
+        b.publish("t", b"m2")
+        assert s1.poll(0.5) == b"m2"
+        assert s2.poll(0.05) is None
+
+    def test_ndarray_clients(self):
+        b = LocalMessageBroker()
+        consumer = NDArrayConsumer(b, "arrays")
+        NDArrayPublisher(b, "arrays").publish_all(
+            [np.full((2, 2), i, np.float32) for i in range(3)])
+        got = consumer.get_arrays(3, timeout=1.0)
+        assert len(got) == 3
+        np.testing.assert_array_equal(got[2], np.full((2, 2), 2, np.float32))
+
+
+class TestTcpBroker:
+    def test_cross_connection_pubsub(self):
+        srv = TcpMessageBroker().serve()
+        try:
+            sub = srv.subscribe("topic")
+            time.sleep(0.1)  # let the subscription register
+            srv.publish("topic", serialize_array(np.arange(5, dtype=np.float32)))
+            payload = sub.poll(timeout=2.0)
+            assert payload is not None
+            arr, _ = deserialize_array(payload)
+            np.testing.assert_array_equal(arr, np.arange(5, dtype=np.float32))
+            sub.close()
+        finally:
+            srv.shutdown()
+
+
+class TestServeRoute:
+    def test_route_predicts(self):
+        b = LocalMessageBroker()
+        model = lambda x: x.sum(axis=1, keepdims=True)
+        route = ServeRoute(b, model, "in", "out").start()
+        out_sub = b.subscribe("out")
+        try:
+            NDArrayPublisher(b, "in").publish(
+                np.array([[1, 2], [3, 4]], np.float32))
+            payload = out_sub.poll(timeout=2.0)
+            assert payload is not None
+            pred, _ = deserialize_array(payload)
+            np.testing.assert_allclose(pred, [[3.0], [7.0]])
+        finally:
+            route.stop()
+
+
+class TestTrainedModels:
+    def test_imagenet_decode_fallback_and_file(self, tmp_path):
+        from deeplearning4j_tpu.modelimport import ImageNetLabels
+        labels = ImageNetLabels(path="/nonexistent")
+        assert labels.get_label(7) == "class_7"
+        p = tmp_path / "labels.txt"
+        p.write_text("\n".join(f"name{i}" for i in range(1000)))
+        labels = ImageNetLabels(path=str(p))
+        probs = np.zeros(1000, np.float32)
+        probs[[3, 5]] = [0.7, 0.3]
+        decoded = labels.decode_predictions(probs, top=2)
+        assert decoded[0][0] == ("name3", pytest.approx(0.7))
+        assert decoded[0][1] == ("name5", pytest.approx(0.3))
+
+    def test_vgg_preprocess(self):
+        from deeplearning4j_tpu.modelimport import TrainedModels
+        img = np.full((1, 4, 4, 3), 0.5, np.float32)  # [0,1] scale
+        x = TrainedModels.VGG16.preprocess(img)
+        np.testing.assert_allclose(
+            x[0, 0, 0], 127.5 - np.array([123.68, 116.779, 103.939]),
+            rtol=1e-5)
