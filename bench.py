@@ -49,7 +49,7 @@ def main():
     t0 = time.perf_counter()
     for _ in range(n_iter):
         model._rng, key = jax.random.split(model._rng)
-        model.params, model.state, model.opt_state, loss = run_step(key)
+        model.params, model.state, model.opt_state, loss, _ = run_step(key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 

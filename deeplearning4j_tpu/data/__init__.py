@@ -5,6 +5,7 @@ from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       ExistingDataSetIterator, INDArrayDataSetIterator,
                       MovingWindowDataSetIterator, MultipleEpochsIterator,
                       SamplingDataSetIterator)
+from .formatter import LocalUnstructuredDataFormatter
 from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
                        LFWDataSetIterator, TinyImageNetDataSetIterator)
 from .mnist import IrisDataSetIterator, MnistDataSetIterator
@@ -16,5 +17,5 @@ __all__ = [
     "IrisDataSetIterator", "MnistDataSetIterator", "MovingWindowDataSetIterator",
     "MultipleEpochsIterator", "SamplingDataSetIterator",
     "CifarDataSetIterator", "EmnistDataSetIterator", "LFWDataSetIterator",
-    "TinyImageNetDataSetIterator",
+    "TinyImageNetDataSetIterator", "LocalUnstructuredDataFormatter",
 ]

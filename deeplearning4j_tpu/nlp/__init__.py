@@ -27,6 +27,7 @@ from .tokenization import (CommonPreprocessor, DefaultTokenizer,
                            LowCasePreProcessor, NGramTokenizer,
                            NGramTokenizerFactory, TokenPreProcess, Tokenizer,
                            TokenizerFactory)
+from .uima import PosTagger, SentenceSegmenter, UimaSentenceIterator
 from .vectorizer import BagOfWordsVectorizer, TfidfVectorizer
 from .vocab import (VocabCache, VocabConstructor, VocabWord, build_huffman,
                     make_unigram_table, subsample_keep_prob)
@@ -34,6 +35,7 @@ from .word2vec import Word2Vec
 from .word_vectors import WordVectors
 
 __all__ = [
+    "PosTagger", "SentenceSegmenter", "UimaSentenceIterator",
     "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
     "KoreanTokenizerFactory", "InvertedIndex", "KeywordExtractor",
     "Glove", "InMemoryLookupTable", "ParagraphVectors", "SequenceVectors",

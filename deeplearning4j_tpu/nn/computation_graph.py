@@ -239,10 +239,17 @@ class ComputationGraph:
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
             confs = self._layer_conf_map()
             grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
+            gleaves = jax.tree_util.tree_leaves(grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
+                if gleaves else jnp.zeros(())
+            glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
+                                      for g in jax.tree_util.tree_leaves(v)))
+                      for k, v in grads.items() if v}
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             new_params = apply_constraints_all(new_params, confs)
-            return new_params, new_state, new_opt, loss
+            return (new_params, new_state, new_opt, loss,
+                    {"global_norm": gnorm, "layer_norms": glayer})
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -257,9 +264,10 @@ class ComputationGraph:
         self.last_batch_size = int(xs[0].shape[0])
         step_fn = self._get_jitted("train_step")
         self._rng, key = jax.random.split(self._rng)
-        self.params, self.state, self.opt_state, loss = step_fn(
+        self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key, xs, ys, ms, lms)
         self._score = float(loss)
+        self._last_grad_stats = gstats
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)

@@ -256,12 +256,23 @@ class MultiLayerNetwork:
                 jax.value_and_grad(loss_fn, has_aux=True)(params)
             confs = self._layer_conf_map()
             grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
+            # per-iteration gradient stats for listeners (reference
+            # ParamAndGradientIterationListener / StatsListener): computed
+            # inside the same program so they fuse with the update
+            gleaves = jax.tree_util.tree_leaves(grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
+                if gleaves else jnp.zeros(())
+            glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
+                                      for g in jax.tree_util.tree_leaves(v)))
+                      for k, v in grads.items() if v}
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             new_params = apply_constraints_all(new_params, confs)
+            gstats = {"global_norm": gnorm, "layer_norms": glayer}
             if with_carry:
-                return new_params, new_state, new_opt, loss, new_carries
-            return new_params, new_state, new_opt, loss
+                return (new_params, new_state, new_opt, loss, gstats,
+                        new_carries)
+            return new_params, new_state, new_opt, loss, gstats
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -352,10 +363,12 @@ class MultiLayerNetwork:
             ym = None if label_mask is None else jnp.asarray(label_mask)[:, sl]
             yc = jnp.asarray(y)[:, sl] if getattr(y, "ndim", 2) == 3 else jnp.asarray(y)
             self._rng, key = jax.random.split(self._rng)
-            self.params, self.state, self.opt_state, loss, carries = step(
+            (self.params, self.state, self.opt_state, loss, gstats,
+             carries) = step(
                 self.params, self.state, self.opt_state, key,
                 jnp.asarray(x)[:, sl], yc, xm, ym, carries)
             self._score = float(loss)
+            self._last_grad_stats = gstats
             self.iteration += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration, self.epoch)
@@ -458,12 +471,13 @@ class MultiLayerNetwork:
         """One train step (shared by fit's inner loop and fit_batch)."""
         step_fn = self._get_jitted("train_step")
         self._rng, key = jax.random.split(self._rng)
-        self.params, self.state, self.opt_state, loss = step_fn(
+        self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key,
             jnp.asarray(x), jnp.asarray(y),
             None if m is None else jnp.asarray(m),
             None if lm is None else jnp.asarray(lm))
         self._score = float(loss)
+        self._last_grad_stats = gstats
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)

@@ -128,7 +128,7 @@ class ParallelWrapper:
                 lst.on_epoch_start(m)
             for x, y, mk, lmk in batches_factory():
                 m._rng, key = jax.random.split(m._rng)
-                m.params, m.state, m.opt_state, loss = step(
+                m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
                     m.params, m.state, m.opt_state, key,
                     put(x), put(y), put(mk), put(lmk))
                 m._score = float(loss)

@@ -1,0 +1,118 @@
+"""Fleet provisioning: TPU pod/VM cluster setup command generation.
+
+Reference ``deeplearning4j-aws`` (SURVEY.md §2.4): ``ec2/provision/
+ClusterSetup.java`` boots an EC2 fleet and ``s3/`` moves artifacts.  The
+TPU-native equivalent provisions Cloud TPU slices: a ``ClusterSpec``
+describes the fleet, ``TpuClusterSetup`` emits (and optionally executes)
+the exact ``gcloud`` commands, and ``StorageTransfer`` wraps ``gsutil``
+for the S3-uploader role.  Command generation is pure (testable,
+zero-egress); execution is explicit opt-in, mirroring the reference's
+side-effecting provisioner.
+"""
+from __future__ import annotations
+
+import shlex
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["ClusterSpec", "TpuClusterSetup", "StorageTransfer"]
+
+
+@dataclass
+class ClusterSpec:
+    """One TPU slice / fleet description (the EC2 fleet-spec role)."""
+    name: str
+    zone: str = "us-central2-b"
+    accelerator_type: str = "v5e-64"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: Optional[str] = None
+    preemptible: bool = False
+    network: Optional[str] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class TpuClusterSetup:
+    """Generate/execute provisioning commands (reference
+    ``ClusterSetup.java`` — its ``provision()`` boots the fleet; here
+    ``apply()`` only runs when ``execute=True``)."""
+
+    def __init__(self, spec: ClusterSpec):
+        self.spec = spec
+
+    def _base(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return cmd
+
+    def create_command(self) -> List[str]:
+        s = self.spec
+        cmd = self._base() + ["create", s.name, f"--zone={s.zone}",
+                              f"--accelerator-type={s.accelerator_type}",
+                              f"--version={s.runtime_version}"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        if s.preemptible:
+            cmd.append("--preemptible")
+        if s.network:
+            cmd.append(f"--network={s.network}")
+        for k, v in sorted(s.tags.items()):
+            cmd.append(f"--labels={k}={v}")
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        s = self.spec
+        cmd = self._base() + ["delete", s.name, f"--zone={s.zone}",
+                              "--quiet"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        return cmd
+
+    def ssh_command(self, worker: str = "all",
+                    remote_command: Optional[str] = None) -> List[str]:
+        s = self.spec
+        cmd = self._base() + ["ssh", s.name, f"--zone={s.zone}",
+                              f"--worker={worker}"]
+        if remote_command:
+            cmd += ["--command", remote_command]
+        return cmd
+
+    def describe_command(self) -> List[str]:
+        s = self.spec
+        return self._base() + ["describe", s.name, f"--zone={s.zone}"]
+
+    def render(self) -> str:
+        """The full provisioning script as shell text (audit artifact)."""
+        return "\n".join(shlex.join(c) for c in (
+            self.create_command(), self.describe_command()))
+
+    def apply(self, execute: bool = False, timeout: float = 600):
+        """Run the create command.  execute=False (default) returns the
+        command without side effects."""
+        cmd = self.create_command()
+        if not execute:
+            return cmd
+        return subprocess.run(cmd, check=True, capture_output=True,
+                              timeout=timeout)
+
+
+class StorageTransfer:
+    """gsutil up/down-loader (reference ``aws/s3/uploader``)."""
+
+    def __init__(self, bucket: str):
+        if not bucket.startswith("gs://"):
+            bucket = f"gs://{bucket}"
+        self.bucket = bucket.rstrip("/")
+
+    def upload_command(self, local_path: str, remote_key: str) -> List[str]:
+        return ["gsutil", "-m", "cp", "-r", local_path,
+                f"{self.bucket}/{remote_key}"]
+
+    def download_command(self, remote_key: str, local_path: str) -> List[str]:
+        return ["gsutil", "-m", "cp", "-r",
+                f"{self.bucket}/{remote_key}", local_path]
+
+    def run(self, cmd: List[str], execute: bool = False, timeout: float = 600):
+        if not execute:
+            return cmd
+        return subprocess.run(cmd, check=True, capture_output=True,
+                              timeout=timeout)

@@ -151,3 +151,86 @@ class ComposableIterationListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch):
         for l in self.listeners:
             l.iteration_done(model, iteration, epoch)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Per-iteration parameter/update statistics to a log or file
+    (reference ``optimize/listeners/ParamAndGradientIterationListener.java``).
+    Gradient norms come from the jitted train step's fused stats
+    (``model._last_grad_stats``); parameter norms are computed host-side."""
+
+    def __init__(self, iterations: int = 1, print_mean: bool = True,
+                 print_norms: bool = True, output_file=None,
+                 delimiter: str = "\t"):
+        self.iterations = max(1, iterations)
+        self.print_mean = print_mean
+        self.print_norms = print_norms
+        self.output_file = output_file
+        self.delimiter = delimiter
+        self.rows: List[dict] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.iterations != 0:
+            return
+        import numpy as np
+        row = {"iteration": iteration, "score": model.get_score()}
+        gstats = getattr(model, "_last_grad_stats", None)
+        if gstats is not None:
+            row["grad_norm"] = float(gstats["global_norm"])
+            for k, v in gstats.get("layer_norms", {}).items():
+                row[f"grad_norm_{k}"] = float(v)
+        if self.print_norms or self.print_mean:
+            for lname, lp in getattr(model, "params", {}).items():
+                for pname, arr in (lp or {}).items():
+                    a = np.asarray(arr)
+                    if self.print_norms:
+                        row[f"l2_{lname}.{pname}"] = float(
+                            np.linalg.norm(a.reshape(-1)))
+                    if self.print_mean:
+                        row[f"mean_{lname}.{pname}"] = float(a.mean())
+        self.rows.append(row)
+        if self.output_file:
+            import json as _json
+            with open(self.output_file, "a", encoding="utf-8") as f:
+                f.write(_json.dumps(row) + "\n")
+        else:
+            log.info("paramStats %s", row)
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic model checkpoints (reference
+    ``optimize/listeners/checkpoint/CheckpointListener.java``): save every
+    N iterations and/or every N epochs, keep the last K."""
+
+    def __init__(self, directory, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+        import os as _os
+        self.directory = directory
+        _os.makedirs(directory, exist_ok=True)
+        self.save_every_n_iterations = save_every_n_iterations
+        self.save_every_n_epochs = save_every_n_epochs
+        self.keep_last = keep_last
+        self.saved: List[str] = []
+
+    def _save(self, model, tag: str):
+        import os as _os
+        from ..utils.model_serializer import write_model
+        path = _os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        write_model(model, path)
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            try:
+                _os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.save_every_n_iterations and \
+                iteration % self.save_every_n_iterations == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model):
+        if self.save_every_n_epochs and \
+                (model.epoch + 1) % self.save_every_n_epochs == 0:
+            self._save(model, f"epoch_{model.epoch}")

@@ -1,9 +1,10 @@
 """Observability: stats collection → storage → web dashboard (reference
 ``deeplearning4j-ui-parent``: StatsListener → StatsStorage → PlayUIServer)."""
+from .connection import UiConnectionInfo
 from .server import RemoteUIStatsStorageRouter, UIServer
 from .stats import StatsListener, StatsReport, array_stats
 from .storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
 
 __all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
            "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
-           "RemoteUIStatsStorageRouter"]
+           "RemoteUIStatsStorageRouter", "UiConnectionInfo"]

@@ -1,0 +1,174 @@
+"""ML pipeline wrappers, provisioning command generation, UIMA-equivalent
+NLP, result DTOs, data formatter, gradient-stats listeners (reference:
+dl4j-spark-ml, deeplearning4j-aws, deeplearning4j-nlp-uima,
+nn/simple, datasets/rearrange, ParamAndGradientIterationListener)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ml import AutoEncoderEstimator, NetworkEstimator
+from deeplearning4j_tpu.nlp import (PosTagger, SentenceSegmenter,
+                                    UimaSentenceIterator)
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.simple import (BinaryClassificationResult,
+                                          RankClassificationResult)
+from deeplearning4j_tpu.provision import (ClusterSpec, StorageTransfer,
+                                          TpuClusterSetup)
+from deeplearning4j_tpu.train.listeners import \
+    ParamAndGradientIterationListener
+
+
+def _conf(n_in=4, n_out=3):
+    return (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _blobs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 3, n)
+    x = rng.standard_normal((n, 4)).astype(np.float32) * 0.3
+    x[:, :3] += np.eye(3, dtype=np.float32)[y] * 2.0
+    return x, y
+
+
+class TestMlWrappers:
+    def test_estimator_fit_predict_score(self):
+        x, y = _blobs()
+        est = NetworkEstimator(_conf, epochs=30, batch_size=32)
+        model = est.fit(x, y)
+        assert model.score(x, y) > 0.9
+        proba = model.predict_proba(x[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-4)
+        assert model.transform(x[:5]).shape == (5, 3)
+
+    def test_params_protocol(self):
+        est = NetworkEstimator(_conf, epochs=3)
+        assert est.get_params()["epochs"] == 3
+        est.set_params(epochs=5)
+        assert est.epochs == 5
+        with pytest.raises(ValueError, match="unknown param"):
+            est.set_params(bogus=1)
+
+    def test_autoencoder_transform_shape(self):
+        from deeplearning4j_tpu.nn.layers.pretrain import AutoEncoder
+
+        def conf():
+            return (NeuralNetConfiguration.builder().seed(3)
+                    .updater(Adam(learning_rate=0.01)).list()
+                    .layer(AutoEncoder(n_out=2, activation="tanh"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+
+        x, _ = _blobs(60)
+        model = AutoEncoderEstimator(conf, epochs=2, batch_size=32).fit(x)
+        enc = model.transform(x)
+        assert enc.shape == (60, 2)
+
+
+class TestProvision:
+    def test_create_delete_commands(self):
+        spec = ClusterSpec(name="trainer", zone="us-central2-b",
+                           accelerator_type="v5e-64", project="p1",
+                           preemptible=True, tags={"team": "ml"})
+        setup = TpuClusterSetup(spec)
+        create = setup.create_command()
+        assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm",
+                              "create"]
+        assert "--accelerator-type=v5e-64" in create
+        assert "--project=p1" in create and "--preemptible" in create
+        assert "--labels=team=ml" in create
+        assert "delete" in setup.delete_command()
+        # dry-run apply returns the command, no execution
+        assert setup.apply(execute=False) == create
+        script = setup.render()
+        assert "tpu-vm create trainer" in script
+
+    def test_ssh_and_storage(self):
+        setup = TpuClusterSetup(ClusterSpec(name="x"))
+        ssh = setup.ssh_command(worker="0", remote_command="hostname")
+        assert ssh[-1] == "hostname" and "--worker=0" in ssh
+        st = StorageTransfer("my-bucket")
+        up = st.upload_command("/tmp/model.zip", "ckpt/model.zip")
+        assert up[-1] == "gs://my-bucket/ckpt/model.zip"
+        assert st.run(up, execute=False) == up
+
+
+class TestUimaEquivalents:
+    def test_sentence_segmentation(self):
+        segs = SentenceSegmenter().segment(
+            "Dr. Smith arrived at 3.5 p.m. sharp. He met J. Doe! Was it "
+            "fun? Yes.")
+        assert segs == ["Dr. Smith arrived at 3.5 p.m. sharp.",
+                        "He met J. Doe!", "Was it fun?", "Yes."]
+
+    def test_sentence_iterator(self):
+        it = UimaSentenceIterator(["One. Two.", "Three!"])
+        assert list(it) == ["One.", "Two.", "Three!"]
+
+    def test_pos_tagger(self):
+        tags = dict(PosTagger().tag("the cat quickly ate 42 fishes"))
+        assert tags["the"] == "DT"
+        assert tags["quickly"] == "RB"
+        assert tags["42"] == "CD"
+        assert tags["fishes"] == "NNS"
+
+
+class TestResultDtos:
+    def test_binary(self):
+        r = BinaryClassificationResult(0.8, threshold=0.6)
+        assert r.value and r.to_dict()["value"]
+        assert not BinaryClassificationResult(0.3).value
+
+    def test_rank(self):
+        r = RankClassificationResult([[0.1, 0.7, 0.2]], ["a", "b", "c"])
+        assert r.max_label() == "b"
+        assert r.rank() == ["b", "c", "a"]
+        assert r.probability(0, "c") == pytest.approx(0.2)
+        with pytest.raises(ValueError, match="labels"):
+            RankClassificationResult([[0.5, 0.5]], ["only_one"])
+
+
+class TestFormatter:
+    def test_split_directories(self, tmp_path):
+        from deeplearning4j_tpu.data import LocalUnstructuredDataFormatter
+        src = tmp_path / "raw"
+        for label in ("cat", "dog"):
+            (src / label).mkdir(parents=True)
+            for i in range(10):
+                (src / label / f"{i}.txt").write_text("x")
+        fmt = LocalUnstructuredDataFormatter(
+            tmp_path / "out", src, test_fraction=0.2, seed=1)
+        fmt.rearrange()
+        assert fmt.get_num_examples_total() == 20
+        assert fmt.get_num_test_examples() == 4
+        train_cats = list((tmp_path / "out/split/train/cat").iterdir())
+        test_cats = list((tmp_path / "out/split/test/cat").iterdir())
+        assert len(train_cats) == 8 and len(test_cats) == 2
+        # copies by default: source intact
+        assert len(list((src / "cat").iterdir())) == 10
+
+
+class TestGradStatsListener:
+    def test_collects_grad_and_param_stats(self, tmp_path):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(_conf()).init()
+        out = tmp_path / "stats.jsonl"
+        lst = ParamAndGradientIterationListener(iterations=1,
+                                                output_file=str(out))
+        net.set_listeners(lst)
+        x, y = _blobs(40)
+        net.fit(x, np.eye(3, dtype=np.float32)[y], epochs=2)
+        assert len(lst.rows) == 2
+        row = lst.rows[-1]
+        assert row["grad_norm"] > 0
+        assert any(k.startswith("l2_layer_0") for k in row)
+        lines = [json.loads(l) for l in out.read_text().splitlines()]
+        assert lines[-1]["iteration"] == row["iteration"]
