@@ -26,7 +26,7 @@ def build_model():
     overridable via env for CPU smoke-testing the bench path."""
     from deeplearning4j_tpu.models import available_bench_model
     return available_bench_model(
-        batch=int(os.environ.get("DL4J_TPU_BENCH_BATCH", "32")),
+        batch=int(os.environ.get("DL4J_TPU_BENCH_BATCH", "256")),
         image=int(os.environ.get("DL4J_TPU_BENCH_IMAGE", "224")))
 
 

@@ -13,10 +13,17 @@ __all__ = [
 ]
 
 
-def available_bench_model(batch: int = 32, image: int = 224):
+def available_bench_model(batch: int = 32, image: int = 224,
+                          compute_dtype: str = "bfloat16"):
     """Flagship bench model: ResNet50-ImageNet (the BASELINE.md north-star
-    metric is ResNet50 examples/sec/chip).  Returns (model, (x, y))."""
+    metric is ResNet50 examples/sec/chip).  bf16 compute is the TPU-native
+    default (f32 master params); DL4J_TPU_BENCH_DTYPE=float32 disables.
+    Returns (model, (x, y))."""
+    import os
+    compute_dtype = os.environ.get("DL4J_TPU_BENCH_DTYPE", compute_dtype)
     model = ResNet50(num_classes=1000,
+                     compute_dtype=None if compute_dtype == "float32"
+                     else compute_dtype,
                      input_shape=(image, image, 3)).init()
     rng = np.random.default_rng(0)
     x = rng.standard_normal((batch, image, image, 3), dtype=np.float32)

@@ -75,6 +75,7 @@ class ZooModel:
     seed: int = 123
     input_shape: Tuple[int, int, int] = (224, 224, 3)   # (h, w, c)
     updater: Optional[UpdaterConf] = None
+    compute_dtype: Optional[str] = None   # 'bfloat16' = TPU fast path
 
     def init(self):
         raise NotImplementedError
@@ -95,6 +96,8 @@ class ZooModel:
 
     def _builder(self):
         b = NeuralNetConfiguration.builder().seed(self.seed)
+        if self.compute_dtype:
+            b = b.compute_dtype(self.compute_dtype)
         return b
 
 
@@ -251,10 +254,12 @@ class ResNet50(ZooModel):
 
     def init(self) -> ComputationGraph:
         h, w, c = self.input_shape
-        g = GraphBuilder(
-            {"activation": "relu", "weight_init": "relu",
-             "updater": self.updater or Nesterovs(learning_rate=1e-1, momentum=0.9)},
-            seed=self.seed)
+        defaults = {"activation": "relu", "weight_init": "relu",
+                    "updater": self.updater or
+                    Nesterovs(learning_rate=1e-1, momentum=0.9)}
+        if self.compute_dtype:
+            defaults["compute_dtype"] = self.compute_dtype
+        g = GraphBuilder(defaults, seed=self.seed)
         g.add_inputs("in").set_input_types(InputType.convolutional(h, w, c))
 
         def conv_bn(name, inp, n_out, kernel, stride=(1, 1), act="relu",

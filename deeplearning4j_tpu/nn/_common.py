@@ -131,3 +131,23 @@ def apply_constraints_all(params, confs: Dict[str, Optional[LayerConf]]):
                         pgroup[pname] = c.apply(pgroup[pname])
             params[name] = pgroup
     return params
+
+
+def _cast_floats(tree, dtype, only=None):
+    """Cast floating leaves to ``dtype`` (mixed-precision helper).  With
+    ``only`` set, cast just the leaves currently of that dtype (used to pin
+    state back to f32 after a bf16 forward)."""
+    dtype = jnp.dtype(dtype)
+    src = None if only is None else jnp.dtype(only)
+
+    def cast(a):
+        if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype,
+                                                         jnp.floating):
+            return a
+        if src is not None and a.dtype != src:
+            return a
+        if src is None and a.dtype != jnp.float32:
+            return a
+        return a.astype(dtype)
+
+    return jax.tree_util.tree_map(cast, tree)

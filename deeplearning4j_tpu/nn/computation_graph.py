@@ -20,8 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ._common import (apply_constraints_all, apply_gradient_norm_all,
-                      build_tx)
+from ._common import (_cast_floats, apply_constraints_all,
+                      apply_gradient_norm_all, build_tx)
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      GraphVertexConf, LayerVertex)
 from .conf.updaters import Sgd, UpdaterConf
@@ -227,10 +227,16 @@ class ComputationGraph:
         gn_mode = self.conf.defaults.get("gradient_normalization")
         gn_thr = float(self.conf.defaults.get(
             "gradient_normalization_threshold", 1.0))
+        cdtype = self.conf.defaults.get("compute_dtype")
         tx = self._tx
 
         def step(params, state, opt_state, key, xs, ys, masks, label_masks):
+            if cdtype is not None:
+                xs = [x.astype(cdtype) for x in xs]
+
             def loss_fn(p):
+                if cdtype is not None:
+                    p = _cast_floats(p, cdtype)
                 loss, new_state = self._loss(p, state, xs, ys, train=True,
                                              key=key, masks=masks,
                                              label_masks=label_masks)
@@ -248,6 +254,8 @@ class ComputationGraph:
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             new_params = apply_constraints_all(new_params, confs)
+            if cdtype is not None:
+                new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
             return (new_params, new_state, new_opt, loss,
                     {"global_norm": gnorm, "layer_norms": glayer})
 

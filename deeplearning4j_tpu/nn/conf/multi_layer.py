@@ -252,6 +252,15 @@ class NeuralNetConfiguration:
             self._defaults["dtype"] = dt
             return self
 
+        def compute_dtype(self, dt: str):
+            """Mixed precision: master params/optimizer state stay float32,
+            forward+backward run in ``dt`` (normally 'bfloat16' — the TPU
+            MXU's native input type).  Normalization statistics are kept
+            float32.  The reference has no equivalent (CUDA fp32); this is
+            the TPU-idiomatic fast path."""
+            self._defaults["compute_dtype"] = str(dt)
+            return self
+
         def optimization_algo(self, algo: str, max_iterations: int = 100):
             """Pick the solver (reference ``OptimizationAlgorithm``):
             'sgd' (default, jitted minibatch path) or the legacy

@@ -30,8 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ._common import (apply_constraints_all, apply_gradient_norm_all,
-                      apply_gradient_normalization, build_tx)
+from ._common import (_cast_floats, apply_constraints_all,
+                      apply_gradient_norm_all, apply_gradient_normalization,
+                      build_tx)
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
@@ -236,11 +237,20 @@ class MultiLayerNetwork:
     def _make_train_step(self, with_carry: bool = False):
         gn_mode = self.conf.defaults.get("gradient_normalization")
         gn_thr = float(self.conf.defaults.get("gradient_normalization_threshold", 1.0))
+        cdtype = self.conf.defaults.get("compute_dtype")
         tx = self._tx
 
         def step(params, state, opt_state, key, x, y, mask, label_mask,
                  carries=None):
+            if cdtype is not None:
+                x = x.astype(cdtype)
+
             def loss_fn(p):
+                if cdtype is not None:
+                    # mixed precision: cast params for the traced stack;
+                    # grads w.r.t. the f32 masters accumulate in f32 (the
+                    # cast is part of the differentiated program)
+                    p = _cast_floats(p, cdtype)
                 if with_carry:
                     # carry state flows INTO the chunk; gradients do not flow
                     # back across the chunk boundary (tBPTT truncation).
@@ -268,6 +278,11 @@ class MultiLayerNetwork:
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             new_params = apply_constraints_all(new_params, confs)
+            if cdtype is not None:
+                # keep running state (BN statistics) in f32 so the step's
+                # input/output treedefs+dtypes stay fixed across iterations
+                new_state = _cast_floats(new_state, jnp.float32,
+                                         only=cdtype)
             gstats = {"global_norm": gnorm, "layer_norms": glayer}
             if with_carry:
                 return (new_params, new_state, new_opt, loss, gstats,

@@ -207,3 +207,32 @@ def test_clone_independent():
     # clone untouched by training the original
     assert not np.allclose(np.asarray(net.params["layer_0"]["W"]),
                            np.asarray(clone.params["layer_0"]["W"]))
+
+
+def test_mixed_precision_compute_dtype():
+    """compute_dtype('bfloat16'): f32 master params/state, bf16 compute,
+    training still converges (TPU fast path; no reference equivalent)."""
+    import jax
+    import jax.numpy as jnp
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Adam(learning_rate=0.05)).compute_dtype("bfloat16")
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    y_cls = rng.integers(0, 3, 90)
+    x = (rng.standard_normal((90, 4)) * 0.3).astype(np.float32)
+    x[:, :3] += np.eye(3, dtype=np.float32)[y_cls] * 2
+    y = np.eye(3, dtype=np.float32)[y_cls]
+    s0 = net.score(x=x, y=y)
+    for _ in range(40):
+        net.fit(x, y)
+    assert net.score() < 0.3 * s0
+    # master params and running state stay float32 across steps
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(net.state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
