@@ -118,7 +118,17 @@ class ComputationGraph:
             lkey = jax.random.fold_in(key, vi) if key is not None else None
             variables = {"params": params.get(name, {}),
                          "state": state.get(name, {})}
-            y, lstate = v.apply(variables, xs, train=train, key=lkey, masks=ms)
+            if train and conf.defaults.get("cache_mode") == "remat" and \
+                    isinstance(v, LayerVertex):
+                # rematerialize per-vertex activations on the backward pass
+                # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM —
+                # SURVEY §7 "Workspaces → jax.checkpoint")
+                def _apply(vv, xx, kk, mm, _v=v):
+                    return _v.apply(vv, xx, train=True, key=kk, masks=mm)
+                y, lstate = jax.checkpoint(_apply)(variables, xs, lkey, ms)
+            else:
+                y, lstate = v.apply(variables, xs, train=train, key=lkey,
+                                    masks=ms)
             acts[name] = y
             new_state[name] = lstate
             mask_of[name] = v.feed_forward_mask(ms, xs)

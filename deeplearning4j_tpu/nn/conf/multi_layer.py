@@ -252,6 +252,18 @@ class NeuralNetConfiguration:
             self._defaults["dtype"] = dt
             return self
 
+        def cache_mode(self, mode: str):
+            """Activation memory policy (reference ``nn/conf/CacheMode.java``
+            + WorkspaceMode): 'none' (default — XLA's buffer allocator
+            manages activations) or 'remat' (``jax.checkpoint`` per layer:
+            recompute activations in the backward pass, trading FLOPs for
+            HBM — the TPU equivalent of cached workspaces)."""
+            if mode not in ("none", "remat"):
+                raise ValueError(f"cache_mode must be 'none' or 'remat', "
+                                 f"got '{mode}'")
+            self._defaults["cache_mode"] = mode
+            return self
+
         def compute_dtype(self, dt: str):
             """Mixed precision: master params/optimizer state stay float32,
             forward+backward run in ``dt`` (normally 'bfloat16' — the TPU

@@ -125,6 +125,12 @@ class MultiLayerNetwork:
                     mask=mask)
                 carries[lname] = new_carry
                 lstate = variables.get("state", {})
+            elif train and self.conf.defaults.get("cache_mode") == "remat":
+                # rematerialize per-layer activations on the backward pass
+                # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM)
+                def _apply(vv, hh, kk, mm, _lc=lc):
+                    return _lc.apply(vv, hh, train=True, key=kk, mask=mm)
+                h, lstate = jax.checkpoint(_apply)(variables, h, lkey, mask)
             else:
                 h, lstate = lc.apply(variables, h, train=train, key=lkey,
                                      mask=mask)

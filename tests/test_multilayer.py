@@ -236,3 +236,32 @@ def test_mixed_precision_compute_dtype():
     for leaf in jax.tree_util.tree_leaves(net.state):
         if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
             assert leaf.dtype == jnp.float32
+
+
+def test_cache_mode_remat_numerics_parity():
+    """cache_mode('remat') recomputes activations in backward; results must
+    be bit-identical to the default path (reference CacheMode semantics:
+    a memory policy, never a numerics change)."""
+    def make(cache):
+        b = NeuralNetConfiguration.builder().seed(4).updater(
+            Adam(learning_rate=0.05))
+        if cache:
+            b = b.cache_mode("remat")
+        conf = (b.list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((60, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 60)]
+    a, b = make(False), make(True)
+    for _ in range(8):
+        a.fit(x, y)
+        b.fit(x, y)
+    assert abs(a.score() - b.score()) < 1e-6
+    with pytest.raises(ValueError, match="cache_mode"):
+        NeuralNetConfiguration.builder().cache_mode("everything")

@@ -117,3 +117,37 @@ def test_remote_rejects_malformed():
         assert ei.value.code == 400
     finally:
         server.stop()
+
+
+def test_profiler_listener_and_memory_stats(tmp_path):
+    """ProfilerListener brackets an iteration window with an XLA trace;
+    device_memory_stats degrades to None on backends without HBM stats."""
+    from deeplearning4j_tpu.utils.profiling import (ProfilerListener,
+                                                    device_memory_stats,
+                                                    trace_annotation)
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    lst = ProfilerListener(str(tmp_path / "trace"), start_iteration=2,
+                           num_iterations=2)
+    net.set_listeners(lst)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((20, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 20)]
+    with trace_annotation("fit"):
+        for _ in range(6):
+            net.fit(x, y)
+    assert lst.captured and not lst._active
+    assert any((tmp_path / "trace").rglob("*"))  # trace files exist
+    stats = device_memory_stats()
+    assert stats is None or "bytes_in_use" in stats
