@@ -157,9 +157,60 @@ def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool) -> _LayerMap:
             name=name, kernel_size=kernel, stride=stride,
             pooling_type="max" if cls.startswith("Max") else "avg"),
             lambda w: {})
-    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
-        return _LayerMap(GlobalPoolingLayer(name=name, pooling_type="avg"),
+    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D",
+               "GlobalMaxPooling2D", "GlobalMaxPooling1D"):
+        return _LayerMap(GlobalPoolingLayer(
+            name=name, pooling_type="max" if "Max" in cls else "avg"),
+            lambda w: {})
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        from ..nn.layers.convolution import Subsampling1DLayer
+        k = conf.get("pool_size", conf.get("pool_length", 2))
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = conf.get("strides", conf.get("stride")) or k
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        return _LayerMap(Subsampling1DLayer(
+            name=name, kernel_size=k, stride=s,
+            pooling_type="max" if cls.startswith("Max") else "avg"),
+            lambda w: {})
+    if cls in ("Conv1D", "Convolution1D"):
+        from ..nn.layers.convolution import Convolution1DLayer
+        n_out = int(conf.get("filters", conf.get("nb_filter", 0)))
+        k = conf.get("kernel_size", conf.get("filter_length", 3))
+        k = int(k[0] if isinstance(k, (list, tuple)) else k)
+        s = conf.get("strides", conf.get("subsample_length", 1))
+        s = int(s[0] if isinstance(s, (list, tuple)) else s)
+        padding = conf.get("padding", conf.get("border_mode", "valid"))
+        if padding not in ("valid", "same", "causal"):
+            raise KerasImportError(f"unsupported Conv1D padding '{padding}'")
+        lc = Convolution1DLayer(
+            name=name, n_out=n_out, kernel_size=k, stride=s,
+            convolution_mode="same" if padding in ("same", "causal")
+            else "truncate",
+            activation=_act(conf.get("activation")),
+            has_bias=conf.get("use_bias", conf.get("bias", True)))
+
+        def copy(w):
+            out = {"W": w.get("kernel", w.get("W"))}  # [k, in, out]
+            if lc.has_bias:
+                out["b"] = w.get("bias", w.get("b"))
+            return out
+
+        return _LayerMap(lc, copy)
+    if cls == "ZeroPadding2D":
+        from ..nn.layers.convolution import ZeroPaddingLayer
+        pad = conf.get("padding", 1)
+        if isinstance(pad, int):
+            padding = (pad, pad, pad, pad)
+        elif len(pad) == 2 and all(isinstance(p, int) for p in pad):
+            padding = (pad[0], pad[0], pad[1], pad[1])
+        else:  # [[top, bottom], [left, right]]
+            padding = (pad[0][0], pad[0][1], pad[1][0], pad[1][1])
+        return _LayerMap(ZeroPaddingLayer(name=name, padding=padding),
                          lambda w: {})
+    if cls == "UpSampling2D":
+        from ..nn.layers.convolution import Upsampling2D
+        size = _pair(conf.get("size", (2, 2)))
+        return _LayerMap(Upsampling2D(name=name, size=size), lambda w: {})
     if cls == "BatchNormalization":
         eps = float(conf.get("epsilon", 1e-3))
         momentum = float(conf.get("momentum", 0.99))
@@ -241,7 +292,11 @@ def _layer_weight_groups(f: Hdf5File) -> Dict[str, Dict[str, np.ndarray]]:
                     for n in list(names)]
                    if names is not None else root.keys())
     for lname in layer_names:
-        g = root[lname]
+        try:
+            g = root[lname]
+        except KeyError:      # weightless layer with no group written
+            out[lname] = {}
+            continue
         weights: Dict[str, np.ndarray] = {}
         wnames = g.attrs.get("weight_names")
         wlist = list(wnames) if wnames is not None else g.keys()
