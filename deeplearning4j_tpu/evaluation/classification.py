@@ -38,6 +38,22 @@ class ConfusionMatrix:
         return int(self.matrix.sum())
 
 
+class Prediction:
+    """One recorded (actual, predicted, metadata) triple (reference
+    ``eval/meta/Prediction.java``)."""
+
+    __slots__ = ("actual", "predicted", "metadata")
+
+    def __init__(self, actual: int, predicted: int, metadata=None):
+        self.actual = actual
+        self.predicted = predicted
+        self.metadata = metadata
+
+    def __repr__(self):
+        return (f"Prediction(actual={self.actual}, "
+                f"predicted={self.predicted}, metadata={self.metadata!r})")
+
+
 class Evaluation:
     """Multi-class classification metrics (reference eval/Evaluation.java)."""
 
@@ -49,13 +65,15 @@ class Evaluation:
         self.confusion: Optional[ConfusionMatrix] = None
         self.top_n_correct = 0
         self.top_n_total = 0
+        self._predictions: List[Prediction] = []
 
     # ------------------------------------------------------------------ eval
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None):
+             mask: Optional[np.ndarray] = None, record_metadata=None):
         """labels/predictions: [batch, n_classes] probabilities or one-hot;
         time series [batch, time, n_classes] are flattened (reference
-        evalTimeSeries)."""
+        evalTimeSeries).  record_metadata: optional per-example objects
+        (reference ``eval/meta/``) enabling get_prediction_errors()."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:
@@ -86,6 +104,15 @@ class Evaluation:
             self.confusion = ConfusionMatrix(self.n_classes)
         self.confusion.add_batch(actual, predicted)
 
+        if record_metadata is not None:
+            if len(record_metadata) != len(actual):
+                raise ValueError(
+                    f"{len(record_metadata)} metadata entries for "
+                    f"{len(actual)} (post-mask) examples")
+            for a, p, md in zip(actual, predicted, record_metadata):
+                self._predictions.append(
+                    Prediction(int(a), int(p), md))
+
         if self.top_n > 1 and predictions.ndim == 2:
             topn = np.argsort(-predictions, axis=-1)[:, :self.top_n]
             self.top_n_correct += int((topn == actual[:, None]).any(axis=1).sum())
@@ -100,6 +127,20 @@ class Evaluation:
         self.confusion.matrix += other.confusion.matrix
         self.top_n_correct += other.top_n_correct
         self.top_n_total += other.top_n_total
+        self._predictions.extend(other._predictions)
+
+    # ----------------------------------------------------- prediction meta
+    def get_prediction_errors(self) -> List["Prediction"]:
+        """Misclassified examples with their metadata (reference
+        ``getPredictionErrors``)."""
+        return [p for p in self._predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List["Prediction"]:
+        return [p for p in self._predictions if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int
+                                           ) -> List["Prediction"]:
+        return [p for p in self._predictions if p.predicted == cls]
 
     # --------------------------------------------------------------- metrics
     def _tp(self, c):

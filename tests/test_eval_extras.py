@@ -187,3 +187,24 @@ class TestModelGuesser:
         assert guess_format(p) == "unknown"
         with pytest.raises(ValueError):
             load_model_guess(p)
+
+
+def test_prediction_metadata_error_inspection():
+    """Per-example metadata (reference eval/meta/): record which source
+    records were misclassified."""
+    from deeplearning4j_tpu.evaluation.classification import Evaluation
+    ev = Evaluation()
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+    preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]  # example 1 wrong
+    ev.eval(labels, preds, record_metadata=["rec_a", "rec_b", "rec_c",
+                                            "rec_d"])
+    errs = ev.get_prediction_errors()
+    assert len(errs) == 1
+    assert errs[0].metadata == "rec_b"
+    assert errs[0].actual == 1 and errs[0].predicted == 2
+    assert {p.metadata for p in ev.get_predictions_by_actual_class(1)} == \
+        {"rec_b", "rec_d"}
+    assert {p.metadata for p in ev.get_predictions_by_predicted_class(2)} == \
+        {"rec_b", "rec_c"}
+    with pytest.raises(ValueError, match="metadata entries"):
+        ev.eval(labels, preds, record_metadata=["only_one"])
