@@ -16,7 +16,8 @@ the same loop body is what a multi-process DCN deployment runs per host
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +26,37 @@ import numpy as np
 from .accumulation import EncodedGradientsAccumulator, EncodingHandler
 
 __all__ = ["TrainingMaster", "ParameterAveragingTrainingMaster",
-           "SharedGradientsTrainingMaster", "tree_average"]
+           "SharedGradientsTrainingMaster", "TrainingMasterStats",
+           "tree_average"]
+
+
+class TrainingMasterStats:
+    """Phase wall-times per fit() call (reference
+    ``ParameterAveragingTrainingMasterStats`` / ``SparkTrainingStats``:
+    split/fit/aggregation/broadcast timings).  Times in seconds."""
+
+    def __init__(self):
+        self.phases: Dict[str, List[float]] = {}
+
+    def record(self, phase: str, seconds: float) -> None:
+        self.phases.setdefault(phase, []).append(seconds)
+
+    def total(self, phase: str) -> float:
+        return float(sum(self.phases.get(phase, ())))
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for k, v in self.phases.items():
+            out[k] = {"count": len(v), "total_s": float(sum(v)),
+                      "mean_s": float(sum(v) / len(v))}
+        return out
+
+    def stats_text(self) -> str:
+        lines = ["phase                count   total_s   mean_s"]
+        for k, d in sorted(self.as_dict().items()):
+            lines.append(f"{k:<20} {d['count']:>6} {d['total_s']:>9.3f} "
+                         f"{d['mean_s']:>8.4f}")
+        return "\n".join(lines)
 
 
 def tree_average(param_trees: Sequence[Any], depth: int = 2):
@@ -79,10 +110,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.averaging_frequency = max(1, averaging_frequency)
         self.aggregation_depth = aggregation_depth
         self.average_updaters = average_updaters
+        self.stats = TrainingMasterStats()
 
     def fit(self, model, iterator) -> None:
+        t0 = time.perf_counter()
         parts = _chunk_batches(iterator, self.num_workers)
+        self.stats.record("split", time.perf_counter() - t0)
+        t0 = time.perf_counter()
         replicas = [model] + [model.clone() for _ in range(self.num_workers - 1)]
+        self.stats.record("broadcast", time.perf_counter() - t0)
         n_rounds = (max(len(p) for p in parts) + self.averaging_frequency - 1
                     ) // self.averaging_frequency
         for rnd in range(n_rounds):
@@ -95,12 +131,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
             threads = [threading.Thread(target=work, args=(w,))
                        for w in range(self.num_workers)]
+            t_fit = time.perf_counter()
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
+            self.stats.record("fit", time.perf_counter() - t_fit)
             active = [w for w in range(self.num_workers) if parts[w][lo:hi]]
             if len(active) > 1:
+                t_agg = time.perf_counter()
                 avg = tree_average([replicas[w].params for w in active],
                                    self.aggregation_depth)
                 if self.average_updaters:
@@ -113,6 +152,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     if self.average_updaters:
                         replicas[w].opt_state = jax.tree_util.tree_map(
                             jnp.array, opt_avg)
+                self.stats.record("aggregation",
+                                  time.perf_counter() - t_agg)
         # model IS replicas[0]; nothing to copy back
 
 

@@ -183,3 +183,16 @@ class TestElasticTrainer:
         import os
         ckpts = [f for f in os.listdir(tmp_path) if f.startswith("ckpt_")]
         assert len(ckpts) == 2
+
+
+def test_master_phase_stats():
+    """SparkTrainingStats role: split/broadcast/fit/aggregation timings."""
+    net = _net()
+    it = IrisDataSetIterator(batch_size=25)
+    master = ParameterAveragingTrainingMaster(num_workers=2,
+                                              averaging_frequency=1)
+    master.fit(net, it)
+    d = master.stats.as_dict()
+    assert {"split", "broadcast", "fit", "aggregation"} <= set(d)
+    assert d["fit"]["total_s"] > 0
+    assert "aggregation" in master.stats.stats_text()
