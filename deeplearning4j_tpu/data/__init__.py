@@ -5,6 +5,8 @@ from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       ExistingDataSetIterator, INDArrayDataSetIterator,
                       MovingWindowDataSetIterator, MultipleEpochsIterator,
                       SamplingDataSetIterator)
+from .dataset import (DataSetCallback, FileSplitDataSetIterator,
+                      export_dataset_batches, load_dataset, save_dataset)
 from .formatter import LocalUnstructuredDataFormatter
 from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
                        LFWDataSetIterator, TinyImageNetDataSetIterator)
@@ -17,5 +19,7 @@ __all__ = [
     "IrisDataSetIterator", "MnistDataSetIterator", "MovingWindowDataSetIterator",
     "MultipleEpochsIterator", "SamplingDataSetIterator",
     "CifarDataSetIterator", "EmnistDataSetIterator", "LFWDataSetIterator",
-    "TinyImageNetDataSetIterator", "LocalUnstructuredDataFormatter",
+    "TinyImageNetDataSetIterator", "LocalUnstructuredDataFormatter", "DataSetCallback",
+    "FileSplitDataSetIterator", "export_dataset_batches", "load_dataset",
+    "save_dataset",
 ]

@@ -313,3 +313,100 @@ class AsyncDataSetIterator(DataSetIterator):
             t.join()
         if err:
             raise err[0]
+
+
+# --------------------------------------------------------------------------
+# file-backed DataSets (reference: spark export-then-fitPaths flow,
+# datasets/iterator/parallel/ file-split iterators + callbacks/)
+# --------------------------------------------------------------------------
+
+def _dataset_to_bytes(ds: DataSet) -> bytes:
+    from ..streaming.codec import serialize_dataset
+    return serialize_dataset(np.asarray(ds.features), np.asarray(ds.labels),
+                             None if ds.features_mask is None
+                             else np.asarray(ds.features_mask),
+                             None if ds.labels_mask is None
+                             else np.asarray(ds.labels_mask))
+
+
+def _dataset_from_bytes(data: bytes) -> DataSet:
+    from ..streaming.codec import deserialize_dataset
+    f, l, fm, lm = deserialize_dataset(data)
+    return DataSet(f, l, fm, lm)
+
+
+def save_dataset(ds: DataSet, path) -> None:
+    """One DataSet -> one binary file (reference DataSet.save)."""
+    with open(path, "wb") as fh:
+        fh.write(_dataset_to_bytes(ds))
+
+
+def load_dataset(path) -> DataSet:
+    with open(path, "rb") as fh:
+        return _dataset_from_bytes(fh.read())
+
+
+def export_dataset_batches(iterator, directory, prefix: str = "dataset"
+                           ) -> List[str]:
+    """Write every batch of an iterator to ``directory`` (the Spark
+    export-to-disk step before ``fitPaths``,
+    ``spark/data/DataSetExportFunction`` role).  Returns the paths."""
+    import os
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    for i, b in enumerate(iterator):
+        ds = b if isinstance(b, DataSet) else DataSet(*b) if isinstance(
+            b, (tuple, list)) else b
+        p = os.path.join(directory, f"{prefix}_{i:06d}.bin")
+        save_dataset(ds, p)
+        paths.append(p)
+    return paths
+
+
+class DataSetCallback:
+    """Hook applied to each loaded DataSet before it reaches the trainer
+    (reference ``datasets/iterator/callbacks/DataSetCallback.java`` — e.g.
+    device placement or augmentation on the prefetch thread)."""
+
+    def call(self, ds: DataSet) -> DataSet:
+        return ds
+
+
+class FileSplitDataSetIterator(DataSetIterator):
+    """Iterate serialized DataSet files; ``worker``/``num_workers`` select
+    an interleaved shard of the file list (reference
+    ``datasets/iterator/parallel/FileSplitParallelDataSetIterator.java``
+    + ``InterleavedDataSetCallback`` role via ``callback``)."""
+
+    def __init__(self, paths_or_dir, callback: Optional[DataSetCallback] = None,
+                 worker: int = 0, num_workers: int = 1):
+        import os
+        if isinstance(paths_or_dir, (str, bytes)) or hasattr(
+                paths_or_dir, "is_dir"):
+            d = str(paths_or_dir)
+            if os.path.isdir(d):
+                paths = sorted(os.path.join(d, f) for f in os.listdir(d)
+                               if f.endswith(".bin"))
+            else:
+                paths = [d]
+        else:
+            paths = [str(p) for p in paths_or_dir]
+        if not 0 <= worker < num_workers:
+            raise ValueError(f"worker {worker} outside 0..{num_workers - 1}")
+        self.paths = paths[worker::num_workers]
+        self.callback = callback
+
+    def batch(self):
+        return -1
+
+    def reset(self):
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for p in self.paths:
+            ds = load_dataset(p)
+            if self.callback is not None:
+                ds = self.callback.call(ds)
+            yield ds
