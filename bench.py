@@ -50,7 +50,9 @@ def main():
     for _ in range(n_iter):
         model._rng, key = jax.random.split(model._rng)
         model.params, model.state, model.opt_state, loss, _ = run_step(key)
-    jax.block_until_ready(loss)
+    # force a device->host value: block_until_ready alone can return early
+    # through transport layers that proxy device buffers
+    float(jnp.asarray(loss))
     dt = time.perf_counter() - t0
 
     examples_per_sec = n_iter * x.shape[0] / dt
