@@ -220,3 +220,35 @@ def test_cycle_detection():
          .set_outputs("b"))
     with pytest.raises(ValueError, match="cycle"):
         b.build()
+
+
+def test_graph_builder_modules():
+    """Reusable sub-graph blocks (reference GraphBuilderModule)."""
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.modules import (ConvBnBlock,
+                                                    InceptionBlock,
+                                                    ResidualBlock)
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    g = GraphBuilder({"updater": Sgd(learning_rate=0.1)})
+    g.add_inputs("in").set_input_types(InputType.convolutional(16, 16, 3))
+    x = ConvBnBlock(8, (3, 3)).add_layers(g, "stem", "in")
+    x = ResidualBlock((4, 4, 8), project=True).add_layers(g, "res", x)
+    x = InceptionBlock(4, 2, 4, 2, 4, 4).add_layers(g, "inc", x)
+    g.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+    g.add_layer("out", OutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"), "gap")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+    ys = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 2)]
+    net.fit([xs], [ys])
+    out = net.output(xs)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert np.asarray(out).shape == (2, 5)
