@@ -1,14 +1,16 @@
 """Parallelism: mesh construction, DP/TP wrapper, GPipe pipeline,
-ring/Ulysses sequence parallelism (reference ``deeplearning4j-scaleout``)."""
+ring/Ulysses sequence parallelism, expert-parallel MoE (reference
+``deeplearning4j-scaleout``)."""
 from .accumulation import (EncodedGradientsAccumulator, EncodingHandler,
                            bitmap_decode, bitmap_encode, threshold_decode,
                            threshold_encode)
+from .expert import init_moe_params, make_moe_train_step, moe_ffn
 from .distributed import (ElasticTrainer, global_device_mesh,
                           initialize_distributed)
 from .inference import InferenceMode, ParallelInference
 from .master import (ParameterAveragingTrainingMaster,
                      SharedGradientsTrainingMaster, TrainingMaster,
-                     tree_average)
+                     TrainingMasterStats, tree_average)
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh, shard_batch
 from .pipeline import gpipe, stack_stage_params
 from .sequence import ring_self_attention, ulysses_attention
@@ -23,5 +25,6 @@ __all__ = [
     "global_device_mesh", "gpipe", "initialize_distributed", "make_mesh",
     "megatron_dense_rule", "ring_self_attention", "shard_batch",
     "stack_stage_params", "threshold_decode", "threshold_encode",
-    "tree_average", "ulysses_attention",
+    "tree_average", "ulysses_attention", "init_moe_params",
+    "make_moe_train_step", "moe_ffn", "TrainingMasterStats",
 ]

@@ -50,6 +50,7 @@ def run(n_devices: int) -> None:
 
     if n_devices % 8 == 0:
         _pipeline_seq_step(n_devices)
+        _expert_parallel_step(n_devices)
 
 
 def _pipeline_seq_step(n_devices: int) -> None:
@@ -75,3 +76,33 @@ def _pipeline_seq_step(n_devices: int) -> None:
         out_specs=(P(), P("pipe"))))
     loss, _ = fn(stacked, xs, ys)
     assert np.isfinite(float(loss)), "pipeline dry-run produced non-finite loss"
+
+
+def _expert_parallel_step(n_devices: int) -> None:
+    """data×expert MoE train step: top-1 routed FFN, tiled all-to-all
+    token exchange over the expert axis, DP grad reduction."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from .expert import init_moe_params, make_moe_train_step
+
+    dp, ep = 2, n_devices // 2
+    embed, hidden, experts = 8, 16, ep
+    mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(dp, ep),
+                ("data", "expert"))
+    params = init_moe_params(jax.random.PRNGKey(0), experts, embed, hidden)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n_devices * 4, embed)),
+                    jnp.float32)
+    y = jnp.tanh(x @ jnp.asarray(
+        rng.standard_normal((embed, embed)), jnp.float32))
+    pspec = {"router": P(None, None), "w1": P("expert"), "w2": P("expert")}
+    fn = jax.jit(shard_map(
+        make_moe_train_step(capacity=4), mesh=mesh,
+        in_specs=(pspec, P(("data", "expert"), None),
+                  P(("data", "expert"), None)),
+        out_specs=(pspec, P())))
+    _, loss = fn(params, x, y)
+    assert np.isfinite(float(loss)), "MoE dry-run produced non-finite loss"
