@@ -165,6 +165,10 @@ class ComputationGraph:
             lp = params.get(name, {})
             if lp:
                 reg = reg + v.regularization_score(lp)
+            if getattr(getattr(v, "layer", None), "AUX_LOSS", False):
+                aux = new_state.get(name, {}).get("aux_loss")
+                if aux is not None:
+                    reg = reg + aux
         return total + reg, new_state
 
     # ---------------------------------------------------------- public API

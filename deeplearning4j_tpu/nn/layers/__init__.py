@@ -8,6 +8,7 @@ from .convolution import (Convolution1DLayer, ConvolutionLayer,
 from .feedforward import (ActivationLayer, CenterLossOutputLayer, DenseLayer,
                           DropoutLayer, EmbeddingLayer, LossLayer, OutputLayer)
 from .misc import FrozenLayer
+from .moe import MixtureOfExpertsLayer
 from .normalization import BatchNormalization, LocalResponseNormalization
 from .objdetect import Yolo2OutputLayer
 from .pooling import GlobalPoolingLayer
@@ -21,7 +22,8 @@ __all__ = [
     "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
     "FrozenLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
     "GravesLSTM", "LastTimeStep", "LayerConf", "LayerNormLayer",
-    "LocalResponseNormalization", "LossLayer", "LSTM", "MultiHeadAttention",
+    "LocalResponseNormalization", "LossLayer", "LSTM",
+    "MixtureOfExpertsLayer", "MultiHeadAttention",
     "OutputLayer", "PositionalEncodingLayer", "RBM", "RnnOutputLayer",
     "SimpleRnn", "TransformerBlock",
     "Subsampling1DLayer", "SubsamplingLayer", "Upsampling1D", "Upsampling2D",

@@ -1,0 +1,89 @@
+"""Mixture-of-experts layer for the config DSL.
+
+No reference equivalent (pre-transformer era) — the layer-level face of
+``parallel/expert.py``: top-1 Switch routing over a stack of expert FFNs,
+fixed capacity for static shapes.  The load-balancing aux loss is threaded
+through layer *state* (``aux_loss``) and added to the objective by the
+network loss (AUX_LOSS flag) — state-threading keeps it remat/checkpoint
+safe.  Works on FF [b, f] and RNN [b, t, f] inputs; for expert-parallel
+sharding see parallel/expert.py's shard_map formulation with all-to-all.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.serde import register_serde
+from ..conf.input_type import InputType
+from .base import BaseLayerConf
+
+__all__ = ["MixtureOfExpertsLayer"]
+
+
+@register_serde
+@dataclass
+class MixtureOfExpertsLayer(BaseLayerConf):
+    """params: router [f, E], w1 [E, f, hidden], b1, w2 [E, hidden, n_out],
+    b2.  capacity_factor sizes each expert's token budget as
+    ``capacity_factor * tokens / n_experts``."""
+    INPUT_KIND = "ff"
+    AUX_LOSS = True
+
+    n_in: int = 0
+    n_out: int = 0
+    n_experts: int = 4
+    hidden: int = 0                 # defaults to 4 * n_in
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            self.n_in = itype.size if itype.kind in ("ff", "rnn") else \
+                itype.flat_size()
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == "rnn":
+            return InputType.recurrent(self.n_out, itype.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        if self.n_in <= 0 or self.n_out <= 0:
+            raise ValueError(
+                f"layer '{self.name}': n_in/n_out unset — declare the "
+                "network input type")
+        h = self.hidden or 4 * self.n_in
+        kr, k1, k2 = jax.random.split(key, 3)
+        params = {
+            "router": self.make_weight(kr, (self.n_in, self.n_experts)),
+            "w1": self.make_weight(k1, (self.n_experts, self.n_in, h)),
+            "b1": self.make_bias((self.n_experts, 1, h)),
+            "w2": self.make_weight(k2, (self.n_experts, h, self.n_out)),
+            "b2": self.make_bias((self.n_experts, 1, self.n_out)),
+        }
+        return {"params": params,
+                "state": {"aux_loss": jnp.zeros((), self._dtype())}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        from ...parallel.expert import _dispatch_tensors
+        params = variables["params"]
+        x = self.maybe_dropout_input(key, x, train)
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        t = x2d.shape[0]
+        capacity = max(int(self.capacity_factor * t / self.n_experts), 1)
+        probs = jax.nn.softmax(x2d @ params["router"], axis=-1)
+        dispatch, combine = _dispatch_tensors(probs, capacity)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d)
+        hmid = self.act_fn(
+            jnp.einsum("ecd,edh->ech", expert_in, params["w1"])
+            + params["b1"])
+        out = jnp.einsum("ech,ehd->ecd", hmid, params["w2"]) + params["b2"]
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        frac = jnp.mean(
+            jax.nn.one_hot(jnp.argmax(probs, -1), self.n_experts), axis=0)
+        aux = self.n_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(
+            jnp.result_type(x))}
+        return y.reshape(shape[:-1] + (self.n_out,)), new_state

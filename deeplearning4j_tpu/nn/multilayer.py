@@ -173,6 +173,10 @@ class MultiLayerNetwork:
             lp = params.get(f"layer_{i}", {})
             if lp:
                 reg = reg + lc.regularization_score(lp)
+            if getattr(lc, "AUX_LOSS", False):
+                aux = new_state.get(f"layer_{i}", {}).get("aux_loss")
+                if aux is not None:
+                    reg = reg + aux
         return loss + reg, new_state
 
     # ---------------------------------------------------------- public API

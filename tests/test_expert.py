@@ -86,3 +86,57 @@ def test_capacity_drops_tokens_gracefully():
     zero_rows_small = int((np.abs(np.asarray(out_small)).sum(1) < 1e-9).sum())
     zero_rows_big = int((np.abs(np.asarray(out_big)).sum(1) < 1e-9).sum())
     assert zero_rows_small > zero_rows_big
+
+
+class TestMoeLayer:
+    """MixtureOfExpertsLayer in the config DSL (single-chip path; aux loss
+    threaded through state)."""
+
+    def _net(self, cdtype=None):
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.multi_layer import \
+            NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.updaters import Adam
+        from deeplearning4j_tpu.nn.layers import (MixtureOfExpertsLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        b = (NeuralNetConfiguration.builder().seed(11)
+             .updater(Adam(learning_rate=0.02)))
+        if cdtype:
+            b = b.compute_dtype(cdtype)
+        conf = (b.list()
+                .layer(MixtureOfExpertsLayer(n_out=8, n_experts=4,
+                                             hidden=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self):
+        rng = np.random.default_rng(4)
+        y_cls = rng.integers(0, 3, 96)
+        x = rng.standard_normal((96, 6)).astype(np.float32) * 0.3
+        x[:, :3] += np.eye(3, dtype=np.float32)[y_cls] * 2
+        return x, np.eye(3, dtype=np.float32)[y_cls]
+
+    def test_learns_and_tracks_aux(self):
+        net = self._net()
+        x, y = self._data()
+        s0 = net.score(x=x, y=y)
+        for _ in range(60):
+            net.fit(x, y)
+        assert net.score() < 0.4 * s0
+        aux = float(np.asarray(net.state["layer_0"]["aux_loss"]))
+        assert np.isfinite(aux) and aux >= 0
+        assert net.evaluate(x, y).accuracy() > 0.9
+
+    def test_works_under_remat_and_bf16(self):
+        import jax
+        net = self._net(cdtype="bfloat16")
+        net.conf.defaults["cache_mode"] = "remat"
+        x, y = self._data()
+        for _ in range(5):
+            net.fit(x, y)
+        assert np.isfinite(net.score())
+        for leaf in jax.tree_util.tree_leaves(net.params):
+            assert leaf.dtype == jnp.float32
