@@ -7,6 +7,7 @@ from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       SamplingDataSetIterator)
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
+from .interop import TorchDataSetIterator, as_torch_dataset, from_torch
 from .formatter import LocalUnstructuredDataFormatter
 from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
                        LFWDataSetIterator, TinyImageNetDataSetIterator)
@@ -21,5 +22,6 @@ __all__ = [
     "CifarDataSetIterator", "EmnistDataSetIterator", "LFWDataSetIterator",
     "TinyImageNetDataSetIterator", "LocalUnstructuredDataFormatter", "DataSetCallback",
     "FileSplitDataSetIterator", "export_dataset_batches", "load_dataset",
-    "save_dataset",
+    "save_dataset", "TorchDataSetIterator", "as_torch_dataset",
+    "from_torch",
 ]

@@ -1,5 +1,7 @@
 """Observability: stats collection → storage → web dashboard (reference
 ``deeplearning4j-ui-parent``: StatsListener → StatsStorage → PlayUIServer)."""
+from .components import (ChartHistogram, ChartLine, ChartScatter,
+                         ComponentTable, ComponentText, render_page)
 from .connection import UiConnectionInfo
 from .server import RemoteUIStatsStorageRouter, UIServer
 from .stats import StatsListener, StatsReport, array_stats
@@ -7,4 +9,6 @@ from .storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
 
 __all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
            "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
-           "RemoteUIStatsStorageRouter", "UiConnectionInfo"]
+           "RemoteUIStatsStorageRouter", "UiConnectionInfo", "ChartLine",
+           "ChartScatter", "ChartHistogram", "ComponentTable",
+           "ComponentText", "render_page"]

@@ -1,0 +1,196 @@
+"""Standalone chart/table/text components rendering to HTML+JS.
+
+Reference ``deeplearning4j-ui-components`` (chart/table/decorator DSL
+rendered to JS for reports and the training UI).  Components here render
+self-contained HTML snippets with inline SVG (no external JS deps — the
+same artifacts EvaluationTools produces), composable into a page via
+``render_page``.
+"""
+from __future__ import annotations
+
+import html
+import json
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ChartLine", "ChartScatter", "ChartHistogram", "ComponentTable",
+           "ComponentText", "render_page"]
+
+
+class _Component:
+    def render(self) -> str:
+        raise NotImplementedError
+
+
+class ComponentText(_Component):
+    """Styled text block (reference ``ComponentText``)."""
+
+    def __init__(self, text: str, size: int = 14, bold: bool = False):
+        self.text = text
+        self.size = size
+        self.bold = bold
+
+    def render(self) -> str:
+        weight = "bold" if self.bold else "normal"
+        return (f'<div style="font-size:{self.size}px;'
+                f'font-weight:{weight};margin:4px 0">'
+                f"{html.escape(self.text)}</div>")
+
+
+class ComponentTable(_Component):
+    """Header + rows table (reference ``ComponentTable``)."""
+
+    def __init__(self, header: Sequence[str], rows: Sequence[Sequence],
+                 title: str = ""):
+        self.header = list(header)
+        self.rows = [list(r) for r in rows]
+        self.title = title
+
+    def render(self) -> str:
+        h = "".join(f"<th>{html.escape(str(c))}</th>" for c in self.header)
+        body = "".join(
+            "<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in r)
+            + "</tr>" for r in self.rows)
+        cap = (f"<caption>{html.escape(self.title)}</caption>"
+               if self.title else "")
+        return (f'<table border="1" cellpadding="4" '
+                f'style="border-collapse:collapse;margin:8px 0">{cap}'
+                f"<tr>{h}</tr>{body}</table>")
+
+
+class _Chart(_Component):
+    WIDTH, HEIGHT, PAD = 540, 300, 40
+
+    def __init__(self, title: str = ""):
+        self.title = title
+
+    def _frame(self, inner: str, x_min, x_max, y_min, y_max) -> str:
+        w, h, p = self.WIDTH, self.HEIGHT, self.PAD
+        axes = (f'<line x1="{p}" y1="{h-p}" x2="{w-p}" y2="{h-p}" '
+                'stroke="black"/>'
+                f'<line x1="{p}" y1="{p}" x2="{p}" y2="{h-p}" '
+                'stroke="black"/>'
+                f'<text x="{p}" y="{h-p+16}" font-size="10">'
+                f"{x_min:.3g}</text>"
+                f'<text x="{w-p-30}" y="{h-p+16}" font-size="10">'
+                f"{x_max:.3g}</text>"
+                f'<text x="2" y="{h-p}" font-size="10">{y_min:.3g}</text>'
+                f'<text x="2" y="{p+8}" font-size="10">{y_max:.3g}</text>')
+        t = (f'<text x="{w//2}" y="16" text-anchor="middle" '
+             f'font-size="13">{html.escape(self.title)}</text>'
+             if self.title else "")
+        return (f'<svg width="{w}" height="{h}" '
+                'xmlns="http://www.w3.org/2000/svg" '
+                'style="background:#fff;margin:8px 0">'
+                f"{t}{axes}{inner}</svg>")
+
+    def _scale(self, xs, ys, x_min, x_max, y_min, y_max):
+        w, h, p = self.WIDTH, self.HEIGHT, self.PAD
+        sx = lambda v: p + (v - x_min) / max(x_max - x_min, 1e-12) * (w - 2 * p)
+        sy = lambda v: h - p - (v - y_min) / max(y_max - y_min, 1e-12) * (h - 2 * p)
+        return [sx(v) for v in xs], [sy(v) for v in ys]
+
+
+_COLORS = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e")
+
+
+class ChartLine(_Chart):
+    """Multi-series line chart (reference ``ChartLine``)."""
+
+    def __init__(self, title: str = ""):
+        super().__init__(title)
+        self.series: List[Tuple[str, np.ndarray, np.ndarray]] = []
+
+    def add_series(self, name: str, x, y) -> "ChartLine":
+        self.series.append((name, np.asarray(x, float),
+                            np.asarray(y, float)))
+        return self
+
+    def render(self) -> str:
+        if not self.series:
+            return self._frame("", 0, 1, 0, 1)
+        x_min = min(s[1].min() for s in self.series)
+        x_max = max(s[1].max() for s in self.series)
+        y_min = min(s[2].min() for s in self.series)
+        y_max = max(s[2].max() for s in self.series)
+        inner = []
+        for i, (name, xs, ys) in enumerate(self.series):
+            px, py = self._scale(xs, ys, x_min, x_max, y_min, y_max)
+            pts = " ".join(f"{a:.1f},{b:.1f}" for a, b in zip(px, py))
+            color = _COLORS[i % len(_COLORS)]
+            inner.append(f'<polyline points="{pts}" fill="none" '
+                         f'stroke="{color}" stroke-width="1.5"/>')
+            inner.append(f'<text x="{self.WIDTH-self.PAD+2}" '
+                         f'y="{self.PAD + 14 * i}" font-size="10" '
+                         f'fill="{color}">{html.escape(name)}</text>')
+        return self._frame("".join(inner), x_min, x_max, y_min, y_max)
+
+
+class ChartScatter(ChartLine):
+    """Scatter chart (reference ``ChartScatter``)."""
+
+    def render(self) -> str:
+        if not self.series:
+            return self._frame("", 0, 1, 0, 1)
+        x_min = min(s[1].min() for s in self.series)
+        x_max = max(s[1].max() for s in self.series)
+        y_min = min(s[2].min() for s in self.series)
+        y_max = max(s[2].max() for s in self.series)
+        inner = []
+        for i, (name, xs, ys) in enumerate(self.series):
+            px, py = self._scale(xs, ys, x_min, x_max, y_min, y_max)
+            color = _COLORS[i % len(_COLORS)]
+            inner.extend(f'<circle cx="{a:.1f}" cy="{b:.1f}" r="2.5" '
+                         f'fill="{color}"/>' for a, b in zip(px, py))
+            inner.append(f'<text x="{self.WIDTH-self.PAD+2}" '
+                         f'y="{self.PAD + 14 * i}" font-size="10" '
+                         f'fill="{color}">{html.escape(name)}</text>')
+        return self._frame("".join(inner), x_min, x_max, y_min, y_max)
+
+
+class ChartHistogram(_Chart):
+    """Binned histogram (reference ``ChartHistogram``)."""
+
+    def __init__(self, title: str = ""):
+        super().__init__(title)
+        self.bins: List[Tuple[float, float, float]] = []  # (lo, hi, count)
+
+    def add_bin(self, lo: float, hi: float, count: float) -> "ChartHistogram":
+        self.bins.append((float(lo), float(hi), float(count)))
+        return self
+
+    @staticmethod
+    def of(values, n_bins: int = 20, title: str = "") -> "ChartHistogram":
+        counts, edges = np.histogram(np.asarray(values, float), bins=n_bins)
+        ch = ChartHistogram(title)
+        for i, c in enumerate(counts):
+            ch.add_bin(edges[i], edges[i + 1], float(c))
+        return ch
+
+    def render(self) -> str:
+        if not self.bins:
+            return self._frame("", 0, 1, 0, 1)
+        x_min = min(b[0] for b in self.bins)
+        x_max = max(b[1] for b in self.bins)
+        y_max = max(b[2] for b in self.bins) or 1.0
+        w, h, p = self.WIDTH, self.HEIGHT, self.PAD
+        sx = lambda v: p + (v - x_min) / max(x_max - x_min, 1e-12) * (w - 2 * p)
+        inner = []
+        for lo, hi, c in self.bins:
+            bh = c / y_max * (h - 2 * p)
+            inner.append(
+                f'<rect x="{sx(lo):.1f}" y="{h - p - bh:.1f}" '
+                f'width="{max(sx(hi) - sx(lo) - 1, 1):.1f}" '
+                f'height="{bh:.1f}" fill="#1f77b4"/>')
+        return self._frame("".join(inner), x_min, x_max, 0, y_max)
+
+
+def render_page(components: Sequence[_Component], title: str = "Report"
+                ) -> str:
+    """Compose components into one standalone HTML page (the reference's
+    component-to-JS rendering role)."""
+    body = "\n".join(c.render() for c in components)
+    return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title></head>"
+            f"<body style='font-family:sans-serif'>{body}</body></html>")

@@ -151,3 +151,68 @@ def test_profiler_listener_and_memory_stats(tmp_path):
     assert any((tmp_path / "trace").rglob("*"))  # trace files exist
     stats = device_memory_stats()
     assert stats is None or "bytes_in_use" in stats
+
+
+def test_ui_components_render(tmp_path):
+    """Chart/table/text DSL -> standalone HTML (reference ui-components)."""
+    from deeplearning4j_tpu.ui import (ChartHistogram, ChartLine,
+                                       ChartScatter, ComponentTable,
+                                       ComponentText, render_page)
+    import numpy as np
+    line = (ChartLine("loss").add_series("train", [0, 1, 2], [3.0, 2.0, 1.0])
+            .add_series("val", [0, 1, 2], [3.5, 2.5, 2.0]))
+    scat = ChartScatter("embed").add_series("pts", [0.1, 0.5], [0.2, 0.9])
+    hist = ChartHistogram.of(np.random.default_rng(0).standard_normal(500),
+                             n_bins=10, title="weights")
+    table = ComponentTable(["metric", "value"], [["acc", 0.98],
+                                                ["f1", 0.97]], title="eval")
+    page = render_page([ComponentText("Training report", bold=True),
+                        line, scat, hist, table])
+    assert page.startswith("<!DOCTYPE html>")
+    assert page.count("<svg") == 3 and "<table" in page
+    assert "polyline" in page and "circle" in page and "rect" in page
+    assert "acc" in page and "weights" in page
+    (tmp_path / "report.html").write_text(page)
+
+
+def test_torch_interop_roundtrip():
+    """torch DataLoader -> our iterator -> train; and back to torch."""
+    import numpy as np
+    import torch
+    import torch.utils.data as tud
+    from deeplearning4j_tpu.data import (INDArrayDataSetIterator,
+                                         as_torch_dataset, from_torch)
+    rng = np.random.default_rng(0)
+    y_cls = rng.integers(0, 3, 60)
+    x = rng.standard_normal((60, 4)).astype(np.float32)
+    x[:, :3] += np.eye(3, dtype=np.float32)[y_cls] * 2
+    tds = tud.TensorDataset(torch.from_numpy(x), torch.from_numpy(y_cls))
+    it = from_torch(tds, batch_size=20, n_classes=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].labels.shape == (20, 3)
+
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=25)
+    assert net.evaluate(x, np.eye(3, dtype=np.float32)[y_cls]).accuracy() > 0.9
+    # NCHW image batches transpose to NHWC
+    imgs = torch.zeros(4, 3, 8, 8)
+    t2 = tud.TensorDataset(imgs, torch.zeros(4, dtype=torch.long))
+    b = next(iter(from_torch(t2, batch_size=4, n_classes=2)))
+    assert b.features.shape == (4, 8, 8, 3)
+    # reverse direction
+    back = as_torch_dataset(INDArrayDataSetIterator(
+        x, np.eye(3, dtype=np.float32)[y_cls], batch_size=30))
+    got = list(iter(back))
+    assert len(got) == 2 and got[0][0].shape == (30, 4)
