@@ -124,7 +124,9 @@ class LeNet(ZooModel):
                 .layer(DenseLayer(n_out=500))
                 .layer(OutputLayer(n_out=self.num_classes,
                                    activation="softmax", loss="mcxent"))
-                .set_input_type(InputType.convolutional(h, w, c))
+                # flat input + auto reshape, matching the reference LeNet's
+                # InputType.convolutionalFlat (MnistDataSetIterator is flat)
+                .set_input_type(InputType.convolutional_flat(h, w, c))
                 .build())
         from ..nn.multilayer import MultiLayerNetwork
         return MultiLayerNetwork(conf).init()

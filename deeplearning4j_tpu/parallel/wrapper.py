@@ -38,8 +38,13 @@ def megatron_dense_rule(params) -> Callable[[str, str, Any], P]:
     """Alternate column/row parallel sharding for stacked dense layers:
     even layers split n_out over 'model', odd layers split n_in — activations
     stay sharded between the pair and XLA inserts one all-reduce per pair."""
-    order = sorted(params.keys(), key=lambda s: int(s.split("_")[1]))
-    idx = {n: i for i, n in enumerate(order)}
+    def _pos(name):
+        tail = name.rsplit("_", 1)[-1]
+        return int(tail) if tail.isdigit() else None
+
+    order = sorted((n for n in params.keys() if _pos(n) is not None),
+                   key=_pos)
+    idx = {n: i for i, n in enumerate(order)}  # non-layer_N names replicate
 
     def rule(lname, pname, leaf):
         if pname == "W" and getattr(leaf, "ndim", 0) == 2:
@@ -107,7 +112,14 @@ class ParallelWrapper:
         Same contract as ``MultiLayerNetwork.fit``: (x, y) arrays or an
         iterable/iterator of batches, optional masks, multiple epochs."""
         m, mesh = self.model, self.mesh
-        put = lambda a: (None if a is None else shard_batch(mesh, jnp.asarray(a)))
+
+        def put(a):
+            if a is None:
+                return None
+            if isinstance(a, (list, tuple)):  # ComputationGraph multi-input
+                return [None if e is None else
+                        shard_batch(mesh, jnp.asarray(e)) for e in a]
+            return shard_batch(mesh, jnp.asarray(a))
         if labels is not None:
             batches_factory = lambda: [(data, labels, mask, label_mask)]
         elif hasattr(data, "reset") or hasattr(data, "__iter__"):

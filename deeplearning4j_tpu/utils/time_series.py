@@ -1,0 +1,63 @@
+"""Time-series utilities.
+
+Reference ``deeplearning4j-nn/.../util/TimeSeriesUtils.java`` (mask
+reshaping, last-time-step extraction, time-axis reversal) — array helpers
+shared by the recurrent stack and evaluation.  All functions are
+jit-friendly (pure jnp/numpy, static shapes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["reverse_time_series", "get_last_time_step",
+           "moving_window_matrix", "reshape_time_series_mask"]
+
+
+def reverse_time_series(x, mask=None):
+    """Reverse [b, t, f] along time; with a mask, each sequence reverses
+    within its own valid length (reference ``reverseTimeSeries``) so
+    padding stays at the end."""
+    x = jnp.asarray(x)
+    if mask is None:
+        return x[:, ::-1]
+    mask = jnp.asarray(mask)
+    t = x.shape[1]
+    lengths = jnp.sum(mask > 0, axis=1).astype(jnp.int32)      # [b]
+    idx = jnp.arange(t)[None, :]                               # [1, t]
+    src = lengths[:, None] - 1 - idx                           # [b, t]
+    src = jnp.where(src >= 0, src, idx)                        # padding stays
+    return jnp.take_along_axis(x, src[:, :, None], axis=1)
+
+
+def get_last_time_step(x, mask=None):
+    """[b, t, f] -> [b, f] at each sequence's final VALID step (reference
+    ``pullLastTimeSteps``)."""
+    x = jnp.asarray(x)
+    if mask is None:
+        return x[:, -1]
+    lengths = jnp.sum(jnp.asarray(mask) > 0, axis=1).astype(jnp.int32)
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(
+        x, idx[:, None, None].repeat(x.shape[-1], -1), axis=1)[:, 0]
+
+
+def moving_window_matrix(x, window: int, stride: int = 1) -> np.ndarray:
+    """[t, f] -> [n_windows, window, f] sliding views (reference
+    ``MovingWindowMatrix``)."""
+    x = np.asarray(x)
+    t = x.shape[0]
+    if window > t:
+        raise ValueError(f"window {window} exceeds length {t}")
+    starts = range(0, t - window + 1, stride)
+    return np.stack([x[s:s + window] for s in starts])
+
+
+def reshape_time_series_mask(mask, n_features: int):
+    """Per-timestep mask [b, t] -> flattened per-example mask
+    [b*t, n_features] for 2-D losses (reference
+    ``reshapeTimeSeriesMaskToVector``)."""
+    m = jnp.asarray(mask).reshape(-1)
+    return jnp.repeat(m[:, None], n_features, axis=1)

@@ -1,0 +1,62 @@
+"""Character-level LSTM text generation — the reference's
+GravesLSTMCharModellingExample / zoo TextGenerationLSTM.
+
+Run: python examples/char_lstm.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+TEXT = ("the quick brown fox jumps over the lazy dog. " * 40)
+
+
+def main():
+    chars = sorted(set(TEXT))
+    idx = {c: i for i, c in enumerate(chars)}
+    v = len(chars)
+    seq, batch = 32, 16
+    rng = np.random.default_rng(0)
+
+    def batch_xy():
+        starts = rng.integers(0, len(TEXT) - seq - 1, batch)
+        x = np.zeros((batch, seq, v), np.float32)
+        y = np.zeros((batch, seq, v), np.float32)
+        for b, s in enumerate(starts):
+            for t in range(seq):
+                x[b, t, idx[TEXT[s + t]]] = 1
+                y[b, t, idx[TEXT[s + t + 1]]] = 1
+        return x, y
+
+    conf = (NeuralNetConfiguration.builder().seed(12)
+            .updater(Adam(learning_rate=5e-3)).list()
+            .layer(LSTM(n_out=64, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=v, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(v, seq)).build())
+    net = MultiLayerNetwork(conf).init()
+    for i in range(150):
+        x, y = batch_xy()
+        net.fit(x, y)
+        if i % 30 == 0:
+            print(f"iter {i}: loss {net.score():.4f}")
+
+    # stream a sample with rnn_time_step (stateful inference)
+    net.rnn_clear_previous_state()
+    cur = np.zeros((1, v), np.float32)
+    cur[0, idx["t"]] = 1
+    out = ["t"]
+    for _ in range(60):
+        probs = np.asarray(net.rnn_time_step(cur))[0]
+        nxt = int(np.argmax(probs))
+        out.append(chars[nxt])
+        cur = np.zeros((1, v), np.float32)
+        cur[0, nxt] = 1
+    print("sample:", "".join(out))
+
+
+if __name__ == "__main__":
+    main()

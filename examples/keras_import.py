@@ -1,0 +1,23 @@
+"""Import a Keras HDF5 model and serve predictions — the reference's
+Keras model-import examples.
+
+Run: python examples/keras_import.py path/to/model.h5
+"""
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport import import_keras_model
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return
+    net = import_keras_model(sys.argv[1])
+    print(f"imported {type(net).__name__} with "
+          f"{net.num_params() if hasattr(net, 'num_params') else '?'} params")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""LeNet on MNIST — the reference's canonical first example
+(dl4j-examples LenetMnistExample): build from the zoo, train with
+listeners, evaluate.
+
+Run: python examples/mnist_lenet.py  (synthetic MNIST unless MNIST_DIR set)
+"""
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.train.listeners import (PerformanceListener,
+                                                ScoreIterationListener)
+
+
+def main():
+    net = LeNet(num_classes=10).init()
+    net.set_listeners(ScoreIterationListener(20), PerformanceListener(20))
+    train = MnistDataSetIterator(batch_size=64, train=True)
+    test = MnistDataSetIterator(batch_size=256, train=False)
+    net.fit(train, epochs=2)
+    ev = net.evaluate(test)
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Word2Vec skip-gram embeddings — the reference's Word2VecRawTextExample.
+
+Run: python examples/word2vec_basic.py
+"""
+from deeplearning4j_tpu.nlp import CollectionSentenceIterator
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+CORPUS = [
+    "king rules the kingdom with the queen",
+    "queen rules beside the king",
+    "dog chases the cat around the yard",
+    "cat runs from the dog in the yard",
+    "king and queen live in the castle",
+    "dog and cat play in the yard",
+] * 30
+
+
+def main():
+    w2v = Word2Vec(sentences=CollectionSentenceIterator(CORPUS),
+                   layer_size=32, window=3, min_word_frequency=2,
+                   seed=7, epochs=12)
+    w2v.fit()
+    print("king ~ queen:", round(w2v.similarity("king", "queen"), 3))
+    print("king ~ dog:  ", round(w2v.similarity("king", "dog"), 3))
+    print("nearest(dog):", w2v.words_nearest("dog", top_n=3))
+
+
+if __name__ == "__main__":
+    main()
