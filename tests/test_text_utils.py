@@ -127,3 +127,38 @@ class TestMovingWindowAndLfw:
         assert np.asarray(b.labels).shape == (16, 5)
         assert 0.0 <= float(np.asarray(b.features).min())
         assert float(np.asarray(b.features).max()) <= 1.0
+
+
+class TestTimeSeriesUtils:
+    def test_reverse_with_mask_keeps_padding(self):
+        from deeplearning4j_tpu.utils.time_series import reverse_time_series
+        x = np.arange(2 * 4 * 1, dtype=np.float32).reshape(2, 4, 1)
+        mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], np.float32)
+        out = np.asarray(reverse_time_series(x, mask))
+        np.testing.assert_allclose(out[0, :, 0], [2, 1, 0, 3])  # pad stays
+        np.testing.assert_allclose(out[1, :, 0], [7, 6, 5, 4])
+
+    def test_last_time_step(self):
+        from deeplearning4j_tpu.utils.time_series import get_last_time_step
+        x = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)
+        mask = np.array([[1, 1, 0], [1, 1, 1]], np.float32)
+        out = np.asarray(get_last_time_step(x, mask))
+        np.testing.assert_allclose(out[0], x[0, 1])
+        np.testing.assert_allclose(out[1], x[1, 2])
+
+    def test_moving_window_matrix(self):
+        from deeplearning4j_tpu.utils.time_series import moving_window_matrix
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        w = moving_window_matrix(x, window=3, stride=1)
+        assert w.shape == (3, 3, 2)
+        np.testing.assert_allclose(w[1], x[1:4])
+        with pytest.raises(ValueError, match="window"):
+            moving_window_matrix(x, window=9)
+
+    def test_reshape_mask(self):
+        from deeplearning4j_tpu.utils.time_series import \
+            reshape_time_series_mask
+        m = np.array([[1, 0], [1, 1]], np.float32)
+        out = np.asarray(reshape_time_series_mask(m, 3))
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out[1], 0)
