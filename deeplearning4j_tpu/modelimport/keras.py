@@ -180,12 +180,13 @@ def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool) -> _LayerMap:
         s = conf.get("strides", conf.get("subsample_length", 1))
         s = int(s[0] if isinstance(s, (list, tuple)) else s)
         padding = conf.get("padding", conf.get("border_mode", "valid"))
-        if padding not in ("valid", "same", "causal"):
+        if padding not in ("valid", "same"):
+            # 'causal' pads left-only — silently mapping it to 'same'
+            # would leak future timesteps
             raise KerasImportError(f"unsupported Conv1D padding '{padding}'")
         lc = Convolution1DLayer(
             name=name, n_out=n_out, kernel_size=k, stride=s,
-            convolution_mode="same" if padding in ("same", "causal")
-            else "truncate",
+            convolution_mode="same" if padding == "same" else "truncate",
             activation=_act(conf.get("activation")),
             has_bias=conf.get("use_bias", conf.get("bias", True)))
 

@@ -40,14 +40,13 @@ class SentenceSegmenter:
             before = text[start:m.start(1)].rstrip()
             word = before.rsplit(None, 1)[-1].lower() if before else ""
             if m.group(1) == ".":
+                # decimals ("3.14") never match _BOUNDARY — no whitespace
+                # follows their period — so only abbreviations and initials
+                # need suppression here
                 if word.rstrip(".") in self.abbrev:
                     continue           # "Dr." — not a boundary
                 if len(word) == 1 and word.isalpha():
                     continue           # "J. Smith" initial
-                nxt = text[m.end():m.end() + 1]
-                if nxt.isdigit() or (word and word[-1].isdigit()
-                                     and nxt.isdigit()):
-                    continue           # decimal "3.14"
             sent = text[start:end].strip()
             if sent:
                 out.append(sent)

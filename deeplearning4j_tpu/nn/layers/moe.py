@@ -66,24 +66,14 @@ class MixtureOfExpertsLayer(BaseLayerConf):
                 "state": {"aux_loss": jnp.zeros((), self._dtype())}}
 
     def apply(self, variables, x, *, train=False, key=None, mask=None):
-        from ...parallel.expert import _dispatch_tensors
+        from ...parallel.expert import moe_ffn
         params = variables["params"]
         x = self.maybe_dropout_input(key, x, train)
         shape = x.shape
         x2d = x.reshape(-1, shape[-1])
         t = x2d.shape[0]
         capacity = max(int(self.capacity_factor * t / self.n_experts), 1)
-        probs = jax.nn.softmax(x2d @ params["router"], axis=-1)
-        dispatch, combine = _dispatch_tensors(probs, capacity)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, x2d)
-        hmid = self.act_fn(
-            jnp.einsum("ecd,edh->ech", expert_in, params["w1"])
-            + params["b1"])
-        out = jnp.einsum("ech,ehd->ecd", hmid, params["w2"]) + params["b2"]
-        y = jnp.einsum("tec,ecd->td", combine, out)
-        frac = jnp.mean(
-            jax.nn.one_hot(jnp.argmax(probs, -1), self.n_experts), axis=0)
-        aux = self.n_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+        y, aux = moe_ffn(params, x2d, capacity, act=self.act_fn)
         new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(
             jnp.result_type(x))}
         return y.reshape(shape[:-1] + (self.n_out,)), new_state

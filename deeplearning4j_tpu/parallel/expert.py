@@ -58,8 +58,8 @@ def _dispatch_tensors(router_probs: jax.Array, capacity: int
 
 
 def moe_ffn(params: Dict[str, jax.Array], x: jax.Array, capacity: int,
-            expert_axis: Optional[str] = None
-            ) -> Tuple[jax.Array, jax.Array]:
+            expert_axis: Optional[str] = None,
+            act=jax.nn.relu) -> Tuple[jax.Array, jax.Array]:
     """MoE FFN over local tokens x [T, D].
 
     Without ``expert_axis``: single-device path — w1/w2 hold ALL experts.
@@ -78,8 +78,9 @@ def moe_ffn(params: Dict[str, jax.Array], x: jax.Array, capacity: int,
     if expert_axis is not None:
         expert_in = lax.all_to_all(expert_in, expert_axis, split_axis=0,
                                    concat_axis=1, tiled=True)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
-    out = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+    h = act(jnp.einsum("ecd,edh->ech", expert_in, params["w1"])
+            + params.get("b1", 0))
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) + params.get("b2", 0)
     if expert_axis is not None:
         out = lax.all_to_all(out, expert_axis, split_axis=1,
                              concat_axis=0, tiled=True)

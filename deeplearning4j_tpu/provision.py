@@ -55,8 +55,11 @@ class TpuClusterSetup:
             cmd.append("--preemptible")
         if s.network:
             cmd.append(f"--network={s.network}")
-        for k, v in sorted(s.tags.items()):
-            cmd.append(f"--labels={k}={v}")
+        if s.tags:
+            # gcloud --labels is a dict flag: repeating it overrides, so
+            # all pairs must go in one comma-joined occurrence
+            pairs = ",".join(f"{k}={v}" for k, v in sorted(s.tags.items()))
+            cmd.append(f"--labels={pairs}")
         return cmd
 
     def delete_command(self) -> List[str]:

@@ -107,6 +107,11 @@ class ChartLine(_Chart):
                             np.asarray(y, float)))
         return self
 
+    def _marks(self, px, py, color) -> str:
+        pts = " ".join(f"{a:.1f},{b:.1f}" for a, b in zip(px, py))
+        return (f'<polyline points="{pts}" fill="none" '
+                f'stroke="{color}" stroke-width="1.5"/>')
+
     def render(self) -> str:
         if not self.series:
             return self._frame("", 0, 1, 0, 1)
@@ -117,10 +122,8 @@ class ChartLine(_Chart):
         inner = []
         for i, (name, xs, ys) in enumerate(self.series):
             px, py = self._scale(xs, ys, x_min, x_max, y_min, y_max)
-            pts = " ".join(f"{a:.1f},{b:.1f}" for a, b in zip(px, py))
             color = _COLORS[i % len(_COLORS)]
-            inner.append(f'<polyline points="{pts}" fill="none" '
-                         f'stroke="{color}" stroke-width="1.5"/>')
+            inner.append(self._marks(px, py, color))
             inner.append(f'<text x="{self.WIDTH-self.PAD+2}" '
                          f'y="{self.PAD + 14 * i}" font-size="10" '
                          f'fill="{color}">{html.escape(name)}</text>')
@@ -128,25 +131,12 @@ class ChartLine(_Chart):
 
 
 class ChartScatter(ChartLine):
-    """Scatter chart (reference ``ChartScatter``)."""
+    """Scatter chart (reference ``ChartScatter``): point marks, shared
+    frame/legend from ChartLine."""
 
-    def render(self) -> str:
-        if not self.series:
-            return self._frame("", 0, 1, 0, 1)
-        x_min = min(s[1].min() for s in self.series)
-        x_max = max(s[1].max() for s in self.series)
-        y_min = min(s[2].min() for s in self.series)
-        y_max = max(s[2].max() for s in self.series)
-        inner = []
-        for i, (name, xs, ys) in enumerate(self.series):
-            px, py = self._scale(xs, ys, x_min, x_max, y_min, y_max)
-            color = _COLORS[i % len(_COLORS)]
-            inner.extend(f'<circle cx="{a:.1f}" cy="{b:.1f}" r="2.5" '
-                         f'fill="{color}"/>' for a, b in zip(px, py))
-            inner.append(f'<text x="{self.WIDTH-self.PAD+2}" '
-                         f'y="{self.PAD + 14 * i}" font-size="10" '
-                         f'fill="{color}">{html.escape(name)}</text>')
-        return self._frame("".join(inner), x_min, x_max, y_min, y_max)
+    def _marks(self, px, py, color) -> str:
+        return "".join(f'<circle cx="{a:.1f}" cy="{b:.1f}" r="2.5" '
+                       f'fill="{color}"/>' for a, b in zip(px, py))
 
 
 class ChartHistogram(_Chart):

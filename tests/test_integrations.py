@@ -85,6 +85,9 @@ class TestProvision:
         assert "--accelerator-type=v5e-64" in create
         assert "--project=p1" in create and "--preemptible" in create
         assert "--labels=team=ml" in create
+        multi = TpuClusterSetup(ClusterSpec(
+            name="m", tags={"b": "2", "a": "1"})).create_command()
+        assert "--labels=a=1,b=2" in multi  # one dict-flag occurrence
         assert "delete" in setup.delete_command()
         # dry-run apply returns the command, no execution
         assert setup.apply(execute=False) == create
@@ -108,6 +111,12 @@ class TestUimaEquivalents:
             "fun? Yes.")
         assert segs == ["Dr. Smith arrived at 3.5 p.m. sharp.",
                         "He met J. Doe!", "Was it fun?", "Yes."]
+
+    def test_sentences_starting_with_numbers_split(self):
+        segs = SentenceSegmenter().segment(
+            "Tests ran fine. 42 of them passed. All good.")
+        assert segs == ["Tests ran fine.", "42 of them passed.",
+                        "All good."]
 
     def test_sentence_iterator(self):
         it = UimaSentenceIterator(["One. Two.", "Three!"])

@@ -178,7 +178,7 @@ def test_ui_components_render(tmp_path):
 def test_torch_interop_roundtrip():
     """torch DataLoader -> our iterator -> train; and back to torch."""
     import numpy as np
-    import torch
+    torch = pytest.importorskip("torch")
     import torch.utils.data as tud
     from deeplearning4j_tpu.data import (INDArrayDataSetIterator,
                                          as_torch_dataset, from_torch)
