@@ -370,7 +370,19 @@ class ComputationGraph:
 
     def evaluate(self, iterator_or_x, y=None):
         from ..evaluation.classification import Evaluation
-        ev = Evaluation()
+        return self._evaluate_with(Evaluation(), iterator_or_x, y)
+
+    def evaluate_regression(self, iterator_or_x, y=None):
+        from ..evaluation.regression import RegressionEvaluation
+        return self._evaluate_with(RegressionEvaluation(), iterator_or_x, y)
+
+    def evaluate_roc(self, iterator_or_x, y=None, threshold_steps: int = 0):
+        from ..evaluation.roc import ROC
+        return self._evaluate_with(ROC(threshold_steps), iterator_or_x, y)
+
+    def _evaluate_with(self, ev, iterator_or_x, y=None):
+        """First network output vs labels (reference ComputationGraph
+        evaluate/evaluateROC/evaluateRegression)."""
         for xs, yy in self._eval_batches(iterator_or_x, y):
             out = self.output(*xs)
             if isinstance(out, list):

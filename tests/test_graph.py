@@ -252,3 +252,34 @@ def test_graph_builder_modules():
     out = net.output(xs)
     out = out[0] if isinstance(out, (list, tuple)) else out
     assert np.asarray(out).shape == (2, 5)
+
+
+def test_graph_evaluate_variants():
+    """CG evaluate/evaluate_regression/evaluate_roc parity with MLN."""
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+
+    g = GraphBuilder({"updater": Adam(learning_rate=0.05)})
+    g.add_inputs("in").set_input_types(InputType.feed_forward(4))
+    g.add_layer("h", DenseLayer(n_out=12, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "h")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(0)
+    y_cls = rng.integers(0, 2, 80)
+    x = rng.standard_normal((80, 4)).astype(np.float32)
+    x[:, 0] += y_cls * 2.5
+    y = np.eye(2, dtype=np.float32)[y_cls]
+    for _ in range(40):
+        net.fit([x], [y])
+    assert net.evaluate(x, y).accuracy() > 0.9
+    roc = net.evaluate_roc(x, y)
+    assert roc.calculate_auc() > 0.9
+    reg = net.evaluate_regression(x, y)
+    assert reg.average_mean_squared_error() < 0.2
