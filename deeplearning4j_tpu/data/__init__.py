@@ -5,6 +5,8 @@ from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       ExistingDataSetIterator, INDArrayDataSetIterator,
                       MovingWindowDataSetIterator, MultipleEpochsIterator,
                       SamplingDataSetIterator)
+from .dataset import MultiDataSet
+from .records import RecordReaderMultiDataSetIterator
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
 from .interop import TorchDataSetIterator, as_torch_dataset, from_torch
@@ -23,5 +25,5 @@ __all__ = [
     "TinyImageNetDataSetIterator", "LocalUnstructuredDataFormatter", "DataSetCallback",
     "FileSplitDataSetIterator", "export_dataset_batches", "load_dataset",
     "save_dataset", "TorchDataSetIterator", "as_torch_dataset",
-    "from_torch",
+    "from_torch", "MultiDataSet", "RecordReaderMultiDataSetIterator",
 ]

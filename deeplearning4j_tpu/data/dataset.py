@@ -410,3 +410,28 @@ class FileSplitDataSetIterator(DataSetIterator):
             if self.callback is not None:
                 ds = self.callback.call(ds)
             yield ds
+
+
+class MultiDataSet:
+    """Multi-input/multi-output container (nd4j MultiDataSet role):
+    features/labels are LISTS of arrays — the ComputationGraph batch
+    shape."""
+
+    def __init__(self, features, labels, features_masks=None,
+                 labels_masks=None):
+        as_list = lambda v: list(v) if isinstance(v, (list, tuple)) else [v]
+        self.features = as_list(features)
+        self.labels = as_list(labels)
+        self.features_mask = (None if features_masks is None
+                              else as_list(features_masks))
+        self.labels_mask = (None if labels_masks is None
+                            else as_list(labels_masks))
+
+    def num_examples(self) -> int:
+        return self.features[0].shape[0]
+
+    def __iter__(self):
+        yield self.features
+        yield self.labels
+        yield self.features_mask
+        yield self.labels_mask

@@ -11,7 +11,7 @@ from __future__ import annotations
 import csv
 import os
 from pathlib import Path
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -207,3 +207,106 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 idx = np.asarray(l, dtype=np.int64).reshape(-1)
                 y[i, :t] = np.eye(self.n_classes, dtype=np.float32)[idx]
         return DataSet(x, y, features_mask=mask, labels_mask=mask)
+
+
+class RecordReaderMultiDataSetIterator:
+    """Multiple record readers -> MultiDataSet batches (reference
+    ``RecordReaderMultiDataSetIterator.java`` builder: named readers with
+    per-reader input/output column selections).
+
+    Usage::
+
+        it = (RecordReaderMultiDataSetIterator.builder(batch_size=32)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)            # columns 0..3 inclusive
+              .add_output_one_hot("csv", 4, 3)   # column 4, 3 classes
+              .build())
+    """
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            self.batch_size = batch_size
+            self.readers: Dict[str, RecordReader] = {}
+            self.inputs: List[tuple] = []    # (reader, lo, hi)
+            self.outputs: List[tuple] = []   # (reader, lo, hi, n_classes)
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self.readers[name] = reader
+            return self
+
+        def add_input(self, reader: str, col_from: int, col_to: int):
+            self.inputs.append((reader, col_from, col_to, None))
+            return self
+
+        def add_output(self, reader: str, col_from: int, col_to: int):
+            self.outputs.append((reader, col_from, col_to, None))
+            return self
+
+        def add_output_one_hot(self, reader: str, col: int, n_classes: int):
+            self.outputs.append((reader, col, col, n_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            if not self.inputs or not self.outputs:
+                raise ValueError("need at least one input and one output")
+            missing = {r for r, *_ in self.inputs + self.outputs} \
+                - set(self.readers)
+            if missing:
+                raise ValueError(f"selections reference unknown readers "
+                                 f"{sorted(missing)}")
+            return RecordReaderMultiDataSetIterator(self)
+
+    @staticmethod
+    def builder(batch_size: int) -> "RecordReaderMultiDataSetIterator.Builder":
+        return RecordReaderMultiDataSetIterator.Builder(batch_size)
+
+    def __init__(self, b: "RecordReaderMultiDataSetIterator.Builder"):
+        self._b = b
+
+    def batch(self) -> int:
+        return self._b.batch_size
+
+    def reset(self) -> None:
+        for r in self._b.readers.values():
+            r.reset()
+
+    @staticmethod
+    def _slice(rows: np.ndarray, lo: int, hi: int,
+               n_classes: Optional[int]) -> np.ndarray:
+        cols = rows[:, lo:hi + 1].astype(np.float32)
+        if n_classes is not None:
+            return np.eye(n_classes, dtype=np.float32)[
+                cols[:, 0].astype(np.int64)]
+        return cols
+
+    def __iter__(self):
+        from .dataset import MultiDataSet
+        b = self._b
+        self.reset()
+        streams = {name: iter(r) for name, r in b.readers.items()}
+        while True:
+            rows: Dict[str, List] = {}
+            done = False
+            for _ in range(b.batch_size):
+                record = {}
+                for name, st in streams.items():
+                    nxt = next(st, None)
+                    if nxt is None:
+                        done = True
+                        break
+                    record[name] = nxt
+                if done:
+                    break
+                for name, vals in record.items():
+                    rows.setdefault(name, []).append(vals)
+            if not rows:
+                return
+            mats = {name: np.asarray(v, np.float32)
+                    for name, v in rows.items()}
+            feats = [self._slice(mats[r], lo, hi, nc)
+                     for r, lo, hi, nc in b.inputs]
+            labels = [self._slice(mats[r], lo, hi, nc)
+                      for r, lo, hi, nc in b.outputs]
+            yield MultiDataSet(feats, labels)
+            if done:
+                return
