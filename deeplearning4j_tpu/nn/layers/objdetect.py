@@ -164,3 +164,36 @@ def get_predicted_objects(activated, threshold: float = 0.5):
                                      float(conf * p[5 + cls]), cls))
         out.append(np.asarray(dets, dtype=np.float32).reshape(-1, 6))
     return out
+
+
+def non_max_suppression(detections, iou_threshold: float = 0.45):
+    """Greedy per-class NMS over one image's [n, 6] detections
+    (x1, y1, x2, y2, score, class) — reference ``YoloUtils.nms``.
+    Returns the surviving rows, score-descending."""
+    import numpy as np
+    dets = np.asarray(detections, np.float32).reshape(-1, 6)
+    if len(dets) == 0:
+        return dets
+    keep = []
+    for cls in np.unique(dets[:, 5]):
+        d = dets[dets[:, 5] == cls]
+        d = d[np.argsort(-d[:, 4])]
+        while len(d):
+            best = d[0]
+            keep.append(best)
+            if len(d) == 1:
+                break
+            rest = d[1:]
+            ix1 = np.maximum(best[0], rest[:, 0])
+            iy1 = np.maximum(best[1], rest[:, 1])
+            ix2 = np.minimum(best[2], rest[:, 2])
+            iy2 = np.minimum(best[3], rest[:, 3])
+            inter = (np.clip(ix2 - ix1, 0, None)
+                     * np.clip(iy2 - iy1, 0, None))
+            a1 = (best[2] - best[0]) * (best[3] - best[1])
+            a2 = ((rest[:, 2] - rest[:, 0])
+                  * (rest[:, 3] - rest[:, 1]))
+            iou = inter / np.maximum(a1 + a2 - inter, 1e-9)
+            d = rest[iou < iou_threshold]
+    out = np.asarray(keep, np.float32).reshape(-1, 6)
+    return out[np.argsort(-out[:, 4])]

@@ -188,3 +188,19 @@ def test_lenet_style_mnist_training():
     assert s1 < s0 * 0.7, (s0, s1)
     acc = net.evaluate(it).accuracy()
     assert acc > 0.8, acc
+
+
+def test_yolo_non_max_suppression():
+    """Greedy per-class NMS (reference YoloUtils.nms)."""
+    from deeplearning4j_tpu.nn.layers.objdetect import non_max_suppression
+    dets = np.array([
+        [0, 0, 2, 2, 0.9, 0],     # kept (best of overlapping pair)
+        [0.1, 0.1, 2.1, 2.1, 0.8, 0],  # IoU ~0.82 with above -> suppressed
+        [5, 5, 7, 7, 0.7, 0],     # kept: disjoint
+        [0, 0, 2, 2, 0.6, 1],     # kept: different class
+    ], np.float32)
+    out = non_max_suppression(dets, iou_threshold=0.45)
+    assert out.shape == (3, 6)
+    assert out[0, 4] == pytest.approx(0.9)      # score-descending
+    np.testing.assert_allclose(sorted(out[:, 4]), [0.6, 0.7, 0.9])
+    assert non_max_suppression(np.zeros((0, 6))).shape == (0, 6)
