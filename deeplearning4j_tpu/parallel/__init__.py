@@ -4,6 +4,8 @@ ring/Ulysses sequence parallelism, expert-parallel MoE (reference
 from .accumulation import (EncodedGradientsAccumulator, EncodingHandler,
                            bitmap_decode, bitmap_encode, threshold_decode,
                            threshold_encode)
+from .remote import (RemoteGradientSharing, decode_message_bytes,
+                     encode_message_bytes)
 from .expert import init_moe_params, make_moe_train_step, moe_ffn
 from .distributed import (ElasticTrainer, global_device_mesh,
                           initialize_distributed)
@@ -27,4 +29,5 @@ __all__ = [
     "stack_stage_params", "threshold_decode", "threshold_encode",
     "tree_average", "ulysses_attention", "init_moe_params",
     "make_moe_train_step", "moe_ffn", "TrainingMasterStats",
+    "RemoteGradientSharing", "encode_message_bytes", "decode_message_bytes",
 ]

@@ -1,0 +1,104 @@
+"""Gradient sharing over a message broker (the DCN / multi-host path).
+
+Reference: the Aeron transport under ``SharedTrainingMaster`` —
+``RoutedTransport``/``MulticastTransport`` carrying ``SilentUpdatesMessage``
+(threshold-quantized gradients) peer-to-peer, no barrier.  Here the same
+encoded-update messages (``parallel/accumulation.py`` formats) get a
+compact binary wire format and ride any broker with
+publish/subscribe(topic) — in-process (``LocalMessageBroker``) for tests,
+TCP (``TcpMessageBroker``) across processes/hosts.  Intra-slice sharing
+stays dense all-reduce over ICI (ParallelWrapper); this is for the
+bandwidth-starved boundary.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .accumulation import EncodingHandler, decode
+
+__all__ = ["encode_message_bytes", "decode_message_bytes",
+           "RemoteGradientSharing"]
+
+_MAGIC = b"GUP1"
+_KINDS = ("threshold", "bitmap")
+
+
+def encode_message_bytes(worker_id: int, msg: Dict[str, Any]) -> bytes:
+    """Encoded-update message -> wire frame (the SilentUpdatesMessage
+    serialization role)."""
+    kind = _KINDS.index(msg["kind"])
+    head = _MAGIC + struct.pack("<iBqf", worker_id, kind, msg["size"],
+                                msg["threshold"])
+    if msg["kind"] == "threshold":
+        idx = np.ascontiguousarray(msg["idx"], np.int32)
+        signs = np.ascontiguousarray(msg["signs"], np.int8)
+        return head + struct.pack("<q", idx.size) + idx.tobytes() \
+            + signs.tobytes()
+    packed = np.ascontiguousarray(msg["packed"], np.uint8)
+    return head + struct.pack("<q", packed.size) + packed.tobytes()
+
+
+def decode_message_bytes(data: bytes):
+    """Wire frame -> (worker_id, message dict)."""
+    if data[:4] != _MAGIC:
+        raise ValueError("bad gradient-update frame magic")
+    worker_id, kind, size, threshold = struct.unpack_from("<iBqf", data, 4)
+    n, = struct.unpack_from("<q", data, 4 + 17)
+    off = 4 + 17 + 8
+    if _KINDS[kind] == "threshold":
+        idx = np.frombuffer(data, np.int32, count=n, offset=off)
+        signs = np.frombuffer(data, np.int8, count=n, offset=off + 4 * n)
+        msg = {"kind": "threshold", "size": size, "threshold": threshold,
+               "idx": idx, "signs": signs}
+    else:
+        packed = np.frombuffer(data, np.uint8, count=n, offset=off)
+        msg = {"kind": "bitmap", "size": size, "threshold": threshold,
+               "packed": packed}
+    return worker_id, msg
+
+
+class RemoteGradientSharing:
+    """One worker's endpoint: publish local encoded updates, drain and
+    apply peers' (reference ``SharedTrainingWrapper`` + accumulator over
+    Aeron).  All workers share one ``topic``; own messages are filtered by
+    worker id."""
+
+    def __init__(self, broker, worker_id: int, topic: str = "gradients",
+                 handler: Optional[EncodingHandler] = None):
+        self.broker = broker
+        self.worker_id = worker_id
+        self.topic = topic
+        self.handler = handler or EncodingHandler()
+        self._sub = broker.subscribe(topic)
+        self.messages_sent = 0
+        self.messages_applied = 0
+
+    def publish_update(self, flat_grad) -> None:
+        msg = self.handler.encode_update(flat_grad)
+        self.broker.publish(self.topic,
+                            encode_message_bytes(self.worker_id, msg))
+        self.messages_sent += 1
+
+    def apply_updates(self, flat_params, timeout: float = 0.0):
+        """Drain pending peer messages into the flat param vector; returns
+        the updated vector (stale messages apply late — by design)."""
+        out = jnp.asarray(flat_params)
+        while True:
+            payload = self._sub.poll(timeout=timeout or 0.001)
+            if payload is None:
+                return out
+            sender, msg = decode_message_bytes(payload)
+            if sender == self.worker_id:
+                continue      # own broadcast echo
+            out = out + decode(msg)
+            self.messages_applied += 1
+
+    def close(self) -> None:
+        if hasattr(self._sub, "close"):
+            self._sub.close()
+        elif hasattr(self.broker, "unsubscribe"):
+            self.broker.unsubscribe(self.topic, self._sub)

@@ -196,3 +196,63 @@ def test_master_phase_stats():
     assert {"split", "broadcast", "fit", "aggregation"} <= set(d)
     assert d["fit"]["total_s"] > 0
     assert "aggregation" in master.stats.stats_text()
+
+
+class TestRemoteGradientSharing:
+    """Broker-transported quantized updates (the Aeron/SilentUpdatesMessage
+    role): wire round-trip + cross-worker training over Local and TCP
+    brokers."""
+
+    def test_wire_roundtrip(self):
+        from deeplearning4j_tpu.parallel.remote import (decode_message_bytes,
+                                                        encode_message_bytes)
+        msg = {"kind": "threshold", "size": 10, "threshold": 0.5,
+               "idx": np.array([1, 7], np.int32),
+               "signs": np.array([1, -1], np.int8)}
+        wid, back = decode_message_bytes(encode_message_bytes(3, msg))
+        assert wid == 3 and back["kind"] == "threshold"
+        assert back["size"] == 10
+        np.testing.assert_array_equal(back["idx"], msg["idx"])
+        np.testing.assert_array_equal(back["signs"], msg["signs"])
+        bm = {"kind": "bitmap", "size": 8, "threshold": 0.25,
+              "packed": np.array([0b01100001, 0b10], np.uint8)}
+        wid, back = decode_message_bytes(encode_message_bytes(1, bm))
+        assert back["kind"] == "bitmap"
+        np.testing.assert_array_equal(back["packed"], bm["packed"])
+
+    def _share_once(self, broker):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.parallel.accumulation import EncodingHandler
+        from deeplearning4j_tpu.parallel.remote import RemoteGradientSharing
+        import time
+        w0 = RemoteGradientSharing(broker, 0, handler=EncodingHandler(
+            initial_threshold=0.1, decay=1.0, boost=1.0))
+        w1 = RemoteGradientSharing(broker, 1, handler=EncodingHandler(
+            initial_threshold=0.1, decay=1.0, boost=1.0))
+        g = np.zeros(16, np.float32)
+        g[3], g[8] = 0.7, -0.9
+        w0.publish_update(g)
+        time.sleep(0.2)
+        params = w1.apply_updates(np.zeros(16, np.float32), timeout=1.0)
+        params = np.asarray(params)
+        # w1 received ±threshold at the transmitted positions
+        assert params[3] > 0 and params[8] < 0
+        assert abs(params).sum() > 0
+        # w0 does not apply its own echo
+        own = np.asarray(w0.apply_updates(np.zeros(16, np.float32),
+                                          timeout=0.3))
+        assert abs(own).sum() == 0
+        assert w0.messages_sent == 1 and w1.messages_applied == 1
+        w0.close(); w1.close()
+
+    def test_local_broker_sharing(self):
+        from deeplearning4j_tpu.streaming import LocalMessageBroker
+        self._share_once(LocalMessageBroker())
+
+    def test_tcp_broker_sharing(self):
+        from deeplearning4j_tpu.streaming import TcpMessageBroker
+        srv = TcpMessageBroker().serve()
+        try:
+            self._share_once(srv)
+        finally:
+            srv.shutdown()
