@@ -487,3 +487,39 @@ class TextGenerationLSTM(ZooModel):
 
 ALL_MODELS = [LeNet, SimpleCNN, AlexNet, VGG16, VGG19, ResNet50, GoogLeNet,
               InceptionResNetV1, FaceNetNN4Small2, TextGenerationLSTM]
+
+
+@dataclass
+class TransformerLM(ZooModel):
+    """Decoder-only transformer language model — the attention-era
+    counterpart of TextGenerationLSTM (no reference equivalent; built from
+    the TPU-native attention stack: pre-norm blocks, causal masking,
+    flash/ring kernels selectable via attn_impl)."""
+    vocab_size: int = 256
+    seq_len: int = 128
+    embed: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    attn_impl: str = "auto"
+
+    def init(self):
+        from ..nn.layers.attention import (PositionalEncodingLayer,
+                                           TransformerBlock)
+        from ..nn.layers.feedforward import EmbeddingSequenceLayer
+        from ..nn.layers.recurrent import RnnOutputLayer
+        b = (self._builder()
+             .updater(self.updater or Adam(learning_rate=3e-4))
+             .weight_init("xavier")
+             .list()
+             .layer(EmbeddingSequenceLayer(n_out=self.embed))
+             .layer(PositionalEncodingLayer()))
+        for _ in range(self.n_layers):
+            b = b.layer(TransformerBlock(n_heads=self.n_heads, causal=True,
+                                         attn_impl=self.attn_impl))
+        conf = (b.layer(RnnOutputLayer(n_out=self.vocab_size,
+                                       activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(self.vocab_size,
+                                                    self.seq_len))
+                .build())
+        from ..nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()

@@ -6,7 +6,8 @@ from .convolution import (Convolution1DLayer, ConvolutionLayer,
                           Subsampling1DLayer, SubsamplingLayer, Upsampling1D,
                           Upsampling2D, ZeroPaddingLayer)
 from .feedforward import (ActivationLayer, CenterLossOutputLayer, DenseLayer,
-                          DropoutLayer, EmbeddingLayer, LossLayer, OutputLayer)
+                          DropoutLayer, EmbeddingLayer,
+                          EmbeddingSequenceLayer, LossLayer, OutputLayer)
 from .misc import FrozenLayer
 from .moe import MixtureOfExpertsLayer
 from .normalization import BatchNormalization, LocalResponseNormalization
@@ -20,6 +21,7 @@ __all__ = [
     "ActivationLayer", "AutoEncoder", "BaseLayerConf", "BatchNormalization",
     "Bidirectional", "CenterLossOutputLayer", "Convolution1DLayer",
     "ConvolutionLayer", "DenseLayer", "DropoutLayer", "EmbeddingLayer",
+    "EmbeddingSequenceLayer",
     "FrozenLayer", "GlobalPoolingLayer", "GravesBidirectionalLSTM",
     "GravesLSTM", "LastTimeStep", "LayerConf", "LayerNormLayer",
     "LocalResponseNormalization", "LossLayer", "LSTM",

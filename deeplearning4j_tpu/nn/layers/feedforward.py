@@ -203,3 +203,35 @@ class EmbeddingLayer(BaseLayerConf):
         if self.has_bias:
             z = z + params["b"]
         return self.act_fn(z), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class EmbeddingSequenceLayer(BaseLayerConf):
+    """Token-id sequence → embedding sequence: [b, t] int (or one-hot
+    [b, t, n_in]) → [b, t, n_out] (reference ``EmbeddingSequenceLayer``).
+    Gather on device; backward is a scatter-add."""
+    INPUT_KIND = "rnn"
+
+    n_in: int = 0     # vocabulary size
+    n_out: int = 0    # embedding dim
+
+    def set_n_in(self, itype: InputType, override: bool = False) -> None:
+        if self.n_in == 0 or override:
+            self.n_in = itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def init(self, key, itype):
+        return {"params": {"W": self.make_weight(key,
+                                                 (self.n_in, self.n_out))},
+                "state": {}}
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        W = variables["params"]["W"]
+        if x.ndim == 3:           # one-hot [b, t, v]: matmul keeps the MXU
+            z = x.astype(W.dtype) @ W
+        else:
+            z = W[x.astype(jnp.int32)]
+        return self.act_fn(z), variables.get("state", {})

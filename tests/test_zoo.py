@@ -97,3 +97,30 @@ def test_text_generation_lstm():
     net.fit(x, y, epochs=10)
     assert net.score(x=x, y=y) < s0
     assert net.output(x).shape == (4, 8, 12)
+
+
+def test_transformer_lm_trains_and_predicts():
+    """Decoder-only TransformerLM (attention-era TextGeneration model):
+    causal next-token loss decreases; output is a distribution per step."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    net = TransformerLM(vocab_size=17, seq_len=12, embed=32, n_layers=2,
+                        n_heads=4, updater=Adam(learning_rate=3e-3)).init()
+    rng = np.random.default_rng(0)
+    # repeatable synthetic sequences: token t+1 = (token t + 1) % 17
+    starts = rng.integers(0, 17, 16)
+    x = (starts[:, None] + np.arange(12)[None, :]) % 17
+    y = np.eye(17, dtype=np.float32)[(x + 1) % 17]
+    s0 = net.score(x=x, y=y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score() < 0.25 * s0, (s0, net.score())
+    out = np.asarray(net.output(x))
+    assert out.shape == (16, 12, 17)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+    # causal: prediction at step 0 must not depend on later tokens
+    x2 = x.copy()
+    x2[:, 6:] = (x2[:, 6:] + 5) % 17
+    out2 = np.asarray(net.output(x2))
+    np.testing.assert_allclose(out[:, :6], out2[:, :6], rtol=1e-4,
+                               atol=1e-5)
