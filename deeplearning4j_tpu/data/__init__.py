@@ -9,6 +9,9 @@ from .dataset import MultiDataSet
 from .records import RecordReaderMultiDataSetIterator
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
+from .normalization import (ImagePreProcessingScaler,
+                            NormalizerMinMaxScaler, NormalizerStandardize,
+                            load_normalizer)
 from .interop import TorchDataSetIterator, as_torch_dataset, from_torch
 from .formatter import LocalUnstructuredDataFormatter
 from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
@@ -26,4 +29,6 @@ __all__ = [
     "FileSplitDataSetIterator", "export_dataset_batches", "load_dataset",
     "save_dataset", "TorchDataSetIterator", "as_torch_dataset",
     "from_torch", "MultiDataSet", "RecordReaderMultiDataSetIterator",
+    "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "load_normalizer",
 ]

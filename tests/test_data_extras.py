@@ -210,3 +210,52 @@ def test_multi_reader_builder_validation():
     with pytest.raises(ValueError, match="unknown readers"):
         (RecordReaderMultiDataSetIterator.builder(4)
          .add_input("nope", 0, 1).add_output("nope", 2, 2).build())
+
+
+class TestNormalizers:
+    def _it(self):
+        from deeplearning4j_tpu.data import INDArrayDataSetIterator
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((100, 4)).astype(np.float32) * [1, 5, 0.2, 3] \
+            + [10, -2, 0, 4]
+        y = (x @ rng.standard_normal((4, 2))).astype(np.float32)
+        return x, y, INDArrayDataSetIterator(x, y, batch_size=25,
+                                             shuffle=False)
+
+    def test_standardize_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.data import (DataSet, NormalizerStandardize,
+                                             load_normalizer)
+        x, y, it = self._it()
+        norm = NormalizerStandardize().fit_label().fit(it)
+        ds = norm.transform(DataSet(x, y))
+        f = np.asarray(ds.features)
+        np.testing.assert_allclose(f.mean(0), 0, atol=1e-5)
+        np.testing.assert_allclose(f.std(0), 1, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ds.labels).mean(0), 0,
+                                   atol=1e-5)
+        back = norm.revert(ds)
+        np.testing.assert_allclose(np.asarray(back.features), x, rtol=1e-4,
+                                   atol=1e-4)
+        norm.save(tmp_path / "n.json")
+        norm2 = load_normalizer(tmp_path / "n.json")
+        ds2 = norm2.transform(DataSet(x, y))
+        np.testing.assert_allclose(np.asarray(ds2.features), f, rtol=1e-6)
+
+    def test_minmax_and_wrap(self):
+        from deeplearning4j_tpu.data import (NormalizerMinMaxScaler)
+        x, y, it = self._it()
+        norm = NormalizerMinMaxScaler(lo=-1, hi=1).fit(it)
+        wrapped = norm.wrap(it)
+        batches = list(wrapped)
+        allf = np.concatenate([np.asarray(b.features) for b in batches])
+        assert allf.min() >= -1 - 1e-5 and allf.max() <= 1 + 1e-5
+        assert np.isclose(allf.min(), -1, atol=1e-5)
+        # wrapped iterator is restartable
+        assert len(list(wrapped)) == 4
+
+    def test_image_scaler_stateless(self):
+        from deeplearning4j_tpu.data import DataSet, ImagePreProcessingScaler
+        img = np.full((2, 4, 4, 3), 127.5, np.float32)
+        ds = ImagePreProcessingScaler().fit(None).transform(
+            DataSet(img, np.zeros((2, 1), np.float32)))
+        np.testing.assert_allclose(np.asarray(ds.features), 0.5)
