@@ -145,6 +145,12 @@ class LSTM(BaseRecurrentLayer):
     HAS_CARRY = True
     forget_gate_bias_init: float = 1.0
     gate_activation: str = "sigmoid"
+    # optional accelerator fast path (the reference's reflective cuDNN
+    # helper hook, ConvolutionLayer.java:74-84 pattern): "pallas" fuses the
+    # recurrence into one kernel with U resident in VMEM; silently falls
+    # back to lax.scan when unsupported (mask, peepholes, exotic
+    # activations) — CudnnLSTMHelper.checkSupported semantics.
+    helper: Optional[str] = None
 
     _PEEPHOLES = False
 
@@ -171,6 +177,21 @@ class LSTM(BaseRecurrentLayer):
         return {"h": jnp.zeros((batch, h), dtype), "c": jnp.zeros((batch, h), dtype)}
 
     def scan(self, params, x, carry, mask=None):
+        if self.helper == "pallas":
+            from ...ops import pallas_lstm
+            if pallas_lstm.supports(
+                    peepholes=self._PEEPHOLES,
+                    gate_activation=self.gate_activation,
+                    activation=self.resolved("activation", "tanh"),
+                    masked=mask is not None):
+                ys, hT, cT = pallas_lstm.lstm_forward_fast(
+                    x.astype(jnp.float32),
+                    params["W"].astype(jnp.float32),
+                    params["U"].astype(jnp.float32),
+                    params["b"].astype(jnp.float32),
+                    carry["h"].astype(jnp.float32),
+                    carry["c"].astype(jnp.float32))
+                return ys, {"h": hT, "c": cT}
         h_units = self.n_out
         act = self.act_fn
         gate = _act.get(self.gate_activation)

@@ -1,0 +1,193 @@
+"""Pallas LSTM forward kernel — the CudnnLSTMHelper of the TPU build.
+
+Reference ``deeplearning4j-cuda/.../recurrent/CudnnLSTMHelper.java:49``:
+an optional per-layer fast path, loaded when supported and numerics-
+validated against the portable implementation (``ValidateCudnnLSTM``).
+Same contract here: :func:`supports` mirrors ``checkSupported`` (sigmoid
+gates + tanh activation, no peepholes, no mask), the layer falls back to
+the ``lax.scan`` path otherwise, and ``tests/test_attention.py`` holds the
+validation suite.
+
+Kernel shape: the input projection ``x @ W + b`` is hoisted OUTSIDE the
+kernel as one [b*t, 4h] MXU matmul (same trick as the scan path).  The
+kernel owns the serial part: grid over time (TPU grid dims execute
+sequentially), the recurrent weights U pinned in VMEM for the whole
+sequence, (h, c) carried in VMEM scratch across grid steps — no HBM
+round-trip per timestep, which is exactly what lax.scan cannot express.
+Forward/inference only (``rnn_time_step``, ``output``): reverse-mode
+would need a custom VJP, and training keeps the differentiable scan.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["lstm_forward", "lstm_forward_fast", "supports"]
+
+try:  # pallas requires a TPU-capable lowering; import tolerant for docs
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+def _pad_to(v: int, m: int) -> int:
+    return ((v + m - 1) // m) * m
+
+
+def supports(*, peepholes: bool, gate_activation: str, activation: str,
+             masked: bool) -> bool:
+    """checkSupported (CudnnLSTMHelper.java:174-183): the kernel covers the
+    standard sigmoid/tanh cell only."""
+    return (not peepholes and not masked
+            and gate_activation == "sigmoid" and activation == "tanh")
+
+
+def _kernel(xz_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
+            h_s, c_s, *, hidden: int):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    z = xz_ref[0] + jnp.dot(h_s[:], u_ref[:],
+                            preferred_element_type=jnp.float32)
+    h = hidden
+    i = jax.nn.sigmoid(z[:, :h])
+    f = jax.nn.sigmoid(z[:, h:2 * h])
+    o = jax.nn.sigmoid(z[:, 2 * h:3 * h])
+    g = jnp.tanh(z[:, 3 * h:])
+    c_new = f * c_s[:] + i * g
+    h_new = o * jnp.tanh(c_new)
+    c_s[:] = c_new
+    h_s[:] = h_new
+    ys_ref[0] = h_new
+
+    @pl.when(t == pl.num_programs(0) - 1)
+    def _():
+        hT_ref[:] = h_s[:]
+        cT_ref[:] = c_s[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(xz_p, u_p, h0_p, c0_p, interpret: bool = False):
+    t, b, h4 = xz_p.shape
+    h = h4 // 4
+    return pl.pallas_call(
+        functools.partial(_kernel, hidden=h),
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, h4), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # U resident all steps
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # h0
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, h), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xz_p, u_p, h0_p, c0_p)
+
+
+def lstm_forward(x, W, U, b, h0, c0, interpret: bool = False
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused LSTM forward.  x [batch, t, f]; W [f, 4h]; U [h, 4h]; b [4h];
+    h0/c0 [batch, h] (IFOG gate order, sigmoid gates, tanh activation).
+    Returns (ys [batch, t, h], hT, cT).  ``interpret=True`` runs the
+    kernel in interpreter mode (CPU tests)."""
+    if not _PALLAS_OK:  # pragma: no cover
+        raise RuntimeError("pallas unavailable in this environment")
+    batch, t, _ = x.shape
+    h = U.shape[0]
+    # hoisted input projection: one MXU matmul for the whole sequence
+    xz = (x.astype(jnp.float32).reshape(batch * t, -1)
+          @ W.astype(jnp.float32) + b.astype(jnp.float32))
+    xz = xz.reshape(batch, t, 4 * h).swapaxes(0, 1)   # time-major
+    # tiling: last dim mult of 128 → h mult of 32 (4h mult of 128);
+    # sublanes mult of 8.  Zero-padding is semantics-preserving: padded U
+    # columns produce z=0 → i=f=o=σ(0), g=0 → c=f·0+i·0=0, h=o·tanh(0)=0.
+    bp = _pad_to(batch, 8)
+    hp = _pad_to(h, 32)
+    xz_p = jnp.zeros((t, bp, 4 * hp), jnp.float32)
+    for gi in range(4):  # interleave gate blocks into padded layout
+        xz_p = xz_p.at[:, :batch, gi * hp:gi * hp + h].set(
+            xz[:, :, gi * h:(gi + 1) * h])
+    u_p = jnp.zeros((hp, 4 * hp), jnp.float32)
+    for gi in range(4):
+        u_p = u_p.at[:h, gi * hp:gi * hp + h].set(
+            U.astype(jnp.float32)[:, gi * h:(gi + 1) * h])
+    h0_p = jnp.zeros((bp, hp), jnp.float32).at[:batch, :h].set(
+        h0.astype(jnp.float32))
+    c0_p = jnp.zeros((bp, hp), jnp.float32).at[:batch, :h].set(
+        c0.astype(jnp.float32))
+    ys, hT, cT = _run(xz_p, u_p, h0_p, c0_p, interpret=interpret)
+    ys = ys.swapaxes(0, 1)[:batch, :, :h]
+    return ys, hT[:batch, :h], cT[:batch, :h]
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper: pallas forward, scan-derived backward (the helper
+# must never change training semantics — ValidateCudnnLSTM's contract)
+# ---------------------------------------------------------------------------
+
+def _scan_impl(x, W, U, b, h0, c0):
+    batch, t, _ = x.shape
+    h = U.shape[0]
+    xz = (x.reshape(batch * t, -1) @ W + b).reshape(batch, t, 4 * h)
+    xz = xz.swapaxes(0, 1)
+
+    def cell(carry, xzt):
+        hh, cc = carry
+        z = xzt + hh @ U
+        i = jax.nn.sigmoid(z[:, :h])
+        f = jax.nn.sigmoid(z[:, h:2 * h])
+        o = jax.nn.sigmoid(z[:, 2 * h:3 * h])
+        g = jnp.tanh(z[:, 3 * h:])
+        cc = f * cc + i * g
+        hh = o * jnp.tanh(cc)
+        return (hh, cc), hh
+
+    (hh, cc), ys = jax.lax.scan(cell, (h0, c0), xz)
+    return ys.swapaxes(0, 1), hh, cc
+
+
+@jax.custom_vjp
+def lstm_forward_fast(x, W, U, b, h0, c0):
+    """Pallas forward on TPU (interpret elsewhere), scan VJP backward —
+    safe under jax.grad, so helper-enabled layers keep working inside
+    differentiated losses (LBFGS line search etc.)."""
+    interpret = jax.default_backend() != "tpu"
+    return lstm_forward(x, W, U, b, h0, c0, interpret=interpret)
+
+
+def _fwd(x, W, U, b, h0, c0):
+    out = lstm_forward_fast(x, W, U, b, h0, c0)
+    return out, (x, W, U, b, h0, c0)
+
+
+def _bwd(res, g):
+    _, vjp = jax.vjp(_scan_impl, *res)
+    return vjp(g)
+
+
+lstm_forward_fast.defvjp(_fwd, _bwd)

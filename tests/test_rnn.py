@@ -177,3 +177,49 @@ def test_variable_length_classification_end_to_end():
         net.fit(x, y, mask=mask)
     preds = np.asarray(net.output(x))  # unmasked output call; check train loss instead
     assert net.get_score() < 0.3, net.get_score()
+
+
+class TestPallasLstmHelper:
+    """The ValidateCudnnLSTM pattern: helper-enabled layer must match the
+    portable scan path in activations AND training behavior."""
+
+    def _nets(self, helper):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(LSTM(n_out=12, activation="tanh", helper=helper))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(4, 6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_forward_matches_scan(self):
+        a, b = self._nets(None), self._nets("pallas")
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, 6, 4)).astype(np.float32)
+        ya = np.asarray(a.output(x))
+        yb = np.asarray(b.output(x))
+        np.testing.assert_allclose(ya, yb, rtol=1e-4, atol=1e-5)
+
+    def test_training_matches_scan(self):
+        """custom-vjp backward == scan backward: identical training."""
+        a, b = self._nets(None), self._nets("pallas")
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 6, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (8, 6))]
+        for _ in range(5):
+            a.fit(x, y)
+            b.fit(x, y)
+        np.testing.assert_allclose(a.score(), b.score(), rtol=1e-4)
+
+    def test_unsupported_falls_back(self):
+        """Masked input silently uses the scan path (checkSupported)."""
+        net = self._nets("pallas")
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((3, 6, 4)).astype(np.float32)
+        mask = np.ones((3, 6), np.float32)
+        mask[:, 4:] = 0
+        y = np.asarray(net.output(x))     # helper path
+        assert np.isfinite(y).all()
+        net.fit(x, np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 6))],
+                mask=mask)                # masked -> scan fallback
+        assert np.isfinite(net.score())
