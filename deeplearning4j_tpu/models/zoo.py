@@ -523,3 +523,6 @@ class TransformerLM(ZooModel):
                 .build())
         from ..nn.multilayer import MultiLayerNetwork
         return MultiLayerNetwork(conf).init()
+
+
+ALL_MODELS.append(TransformerLM)
