@@ -28,7 +28,7 @@ class MixtureOfExpertsLayer(BaseLayerConf):
     """params: router [f, E], w1 [E, f, hidden], b1, w2 [E, hidden, n_out],
     b2.  capacity_factor sizes each expert's token budget as
     ``capacity_factor * tokens / n_experts``."""
-    INPUT_KIND = "ff"
+    INPUT_KIND = "any"   # FF [b,f] and RNN [b,t,f] both handled natively
     AUX_LOSS = True
 
     n_in: int = 0

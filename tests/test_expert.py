@@ -140,3 +140,28 @@ class TestMoeLayer:
         assert np.isfinite(net.score())
         for leaf in jax.tree_util.tree_leaves(net.params):
             assert leaf.dtype == jnp.float32
+
+
+def test_moe_layer_rnn_input():
+    """MoE layer consumes [b, t, f] natively (no flatten preprocessor)."""
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Adam(learning_rate=0.02)).list()
+            .layer(MixtureOfExpertsLayer(n_out=8, n_experts=2, hidden=16,
+                                         activation="relu"))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(5, 7)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 7, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 7))]
+    net.fit(x, y)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 7, 3)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
