@@ -203,20 +203,35 @@ class CheckpointListener(TrainingListener):
     N iterations and/or every N epochs, keep the last K."""
 
     def __init__(self, directory, save_every_n_iterations: Optional[int] = None,
-                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
+                 background: bool = False):
         import os as _os
         self.directory = directory
         _os.makedirs(directory, exist_ok=True)
         self.save_every_n_iterations = save_every_n_iterations
         self.save_every_n_epochs = save_every_n_epochs
         self.keep_last = keep_last
+        self.background = background
         self.saved: List[str] = []
+        self._worker = None
 
     def _save(self, model, tag: str):
         import os as _os
         from ..utils.model_serializer import write_model
         path = _os.path.join(self.directory, f"checkpoint_{tag}.zip")
-        write_model(model, path)
+        if self.background:
+            # async checkpointing: snapshot device buffers to host, write
+            # on a worker thread so the train loop never blocks on IO
+            # (the role orbax's async checkpointer plays; donation-safe
+            # because clone() copies buffers)
+            import threading
+            snapshot = model.clone()
+            self.wait()          # at most one in-flight write
+            self._worker = threading.Thread(
+                target=write_model, args=(snapshot, path), daemon=True)
+            self._worker.start()
+        else:
+            write_model(model, path)
         self.saved.append(path)
         while len(self.saved) > self.keep_last:
             old = self.saved.pop(0)
@@ -224,6 +239,12 @@ class CheckpointListener(TrainingListener):
                 _os.remove(old)
             except OSError:
                 pass
+
+    def wait(self) -> None:
+        """Block until any in-flight background checkpoint completes."""
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
 
     def iteration_done(self, model, iteration, epoch):
         if self.save_every_n_iterations and \

@@ -181,3 +181,24 @@ class TestGradStatsListener:
         assert any(k.startswith("l2_layer_0") for k in row)
         lines = [json.loads(l) for l in out.read_text().splitlines()]
         assert lines[-1]["iteration"] == row["iteration"]
+
+
+def test_checkpoint_listener_background(tmp_path):
+    """Async checkpointing: snapshot + worker-thread write, keep_last
+    rotation, restorable artifact."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train.listeners import CheckpointListener
+    from deeplearning4j_tpu.utils.model_serializer import \
+        restore_multi_layer_network
+    net = MultiLayerNetwork(_conf()).init()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                             keep_last=2, background=True)
+    net.set_listeners(lst)
+    x, y = _blobs(40)
+    net.fit(x, np.eye(3, dtype=np.float32)[y], epochs=6)
+    lst.wait()
+    import os
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 2            # rotation kept the last 2
+    back = restore_multi_layer_network(os.path.join(tmp_path, files[-1]))
+    assert back.num_params() == net.num_params()
