@@ -129,6 +129,35 @@ class Evaluation:
         self.top_n_total += other.top_n_total
         self._predictions.extend(other._predictions)
 
+    # ------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        """Reference ``eval/serde``: evaluations are serializable so
+        workers can ship partial results for merge()."""
+        import json
+        return json.dumps({
+            "type": "Evaluation",
+            "n_classes": self.n_classes,
+            "labels": self.label_names,
+            "top_n": self.top_n,
+            "top_n_correct": self.top_n_correct,
+            "top_n_total": self.top_n_total,
+            "confusion": (self.confusion.matrix.tolist()
+                          if self.confusion is not None else None),
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "Evaluation":
+        import json
+        d = json.loads(s)
+        ev = Evaluation(n_classes=d["n_classes"], labels=d["labels"],
+                        top_n=d.get("top_n", 1))
+        if d.get("confusion") is not None:
+            ev.confusion = ConfusionMatrix(d["n_classes"])
+            ev.confusion.matrix = np.asarray(d["confusion"], np.int64)
+        ev.top_n_correct = d.get("top_n_correct", 0)
+        ev.top_n_total = d.get("top_n_total", 0)
+        return ev
+
     # ----------------------------------------------------- prediction meta
     def get_prediction_errors(self) -> List["Prediction"]:
         """Misclassified examples with their metadata (reference

@@ -208,3 +208,24 @@ def test_prediction_metadata_error_inspection():
         {"rec_b", "rec_c"}
     with pytest.raises(ValueError, match="metadata entries"):
         ev.eval(labels, preds, record_metadata=["only_one"])
+
+
+def test_evaluation_json_roundtrip_and_merge():
+    """eval/serde role: serialize partial evaluations, merge on a driver."""
+    from deeplearning4j_tpu.evaluation.classification import Evaluation
+    rng = np.random.default_rng(0)
+    labels = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 60)]
+    preds = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 60)]
+    full = Evaluation()
+    full.eval(labels, preds)
+    # two workers evaluate halves, ship JSON, driver merges
+    parts = []
+    for sl in (slice(0, 30), slice(30, 60)):
+        ev = Evaluation()
+        ev.eval(labels[sl], preds[sl])
+        parts.append(Evaluation.from_json(ev.to_json()))
+    merged = parts[0]
+    merged.merge(parts[1])
+    assert merged.accuracy() == pytest.approx(full.accuracy())
+    np.testing.assert_array_equal(merged.confusion.matrix,
+                                  full.confusion.matrix)
