@@ -354,7 +354,16 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
-            for batch in batches_factory():
+            batches = iter(batches_factory())
+            while True:
+                t_etl = time.perf_counter()
+                batch = next(batches, None)
+                # ETL/compute boundary timing (reference lastEtlTime,
+                # MultiLayerNetwork.java:1203-1209): time blocked on the
+                # data pipeline, visible to PerformanceListener
+                self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                if batch is None:
+                    break
                 x, y, m, lm = batch
                 self.last_batch_size = int(getattr(x, "shape", (0,))[0])
                 if self.conf.backprop_type == "tbptt" and \

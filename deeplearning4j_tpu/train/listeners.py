@@ -76,6 +76,9 @@ class PerformanceListener(TrainingListener):
                 self.samples_per_sec = self.last_batch_size * iters / dt
             msg = (f"iteration {iteration}; iterations/sec: "
                    f"{self.batches_per_sec:.3f}; samples/sec: {self.samples_per_sec:.3f}")
+            etl = getattr(model, "last_etl_ms", None)
+            if etl is not None:
+                msg += f"; ETL: {etl:.1f} ms"
             if self.report_score:
                 msg += f"; score: {model.get_score()}"
             log.info(msg)
