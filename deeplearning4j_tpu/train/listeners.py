@@ -258,3 +258,54 @@ class CheckpointListener(TrainingListener):
         if self.save_every_n_epochs and \
                 (model.epoch + 1) % self.save_every_n_epochs == 0:
             self._save(model, f"epoch_{model.epoch}")
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    """Render conv-layer activation grids to HTML every N iterations
+    (reference ``RemoteConvolutionalIterationListener`` / ``WebReporter``:
+    the reference posts rendered activations to the UI; here they land as
+    standalone HTML files, or are POSTed to a remote router when ``url``
+    is given)."""
+
+    def __init__(self, probe_batch, frequency: int = 50, output_dir=None,
+                 layer_index: int = 0, url: Optional[str] = None):
+        import os as _os
+        self.probe = probe_batch
+        self.frequency = max(1, frequency)
+        self.output_dir = output_dir
+        self.layer_index = layer_index
+        self.url = url
+        self.rendered: List[str] = []
+        if output_dir:
+            _os.makedirs(output_dir, exist_ok=True)
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency != 0:
+            return
+        import numpy as np
+        from ..ui.components import activation_grid_svg, render_page
+        acts = model.feed_forward(self.probe)
+        a = np.asarray(acts[self.layer_index])
+        if a.ndim != 4:
+            return  # not a conv activation
+        svg = activation_grid_svg(a)
+        page = (f"<h3>iteration {iteration}, layer {self.layer_index}, "
+                f"shape {a.shape}</h3>{svg}")
+        self.rendered.append(page)
+        if self.output_dir:
+            import os as _os
+            path = _os.path.join(self.output_dir,
+                                 f"activations_{iteration:06d}.html")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(f"<!DOCTYPE html><html><body>{page}</body></html>")
+        if self.url:
+            import json as _json
+            import urllib.request
+            req = urllib.request.Request(
+                self.url, data=_json.dumps(
+                    {"iteration": iteration, "svg": svg}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except OSError:
+                log.warning("activation POST to %s failed", self.url)

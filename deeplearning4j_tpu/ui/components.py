@@ -184,3 +184,38 @@ def render_page(components: Sequence[_Component], title: str = "Report"
     return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
             f"<title>{html.escape(title)}</title></head>"
             f"<body style='font-family:sans-serif'>{body}</body></html>")
+
+
+def activation_grid_svg(activations, max_maps: int = 16,
+                        cell: int = 56) -> str:
+    """[h, w, c] (or [b, h, w, c] — first example) activation maps as an
+    SVG grid of grayscale cells (reference
+    ``ConvolutionalIterationListener`` rendering)."""
+    a = np.asarray(activations, np.float32)
+    if a.ndim == 4:
+        a = a[0]
+    if a.ndim != 3:
+        raise ValueError(f"expected [h,w,c] activations, got {a.shape}")
+    c = min(a.shape[-1], max_maps)
+    cols = int(np.ceil(np.sqrt(c)))
+    rows = int(np.ceil(c / cols))
+    h, w = a.shape[:2]
+    parts = []
+    for m in range(c):
+        fmap = a[:, :, m]
+        lo, hi = float(fmap.min()), float(fmap.max())
+        norm = (fmap - lo) / max(hi - lo, 1e-9)
+        ox = (m % cols) * (cell + 4)
+        oy = (m // cols) * (cell + 4)
+        px = cell / max(h, w)
+        for r in range(h):
+            for cc_ in range(w):
+                g = int(norm[r, cc_] * 255)
+                parts.append(
+                    f'<rect x="{ox + cc_ * px:.1f}" y="{oy + r * px:.1f}" '
+                    f'width="{px:.2f}" height="{px:.2f}" '
+                    f'fill="rgb({g},{g},{g})"/>')
+    width = cols * (cell + 4)
+    height = rows * (cell + 4)
+    return (f'<svg width="{width}" height="{height}" '
+            f'xmlns="http://www.w3.org/2000/svg">{"".join(parts)}</svg>')

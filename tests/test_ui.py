@@ -216,3 +216,39 @@ def test_torch_interop_roundtrip():
         x, np.eye(3, dtype=np.float32)[y_cls], batch_size=30))
     got = list(iter(back))
     assert len(got) == 2 and got[0][0].shape == (30, 4)
+
+
+def test_convolutional_iteration_listener(tmp_path):
+    """Activation grids rendered to HTML during training (reference
+    RemoteConvolutionalIterationListener role)."""
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.train.listeners import \
+        ConvolutionalIterationListener
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .updater(Sgd(learning_rate=0.05)).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu",
+                                    convolution_mode="same"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 8, 8, 1)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+    lst = ConvolutionalIterationListener(x[:1], frequency=2,
+                                         output_dir=str(tmp_path))
+    net.set_listeners(lst)
+    for _ in range(4):
+        net.fit(x, y)
+    files = list(tmp_path.glob("activations_*.html"))
+    assert len(files) == 2
+    content = files[0].read_text()
+    assert "<svg" in content and "rect" in content
