@@ -105,3 +105,8 @@ class EarlyStoppingTrainer:
 # reference has separate EarlyStoppingTrainer / EarlyStoppingGraphTrainer;
 # the graph variant is the same loop here
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+# reference ``EarlyStoppingParallelTrainer`` (scaleout module): the same
+# loop driving a ParallelWrapper — the wrapper duck-types the model surface
+# (fit_batch/get_score/params/init), so no separate implementation needed.
+EarlyStoppingParallelTrainer = EarlyStoppingTrainer

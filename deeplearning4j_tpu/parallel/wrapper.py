@@ -100,6 +100,85 @@ class ParallelWrapper:
 
         m.opt_state = place_opt(m.opt_state)
 
+    # ---- model duck-typing (EarlyStoppingTrainer & friends) ----------
+    @property
+    def params(self):
+        return self.model.params
+
+    def init(self):
+        self.model.init()
+        self._place()
+        return self
+
+    def get_score(self) -> float:
+        return self.model.get_score()
+
+    def score(self, *a, **kw) -> float:
+        return self.model.score(*a, **kw)
+
+    def _normalize_batch(self, b):
+        return self.model._normalize_batch(b)
+
+    def clone(self):
+        """Snapshot of the UNDERLYING model (savers keep plain models)."""
+        return self.model.clone()
+
+    def evaluate(self, *a, **kw):
+        return self.model.evaluate(*a, **kw)
+
+    def fit_batch(self, batch) -> float:
+        """One sharded train step on one batch, no epoch bookkeeping
+        (the EarlyStoppingTrainer inner-loop contract)."""
+        m = self.model
+        trimmed = self._trim(m._normalize_batch(batch))
+        if trimmed is None:    # sub-shard batch: nothing to step on
+            return m._score
+        x, y, mk, lmk = trimmed
+        put = self._put
+        m._rng, key = jax.random.split(m._rng)
+        m.params, m.state, m.opt_state, loss, m._last_grad_stats = \
+            self._get_step()(m.params, m.state, m.opt_state, key,
+                             put(x), put(y), put(mk), put(lmk))
+        m._score = float(loss)
+        m.iteration += 1
+        for lst in m.listeners:
+            lst.iteration_done(m, m.iteration, m.epoch)
+        return m._score
+
+    def _data_axis_size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in (DATA_AXIS,)
+                            if a in self.mesh.shape]))
+
+    def _trim(self, batch):
+        """Drop the remainder rows of a partial batch so the leading dim
+        shards evenly over the data axis (standard DP practice; the
+        reference round-robins whole batches to workers instead)."""
+        d = self._data_axis_size()
+        x = batch[0][0] if isinstance(batch[0], (list, tuple)) else batch[0]
+        n = int(x.shape[0])
+        keep = (n // d) * d
+        if keep == n:
+            return batch
+        if keep == 0:
+            return None   # batch smaller than the data axis: skip it
+
+        def cut(a):
+            if a is None:
+                return None
+            if isinstance(a, (list, tuple)):
+                return [None if e is None else e[:keep] for e in a]
+            return a[:keep]
+
+        return tuple(cut(p_) for p_ in batch)
+
+    def _put(self, a):
+        if a is None:
+            return None
+        if isinstance(a, (list, tuple)):
+            return [None if e is None else
+                    shard_batch(self.mesh, jnp.asarray(e)) for e in a]
+        return shard_batch(self.mesh, jnp.asarray(a))
+
     def _get_step(self):
         if self._step is None:
             self._step = self.model._get_jitted("train_step")
@@ -111,15 +190,8 @@ class ParallelWrapper:
         """Shard each batch over the mesh then run the jitted SPMD step.
         Same contract as ``MultiLayerNetwork.fit``: (x, y) arrays or an
         iterable/iterator of batches, optional masks, multiple epochs."""
-        m, mesh = self.model, self.mesh
-
-        def put(a):
-            if a is None:
-                return None
-            if isinstance(a, (list, tuple)):  # ComputationGraph multi-input
-                return [None if e is None else
-                        shard_batch(mesh, jnp.asarray(e)) for e in a]
-            return shard_batch(mesh, jnp.asarray(a))
+        m = self.model
+        put = self._put
         if labels is not None:
             batches_factory = lambda: [(data, labels, mask, label_mask)]
         elif hasattr(data, "reset") or hasattr(data, "__iter__"):
@@ -138,7 +210,11 @@ class ParallelWrapper:
         for _ in range(epochs):
             for lst in m.listeners:
                 lst.on_epoch_start(m)
-            for x, y, mk, lmk in batches_factory():
+            for raw in batches_factory():
+                trimmed = self._trim(raw)
+                if trimmed is None:
+                    continue
+                x, y, mk, lmk = trimmed
                 m._rng, key = jax.random.split(m._rng)
                 m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
                     m.params, m.state, m.opt_state, key,

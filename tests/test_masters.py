@@ -256,3 +256,34 @@ class TestRemoteGradientSharing:
             self._share_once(srv)
         finally:
             srv.shutdown()
+
+
+def test_early_stopping_parallel_trainer():
+    """EarlyStoppingParallelTrainer role: the standard early-stopping loop
+    driving a mesh-sharded ParallelWrapper."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from deeplearning4j_tpu.earlystopping.config import \
+        EarlyStoppingConfiguration
+    from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+    from deeplearning4j_tpu.earlystopping.scorecalc import \
+        DataSetLossCalculator
+    from deeplearning4j_tpu.earlystopping.terminations import \
+        MaxEpochsTerminationCondition
+    from deeplearning4j_tpu.earlystopping.trainer import \
+        EarlyStoppingParallelTrainer
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+
+    net = _net(updater=Adam(learning_rate=0.05))
+    wrapper = ParallelWrapper(net, make_mesh(8, tp=1))
+    train_it = IrisDataSetIterator(batch_size=48)
+    conf = EarlyStoppingConfiguration(
+        epoch_terminations=[MaxEpochsTerminationCondition(8)],
+        score_calculator=DataSetLossCalculator(
+            IrisDataSetIterator(batch_size=48)),
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingParallelTrainer(conf, wrapper, train_it).fit()
+    assert result.total_epochs <= 8
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
