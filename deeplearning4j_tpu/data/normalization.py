@@ -44,14 +44,25 @@ class _BaseNormalizer:
             yield b if isinstance(b, DataSet) else DataSet(b[0], b[1])
 
     def fit(self, data) -> "_BaseNormalizer":
-        feats, labels = [], []
+        """Streaming fit: per-batch running accumulators, O(features)
+        memory — the dataset is never materialized (nd4j normalizers use
+        the same running-stats approach)."""
+        self._begin_fit()
         for ds in self._batches(data):
-            feats.append(np.asarray(ds.features, np.float64))
-            if self.fit_labels:
-                labels.append(np.asarray(ds.labels, np.float64))
-        self._fit_arrays(np.concatenate(feats),
-                         np.concatenate(labels) if labels else None)
+            self._update_fit(np.asarray(ds.features, np.float64),
+                             np.asarray(ds.labels, np.float64)
+                             if self.fit_labels else None)
+        self._finish_fit()
         return self
+
+    def _begin_fit(self):
+        raise NotImplementedError
+
+    def _update_fit(self, feats, labels):
+        raise NotImplementedError
+
+    def _finish_fit(self):
+        raise NotImplementedError
 
     def transform(self, ds: DataSet) -> DataSet:
         f = self._tx(np.asarray(ds.features, np.float32), False)
@@ -116,15 +127,33 @@ class NormalizerStandardize(_BaseNormalizer):
         self.mean = self.std = None
         self.label_mean = self.label_std = None
 
-    @staticmethod
-    def _col_stats(a):
-        flat = a.reshape(-1, a.shape[-1])
-        return flat.mean(0), flat.std(0)
+    def _begin_fit(self):
+        self._acc = {}
 
-    def _fit_arrays(self, feats, labels):
-        self.mean, self.std = self._col_stats(feats)
+    @staticmethod
+    def _acc_update(acc, key, a):
+        flat = a.reshape(-1, a.shape[-1])
+        n, sm, sq = acc.get(key, (0, 0.0, 0.0))
+        acc[key] = (n + flat.shape[0], sm + flat.sum(0),
+                    sq + (flat * flat).sum(0))
+
+    @staticmethod
+    def _acc_final(acc, key):
+        n, sm, sq = acc[key]
+        mean = sm / max(n, 1)
+        var = np.maximum(sq / max(n, 1) - mean * mean, 0.0)
+        return mean, np.sqrt(var)
+
+    def _update_fit(self, feats, labels):
+        self._acc_update(self._acc, "f", feats)
         if labels is not None:
-            self.label_mean, self.label_std = self._col_stats(labels)
+            self._acc_update(self._acc, "l", labels)
+
+    def _finish_fit(self):
+        self.mean, self.std = self._acc_final(self._acc, "f")
+        if "l" in self._acc:
+            self.label_mean, self.label_std = self._acc_final(self._acc, "l")
+        del self._acc
 
     def _tx(self, a, is_label):
         m, s = ((self.label_mean, self.label_std) if is_label
@@ -162,12 +191,23 @@ class NormalizerMinMaxScaler(_BaseNormalizer):
         self.min = self.max = None
         self.label_min = self.label_max = None
 
-    def _fit_arrays(self, feats, labels):
+    def _begin_fit(self):
+        self.min = self.max = None
+        self.label_min = self.label_max = None
+
+    def _update_fit(self, feats, labels):
         flat = feats.reshape(-1, feats.shape[-1])
-        self.min, self.max = flat.min(0), flat.max(0)
+        lo, hi = flat.min(0), flat.max(0)
+        self.min = lo if self.min is None else np.minimum(self.min, lo)
+        self.max = hi if self.max is None else np.maximum(self.max, hi)
         if labels is not None:
             lf = labels.reshape(-1, labels.shape[-1])
-            self.label_min, self.label_max = lf.min(0), lf.max(0)
+            llo, lhi = lf.min(0), lf.max(0)
+            self.label_min = llo if self.label_min is None else                 np.minimum(self.label_min, llo)
+            self.label_max = lhi if self.label_max is None else                 np.maximum(self.label_max, lhi)
+
+    def _finish_fit(self):
+        pass
 
     def _scale(self, a, lo_v, hi_v):
         rng = np.maximum(hi_v - lo_v, self._EPS)

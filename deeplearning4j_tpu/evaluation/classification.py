@@ -143,6 +143,12 @@ class Evaluation:
             "top_n_total": self.top_n_total,
             "confusion": (self.confusion.matrix.tolist()
                           if self.confusion is not None else None),
+            "predictions": [
+                {"a": p.actual, "p": p.predicted,
+                 "m": p.metadata if isinstance(
+                     p.metadata, (str, int, float, type(None)))
+                 else str(p.metadata)}
+                for p in self._predictions],
         })
 
     @staticmethod
@@ -156,6 +162,8 @@ class Evaluation:
             ev.confusion.matrix = np.asarray(d["confusion"], np.int64)
         ev.top_n_correct = d.get("top_n_correct", 0)
         ev.top_n_total = d.get("top_n_total", 0)
+        ev._predictions = [Prediction(r["a"], r["p"], r.get("m"))
+                           for r in d.get("predictions", [])]
         return ev
 
     # ----------------------------------------------------- prediction meta

@@ -69,6 +69,8 @@ class MixtureOfExpertsLayer(BaseLayerConf):
         from ...parallel.expert import moe_ffn
         params = variables["params"]
         x = self.maybe_dropout_input(key, x, train)
+        if x.ndim == 4:   # CNN [b,h,w,c] -> flat [b, h*w*c] (set_n_in used
+            x = x.reshape(x.shape[0], -1)  # flat_size for cnn input types)
         shape = x.shape
         x2d = x.reshape(-1, shape[-1])
         t = x2d.shape[0]

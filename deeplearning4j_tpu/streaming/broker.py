@@ -175,17 +175,39 @@ class TcpMessageBroker:
     class _TcpSubscription:
         def __init__(self, sock: socket.socket):
             self._sock = sock
+            self._buf = bytearray()   # partial frame survives poll timeouts
+
+        def _fill(self, n: int, timeout: Optional[float]) -> bool:
+            """Buffer until n bytes are available; False on timeout/EOF
+            with the partial data RETAINED for the next poll."""
+            import time as _time
+            deadline = None if timeout is None else _time.time() + timeout
+            while len(self._buf) < n:
+                if deadline is not None:
+                    remaining = deadline - _time.time()
+                    if remaining <= 0:
+                        return False
+                    self._sock.settimeout(remaining)
+                else:
+                    self._sock.settimeout(None)
+                try:
+                    chunk = self._sock.recv(65536)
+                except socket.timeout:
+                    return False
+                if not chunk:
+                    return False
+                self._buf.extend(chunk)
+            return True
 
         def poll(self, timeout: Optional[float] = None) -> Optional[bytes]:
-            self._sock.settimeout(timeout)
-            try:
-                head = _recv_exact(self._sock, 4)
-                if head is None:
-                    return None
-                return _recv_exact(self._sock,
-                                   struct.unpack("<I", head)[0])
-            except socket.timeout:
+            if not self._fill(4, timeout):
                 return None
+            size = struct.unpack("<I", bytes(self._buf[:4]))[0]
+            if not self._fill(4 + size, timeout):
+                return None
+            payload = bytes(self._buf[4:4 + size])
+            del self._buf[:4 + size]
+            return payload
 
         def close(self):
             self._sock.close()

@@ -230,8 +230,16 @@ class CheckpointListener(TrainingListener):
             import threading
             snapshot = model.clone()
             self.wait()          # at most one in-flight write
-            self._worker = threading.Thread(
-                target=write_model, args=(snapshot, path), daemon=True)
+
+            def _write():
+                try:
+                    write_model(snapshot, path)
+                except Exception:
+                    log.exception("background checkpoint to %s failed", path)
+
+            # non-daemon: interpreter exit waits for the final write to
+            # land instead of killing it mid-file
+            self._worker = threading.Thread(target=_write, daemon=False)
             self._worker.start()
         else:
             write_model(model, path)

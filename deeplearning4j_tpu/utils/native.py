@@ -1,4 +1,4 @@
-"""ctypes loader for the native host kernels (``native/dl4j_tpu_native.cpp``).
+"""ctypes loader for the native host kernels (``deeplearning4j_tpu/native_src.cpp``).
 
 The library is compiled on demand with g++ into ``native/build/`` and cached;
 every entry point has a pure-Python/numpy fallback so the framework works
@@ -26,8 +26,13 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
-_SRC = Path(__file__).resolve().parents[2] / "native" / "dl4j_tpu_native.cpp"
-_BUILD_DIR = _SRC.parent / "build"
+# source ships INSIDE the package so pip-installed trees compile too;
+# the build cache lives next to it (falls back to pure numpy when the
+# location is read-only or g++ is absent)
+_SRC = Path(__file__).resolve().parents[1] / "native_src.cpp"
+_BUILD_DIR = Path(
+    os.environ.get("DL4J_TPU_NATIVE_BUILD_DIR",
+                   str(_SRC.parent / "_native_build")))
 _SO = _BUILD_DIR / "libdl4j_tpu_native.so"
 
 _i8 = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
