@@ -7,6 +7,7 @@ from .dataset import (AsyncDataSetIterator, BenchmarkDataSetIterator, DataSet,
                       SamplingDataSetIterator)
 from .dataset import MultiDataSet
 from .records import RecordReaderMultiDataSetIterator
+from .dataset import AsyncMultiDataSetIterator
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
 from .normalization import (ImagePreProcessingScaler,
@@ -19,7 +20,7 @@ from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
 from .mnist import IrisDataSetIterator, MnistDataSetIterator
 
 __all__ = [
-    "AsyncDataSetIterator", "BenchmarkDataSetIterator", "DataSet",
+    "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "BenchmarkDataSetIterator", "DataSet",
     "DataSetIterator", "EarlyTerminationDataSetIterator",
     "ExistingDataSetIterator", "INDArrayDataSetIterator",
     "IrisDataSetIterator", "MnistDataSetIterator", "MovingWindowDataSetIterator",

@@ -435,3 +435,9 @@ class MultiDataSet:
         yield self.labels
         yield self.features_mask
         yield self.labels_mask
+
+
+# The prefetch loop is payload-agnostic (it queues whatever the underlying
+# iterator yields), so the MultiDataSet variant (reference
+# ``AsyncMultiDataSetIterator``) is the same class.
+AsyncMultiDataSetIterator = AsyncDataSetIterator

@@ -259,3 +259,36 @@ class TestNormalizers:
         ds = ImagePreProcessingScaler().fit(None).transform(
             DataSet(img, np.zeros((2, 1), np.float32)))
         np.testing.assert_allclose(np.asarray(ds.features), 0.5)
+
+
+def test_async_multi_dataset_iterator():
+    """Prefetch wraps MultiDataSet iterators unchanged (reference
+    AsyncMultiDataSetIterator)."""
+    from deeplearning4j_tpu.data import (AsyncMultiDataSetIterator,
+                                         MultiDataSet)
+
+    class Src:
+        def batch(self):
+            return 4
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            for i in range(3):
+                yield MultiDataSet([np.full((4, 2), i, np.float32)],
+                                   [np.zeros((4, 1), np.float32)])
+
+    got = list(AsyncMultiDataSetIterator(Src(), queue_size=2))
+    assert len(got) == 3
+    assert got[2].features[0][0, 0] == 2.0
+
+
+def test_log_once():
+    import logging
+    from deeplearning4j_tpu.utils.log_once import reset_once, warn_once
+    reset_once()
+    lg = logging.getLogger("t.once")
+    assert warn_once(lg, "hot loop warning %d", 1)
+    assert not warn_once(lg, "hot loop warning %d", 1)
+    assert warn_once(lg, "different message")
