@@ -1,0 +1,87 @@
+"""Validation error messages (reference
+``deeplearning4j-core/src/test/.../exceptions/``: misconfigurations must
+fail fast with messages that name the problem and the fix)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Sgd
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _build(*layers, itype=None):
+    b = (NeuralNetConfiguration.builder().seed(0)
+         .updater(Sgd(learning_rate=0.1)).list())
+    for l in layers:
+        b = b.layer(l)
+    if itype is not None:
+        b = b.set_input_type(itype)
+    return b.build()
+
+
+def test_missing_n_in_without_input_type():
+    conf = _build(DenseLayer(n_out=4, activation="relu"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+    with pytest.raises(ValueError, match="n_in|input type"):
+        MultiLayerNetwork(conf).init()
+
+
+def test_unknown_activation_lists_available():
+    conf = _build(DenseLayer(n_out=4, activation="not_an_act"),
+                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                  itype=InputType.feed_forward(3))
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises((KeyError, ValueError)) as ei:
+        net.output(np.zeros((1, 3), np.float32))
+    assert "not_an_act" in str(ei.value) or "activation" in str(ei.value)
+
+
+def test_non_output_last_layer_score():
+    conf = _build(DenseLayer(n_out=4, activation="relu"),
+                  itype=InputType.feed_forward(3))
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="output layer"):
+        net.score(x=np.zeros((2, 3), np.float32),
+                  y=np.zeros((2, 4), np.float32))
+
+
+def test_graph_cycle_detected():
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    g = GraphBuilder({})
+    g.add_inputs("in").set_input_types(InputType.feed_forward(3))
+    g.add_layer("a", DenseLayer(n_out=4), "in", "b")
+    g.add_layer("b", DenseLayer(n_out=4), "a")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "b")
+    g.set_outputs("out")
+    with pytest.raises(ValueError, match="cycle"):
+        g.build()
+
+
+def test_graph_unknown_input_named():
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    g = GraphBuilder({})
+    g.add_inputs("in").set_input_types(InputType.feed_forward(3))
+    g.add_layer("a", DenseLayer(n_out=4), "nonexistent")
+    g.set_outputs("a")
+    with pytest.raises(ValueError, match="nonexistent"):
+        g.build()
+
+
+def test_unknown_updater_via_solver():
+    net = MultiLayerNetwork(_build(
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        itype=InputType.feed_forward(3))).init()
+    from deeplearning4j_tpu.train.solvers import Solver
+    with pytest.raises(ValueError, match="available"):
+        Solver(net, "quantum_annealing")
+
+
+def test_wrong_label_width_fails_fast():
+    conf = _build(OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+                  itype=InputType.feed_forward(4))
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(Exception):
+        net.fit(np.zeros((8, 4), np.float32), np.zeros((8, 7), np.float32))
