@@ -165,3 +165,28 @@ def test_moe_layer_rnn_input():
     out = np.asarray(net.output(x))
     assert out.shape == (4, 7, 3)
     np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_moe_layer_gradient_check():
+    """Central-difference check (the GradientCheckUtil oracle) on the MoE
+    layer: away from routing-decision boundaries the dispatch is constant,
+    so analytic grads must match numeric ones."""
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.layers import MixtureOfExpertsLayer
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.gradient_check import check_gradients
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(MixtureOfExpertsLayer(n_out=5, n_experts=2, hidden=6,
+                                         capacity_factor=2.0,
+                                         activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 4))
+    y = np.eye(2)[rng.integers(0, 2, 6)]
+    assert check_gradients(net, x, y, subset=40)
