@@ -49,3 +49,26 @@ def test_golden_updater_state_restored(golden):
 def test_restore_model_sniffs_class(golden):
     net = restore_model(str(RES / "golden_mlp_v1.zip"))
     assert type(net).__name__ == "MultiLayerNetwork"
+
+
+class TestGoldenGraph:
+    """Graph-model format stability (same contract as the MLN fixture)."""
+
+    @pytest.fixture(scope="class")
+    def golden(self):
+        from deeplearning4j_tpu.utils.model_serializer import \
+            restore_computation_graph
+        net = restore_computation_graph(str(RES / "golden_graph_v1.zip"))
+        io = np.load(RES / "golden_graph_v1_io.npz")
+        return net, io
+
+    def test_structure(self, golden):
+        net, _ = golden
+        assert set(net.conf.vertices) == {"a", "b", "add", "out"}
+        assert net.conf.network_inputs == ["in"]
+
+    def test_inference_parity(self, golden):
+        net, io = golden
+        out = net.output(io["probe"])
+        out = np.asarray(out[0] if isinstance(out, list) else out)
+        np.testing.assert_allclose(out, io["output"], rtol=1e-5, atol=1e-6)
