@@ -61,12 +61,20 @@ class ParallelWrapper:
     """Train a model over a mesh. Drop-in for single-device ``model.fit``."""
 
     def __init__(self, model, mesh: Optional[Mesh] = None, *,
-                 param_rule: Optional[Callable] = None):
+                 param_rule: Optional[Callable] = None,
+                 shard_optimizer_state: bool = False):
         if model.params == {}:
             model.init()
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.param_rule = param_rule
+        # ZeRO-1 / "Automatic Cross-Replica Sharding of Weight Update in
+        # Data-Parallel Training" (arXiv:2004.13336, PAPERS.md): shard the
+        # optimizer state over the data axis; GSPMD then compiles the
+        # update as reduce-scatter(grads) -> sharded optimizer math ->
+        # all-gather(params), cutting optimizer memory by 1/dp with the
+        # same numerics.
+        self.shard_optimizer_state = shard_optimizer_state
         self._place()
         self._step = None
 
@@ -85,8 +93,23 @@ class ParallelWrapper:
         # get the param sharding; everything else (counts) is replicated
         param_treedef = jax.tree_util.tree_structure(m.params)
 
+        def zero1_sharding(leaf):
+            """First dim divisible by the data-axis size gets sharded;
+            otherwise replicate (small biases, scalars)."""
+            d = self.mesh.shape.get(DATA_AXIS, 1)
+            shp = getattr(leaf, "shape", ())
+            for i, n in enumerate(shp):
+                if n % d == 0 and n >= d:
+                    spec = [None] * len(shp)
+                    spec[i] = DATA_AXIS
+                    return NamedSharding(mesh, P(*spec))
+            return NamedSharding(mesh, P())
+
         def place_opt(o):
             if jax.tree_util.tree_structure(o) == param_treedef:
+                if self.shard_optimizer_state and self.param_rule is None:
+                    return jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, zero1_sharding(a)), o)
                 return jax.tree_util.tree_map(jax.device_put, o, self.param_shardings)
             if isinstance(o, tuple) and hasattr(o, "_fields"):  # NamedTuple state
                 return type(o)(*[place_opt(c) for c in o])
