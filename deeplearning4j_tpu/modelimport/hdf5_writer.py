@@ -67,14 +67,14 @@ class Hdf5Writer:
 
     @staticmethod
     def _dt_float(size: int) -> bytes:
-        # class 1 (float) v1, little-endian IEEE
+        # class 1 (float) v1, little-endian IEEE; second bit-field byte is
+        # the sign-bit position (31 for f32, 63 for f64)
         if size == 4:
             props = struct.pack("<HHBBBBI", 0, 32, 23, 8, 0, 23, 127)
-            bits = (0x20, 0x3F, 0x00)
         else:
             props = struct.pack("<HHBBBBI", 0, 64, 52, 11, 0, 52, 1023)
-            bits = (0x20, 0x3F, 0x00)
-        return struct.pack("<BBBBI", 0x11, bits[0], bits[1], bits[2],
+        sign_pos = size * 8 - 1
+        return struct.pack("<BBBBI", 0x11, 0x20, sign_pos, 0x00,
                            size) + props
 
     @staticmethod
@@ -187,6 +187,15 @@ class Hdf5Writer:
             self._put(p, key)
             self._put(p + key_size, struct.pack("<Q", addr))
             p += key_size + 8
+        # final (upper-bound) key: one chunk past the end in every dim —
+        # libhdf5 binary-searches the keys, a zeroed bound breaks lookup
+        # of edge chunks
+        bound = struct.pack("<II", 0, 0)
+        for d in range(ndims):
+            end = ((arr.shape[d] + chunks[d] - 1) // chunks[d]) * chunks[d]
+            bound += struct.pack("<Q", end)
+        bound += struct.pack("<Q", 0)
+        self._put(p, bound)
         msgs = [self._msg(0x0008, struct.pack(
             "<BBBQ", 3, 2, ndims + 1, tree_addr)
             + b"".join(struct.pack("<I", c) for c in chunks)
@@ -224,8 +233,9 @@ class Hdf5Writer:
         heap_data_addr = self._alloc(max(len(heap_data), 8))
         self._put(heap_data_addr, bytes(heap_data))
         heap_addr = self._alloc(32)
+        # free-list head = 1 (H5HL_FREE_NULL): no free blocks
         self._put(heap_addr, b"HEAP" + struct.pack(
-            "<B3xQQQ", 0, len(heap_data), len(heap_data), heap_data_addr))
+            "<B3xQQQ", 0, len(heap_data), 1, heap_data_addr))
         # single SNOD with all entries (names must be heap-offset sorted)
         snod_addr = self._alloc(8 + 40 * len(names))
         self._put(snod_addr, b"SNOD" + struct.pack("<BxH", 1, len(names)))
@@ -276,7 +286,7 @@ class Hdf5Writer:
         self._patch_refs(gheap_addr)
         # superblock v0
         sb = b"\x89HDF\r\n\x1a\n" + struct.pack(
-            "<BBBxBBBxHHI", 0, 0, 0, 0, 8, 8, 4, 16, 0x10003)
+            "<BBBxBBBxHHI", 0, 0, 0, 0, 8, 8, 4, 16, 0)
         sb += struct.pack("<QQQQ", 0, UNDEF, len(self.buf), UNDEF)
         sb += struct.pack("<QQI4x16x", 0, root_addr, 0)
         self.buf[:len(sb)] = sb
@@ -289,9 +299,14 @@ class Hdf5Writer:
         for i, payload in enumerate(self._gheap, start=1):
             objs += struct.pack("<HH4xQ", i, 1, len(payload))
             objs += _pad8(payload)
-        total = 16 + len(objs) + 16  # header + objects + free-space object
+        # libhdf5 requires collections of at least 4096 bytes; the tail is
+        # a free-space object (index 0, size = remaining bytes incl. its
+        # own 16-byte header)
+        total = max(16 + len(objs) + 16, 4096)
+        free = total - 16 - len(objs)
         addr = self._alloc(total)
-        self._put(addr, b"GCOL" + struct.pack("<B3xQ", 1, total) + objs)
+        self._put(addr, b"GCOL" + struct.pack("<B3xQ", 1, total) + objs
+                  + struct.pack("<HH4xQ", 0, 0, free))
         return addr
 
     def _patch_refs(self, gheap_addr: int):

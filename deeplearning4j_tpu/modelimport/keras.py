@@ -91,7 +91,8 @@ class _LayerMap:
         self.copy = copy  # fn(keras_weights: dict[str, np.ndarray]) -> params
 
 
-def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool) -> _LayerMap:
+def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool,
+               rnn_input: bool = False) -> _LayerMap:
     name = conf.get("name")
     if cls == "Dense":
         act = _act(conf.get("activation"))
@@ -99,8 +100,14 @@ def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool) -> _LayerMap:
         use_bias = conf.get("bias", conf.get("use_bias", True))
         if is_last:
             loss = "mcxent" if act == "softmax" else "mse"
-            lc = OutputLayer(name=name, n_out=n_out, activation=act,
-                             loss=loss, has_bias=use_bias)
+            if rnn_input:
+                # Keras Dense over [b,t,f] is time-distributed; keep the
+                # time axis (RnnOutputLayer) instead of auto-flattening
+                lc = RnnOutputLayer(name=name, n_out=n_out, activation=act,
+                                    loss=loss, has_bias=use_bias)
+            else:
+                lc = OutputLayer(name=name, n_out=n_out, activation=act,
+                                 loss=loss, has_bias=use_bias)
         else:
             lc = DenseLayer(name=name, n_out=n_out, activation=act,
                             has_bias=use_bias)
@@ -341,6 +348,7 @@ def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
     # find the last REAL layer (Flatten/InputLayer don't count)
     real_idx = [i for i, l in enumerate(layer_list)
                 if l["class_name"] not in ("Flatten", "InputLayer")]
+    rnn_ctx = False   # does the running activation carry a time axis?
     for i, l in enumerate(layer_list):
         cls = l["class_name"]
         conf = _cfg(l)
@@ -348,9 +356,17 @@ def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
             it = _input_type_from(conf)
             if it is not None:
                 itype = it
+                rnn_ctx = it.kind == "rnn"
         if cls == "InputLayer":
             continue
-        lm = _map_layer(cls, conf, is_last=(real_idx and i == real_idx[-1]))
+        lm = _map_layer(cls, conf, is_last=(real_idx and i == real_idx[-1]),
+                        rnn_input=rnn_ctx)
+        if cls in ("LSTM", "SimpleRNN", "Conv1D", "Convolution1D"):
+            rnn_ctx = conf.get("return_sequences", True) or \
+                cls in ("Conv1D", "Convolution1D")
+        elif cls not in ("Dropout", "Activation", "MaxPooling1D",
+                         "AveragePooling1D", "BatchNormalization"):
+            rnn_ctx = rnn_ctx and cls == "Dense"  # time-distributed keeps t
         if lm.conf is None:  # Flatten
             continue
         maps.append(lm)

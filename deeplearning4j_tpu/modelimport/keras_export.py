@@ -1,0 +1,170 @@
+"""Export MultiLayerNetwork models to Keras-2-layout HDF5.
+
+The reverse of ``keras.py`` (the reference ships import only; export
+closes the interchange loop so models trained here load in Keras/DL4J
+tooling).  Files are written with our own ``Hdf5Writer`` — the emitted
+format (v1 headers, symbol-table groups, GCOL vlen strings) is exactly
+what libhdf5 produces, so real h5py/Keras can read them (cross-validated
+in tests with h5py).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .hdf5_writer import Hdf5Writer
+
+__all__ = ["export_keras_sequential"]
+
+_ACT_INV = {
+    "relu": "relu", "tanh": "tanh", "sigmoid": "sigmoid",
+    "softmax": "softmax", "identity": "linear", "elu": "elu",
+    "selu": "selu", "softplus": "softplus", "softsign": "softsign",
+    "hardsigmoid": "hard_sigmoid", "swish": "swish", "gelu": "gelu",
+}
+
+
+def _act_name(layer) -> str:
+    a = layer.resolved("activation", "identity")
+    if a not in _ACT_INV:
+        raise ValueError(f"activation '{a}' has no Keras name")
+    return _ACT_INV[a]
+
+
+def _np(p) -> np.ndarray:
+    return np.asarray(p, np.float32)
+
+
+def _export_layer(i: int, lc, params: Dict[str, Any],
+                  state: Dict[str, Any], input_shape: Optional[list]):
+    """Returns (keras_layer_config, {weight_name: array}) or None to skip."""
+    cls = type(lc).__name__
+    name = lc.name or f"layer_{i}"
+    conf: Dict[str, Any] = {"name": name}
+    if input_shape is not None:
+        conf["batch_input_shape"] = input_shape
+    if cls in ("DenseLayer", "OutputLayer", "CenterLossOutputLayer",
+               "RnnOutputLayer"):   # Keras Dense maps over [b,t,f] too
+        conf.update(units=int(lc.n_out), activation=_act_name(lc),
+                    use_bias=bool(getattr(lc, "has_bias", True)))
+        w = {"kernel:0": _np(params["W"])}
+        if "b" in params:
+            w["bias:0"] = _np(params["b"])
+        return {"class_name": "Dense", "config": conf}, w
+    if cls == "ConvolutionLayer":
+        kh, kw = lc.kernel_size if isinstance(lc.kernel_size, (tuple, list)) \
+            else (lc.kernel_size, lc.kernel_size)
+        conf.update(filters=int(lc.n_out), kernel_size=[int(kh), int(kw)],
+                    strides=[int(s) for s in lc.stride],
+                    padding="same" if lc.convolution_mode == "same"
+                    else "valid",
+                    activation=_act_name(lc),
+                    use_bias=bool(lc.has_bias))
+        w = {"kernel:0": _np(params["W"])}   # HWIO both sides
+        if "b" in params:
+            w["bias:0"] = _np(params["b"])
+        return {"class_name": "Conv2D", "config": conf}, w
+    if cls == "SubsamplingLayer":
+        kname = ("MaxPooling2D" if lc.pooling_type == "max"
+                 else "AveragePooling2D")
+        conf.update(pool_size=[int(k) for k in lc.kernel_size],
+                    strides=[int(s) for s in lc.stride])
+        return {"class_name": kname, "config": conf}, {}
+    if cls == "BatchNormalization":
+        conf.update(epsilon=float(lc.eps), momentum=float(lc.decay))
+        w = {}
+        if "gamma" in params:
+            w["gamma:0"] = _np(params["gamma"])
+            w["beta:0"] = _np(params["beta"])
+        w["moving_mean:0"] = _np(state.get("mean"))
+        w["moving_variance:0"] = _np(state.get("var"))
+        return {"class_name": "BatchNormalization", "config": conf}, w
+    if cls == "LSTM":
+        h = int(lc.n_out)
+        conf.update(units=h, activation=_act_name(lc),
+                    recurrent_activation="sigmoid", return_sequences=True)
+
+        def reorder(m):  # ours i,f,o,g(=c) -> keras i,f,c,o
+            blocks = [m[..., g * h:(g + 1) * h] for g in range(4)]
+            return np.concatenate(
+                [blocks[0], blocks[1], blocks[3], blocks[2]], axis=-1)
+
+        return {"class_name": "LSTM", "config": conf}, {
+            "kernel:0": reorder(_np(params["W"])),
+            "recurrent_kernel:0": reorder(_np(params["U"])),
+            "bias:0": reorder(_np(params["b"]).reshape(1, -1)).reshape(-1)}
+    if cls == "SimpleRnn":
+        conf.update(units=int(lc.n_out), activation=_act_name(lc),
+                    return_sequences=True)
+        return {"class_name": "SimpleRNN", "config": conf}, {
+            "kernel:0": _np(params["W"]),
+            "recurrent_kernel:0": _np(params["U"]),
+            "bias:0": _np(params["b"])}
+    if cls == "EmbeddingLayer":
+        conf.update(input_dim=int(lc.n_in), output_dim=int(lc.n_out))
+        return {"class_name": "Embedding", "config": conf}, {
+            "embeddings:0": _np(params["W"])}
+    if cls == "ActivationLayer":
+        conf.update(activation=_act_name(lc))
+        return {"class_name": "Activation", "config": conf}, {}
+    if cls == "DropoutLayer":
+        conf.update(rate=1.0 - float(lc.dropout))
+        return {"class_name": "Dropout", "config": conf}, {}
+    if cls == "GlobalPoolingLayer":
+        kname = ("GlobalMaxPooling2D" if lc.pooling_type == "max"
+                 else "GlobalAveragePooling2D")
+        return {"class_name": kname, "config": conf}, {}
+    raise ValueError(
+        f"layer {name} ({cls}) has no Keras export mapping")
+
+
+def _input_shape(itype) -> Optional[list]:
+    if itype is None:
+        return None
+    if itype.kind == "ff":
+        return [None, int(itype.size)]
+    if itype.kind == "rnn":
+        t = itype.timesteps
+        return [None, None if not t or t < 0 else int(t), int(itype.size)]
+    if itype.kind in ("cnn", "cnnflat"):
+        return [None, int(itype.height), int(itype.width),
+                int(itype.channels)]
+    return None
+
+
+def export_keras_sequential(net, path: Optional[str] = None) -> bytes:
+    """Write ``net`` (MultiLayerNetwork) as a Keras-2 Sequential
+    ``model.save()``-layout HDF5; returns the bytes (and writes ``path``
+    when given)."""
+    layer_entries: List[dict] = []
+    tree: Dict[str, Any] = {"model_weights": {}}
+    attrs: Dict[str, Dict[str, Any]] = {}
+    layer_names: List[str] = []
+    for i, lc in enumerate(net.layers):
+        ishape = _input_shape(net.conf.input_type) if i == 0 else None
+        entry = _export_layer(i, lc, net.params.get(f"layer_{i}", {}),
+                              net.state.get(f"layer_{i}", {}), ishape)
+        kconf, weights = entry
+        lname = kconf["config"]["name"]
+        layer_entries.append(kconf)
+        layer_names.append(lname)
+        group: Dict[str, Any] = {}
+        wnames = []
+        for wn, arr in weights.items():
+            group[wn] = arr
+            wnames.append(f"{lname}/{wn}")
+        tree["model_weights"][lname] = group
+        attrs[f"/model_weights/{lname}"] = {"weight_names": wnames}
+    config = {"class_name": "Sequential",
+              "config": {"name": "sequential", "layers": layer_entries}}
+    attrs["/"] = {"model_config": json.dumps(config),
+                  "keras_version": "2.1.6", "backend": "tensorflow"}
+    attrs["/model_weights"] = {"layer_names": layer_names,
+                               "backend": "tensorflow"}
+    data = Hdf5Writer().write(tree, attrs)
+    if path:
+        with open(path, "wb") as fh:
+            fh.write(data)
+    return data
