@@ -473,6 +473,7 @@ def import_keras_model(path_or_bytes):
     alias: Dict[str, str] = {}      # skipped layers forward to their input
     copy_items: List[Tuple[str, Any]] = []
     input_types: List[InputType] = []
+    rnn_of: Dict[str, bool] = {}    # layer name -> carries a time axis
 
     def resolve(names: List[str]) -> List[str]:
         return [alias.get(n, n) for n in names]
@@ -481,7 +482,9 @@ def import_keras_model(path_or_bytes):
         cls = l["class_name"]
         conf = _cfg(l)
         name = l.get("name") or conf.get("name")
-        inbound = resolve(_inbound_names(l))
+        raw_inbound = _inbound_names(l)
+        inbound = resolve(raw_inbound)
+        rnn_in = any(rnn_of.get(n, False) for n in raw_inbound)
         if cls == "InputLayer" or not inbound:
             it = _input_type_from(conf)
             if it is None:
@@ -489,10 +492,12 @@ def import_keras_model(path_or_bytes):
                     f"input layer '{name}' has no batch_input_shape")
             g.add_inputs(name)
             input_types.append(it)
+            rnn_of[name] = it.kind == "rnn"
             continue
         if cls in _MERGE_ELEMENTWISE:
             g.add_vertex(name, ElementWiseVertex(op=_MERGE_ELEMENTWISE[cls]),
                          *inbound)
+            rnn_of[name] = rnn_in
             continue
         if cls in ("Concatenate", "Merge"):
             mode = conf.get("mode", "concat")
@@ -501,8 +506,19 @@ def import_keras_model(path_or_bytes):
             else:
                 g.add_vertex(name, ElementWiseVertex(op=_MERGE_MODE[mode]),
                              *inbound)
+            rnn_of[name] = rnn_in
             continue
-        lm = _map_layer(cls, conf, is_last=name in out_names)
+        # time-axis propagation (mirrors the Sequential path's rnn_ctx)
+        if cls in ("LSTM", "SimpleRNN", "Conv1D", "Convolution1D"):
+            rnn_of[name] = conf.get("return_sequences", True) or \
+                cls in ("Conv1D", "Convolution1D")
+        elif cls in ("Dropout", "Activation", "MaxPooling1D",
+                     "AveragePooling1D", "BatchNormalization", "Dense"):
+            rnn_of[name] = rnn_in
+        else:
+            rnn_of[name] = False
+        lm = _map_layer(cls, conf, is_last=name in out_names,
+                        rnn_input=rnn_in)
         if lm.conf is None:  # Flatten: auto preprocessor handles reshapes
             alias[name] = inbound[0]
             continue

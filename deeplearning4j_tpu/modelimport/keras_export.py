@@ -37,8 +37,15 @@ def _np(p) -> np.ndarray:
     return np.asarray(p, np.float32)
 
 
+def _pair_list(v) -> list:
+    if isinstance(v, (tuple, list)):
+        return [int(x) for x in v]
+    return [int(v), int(v)]
+
+
 def _export_layer(i: int, lc, params: Dict[str, Any],
-                  state: Dict[str, Any], input_shape: Optional[list]):
+                  state: Dict[str, Any], input_shape: Optional[list],
+                  input_kind: Optional[str] = None):
     """Returns (keras_layer_config, {weight_name: array}) or None to skip."""
     cls = type(lc).__name__
     name = lc.name or f"layer_{i}"
@@ -54,10 +61,19 @@ def _export_layer(i: int, lc, params: Dict[str, Any],
             w["bias:0"] = _np(params["b"])
         return {"class_name": "Dense", "config": conf}, w
     if cls == "ConvolutionLayer":
-        kh, kw = lc.kernel_size if isinstance(lc.kernel_size, (tuple, list)) \
-            else (lc.kernel_size, lc.kernel_size)
-        conf.update(filters=int(lc.n_out), kernel_size=[int(kh), int(kw)],
-                    strides=[int(s) for s in lc.stride],
+        pad = _pair_list(getattr(lc, "padding", (0, 0)))
+        dil = _pair_list(getattr(lc, "dilation", (1, 1)))
+        if lc.convolution_mode != "same" and any(pad):
+            raise ValueError(
+                f"layer {name}: explicit padding {pad} has no Keras "
+                "Sequential equivalent (use convolution_mode='same' or "
+                "zero padding layers)")
+        if any(d != 1 for d in dil):
+            raise ValueError(
+                f"layer {name}: dilation {dil} is not exported")
+        conf.update(filters=int(lc.n_out),
+                    kernel_size=_pair_list(lc.kernel_size),
+                    strides=_pair_list(lc.stride),
                     padding="same" if lc.convolution_mode == "same"
                     else "valid",
                     activation=_act_name(lc),
@@ -69,22 +85,31 @@ def _export_layer(i: int, lc, params: Dict[str, Any],
     if cls == "SubsamplingLayer":
         kname = ("MaxPooling2D" if lc.pooling_type == "max"
                  else "AveragePooling2D")
-        conf.update(pool_size=[int(k) for k in lc.kernel_size],
-                    strides=[int(s) for s in lc.stride])
+        conf.update(pool_size=_pair_list(lc.kernel_size),
+                    strides=_pair_list(lc.stride))
         return {"class_name": kname, "config": conf}, {}
     if cls == "BatchNormalization":
         conf.update(epsilon=float(lc.eps), momentum=float(lc.decay))
+        if state.get("mean") is None or state.get("var") is None:
+            raise ValueError(
+                f"layer {name}: BatchNormalization has no moving statistics "
+                "in net.state — initialize/train the network before export")
         w = {}
         if "gamma" in params:
             w["gamma:0"] = _np(params["gamma"])
             w["beta:0"] = _np(params["beta"])
-        w["moving_mean:0"] = _np(state.get("mean"))
-        w["moving_variance:0"] = _np(state.get("var"))
+        w["moving_mean:0"] = _np(state["mean"])
+        w["moving_variance:0"] = _np(state["var"])
         return {"class_name": "BatchNormalization", "config": conf}, w
     if cls == "LSTM":
         h = int(lc.n_out)
+        gate = getattr(lc, "gate_activation", "sigmoid")
+        if gate not in _ACT_INV:
+            raise ValueError(
+                f"layer {name}: gate activation '{gate}' has no Keras name")
         conf.update(units=h, activation=_act_name(lc),
-                    recurrent_activation="sigmoid", return_sequences=True)
+                    recurrent_activation=_ACT_INV[gate],
+                    return_sequences=True)
 
         def reorder(m):  # ours i,f,o,g(=c) -> keras i,f,c,o
             blocks = [m[..., g * h:(g + 1) * h] for g in range(4)]
@@ -113,8 +138,9 @@ def _export_layer(i: int, lc, params: Dict[str, Any],
         conf.update(rate=1.0 - float(lc.dropout))
         return {"class_name": "Dropout", "config": conf}, {}
     if cls == "GlobalPoolingLayer":
-        kname = ("GlobalMaxPooling2D" if lc.pooling_type == "max"
-                 else "GlobalAveragePooling2D")
+        dim = "1D" if input_kind == "rnn" else "2D"
+        kname = (f"GlobalMaxPooling{dim}" if lc.pooling_type == "max"
+                 else f"GlobalAveragePooling{dim}")
         return {"class_name": kname, "config": conf}, {}
     raise ValueError(
         f"layer {name} ({cls}) has no Keras export mapping")
@@ -142,10 +168,14 @@ def export_keras_sequential(net, path: Optional[str] = None) -> bytes:
     tree: Dict[str, Any] = {"model_weights": {}}
     attrs: Dict[str, Dict[str, Any]] = {}
     layer_names: List[str] = []
+    layer_itypes = getattr(net.conf, "layer_input_types", None) or []
     for i, lc in enumerate(net.layers):
         ishape = _input_shape(net.conf.input_type) if i == 0 else None
+        ikind = (layer_itypes[i].kind if i < len(layer_itypes)
+                 and layer_itypes[i] is not None else None)
         entry = _export_layer(i, lc, net.params.get(f"layer_{i}", {}),
-                              net.state.get(f"layer_{i}", {}), ishape)
+                              net.state.get(f"layer_{i}", {}), ishape,
+                              input_kind=ikind)
         kconf, weights = entry
         lname = kconf["config"]["name"]
         layer_entries.append(kconf)

@@ -74,6 +74,11 @@ class ParallelWrapper:
         # update as reduce-scatter(grads) -> sharded optimizer math ->
         # all-gather(params), cutting optimizer memory by 1/dp with the
         # same numerics.
+        if shard_optimizer_state and param_rule is not None:
+            raise ValueError(
+                "shard_optimizer_state=True is only supported with "
+                "replicated params (param_rule=None): a TP param_rule "
+                "already shards the optimizer state with the params")
         self.shard_optimizer_state = shard_optimizer_state
         self._place()
         self._step = None
