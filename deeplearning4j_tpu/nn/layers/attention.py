@@ -93,8 +93,15 @@ class MultiHeadAttention(BaseLayerConf):
 
     Projections pack all heads into single [n_in, h*d] matmuls (MXU-shaped);
     softmax statistics run in at least float32 even under bfloat16 params.
+
+    HAS_CARRY: the carry is a KV cache ({k, v, pos}, capacity
+    ``max_cache_len``) enabling incremental decoding through
+    ``rnn_time_step`` — the attention-era face of the reference's stateful
+    RNN inference.  Past ``max_cache_len`` the slice update saturates
+    (oldest semantics undefined); size the cache for the longest sequence.
     """
     INPUT_KIND = "rnn"
+    HAS_CARRY = True
     _BIAS_PARAMS = ("bq", "bk", "bv", "bo")
 
     n_in: int = 0
@@ -106,6 +113,7 @@ class MultiHeadAttention(BaseLayerConf):
     seq_axis: str = "seq"
     has_bias: bool = True
     attn_dropout: Optional[float] = None   # retain prob on attention output
+    max_cache_len: int = 512    # KV-cache capacity for incremental decode
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_in == 0 or override:
@@ -172,6 +180,55 @@ class MultiHeadAttention(BaseLayerConf):
         y = self.attend(p, x, train=train, key=key, mask=mask)
         return self.act_fn(y), variables.get("state", {})
 
+    # ---- KV-cache incremental decoding -----------------------------------
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        h, d = self._dims()
+        L = self.max_cache_len
+        return {"k": jnp.zeros((batch, h, L, d), dtype),
+                "v": jnp.zeros((batch, h, L, d), dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def attend_cached(self, p, x, carry):
+        """Project the t new steps, extend the cache, attend q against the
+        full prefix.  Returns (y [b,t,n_out], new_carry)."""
+        q = self._heads(x, p, "Wq", "bq")                 # [b,h,t,d]
+        k_new = self._heads(x, p, "Wk", "bk")
+        v_new = self._heads(x, p, "Wv", "bv")
+        pos = carry["pos"]
+        L = self.max_cache_len
+        z = jnp.zeros((), pos.dtype)   # index dtypes must match under x64
+        k = jax.lax.dynamic_update_slice(
+            carry["k"], k_new.astype(carry["k"].dtype), (z, z, pos, z))
+        v = jax.lax.dynamic_update_slice(
+            carry["v"], v_new.astype(carry["v"].dtype), (z, z, pos, z))
+        t = q.shape[2]
+        d = q.shape[-1]
+        scores = jnp.einsum("bhtd,bhld->bhtl", q, k.astype(q.dtype))
+        scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(
+            scores.dtype)
+        # key l visible to query j iff l <= pos + j (causal over the prefix)
+        l_idx = jnp.arange(L)[None, :]
+        q_idx = pos + jnp.arange(t)[:, None]
+        visible = l_idx <= q_idx                           # [t, L]
+        scores = jnp.where(visible[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        o = jnp.einsum("bhtl,bhld->bhtd", probs.astype(q.dtype),
+                       v.astype(q.dtype))
+        b_, h, _, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b_, t, -1)
+        y = o @ p["Wo"]
+        if self.has_bias:
+            y = y + p["bo"]
+        return y, {"k": k, "v": v, "pos": pos + t}
+
+    def apply_with_carry(self, variables, x, carry, *, train=False,
+                         key=None, mask=None):
+        if carry is None:
+            carry = self.init_carry(x.shape[0], x.dtype)
+        p = variables["params"]
+        y, new_carry = self.attend_cached(p, x, carry)
+        return self.act_fn(y), new_carry
+
 
 @register_serde
 @dataclass
@@ -183,6 +240,7 @@ class TransformerBlock(BaseLayerConf):
     implementation; ffn_mult sizes the hidden MLP.
     """
     INPUT_KIND = "rnn"
+    HAS_CARRY = True
     _BIAS_PARAMS = ("mha_bq", "mha_bk", "mha_bv", "mha_bo", "b1", "b2",
                     "ln1_g", "ln1_b", "ln2_g", "ln2_b")
 
@@ -193,6 +251,7 @@ class TransformerBlock(BaseLayerConf):
     attn_impl: str = "auto"
     seq_axis: str = "seq"
     eps: float = 1e-5
+    max_cache_len: int = 512
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_in == 0 or override:
@@ -210,7 +269,8 @@ class TransformerBlock(BaseLayerConf):
             causal=self.causal, attn_impl=self.attn_impl,
             seq_axis=self.seq_axis, activation="identity",
             weight_init=self.weight_init, weight_dist=self.weight_dist,
-            bias_init=self.bias_init, dtype=self.dtype)
+            bias_init=self.bias_init, dtype=self.dtype,
+            max_cache_len=self.max_cache_len)
         return m
 
     def init(self, key, itype):
@@ -241,19 +301,54 @@ class TransformerBlock(BaseLayerConf):
         ff = jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
         return x + ff, variables.get("state", {})
 
+    # ---- KV-cache incremental decoding -----------------------------------
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return self._mha().init_carry(batch, dtype)
+
+    def apply_with_carry(self, variables, x, carry, *, train=False,
+                         key=None, mask=None):
+        if carry is None:
+            carry = self.init_carry(x.shape[0], x.dtype)
+        p = variables["params"]
+        mha_p = {k[4:]: v for k, v in p.items() if k.startswith("mha_")}
+        xn = _layer_norm(x, p["ln1_g"], p["ln1_b"], self.eps)
+        attn, new_carry = self._mha().attend_cached(mha_p, xn, carry)
+        x = x + attn
+        xn = _layer_norm(x, p["ln2_g"], p["ln2_b"], self.eps)
+        ff = jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+        return x + ff, new_carry
+
 
 @register_serde
 @dataclass
 class PositionalEncodingLayer(LayerConf):
-    """Sinusoidal positional encoding added to RNN-typed input (no params)."""
+    """Sinusoidal positional encoding added to RNN-typed input (no params).
+    Carry = stream position, so incremental decode keeps absolute
+    positions."""
+    HAS_CARRY = True
 
     def output_type(self, itype: InputType) -> InputType:
         return itype
 
-    def apply(self, variables, x, *, train=False, key=None, mask=None):
-        b, t, e = x.shape
-        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    @staticmethod
+    def _pe(t, e, offset, dtype):
+        pos = (offset + jnp.arange(t, dtype=jnp.float32))[:, None]
         i = jnp.arange(e, dtype=jnp.float32)[None, :]
         angle = pos / jnp.power(10000.0, (2 * (i // 2)) / e)
-        pe = jnp.where(i % 2 == 0, jnp.sin(angle), jnp.cos(angle))
-        return x + pe.astype(x.dtype), variables.get("state", {})
+        return jnp.where(i % 2 == 0, jnp.sin(angle),
+                         jnp.cos(angle)).astype(dtype)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        b, t, e = x.shape
+        return x + self._pe(t, e, 0.0, x.dtype), variables.get("state", {})
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return {"pos": jnp.zeros((), jnp.int32)}
+
+    def apply_with_carry(self, variables, x, carry, *, train=False,
+                         key=None, mask=None):
+        if carry is None:
+            carry = self.init_carry(x.shape[0], x.dtype)
+        b, t, e = x.shape
+        y = x + self._pe(t, e, carry["pos"].astype(jnp.float32), x.dtype)
+        return y, {"pos": carry["pos"] + t}

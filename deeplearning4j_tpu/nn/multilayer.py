@@ -537,7 +537,9 @@ class MultiLayerNetwork:
                 "backward pass needs the full sequence (reference throws "
                 "likewise)")
         x = jnp.asarray(x)
-        squeeze = x.ndim == 2
+        # float [b, f] = one step of features; int [b, t] = token ids over
+        # time (embedding-sequence models) — already a sequence
+        squeeze = x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating)
         if squeeze:
             x = x[:, None, :]
         if getattr(self, "_rnn_carries", None) is None or \

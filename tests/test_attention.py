@@ -170,3 +170,27 @@ def test_transformer_lm_trains():
     for _ in range(30):
         net.fit(x, y)
     assert float(net.score((x, y))) < first
+
+
+def test_transformer_incremental_decode_matches_full_forward():
+    """KV-cached rnn_time_step == full forward at each position (the
+    attention-era stateful-inference contract; reference rnnTimeStep)."""
+    from deeplearning4j_tpu.models import TransformerLM
+    net = TransformerLM(vocab_size=13, seq_len=9, embed=16, n_layers=2,
+                        n_heads=2).init()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 13, (3, 9))
+    full = np.asarray(net.output(x))                      # [3, 9, 13]
+    net.rnn_clear_previous_state()
+    steps = []
+    for t in range(9):
+        y = np.asarray(net.rnn_time_step(x[:, t:t + 1]))  # [3, 1, 13]
+        steps.append(y[:, 0])
+    inc = np.stack(steps, axis=1)
+    np.testing.assert_allclose(inc, full, rtol=2e-3, atol=2e-4)
+    # chunked streaming matches too (prefix then remainder)
+    net.rnn_clear_previous_state()
+    a = np.asarray(net.rnn_time_step(x[:, :4]))
+    b = np.asarray(net.rnn_time_step(x[:, 4:]))
+    np.testing.assert_allclose(np.concatenate([a, b], 1), full, rtol=2e-3,
+                               atol=2e-4)
