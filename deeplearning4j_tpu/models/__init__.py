@@ -9,7 +9,7 @@ __all__ = [
     "ALL_MODELS", "AlexNet", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TransformerLM", "VGG16", "VGG19", "ZooModel",
-    "available_bench_model", "flagship_entry_model",
+    "available_bench_model", "flagship_entry_model", "generate_tokens",
 ]
 
 
@@ -39,3 +39,27 @@ def flagship_entry_model():
     x = rng.standard_normal((8, 96, 96, 3), dtype=np.float32)
     y = np.eye(100, dtype=np.float32)[rng.integers(0, 100, 8)]
     return model, (x, y)
+
+
+def generate_tokens(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
+                    seed: int = 0):
+    """Autoregressive sampling through the KV-cached ``rnn_time_step``
+    stream (works for TransformerLM and recurrent LMs alike).
+    prompt_ids: [batch, t0] ints.  Returns [batch, t0 + n_tokens]."""
+    rng = np.random.default_rng(seed)
+    prompt_ids = np.asarray(prompt_ids)
+    net.rnn_clear_previous_state()
+    probs = np.asarray(net.rnn_time_step(prompt_ids))[:, -1]   # [b, v]
+    out = [prompt_ids]
+    for _ in range(n_tokens):
+        if temperature <= 0:
+            nxt = probs.argmax(-1)
+        else:
+            logits = np.log(np.maximum(probs, 1e-9)) / temperature
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            nxt = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+        nxt = nxt.astype(prompt_ids.dtype)[:, None]
+        out.append(nxt)
+        probs = np.asarray(net.rnn_time_step(nxt))[:, -1]
+    return np.concatenate(out, axis=1)

@@ -124,3 +124,21 @@ def test_transformer_lm_trains_and_predicts():
     out2 = np.asarray(net.output(x2))
     np.testing.assert_allclose(out[:, :6], out2[:, :6], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_generate_tokens_greedy_recovers_cycle():
+    """Autoregressive generation through the KV cache: a model trained on
+    the +1-cycle task must greedily continue the cycle."""
+    from deeplearning4j_tpu.models import TransformerLM, generate_tokens
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    net = TransformerLM(vocab_size=11, seq_len=10, embed=32, n_layers=2,
+                        n_heads=4, updater=Adam(learning_rate=3e-3)).init()
+    rng = np.random.default_rng(1)
+    starts = rng.integers(0, 11, 32)
+    x = (starts[:, None] + np.arange(10)[None, :]) % 11
+    y = np.eye(11, dtype=np.float32)[(x + 1) % 11]
+    for _ in range(80):
+        net.fit(x, y)
+    prompt = np.array([[3, 4, 5]])
+    gen = generate_tokens(net, prompt, n_tokens=5, temperature=0.0)
+    assert gen.tolist()[0] == [3, 4, 5, 6, 7, 8, 9, 10]
