@@ -501,6 +501,7 @@ class TransformerLM(ZooModel):
     n_layers: int = 4
     n_heads: int = 8
     attn_impl: str = "auto"
+    moe_experts: int = 0    # >0: Switch-style sparse FFN blocks
 
     def init(self):
         from ..nn.layers.attention import (PositionalEncodingLayer,
@@ -515,7 +516,8 @@ class TransformerLM(ZooModel):
              .layer(PositionalEncodingLayer()))
         for _ in range(self.n_layers):
             b = b.layer(TransformerBlock(n_heads=self.n_heads, causal=True,
-                                         attn_impl=self.attn_impl))
+                                         attn_impl=self.attn_impl,
+                                         moe_experts=self.moe_experts))
         conf = (b.layer(RnnOutputLayer(n_out=self.vocab_size,
                                        activation="softmax", loss="mcxent"))
                 .set_input_type(InputType.recurrent(self.vocab_size,

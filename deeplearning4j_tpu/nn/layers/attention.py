@@ -252,6 +252,15 @@ class TransformerBlock(BaseLayerConf):
     seq_axis: str = "seq"
     eps: float = 1e-5
     max_cache_len: int = 512
+    # Switch-transformer style sparse FFN: >0 replaces the dense MLP with
+    # a top-1 routed expert stack (aux loss threads through state)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    @property
+    def AUX_LOSS(self):
+        return self.moe_experts > 0
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_in == 0 or override:
@@ -276,18 +285,52 @@ class TransformerBlock(BaseLayerConf):
     def init(self, key, itype):
         e = self.n_in
         f = self.ffn_mult * e
-        k_mha, k1, k2 = jax.random.split(key, 3)
+        k_mha, k1, k2, kr = jax.random.split(key, 4)
         mha_vars = self._mha().init(k_mha, itype)
         params = {f"mha_{k}": v for k, v in mha_vars["params"].items()}
+        if self.moe_experts > 0:
+            E = self.moe_experts
+            params.update({
+                "router": self.make_weight(kr, (e, E)),
+                "w1": self.make_weight(k1, (E, e, f)),
+                "b1": self.make_bias((E, 1, f)),
+                "w2": self.make_weight(k2, (E, f, e)),
+                "b2": self.make_bias((E, 1, e)),
+            })
+        else:
+            params.update({
+                "W1": self.make_weight(k1, (e, f)),
+                "b1": self.make_bias((f,)),
+                "W2": self.make_weight(k2, (f, e)),
+                "b2": self.make_bias((e,)),
+            })
         params.update({
-            "W1": self.make_weight(k1, (e, f)), "b1": self.make_bias((f,)),
-            "W2": self.make_weight(k2, (f, e)), "b2": self.make_bias((e,)),
             "ln1_g": jnp.ones((e,), self._dtype()),
             "ln1_b": jnp.zeros((e,), self._dtype()),
             "ln2_g": jnp.ones((e,), self._dtype()),
             "ln2_b": jnp.zeros((e,), self._dtype()),
         })
-        return {"params": params, "state": {}}
+        state = {}
+        if self.moe_experts > 0:
+            state["aux_loss"] = jnp.zeros((), self._dtype())
+        return {"params": params, "state": state}
+
+    def _ffn(self, p, xn):
+        """Dense or routed MLP; returns (out, state_update)."""
+        if self.moe_experts == 0:
+            return (jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"]
+                    + p["b2"], {})
+        from ...parallel.expert import moe_ffn
+        b, t, e = xn.shape
+        x2d = xn.reshape(b * t, e)
+        capacity = max(int(self.moe_capacity_factor * b * t
+                           / self.moe_experts), 1)
+        moe_p = {"router": p["router"], "w1": p["w1"], "b1": p["b1"],
+                 "w2": p["w2"], "b2": p["b2"]}
+        y, aux = moe_ffn(moe_p, x2d, capacity, act=jax.nn.gelu)
+        return y.reshape(b, t, e), {
+            "aux_loss": (self.aux_loss_weight * aux).astype(
+                jnp.result_type(xn))}
 
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         p = self.maybe_noise_weights(key, variables["params"], train)
@@ -298,8 +341,8 @@ class TransformerBlock(BaseLayerConf):
         x = x + self._mha().attend(mha_p, xn, train=train, key=key, mask=mask)
 
         xn = _layer_norm(x, p["ln2_g"], p["ln2_b"], self.eps)
-        ff = jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
-        return x + ff, variables.get("state", {})
+        ff, st = self._ffn(p, xn)
+        return x + ff, st if st else variables.get("state", {})
 
     # ---- KV-cache incremental decoding -----------------------------------
     def init_carry(self, batch: int, dtype=jnp.float32):
@@ -315,7 +358,7 @@ class TransformerBlock(BaseLayerConf):
         attn, new_carry = self._mha().attend_cached(mha_p, xn, carry)
         x = x + attn
         xn = _layer_norm(x, p["ln2_g"], p["ln2_b"], self.eps)
-        ff = jax.nn.gelu(xn @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+        ff, _ = self._ffn(p, xn)
         return x + ff, new_carry
 
 

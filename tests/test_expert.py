@@ -190,3 +190,31 @@ def test_moe_layer_gradient_check():
     x = rng.standard_normal((6, 4))
     y = np.eye(2)[rng.integers(0, 2, 6)]
     assert check_gradients(net, x, y, subset=40)
+
+
+def test_switch_transformer_block_moe():
+    """TransformerBlock(moe_experts>0): Switch-style sparse FFN — trains,
+    aux loss tracked in state, KV-cached decode still matches full fwd."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    net = TransformerLM(vocab_size=11, seq_len=8, embed=16, n_layers=2,
+                        n_heads=2, moe_experts=4,
+                        updater=Adam(learning_rate=3e-3)).init()
+    rng = np.random.default_rng(2)
+    starts = rng.integers(0, 11, 16)
+    x = (starts[:, None] + np.arange(8)[None, :]) % 11
+    y = np.eye(11, dtype=np.float32)[(x + 1) % 11]
+    s0 = net.score(x=x, y=y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score() < 0.4 * s0
+    aux = float(np.asarray(net.state["layer_2"]["aux_loss"]))
+    assert np.isfinite(aux) and aux >= 0
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    a = np.asarray(net.rnn_time_step(x[:, :3]))
+    b = np.asarray(net.rnn_time_step(x[:, 3:]))
+    inc = np.concatenate([a, b], axis=1)
+    # MoE capacity depends on token count, so routing/drops differ between
+    # full-batch and chunked streams; require close, not identical
+    assert np.mean(np.abs(inc - full)) < 0.05
