@@ -48,6 +48,13 @@ def generate_tokens(net, prompt_ids, n_tokens: int, temperature: float = 1.0,
     prompt_ids: [batch, t0] ints.  Returns [batch, t0 + n_tokens]."""
     rng = np.random.default_rng(seed)
     prompt_ids = np.asarray(prompt_ids)
+    caches = [c for c in (getattr(l, "max_cache_len", None)
+                          for l in net.layers) if c]
+    total = prompt_ids.shape[1] + n_tokens
+    if caches and total > min(caches):
+        raise ValueError(
+            f"prompt + n_tokens = {total} exceeds the smallest KV cache "
+            f"({min(caches)}); raise max_cache_len on the attention layers")
     net.rnn_clear_previous_state()
     probs = np.asarray(net.rnn_time_step(prompt_ids))[:, -1]   # [b, v]
     out = [prompt_ids]

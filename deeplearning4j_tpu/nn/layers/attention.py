@@ -167,6 +167,9 @@ class MultiHeadAttention(BaseLayerConf):
         y = o @ p["Wo"]
         if self.has_bias:
             y = y + p["bo"]
+        return self._maybe_attn_dropout(y, train, key)
+
+    def _maybe_attn_dropout(self, y, train, key):
         if train and self.attn_dropout and key is not None:
             keep = self.attn_dropout
             mask_d = jax.random.bernoulli(jax.random.fold_in(key, 7), keep,
@@ -186,47 +189,51 @@ class MultiHeadAttention(BaseLayerConf):
         L = self.max_cache_len
         return {"k": jnp.zeros((batch, h, L, d), dtype),
                 "v": jnp.zeros((batch, h, L, d), dtype),
+                "m": jnp.zeros((batch, L), jnp.float32),   # cache validity
                 "pos": jnp.zeros((), jnp.int32)}
 
-    def attend_cached(self, p, x, carry):
+    def attend_cached(self, p, x, carry, *, mask=None):
         """Project the t new steps, extend the cache, attend q against the
-        full prefix.  Returns (y [b,t,n_out], new_carry)."""
+        full prefix (``sdpa_reference`` with q_offset — one SDPA
+        implementation).  Honors self.causal and key-padding masks; masked
+        positions are recorded invalid in the cache.  Returns
+        (y [b,t,n_out], new_carry)."""
+        from ...ops.attention import sdpa_reference
         q = self._heads(x, p, "Wq", "bq")                 # [b,h,t,d]
         k_new = self._heads(x, p, "Wk", "bk")
         v_new = self._heads(x, p, "Wv", "bv")
         pos = carry["pos"]
         L = self.max_cache_len
+        t = q.shape[2]
         z = jnp.zeros((), pos.dtype)   # index dtypes must match under x64
         k = jax.lax.dynamic_update_slice(
             carry["k"], k_new.astype(carry["k"].dtype), (z, z, pos, z))
         v = jax.lax.dynamic_update_slice(
             carry["v"], v_new.astype(carry["v"].dtype), (z, z, pos, z))
-        t = q.shape[2]
-        d = q.shape[-1]
-        scores = jnp.einsum("bhtd,bhld->bhtl", q, k.astype(q.dtype))
-        scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(
-            scores.dtype)
-        # key l visible to query j iff l <= pos + j (causal over the prefix)
-        l_idx = jnp.arange(L)[None, :]
-        q_idx = pos + jnp.arange(t)[:, None]
-        visible = l_idx <= q_idx                           # [t, L]
-        scores = jnp.where(visible[None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        o = jnp.einsum("bhtl,bhld->bhtd", probs.astype(q.dtype),
-                       v.astype(q.dtype))
-        b_, h, _, _ = o.shape
+        b_ = x.shape[0]
+        chunk_valid = (jnp.ones((b_, t), jnp.float32) if mask is None
+                       else mask.astype(jnp.float32))
+        m = jax.lax.dynamic_update_slice(carry["m"], chunk_valid, (z, pos))
+        written = (jnp.arange(L) < pos + t).astype(jnp.float32)   # [L]
+        key_mask = m * written[None, :]                            # [b, L]
+        o = sdpa_reference(q, k.astype(q.dtype), v.astype(q.dtype),
+                           mask=key_mask, causal=self.causal, q_offset=pos)
         o = o.transpose(0, 2, 1, 3).reshape(b_, t, -1)
         y = o @ p["Wo"]
         if self.has_bias:
             y = y + p["bo"]
-        return y, {"k": k, "v": v, "pos": pos + t}
+        if mask is not None:   # zero outputs at padded query steps
+            y = y * mask.astype(y.dtype)[:, :, None]
+        return y, {"k": k, "v": v, "m": m, "pos": pos + t}
 
     def apply_with_carry(self, variables, x, carry, *, train=False,
                          key=None, mask=None):
         if carry is None:
             carry = self.init_carry(x.shape[0], x.dtype)
-        p = variables["params"]
-        y, new_carry = self.attend_cached(p, x, carry)
+        p = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
+        y, new_carry = self.attend_cached(p, x, carry, mask=mask)
+        y = self._maybe_attn_dropout(y, train, key)
         return self.act_fn(y), new_carry
 
 
@@ -352,13 +359,19 @@ class TransformerBlock(BaseLayerConf):
                          key=None, mask=None):
         if carry is None:
             carry = self.init_carry(x.shape[0], x.dtype)
-        p = variables["params"]
+        p = self.maybe_noise_weights(key, variables["params"], train)
+        x = self.maybe_dropout_input(key, x, train)
         mha_p = {k[4:]: v for k, v in p.items() if k.startswith("mha_")}
         xn = _layer_norm(x, p["ln1_g"], p["ln1_b"], self.eps)
-        attn, new_carry = self._mha().attend_cached(mha_p, xn, carry)
+        attn, new_carry = self._mha().attend_cached(mha_p, xn, carry,
+                                                    mask=mask)
         x = x + attn
         xn = _layer_norm(x, p["ln2_g"], p["ln2_b"], self.eps)
-        ff, _ = self._ffn(p, xn)
+        ff, st = self._ffn(p, xn)
+        if st:
+            # thread the MoE aux loss out through the caller's mutable
+            # variables dict (the MLN carry path reads state after the call)
+            variables["state"] = st
         return x + ff, new_carry
 
 

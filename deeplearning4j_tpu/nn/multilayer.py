@@ -537,9 +537,12 @@ class MultiLayerNetwork:
                 "backward pass needs the full sequence (reference throws "
                 "likewise)")
         x = jnp.asarray(x)
-        # float [b, f] = one step of features; int [b, t] = token ids over
-        # time (embedding-sequence models) — already a sequence
-        squeeze = x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating)
+        # [b, f] = one feature step, squeezed to [b,1,f] — EXCEPT for
+        # embedding-sequence models, whose 2-D input is token ids [b, t]
+        from .layers.feedforward import EmbeddingSequenceLayer
+        ids_model = bool(self.layers) and isinstance(
+            self.layers[0], EmbeddingSequenceLayer)
+        squeeze = x.ndim == 2 and not ids_model
         if squeeze:
             x = x[:, None, :]
         if getattr(self, "_rnn_carries", None) is None or \
