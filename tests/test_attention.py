@@ -194,3 +194,31 @@ def test_transformer_incremental_decode_matches_full_forward():
     b = np.asarray(net.rnn_time_step(x[:, 4:]))
     np.testing.assert_allclose(np.concatenate([a, b], 1), full, rtol=2e-3,
                                atol=2e-4)
+
+
+def test_cached_attention_honors_mask_and_causal_flag():
+    """Carry-path parity with apply(): padding mask respected, causal flag
+    honored (non-causal MHA must not become causal in the cache path)."""
+    from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+    rng = np.random.default_rng(0)
+    for causal in (False, True):
+        lc = MultiHeadAttention(n_in=8, n_out=8, n_heads=2, causal=causal,
+                                attn_impl="reference", activation="identity",
+                                max_cache_len=16)
+        v = lc.init(jax.random.PRNGKey(0), None)
+        x = jnp.asarray(rng.standard_normal((3, 6, 8)), jnp.float32)
+        mask = jnp.asarray(np.array([[1, 1, 1, 1, 0, 0],
+                                     [1, 1, 1, 1, 1, 1],
+                                     [1, 1, 0, 0, 0, 0]], np.float32))
+        full, _ = lc.apply(v, x, mask=mask)
+        carry = lc.init_carry(3, jnp.float32)
+        cached, carry = lc.apply_with_carry(v, x, carry, mask=mask)
+        # parity at VALID positions; the carry path additionally zeroes
+        # padded query steps (the recurrent _mask_step convention)
+        m = np.asarray(mask)[:, :, None]
+        np.testing.assert_allclose(np.asarray(cached) * m,
+                                   np.asarray(full) * m,
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"causal={causal}")
+        np.testing.assert_allclose(np.asarray(cached) * (1 - m), 0.0)
+        assert int(carry["pos"]) == 6
