@@ -3,7 +3,7 @@ parsing + Keras model/weight mapping onto the config DSL."""
 from .hdf5 import Hdf5Dataset, Hdf5File, Hdf5FormatError, Hdf5Group
 from .hdf5_writer import Hdf5Writer, write_hdf5
 from .trainedmodels import ImageNetLabels, TrainedModels, VGG16Helper
-from .keras_export import export_keras_sequential
+from .keras_export import export_keras_model, export_keras_sequential
 from .keras import (KerasImportError, KerasModelImport, import_keras_model,
                     import_keras_sequential_model)
 
@@ -11,4 +11,4 @@ __all__ = ["Hdf5File", "Hdf5Group", "Hdf5Dataset", "Hdf5FormatError",
            "Hdf5Writer", "write_hdf5", "KerasModelImport",
            "KerasImportError", "import_keras_sequential_model",
            "import_keras_model", "ImageNetLabels", "TrainedModels",
-           "VGG16Helper", "export_keras_sequential"]
+           "VGG16Helper", "export_keras_sequential", "export_keras_model"]

@@ -198,3 +198,85 @@ def export_keras_sequential(net, path: Optional[str] = None) -> bytes:
         with open(path, "wb") as fh:
             fh.write(data)
     return data
+
+
+_EW_TO_KERAS = {"add": "Add", "subtract": "Subtract", "product": "Multiply",
+                "average": "Average", "max": "Maximum"}
+
+
+def export_keras_model(net, path: Optional[str] = None) -> bytes:
+    """Write a ComputationGraph as a Keras functional ``Model`` HDF5
+    (inverse of ``import_keras_model``).  Covers LayerVertex (with the
+    Sequential layer mappings), ElementWise merge vertices, and
+    MergeVertex → Concatenate; other vertex types raise."""
+    from ..nn.conf.computation_graph import (ElementWiseVertex, LayerVertex,
+                                             MergeVertex)
+    conf = net.conf
+    layer_entries: List[dict] = []
+    tree: Dict[str, Any] = {"model_weights": {}}
+    attrs: Dict[str, Dict[str, Any]] = {}
+    layer_names: List[str] = []
+
+    for name in conf.network_inputs:
+        idx = conf.network_inputs.index(name)
+        it = (conf.input_types[idx] if idx < len(conf.input_types) else None)
+        shape = _input_shape(it)
+        if shape is None:
+            raise ValueError(f"network input '{name}' needs an InputType "
+                             "for Keras export")
+        layer_entries.append({
+            "class_name": "InputLayer", "name": name,
+            "config": {"name": name, "batch_input_shape": shape},
+            "inbound_nodes": []})
+
+    for name in conf.topological_order:
+        v = conf.vertices[name]
+        inbound = [[[src, 0, 0, {}] for src in conf.vertex_inputs[name]]]
+        if isinstance(v, ElementWiseVertex):
+            if v.op not in _EW_TO_KERAS:
+                raise ValueError(f"vertex {name}: elementwise op '{v.op}' "
+                                 "has no Keras merge layer")
+            layer_entries.append({
+                "class_name": _EW_TO_KERAS[v.op], "name": name,
+                "config": {"name": name}, "inbound_nodes": inbound})
+            continue
+        if isinstance(v, MergeVertex):
+            layer_entries.append({
+                "class_name": "Concatenate", "name": name,
+                "config": {"name": name}, "inbound_nodes": inbound})
+            continue
+        if not isinstance(v, LayerVertex):
+            raise ValueError(
+                f"vertex {name} ({type(v).__name__}) has no Keras export "
+                "mapping")
+        itypes = conf.vertex_input_types.get(name) or [None]
+        ikind = itypes[0].kind if itypes and itypes[0] is not None else None
+        kconf, weights = _export_layer(
+            0, v.layer, net.params.get(name, {}), net.state.get(name, {}),
+            None, input_kind=ikind)
+        kconf["config"]["name"] = name
+        kconf["name"] = name
+        kconf["inbound_nodes"] = inbound
+        layer_entries.append(kconf)
+        layer_names.append(name)
+        group = {}
+        wnames = []
+        for wn, arr in weights.items():
+            group[wn] = arr
+            wnames.append(f"{name}/{wn}")
+        tree["model_weights"][name] = group
+        attrs[f"/model_weights/{name}"] = {"weight_names": wnames}
+
+    config = {"class_name": "Model", "config": {
+        "name": "model", "layers": layer_entries,
+        "input_layers": [[n, 0, 0] for n in conf.network_inputs],
+        "output_layers": [[n, 0, 0] for n in conf.network_outputs]}}
+    attrs["/"] = {"model_config": json.dumps(config),
+                  "keras_version": "2.1.6", "backend": "tensorflow"}
+    attrs["/model_weights"] = {"layer_names": layer_names,
+                               "backend": "tensorflow"}
+    data = Hdf5Writer().write(tree, attrs)
+    if path:
+        with open(path, "wb") as fh:
+            fh.write(data)
+    return data
