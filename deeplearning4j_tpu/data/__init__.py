@@ -10,6 +10,9 @@ from .records import RecordReaderMultiDataSetIterator
 from .dataset import AsyncMultiDataSetIterator
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
+from .transforms import (ComposeTransform, CutoutTransform,
+                         ImageTransform, RandomCropTransform,
+                         RandomFlipTransform, TransformingDataSetIterator)
 from .normalization import (ImagePreProcessingScaler,
                             NormalizerMinMaxScaler, NormalizerStandardize,
                             load_normalizer)
@@ -31,5 +34,7 @@ __all__ = [
     "save_dataset", "TorchDataSetIterator", "as_torch_dataset",
     "from_torch", "MultiDataSet", "RecordReaderMultiDataSetIterator",
     "NormalizerStandardize", "NormalizerMinMaxScaler",
-    "ImagePreProcessingScaler", "load_normalizer",
+    "ImagePreProcessingScaler", "load_normalizer", "ImageTransform", "RandomFlipTransform",
+    "RandomCropTransform", "CutoutTransform", "ComposeTransform",
+    "TransformingDataSetIterator",
 ]

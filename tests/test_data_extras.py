@@ -292,3 +292,47 @@ def test_log_once():
     assert warn_once(lg, "hot loop warning %d", 1)
     assert not warn_once(lg, "hot loop warning %d", 1)
     assert warn_once(lg, "different message")
+
+
+class TestImageTransforms:
+    def _batchset(self):
+        from deeplearning4j_tpu.data import DataSet, INDArrayDataSetIterator
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((12, 8, 8, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 12)]
+        return x, INDArrayDataSetIterator(x, y, batch_size=6, shuffle=False)
+
+    def test_flip_crop_cutout_compose(self):
+        from deeplearning4j_tpu.data import (ComposeTransform,
+                                             CutoutTransform,
+                                             RandomCropTransform,
+                                             RandomFlipTransform,
+                                             TransformingDataSetIterator)
+        x, it = self._batchset()
+        tf = ComposeTransform([RandomFlipTransform(p=1.0),
+                               RandomCropTransform(padding=2),
+                               CutoutTransform(size=3, p=1.0)])
+        tit = TransformingDataSetIterator(it, tf, seed=4)
+        batches = list(tit)
+        assert len(batches) == 2
+        out = np.concatenate([np.asarray(b.features) for b in batches])
+        assert out.shape == x.shape
+        assert not np.allclose(out, x)          # actually transformed
+        # every image has a zeroed cutout patch
+        assert all((np.abs(img) < 1e-12).sum() >= 9 for img in out)
+        # deterministic per epoch index
+        again = np.concatenate(
+            [np.asarray(b.features) for b in
+             TransformingDataSetIterator(self._batchset()[1], tf, seed=4)])
+        np.testing.assert_allclose(again, out)
+        # reset advances the epoch -> fresh draws
+        tit.reset()
+        fresh = np.concatenate([np.asarray(b.features) for b in tit])
+        assert not np.allclose(fresh, out)
+
+    def test_flip_only_flips_width(self):
+        from deeplearning4j_tpu.data import RandomFlipTransform
+        rng = np.random.default_rng(0)
+        x = np.arange(2 * 2 * 3 * 1, dtype=np.float32).reshape(2, 2, 3, 1)
+        out = RandomFlipTransform(p=1.0).transform(x, rng)
+        np.testing.assert_allclose(out, x[:, :, ::-1])
