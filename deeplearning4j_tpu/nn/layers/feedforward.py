@@ -8,7 +8,7 @@ DropoutLayer,EmbeddingLayer}``.  The matmul runs in the layer's dtype
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -67,13 +67,25 @@ class DenseLayer(BaseLayerConf):
 @register_serde
 @dataclass
 class OutputLayer(DenseLayer):
-    """Dense + loss head (reference ``nn/conf/layers/OutputLayer``)."""
+    """Dense + loss head (reference ``nn/conf/layers/OutputLayer``).
+    ``loss_weights`` is the reference's per-output weight vector
+    (e.g. ``LossMCXENT(weights)`` for class imbalance): the per-unit loss
+    is scaled column-wise before reduction."""
     loss: str = "mcxent"
+    loss_weights: Optional[Sequence[float]] = None
 
     def compute_loss(self, variables, x, labels, *, train=False, key=None,
                      mask=None, average=True):
         z = self.pre_output(variables, x, train=train, key=key)
         act = self.resolved("activation", "identity")
+        if self.loss_weights is not None:
+            w = jnp.asarray(self.loss_weights, z.dtype)
+            if w.shape[-1] != self.n_out:
+                raise ValueError(
+                    f"layer '{self.name}': {w.shape[-1]} loss weights for "
+                    f"{self.n_out} outputs")
+            return _losses.get(self.loss)(labels, z, act, mask,
+                                          unit_weights=w)
         return _losses.get(self.loss)(labels, z, act, mask)
 
 

@@ -51,12 +51,17 @@ def names():
     return sorted(_REGISTRY)
 
 
-def _apply_mask_and_mean(per_unit: Array, mask: Optional[Array]) -> Array:
+def _apply_mask_and_mean(per_unit: Array, mask: Optional[Array],
+                         unit_weights: Optional[Array] = None) -> Array:
     """Sum per-unit scores over feature axes, average over (masked) examples.
 
     per_unit has shape [batch, ...features]. mask broadcasts against it (e.g.
     [batch] or [batch, 1] per-example masks, or full per-unit masks).
+    unit_weights: per-output-column scaling (the reference ILossFunction
+    weights vector), broadcast over the trailing axis.
     """
+    if unit_weights is not None:
+        per_unit = per_unit * unit_weights
     if mask is not None:
         mask = mask.astype(per_unit.dtype)
         while mask.ndim < per_unit.ndim:
@@ -73,41 +78,39 @@ def _apply_mask_and_mean(per_unit: Array, mask: Optional[Array]) -> Array:
 
 @register("mse")
 @register("squared_loss")
-def mse(labels, preout, activation="identity", mask=None):
+def mse(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean((out - labels) ** 2, mask)
+    return _apply_mask_and_mean((out - labels) ** 2, mask, unit_weights)
 
 
 @register("l2")
-def l2(labels, preout, activation="identity", mask=None):
+def l2(labels, preout, activation="identity", mask=None, unit_weights=None):
     return mse(labels, preout, activation, mask)
 
 
 @register("mae")
 @register("l1")
-def mae(labels, preout, activation="identity", mask=None):
+def mae(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean(jnp.abs(out - labels), mask)
+    return _apply_mask_and_mean(jnp.abs(out - labels), mask, unit_weights)
 
 
 @register("mape")
 @register("mean_absolute_percentage_error")
-def mape(labels, preout, activation="identity", mask=None):
+def mape(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean(100.0 * jnp.abs((out - labels) / (labels + _EPS)), mask)
+    return _apply_mask_and_mean(100.0 * jnp.abs((out - labels) / (labels + _EPS)), mask, unit_weights)
 
 
 @register("msle")
 @register("mean_squared_logarithmic_error")
-def msle(labels, preout, activation="identity", mask=None):
+def msle(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean(
-        (jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS))) ** 2,
-        mask)
+    return _apply_mask_and_mean((jnp.log1p(jnp.maximum(out, -1 + _EPS)) - jnp.log1p(jnp.maximum(labels, -1 + _EPS))) ** 2, mask, unit_weights)
 
 
 @register("xent")
-def xent(labels, preout, activation="sigmoid", mask=None):
+def xent(labels, preout, activation="sigmoid", mask=None, unit_weights=None):
     """Binary cross-entropy. Fused stable form when activation is sigmoid."""
     if (isinstance(activation, str) and activation.lower() == "sigmoid"):
         # log(1+exp(-|x|)) formulation
@@ -115,12 +118,12 @@ def xent(labels, preout, activation="sigmoid", mask=None):
     else:
         out = jnp.clip(activations.get(activation)(preout), _EPS, 1 - _EPS)
         per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
-    return _apply_mask_and_mean(per, mask)
+    return _apply_mask_and_mean(per, mask, unit_weights)
 
 
 @register("mcxent")
 @register("negativeloglikelihood")
-def mcxent(labels, preout, activation="softmax", mask=None):
+def mcxent(labels, preout, activation="softmax", mask=None, unit_weights=None):
     """Multi-class cross-entropy; fused log-softmax when activation is softmax."""
     if isinstance(activation, str) and activation.lower() == "softmax":
         logp = jax.nn.log_softmax(preout, axis=-1)
@@ -128,62 +131,62 @@ def mcxent(labels, preout, activation="softmax", mask=None):
     else:
         out = jnp.clip(activations.get(activation)(preout), _EPS, 1.0)
         per = -(labels * jnp.log(out))
-    return _apply_mask_and_mean(per, mask)
+    return _apply_mask_and_mean(per, mask, unit_weights)
 
 
 @register("sparse_mcxent")
-def sparse_mcxent(labels, preout, activation="softmax", mask=None):
+def sparse_mcxent(labels, preout, activation="softmax", mask=None, unit_weights=None):
     """labels are integer class indices [batch, ...]."""
     logp = jax.nn.log_softmax(preout, axis=-1)
     per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    return _apply_mask_and_mean(per[..., None], mask)
+    return _apply_mask_and_mean(per[..., None], mask, unit_weights)
 
 
 @register("hinge")
-def hinge(labels, preout, activation="identity", mask=None):
+def hinge(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
     # labels in {-1, +1} (reference converts 0/1)
     lab = jnp.where(labels > 0, 1.0, -1.0)
-    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out), mask)
+    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out), mask, unit_weights)
 
 
 @register("squared_hinge")
-def squared_hinge(labels, preout, activation="identity", mask=None):
+def squared_hinge(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
     lab = jnp.where(labels > 0, 1.0, -1.0)
-    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out) ** 2, mask)
+    return _apply_mask_and_mean(jnp.maximum(0.0, 1.0 - lab * out) ** 2, mask, unit_weights)
 
 
 @register("kl_divergence")
 @register("kld")
-def kld(labels, preout, activation="softmax", mask=None):
+def kld(labels, preout, activation="softmax", mask=None, unit_weights=None):
     out = jnp.clip(activations.get(activation)(preout), _EPS, 1.0)
     lab = jnp.clip(labels, _EPS, 1.0)
-    return _apply_mask_and_mean(lab * (jnp.log(lab) - jnp.log(out)), mask)
+    return _apply_mask_and_mean(lab * (jnp.log(lab) - jnp.log(out)), mask, unit_weights)
 
 
 @register("poisson")
-def poisson(labels, preout, activation="identity", mask=None):
+def poisson(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask)
+    return _apply_mask_and_mean(out - labels * jnp.log(jnp.maximum(out, _EPS)), mask, unit_weights)
 
 
 @register("cosine_proximity")
-def cosine_proximity(labels, preout, activation="identity", mask=None):
+def cosine_proximity(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
     num = jnp.sum(labels * out, axis=-1)
     den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + _EPS
-    return _apply_mask_and_mean((-num / den)[..., None], mask)
+    return _apply_mask_and_mean((-num / den)[..., None], mask, unit_weights)
 
 
 @register("wasserstein")
-def wasserstein(labels, preout, activation="identity", mask=None):
+def wasserstein(labels, preout, activation="identity", mask=None, unit_weights=None):
     out = activations.get(activation)(preout)
-    return _apply_mask_and_mean(labels * out, mask)
+    return _apply_mask_and_mean(labels * out, mask, unit_weights)
 
 
 @register("fmeasure")
-def fmeasure(labels, preout, activation="sigmoid", mask=None):
+def fmeasure(labels, preout, activation="sigmoid", mask=None, unit_weights=None):
     """Differentiable soft-F_beta loss (beta=1), reference LossFMeasure."""
     out = activations.get(activation)(preout)
     tp = jnp.sum(labels * out)

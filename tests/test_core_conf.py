@@ -161,3 +161,33 @@ def test_global_defaults_reach_wrapped_layers():
     assert bi.fwd.l2 == 0.5 and bi.fwd.weight_init == "uniform"
     assert lts.underlying.l2 == 0.5
     assert out.l2 == 0.5
+
+
+def test_loss_weights_scale_per_class():
+    """Per-output loss weights (reference LossMCXENT(weights))."""
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    import pytest
+
+    def net(w=None):
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(learning_rate=0.1)).list()
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent", loss_weights=w))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    base = net().score(x=x, y=y)
+    doubled = net([2.0, 2.0, 2.0]).score(x=x, y=y)
+    assert doubled == pytest.approx(2 * base, rel=1e-5)
+    # mismatched width fails fast
+    with pytest.raises(ValueError, match="loss weights"):
+        net([1.0, 2.0]).score(x=x, y=y)
