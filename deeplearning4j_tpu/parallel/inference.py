@@ -57,6 +57,13 @@ class ParallelInference:
                  max_batch_size: int = 32, queue_limit: int = 256,
                  nano_wait: float = 0.002,
                  batch_buckets: Optional[Sequence[int]] = None):
+        if inference_mode not in (InferenceMode.INPLACE,
+                                  InferenceMode.BATCHED):
+            raise ValueError(
+                f"unknown inference_mode '{inference_mode}'; expected "
+                f"'{InferenceMode.INPLACE}' or '{InferenceMode.BATCHED}' "
+                "(an unrecognized mode would queue requests with no "
+                "dispatcher and hang)")
         self.model = model
         self.mode = inference_mode
         self.max_batch_size = max_batch_size

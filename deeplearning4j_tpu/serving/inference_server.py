@@ -4,6 +4,7 @@ replaced by a plain HTTP predict endpoint over :class:`ParallelInference`).
 
 Endpoints:
   POST /predict  {"data": [[...], ...]}  -> {"output": [[...], ...]}
+  POST /reload   {"path": "model.zip"}   -> hot-swap the served model
   GET  /health
 """
 from __future__ import annotations
@@ -26,7 +27,15 @@ class _PredictHandler(JsonHandler):
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
-        if self.path.rstrip("/") != "/predict":
+        route = self.path.rstrip("/")
+        if route == "/reload":
+            try:
+                body = self._read_json()
+                self.server_ref.reload(body["path"])
+            except Exception as e:
+                return self._json({"error": str(e)}, 400)
+            return self._json({"ok": True})
+        if route != "/predict":
             return self._json({"error": "not found"}, 404)
         try:
             x = np.asarray(self._read_json()["data"], dtype=np.float32)
@@ -45,10 +54,23 @@ class InferenceServer:
     def __init__(self, model, port: int = 0,
                  inference_mode: str = InferenceMode.BATCHED,
                  max_batch_size: int = 32):
+        self._mode = inference_mode
+        self._max_batch = max_batch_size
         self.inference = ParallelInference(model, inference_mode,
                                            max_batch_size=max_batch_size)
         self._server = BackgroundHttpServer(_PredictHandler, port,
                                             server_ref=self)
+
+    def reload(self, path: str) -> None:
+        """Hot-swap the served model from a checkpoint zip (the rolling
+        model-update story: new requests hit the new model, the old
+        batcher drains first)."""
+        from ..utils.model_serializer import restore_model
+        new_model = restore_model(path)
+        old = self.inference
+        self.inference = ParallelInference(new_model, self._mode,
+                                           max_batch_size=self._max_batch)
+        old.shutdown()
 
     @property
     def port(self) -> int:

@@ -119,10 +119,46 @@ class TestInferenceServer:
     def test_predict_roundtrip(self, iris_net):
         server = InferenceServer(iris_net).start()
         try:
-            client = InferenceClient(f"http://127.0.0.1:{server.port}")
+            client = InferenceClient(f"http://127.0.0.1:{server.port}", timeout=60)
             x = np.random.default_rng(4).standard_normal((4, 4)).astype(np.float32)
             out = client.predict(x)
             np.testing.assert_allclose(out, np.asarray(iris_net.output(x)),
                                        rtol=1e-4, atol=1e-5)
         finally:
             server.stop()
+
+
+def test_inference_server_hot_reload(tmp_path):
+    """POST /reload swaps the served model from a checkpoint zip."""
+    from deeplearning4j_tpu.serving.inference_server import (InferenceClient,
+                                                             InferenceServer)
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+    def _small_net(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    net_a = _small_net(seed=1)
+    net_b = _small_net(seed=99)
+    write_model(net_b, tmp_path / "b.zip")
+    server = InferenceServer(net_a, inference_mode="INPLACE").start()
+    try:
+        client = InferenceClient(f"http://127.0.0.1:{server.port}", timeout=60)
+        x = np.ones((2, 4), np.float32)
+        before = client.predict(x)
+        client.post("/reload", {"path": str(tmp_path / "b.zip")})
+        after = client.predict(x)
+        assert not np.allclose(before, after)   # different params serve now
+        np.testing.assert_allclose(after, np.asarray(net_b.output(x)),
+                                   rtol=1e-5)
+        # bad path is a 400-class error, server stays up
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            client.post("/reload", {"path": "/nonexistent.zip"})
+        np.testing.assert_allclose(client.predict(x), after, rtol=1e-5)
+    finally:
+        server.stop()
