@@ -283,3 +283,36 @@ def test_graph_evaluate_variants():
     assert roc.calculate_auc() > 0.9
     reg = net.evaluate_regression(x, y)
     assert reg.average_mean_squared_error() < 0.2
+
+
+def test_graph_bf16_and_remat():
+    """CG under compute_dtype bfloat16 + cache_mode remat: trains, masters
+    stay f32 (mixed precision plumbing on the graph path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    g = GraphBuilder({"updater": Adam(learning_rate=0.05),
+                      "compute_dtype": "bfloat16", "cache_mode": "remat"})
+    g.add_inputs("in").set_input_types(InputType.feed_forward(4))
+    g.add_layer("h", DenseLayer(n_out=8, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "h")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    s0 = None
+    for _ in range(15):
+        net.fit([x], [y])
+        if s0 is None:
+            s0 = net.get_score()
+    assert net.get_score() < s0
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert leaf.dtype == jnp.float32
