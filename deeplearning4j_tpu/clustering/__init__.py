@@ -6,6 +6,17 @@ from .kmeans import ClusterSet, KMeans
 from .neighbors import BruteForceNN, KDTree, VPTree, pairwise_distance
 from .sptree import SPTree
 from .tsne import BarnesHutTsne, Tsne
+from .algorithm import (BaseClusteringAlgorithm, ClusteringOptimizationType,
+                        ClusterSetInfo, ConvergenceCondition,
+                        FixedClusterCountStrategy,
+                        FixedIterationCountCondition, IterationHistory,
+                        IterationInfo, KMeansClustering, OptimisationStrategy,
+                        VarianceVariationCondition)
 
 __all__ = ["KMeans", "ClusterSet", "BruteForceNN", "VPTree", "KDTree",
-           "pairwise_distance", "SPTree", "Tsne", "BarnesHutTsne"]
+           "pairwise_distance", "SPTree", "Tsne", "BarnesHutTsne",
+           "BaseClusteringAlgorithm", "ClusteringOptimizationType",
+           "ClusterSetInfo", "ConvergenceCondition",
+           "FixedClusterCountStrategy", "FixedIterationCountCondition",
+           "IterationHistory", "IterationInfo", "KMeansClustering",
+           "OptimisationStrategy", "VarianceVariationCondition"]

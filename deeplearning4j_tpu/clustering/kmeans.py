@@ -21,24 +21,50 @@ import numpy as np
 
 from .neighbors import pairwise_distance
 
-__all__ = ["KMeans", "ClusterSet"]
+__all__ = ["KMeans", "ClusterSet", "kmeanspp_init"]
+
+
+def _assign_refresh(points, centers, metric: str):
+    """Shared Lloyd core (used by KMeans and the strategy framework in
+    ``algorithm.py``): distance Gram matrix, argmin assignment, one-hot,
+    counts, refreshed centers (old center kept where a cluster went empty)."""
+    d = pairwise_distance(points, centers, metric)          # [N,K]
+    assign = jnp.argmin(d, axis=1)                          # [N]
+    one_hot = jax.nn.one_hot(assign, centers.shape[0],
+                             dtype=points.dtype)            # [N,K]
+    counts = one_hot.sum(axis=0)                            # [K]
+    sums = one_hot.T @ points                               # [K,D]  (MXU)
+    new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+    new_centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+    return d, assign, one_hot, counts, new_centers
 
 
 @functools.partial(jax.jit, static_argnames=("metric",))
 def _lloyd_step(points, centers, metric: str):
-    d = pairwise_distance(points, centers, metric)          # [N,K]
-    assign = jnp.argmin(d, axis=1)                          # [N]
-    k = centers.shape[0]
-    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # [N,K]
-    counts = one_hot.sum(axis=0)                            # [K]
-    sums = one_hot.T @ points                               # [K,D]  (MXU)
-    new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
-    # keep old center where a cluster went empty
-    new_centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+    d, assign, _, counts, new_centers = _assign_refresh(points, centers, metric)
     cost = jnp.sum(jnp.min(d, axis=1))
     # farthest point from its own centroid (used for empty-cluster reseed)
     far = jnp.argmax(jnp.min(d, axis=1))
     return new_centers, assign, counts, cost, far
+
+
+def kmeanspp_init(points: np.ndarray, k: int, rng,
+                  metric: str = "euclidean") -> np.ndarray:
+    """Distance-weighted (k-means++) seeding: each next center is sampled with
+    probability proportional to its squared distance from the nearest chosen
+    center (the reference's initClusters loop,
+    ``clustering/algorithm/BaseClusteringAlgorithm.java:145-160``)."""
+    n = len(points)
+    centers = [points[rng.integers(n)]]
+    d2 = None
+    for _ in range(1, min(k, n)):
+        cur = np.asarray(pairwise_distance(
+            jnp.asarray(points), jnp.asarray(np.stack(centers[-1:])),
+            metric))[:, 0] ** 2
+        d2 = cur if d2 is None else np.minimum(d2, cur)
+        probs = d2 / max(d2.sum(), 1e-12)
+        centers.append(points[rng.choice(n, p=probs)])
+    return np.stack(centers)
 
 
 @dataclass
@@ -69,19 +95,9 @@ class KMeans:
         self.init = init
 
     def _init_centers(self, points: np.ndarray, rng) -> np.ndarray:
-        n = len(points)
         if self.init == "random":
-            return points[rng.choice(n, self.k, replace=False)]
-        # k-means++: iteratively sample proportional to squared distance
-        centers = [points[rng.integers(n)]]
-        d2 = None
-        for _ in range(1, self.k):
-            cur = np.asarray(pairwise_distance(
-                jnp.asarray(points), jnp.asarray(centers[-1:]), self.metric))[:, 0] ** 2
-            d2 = cur if d2 is None else np.minimum(d2, cur)
-            probs = d2 / max(d2.sum(), 1e-12)
-            centers.append(points[rng.choice(n, p=probs)])
-        return np.stack(centers)
+            return points[rng.choice(len(points), self.k, replace=False)]
+        return kmeanspp_init(points, self.k, rng, self.metric)
 
     def fit(self, points) -> ClusterSet:
         points_np = np.asarray(points, dtype=np.float32)

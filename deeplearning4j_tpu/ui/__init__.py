@@ -3,6 +3,8 @@
 from .components import (ChartHistogram, ChartLine, ChartScatter,
                          ComponentTable, ComponentText, render_page)
 from .connection import UiConnectionInfo
+from .renders import (coords_to_csv_lines, embedding_coords,
+                      render_word_scatter, upload_tsne)
 from .server import RemoteUIStatsStorageRouter, UIServer
 from .stats import StatsListener, StatsReport, array_stats
 from .storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
@@ -11,4 +13,5 @@ __all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
            "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
            "RemoteUIStatsStorageRouter", "UiConnectionInfo", "ChartLine",
            "ChartScatter", "ChartHistogram", "ComponentTable",
-           "ComponentText", "render_page"]
+           "ComponentText", "render_page", "embedding_coords",
+           "coords_to_csv_lines", "render_word_scatter", "upload_tsne"]

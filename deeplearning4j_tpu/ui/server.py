@@ -13,11 +13,17 @@ Endpoints:
   GET  /train/<sid>/model     JSON per-parameter stats of the latest record
   GET  /train/<sid>/system    JSON memory series
   POST /remote                accept a posted StatsReport JSON (remote router)
+  GET  /tsne                  embedding scatter page (``ui/module/tsne/TsneModule.java``)
+  GET  /tsne/sessions         JSON list of uploaded coordinate sets
+  GET  /tsne/coords/<sid>     JSON list of "x,y,label" lines
+  POST /tsne/upload           upload coords CSV (body = text, one point/line)
+  POST /tsne/post/<sid>       same, stored under an explicit session id
 """
 from __future__ import annotations
 
 import json
 from typing import Optional
+from urllib.parse import unquote
 from urllib.request import Request, urlopen
 
 from ..utils.http import BackgroundHttpServer, JsonHandler
@@ -62,20 +68,60 @@ async function refresh(){
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
 
+_TSNE_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>dl4j-tpu embedding viewer</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+#plot{background:#fff;border:1px solid #ddd}</style></head><body>
+<h2>Embedding scatter (t-SNE)</h2>
+<div>session: <select id="sess"></select></div>
+<canvas id="plot" width="900" height="700"></canvas>
+<script>
+async function sessions(){const ss=await (await fetch('/tsne/sessions')).json();
+ const sel=document.getElementById('sess');sel.innerHTML='';
+ ss.forEach(s=>{const o=document.createElement('option');o.value=o.text=s;sel.add(o);});
+ if(ss.length)draw(sel.value);}
+async function draw(sid){const lines=await (await fetch('/tsne/coords/'+sid)).json();
+ const pts=lines.map(l=>l.split(',')).filter(p=>p.length>=2)
+   .map(p=>({x:+p[0],y:+p[1],l:p[2]||''}));
+ if(!pts.length)return;const c=document.getElementById('plot'),x=c.getContext('2d');
+ x.clearRect(0,0,c.width,c.height);
+ const xs=pts.map(p=>p.x),ys=pts.map(p=>p.y);
+ const mx=Math.min(...xs),Mx=Math.max(...xs),my=Math.min(...ys),My=Math.max(...ys);
+ pts.forEach(p=>{const px=20+(p.x-mx)/((Mx-mx)||1)*(c.width-40),
+  py=20+(p.y-my)/((My-my)||1)*(c.height-40);
+  x.fillStyle='#1565c0';x.beginPath();x.arc(px,py,2,0,6.3);x.fill();
+  if(p.l){x.fillStyle='#333';x.fillText(p.l,px+3,py-3);}});}
+document.getElementById('sess').addEventListener('change',e=>draw(e.target.value));
+sessions();
+</script></body></html>"""
+
+_UPLOADED_FILE = "UploadedFile"
+
 
 class _Handler(JsonHandler):
-    storage: StatsStorage = None  # set by UIServer
+    storage: StatsStorage = None   # set by UIServer
+    tsne_sessions: dict = None     # sid -> list[str] coordinate lines
+
+    def _html(self, page: str):
+        data = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_GET(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         if not parts:
-            page = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(page)))
-            self.end_headers()
-            self.wfile.write(page)
-            return
+            return self._html(_PAGE)
+        if parts[0] == "tsne":
+            if len(parts) == 1:
+                return self._html(_TSNE_PAGE)
+            if parts[1] == "sessions":
+                return self._json(sorted(self.tsne_sessions))
+            if parts[1] == "coords" and len(parts) == 3:
+                return self._json(self.tsne_sessions.get(unquote(parts[2]), []))
+            return self._json({"error": "not found"}, 404)
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         if len(parts) == 2 and parts[1] == "sessions":
@@ -103,6 +149,18 @@ class _Handler(JsonHandler):
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if parts and parts[0] == "tsne":
+            n = int(self.headers.get("Content-Length", 0))
+            text = self.rfile.read(n).decode("utf-8", errors="replace")
+            lines = [ln.strip() for ln in text.splitlines() if ln.strip()]
+            if len(parts) == 2 and parts[1] == "upload":
+                self.tsne_sessions[_UPLOADED_FILE] = lines
+            elif len(parts) == 3 and parts[1] == "post":
+                self.tsne_sessions[unquote(parts[2])] = lines
+            else:
+                return self._json({"error": "not found"}, 404)
+            return self._json({"ok": True, "points": len(lines)})
         if self.path.rstrip("/") != "/remote":
             return self._json({"error": "not found"}, 404)
         try:
@@ -119,7 +177,8 @@ class UIServer:
 
     def __init__(self, port: int = 0):
         self._server = BackgroundHttpServer(_Handler, port,
-                                            storage=InMemoryStatsStorage())
+                                            storage=InMemoryStatsStorage(),
+                                            tsne_sessions={})
         self._handler = self._server.httpd.RequestHandlerClass
 
     @property

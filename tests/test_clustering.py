@@ -132,3 +132,69 @@ class TestTsne:
         dmin = min(np.linalg.norm(cents[a] - cents[b])
                    for a in range(3) for b in range(a + 1, 3))
         assert dmin > 1.5 * spread
+
+
+class TestClusteringFramework:
+    """Strategy/condition machinery (reference
+    clustering/algorithm/BaseClusteringAlgorithm.java)."""
+
+    def test_fixed_count_strategy_converges_on_blobs(self):
+        from deeplearning4j_tpu.clustering import KMeansClustering
+        pts, labels = _blobs(n_per=40)
+        cs = KMeansClustering.setup(3, max_iterations=50, seed=0).apply_to(pts)
+        assert cs.centers.shape == (3, 2)
+        # each blob maps to exactly one cluster
+        found = {tuple(np.bincount(cs.assignments[labels == c], minlength=3))
+                 for c in range(3)}
+        for counts in found:
+            assert max(counts) == 40
+
+    def test_convergence_condition_stops_early(self):
+        from deeplearning4j_tpu.clustering import KMeansClustering
+        pts, _ = _blobs(n_per=40)
+        algo = KMeansClustering.setup_with_convergence(3, rate=0.01, seed=0)
+        cs = algo.apply_to(pts)
+        assert cs.iterations < 50
+        assert algo.history.iteration_count == cs.iterations
+
+    def test_variance_variation_condition(self):
+        from deeplearning4j_tpu.clustering import (
+            BaseClusteringAlgorithm, FixedClusterCountStrategy,
+            VarianceVariationCondition)
+        pts, _ = _blobs(n_per=30)
+        strat = FixedClusterCountStrategy.setup(3)
+        strat.termination_condition = \
+            VarianceVariationCondition.variance_variation_less_than(0.05, 2)
+        algo = BaseClusteringAlgorithm.setup(strat, seed=1)
+        cs = algo.apply_to(pts)
+        assert cs.centers.shape[0] == 3
+        assert cs.iterations <= algo.max_iterations
+
+    def test_optimisation_strategy_splits_spread_clusters(self):
+        from deeplearning4j_tpu.clustering import (
+            BaseClusteringAlgorithm, ClusteringOptimizationType,
+            OptimisationStrategy)
+        pts, _ = _blobs(n_per=40)  # 3 well-separated blobs
+        # start with k=1; max point-to-center threshold forces splits
+        strat = (OptimisationStrategy.setup(1)
+                 .optimize(ClusteringOptimizationType
+                           .MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE, 6.0))
+        strat.end_when_distribution_variation_rate_less_than(0.001)
+        algo = BaseClusteringAlgorithm.setup(strat, seed=0, max_iterations=30)
+        cs = algo.apply_to(pts)
+        assert cs.centers.shape[0] >= 3  # split its way up from one cluster
+        info = algo.history.most_recent().cluster_set_info
+        assert (info.max_distance[info.counts > 0] <= 6.5).all()
+
+    def test_point_count_optimization(self):
+        from deeplearning4j_tpu.clustering import (
+            BaseClusteringAlgorithm, ClusteringOptimizationType,
+            OptimisationStrategy)
+        pts, _ = _blobs(n_per=40)
+        strat = (OptimisationStrategy.setup(2)
+                 .optimize(ClusteringOptimizationType
+                           .MINIMIZE_PER_CLUSTER_POINT_COUNT, 50))
+        strat.end_when_iteration_count_equals(25)
+        cs = BaseClusteringAlgorithm.setup(strat, seed=0,
+                                           max_iterations=25).apply_to(pts)
+        assert cs.centers.shape[0] > 2

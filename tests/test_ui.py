@@ -252,3 +252,53 @@ def test_convolutional_iteration_listener(tmp_path):
     assert len(files) == 2
     content = files[0].read_text()
     assert "<svg" in content and "rect" in content
+
+
+def test_tsne_module_upload_and_coords():
+    """/tsne endpoints (reference ui/module/tsne/TsneModule.java)."""
+    from deeplearning4j_tpu.ui import upload_tsne, coords_to_csv_lines
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        coords = np.array([[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]])
+        upload_tsne(base, coords, labels=["a", "b", "c"])
+        sessions = json.load(urllib.request.urlopen(f"{base}/tsne/sessions"))
+        assert sessions == ["UploadedFile"]
+        lines = json.load(
+            urllib.request.urlopen(f"{base}/tsne/coords/UploadedFile"))
+        assert lines == coords_to_csv_lines(coords, ["a", "b", "c"])
+        assert lines[0] == "0,1,a"
+        # explicit session id
+        upload_tsne(base, coords[:2], session_id="run7")
+        sessions = json.load(urllib.request.urlopen(f"{base}/tsne/sessions"))
+        assert "run7" in sessions
+        html = urllib.request.urlopen(f"{base}/tsne").read().decode()
+        assert "Embedding scatter" in html
+    finally:
+        server.stop()
+
+
+def test_embedding_coords_and_word_scatter(tmp_path):
+    from deeplearning4j_tpu.ui import embedding_coords, render_word_scatter
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((20, 16))
+    pca = embedding_coords(vecs, method="pca")
+    assert pca.shape == (20, 2)
+    # PCA projection preserves the top-2 covariance directions: reconstruct
+    # variance ordering
+    assert pca[:, 0].var() >= pca[:, 1].var()
+    ts = embedding_coords(vecs[:12], method="tsne", max_iter=50)
+    assert ts.shape == (12, 2)
+
+    class _WV:  # minimal WordVectors-protocol stub
+        class vocab:
+            @staticmethod
+            def words():
+                return [f"w{i}" for i in range(20)]
+        @staticmethod
+        def get_word_vector(w):
+            return vecs[int(w[1:])]
+
+    out = tmp_path / "words.html"
+    html = render_word_scatter(_WV(), path=str(out))
+    assert "svg" in html and out.exists()
