@@ -271,7 +271,9 @@ class BaseClusteringAlgorithm:
              far_idx) = _classify_and_refresh(
                 pts, jnp.asarray(centers), prev_assign, strat.metric)
             prev_assign = assign
-            centers = np.asarray(c_new)
+            # np.array (copy): _apply_strategy writes into this buffer, and
+            # np.asarray on a device array yields a read-only view
+            centers = np.array(c_new)
             info = ClusterSetInfo(np.asarray(counts), np.asarray(avg_d),
                                   np.asarray(max_d), np.asarray(var_d),
                                   float(cost), int(n_changed))
@@ -328,7 +330,6 @@ class BaseClusteringAlgorithm:
         farthest member as a new center (ClusterUtils.applyOptimization)."""
         strat: OptimisationStrategy = self.strategy  # type: ignore
         t, v = strat.optimization_type, strat.optimization_value
-        cnt = np.maximum(info.counts, 1.0)
         if t is ClusteringOptimizationType.MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE:
             bad = info.avg_distance > v
         elif t is ClusteringOptimizationType.MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE:

@@ -62,8 +62,11 @@ def kmeanspp_init(points: np.ndarray, k: int, rng,
             jnp.asarray(points), jnp.asarray(np.stack(centers[-1:])),
             metric))[:, 0] ** 2
         d2 = cur if d2 is None else np.minimum(d2, cur)
-        probs = d2 / max(d2.sum(), 1e-12)
-        centers.append(points[rng.choice(n, p=probs)])
+        total = d2.sum()
+        if total <= 0.0:  # all points coincide with a center: uniform pick
+            centers.append(points[rng.integers(n)])
+            continue
+        centers.append(points[rng.choice(n, p=d2 / total)])
     return np.stack(centers)
 
 

@@ -9,12 +9,15 @@ from .terminations import (BestScoreEpochTerminationCondition,
                            MaxScoreIterationTerminationCondition,
                            MaxTimeIterationTerminationCondition,
                            ScoreImprovementEpochTerminationCondition)
-from .trainer import EarlyStoppingTrainer
+from .trainer import (EarlyStoppingGraphTrainer, EarlyStoppingMasterTrainer,
+                      EarlyStoppingParallelTrainer, EarlyStoppingTrainer)
 
 __all__ = [
     "AccuracyScoreCalculator", "BestScoreEpochTerminationCondition",
     "DataSetLossCalculator", "EarlyStoppingConfiguration",
-    "EarlyStoppingResult", "EarlyStoppingTrainer", "InMemoryModelSaver",
+    "EarlyStoppingResult", "EarlyStoppingTrainer", "EarlyStoppingGraphTrainer",
+    "EarlyStoppingMasterTrainer", "EarlyStoppingParallelTrainer",
+    "InMemoryModelSaver",
     "InvalidScoreIterationTerminationCondition", "LocalFileModelSaver",
     "MaxEpochsTerminationCondition", "MaxScoreIterationTerminationCondition",
     "MaxTimeIterationTerminationCondition",

@@ -19,6 +19,20 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_iterator = train_iterator
 
+    def _fit_epoch(self):
+        """One training epoch; returns the iteration-termination condition
+        that fired, or None.  Overridden by the master-driven variant."""
+        conf = self.config
+        if hasattr(self.train_iterator, "reset"):
+            self.train_iterator.reset()
+        for batch in self.train_iterator:
+            # fit_batch: no epoch bookkeeping — this loop owns epochs
+            last = self.net.fit_batch(batch)
+            for c in conf.iteration_terminations:
+                if c.terminate(last):
+                    return c
+        return None
+
     def fit(self) -> EarlyStoppingResult:
         conf = self.config
         for c in conf.epoch_terminations:
@@ -37,18 +51,7 @@ class EarlyStoppingTrainer:
 
         while True:
             # ---- one epoch, with iteration-level termination checks -------
-            it_terminated = None
-            if hasattr(self.train_iterator, "reset"):
-                self.train_iterator.reset()
-            for batch in self.train_iterator:
-                # fit_batch: no epoch bookkeeping — this loop owns epochs
-                last = self.net.fit_batch(batch)
-                for c in conf.iteration_terminations:
-                    if c.terminate(last):
-                        it_terminated = c
-                        break
-                if it_terminated:
-                    break
+            it_terminated = self._fit_epoch()
             if it_terminated is not None:
                 details = type(it_terminated).__name__
                 log.info("early stopping: iteration termination %s", details)
@@ -110,3 +113,21 @@ EarlyStoppingGraphTrainer = EarlyStoppingTrainer
 # loop driving a ParallelWrapper — the wrapper duck-types the model surface
 # (fit_batch/get_score/params/init), so no separate implementation needed.
 EarlyStoppingParallelTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingMasterTrainer(EarlyStoppingTrainer):
+    """Early stopping where each epoch is one TrainingMaster pass over the
+    data (reference ``spark/earlystopping/SparkEarlyStoppingTrainer`` /
+    ``BaseSparkEarlyStoppingTrainer``: fit one RDD pass per epoch, score on
+    the driver).  Iteration-level terminations don't apply — the master owns
+    the inner loop, as the Spark workers do in the reference."""
+
+    def __init__(self, config, net, master, train_iterator):
+        super().__init__(config, net, train_iterator)
+        self.master = master
+
+    def _fit_epoch(self):
+        if hasattr(self.train_iterator, "reset"):
+            self.train_iterator.reset()
+        self.master.fit(self.net, self.train_iterator)
+        return None

@@ -92,10 +92,99 @@ def _chunk_batches(iterator, n_workers: int) -> List[List[Any]]:
 
 
 class TrainingMaster:
-    """fit(model, iterator) contract (reference ``TrainingMaster.java:28``)."""
+    """fit(model, iterator) contract (reference ``TrainingMaster.java:28``),
+    plus the distributed evaluation/scoring surface the reference exposes on
+    the Spark facades (``SparkDl4jMultiLayer.evaluate`` map-partitions +
+    ``IEvaluation.merge`` reduce; ``calculateScore`` :~ sum/average loss)."""
+
+    num_workers: int = 2
 
     def fit(self, model, iterator) -> None:
         raise NotImplementedError
+
+    def _fan_out(self, model, iterator, num_workers: Optional[int],
+                 per_batch: Callable[[Any, Any, int], None]) -> int:
+        """Shared map scaffolding for the evaluation/scoring surface: chunk
+        batches over worker threads, give each a model replica (the
+        broadcast), run ``per_batch(replica, batch, worker)`` on its share,
+        re-raise the first worker error.  Returns the worker count used."""
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        parts = [p for p in _chunk_batches(
+            iterator, num_workers or self.num_workers) if p]
+        if not parts:
+            return 0
+        replicas = [model] + [model.clone() for _ in range(len(parts) - 1)]
+        errors: List[Exception] = []
+
+        def work(w):
+            try:
+                for batch in parts[w]:
+                    per_batch(replicas[w], batch, w)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(w,))
+                   for w in range(len(parts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return len(parts)
+
+    def evaluate(self, model, iterator, eval_factory=None,
+                 num_workers: Optional[int] = None):
+        """Distributed evaluation: batches fan out over worker threads, each
+        holding a model replica and a partial IEvaluation; partials merge at
+        the end.  ``eval_factory`` picks the evaluation type (Evaluation by
+        default — pass e.g. ``RegressionEvaluation`` or
+        ``lambda: ROC(threshold_steps=30)``)."""
+        from ..evaluation.classification import Evaluation
+        n_max = num_workers or self.num_workers
+        evals = [(eval_factory or Evaluation)() for _ in range(n_max)]
+
+        def per_batch(replica, batch, w):
+            x, y, _, lm = replica._normalize_batch(batch)
+            if isinstance(x, list):  # ComputationGraph batch
+                out = replica.output(*x)
+                out = out[0] if isinstance(out, (list, tuple)) else out
+                y0 = y[0] if isinstance(y, (list, tuple)) else y
+                lm0 = lm[0] if isinstance(lm, (list, tuple)) else lm
+            else:
+                out, y0, lm0 = replica.output(x), y, lm
+            evals[w].eval(np.asarray(y0), np.asarray(out),
+                          mask=None if lm0 is None else np.asarray(lm0))
+
+        used = self._fan_out(model, iterator, num_workers, per_batch)
+        merged = evals[0]
+        for ev in evals[1:used]:
+            merged.merge(ev)
+        return merged
+
+    def score(self, model, iterator, average: bool = True,
+              num_workers: Optional[int] = None) -> float:
+        """Distributed loss over the dataset (reference
+        ``SparkDl4jMultiLayer.calculateScore``: per-partition loss sums,
+        reduced; ``average`` divides by the example count)."""
+        n_max = num_workers or self.num_workers
+        totals, counts = [0.0] * n_max, [0] * n_max
+
+        def per_batch(replica, batch, w):
+            x, y, _, _ = replica._normalize_batch(batch)
+            if isinstance(x, list):
+                s = replica.score(inputs=x, labels=y)
+                bs = int(np.asarray(x[0]).shape[0])
+            else:
+                s = replica.score(x=x, y=y)
+                bs = int(np.asarray(x).shape[0])
+            totals[w] += s * bs
+            counts[w] += bs
+
+        self._fan_out(model, iterator, num_workers, per_batch)
+        total, n = sum(totals), sum(counts)
+        return total / max(n, 1) if average else total
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):

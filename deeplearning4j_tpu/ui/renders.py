@@ -64,8 +64,6 @@ def render_word_scatter(word_vectors, words: Optional[Sequence[str]] = None,
     html = render_page(
         [ComponentText(f"{len(vocab_words)} words, method={method}"), chart],
         title=title)
-    # labels as a plain table appendix (SVG text at every point is unreadable
-    # for big vocabs; the interactive /tsne page handles hover-scale instead)
     if path is not None:
         with open(path, "w") as f:
             f.write(html)

@@ -198,3 +198,20 @@ class TestClusteringFramework:
         cs = BaseClusteringAlgorithm.setup(strat, seed=0,
                                            max_iterations=25).apply_to(pts)
         assert cs.centers.shape[0] > 2
+
+    def test_empty_cluster_reseed_and_duplicate_points(self):
+        """Regression: reseeding writes into a copied buffer (device arrays
+        are read-only) and k-means++ handles duplicate-heavy data."""
+        from deeplearning4j_tpu.clustering import KMeansClustering
+        rng = np.random.default_rng(0)
+        # two tight far-apart blobs, k=8 -> empty clusters guaranteed
+        pts = np.concatenate([np.zeros((20, 2)), np.full((20, 2), 50.0)])
+        pts += rng.standard_normal(pts.shape) * 0.01
+        cs = KMeansClustering.setup(8, max_iterations=12, seed=0).apply_to(
+            pts.astype(np.float32))
+        assert cs.centers.shape == (8, 2)
+        # only 2 distinct values, k=6 -> zero residual distances during init
+        dup = np.repeat(np.array([[0.0, 0.0], [9.0, 9.0]], np.float32),
+                        20, axis=0)
+        cs2 = KMeansClustering.setup(6, max_iterations=8, seed=1).apply_to(dup)
+        assert cs2.centers.shape[0] == 6

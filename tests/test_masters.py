@@ -321,3 +321,66 @@ def test_zero1_optimizer_state_sharding():
                if hasattr(l, "sharding") and hasattr(l, "ndim") and l.ndim
                and "data" in str(l.sharding)]
     assert sharded, "no optimizer-state leaf carries a data-axis sharding"
+
+
+class TestDistributedEvalScore:
+    """Distributed evaluation/scoring on masters (reference
+    SparkDl4jMultiLayer.evaluate map-partitions + IEvaluation.merge,
+    calculateScore)."""
+
+    def _trained(self):
+        net = _net(updater=Adam(learning_rate=0.05))
+        it = IrisDataSetIterator(batch_size=25)
+        for _ in range(60):
+            it.reset()
+            net.fit(it)
+        return net
+
+    def test_evaluate_matches_local(self):
+        net = self._trained()
+        master = ParameterAveragingTrainingMaster(num_workers=3)
+        it = IrisDataSetIterator(batch_size=15)
+        ev = master.evaluate(net, it)
+        it.reset()
+        local = net.evaluate(it)
+        assert ev.accuracy() == pytest.approx(local.accuracy())
+        assert ev.confusion.total() == 150
+
+    def test_score_matches_local(self):
+        net = self._trained()
+        master = ParameterAveragingTrainingMaster(num_workers=3)
+        dist = master.score(net, IrisDataSetIterator(batch_size=15))
+        ds = next(iter(IrisDataSetIterator(batch_size=150)))
+        local = net.score(x=ds.features, y=ds.labels)
+        assert dist == pytest.approx(local, rel=1e-3)
+
+    def test_evaluate_custom_factory(self):
+        from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+        net = self._trained()
+        master = ParameterAveragingTrainingMaster(num_workers=2)
+        ev = master.evaluate(net, IrisDataSetIterator(batch_size=30),
+                             eval_factory=RegressionEvaluation)
+        assert ev.average_mean_squared_error() >= 0.0
+
+
+class TestEarlyStoppingMaster:
+    def test_master_trainer_stops_and_returns_best(self):
+        from deeplearning4j_tpu.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingMasterTrainer, InMemoryModelSaver,
+            MaxEpochsTerminationCondition)
+        net = _net(updater=Adam(learning_rate=0.05))
+        master = ParameterAveragingTrainingMaster(num_workers=2,
+                                                  averaging_frequency=2)
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                IrisDataSetIterator(batch_size=50)),
+            epoch_terminations=[MaxEpochsTerminationCondition(8)],
+            model_saver=InMemoryModelSaver())
+        result = EarlyStoppingMasterTrainer(
+            conf, net, master, IrisDataSetIterator(batch_size=15)).fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.total_epochs <= 8
+        assert result.best_model is not None
+        # training through the master should have learned something
+        assert result.best_model_score < 1.0
