@@ -21,6 +21,7 @@ from .formatter import LocalUnstructuredDataFormatter
 from .fetchers import (CifarDataSetIterator, EmnistDataSetIterator,
                        LFWDataSetIterator, TinyImageNetDataSetIterator)
 from .mnist import IrisDataSetIterator, MnistDataSetIterator
+from .vectorizer import CallableVectorizer, TextCorpusVectorizer, Vectorizer
 
 __all__ = [
     "AsyncDataSetIterator", "AsyncMultiDataSetIterator", "BenchmarkDataSetIterator", "DataSet",
@@ -33,6 +34,7 @@ __all__ = [
     "FileSplitDataSetIterator", "export_dataset_batches", "load_dataset",
     "save_dataset", "TorchDataSetIterator", "as_torch_dataset",
     "from_torch", "MultiDataSet", "RecordReaderMultiDataSetIterator",
+    "Vectorizer", "CallableVectorizer", "TextCorpusVectorizer",
     "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "load_normalizer", "ImageTransform", "RandomFlipTransform",
     "RandomCropTransform", "CutoutTransform", "ComposeTransform",

@@ -10,6 +10,7 @@ from .expert import init_moe_params, make_moe_train_step, moe_ffn
 from .distributed import (ElasticTrainer, global_device_mesh,
                           initialize_distributed)
 from .inference import InferenceMode, ParallelInference
+from .layer import DistributedLayerTrainer
 from .master import (ParameterAveragingTrainingMaster,
                      SharedGradientsTrainingMaster, TrainingMaster,
                      TrainingMasterStats, tree_average)
@@ -30,4 +31,5 @@ __all__ = [
     "tree_average", "ulysses_attention", "init_moe_params",
     "make_moe_train_step", "moe_ffn", "TrainingMasterStats",
     "RemoteGradientSharing", "encode_message_bytes", "decode_message_bytes",
+    "DistributedLayerTrainer",
 ]

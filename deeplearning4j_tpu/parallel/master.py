@@ -105,22 +105,24 @@ class TrainingMaster:
     def _fan_out(self, model, iterator, num_workers: Optional[int],
                  per_batch: Callable[[Any, Any, int], None]) -> int:
         """Shared map scaffolding for the evaluation/scoring surface: chunk
-        batches over worker threads, give each a model replica (the
-        broadcast), run ``per_batch(replica, batch, worker)`` on its share,
-        re-raise the first worker error.  Returns the worker count used."""
+        batches over worker threads, run ``per_batch(model, batch, worker)``
+        on each share, re-raise the first worker error.  Returns the worker
+        count used.  The one model is shared across threads — output/score
+        are read-only (only the train step donates buffers), so the
+        reference's broadcast-a-copy step has no role here and cloning would
+        just pay a param copy + re-jit per worker."""
         if hasattr(iterator, "reset"):
             iterator.reset()
         parts = [p for p in _chunk_batches(
             iterator, num_workers or self.num_workers) if p]
         if not parts:
             return 0
-        replicas = [model] + [model.clone() for _ in range(len(parts) - 1)]
         errors: List[Exception] = []
 
         def work(w):
             try:
                 for batch in parts[w]:
-                    per_batch(replicas[w], batch, w)
+                    per_batch(model, batch, w)
             except Exception as e:
                 errors.append(e)
 

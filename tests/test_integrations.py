@@ -202,3 +202,58 @@ def test_checkpoint_listener_background(tmp_path):
     assert len(files) == 2            # rotation kept the last 2
     back = restore_multi_layer_network(os.path.join(tmp_path, files[-1]))
     assert back.num_params() == net.num_params()
+
+
+class TestLegacyCompleteness:
+    """Minor/legacy reference packages (SURVEY §2.6 completeness listing)."""
+
+    def test_recursive_tree(self):
+        """nn/layers/feedforward/autoencoder/recursive/Tree.java surface."""
+        from deeplearning4j_tpu.nn.recursive import Tree
+        leaves = [Tree(tokens=[w]) for w in ["the", "cat", "sat"]]
+        np_ = Tree(); np_.label = "NP"; np_.connect(leaves[:2])
+        vp = Tree(); vp.label = "VP"; vp.connect([leaves[2]])
+        root = Tree(); root.label = "S"; root.connect([np_, vp])
+        assert root.yield_words() == ["the", "cat", "sat"]
+        assert [t.tokens[0] for t in root.get_leaves()] == ["the", "cat", "sat"]
+        assert root.depth() == 2 and leaves[0].depth() == 0
+        # preterminal = exactly one leaf child (reference Tree.java:162)
+        assert vp.is_pre_terminal()
+        assert not np_.is_pre_terminal() and not root.is_leaf()
+        assert root.depth_of(leaves[1]) == 2
+        assert leaves[0].parent_in(root) is np_
+        assert leaves[0].ancestor(2, root) is root
+        np_.error, leaves[0].error = 0.5, 0.25
+        assert root.error_sum() == 0.75
+        clone = root.clone()
+        assert clone.yield_words() == root.yield_words()
+        assert clone.children[0] is not np_
+        clone.children[0].error = 9.0
+        assert root.error_sum() == 0.75  # deep copy
+
+    def test_legacy_vectorizer(self):
+        """datasets/vectorizer/Vectorizer.java contract."""
+        from deeplearning4j_tpu.data import (CallableVectorizer,
+                                             TextCorpusVectorizer)
+        ds = CallableVectorizer(
+            lambda: (np.ones((4, 3)), np.eye(4))).vectorize()
+        assert ds.features.shape == (4, 3) and ds.labels.shape == (4, 4)
+        docs = ["good great fine", "bad awful poor", "great good"]
+        ds2 = TextCorpusVectorizer(docs, [0, 1, 0], n_classes=2).vectorize()
+        assert ds2.features.shape[0] == 3 and ds2.labels.shape == (3, 2)
+        assert ds2.features.dtype == np.float32
+
+    def test_distributed_layer_trainer(self):
+        """SparkDl4jLayer.java single-layer path over a master."""
+        from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+        from deeplearning4j_tpu.nn.conf.updaters import Adam
+        from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+        from deeplearning4j_tpu.parallel import DistributedLayerTrainer
+        trainer = DistributedLayerTrainer(
+            OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            input_size=4, updater=Adam(learning_rate=0.1), seed=5)
+        trainer.fit(IrisDataSetIterator(batch_size=25), epochs=20)
+        ds = next(iter(IrisDataSetIterator(batch_size=150)))
+        preds = trainer.predict(ds.features)
+        acc = (preds.argmax(1) == np.asarray(ds.labels).argmax(1)).mean()
+        assert acc > 0.85, acc
