@@ -55,6 +55,50 @@ def skipgram_step(syn0, syn1, syn1neg, ctx, points, codes, code_mask,
     return syn0, syn1, syn1neg
 
 
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("K",))
+def skipgram_steps_ns(syn0, syn1neg, table, ctxs, centers, n_valids, key,
+                      alphas, K: int):
+    """S sequential NS skip-gram step-batches fused into ONE dispatch.
+
+    ctxs/centers: (S, B) int32; n_valids/alphas: (S,).  Why a scan: each
+    individual step is microseconds of device work, so per-dispatch latency
+    (tens of ms through a remote-attached TPU) otherwise dominates — the
+    same motive as the reference executing thousands of ``AggregateSkipGram``
+    ops per executioner call (SkipGram.java:271-283).  Negatives are drawn
+    on device from the HBM-resident unigram table; collisions with the
+    target are masked (equivalent under expectation to the C redraw loop).
+    Padded rows (row index >= n_valid) scatter zeros.
+    """
+    S, B = ctxs.shape
+    keys = jax.random.split(key, S)
+
+    def body(carry, args):
+        syn0, syn1neg = carry
+        ctx, center, n_valid, k, alpha = args
+        row_valid = (jnp.arange(B) < n_valid).astype(syn0.dtype)
+        samples = table[jax.random.randint(k, (B, K), 0, table.shape[0])]
+        neg = jnp.concatenate([center[:, None], samples], axis=1)
+        neg_label = jnp.concatenate(
+            [jnp.ones((B, 1), syn0.dtype), jnp.zeros((B, K), syn0.dtype)],
+            axis=1)
+        neg_mask = jnp.concatenate(
+            [jnp.ones((B, 1), syn0.dtype),
+             (samples != center[:, None]).astype(syn0.dtype)], axis=1)
+        neg_mask = neg_mask * row_valid[:, None]
+        v = syn0[ctx]
+        nvecs = syn1neg[neg]
+        fn = _sigmoid(jnp.einsum("bd,bkd->bk", v, nvecs))
+        gn = (neg_label - fn) * alpha * neg_mask
+        neu1e = jnp.einsum("bk,bkd->bd", gn, nvecs)
+        syn1neg = syn1neg.at[neg].add(gn[..., None] * v[:, None, :])
+        syn0 = syn0.at[ctx].add(neu1e * row_valid[:, None])
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1neg), (ctxs, centers, n_valids, keys, alphas))
+    return syn0, syn1neg
+
+
 @partial(jax.jit, donate_argnums=(0, 1, 2))
 def cbow_step(syn0, syn1, syn1neg, ctx, ctx_mask, points, codes, code_mask,
               neg, neg_label, neg_mask, alpha):

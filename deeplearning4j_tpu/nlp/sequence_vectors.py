@@ -13,44 +13,93 @@ Learning algorithms are selected by name, mirroring the reference's pluggable
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Iterable, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .elements import cbow_step, infer_step, skipgram_step
+from .elements import (cbow_step, infer_step, skipgram_step,
+                       skipgram_steps_ns)
 from .lookup_table import InMemoryLookupTable
 from .vocab import VocabCache, VocabConstructor, subsample_keep_prob
 from .word_vectors import WordVectors
 
 
 class _PairBatcher:
-    """Accumulates (ctx, center) training pairs into fixed-shape batches."""
+    """Accumulates (ctx, center) training pairs into fixed-shape batches.
+
+    Pairs arrive as whole numpy arrays (``add_many`` — one call per sequence,
+    not one per pair): the reference reaches throughput by batching the hot
+    loop into native ``AggregateSkipGram`` ops, and the host-side equivalent
+    is keeping pair generation vectorized end to end."""
 
     def __init__(self, batch_size: int, code_len: int, negative: int,
                  use_hs: bool):
         self.B, self.C, self.K = batch_size, code_len, negative
         self.use_hs = use_hs
-        self.ctx: List[int] = []
-        self.center: List[int] = []
+        # deque of chunks + read offset into the head chunk: _take hands out
+        # B-sized slices without re-concatenating the tail (a per-call full
+        # copy would make the S drains per dispatch quadratic in scan steps)
+        self._ctx: deque = deque()
+        self._cen: deque = deque()
+        self._seen: deque = deque()
+        self._off = 0
+        self.count = 0
 
-    def add(self, ctx: int, center: int) -> bool:
-        self.ctx.append(ctx)
-        self.center.append(center)
-        return len(self.ctx) >= self.B
+    def add(self, ctx: int, center: int, seen: int = 0) -> bool:
+        return self.add_many(np.array([ctx], dtype=np.int64),
+                             np.array([center], dtype=np.int64), seen)
+
+    def add_many(self, ctx, center, seen: int = 0) -> bool:
+        """Buffer a whole sequence's pairs.  ``seen`` (words consumed when
+        these pairs were emitted) rides along so the learning-rate decay is
+        applied at the pair's corpus position, not at dispatch time — with
+        multi-step dispatch the two can be far apart."""
+        ctx = np.asarray(ctx, dtype=np.int64)
+        if ctx.size:
+            self._ctx.append(ctx)
+            self._cen.append(np.asarray(center, dtype=np.int64))
+            self._seen.append(np.full(ctx.size, seen, dtype=np.int64))
+            self.count += ctx.size
+        return self.count >= self.B
+
+    def _take(self, force: bool):
+        if self.count == 0 or (self.count < self.B and not force):
+            return None
+        ctx = np.zeros(self.B, dtype=np.int32)
+        center = np.zeros(self.B, dtype=np.int32)
+        seen_sum, taken = 0.0, 0
+        while self._ctx and taken < self.B:
+            head = self._ctx[0]
+            take = min(self.B - taken, head.size - self._off)
+            sl = slice(self._off, self._off + take)
+            ctx[taken:taken + take] = head[sl]
+            center[taken:taken + take] = self._cen[0][sl]
+            seen_sum += float(self._seen[0][sl].sum())
+            taken += take
+            self._off += take
+            if self._off >= head.size:
+                self._ctx.popleft()
+                self._cen.popleft()
+                self._seen.popleft()
+                self._off = 0
+        self.count -= taken
+        return ctx, center, taken, seen_sum / max(taken, 1)
 
     def drain(self, vocab_words, table, rng, force=False):
-        if not self.ctx or (len(self.ctx) < self.B and not force):
+        taken = self._take(force)
+        if taken is None:
             return None
-        n = min(len(self.ctx), self.B)
-        ctx = np.zeros(self.B, dtype=np.int32)
-        ctx[:n] = self.ctx[:self.B]
-        center = np.zeros(self.B, dtype=np.int32)
-        center[:n] = self.center[:self.B]
+        ctx, center, n, seen_mean = taken
         batch = _label_arrays(center, n, self.B, self.C, self.K,
                               vocab_words, table, rng, use_hs=self.use_hs)
-        self.ctx, self.center = self.ctx[self.B:], self.center[self.B:]
-        return (ctx,) + batch
+        return (ctx,) + batch + (seen_mean,)
+
+    def drain_pairs(self, force=False):
+        """(ctx, center, n, seen_mean) — for the device-sampling fast path."""
+        return self._take(force)
 
 
 def _label_arrays(center, n, B, C, K, vocab_words, table, rng, use_hs=True):
@@ -95,7 +144,7 @@ class SequenceVectors(WordVectors):
                  sampling: float = 0.0, min_word_frequency: int = 1,
                  epochs: int = 1, batch_size: int = 512, seed: int = 123,
                  elements_algorithm: str = "skipgram",
-                 max_code_length: int = 40):
+                 max_code_length: int = 40, scan_steps: int = 16):
         self.layer_size = layer_size
         self.window = window
         self.learning_rate = learning_rate
@@ -109,6 +158,9 @@ class SequenceVectors(WordVectors):
         self.seed = seed
         self.elements_algorithm = elements_algorithm
         self.max_code_length = max_code_length
+        # step-batches fused per dispatch on the NS fast path (lax.scan):
+        # per-dispatch latency dominates these microsecond steps otherwise
+        self.scan_steps = max(1, scan_steps)
         self.vocab: Optional[VocabCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
 
@@ -145,25 +197,67 @@ class SequenceVectors(WordVectors):
             syn1 = jnp.zeros_like(syn0)
         if syn1neg is None:
             syn1neg = jnp.zeros_like(syn0)
-        batcher = _PairBatcher(self.batch_size, code_len, self.negative,
+        # Batched rows update from stale weights (the reference's sequential
+        # hogwild never sees this): with a small vocabulary a big batch packs
+        # many duplicates of the same word whose correlated updates sum and
+        # can diverge.  Cap rows-per-step by vocab size and spend the budget
+        # on extra scan steps instead (steps read fresh carry weights).
+        n_words = max(len(vocab_words), 1)
+        b_eff = min(self.batch_size, max(64, 4 * n_words))
+        scan_eff = self.scan_steps
+        if b_eff < self.batch_size:
+            scan_eff = min(512, -(-self.scan_steps * self.batch_size // b_eff))
+        batcher = _PairBatcher(b_eff, code_len, self.negative,
                                self.use_hs)
-        step = skipgram_step if self.elements_algorithm == "skipgram" else None
+        is_skipgram = self.elements_algorithm == "skipgram"
+        # device-sampling fast path: NS-only skip-gram ships just the int32
+        # pair indices per step; negatives come from the HBM-resident table
+        fast_ns = (is_skipgram and not self.use_hs and self.negative > 0
+                   and lt.table is not None and len(lt.table))
+        key = jax.random.PRNGKey(self.seed) if fast_ns else None
+        if fast_ns:
+            table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
+
+        def decay(seen_at: float) -> float:
+            """LR at a given corpus position (word2vec linear decay)."""
+            return max(self.min_learning_rate,
+                       self.learning_rate * (1.0 - seen_at / total))
 
         def flush(force=False):
-            nonlocal syn0, syn1, syn1neg
+            nonlocal syn0, syn1, syn1neg, key
             while True:
-                alpha = max(self.min_learning_rate,
-                            self.learning_rate * (1.0 - seen / total))
-                if step is not None:
+                if fast_ns:
+                    S, B = scan_eff, b_eff
+                    if batcher.count == 0 or (
+                            batcher.count < S * B and not force):
+                        return
+                    ctxs = np.zeros((S, B), dtype=np.int32)
+                    cens = np.zeros((S, B), dtype=np.int32)
+                    n_valids = np.zeros(S, dtype=np.int32)
+                    alphas = np.zeros(S, dtype=np.float32)
+                    for s in range(S):
+                        b = batcher.drain_pairs(force=force)
+                        if b is None:
+                            break
+                        ctxs[s], cens[s], n_valids[s], seen_mean = b
+                        alphas[s] = decay(seen_mean)
+                    if not n_valids.any():
+                        return
+                    key, sub = jax.random.split(key)
+                    syn0, syn1neg = skipgram_steps_ns(
+                        syn0, syn1neg, table_dev, jnp.asarray(ctxs),
+                        jnp.asarray(cens), jnp.asarray(n_valids), sub,
+                        jnp.asarray(alphas), self.negative)
+                elif is_skipgram:
                     b = batcher.drain(vocab_words, lt.table, rng, force=force)
                     if b is None:
                         return
-                    ctx, _center, pts, cds, cm, neg, nl, nm = b
-                    syn0, syn1, syn1neg = step(
+                    ctx, _center, pts, cds, cm, neg, nl, nm, seen_mean = b
+                    syn0, syn1, syn1neg = skipgram_step(
                         syn0, syn1, syn1neg, jnp.asarray(ctx),
                         jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(cm),
                         jnp.asarray(neg), jnp.asarray(nl), jnp.asarray(nm),
-                        jnp.float32(alpha))
+                        jnp.float32(decay(seen_mean)))
                 else:
                     b = self._drain_cbow(vocab_words, lt.table, rng, force)
                     if b is None:
@@ -173,7 +267,7 @@ class SequenceVectors(WordVectors):
                         syn0, syn1, syn1neg, jnp.asarray(ctxw),
                         jnp.asarray(cmask), jnp.asarray(pts), jnp.asarray(cds),
                         jnp.asarray(cm), jnp.asarray(neg), jnp.asarray(nl),
-                        jnp.asarray(nm), jnp.float32(alpha))
+                        jnp.asarray(nm), jnp.float32(decay(seen)))
                 if force and self._pending_empty(batcher):
                     return
 
@@ -192,31 +286,38 @@ class SequenceVectors(WordVectors):
                 label_idxs = [self.vocab.index_of(l)
                               for l in self._sequence_labels(seq_idx)]
                 label_idxs = [l for l in label_idxs if l >= 0]
-                self._emit_sequence(idxs, label_idxs, batcher, rng)
+                self._emit_sequence(idxs, label_idxs, batcher, rng, seen)
                 flush()
         flush(force=True)
         lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
 
     def _pending_empty(self, batcher) -> bool:
         if self.elements_algorithm == "skipgram":
-            return not batcher.ctx
+            return batcher.count == 0
         return not self._cbow_buf
 
     def _emit_sequence(self, idxs: np.ndarray, label_idxs: List[int],
-                       batcher: _PairBatcher, rng) -> None:
+                       batcher: _PairBatcher, rng, seen: int = 0) -> None:
         """Window-pair generation: skip-gram emits (context-row, center-label)
         pairs with a reduced window b ~ U[0, window) exactly like the C
         original (``SkipGram.skipGram``, SkipGram.java:200-221)."""
         W = self.window
         if self.elements_algorithm == "skipgram":
-            for i in range(len(idxs)):
-                b = int(rng.integers(0, W))
-                for j in range(i - W + b, i + W - b + 1):
-                    if j == i or j < 0 or j >= len(idxs):
-                        continue
-                    batcher.add(int(idxs[j]), int(idxs[i]))
-                for l in label_idxs:  # DBOW: label row learns to predict words
-                    batcher.add(l, int(idxs[i]))
+            # vectorized window-pair emission: per-center reduced half-width
+            # w = W - b, b ~ U[0, W) (the C original's window shrink), all
+            # pairs of the sequence built in one numpy pass
+            n = len(idxs)
+            w = W - rng.integers(0, W, size=n)               # (n,) in [1, W]
+            base = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+            offs = np.broadcast_to(base, (n, 2 * W))
+            pos = np.arange(n)[:, None] + offs
+            valid = (np.abs(offs) <= w[:, None]) & (pos >= 0) & (pos < n)
+            cen_rows = np.broadcast_to(np.arange(n)[:, None], (n, 2 * W))
+            batcher.add_many(idxs[pos[valid]], idxs[cen_rows[valid]], seen)
+            if label_idxs:  # DBOW: label row learns to predict words
+                labs = np.asarray(label_idxs, dtype=np.int64)
+                batcher.add_many(np.tile(labs, n), np.repeat(idxs, labs.size),
+                                 seen)
         else:  # cbow / dm
             for i in range(len(idxs)):
                 b = int(rng.integers(0, W))

@@ -139,23 +139,23 @@ class TrainingMaster:
     def evaluate(self, model, iterator, eval_factory=None,
                  num_workers: Optional[int] = None):
         """Distributed evaluation: batches fan out over worker threads, each
-        holding a model replica and a partial IEvaluation; partials merge at
-        the end.  ``eval_factory`` picks the evaluation type (Evaluation by
+        accumulating a partial IEvaluation against the one shared read-only
+        model; partials merge at the end.  ``eval_factory`` picks the evaluation type (Evaluation by
         default — pass e.g. ``RegressionEvaluation`` or
         ``lambda: ROC(threshold_steps=30)``)."""
         from ..evaluation.classification import Evaluation
         n_max = num_workers or self.num_workers
         evals = [(eval_factory or Evaluation)() for _ in range(n_max)]
 
-        def per_batch(replica, batch, w):
-            x, y, _, lm = replica._normalize_batch(batch)
+        def per_batch(net, batch, w):
+            x, y, _, lm = net._normalize_batch(batch)
             if isinstance(x, list):  # ComputationGraph batch
-                out = replica.output(*x)
+                out = net.output(*x)
                 out = out[0] if isinstance(out, (list, tuple)) else out
                 y0 = y[0] if isinstance(y, (list, tuple)) else y
                 lm0 = lm[0] if isinstance(lm, (list, tuple)) else lm
             else:
-                out, y0, lm0 = replica.output(x), y, lm
+                out, y0, lm0 = net.output(x), y, lm
             evals[w].eval(np.asarray(y0), np.asarray(out),
                           mask=None if lm0 is None else np.asarray(lm0))
 
@@ -173,13 +173,13 @@ class TrainingMaster:
         n_max = num_workers or self.num_workers
         totals, counts = [0.0] * n_max, [0] * n_max
 
-        def per_batch(replica, batch, w):
-            x, y, _, _ = replica._normalize_batch(batch)
+        def per_batch(net, batch, w):
+            x, y, _, _ = net._normalize_batch(batch)
             if isinstance(x, list):
-                s = replica.score(inputs=x, labels=y)
+                s = net.score(inputs=x, labels=y)
                 bs = int(np.asarray(x[0]).shape[0])
             else:
-                s = replica.score(x=x, y=y)
+                s = net.score(x=x, y=y)
                 bs = int(np.asarray(x).shape[0])
             totals[w] += s * bs
             counts[w] += bs

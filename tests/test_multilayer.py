@@ -265,3 +265,72 @@ def test_cache_mode_remat_numerics_parity():
     assert abs(a.score() - b.score()) < 1e-6
     with pytest.raises(ValueError, match="cache_mode"):
         NeuralNetConfiguration.builder().cache_mode("everything")
+
+
+# ---- LossFunctionGradientCheck (reference
+# gradientcheck/LossFunctionGradientCheck.java: every ILossFunction against
+# central differences, targets shaped to each loss's domain) ----------------
+@pytest.mark.parametrize("loss,act,target", [
+    ("mape", "identity", "positive"),
+    ("msle", "relu", "positive"),
+    ("mcxent", "softmax", "onehot"),
+    ("squared_hinge", "identity", "pm1"),
+    ("kl_divergence", "softmax", "simplex"),
+    ("poisson", "softplus", "counts"),
+    ("cosine_proximity", "identity", "normal"),
+    ("wasserstein", "identity", "pm1"),
+    ("fmeasure", "sigmoid", "binary"),
+])
+def test_gradient_check_remaining_losses(loss, act, target):
+    out_dim = 3
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Sgd(learning_rate=0.1))
+            .dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=5, activation="sigmoid"))
+            .layer(OutputLayer(n_out=out_dim, activation=act, loss=loss))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((5, 3))
+    if target == "positive":
+        y = rng.uniform(0.5, 2.0, (5, out_dim))
+    elif target == "onehot":
+        y = np.eye(out_dim)[rng.integers(0, out_dim, 5)]
+    elif target == "pm1":
+        y = rng.choice([-1.0, 1.0], (5, out_dim))
+    elif target == "simplex":
+        y = rng.uniform(0.1, 1.0, (5, out_dim))
+        y /= y.sum(axis=1, keepdims=True)
+    elif target == "counts":
+        y = rng.integers(0, 5, (5, out_dim)).astype(float)
+    elif target == "binary":
+        y = rng.integers(0, 2, (5, out_dim)).astype(float)
+    else:
+        y = rng.standard_normal((5, out_dim))
+    assert check_gradients(net, x, y), loss
+
+
+# ---- NoBiasGradientCheckTests (reference
+# gradientcheck/NoBiasGradientCheckTests.java: has_bias=False layers train
+# correctly and carry no bias parameter) ------------------------------------
+def test_gradient_check_no_bias():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9)
+            .updater(Sgd(learning_rate=0.1))
+            .dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=6, activation="tanh", has_bias=False))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                               has_bias=False))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for lname, lparams in net.params.items():
+        assert "b" not in lparams, (lname, list(lparams))
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((6, 4))
+    y = np.eye(3)[rng.integers(0, 3, 6)]
+    assert check_gradients(net, x, y)

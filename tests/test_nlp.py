@@ -256,3 +256,21 @@ def test_tfidf_downweights_common_terms():
     m = tf.transform(docs)
     assert m[0, tf.vocab.index_of("cat")] == pytest.approx(0.0)  # df=N → idf 0
     assert m[0, tf.vocab.index_of("dog")] > 0
+
+
+def test_skipgram_tiny_vocab_large_batch_stable():
+    """Regression: with a tiny vocabulary a large batch packs many stale
+    duplicate updates per word, which diverged before the vocab-size batch
+    cap; must stay bounded and learn the topic split."""
+    rng = np.random.default_rng(4)
+    animals = ["cat", "dog", "cow", "horse", "sheep"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    sents = [" ".join(rng.choice(animals if rng.random() < 0.5 else tech,
+                                 size=8)) for _ in range(400)]
+    w2v = Word2Vec(sentences=sents, min_word_frequency=1, epochs=3,
+                   layer_size=32, window=4, negative=5, seed=0,
+                   batch_size=1024, scan_steps=8)
+    w2v.fit()
+    s0 = np.asarray(w2v.lookup_table.syn0)
+    assert np.isfinite(s0).all() and np.abs(s0).max() < 100.0
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "gpu")
