@@ -3,12 +3,14 @@ import numpy as np
 
 from .zoo import (ALL_MODELS, AlexNet, FaceNetNN4Small2, GoogLeNet,
                   InceptionResNetV1, LeNet, ResNet50, SimpleCNN,
-                  TextGenerationLSTM, TransformerLM, VGG16, VGG19, ZooModel)
+                  ModelSelector, TextGenerationLSTM, TransformerLM, VGG16,
+                  VGG19, ZooModel)
 
 __all__ = [
     "ALL_MODELS", "AlexNet", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
-    "TextGenerationLSTM", "TransformerLM", "VGG16", "VGG19", "ZooModel",
+    "ModelSelector", "TextGenerationLSTM", "TransformerLM", "VGG16",
+    "VGG19", "ZooModel",
     "available_bench_model", "flagship_entry_model", "generate_tokens",
 ]
 

@@ -528,3 +528,33 @@ class TransformerLM(ZooModel):
 
 
 ALL_MODELS.append(TransformerLM)
+
+
+class ModelSelector:
+    """Select zoo models by name/type (reference
+    ``deeplearning4j-zoo/.../ModelSelector.java``: select(ZooType) returns a
+    name → instance map for benchmarking sweeps over the whole zoo)."""
+
+    @staticmethod
+    def select(*names, **init_kwargs):
+        """``names``: model class names (case-insensitive), or "all"/"cnn".
+        Returns {name: uninitialized model instance}."""
+        by_name = {cls.__name__.lower(): cls for cls in ALL_MODELS}
+        out = {}
+        for name in names:
+            key = name.lower()
+            if key == "all":
+                out.update({cls.__name__: cls(**init_kwargs)
+                            for cls in ALL_MODELS})
+            elif key == "cnn":
+                out.update({cls.__name__: cls(**init_kwargs)
+                            for cls in ALL_MODELS
+                            if cls.__name__ not in
+                            ("TextGenerationLSTM", "TransformerLM")})
+            elif key in by_name:
+                out[by_name[key].__name__] = by_name[key](**init_kwargs)
+            else:
+                raise ValueError(
+                    f"unknown zoo model '{name}'; available: "
+                    f"{sorted(by_name)} or 'all'/'cnn'")
+        return out

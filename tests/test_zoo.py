@@ -142,3 +142,18 @@ def test_generate_tokens_greedy_recovers_cycle():
     prompt = np.array([[3, 4, 5]])
     gen = generate_tokens(net, prompt, n_tokens=5, temperature=0.0)
     assert gen.tolist()[0] == [3, 4, 5, 6, 7, 8, 9, 10]
+
+
+def test_model_selector():
+    """ModelSelector.select (reference deeplearning4j-zoo ModelSelector)."""
+    from deeplearning4j_tpu.models import LeNet, ModelSelector
+    sel = ModelSelector.select("lenet", "simplecnn", num_classes=7)
+    assert set(sel) == {"LeNet", "SimpleCNN"}
+    assert isinstance(sel["LeNet"], LeNet)
+    net = sel["LeNet"].init()
+    assert net.params
+    everything = ModelSelector.select("all")
+    assert len(everything) == len(__import__(
+        "deeplearning4j_tpu.models", fromlist=["ALL_MODELS"]).ALL_MODELS)
+    with pytest.raises(ValueError, match="unknown zoo model"):
+        ModelSelector.select("nonexistent")
