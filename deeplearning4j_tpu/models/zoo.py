@@ -71,6 +71,7 @@ def _max_pool(g: GraphBuilder, name: str, inp: str, kernel=(3, 3),
 @dataclass
 class ZooModel:
     """Base zoo model (reference ``ZooModel.java``)."""
+    model_type = "cnn"   # "cnn" | "rnn" — ModelSelector filter key
     num_classes: int = 1000
     seed: int = 123
     input_shape: Tuple[int, int, int] = (224, 224, 3)   # (h, w, c)
@@ -464,6 +465,7 @@ class FaceNetNN4Small2(ZooModel):
 class TextGenerationLSTM(ZooModel):
     """Char-level text generation LSTM (reference
     ``model/TextGenerationLSTM.java:34``)."""
+    model_type = "rnn"
     num_classes: int = 26          # vocab size
     timesteps: int = 40
     hidden: int = 256
@@ -495,6 +497,7 @@ class TransformerLM(ZooModel):
     counterpart of TextGenerationLSTM (no reference equivalent; built from
     the TPU-native attention stack: pre-norm blocks, causal masking,
     flash/ring kernels selectable via attn_impl)."""
+    model_type = "rnn"
     vocab_size: int = 256
     seq_len: int = 128
     embed: int = 256
@@ -537,8 +540,8 @@ class ModelSelector:
 
     @staticmethod
     def select(*names, **init_kwargs):
-        """``names``: model class names (case-insensitive), or "all"/"cnn".
-        Returns {name: uninitialized model instance}."""
+        """``names``: model class names (case-insensitive), a model_type
+        ("cnn"/"rnn"), or "all".  Returns {name: uninitialized instance}."""
         by_name = {cls.__name__.lower(): cls for cls in ALL_MODELS}
         out = {}
         for name in names:
@@ -546,15 +549,14 @@ class ModelSelector:
             if key == "all":
                 out.update({cls.__name__: cls(**init_kwargs)
                             for cls in ALL_MODELS})
-            elif key == "cnn":
+            elif key in ("cnn", "rnn"):
                 out.update({cls.__name__: cls(**init_kwargs)
                             for cls in ALL_MODELS
-                            if cls.__name__ not in
-                            ("TextGenerationLSTM", "TransformerLM")})
+                            if cls.model_type == key})
             elif key in by_name:
                 out[by_name[key].__name__] = by_name[key](**init_kwargs)
             else:
                 raise ValueError(
                     f"unknown zoo model '{name}'; available: "
-                    f"{sorted(by_name)} or 'all'/'cnn'")
+                    f"{sorted(by_name)} or 'all'/'cnn'/'rnn'")
         return out

@@ -151,3 +151,68 @@ def _cast_floats(tree, dtype, only=None):
         return a.astype(dtype)
 
     return jax.tree_util.tree_map(cast, tree)
+
+
+def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
+                         shuffle: bool, call_step, fit_tail):
+    """Shared device-resident epoch trainer behind
+    ``MultiLayerNetwork.fit_on_device`` / ``ComputationGraph.fit_on_device``.
+
+    One jitted program scans the train step over all minibatches, gathering
+    each minibatch from the single HBM-resident dataset copy inside the scan
+    body (a whole-dataset permuted copy would double the footprint of an
+    HBM-bound feature).  ``xs``/``ys``: lists of device arrays.
+    ``call_step(p, s, o, key, bx, by)`` adapts the model's jitted train step
+    to list-shaped batches; ``fit_tail(xt, yt)`` trains the ragged tail via
+    the normal per-batch path.
+    """
+    n = int(xs[0].shape[0])
+    nb = n // batch_size
+    if nb == 0:
+        raise ValueError(f"batch_size {batch_size} exceeds dataset ({n})")
+    used = nb * batch_size
+    cache_key = ("epoch_scan", nb, batch_size,
+                 tuple(a.shape[1:] for a in xs),
+                 tuple(a.shape[1:] for a in ys))
+    fn = model._jit_cache.get(cache_key)
+    if fn is None:
+        def epoch_fn(params, state, opt_state, key, xd, yd, perm_steps):
+            def body(carry, idx):
+                p, s, o, k = carry
+                k, sub = jax.random.split(k)
+                bx = [a[idx] for a in xd]     # one minibatch gather per step
+                by = [a[idx] for a in yd]
+                p, s, o, loss, gstats = call_step(p, s, o, sub, bx, by)
+                return (p, s, o, k), (loss, gstats)
+
+            (p, s, o, _), (losses, gstats) = jax.lax.scan(
+                body, (params, state, opt_state, key), perm_steps)
+            # listeners see the final step's gradient norms
+            gstats = jax.tree_util.tree_map(lambda a: a[-1], gstats)
+            return p, s, o, losses, gstats
+
+        fn = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
+        model._jit_cache[cache_key] = fn
+    for _ in range(epochs):
+        for lst in model.listeners:
+            lst.on_epoch_start(model)
+        model._rng, key, pk = jax.random.split(model._rng, 3)
+        perm = (jax.random.permutation(pk, n) if shuffle
+                else jnp.arange(n))
+        perm_steps = perm[:used].reshape(nb, batch_size)
+        (model.params, model.state, model.opt_state, losses,
+         gstats) = fn(model.params, model.state, model.opt_state, key,
+                      xs, ys, perm_steps)
+        model.iteration += nb
+        model.last_batch_size = batch_size
+        model._score = float(losses[-1])
+        model._last_grad_stats = gstats
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration, model.epoch)
+        if used < n:
+            tail = perm[used:]
+            fit_tail([a[tail] for a in xs], [a[tail] for a in ys])
+        for lst in model.listeners:
+            lst.on_epoch_end(model)
+        model.epoch += 1
+    return model

@@ -21,7 +21,8 @@ import numpy as np
 import optax
 
 from ._common import (_cast_floats, apply_constraints_all,
-                      apply_gradient_norm_all, build_tx)
+                      apply_gradient_norm_all, build_tx,
+                      fit_on_device_epochs)
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      GraphVertexConf, LayerVertex)
 from .conf.updaters import Sgd, UpdaterConf
@@ -202,6 +203,11 @@ class ComputationGraph:
         return acts
 
     def score(self, dataset=None, inputs=None, labels=None) -> float:
+        """Loss on a dataset; with no arguments, the score of the most
+        recent training minibatch (reference ``score()`` / ``score(DataSet)``
+        — same contract as MultiLayerNetwork)."""
+        if dataset is None and inputs is None:
+            return self._score
         if dataset is not None:
             inputs, labels, _, _ = self._normalize_batch(dataset)
         inputs = [jnp.asarray(x) for x in _as_list(inputs)]
@@ -342,6 +348,25 @@ class ComputationGraph:
                 lst.on_epoch_end(self)
             self.epoch += 1
         return self
+
+    def fit_on_device(self, inputs, labels, *, batch_size: int,
+                      epochs: int = 1, shuffle: bool = True
+                      ) -> "ComputationGraph":
+        """Device-resident epoch training for graphs: the dataset stays in
+        HBM and one jitted program scans the train step over all minibatches
+        (one dispatch per epoch; see ``MultiLayerNetwork.fit_on_device``).
+        ``inputs``/``labels``: array or list of arrays (multi-input/output).
+        """
+        if self.params == {}:
+            self.init()
+        step = self._get_jitted("train_step")
+        return fit_on_device_epochs(
+            self, [jnp.asarray(a) for a in _as_list(inputs)],
+            [jnp.asarray(a) for a in _as_list(labels)], batch_size, epochs,
+            shuffle,
+            call_step=lambda p, s, o, k, bx, by: step(p, s, o, k, bx, by,
+                                                      None, None),
+            fit_tail=lambda xt, yt: self._fit_one(xt, yt, None, None))
 
     @staticmethod
     def _normalize_batch(b):

@@ -32,7 +32,7 @@ import optax
 
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, apply_gradient_normalization,
-                      build_tx)
+                      build_tx, fit_on_device_epochs)
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
@@ -393,60 +393,22 @@ class MultiLayerNetwork:
         """
         if self.params == {}:
             self.init()
-        x, y = jnp.asarray(x), jnp.asarray(y)
-        n = int(x.shape[0])
-        nb = n // batch_size
-        if nb == 0:
-            raise ValueError(f"batch_size {batch_size} exceeds dataset ({n})")
-        used = nb * batch_size
+        if self.conf.backprop_type == "tbptt":
+            raise ValueError(
+                "fit_on_device does not support tBPTT (the scanned step has "
+                "no carry truncation); use fit()")
+        algo = self.conf.defaults.get("optimization_algo", "sgd")
+        if algo not in (None, "sgd", "stochastic_gradient_descent"):
+            raise ValueError(
+                f"fit_on_device requires the SGD path; optimization_algo="
+                f"'{algo}' routes through the legacy solvers — use fit()")
         step = self._get_jitted("train_step")
-        cache_key = ("epoch_scan", nb, batch_size, x.shape[1:], y.shape[1:])
-        fn = self._jit_cache.get(cache_key)
-        if fn is None:
-            def epoch_fn(params, state, opt_state, key, xd, yd, perm):
-                xb = xd[perm].reshape((nb, batch_size) + xd.shape[1:])
-                yb = yd[perm].reshape((nb, batch_size) + yd.shape[1:])
-
-                def body(carry, batch):
-                    p, s, o, k = carry
-                    k, sub = jax.random.split(k)
-                    bx, by = batch
-                    p, s, o, loss, gstats = step(p, s, o, sub, bx, by,
-                                                 None, None)
-                    return (p, s, o, k), (loss, gstats)
-
-                (p, s, o, _), (losses, gstats) = jax.lax.scan(
-                    body, (params, state, opt_state, key), (xb, yb))
-                # listeners see the final step's gradient norms
-                gstats = jax.tree_util.tree_map(lambda a: a[-1], gstats)
-                return p, s, o, losses, gstats
-
-            fn = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
-            self._jit_cache[cache_key] = fn
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self)
-            self._rng, key, pk = jax.random.split(self._rng, 3)
-            perm = (jax.random.permutation(pk, n) if shuffle
-                    else jnp.arange(n))
-            self.params, self.state, self.opt_state, losses, gstats = fn(
-                self.params, self.state, self.opt_state, key, x, y,
-                perm[:used])
-            self.iteration += nb
-            self.last_batch_size = batch_size
-            self._score = float(losses[-1])
-            self._last_grad_stats = gstats
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, self.epoch)
-            if used < n:
-                # ragged tail can't join the static-shape scan: run it
-                # through the normal per-batch step (its own cached compile)
-                tail = perm[used:]
-                self._fit_one(x[tail], y[tail], None, None)
-            for lst in self.listeners:
-                lst.on_epoch_end(self)
-            self.epoch += 1
-        return self
+        return fit_on_device_epochs(
+            self, [jnp.asarray(x)], [jnp.asarray(y)], batch_size, epochs,
+            shuffle,
+            call_step=lambda p, s, o, k, bx, by: step(p, s, o, k, bx[0],
+                                                      by[0], None, None),
+            fit_tail=lambda xt, yt: self._fit_one(xt[0], yt[0], None, None))
 
     def _fit_tbptt(self, step_fn, x, y, mask, label_mask):
         """Truncated BPTT (reference ``doTruncatedBPTT``,

@@ -316,3 +316,35 @@ def test_graph_bf16_and_remat():
     assert net.get_score() < s0
     for leaf in jax.tree_util.tree_leaves(net.params):
         assert leaf.dtype == jnp.float32
+
+
+def test_graph_fit_on_device():
+    """ComputationGraph.fit_on_device: scanned epochs train a two-input
+    graph and match bookkeeping."""
+    import jax
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .set_input_types(InputType.feed_forward(3),
+                             InputType.feed_forward(2))
+            .add_layer("da", DenseLayer(n_out=8, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=8, activation="tanh"), "b")
+            .add_vertex("merge", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "merge")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    n = 100
+    a = rng.standard_normal((n, 3)).astype(np.float32)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    # label depends on both inputs -> must use both branches to learn
+    y = np.eye(2, dtype=np.float32)[((a[:, 0] + b[:, 0]) > 0).astype(int)]
+    net.fit_on_device([a, b], [y], batch_size=32, epochs=40)
+    assert net.epoch == 40
+    assert net.iteration == 40 * (100 // 32 + 1)  # 3 scanned + 1 tail
+    preds = np.asarray(net.output_single(a, b))
+    acc = (preds.argmax(1) == y.argmax(1)).mean()
+    assert acc > 0.85, acc

@@ -157,3 +157,11 @@ def test_model_selector():
         "deeplearning4j_tpu.models", fromlist=["ALL_MODELS"]).ALL_MODELS)
     with pytest.raises(ValueError, match="unknown zoo model"):
         ModelSelector.select("nonexistent")
+
+
+def test_model_selector_type_filter():
+    from deeplearning4j_tpu.models import ModelSelector
+    rnn = ModelSelector.select("rnn")
+    assert set(rnn) == {"TextGenerationLSTM", "TransformerLM"}
+    cnn = ModelSelector.select("cnn")
+    assert "TextGenerationLSTM" not in cnn and "LeNet" in cnn
