@@ -10,6 +10,15 @@ from .records import RecordReaderMultiDataSetIterator
 from .dataset import AsyncMultiDataSetIterator
 from .dataset import (DataSetCallback, FileSplitDataSetIterator,
                       export_dataset_batches, load_dataset, save_dataset)
+from .dataset import (AsyncShieldDataSetIterator,
+                      AsyncShieldMultiDataSetIterator, CombinedPreProcessor,
+                      DataSetPreProcessor, DoublesDataSetIterator,
+                      DummyPreProcessor, FileSplitParallelDataSetIterator,
+                      FloatsDataSetIterator, IteratorDataSetIterator,
+                      JointParallelDataSetIterator,
+                      MultiDataSetWrapperIterator,
+                      PreProcessedDataSetIterator,
+                      ReconstructionDataSetIterator)
 from .transforms import (ComposeTransform, CutoutTransform,
                          ImageTransform, RandomCropTransform,
                          RandomFlipTransform, TransformingDataSetIterator)
@@ -38,5 +47,11 @@ __all__ = [
     "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "load_normalizer", "ImageTransform", "RandomFlipTransform",
     "RandomCropTransform", "CutoutTransform", "ComposeTransform",
-    "TransformingDataSetIterator",
+    "TransformingDataSetIterator", "AsyncShieldDataSetIterator",
+    "AsyncShieldMultiDataSetIterator", "CombinedPreProcessor",
+    "DataSetPreProcessor", "DoublesDataSetIterator", "DummyPreProcessor",
+    "FileSplitParallelDataSetIterator", "FloatsDataSetIterator",
+    "IteratorDataSetIterator", "JointParallelDataSetIterator",
+    "MultiDataSetWrapperIterator", "PreProcessedDataSetIterator",
+    "ReconstructionDataSetIterator",
 ]

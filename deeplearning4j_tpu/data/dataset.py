@@ -266,6 +266,12 @@ class AsyncDataSetIterator(DataSetIterator):
     _SENTINEL = object()
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 4):
+        # AsyncShieldDataSetIterator is defined below in this module; it
+        # exists by the time any caller constructs an async wrapper
+        if isinstance(underlying, AsyncShieldDataSetIterator):
+            raise ValueError(
+                "iterator is wrapped in AsyncShieldDataSetIterator — it must "
+                "not be prefetched from a background thread")
         self.underlying = underlying
         self.queue_size = queue_size
 
@@ -441,3 +447,295 @@ class MultiDataSet:
 # iterator yields), so the MultiDataSet variant (reference
 # ``AsyncMultiDataSetIterator``) is the same class.
 AsyncMultiDataSetIterator = AsyncDataSetIterator
+
+
+class DataSetPreProcessor:
+    """``pre_process(DataSet) -> None`` contract (nd4j DataSetPreProcessor;
+    mutates the batch in place before the model sees it)."""
+
+    def pre_process(self, ds: DataSet) -> None:
+        raise NotImplementedError
+
+
+class DummyPreProcessor(DataSetPreProcessor):
+    """No-op (reference ``DummyPreProcessor.java``)."""
+
+    def pre_process(self, ds: DataSet) -> None:
+        pass
+
+
+class CombinedPreProcessor(DataSetPreProcessor):
+    """Apply several preprocessors in order (reference
+    ``CombinedPreProcessor.java`` builder; also serves the
+    CombinedMultiDataSetPreProcessor role — members just need
+    ``pre_process``)."""
+
+    def __init__(self, *pre_processors: DataSetPreProcessor):
+        self.pre_processors = list(pre_processors)
+
+    def add_pre_processor(self, pp: DataSetPreProcessor) -> "CombinedPreProcessor":
+        self.pre_processors.append(pp)
+        return self
+
+    def pre_process(self, ds: DataSet) -> None:
+        for pp in self.pre_processors:
+            pp.pre_process(ds)
+
+
+class PreProcessedDataSetIterator(DataSetIterator):
+    """Wrap an iterator, applying a DataSetPreProcessor to every batch (the
+    reference attaches this via ``DataSetIterator.setPreProcessor``)."""
+
+    def __init__(self, iterator: DataSetIterator,
+                 pre_processor: DataSetPreProcessor):
+        self.iterator = iterator
+        self.pre_processor = pre_processor
+
+    def reset(self):
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+
+    def batch(self):
+        return self.iterator.batch()
+
+    def __iter__(self):
+        for ds in self.iterator:
+            self.pre_processor.pre_process(ds)
+            yield ds
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Marker wrapper that prevents async prefetching of the underlying
+    iterator (reference ``AsyncShieldDataSetIterator.java``: used when
+    batches must be produced on the training thread, e.g. the source is not
+    thread-safe).  ``AsyncDataSetIterator`` refuses to wrap it."""
+
+    def __init__(self, iterator: DataSetIterator):
+        self.iterator = iterator
+
+    def reset(self):
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+
+    def batch(self):
+        return self.iterator.batch()
+
+    def __iter__(self):
+        return iter(self.iterator)
+
+
+#: reference has a separate AsyncShieldMultiDataSetIterator; MultiDataSet
+#: batches flow through the same wrapper here
+AsyncShieldMultiDataSetIterator = AsyncShieldDataSetIterator
+
+
+class _PairsDataSetIterator(DataSetIterator):
+    """Batched iteration over an iterable of (features, labels) pairs."""
+
+    _dtype = np.float32
+
+    def __init__(self, pairs, batch_size: int):
+        self._pairs = list(pairs)
+        self.batch_size = batch_size
+
+    def batch(self):
+        return self.batch_size
+
+    def __iter__(self):
+        for i in range(0, len(self._pairs), self.batch_size):
+            chunk = self._pairs[i:i + self.batch_size]
+            f = np.stack([np.asarray(p[0], dtype=self._dtype) for p in chunk])
+            l = np.stack([np.asarray(p[1], dtype=self._dtype) for p in chunk])
+            yield DataSet(f, l)
+
+
+class FloatsDataSetIterator(_PairsDataSetIterator):
+    """(float32) reference ``FloatsDataSetIterator.java``."""
+    _dtype = np.float32
+
+
+class DoublesDataSetIterator(_PairsDataSetIterator):
+    """(float64) reference ``DoublesDataSetIterator.java``."""
+    _dtype = np.float64
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch an iterator of DataSets to a target minibatch size
+    (reference ``IteratorDataSetIterator.java``: splits/joins incoming
+    examples so every yielded batch has ``batch_size`` rows; also serves the
+    IteratorMultiDataSetIterator role for single-input sets)."""
+
+    def __init__(self, iterator, batch_size: int):
+        self._source = iterator
+        self.batch_size = batch_size
+
+    def batch(self):
+        return self.batch_size
+
+    def reset(self):
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+
+    def __iter__(self):
+        # four parallel buffers: features, labels, and the optional masks
+        # (masks must survive re-batching — dropping them would silently
+        # un-mask padded RNN timesteps); a mask column is kept only while
+        # every incoming batch provides it
+        bufs = [[], [], [], []]
+        have = 0
+        has_mask = [True, True]
+
+        def _emit(lo, hi):
+            cat = [np.concatenate(b)[lo:hi] if b else None for b in bufs]
+            return DataSet(cat[0], cat[1],
+                           cat[2] if has_mask[0] else None,
+                           cat[3] if has_mask[1] else None)
+
+        def _trim(b, keep):
+            return [np.concatenate(b)[keep:]] if b else []
+
+        for ds in self._source:
+            parts = [np.asarray(ds.features), np.asarray(ds.labels),
+                     ds.features_mask, ds.labels_mask]
+            for j in range(2):
+                if parts[2 + j] is None:
+                    has_mask[j] = False
+                elif has_mask[j]:
+                    bufs[2 + j].append(np.asarray(parts[2 + j]))
+            bufs[0].append(parts[0])
+            bufs[1].append(parts[1])
+            have += parts[0].shape[0]
+            while have >= self.batch_size:
+                yield _emit(0, self.batch_size)
+                bufs = [_trim(b, self.batch_size) for b in bufs]
+                have = bufs[0][0].shape[0] if bufs[0] else 0
+        if have:
+            yield _emit(0, None)
+
+
+class MultiDataSetWrapperIterator(DataSetIterator):
+    """Adapt a single-input/single-output MultiDataSet iterator to the
+    DataSet protocol (reference ``MultiDataSetWrapperIterator.java``)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def reset(self):
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+
+    def batch(self):
+        return self.iterator.batch()
+
+    def __iter__(self):
+        for mds in self.iterator:
+            feats, labels = mds.features, mds.labels
+            if isinstance(feats, (list, tuple)):
+                if len(feats) != 1:
+                    raise ValueError(
+                        "MultiDataSetWrapperIterator needs exactly one input "
+                        f"array, got {len(feats)}")
+                feats = feats[0]
+            if isinstance(labels, (list, tuple)):
+                if len(labels) != 1:
+                    raise ValueError(
+                        "MultiDataSetWrapperIterator needs exactly one output "
+                        f"array, got {len(labels)}")
+                labels = labels[0]
+            yield DataSet(feats, labels)
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """labels := features (autoencoder targets; reference
+    ``ReconstructionDataSetIterator.java``)."""
+
+    def __init__(self, iterator: DataSetIterator):
+        self.iterator = iterator
+
+    def reset(self):
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+
+    def batch(self):
+        return self.iterator.batch()
+
+    def __iter__(self):
+        for ds in self.iterator:
+            yield DataSet(ds.features, ds.features,
+                          ds.features_mask, ds.features_mask)
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Interleave several source iterators round-robin (reference
+    ``parallel/JointParallelDataSetIterator.java`` with ``InequalityHandling``
+    for sources of different length: ``stop`` ends the epoch when any source
+    is exhausted, ``pass`` skips exhausted sources, ``reset`` restarts an
+    exhausted source — the reference's STOP_EVERYONE / PASS_NULL /
+    RESET per-source policy enums)."""
+
+    def __init__(self, *iterators, inequality: str = "pass"):
+        if inequality not in ("stop", "pass", "reset"):
+            raise ValueError(f"unknown inequality handling '{inequality}'; "
+                             "expected stop|pass|reset")
+        self.iterators = list(iterators)
+        self.inequality = inequality
+
+    def reset(self):
+        for it in self.iterators:
+            if hasattr(it, "reset"):
+                it.reset()
+
+    def batch(self):
+        return self.iterators[0].batch()
+
+    def __iter__(self):
+        actives = [iter(it) for it in self.iterators]
+        exhausted = [False] * len(actives)   # stop yielding from this source
+        drained = [False] * len(actives)     # has run dry at least once
+        while True:
+            progressed = False
+            for i, src in enumerate(actives):
+                if exhausted[i]:
+                    continue
+                try:
+                    yield next(src)
+                    progressed = True
+                except StopIteration:
+                    drained[i] = True
+                    if self.inequality == "stop":
+                        return
+                    if self.inequality == "reset":
+                        # epoch ends once EVERY source has run dry once
+                        # (reference RESET policy) — until then, restart
+                        if all(drained):
+                            return
+                        if hasattr(self.iterators[i], "reset"):
+                            self.iterators[i].reset()
+                        actives[i] = iter(self.iterators[i])
+                        try:
+                            yield next(actives[i])
+                            progressed = True
+                            continue
+                        except StopIteration:
+                            pass
+                    exhausted[i] = True
+            if all(exhausted) or not progressed:
+                return
+
+
+class FileSplitParallelDataSetIterator(JointParallelDataSetIterator):
+    """Joint-parallel iteration over saved dataset files matching a pattern
+    (reference ``parallel/FileSplitParallelDataSetIterator.java``: one
+    FileSplitDataSetIterator per shard, interleaved)."""
+
+    def __init__(self, directory, n_shards: int = 2,
+                 inequality: str = "pass"):
+        # FileSplitDataSetIterator already owns the interleaved sharding
+        # (worker/num_workers); this class just joins the shards
+        shards = [FileSplitDataSetIterator(directory, worker=i,
+                                           num_workers=n_shards)
+                  for i in range(n_shards)]
+        shards = [s for s in shards if s.paths]
+        if not shards:
+            raise FileNotFoundError(f"no .bin dataset files in {directory}")
+        super().__init__(*shards, inequality=inequality)

@@ -167,6 +167,13 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
     the normal per-batch path.
     """
     n = int(xs[0].shape[0])
+    for a in list(xs) + list(ys):
+        if int(a.shape[0]) != n:
+            # jnp gather clamps out-of-range indices, so a mismatch would
+            # silently train on duplicated rows rather than erroring
+            raise ValueError(
+                f"all inputs/labels need the same leading dimension; got "
+                f"{[int(b.shape[0]) for b in list(xs) + list(ys)]}")
     nb = n // batch_size
     if nb == 0:
         raise ValueError(f"batch_size {batch_size} exceeds dataset ({n})")

@@ -1,0 +1,51 @@
+"""Clustering + embedding visualization end to end: KMeans with the strategy
+framework, t-SNE projection, and the UI embedding viewer (reference
+workflow: BarnesHutTsne → CSV → /tsne upload page).
+
+Run: JAX_PLATFORMS=cpu python examples/clustering_tsne_ui.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.clustering import (BaseClusteringAlgorithm,
+                                           ClusteringOptimizationType,
+                                           KMeansClustering,
+                                           OptimisationStrategy)
+from deeplearning4j_tpu.ui import UIServer, coords_to_csv_lines, upload_tsne
+from deeplearning4j_tpu.ui.renders import embedding_coords
+
+
+def main():
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((4, 16)) * 6.0
+    pts = np.concatenate([c + rng.standard_normal((60, 16))
+                          for c in centers]).astype(np.float32)
+
+    # fixed-count KMeans
+    cs = KMeansClustering.setup(4, max_iterations=40, seed=0).apply_to(pts)
+    print("kmeans cost:", round(cs.cost, 2), "iterations:", cs.iterations)
+
+    # optimisation strategy: grow clusters until max point-to-center <= 8
+    strat = (OptimisationStrategy.setup(1)
+             .optimize(ClusteringOptimizationType
+                       .MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE, 8.0))
+    strat.end_when_distribution_variation_rate_less_than(1e-3)
+    algo = BaseClusteringAlgorithm.setup(strat, seed=0, max_iterations=30)
+    grown = algo.apply_to(pts)
+    print("optimisation strategy grew to", grown.centers.shape[0], "clusters")
+
+    # project to 2-D and publish to the UI's embedding viewer
+    coords = embedding_coords(pts, method="tsne", max_iter=250)
+    labels = [f"c{a}" for a in cs.assignments]
+    server = UIServer(port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        upload_tsne(url, coords, labels=labels, session_id="kmeans-demo")
+        print(f"embedding viewer live at {url}/tsne (session 'kmeans-demo';"
+              " Ctrl-C to stop in a real session)")
+        print("first csv line:", coords_to_csv_lines(coords, labels)[0])
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

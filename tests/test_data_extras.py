@@ -336,3 +336,140 @@ class TestImageTransforms:
         x = np.arange(2 * 2 * 3 * 1, dtype=np.float32).reshape(2, 2, 3, 1)
         out = RandomFlipTransform(p=1.0).transform(x, rng)
         np.testing.assert_allclose(out, x[:, :, ::-1])
+
+
+class TestIteratorFamilyCompleteness:
+    """Remaining reference iterator classes (datasets/iterator/ listing)."""
+
+    def _src(self, n=20, batch=5):
+        from deeplearning4j_tpu.data import INDArrayDataSetIterator
+        rng = np.random.default_rng(0)
+        return INDArrayDataSetIterator(
+            rng.standard_normal((n, 3)).astype(np.float32),
+            np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)], batch)
+
+    def test_preprocessors_and_wrapper(self):
+        from deeplearning4j_tpu.data import (CombinedPreProcessor,
+                                             DataSetPreProcessor,
+                                             DummyPreProcessor,
+                                             PreProcessedDataSetIterator)
+
+        class Scale(DataSetPreProcessor):
+            def pre_process(self, ds):
+                ds.features = ds.features * 2.0
+
+        it = PreProcessedDataSetIterator(
+            self._src(), CombinedPreProcessor(DummyPreProcessor(), Scale(),
+                                              Scale()))
+        raw = next(iter(self._src()))
+        processed = next(iter(it))
+        np.testing.assert_allclose(processed.features, raw.features * 4.0)
+
+    def test_async_shield_refuses_prefetch(self):
+        from deeplearning4j_tpu.data import (AsyncDataSetIterator,
+                                             AsyncShieldDataSetIterator)
+        shielded = AsyncShieldDataSetIterator(self._src())
+        assert len(list(shielded)) == 4
+        with pytest.raises(ValueError, match="AsyncShield"):
+            AsyncDataSetIterator(shielded)
+
+    def test_floats_doubles_iterators(self):
+        from deeplearning4j_tpu.data import (DoublesDataSetIterator,
+                                             FloatsDataSetIterator)
+        pairs = [([1.0, 2.0], [1.0, 0.0]) for _ in range(7)]
+        fl = list(FloatsDataSetIterator(pairs, batch_size=3))
+        assert [b.features.shape[0] for b in fl] == [3, 3, 1]
+        assert fl[0].features.dtype == np.float32
+        db = list(DoublesDataSetIterator(pairs, batch_size=4))
+        assert db[0].features.dtype == np.float64
+
+    def test_iterator_rebatching(self):
+        from deeplearning4j_tpu.data import IteratorDataSetIterator
+        it = IteratorDataSetIterator(self._src(n=20, batch=3), batch_size=8)
+        sizes = [b.features.shape[0] for b in it]
+        assert sizes == [8, 8, 4]
+
+    def test_multidataset_wrapper_and_reconstruction(self):
+        from deeplearning4j_tpu.data import (MultiDataSet,
+                                             MultiDataSetWrapperIterator,
+                                             ReconstructionDataSetIterator)
+        rng = np.random.default_rng(1)
+        mds = [MultiDataSet([rng.standard_normal((4, 3))],
+                            [rng.standard_normal((4, 2))]) for _ in range(3)]
+
+        class _MdsIt:
+            def __iter__(self):
+                return iter(mds)
+            def batch(self):
+                return 4
+
+        ds = list(MultiDataSetWrapperIterator(_MdsIt()))
+        assert len(ds) == 3 and ds[0].features.shape == (4, 3)
+        rec = next(iter(ReconstructionDataSetIterator(self._src())))
+        np.testing.assert_array_equal(rec.features, rec.labels)
+
+    def test_joint_parallel_modes(self):
+        from deeplearning4j_tpu.data import JointParallelDataSetIterator
+        short, long_ = self._src(n=10, batch=5), self._src(n=20, batch=5)
+        # pass: exhausted source skipped -> 2 + 4 batches
+        j = JointParallelDataSetIterator(short, long_, inequality="pass")
+        assert len(list(j)) == 6
+        # stop: ends when the short one runs dry
+        j = JointParallelDataSetIterator(self._src(n=10, batch=5),
+                                         self._src(n=20, batch=5),
+                                         inequality="stop")
+        assert len(list(j)) <= 5
+        with pytest.raises(ValueError, match="inequality"):
+            JointParallelDataSetIterator(short, inequality="bogus")
+
+    def test_file_split_parallel(self, tmp_path):
+        from deeplearning4j_tpu.data import (FileSplitParallelDataSetIterator,
+                                             export_dataset_batches)
+        export_dataset_batches(self._src(n=20, batch=5), tmp_path)
+        it = FileSplitParallelDataSetIterator(tmp_path, n_shards=2)
+        batches = list(it)
+        assert len(batches) == 4
+        assert sum(b.features.shape[0] for b in batches) == 20
+
+    def test_joint_parallel_reset_terminates(self):
+        """Regression: reset mode ends once every source has drained once
+        (it used to loop forever with >=2 non-empty sources)."""
+        from deeplearning4j_tpu.data import JointParallelDataSetIterator
+        j = JointParallelDataSetIterator(self._src(n=10, batch=5),
+                                         self._src(n=20, batch=5),
+                                         inequality="reset")
+        batches = list(j)  # must terminate
+        # short source restarts until the long one drains: >= 4 + 2 batches
+        assert 6 <= len(batches) <= 9
+
+    def test_rebatching_preserves_masks(self):
+        from deeplearning4j_tpu.data import (DataSet, ExistingDataSetIterator,
+                                             IteratorDataSetIterator)
+        rng = np.random.default_rng(2)
+        sets = [DataSet(rng.standard_normal((4, 6, 3)).astype(np.float32),
+                        rng.standard_normal((4, 6, 2)).astype(np.float32),
+                        (rng.random((4, 6)) > 0.3).astype(np.float32))
+                for _ in range(3)]
+        out = list(IteratorDataSetIterator(ExistingDataSetIterator(sets),
+                                           batch_size=5))
+        assert [b.features.shape[0] for b in out] == [5, 5, 2]
+        stacked = np.concatenate([b.features_mask for b in out])
+        expect = np.concatenate([s.features_mask for s in sets])
+        np.testing.assert_array_equal(stacked, expect)
+        assert out[0].labels_mask is None  # never provided -> stays absent
+
+    def test_fit_on_device_leading_dim_mismatch(self):
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                              OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="leading dimension"):
+            net.fit_on_device(np.zeros((10, 3), np.float32),
+                              np.zeros((8, 2), np.float32), batch_size=4)
