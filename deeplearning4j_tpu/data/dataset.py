@@ -627,22 +627,27 @@ class MultiDataSetWrapperIterator(DataSetIterator):
     def batch(self):
         return self.iterator.batch()
 
+    @staticmethod
+    def _single(value, kind: str, required: bool = False):
+        if isinstance(value, (list, tuple)):
+            if len(value) != 1:
+                if not value and not required:
+                    return None
+                raise ValueError(
+                    f"MultiDataSetWrapperIterator needs exactly one {kind} "
+                    f"array, got {len(value)}")
+            return value[0]
+        return value
+
     def __iter__(self):
         for mds in self.iterator:
-            feats, labels = mds.features, mds.labels
-            if isinstance(feats, (list, tuple)):
-                if len(feats) != 1:
-                    raise ValueError(
-                        "MultiDataSetWrapperIterator needs exactly one input "
-                        f"array, got {len(feats)}")
-                feats = feats[0]
-            if isinstance(labels, (list, tuple)):
-                if len(labels) != 1:
-                    raise ValueError(
-                        "MultiDataSetWrapperIterator needs exactly one output "
-                        f"array, got {len(labels)}")
-                labels = labels[0]
-            yield DataSet(feats, labels)
+            yield DataSet(
+                self._single(mds.features, "input", required=True),
+                self._single(mds.labels, "output", required=True),
+                self._single(getattr(mds, "features_mask", None),
+                             "input mask"),
+                self._single(getattr(mds, "labels_mask", None),
+                             "label mask"))
 
 
 class ReconstructionDataSetIterator(DataSetIterator):

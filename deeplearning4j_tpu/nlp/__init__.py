@@ -10,6 +10,8 @@ from .cjk import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
 from .glove import Glove
 from .inverted_index import InvertedIndex, KeywordExtractor
 from .lookup_table import InMemoryLookupTable
+from .moving_window import (ContextLabelRetriever, Window, WindowConverter,
+                            windows)
 from .paragraph_vectors import ParagraphVectors
 from .sentence_iterator import (AggregatingSentenceIterator, BasicLineIterator,
                                 CollectionSentenceIterator,
@@ -35,6 +37,7 @@ from .word2vec import Word2Vec
 from .word_vectors import WordVectors
 
 __all__ = [
+    "Window", "windows", "WindowConverter", "ContextLabelRetriever",
     "PosTagger", "SentenceSegmenter", "UimaSentenceIterator",
     "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
     "KoreanTokenizerFactory", "InvertedIndex", "KeywordExtractor",

@@ -162,3 +162,55 @@ class TestTimeSeriesUtils:
         out = np.asarray(reshape_time_series_mask(m, 3))
         assert out.shape == (4, 3)
         np.testing.assert_allclose(out[1], 0)
+
+
+class TestMovingWindow:
+    """text/movingwindow package (Windows/Window/WindowConverter/
+    ContextLabelRetriever)."""
+
+    def test_windows_padding_and_focus(self):
+        from deeplearning4j_tpu.nlp.moving_window import windows
+        ws = windows("the quick brown fox", window_size=5)
+        assert len(ws) == 4
+        assert ws[0].words == ["<s>", "<s>", "the", "quick", "brown"]
+        assert ws[0].focus_word() == "the"
+        assert ws[-1].focus_word() == "fox"
+        assert ws[-1].words == ["quick", "brown", "fox", "</s>", "</s>"]
+        with pytest.raises(ValueError, match="odd"):
+            from deeplearning4j_tpu.nlp.moving_window import windows as _w
+            _w("a b c", window_size=4)
+
+    def test_window_converter(self):
+        from deeplearning4j_tpu.nlp.moving_window import (WindowConverter,
+                                                          windows)
+
+        class _Vec:
+            class lookup_table:
+                syn0 = np.zeros((3, 4))
+            @staticmethod
+            def get_word_vector(w):
+                return {"a": np.ones(4), "b": np.full(4, 2.0)}.get(w)
+
+        ws = windows("a b a", window_size=3)
+        m = WindowConverter.as_example_matrix(ws[1], _Vec())
+        assert m.shape == (3, 4)
+        np.testing.assert_array_equal(m[0], np.ones(4))
+        np.testing.assert_array_equal(m[1], np.full(4, 2.0))
+        flat = WindowConverter.as_example_array(ws[1], _Vec(), normalize=True)
+        assert flat.shape == (12,)
+        assert abs(np.linalg.norm(flat) - 1.0) < 1e-6
+
+    def test_context_label_retriever(self):
+        from deeplearning4j_tpu.nlp.moving_window import ContextLabelRetriever
+        text, spans = ContextLabelRetriever.string_with_labels(
+            "the <PER> john smith </PER> went to <LOC> paris </LOC> today")
+        assert text == "the john smith went to paris today"
+        assert spans == {"PER": [(1, 3)], "LOC": [(5, 6)]}
+        # repeated labels keep every span (multimap semantics)
+        _, multi = ContextLabelRetriever.string_with_labels(
+            "<PER> john </PER> met <PER> mary </PER>")
+        assert multi == {"PER": [(0, 1), (2, 3)]}
+        with pytest.raises(ValueError, match="unclosed"):
+            ContextLabelRetriever.string_with_labels("<PER> john")
+        with pytest.raises(ValueError, match="mismatched"):
+            ContextLabelRetriever.string_with_labels("<PER> x </LOC>")
