@@ -27,8 +27,8 @@ class Window:
                  begin: int, end: int):
         self.words = list(words)
         self.window_size = window_size
-        self.begin = begin
-        self.end = end
+        self.begin = begin   # token index of the window's first slot
+        self.end = end       # token index of the window's last slot
         self.median = len(self.words) // 2
         self.label = "NONE"
 
@@ -36,10 +36,12 @@ class Window:
         return self.words[self.median]
 
     def is_begin_label(self) -> bool:
-        return self.begin == 0
+        """Window touches the sentence start (contains <s> padding)."""
+        return self.begin < 0
 
     def is_end_label(self) -> bool:
-        return self.end == 0
+        """Window touches the sentence end (contains </s> padding)."""
+        return "</s>" in self.words
 
     def __repr__(self):
         return f"Window({' '.join(self.words)} @ {self.focus_word()})"

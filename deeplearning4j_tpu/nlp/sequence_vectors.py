@@ -88,13 +88,14 @@ class _PairBatcher:
         self.count -= taken
         return ctx, center, taken, seen_sum / max(taken, 1)
 
-    def drain(self, vocab_words, table, rng, force=False):
+    def drain(self, vocab_words, table, rng, force=False, hs_tables=None):
         taken = self._take(force)
         if taken is None:
             return None
         ctx, center, n, seen_mean = taken
         batch = _label_arrays(center, n, self.B, self.C, self.K,
-                              vocab_words, table, rng, use_hs=self.use_hs)
+                              vocab_words, table, rng, use_hs=self.use_hs,
+                              hs_tables=hs_tables)
         return (ctx,) + batch + (seen_mean,)
 
     def drain_pairs(self, force=False):
@@ -102,24 +103,45 @@ class _PairBatcher:
         return self._take(force)
 
 
-def _label_arrays(center, n, B, C, K, vocab_words, table, rng, use_hs=True):
+def build_hs_tables(vocab_words, C):
+    """Vocab-level padded Huffman tables [V, C] (points/codes/mask): one
+    fancy-index per batch replaces the per-row HS lookup loop.  Built once
+    per fit by the caller (no global cache — vocab lists are rebuilt and
+    Huffman codes mutated across fits, so identity-keyed caching is unsafe)."""
+    V = len(vocab_words)
+    pts = np.zeros((V, C), dtype=np.int32)
+    cds = np.zeros((V, C), dtype=np.float32)
+    msk = np.zeros((V, C), dtype=np.float32)
+    for i, vw in enumerate(vocab_words):
+        L = min(len(vw.codes), C)
+        if L:
+            pts[i, :L] = vw.points[:L]
+            cds[i, :L] = vw.codes[:L]
+            msk[i, :L] = 1.0
+    return pts, cds, msk
+
+
+def _label_arrays(center, n, B, C, K, vocab_words, table, rng, use_hs=True,
+                  hs_tables=None):
     """HS codes/points + negative samples for each batch row's center word.
 
     Masks gate the two objectives independently, matching the reference's
     ``isUseHierarchicSoftmax`` / ``negative > 0`` branches
     (SkipGram.java:236-257): HS disabled → code_mask stays zero; negative
     sampling disabled → neg_mask stays zero (including the positive column).
+    ``hs_tables``: precomputed ``build_hs_tables`` output; built on the fly
+    when absent.
     """
     points = np.zeros((B, C), dtype=np.int32)
     codes = np.zeros((B, C), dtype=np.float32)
     code_mask = np.zeros((B, C), dtype=np.float32)
-    for r in range(n if use_hs else 0):
-        vw = vocab_words[center[r]]
-        L = min(len(vw.codes), C)
-        if L:
-            points[r, :L] = vw.points[:L]
-            codes[r, :L] = vw.codes[:L]
-            code_mask[r, :L] = 1.0
+    if use_hs:
+        pts_t, cds_t, msk_t = hs_tables if hs_tables is not None \
+            else build_hs_tables(vocab_words, C)
+        idx = center[:n]
+        points[:n] = pts_t[idx]
+        codes[:n] = cds_t[idx]
+        code_mask[:n] = msk_t[idx]
     neg = np.zeros((B, K + 1), dtype=np.int32)
     neg_label = np.zeros((B, K + 1), dtype=np.float32)
     neg_mask = np.zeros((B, K + 1), dtype=np.float32)
@@ -214,6 +236,8 @@ class SequenceVectors(WordVectors):
         # pair indices per step; negatives come from the HBM-resident table
         fast_ns = (is_skipgram and not self.use_hs and self.negative > 0
                    and lt.table is not None and len(lt.table))
+        hs_tables = build_hs_tables(vocab_words, code_len) if self.use_hs \
+            else None
         key = jax.random.PRNGKey(self.seed) if fast_ns else None
         if fast_ns:
             table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
@@ -249,7 +273,8 @@ class SequenceVectors(WordVectors):
                         jnp.asarray(cens), jnp.asarray(n_valids), sub,
                         jnp.asarray(alphas), self.negative)
                 elif is_skipgram:
-                    b = batcher.drain(vocab_words, lt.table, rng, force=force)
+                    b = batcher.drain(vocab_words, lt.table, rng,
+                                      force=force, hs_tables=hs_tables)
                     if b is None:
                         return
                     ctx, _center, pts, cds, cm, neg, nl, nm, seen_mean = b
@@ -259,7 +284,8 @@ class SequenceVectors(WordVectors):
                         jnp.asarray(neg), jnp.asarray(nl), jnp.asarray(nm),
                         jnp.float32(decay(seen_mean)))
                 else:
-                    b = self._drain_cbow(vocab_words, lt.table, rng, force)
+                    b = self._drain_cbow(vocab_words, lt.table, rng, force,
+                                         hs_tables=hs_tables)
                     if b is None:
                         return
                     ctxw, cmask, _center, pts, cds, cm, neg, nl, nm = b
@@ -327,7 +353,7 @@ class SequenceVectors(WordVectors):
                 if ctx:
                     self._cbow_buf.append((ctx, int(idxs[i])))
 
-    def _drain_cbow(self, vocab_words, table, rng, force):
+    def _drain_cbow(self, vocab_words, table, rng, force, hs_tables=None):
         B = self.batch_size
         if not self._cbow_buf or (len(self._cbow_buf) < B and not force):
             return None
@@ -348,5 +374,6 @@ class SequenceVectors(WordVectors):
         code_len = max((vw.code_length for vw in vocab_words), default=1)
         code_len = min(max(code_len, 1), self.max_code_length)
         rest = _label_arrays(center, n, B, code_len, self.negative,
-                             vocab_words, table, rng, use_hs=self.use_hs)
+                             vocab_words, table, rng, use_hs=self.use_hs,
+                             hs_tables=hs_tables)
         return (ctxw, cmask) + rest

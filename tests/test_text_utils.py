@@ -214,3 +214,10 @@ class TestMovingWindow:
             ContextLabelRetriever.string_with_labels("<PER> john")
         with pytest.raises(ValueError, match="mismatched"):
             ContextLabelRetriever.string_with_labels("<PER> x </LOC>")
+
+    def test_window_boundary_flags(self):
+        from deeplearning4j_tpu.nlp.moving_window import windows
+        ws = windows("a b c d e", window_size=3)
+        assert ws[0].is_begin_label() and not ws[0].is_end_label()
+        assert not ws[2].is_begin_label() and not ws[2].is_end_label()
+        assert ws[-1].is_end_label() and not ws[-1].is_begin_label()
