@@ -24,11 +24,12 @@ class Window:
     focus word at the median position, an optional label."""
 
     def __init__(self, words: Sequence[str], window_size: int,
-                 begin: int, end: int):
+                 begin: int, end: int, n_tokens: Optional[int] = None):
         self.words = list(words)
         self.window_size = window_size
-        self.begin = begin   # token index of the window's first slot
-        self.end = end       # token index of the window's last slot
+        self.begin = begin       # token index of the window's first slot
+        self.end = end           # token index of the window's last slot
+        self.n_tokens = n_tokens  # sentence length (boundary detection)
         self.median = len(self.words) // 2
         self.label = "NONE"
 
@@ -40,8 +41,10 @@ class Window:
         return self.begin < 0
 
     def is_end_label(self) -> bool:
-        """Window touches the sentence end (contains </s> padding)."""
-        return "</s>" in self.words
+        """Window touches the sentence end (contains </s> padding).  Index
+        based, like is_begin_label — a literal '</s>' input token must not
+        fake a boundary."""
+        return self.n_tokens is not None and self.end >= self.n_tokens
 
     def __repr__(self):
         return f"Window({' '.join(self.words)} @ {self.focus_word()})"
@@ -79,7 +82,8 @@ def windows(text_or_tokens, window_size: int = 5,
                 ctx.append("</s>")
             else:
                 ctx.append(tokens[j])
-        out.append(Window(ctx, window_size, i - half, i + half))
+        out.append(Window(ctx, window_size, i - half, i + half,
+                          n_tokens=len(tokens)))
     return out
 
 

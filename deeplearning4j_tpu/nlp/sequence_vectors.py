@@ -104,20 +104,25 @@ class _PairBatcher:
 
 
 def build_hs_tables(vocab_words, C):
-    """Vocab-level padded Huffman tables [V, C] (points/codes/mask): one
+    """Vocab-level padded Huffman tables [V, C'] (points/codes/mask): one
     fancy-index per batch replaces the per-row HS lookup loop.  Built once
     per fit by the caller (no global cache — vocab lists are rebuilt and
-    Huffman codes mutated across fits, so identity-keyed caching is unsafe)."""
+    Huffman codes mutated across fits, so identity-keyed caching is unsafe).
+    Width is capped to the actual max code length and codes/mask are uint8:
+    HS is the big-vocab objective, so these tables are sized with care
+    (V=1M, C'=25: ~100MB points + ~50MB codes/mask)."""
     V = len(vocab_words)
+    C = min(C, max((len(vw.codes) for vw in vocab_words), default=1))
+    C = max(C, 1)
     pts = np.zeros((V, C), dtype=np.int32)
-    cds = np.zeros((V, C), dtype=np.float32)
-    msk = np.zeros((V, C), dtype=np.float32)
+    cds = np.zeros((V, C), dtype=np.uint8)
+    msk = np.zeros((V, C), dtype=np.uint8)
     for i, vw in enumerate(vocab_words):
         L = min(len(vw.codes), C)
         if L:
             pts[i, :L] = vw.points[:L]
             cds[i, :L] = vw.codes[:L]
-            msk[i, :L] = 1.0
+            msk[i, :L] = 1
     return pts, cds, msk
 
 
@@ -139,9 +144,10 @@ def _label_arrays(center, n, B, C, K, vocab_words, table, rng, use_hs=True,
         pts_t, cds_t, msk_t = hs_tables if hs_tables is not None \
             else build_hs_tables(vocab_words, C)
         idx = center[:n]
-        points[:n] = pts_t[idx]
-        codes[:n] = cds_t[idx]
-        code_mask[:n] = msk_t[idx]
+        Ct = min(pts_t.shape[1], C)   # tables are capped to real code length
+        points[:n, :Ct] = pts_t[idx, :Ct]
+        codes[:n, :Ct] = cds_t[idx, :Ct]
+        code_mask[:n, :Ct] = msk_t[idx, :Ct]
     neg = np.zeros((B, K + 1), dtype=np.int32)
     neg_label = np.zeros((B, K + 1), dtype=np.float32)
     neg_mask = np.zeros((B, K + 1), dtype=np.float32)
@@ -371,8 +377,11 @@ class SequenceVectors(WordVectors):
             ctxw[r, :len(c)] = c
             cmask[r, :len(c)] = 1.0
             center[r] = t
-        code_len = max((vw.code_length for vw in vocab_words), default=1)
-        code_len = min(max(code_len, 1), self.max_code_length)
+        if hs_tables is not None:
+            code_len = hs_tables[0].shape[1]   # the tables fix the width
+        else:
+            code_len = max((vw.code_length for vw in vocab_words), default=1)
+            code_len = min(max(code_len, 1), self.max_code_length)
         rest = _label_arrays(center, n, B, code_len, self.negative,
                              vocab_words, table, rng, use_hs=self.use_hs,
                              hs_tables=hs_tables)

@@ -7,10 +7,12 @@ from .renders import (coords_to_csv_lines, embedding_coords,
                       render_word_scatter, upload_tsne)
 from .server import RemoteUIStatsStorageRouter, UIServer
 from .stats import StatsListener, StatsReport, array_stats
-from .storage import FileStatsStorage, InMemoryStatsStorage, StatsStorage
+from .storage import (FileStatsStorage, InMemoryStatsStorage,
+                      SqliteStatsStorage, StatsStorage)
 
 __all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
-           "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
+           "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+           "UIServer",
            "RemoteUIStatsStorageRouter", "UiConnectionInfo", "ChartLine",
            "ChartScatter", "ChartHistogram", "ComponentTable",
            "ComponentText", "render_page", "embedding_coords",

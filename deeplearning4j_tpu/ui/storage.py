@@ -17,7 +17,8 @@ from typing import Callable, Dict, List, Optional
 
 from .stats import StatsReport
 
-__all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage"]
+__all__ = ["StatsStorage", "InMemoryStatsStorage", "FileStatsStorage",
+           "SqliteStatsStorage"]
 
 _MAGIC = b"DL4JTPU1"
 
@@ -139,3 +140,65 @@ class FileStatsStorage(StatsStorage):
 
     def close(self):
         self._fh.close()
+
+
+class SqliteStatsStorage(StatsStorage):
+    """SQLite-backed storage (reference ``ui-model/.../ui/storage/sqlite/
+    J7FileStatsStorage.java`` — the embedded-DB backend next to the MapDB
+    file store).  One ``records`` table indexed by (session, worker,
+    iteration); reports persist as JSON blobs.  Safe across threads: each
+    call opens a short-lived connection (sqlite serializes writers)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = str(path)
+        self._exec(
+            "CREATE TABLE IF NOT EXISTS records ("
+            " session_id TEXT NOT NULL,"
+            " worker_id TEXT NOT NULL DEFAULT '',"
+            " iteration INTEGER NOT NULL DEFAULT 0,"
+            " payload TEXT NOT NULL)")
+        self._exec(
+            "CREATE INDEX IF NOT EXISTS idx_records "
+            "ON records (session_id, worker_id, iteration)")
+
+    def _exec(self, sql: str, params: tuple = ()) -> list:
+        """One short-lived connection per call: commit AND close (the
+        sqlite3 context manager only commits)."""
+        import sqlite3
+        from contextlib import closing
+        with closing(sqlite3.connect(self.path)) as conn:
+            with conn:
+                return conn.execute(sql, params).fetchall()
+
+    def _store(self, report: StatsReport) -> None:
+        d = report.to_dict()
+        with self._lock:
+            self._exec(
+                "INSERT INTO records VALUES (?, ?, ?, ?)",
+                (report.session_id, report.worker_id or "",
+                 int(report.iteration or 0), json.dumps(d)))
+
+    def list_session_ids(self) -> List[str]:
+        rows = self._exec("SELECT DISTINCT session_id FROM records")
+        return sorted(r[0] for r in rows)
+
+    def list_worker_ids(self, session_id: str) -> List[str]:
+        rows = self._exec(
+            "SELECT DISTINCT worker_id FROM records WHERE session_id=?",
+            (session_id,))
+        return sorted(r[0] for r in rows)
+
+    def get_records(self, session_id: str,
+                    worker_id: Optional[str] = None) -> List[StatsReport]:
+        # insertion order (rowid), matching the InMemory/File backends —
+        # get_latest_record must agree across storage implementations
+        if worker_id is not None:
+            rows = self._exec(
+                "SELECT payload FROM records WHERE session_id=? AND "
+                "worker_id=? ORDER BY rowid", (session_id, worker_id))
+        else:
+            rows = self._exec(
+                "SELECT payload FROM records WHERE session_id=? "
+                "ORDER BY rowid", (session_id,))
+        return [StatsReport.from_dict(json.loads(r[0])) for r in rows]

@@ -302,3 +302,29 @@ def test_embedding_coords_and_word_scatter(tmp_path):
     out = tmp_path / "words.html"
     html = render_word_scatter(_WV(), path=str(out))
     assert "svg" in html and out.exists()
+
+
+def test_sqlite_stats_storage(tmp_path):
+    """SQLite storage backend (reference ui/storage/sqlite/)."""
+    from deeplearning4j_tpu.ui import SqliteStatsStorage, StatsReport
+    path = str(tmp_path / "stats.db")
+    storage = SqliteStatsStorage(path)
+    seen = []
+    storage.register_listener(seen.append)
+    for it in range(3):
+        storage.put_record(StatsReport(
+            session_id="s1", worker_id="w0", iteration=it, epoch=0,
+            timestamp=it * 1.0, score=1.0 / (it + 1), iter_time_ms=1.0))
+    storage.put_record(StatsReport(session_id="s2", worker_id="w1",
+                                   iteration=0, epoch=0, timestamp=9.0,
+                                   score=0.5, iter_time_ms=1.0))
+    assert len(seen) == 4
+    assert storage.list_session_ids() == ["s1", "s2"]
+    assert storage.list_worker_ids("s1") == ["w0"]
+    recs = storage.get_records("s1")
+    assert [r.iteration for r in recs] == [0, 1, 2]
+    assert storage.get_latest_record("s1").score == pytest.approx(1 / 3)
+    # reopen from disk: records survive the process boundary
+    storage2 = SqliteStatsStorage(path)
+    assert storage2.list_session_ids() == ["s1", "s2"]
+    assert storage2.get_records("s2")[0].worker_id == "w1"
