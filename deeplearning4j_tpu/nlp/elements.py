@@ -175,3 +175,21 @@ def glove_step(w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, rows, cols, xij,
     b_ctx = b_ctx.at[cols].add(-alpha * fdiff / jnp.sqrt(hbc[cols] + 1e-8))
     loss = 0.5 * jnp.sum(fdiff * diff)
     return w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, loss
+
+
+@partial(jax.jit, donate_argnums=tuple(range(8)))
+def glove_epoch(w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, rows_b, cols_b, xij_b,
+                alpha, x_max, exponent):
+    """One GloVe epoch fused into a single dispatch: ``lax.scan`` over
+    pre-batched (nb, B) cooccurrence index arrays, each step the AdaGrad
+    update of ``glove_step`` (same dispatch-latency motive as
+    ``skipgram_steps_ns``).  Returns per-batch losses [nb]."""
+    def body(carry, batch):
+        r, c, x = batch
+        out = glove_step(*carry, r, c, x, alpha, x_max, exponent)
+        return out[:8], out[8]
+
+    carry, losses = jax.lax.scan(
+        body, (w, w_ctx, b, b_ctx, hw, hwc, hb, hbc),
+        (rows_b, cols_b, xij_b))
+    return carry + (losses,)

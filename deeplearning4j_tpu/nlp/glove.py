@@ -14,7 +14,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from .elements import glove_step
+from .elements import glove_epoch
 from .lookup_table import InMemoryLookupTable
 from .sentence_iterator import CollectionSentenceIterator, SentenceIterator
 from .sequence_vectors import SequenceVectors
@@ -99,17 +99,25 @@ class Glove(WordVectors):
         hbc = jnp.zeros(n, dt)
         B = self.batch_size
         n_pairs = len(xij)
-        pad = (-n_pairs) % B
+        # scan-fuse up to `chunk` batches per dispatch: amortizes dispatch
+        # latency like skipgram_steps_ns while keeping device memory for the
+        # index arrays bounded (~chunk*B*12 bytes) and the compile count at
+        # one (every dispatch has the same (chunk, B) shape via padding)
+        chunk = min(256, max(1, -(-n_pairs // B)))
+        stride = B * chunk
+        pad = (-n_pairs) % stride
         for _epoch in range(self.epochs):
             order = rng.permutation(n_pairs)
             pr = np.concatenate([rows[order], np.zeros(pad, np.int32)])
             pc = np.concatenate([cols[order], np.zeros(pad, np.int32)])
             # padded entries carry xij≈0 → weight (x/xmax)^α ≈ 0 → no gradient
             px = np.concatenate([xij[order], np.full(pad, 1e-8, np.float32)])
-            for s in range(0, n_pairs + pad, B):
-                w, wc, b, bc, hw, hwc, hb, hbc, _loss = glove_step(
+            for s in range(0, n_pairs + pad, stride):
+                w, wc, b, bc, hw, hwc, hb, hbc, _losses = glove_epoch(
                     w, wc, b, bc, hw, hwc, hb, hbc,
-                    jnp.asarray(pr[s:s + B]), jnp.asarray(pc[s:s + B]),
-                    jnp.asarray(px[s:s + B]), jnp.float32(self.learning_rate),
+                    jnp.asarray(pr[s:s + stride].reshape(chunk, B)),
+                    jnp.asarray(pc[s:s + stride].reshape(chunk, B)),
+                    jnp.asarray(px[s:s + stride].reshape(chunk, B)),
+                    jnp.float32(self.learning_rate),
                     jnp.float32(self.x_max), jnp.float32(self.alpha))
         self.lookup_table.syn0 = w + wc

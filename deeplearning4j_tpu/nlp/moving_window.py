@@ -42,9 +42,12 @@ class Window:
 
     def is_end_label(self) -> bool:
         """Window touches the sentence end (contains </s> padding).  Index
-        based, like is_begin_label — a literal '</s>' input token must not
-        fake a boundary."""
-        return self.n_tokens is not None and self.end >= self.n_tokens
+        based when ``n_tokens`` is known (a literal '</s>' input token must
+        not fake a boundary); directly-built windows without it fall back to
+        the sentinel check."""
+        if self.n_tokens is not None:
+            return self.end >= self.n_tokens
+        return "</s>" in self.words
 
     def __repr__(self):
         return f"Window({' '.join(self.words)} @ {self.focus_word()})"
