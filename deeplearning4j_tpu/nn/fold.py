@@ -1,0 +1,159 @@
+"""Batch-norm folding for inference deployment.
+
+At inference a BatchNormalization layer is a per-channel affine transform
+(running mean/var), which folds exactly into the weights of the preceding
+convolution/dense layer.  Measured on the v5e bench ResNet50, XLA already
+fuses the BN affine into the conv epilogue, so folding does NOT buy
+single-chip throughput — its value is the deployment artifact: a
+params-only model with no BN state to ship/version, fewer graph nodes for
+export paths, and exact-output equivalence (validated to float noise on
+all 53 ResNet50 BN vertices).
+
+``fold_batch_norms(net)`` returns a transformed COPY for serving; the
+original keeps training.  Foldable pattern: Conv/Dense with identity
+activation directly feeding a BatchNormalization (the zoo's conv_bn blocks);
+the BN slot becomes an ActivationLayer carrying BN's activation.  Anything
+else (BN after pooling/merge, nonlinear conv) is left as-is — BN inference
+mode is still correct, just unfused.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layers.convolution import Convolution1DLayer, ConvolutionLayer
+from .layers.feedforward import ActivationLayer, DenseLayer
+from .layers.normalization import BatchNormalization
+
+__all__ = ["fold_batch_norms"]
+
+
+def _bn_affine(bn: BatchNormalization, params, state) -> Tuple[np.ndarray,
+                                                               np.ndarray]:
+    """Per-channel (scale, shift) of the BN inference transform."""
+    mean = np.asarray(state["mean"], np.float64)
+    var = np.asarray(state["var"], np.float64)
+    scale = 1.0 / np.sqrt(var + bn.eps)
+    shift = -mean * scale
+    if not bn.lock_gamma_beta:
+        gamma = np.asarray(params["gamma"], np.float64)
+        beta = np.asarray(params["beta"], np.float64)
+        scale = scale * gamma
+        shift = shift * gamma + beta
+    return scale, shift
+
+
+def _fold_into(prev_params, scale, shift):
+    """W' = W * scale (output-channel minor axis), b' = b*scale + shift."""
+    W = np.asarray(prev_params["W"], np.float64)
+    new = {"W": jnp.asarray(W * scale, prev_params["W"].dtype)}
+    b = np.asarray(prev_params["b"], np.float64) if "b" in prev_params \
+        else np.zeros(W.shape[-1])
+    new["b"] = jnp.asarray(b * scale + shift,
+                           prev_params.get("b", prev_params["W"]).dtype)
+    return new
+
+
+def _is_foldable_prev(layer) -> bool:
+    return (isinstance(layer, (ConvolutionLayer, Convolution1DLayer,
+                               DenseLayer))
+            and getattr(layer, "activation", "identity") in
+            ("identity", "linear", None))
+
+
+def fold_batch_norms(net):
+    """Return an inference copy with every foldable Conv/Dense→BN pair
+    fused.  Works for MultiLayerNetwork (adjacent layers) and
+    ComputationGraph (single-consumer layer vertices)."""
+    from .computation_graph import ComputationGraph
+    from .multilayer import MultiLayerNetwork
+    out = net.clone()
+    if isinstance(net, MultiLayerNetwork):
+        out = _fold_mln(out)
+    elif isinstance(net, ComputationGraph):
+        out = _fold_graph(out)
+    else:
+        raise TypeError(f"cannot fold {type(net).__name__}")
+    # the param tree changed shape (BN params dropped, biases added):
+    # rebuild the optimizer state so serialization round-trips
+    out._tx = out._build_tx()
+    out.opt_state = out._tx.init(out.params)
+    return out
+
+
+def _replacement_activation(bn: BatchNormalization) -> ActivationLayer:
+    act = getattr(bn, "activation", None) or "identity"
+    repl = ActivationLayer(activation=act)
+    # mirror the BN conf's resolved hyperparams (updater etc.) so the folded
+    # model's optimizer-state tree matches one built fresh from the folded
+    # conf — serialization round-trips through MultiLayerNetwork(conf).init()
+    for attr in ("updater", "bias_updater"):
+        if getattr(bn, attr, None) is not None and hasattr(repl, attr):
+            setattr(repl, attr, getattr(bn, attr))
+    return repl
+
+
+def _fold_mln(net):
+    for i in range(1, len(net.layers)):
+        bn = net.layers[i]
+        prev = net.layers[i - 1]
+        if not isinstance(bn, BatchNormalization):
+            continue
+        if not _is_foldable_prev(prev):
+            continue
+        pkey, bkey = f"layer_{i-1}", f"layer_{i}"
+        if not net.params.get(pkey):
+            continue
+        scale, shift = _bn_affine(bn, net.params.get(bkey, {}),
+                                  net.state.get(bkey, {}))
+        net.params[pkey] = _fold_into(net.params[pkey], scale, shift)
+        # the clone's conf is a deep copy — safe to flip has_bias in place
+        # (folding always produces a bias term)
+        if hasattr(prev, "has_bias"):
+            prev.has_bias = True
+        repl = _replacement_activation(bn)
+        net.layers[i] = repl
+        net.conf.layers[i] = repl
+        net.params[bkey] = {}
+        net.state[bkey] = {}
+    net._jit_cache = {}
+    return net
+
+
+def _fold_graph(net):
+    from .conf.computation_graph import LayerVertex
+    conf = net.conf
+    # consumer map: vertex -> list of vertices reading it
+    consumers: dict = {}
+    for name, ins in conf.vertex_inputs.items():
+        for src in ins:
+            consumers.setdefault(src, []).append(name)
+    for name in list(conf.topological_order):
+        v = conf.vertices[name]
+        if not (isinstance(v, LayerVertex) and
+                isinstance(v.layer, BatchNormalization)):
+            continue
+        srcs = conf.vertex_inputs[name]
+        if len(srcs) != 1:
+            continue
+        src = srcs[0]
+        pv = conf.vertices.get(src)
+        if not (isinstance(pv, LayerVertex) and _is_foldable_prev(pv.layer)):
+            continue
+        if consumers.get(src) != [name]:   # conv output used elsewhere too
+            continue
+        if not net.params.get(src):
+            continue
+        bn = v.layer
+        scale, shift = _bn_affine(bn, net.params.get(name, {}),
+                                  net.state.get(name, {}))
+        net.params[src] = _fold_into(net.params[src], scale, shift)
+        if hasattr(pv.layer, "has_bias"):
+            pv.layer.has_bias = True
+        conf.vertices[name] = LayerVertex(layer=_replacement_activation(bn))
+        net.params[name] = {}
+        net.state[name] = {}
+    net._jit_cache = {}
+    return net

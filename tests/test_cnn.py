@@ -204,3 +204,62 @@ def test_yolo_non_max_suppression():
     assert out[0, 4] == pytest.approx(0.9)      # score-descending
     np.testing.assert_allclose(sorted(out[:, 4]), [0.6, 0.7, 0.9])
     assert non_max_suppression(np.zeros((0, 6))).shape == (0, 6)
+
+
+class TestBatchNormFolding:
+    """fold_batch_norms: exact inference equivalence, BN params removed."""
+
+    def test_mln_fold_exact(self):
+        from deeplearning4j_tpu.nn.fold import fold_batch_norms
+        from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+        rng = np.random.default_rng(0)
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(learning_rate=0.01)).list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                        activation="identity"))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(DenseLayer(n_out=8, activation="identity"))
+                .layer(BatchNormalization(activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        # make BN stats/affine non-trivial
+        import jax.numpy as jnp
+        for name, st in net.state.items():
+            if "mean" in st:
+                f = st["mean"].shape[0]
+                st["mean"] = jnp.asarray(rng.standard_normal(f) * 0.4)
+                st["var"] = jnp.asarray(rng.uniform(0.5, 2.0, f))
+                net.params[name]["gamma"] = jnp.asarray(
+                    rng.uniform(0.5, 1.5, f))
+                net.params[name]["beta"] = jnp.asarray(
+                    rng.standard_normal(f) * 0.3)
+        x = rng.standard_normal((5, 8, 8, 2)).astype(np.float32)
+        ref = np.asarray(net.output(x))
+        folded = fold_batch_norms(net)
+        got = np.asarray(folded.output(x))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        # both BN layers folded away (no gamma left anywhere)
+        assert not any("gamma" in p for p in folded.params.values())
+        # original untouched
+        assert any("gamma" in p for p in net.params.values())
+
+    def test_graph_fold_resnet_block(self):
+        from deeplearning4j_tpu.models import ResNet50
+        from deeplearning4j_tpu.nn.fold import fold_batch_norms
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        net = ResNet50(num_classes=4, input_shape=(32, 32, 3)).init()
+        for name, st in net.state.items():
+            if "mean" in st:
+                f = st["mean"].shape[0]
+                st["mean"] = jnp.asarray(rng.standard_normal(f) * 0.3)
+                st["var"] = jnp.asarray(rng.uniform(0.5, 2.0, f))
+        x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+        ref = np.asarray(net.output_single(x))
+        folded = fold_batch_norms(net)
+        got = np.asarray(folded.output_single(x))
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+        assert not any("gamma" in p for p in folded.params.values())
