@@ -6,16 +6,79 @@ shapes.  Upgraded alongside the flagship model.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
-def run(n_devices: int) -> None:
+def provision_devices(n_devices: int):
+    """Return >= n_devices jax devices, self-provisioning a virtual CPU mesh.
+
+    Real-hardware path first: if the default backend already exposes enough
+    devices (an actual pod slice), use them.  Otherwise force the host
+    platform to expose ``n_devices`` virtual CPU devices.  XLA_FLAGS must be
+    set before the CPU backend initializes — it is lazy per-platform, so this
+    works even when a TPU backend (e.g. the 'axon' plugin, which pins the
+    default platform at interpreter start) is already up: ``jax.devices()``
+    still reports the TPU, but ``jax.devices('cpu')`` honors the flag.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={max(n_devices, 8)}"
+        ).strip()
+
     import jax
 
-    if len(jax.devices()) < n_devices:
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        devices = []  # default backend failed to init (e.g. wedged TPU relay)
+    if len(devices) >= n_devices:
+        return devices[:n_devices]
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        cpu = []
+    if len(cpu) >= n_devices:
+        return cpu[:n_devices]
+    return None  # backend already up with too few devices; caller re-execs
+
+
+def _run_in_subprocess(n_devices: int) -> None:
+    """Re-exec the dry run in a fresh interpreter where XLA_FLAGS and
+    JAX_PLATFORMS are set BEFORE jax initializes — the only reliable route
+    when the calling process already brought up a too-small backend."""
+    import subprocess
+    import sys
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={max(n_devices, 8)}"
+    ).strip()
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from deeplearning4j_tpu.parallel import dryrun; "
+         f"dryrun.run({n_devices})"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
         raise RuntimeError(
-            f"need {n_devices} devices, have {len(jax.devices())} "
-            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+            f"subprocess dryrun failed (rc={proc.returncode}):\n"
+            + proc.stderr[-4000:])
+
+
+def run(n_devices: int) -> None:
+    devices = provision_devices(n_devices)
+    if devices is None:
+        return _run_in_subprocess(n_devices)
+
+    import jax
 
     from ..nn.conf.input_type import InputType
     from ..nn.conf.multi_layer import NeuralNetConfiguration
@@ -26,7 +89,7 @@ def run(n_devices: int) -> None:
     from .wrapper import ParallelWrapper, megatron_dense_rule
 
     tp = 2 if n_devices % 2 == 0 else 1
-    mesh = make_mesh(n_devices, tp=tp)
+    mesh = make_mesh(n_devices, tp=tp, devices=devices)
 
     conf = (NeuralNetConfiguration.builder()
             .seed(42).activation("relu").weight_init("xavier")
@@ -45,21 +108,25 @@ def run(n_devices: int) -> None:
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
 
     pw = ParallelWrapper(model, mesh, param_rule=megatron_dense_rule(model.params))
+    # The PRNG key was created on the default backend at init; commit it to
+    # the dry-run devices so the jitted step doesn't see mixed placements
+    # (relevant when the default backend is a lone TPU and the mesh is CPU).
+    model._rng = jax.device_put(model._rng, devices[0])
     pw.fit(x, y)
     assert np.isfinite(model.get_score()), "dry-run step produced non-finite loss"
 
     if n_devices % 8 == 0:
-        _pipeline_seq_step(n_devices)
-        _expert_parallel_step(n_devices)
+        _pipeline_seq_step(n_devices, devices)
+        _expert_parallel_step(n_devices, devices)
 
 
-def _pipeline_seq_step(n_devices: int) -> None:
+def _pipeline_seq_step(n_devices: int, devices) -> None:
     """data×pipe×seq 3D-sharded transformer train step: GPipe microbatching
     with ring attention inside each stage, DP gradient pmean, SGD update.
     Model + step come from ``demo.py`` (shared with the pipeline tests)."""
     import jax
     from jax import shard_map
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from .demo import build_demo_inputs, make_pipelined_train_step
 
@@ -67,42 +134,49 @@ def _pipeline_seq_step(n_devices: int) -> None:
     stacked, xs, ys = build_demo_inputs(
         n_stages=pp, embed=8, n_heads=2, seq_len=4 * sp, microbatch=2 * dp,
         n_micro=pp)
-    mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(dp, pp, sp),
+    mesh = Mesh(np.array(devices[:n_devices]).reshape(dp, pp, sp),
                 ("data", "pipe", "seq"))
     train_step = make_pipelined_train_step(n_heads=2)
+    in_specs = (P("pipe"), P(None, "data", "seq"), P(None, "data", "seq"))
+    # Inputs were built on the default backend; commit them to the mesh
+    # (cross-backend device_put) so the jitted program sees one placement.
+    stacked = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P("pipe"))), stacked)
+    xs = jax.device_put(xs, NamedSharding(mesh, in_specs[1]))
+    ys = jax.device_put(ys, NamedSharding(mesh, in_specs[2]))
     fn = jax.jit(shard_map(
-        train_step, mesh=mesh,
-        in_specs=(P("pipe"), P(None, "data", "seq"), P(None, "data", "seq")),
+        train_step, mesh=mesh, in_specs=in_specs,
         out_specs=(P(), P("pipe"))))
     loss, _ = fn(stacked, xs, ys)
     assert np.isfinite(float(loss)), "pipeline dry-run produced non-finite loss"
 
 
-def _expert_parallel_step(n_devices: int) -> None:
+def _expert_parallel_step(n_devices: int, devices) -> None:
     """data×expert MoE train step: top-1 routed FFN, tiled all-to-all
     token exchange over the expert axis, DP grad reduction."""
     import jax
-    import jax.numpy as jnp
     from jax import shard_map
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from .expert import init_moe_params, make_moe_train_step
 
     dp, ep = 2, n_devices // 2
     embed, hidden, experts = 8, 16, ep
-    mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(dp, ep),
+    mesh = Mesh(np.array(devices[:n_devices]).reshape(dp, ep),
                 ("data", "expert"))
     params = init_moe_params(jax.random.PRNGKey(0), experts, embed, hidden)
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((n_devices * 4, embed)),
-                    jnp.float32)
-    y = jnp.tanh(x @ jnp.asarray(
-        rng.standard_normal((embed, embed)), jnp.float32))
+    x = rng.standard_normal((n_devices * 4, embed)).astype(np.float32)
+    y = np.tanh(x @ rng.standard_normal((embed, embed)).astype(np.float32))
     pspec = {"router": P(None, None), "w1": P("expert"), "w2": P("expert")}
+    batch_spec = P(("data", "expert"), None)
+    params = {k: jax.device_put(v, NamedSharding(mesh, pspec[k]))
+              for k, v in params.items()}
+    x = jax.device_put(x, NamedSharding(mesh, batch_spec))
+    y = jax.device_put(y, NamedSharding(mesh, batch_spec))
     fn = jax.jit(shard_map(
         make_moe_train_step(capacity=4), mesh=mesh,
-        in_specs=(pspec, P(("data", "expert"), None),
-                  P(("data", "expert"), None)),
+        in_specs=(pspec, batch_spec, batch_spec),
         out_specs=(pspec, P())))
     _, loss = fn(params, x, y)
     assert np.isfinite(float(loss)), "MoE dry-run produced non-finite loss"
