@@ -25,6 +25,64 @@ from ..conf.input_type import InputType
 from .base import BaseLayerConf, LayerConf
 
 
+@jax.custom_vjp
+def _bn_train_norm(x, gamma, beta, eps):
+    """Training-mode batch norm with a hand-derived backward.
+
+    The autodiff-derived VJP spreads the input gradient over several reduce
+    fusions; this version pins the backward to the two-pass minimum (one
+    multi-output reduce for dbeta/dgamma, one elementwise pass for dx) —
+    the role the reference delegates to
+    ``CudnnBatchNormalizationHelper.java:45`` (cudnnBatchNormalizationBackward
+    is the same fused formula).  Returns (y, mean, var) with stats in f32.
+    """
+    y, mean, var, _ = _bn_fwd_math(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _acc_dtype(dt):
+    """f32 accumulation for low-precision inputs, f64 stays f64 (the
+    gradient-check oracle runs the whole net in double)."""
+    return jnp.promote_types(dt, jnp.float32)
+
+
+def _bn_fwd_math(x, gamma, beta, eps):
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(_acc_dtype(x.dtype))
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    y = xhat * gamma + beta
+    return y, mean, var, inv
+
+
+def _bn_train_fwd(x, gamma, beta, eps):
+    y, mean, var, inv = _bn_fwd_math(x, gamma, beta, eps)
+    return (y, mean, var), (x, gamma, mean, inv)
+
+
+def _bn_train_bwd(res, cts):
+    x, gamma, mean, inv = res
+    dy, _, _ = cts  # no gradient flows into the returned running stats
+    axes = tuple(range(x.ndim - 1))
+    n = x.size // x.shape[-1]
+    acc = _acc_dtype(x.dtype)
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    dyf = dy.astype(acc)
+    # pass 1: both reductions share one read of (dy, xhat)
+    dbeta = jnp.sum(dyf, axis=axes)
+    dgamma = jnp.sum(dyf * xhat.astype(acc), axis=axes)
+    # pass 2: dx = inv*gamma*(dy - dbeta/n - xhat*dgamma/n)
+    coef = (inv * gamma.astype(acc)).astype(x.dtype)
+    dx = coef * (dy - (dbeta / n).astype(x.dtype)
+                 - xhat * (dgamma / n).astype(x.dtype))
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype), None
+
+
+_bn_train_norm.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_serde
 @dataclass
 class BatchNormalization(BaseLayerConf):
@@ -69,18 +127,27 @@ class BatchNormalization(BaseLayerConf):
         params, state = variables["params"], variables["state"]
         axes = tuple(range(x.ndim - 1))  # all but channel-minor
         if train and self.is_minibatch:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # One-pass f32 statistics (E[x²]−E[x]², single HBM read) and a
+            # hand-derived two-pass backward — see _bn_train_norm.
+            if self.lock_gamma_beta:
+                gamma = jnp.ones((x.shape[-1],), x.dtype)
+                beta = jnp.zeros((x.shape[-1],), x.dtype)
+            else:
+                gamma, beta = params["gamma"], params["beta"]
+            y, mean, var = _bn_train_norm(x, gamma.astype(x.dtype),
+                                          beta.astype(x.dtype), self.eps)
             d = self.decay
-            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
-                         "var": d * state["var"] + (1 - d) * var}
-        else:
-            mean, var = state["mean"], state["var"]
-            new_state = state
-        xhat = (x - mean) * lax.rsqrt(var + self.eps)
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean.astype(
+                             state["mean"].dtype),
+                         "var": d * state["var"] + (1 - d) * var.astype(
+                             state["var"].dtype)}
+            return self.act_fn(y), new_state
+        mean, var = state["mean"], state["var"]
+        xhat = (x - mean.astype(x.dtype)) * lax.rsqrt(
+            var.astype(x.dtype) + self.eps)
         if not self.lock_gamma_beta:
             xhat = xhat * params["gamma"] + params["beta"]
-        return self.act_fn(xhat), new_state
+        return self.act_fn(xhat), state
 
 
 @register_serde

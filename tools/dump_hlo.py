@@ -1,0 +1,22 @@
+import jax, jax.numpy as jnp
+from deeplearning4j_tpu.models import available_bench_model
+
+model, (x, y) = available_bench_model(batch=256, image=224)
+x, y = jnp.asarray(x), jnp.asarray(y)
+model.fit(x, y)
+step = model._get_jitted("train_step")
+model._rng, key = jax.random.split(model._rng)
+lowered = step.lower(model.params, model.state, model.opt_state, key,
+                     [x], [y], None, None)
+compiled = lowered.compile()
+with open("/tmp/hlo_opt.txt", "w") as f:
+    f.write(compiled.as_text())
+ca = compiled.cost_analysis()
+if isinstance(ca, list): ca = ca[0]
+import json
+flops = ca.get("flops", 0)
+print(json.dumps({k: v for k, v in ca.items()
+                  if k in ("flops", "bytes accessed", "optimal_seconds",
+                           "bytes accessed0{}", "bytes accessedout{}")},
+                 indent=0))
+print("flops/step TFLOP:", flops / 1e12)

@@ -1,0 +1,25 @@
+import glob, gzip, json, os, time
+import jax, jax.numpy as jnp
+from deeplearning4j_tpu.models import available_bench_model
+
+model, (x, y) = available_bench_model(batch=256, image=224)
+x, y = jnp.asarray(x), jnp.asarray(y)
+model.fit(x, y)
+step = model._get_jitted("train_step")
+
+def run():
+    model._rng, key = jax.random.split(model._rng)
+    model.params, model.state, model.opt_state, loss, _ = step(
+        model.params, model.state, model.opt_state, key, [x], [y], None, None)
+    return loss
+
+for _ in range(3):
+    loss = run()
+float(jnp.asarray(loss))
+
+jax.profiler.start_trace("/tmp/xprof")
+for _ in range(3):
+    loss = run()
+float(jnp.asarray(loss))
+jax.profiler.stop_trace()
+print("trace files:", glob.glob("/tmp/xprof/**/*", recursive=True))

@@ -1,0 +1,63 @@
+import gzip, json, re, sys
+from collections import defaultdict
+
+trace_path, hlo_path = sys.argv[1], sys.argv[2]
+with gzip.open(trace_path, "rt") as f:
+    events = json.load(f)["traceEvents"]
+
+# tid metadata to understand tracks
+tids = {}
+for e in events:
+    if e.get("ph") == "M" and e.get("name") == "thread_name":
+        tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
+print("device tracks:", {k: v for k, v in tids.items() if k[0] == 3})
+
+hlo = open(hlo_path).read()
+comps = {}
+for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?: \([^)]*\))? -> [^\n{]+\{\n(.*?)^\}", hlo, re.M | re.S):
+    comps[m.group(1)] = m.group(2)
+fusion_calls = dict(re.findall(r"%?([\w.\-]+) = [^\n]*fusion\([^\n]*calls=%?([\w.\-]+)", hlo))
+
+def comp_kinds(cname, depth=0):
+    body = comps.get(cname, "")
+    kinds = set(re.findall(r"= (?:\([^)]*\)|\S+?) ([a-z][\w\-]*)[\(.]", body))
+    if depth < 2:
+        for sub in re.findall(r"calls=%?([\w.\-]+)", body):
+            kinds |= comp_kinds(sub, depth + 1)
+    return kinds
+
+def categorize(name):
+    base = name.split("(")[0]
+    comp = fusion_calls.get(base)
+    if comp:
+        kinds = comp_kinds(comp)
+        if "convolution" in kinds: return "conv"
+        if "dot" in kinds: return "dot"
+        if "reduce" in kinds: return "bn_reduce"
+        if "reduce-window" in kinds or "select-and-scatter" in kinds: return "pool"
+        return "elementwise"
+    if "convolution" in base: return "conv"
+    if "select-and-scatter" in base or "reduce-window" in base: return "pool"
+    if "copy" in base: return "copy"
+    if "all-reduce" in base or "all-gather" in base: return "collective"
+    if base in ("jit_step",) or base.isdigit(): return "SKIP"
+    if "reduce" in base: return "bn_reduce"
+    return "misc:" + base[:18]
+
+# use only one track per pid=3: pick the track with max total to avoid dup lanes
+track_tot = defaultdict(float)
+for e in events:
+    if e.get("ph") == "X" and e.get("pid") == 3:
+        track_tot[e["tid"]] += e.get("dur", 0)
+print("track totals (ms):", {t: round(v/1e3,1) for t, v in sorted(track_tot.items())})
+
+for chosen in sorted(track_tot, key=lambda t: -track_tot[t]):
+    agg = defaultdict(float); cnt = defaultdict(int)
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") == 3 and e["tid"] == chosen:
+            c = categorize(e["name"])
+            agg[c] += e.get("dur", 0); cnt[c] += 1
+    tot = sum(v for k, v in agg.items() if k != "SKIP")
+    print(f"\ntrack {chosen} ({tids.get((3,chosen),'')}): {tot/3e3:.1f} ms/step attributed")
+    for c, v in sorted(agg.items(), key=lambda kv: -kv[1]):
+        print(f"  {v/3e3:8.2f} ms/step x{cnt[c]//3:4d} {c}")

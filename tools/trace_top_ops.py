@@ -1,0 +1,48 @@
+import gzip, json, re, sys
+from collections import defaultdict
+
+trace_path, hlo_path = sys.argv[1], sys.argv[2]
+with gzip.open(trace_path, "rt") as f:
+    events = json.load(f)["traceEvents"]
+hlo = open(hlo_path).read()
+comps = {}
+for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?: \([^)]*\))? -> ([^\n{]+)\{\n(.*?)^\}", hlo, re.M | re.S):
+    comps[m.group(1)] = (m.group(2), m.group(3))
+fusion_calls = dict(re.findall(r"%?([\w.\-]+) = [^\n]*fusion\([^\n]*calls=%?([\w.\-]+)", hlo))
+
+def conv_shapes(cname):
+    body = comps.get(cname, ("", ""))[1]
+    out = []
+    for m in re.finditer(r"= (\S+) convolution\(([^)]*)\)[^\n]*window={([^}]*)}", body):
+        out.append(f"{m.group(1)} win[{m.group(3)[:40]}]")
+    for sub in re.findall(r"calls=%?([\w.\-]+)", body):
+        out.extend(conv_shapes(sub))
+    return out
+
+agg = defaultdict(float)
+for e in events:
+    if e.get("ph") == "X" and e.get("pid") == 3 and e.get("tid") == 3:
+        agg[e["name"]] += e.get("dur", 0)
+
+def pick(pred, n=18):
+    rows = []
+    for name, dur in sorted(agg.items(), key=lambda kv: -kv[1]):
+        base = name.split("(")[0]
+        comp = fusion_calls.get(base)
+        if comp is None: continue
+        body = comps.get(comp, ("", ""))[1]
+        kinds = set(re.findall(r"= (?:\([^)]*\)|\S+?) ([a-z][\w\-]*)[\(.]", body))
+        for sub in re.findall(r"calls=%?([\w.\-]+)", body):
+            kinds |= set(re.findall(r"= (?:\([^)]*\)|\S+?) ([a-z][\w\-]*)[\(.]", comps.get(sub, ("",""))[1]))
+        if not pred(kinds): continue
+        cs = conv_shapes(comp)
+        rows.append((dur/3e3, name, cs[:2]))
+        if len(rows) >= n: break
+    return rows
+
+print("== top conv fusions ==")
+for d, n, cs in pick(lambda k: "convolution" in k):
+    print(f"  {d:6.2f} ms  {n[:28]:30s} {cs}")
+print("== top elementwise (no conv/reduce) ==")
+for d, n, cs in pick(lambda k: "convolution" not in k and "reduce" not in k, 12):
+    print(f"  {d:6.2f} ms  {n[:40]}")
