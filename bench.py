@@ -26,7 +26,7 @@ BASELINE_EXAMPLES_PER_SEC = 2055.4
 
 def main():
     import jax.numpy as jnp
-    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.models import available_bench_model
 
     batch = int(os.environ.get("DL4J_TPU_BENCH_BATCH", "256"))
     image = int(os.environ.get("DL4J_TPU_BENCH_IMAGE", "224"))
@@ -34,19 +34,14 @@ def main():
     epochs = int(os.environ.get("DL4J_TPU_BENCH_EPOCHS", "4"))
     cdtype = os.environ.get("DL4J_TPU_BENCH_DTYPE", "bfloat16")
 
-    model = ResNet50(num_classes=1000,
-                     compute_dtype=None if cdtype == "float32" else cdtype,
-                     input_shape=(image, image, 3)).init()
-    rng = np.random.default_rng(0)
     n = batch * nbatch
+    model, (x, y) = available_bench_model(batch=n, image=image)
     # device-resident dataset in the compute dtype (a real input pipeline
     # feeds decoded uint8→bf16; keeping the HBM copy f32 would double the
     # per-step gather traffic for no numerical benefit)
     xdt = jnp.float32 if cdtype == "float32" else jnp.dtype(cdtype)
-    x = jnp.asarray(rng.standard_normal((n, image, image, 3),
-                                        dtype=np.float32), xdt)
-    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
-        rng.integers(0, 1000, n)])
+    x = jnp.asarray(x, xdt)
+    y = jnp.asarray(y)
 
     # warm epoch: compile + first execution
     model.fit_on_device(x, y, batch_size=batch, epochs=1)

@@ -35,6 +35,12 @@ def _bn_train_norm(x, gamma, beta, eps):
     the role the reference delegates to
     ``CudnnBatchNormalizationHelper.java:45`` (cudnnBatchNormalizationBackward
     is the same fused formula).  Returns (y, mean, var) with stats in f32.
+
+    INVARIANT: the backward rule drops the cotangents on the returned
+    mean/var — they exist only to feed the NON-differentiated running-stats
+    EMA.  Do not differentiate through a consumer of these outputs (e.g. a
+    batch-statistics regularizer); the gradient would be silently missing
+    that contribution.
     """
     y, mean, var, _ = _bn_fwd_math(x, gamma, beta, eps)
     return y, mean, var
@@ -64,7 +70,8 @@ def _bn_train_fwd(x, gamma, beta, eps):
 
 def _bn_train_bwd(res, cts):
     x, gamma, mean, inv = res
-    dy, _, _ = cts  # no gradient flows into the returned running stats
+    # mean/var cotangents dropped by contract — see _bn_train_norm docstring
+    dy, _, _ = cts
     axes = tuple(range(x.ndim - 1))
     n = x.size // x.shape[-1]
     acc = _acc_dtype(x.dtype)
@@ -125,7 +132,6 @@ class BatchNormalization(BaseLayerConf):
 
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         params, state = variables["params"], variables["state"]
-        axes = tuple(range(x.ndim - 1))  # all but channel-minor
         if train and self.is_minibatch:
             # One-pass f32 statistics (E[x²]−E[x]², single HBM read) and a
             # hand-derived two-pass backward — see _bn_train_norm.

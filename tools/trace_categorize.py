@@ -10,7 +10,10 @@ tids = {}
 for e in events:
     if e.get("ph") == "M" and e.get("name") == "thread_name":
         tids[(e["pid"], e["tid"])] = e["args"].get("name", "")
-print("device tracks:", {k: v for k, v in tids.items() if k[0] == 3})
+dev_pids = {e["pid"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "TPU" in e["args"].get("name", "")}
+print("device tracks:", {k: v for k, v in tids.items() if k[0] in dev_pids})
 
 hlo = open(hlo_path).read()
 comps = {}
@@ -47,14 +50,14 @@ def categorize(name):
 # use only one track per pid=3: pick the track with max total to avoid dup lanes
 track_tot = defaultdict(float)
 for e in events:
-    if e.get("ph") == "X" and e.get("pid") == 3:
+    if e.get("ph") == "X" and e.get("pid") in dev_pids:
         track_tot[e["tid"]] += e.get("dur", 0)
 print("track totals (ms):", {t: round(v/1e3,1) for t, v in sorted(track_tot.items())})
 
 for chosen in sorted(track_tot, key=lambda t: -track_tot[t]):
     agg = defaultdict(float); cnt = defaultdict(int)
     for e in events:
-        if e.get("ph") == "X" and e.get("pid") == 3 and e["tid"] == chosen:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids and e["tid"] == chosen:
             c = categorize(e["name"])
             agg[c] += e.get("dur", 0); cnt[c] += 1
     tot = sum(v for k, v in agg.items() if k != "SKIP")

@@ -19,9 +19,19 @@ def conv_shapes(cname):
         out.extend(conv_shapes(sub))
     return out
 
+# pick the busiest device track (tids vary across traces — same approach
+# as trace_categorize.py)
+dev_pids = {e["pid"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "TPU" in e["args"].get("name", "")}
+track_tot = defaultdict(float)
+for e in events:
+    if e.get("ph") == "X" and e.get("pid") in dev_pids:
+        track_tot[(e["pid"], e["tid"])] += e.get("dur", 0)
+busiest = max(track_tot, key=track_tot.get)
 agg = defaultdict(float)
 for e in events:
-    if e.get("ph") == "X" and e.get("pid") == 3 and e.get("tid") == 3:
+    if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) == busiest:
         agg[e["name"]] += e.get("dur", 0)
 
 def pick(pred, n=18):
