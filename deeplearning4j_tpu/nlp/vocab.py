@@ -65,6 +65,12 @@ class VocabCache:
         vw = self._words.get(word)
         return -1 if vw is None else vw.index
 
+    def index_map(self) -> Dict[str, int]:
+        """Plain word→index dict for bulk token indexing (one dict lookup
+        per token instead of a method call + VocabWord hop).  Built fresh on
+        each call — callers hold it for the duration of one fit."""
+        return {vw.word: vw.index for vw in self._by_index}
+
     def word_frequency(self, word: str) -> int:
         vw = self._words.get(word)
         return 0 if vw is None else vw.count

@@ -45,7 +45,11 @@ class WordVectors:
     def words_nearest(self, positive, negative: Sequence[str] = (),
                       top_n: int = 10) -> List[str]:
         """Nearest words to positive − negative (analogy support,
-        ``WordVectorsImpl.wordsNearest``)."""
+        ``WordVectorsImpl.wordsNearest``).  Also accepts the reference's
+        two-arg overload ``words_nearest(word, n)`` — an int in the second
+        position is the result count."""
+        if isinstance(negative, int):
+            negative, top_n = (), negative
         if isinstance(positive, str):
             positive = [positive]
         normed = self._normed()

@@ -274,3 +274,44 @@ def test_skipgram_tiny_vocab_large_batch_stable():
     s0 = np.asarray(w2v.lookup_table.syn0)
     assert np.isfinite(s0).all() and np.abs(s0).max() < 100.0
     assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "gpu")
+
+
+def test_bulk_ns_padded_tail_and_tiny_corpus():
+    """The corpus-level NS fast path pads its final partial dispatch; the
+    padded rows must scatter zeros (n_valids masking), and a corpus far
+    smaller than one dispatch must still train."""
+    w = Word2Vec(sentences=["a b c d e", "c d e f g", "a c e g"],
+                 layer_size=16, window=2, negative=3, epochs=2, seed=7,
+                 min_word_frequency=1)
+    w.build_vocab()
+    before = np.asarray(w.lookup_table.syn0).copy()
+    w.fit()
+    v = np.asarray(w.get_word_vector("c"))
+    assert v.shape == (16,) and np.isfinite(v).all()
+    after = np.asarray(w.lookup_table.syn0)
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after), "training did not update weights"
+
+
+def test_bulk_ns_subsampling_and_epoch_cache():
+    """Subsampling drops words before windowing and the indexed corpus is
+    cached across epochs — both must keep the run finite and learning."""
+    rng = np.random.default_rng(1)
+    sents = [" ".join("w%d" % i for i in rng.integers(0, 50, 12))
+             for _ in range(300)]
+    w2 = Word2Vec(sentences=sents, layer_size=16, window=3, negative=5,
+                  epochs=3, sampling=1e-3, seed=3, min_word_frequency=1)
+    w2.fit()
+    assert np.isfinite(w2.similarity("w1", "w2"))
+    s0 = np.asarray(w2.lookup_table.syn0)
+    assert np.isfinite(s0).all()
+
+
+def test_bulk_ns_degenerate_sentences():
+    """Single-word / empty sentences emit no pairs but must not break the
+    chunked emission."""
+    w3 = Word2Vec(sentences=["a", "", "a b", "b a b a b a"], layer_size=8,
+                  window=5, negative=2, epochs=1, seed=5,
+                  min_word_frequency=1)
+    w3.fit()
+    assert np.isfinite(np.asarray(w3.lookup_table.syn0)).all()
