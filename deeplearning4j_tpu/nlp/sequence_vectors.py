@@ -233,15 +233,31 @@ class SequenceVectors(WordVectors):
     _BULK_CHUNK_WORDS = 1 << 18          # corpus words per vectorized emission
     _BULK_CACHE_LIMIT = 50_000_000       # max words of indexed-corpus cache
 
+    def _ns_fast_eligible(self) -> bool:
+        """NS-only skip-gram with a device-resident negative table: the
+        configuration both fast paths (in-batcher and bulk) require."""
+        lt = self.lookup_table
+        return (self.elements_algorithm == "skipgram" and not self.use_hs
+                and self.negative > 0
+                and lt.table is not None and len(lt.table) > 0)
+
+    def _rows_per_step(self) -> int:
+        """Batched rows update from stale weights (the reference's
+        sequential hogwild never sees this): with a small vocabulary a big
+        batch packs many duplicates of the same word whose correlated
+        updates sum and can diverge.  Cap rows-per-step by vocab size and
+        spend the budget on extra scan steps instead (steps read fresh
+        carry weights)."""
+        n_words = max(self.vocab.num_words(), 1)
+        return int(min(self.batch_size, max(64, 4 * n_words)))
+
     def fit(self) -> None:
         if self.vocab is None:
             self.build_vocab()
         has_labels = (type(self)._sequence_labels
                       is not SequenceVectors._sequence_labels)
         lt = self.lookup_table
-        if (self.elements_algorithm == "skipgram" and not self.use_hs
-                and self.negative > 0 and not has_labels
-                and lt.table is not None and len(lt.table)):
+        if self._ns_fast_eligible() and not has_labels:
             return self._fit_bulk_ns()
         rng = np.random.default_rng(self.seed)
         vocab_words = self.vocab.vocab_words()
@@ -255,13 +271,7 @@ class SequenceVectors(WordVectors):
             syn1 = jnp.zeros_like(syn0)
         if syn1neg is None:
             syn1neg = jnp.zeros_like(syn0)
-        # Batched rows update from stale weights (the reference's sequential
-        # hogwild never sees this): with a small vocabulary a big batch packs
-        # many duplicates of the same word whose correlated updates sum and
-        # can diverge.  Cap rows-per-step by vocab size and spend the budget
-        # on extra scan steps instead (steps read fresh carry weights).
-        n_words = max(len(vocab_words), 1)
-        b_eff = min(self.batch_size, max(64, 4 * n_words))
+        b_eff = self._rows_per_step()     # stale-duplicate cap (see helper)
         scan_eff = self.scan_steps
         if b_eff < self.batch_size:
             scan_eff = min(512, -(-self.scan_steps * self.batch_size // b_eff))
@@ -270,8 +280,7 @@ class SequenceVectors(WordVectors):
         is_skipgram = self.elements_algorithm == "skipgram"
         # device-sampling fast path: NS-only skip-gram ships just the int32
         # pair indices per step; negatives come from the HBM-resident table
-        fast_ns = (is_skipgram and not self.use_hs and self.negative > 0
-                   and lt.table is not None and len(lt.table))
+        fast_ns = self._ns_fast_eligible()
         hs_tables = build_hs_tables(vocab_words, code_len) if self.use_hs \
             else None
         key = jax.random.PRNGKey(self.seed) if fast_ns else None
@@ -376,12 +385,11 @@ class SequenceVectors(WordVectors):
         rng = np.random.default_rng(self.seed)
         keep = subsample_keep_prob(self.vocab, self.sampling)
         total = max(self.vocab.total_word_count * self.epochs, 1)
-        n_words = max(self.vocab.num_words(), 1)
         W = self.window
         # honor the configured batch_size (same stale-duplicate cap as the
         # generic path) and spend the rest of the dispatch budget on scan
         # steps — steps read fresh carry weights, so more steps never hurts
-        B = int(min(self.batch_size, max(64, 4 * n_words)))
+        B = self._rows_per_step()
         S = max(self.scan_steps, self._BULK_PAIRS_PER_DISPATCH // B)
         syn0, syn1neg = lt.syn0, lt.syn1neg
         table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))

@@ -63,9 +63,16 @@ class ElasticTrainer:
     def __init__(self, model, checkpoint_dir: str, save_freq: int = 10,
                  keep_last: int = 2):
         self.model = model
+        # A mesh wrapper (ParallelWrapper) trains, but its underlying
+        # network is what serializes; after restore the wrapper re-places
+        # the loaded host arrays onto the mesh.  In multi-process runs give
+        # each process its own checkpoint_dir (SPMD training is
+        # deterministic, so the replicas' checkpoints are identical).
+        self._net = model.model if hasattr(model, "_place") else model
         self.dir = checkpoint_dir
         self.save_freq = max(1, save_freq)
         self.keep_last = max(1, keep_last)
+        self.last_restored_step = 0
         os.makedirs(checkpoint_dir, exist_ok=True)
 
     # -- checkpoint bookkeeping ------------------------------------------
@@ -83,7 +90,7 @@ class ElasticTrainer:
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
         os.close(fd)
         try:
-            write_model(self.model, tmp, save_updater=True)
+            write_model(self._net, tmp, save_updater=True)
             os.replace(tmp, path)  # atomic: no torn checkpoints
         finally:
             if os.path.exists(tmp):
@@ -102,11 +109,14 @@ class ElasticTrainer:
         if step:
             from ..utils.model_serializer import restore_model
             restored = restore_model(self._ckpt_path(step), load_updater=True)
-            self.model.params = restored.params
-            self.model.state = restored.state
-            self.model.opt_state = restored.opt_state
-            self.model.iteration = restored.iteration
-            self.model.epoch = restored.epoch
+            self._net.params = restored.params
+            self._net.state = restored.state
+            self._net.opt_state = restored.opt_state
+            self._net.iteration = restored.iteration
+            self._net.epoch = restored.epoch
+            if self._net is not self.model:
+                self.model._place()   # re-shard restored arrays on the mesh
+        self.last_restored_step = step
         return step
 
     # -- training loop ----------------------------------------------------

@@ -1,0 +1,83 @@
+"""Multi-process training worker (launched by test_distributed.py).
+
+One OS process per 'host': jax.distributed over a loopback coordinator, a
+global device mesh spanning both processes' CPU devices, ParallelWrapper
+SPMD training, ElasticTrainer checkpoint-restart.  The reference proves its
+cluster semantics the same way — local[N] Spark + loopback Aeron
+(``BaseSparkTest.java:46``, GradientSharingTrainingTest).
+
+Env: MP_PID, MP_NPROC, MP_PORT, MP_DIR, MP_MAX_STEPS, MP_CRASH_AT
+(crash hard — os._exit(17) — before training batch #MP_CRASH_AT).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    pid = int(os.environ["MP_PID"])
+    nproc = int(os.environ["MP_NPROC"])
+    port = os.environ["MP_PORT"]
+    outdir = os.environ["MP_DIR"]
+    max_steps = int(os.environ.get("MP_MAX_STEPS", "10"))
+    crash_at = int(os.environ.get("MP_CRASH_AT", "0"))
+
+    from deeplearning4j_tpu.parallel.distributed import (
+        ElasticTrainer, global_device_mesh, initialize_distributed)
+
+    assert initialize_distributed(f"127.0.0.1:{port}", nproc, pid)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.01))
+            .list()
+            .layer(DenseLayer(n_out=32))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(20))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    mesh = global_device_mesh()          # pure DP over all processes' devices
+    pw = ParallelWrapper(model, mesh)
+
+    rng = np.random.default_rng(7)       # identical batches on every process
+    all_batches = []
+    for _ in range(16):
+        x = rng.standard_normal((16, 20)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        all_batches.append((x, y))
+
+    def batches():
+        for i, b in enumerate(all_batches):
+            if crash_at and i == crash_at:
+                os._exit(17)             # hard crash mid-run, no cleanup
+            yield b
+
+    trainer = ElasticTrainer(pw, os.path.join(outdir, f"ckpt_p{pid}"),
+                             save_freq=2)
+    steps = trainer.fit(batches, max_steps=max_steps)
+
+    result = {"pid": pid, "steps": steps,
+              "resumed_from": trainer.last_restored_step,
+              "score": model.get_score(),
+              "param_sum": float(np.asarray(
+                  model.params["layer_0"]["W"]).sum())}
+    with open(os.path.join(outdir, f"result_p{pid}.json"), "w") as f:
+        json.dump(result, f)
+    print(f"[{pid}] done: {result}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
