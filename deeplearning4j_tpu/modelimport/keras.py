@@ -9,9 +9,14 @@ where conventions differ — e.g. Keras LSTM gate order i,f,c,o vs our
 i,f,o,g).  TF channel-last conventions are assumed (the DL4J importer's
 default for TF-backend files).
 
-Supported layers: Dense, Activation, Dropout, Flatten, Conv2D,
-MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D, BatchNormalization,
-LSTM, SimpleRNN, Embedding.  Unsupported layers raise
+Supported layers: Dense, Activation, Dropout, Flatten, Conv1D/2D,
+MaxPooling1D/2D, AveragePooling1D/2D, Global*Pooling1D/2D, ZeroPadding2D,
+UpSampling2D, BatchNormalization, LSTM, SimpleRNN, Embedding, Reshape,
+Permute, RepeatVector, TimeDistributed, and the advanced activations
+LeakyReLU / ELU / ThresholdedReLU (reference registry
+``KerasLayer.java:42`` + ``layers/advanced/activations/``).  Additional
+classes can be plugged in with :func:`register_keras_layer` (the
+``layers/custom/`` registry hook).  Unsupported layers raise
 ``KerasImportError`` naming the layer class (reference
 ``UnsupportedKerasConfigurationException``).
 """
@@ -36,11 +41,27 @@ from ..nn.multilayer import MultiLayerNetwork
 from .hdf5 import Hdf5File, Hdf5FormatError
 
 __all__ = ["KerasModelImport", "KerasImportError",
-           "import_keras_sequential_model", "import_keras_model"]
+           "import_keras_sequential_model", "import_keras_model",
+           "register_keras_layer"]
 
 
 class KerasImportError(ValueError):
     pass
+
+
+# Custom layer mappers (reference KerasLayer.registerCustomLayer /
+# ``layers/custom/``): class name -> fn(conf, is_last, rnn_input) -> _LayerMap
+_CUSTOM_LAYERS: Dict[str, Any] = {}
+
+
+def register_keras_layer(class_name: str, mapper) -> None:
+    """Register an import mapper for a custom Keras layer class.
+
+    ``mapper(conf: dict, is_last: bool, rnn_input: bool) -> _LayerMap`` —
+    build a layer conf plus a weight-copy function (``_LayerMap(conf,
+    copy_fn)``; ``copy_fn(keras_weights) -> params dict``).
+    """
+    _CUSTOM_LAYERS[class_name] = mapper
 
 
 _ACT_MAP = {
@@ -94,6 +115,49 @@ class _LayerMap:
 def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool,
                rnn_input: bool = False) -> _LayerMap:
     name = conf.get("name")
+    if cls in _CUSTOM_LAYERS:
+        return _CUSTOM_LAYERS[cls](conf, is_last, rnn_input)
+    if cls == "TimeDistributed":
+        # wrapper: apply the inner layer per timestep — our dense/activation
+        # layers already operate on the trailing feature axis of [b,t,f],
+        # so for those the wrapper reduces to the inner mapping with rnn
+        # semantics.  Spatial/recurrent inner layers would need real
+        # per-step lifting — refuse rather than import a wrong network.
+        inner = conf.get("layer") or {}
+        inner_cls = inner.get("class_name", "")
+        if inner_cls not in ("Dense", "Activation", "Dropout"):
+            raise KerasImportError(
+                f"unsupported TimeDistributed inner layer '{inner_cls}' "
+                "(only Dense/Activation/Dropout map directly)")
+        inner_conf = dict(_cfg(inner))
+        inner_conf.setdefault("name", name)
+        return _map_layer(inner_cls, inner_conf,
+                          is_last=is_last, rnn_input=True)
+    if cls == "LeakyReLU":
+        alpha = float(conf.get("alpha", conf.get("negative_slope", 0.3)))
+        return _LayerMap(ActivationLayer(
+            name=name, activation=f"leakyrelu:{alpha}"), lambda w: {})
+    if cls == "ELU":
+        alpha = float(conf.get("alpha", 1.0))
+        return _LayerMap(ActivationLayer(
+            name=name, activation=f"elu:{alpha}"), lambda w: {})
+    if cls == "ThresholdedReLU":
+        theta = float(conf.get("theta", 1.0))
+        return _LayerMap(ActivationLayer(
+            name=name, activation=f"thresholdedrelu:{theta}"), lambda w: {})
+    if cls == "Reshape":
+        from ..nn.layers.misc import ReshapeLayer
+        return _LayerMap(ReshapeLayer(
+            name=name, target_shape=tuple(conf["target_shape"])),
+            lambda w: {})
+    if cls == "Permute":
+        from ..nn.layers.misc import PermuteLayer
+        return _LayerMap(PermuteLayer(name=name, dims=tuple(conf["dims"])),
+                         lambda w: {})
+    if cls == "RepeatVector":
+        from ..nn.layers.misc import RepeatVector
+        return _LayerMap(RepeatVector(name=name, n=int(conf["n"])),
+                         lambda w: {})
     if cls == "Dense":
         act = _act(conf.get("activation"))
         n_out = int(conf["units"] if "units" in conf else conf["output_dim"])
@@ -245,6 +309,11 @@ def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool,
                            conf.get("inner_activation", "hard_sigmoid"))
         lc = LSTM(name=name, n_out=n_out, activation=act,
                   gate_activation=_act(rec_act))
+        if not conf.get("return_sequences", True):
+            # Keras return_sequences=False keeps only the final step; the
+            # reference maps this with the LastTimeStep wrapper
+            from ..nn.layers.recurrent import LastTimeStep
+            lc = LastTimeStep(name=name, underlying=lc)
 
         def copy(w):
             if "kernel" in w:  # Keras 2: fused [in,4h] with gate order ifco
@@ -270,6 +339,9 @@ def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool,
         n_out = int(conf.get("units", conf.get("output_dim", 0)))
         lc = SimpleRnn(name=name, n_out=n_out,
                        activation=_act(conf.get("activation", "tanh")))
+        if not conf.get("return_sequences", True):
+            from ..nn.layers.recurrent import LastTimeStep
+            lc = LastTimeStep(name=name, underlying=lc)
 
         def copy(w):
             out = {"W": w.get("kernel", w.get("W")),
@@ -364,8 +436,13 @@ def import_keras_sequential_model(path_or_bytes) -> MultiLayerNetwork:
         if cls in ("LSTM", "SimpleRNN", "Conv1D", "Convolution1D"):
             rnn_ctx = conf.get("return_sequences", True) or \
                 cls in ("Conv1D", "Convolution1D")
+        elif cls == "Reshape":
+            rnn_ctx = len(conf.get("target_shape", ())) == 2
+        elif cls in ("RepeatVector", "TimeDistributed"):
+            rnn_ctx = True
         elif cls not in ("Dropout", "Activation", "MaxPooling1D",
-                         "AveragePooling1D", "BatchNormalization"):
+                         "AveragePooling1D", "BatchNormalization",
+                         "LeakyReLU", "ELU", "ThresholdedReLU", "Permute"):
             rnn_ctx = rnn_ctx and cls == "Dense"  # time-distributed keeps t
         if lm.conf is None:  # Flatten
             continue
@@ -512,8 +589,13 @@ def import_keras_model(path_or_bytes):
         if cls in ("LSTM", "SimpleRNN", "Conv1D", "Convolution1D"):
             rnn_of[name] = conf.get("return_sequences", True) or \
                 cls in ("Conv1D", "Convolution1D")
+        elif cls == "Reshape":
+            rnn_of[name] = len(conf.get("target_shape", ())) == 2
+        elif cls in ("RepeatVector", "TimeDistributed"):
+            rnn_of[name] = True
         elif cls in ("Dropout", "Activation", "MaxPooling1D",
-                     "AveragePooling1D", "BatchNormalization", "Dense"):
+                     "AveragePooling1D", "BatchNormalization", "Dense",
+                     "LeakyReLU", "ELU", "ThresholdedReLU", "Permute"):
             rnn_of[name] = rnn_in
         else:
             rnn_of[name] = False

@@ -27,12 +27,35 @@ def register(name: str):
     return deco
 
 
+# parameterized activations: "name:param" (e.g. "leakyrelu:0.3") — a plain
+# string so layer configs stay JSON/YAML-serializable (the reference carries
+# the parameter on the IActivation object, e.g. ActivationLReLU(alpha))
+_PARAMETERIZED: Dict[str, Callable[[float], Callable[[Array], Array]]] = {}
+
+
+def register_parameterized(name: str):
+    def deco(factory):
+        _PARAMETERIZED[name.lower()] = factory
+        return factory
+    return deco
+
+
 def get(name) -> Callable[[Array], Array]:
-    """Resolve an activation by name (case-insensitive). Callables pass through."""
+    """Resolve an activation by name (case-insensitive). Callables pass
+    through.  ``"name:param"`` resolves a parameterized activation, e.g.
+    ``"leakyrelu:0.3"``."""
     if callable(name):
         return name
+    s = name.lower()
+    if ":" in s:
+        base, _, arg = s.partition(":")
+        if base in _PARAMETERIZED:
+            return _PARAMETERIZED[base](float(arg))
+        raise ValueError(
+            f"Unknown parameterized activation '{base}'. "
+            f"Available: {sorted(_PARAMETERIZED)}")
     try:
-        return _REGISTRY[name.lower()]
+        return _REGISTRY[s]
     except KeyError:
         raise ValueError(
             f"Unknown activation '{name}'. Available: {sorted(_REGISTRY)}") from None
@@ -150,3 +173,19 @@ def rrelu(x):
 @register("thresholdedrelu")
 def thresholdedrelu(x):
     return jnp.where(x > 1.0, x, 0.0)
+
+
+@register_parameterized("leakyrelu")
+@register_parameterized("lrelu")
+def _leakyrelu_p(alpha: float):
+    return lambda x: jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@register_parameterized("elu")
+def _elu_p(alpha: float):
+    return lambda x: jax.nn.elu(x, alpha=alpha)
+
+
+@register_parameterized("thresholdedrelu")
+def _thresholdedrelu_p(theta: float):
+    return lambda x: jnp.where(x > theta, x, 0.0)

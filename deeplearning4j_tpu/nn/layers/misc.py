@@ -86,3 +86,76 @@ class FrozenLayer(LayerConf):
 
     def feed_forward_mask(self, mask, itype):
         return self.underlying.feed_forward_mask(mask, itype)
+
+
+@register_serde
+@dataclass
+class ReshapeLayer(LayerConf):
+    """Per-example reshape (role of Keras ``Reshape``; the reference maps it
+    via ``KerasReshape`` preprocessors, ``deeplearning4j-modelimport``).
+    ``target_shape``: per-example dims — rank 1 → ff, 2 → rnn [t, f]
+    (time-major per-example, stored batch-major), 3 → cnn [h, w, c]."""
+    INPUT_KIND = "any"
+
+    target_shape: tuple = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        t = tuple(int(d) for d in self.target_shape)
+        if len(t) == 1:
+            return InputType.feed_forward(t[0])
+        if len(t) == 2:
+            return InputType.recurrent(t[1], t[0])
+        if len(t) == 3:
+            return InputType.convolutional(t[0], t[1], t[2])
+        raise ValueError(f"ReshapeLayer: unsupported rank {len(t)}")
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return (x.reshape((x.shape[0],) + tuple(self.target_shape)),
+                variables.get("state", {}))
+
+
+@register_serde
+@dataclass
+class PermuteLayer(LayerConf):
+    """Per-example axis permutation (Keras ``Permute``; 1-indexed dims over
+    the per-example axes, batch axis fixed)."""
+    INPUT_KIND = "any"
+
+    dims: tuple = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == "rnn":
+            shape = [itype.timesteps, itype.size]
+        elif itype.kind == "cnn":
+            shape = [itype.height, itype.width, itype.channels]
+        else:
+            shape = [itype.size]
+        out = [shape[d - 1] for d in self.dims]
+        if len(out) == 1:
+            return InputType.feed_forward(out[0])
+        if len(out) == 2:
+            return InputType.recurrent(out[1], out[0])
+        if len(out) == 3:
+            return InputType.convolutional(out[0], out[1], out[2])
+        raise ValueError(f"PermuteLayer: unsupported rank {len(out)}")
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        return jnp.transpose(x, perm), variables.get("state", {})
+
+
+@register_serde
+@dataclass
+class RepeatVector(LayerConf):
+    """Repeat a [b, f] feature vector n times → [b, n, f] (Keras
+    ``RepeatVector``; reference ``nn/conf/layers/misc/RepeatVector`` role)."""
+    INPUT_KIND = "ff"
+
+    n: int = 1
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(itype.size, self.n)
+
+    def apply(self, variables, x, *, train=False, key=None, mask=None):
+        return (jnp.repeat(x[:, None, :], self.n, axis=1),
+                variables.get("state", {}))

@@ -68,7 +68,9 @@ class ElasticTrainer:
         # the loaded host arrays onto the mesh.  In multi-process runs give
         # each process its own checkpoint_dir (SPMD training is
         # deterministic, so the replicas' checkpoints are identical).
-        self._net = model.model if hasattr(model, "_place") else model
+        inner = getattr(model, "model", None)
+        self._net = inner if (inner is not None
+                              and hasattr(model, "_place")) else model
         self.dir = checkpoint_dir
         self.save_freq = max(1, save_freq)
         self.keep_last = max(1, keep_last)

@@ -40,9 +40,13 @@ def _spawn(pid: int, port: int, outdir: str, max_steps: int,
     })
     if crash_at:
         env["MP_CRASH_AT"] = str(crash_at)
-    return subprocess.Popen([sys.executable, HELPER], env=env,
-                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                            text=True)
+    # log to files, not pipes: a chatty child filling the pipe buffer would
+    # block mid-write and turn a pass into a timeout flake
+    log = open(os.path.join(outdir, f"worker_{pid}.log"), "w")
+    p = subprocess.Popen([sys.executable, HELPER], env=env,
+                         stdout=log, stderr=subprocess.STDOUT, text=True)
+    p._logfile = log
+    return p
 
 
 def _run_workers(port, outdir, max_steps, crash_at_p1=0, timeout=300):
@@ -66,7 +70,11 @@ def _run_workers(port, outdir, max_steps, crash_at_p1=0, timeout=300):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    outs = [p.stdout.read() if p.stdout else "" for p in procs]
+            p._logfile.close()
+    outs = []
+    for pid in range(NPROC):
+        with open(os.path.join(outdir, f"worker_{pid}.log")) as f:
+            outs.append(f.read())
     return rcs, outs
 
 
