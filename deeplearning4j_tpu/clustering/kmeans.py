@@ -55,9 +55,12 @@ def kmeanspp_init(points: np.ndarray, k: int, rng,
     center (the reference's initClusters loop,
     ``clustering/algorithm/BaseClusteringAlgorithm.java:145-160``)."""
     n = len(points)
+    if k > n:
+        raise ValueError(
+            f"k={k} clusters requested but only {n} points given")
     centers = [points[rng.integers(n)]]
     d2 = None
-    for _ in range(1, min(k, n)):
+    for _ in range(1, k):
         cur = np.asarray(pairwise_distance(
             jnp.asarray(points), jnp.asarray(np.stack(centers[-1:])),
             metric))[:, 0] ** 2

@@ -579,11 +579,12 @@ class IteratorDataSetIterator(DataSetIterator):
     def __iter__(self):
         # four parallel buffers: features, labels, and the optional masks
         # (masks must survive re-batching — dropping them would silently
-        # un-mask padded RNN timesteps); a mask column is kept only while
-        # every incoming batch provides it
+        # un-mask padded RNN timesteps).  Mask presence must be consistent
+        # across the stream: flipping mid-stream would emit some re-batched
+        # sets with masks and some without, so mixing raises instead.
         bufs = [[], [], [], []]
         have = 0
-        has_mask = [True, True]
+        has_mask = [None, None]   # None = undecided yet
 
         def _emit(lo, hi):
             cat = [np.concatenate(b)[lo:hi] if b else None for b in bufs]
@@ -598,9 +599,16 @@ class IteratorDataSetIterator(DataSetIterator):
             parts = [np.asarray(ds.features), np.asarray(ds.labels),
                      ds.features_mask, ds.labels_mask]
             for j in range(2):
-                if parts[2 + j] is None:
-                    has_mask[j] = False
-                elif has_mask[j]:
+                present = parts[2 + j] is not None
+                if has_mask[j] is None:
+                    has_mask[j] = present
+                elif has_mask[j] != present:
+                    which = "features" if j == 0 else "labels"
+                    raise ValueError(
+                        f"IteratorDataSetIterator: inconsistent {which}_mask "
+                        "presence across incoming batches (some batches "
+                        "carry a mask, others do not)")
+                if present:
                     bufs[2 + j].append(np.asarray(parts[2 + j]))
             bufs[0].append(parts[0])
             bufs[1].append(parts[1])

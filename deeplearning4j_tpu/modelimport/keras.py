@@ -42,7 +42,7 @@ from .hdf5 import Hdf5File, Hdf5FormatError
 
 __all__ = ["KerasModelImport", "KerasImportError",
            "import_keras_sequential_model", "import_keras_model",
-           "register_keras_layer"]
+           "register_keras_layer", "KerasLayerMapping"]
 
 
 class KerasImportError(ValueError):
@@ -57,9 +57,10 @@ _CUSTOM_LAYERS: Dict[str, Any] = {}
 def register_keras_layer(class_name: str, mapper) -> None:
     """Register an import mapper for a custom Keras layer class.
 
-    ``mapper(conf: dict, is_last: bool, rnn_input: bool) -> _LayerMap`` —
-    build a layer conf plus a weight-copy function (``_LayerMap(conf,
-    copy_fn)``; ``copy_fn(keras_weights) -> params dict``).
+    ``mapper(conf: dict, is_last: bool, rnn_input: bool) ->
+    KerasLayerMapping`` — build a layer conf plus a weight-copy function
+    (``KerasLayerMapping(conf, copy_fn)``; ``copy_fn(keras_weights) ->
+    params dict``).
     """
     _CUSTOM_LAYERS[class_name] = mapper
 
@@ -104,12 +105,16 @@ def _pair(v) -> Tuple[int, int]:
     return int(v), int(v)
 
 
-class _LayerMap:
-    """One imported layer: our conf + a weight-copy function."""
+class KerasLayerMapping:
+    """One imported layer: our conf + a weight-copy function.  Public —
+    custom mappers registered via :func:`register_keras_layer` return it."""
 
     def __init__(self, conf=None, copy=None):
         self.conf = conf
         self.copy = copy  # fn(keras_weights: dict[str, np.ndarray]) -> params
+
+
+_LayerMap = KerasLayerMapping   # internal alias used by the built-in mappers
 
 
 def _map_layer(cls: str, conf: Dict[str, Any], is_last: bool,

@@ -109,6 +109,11 @@ class ReshapeLayer(LayerConf):
             return InputType.convolutional(t[0], t[1], t[2])
         raise ValueError(f"ReshapeLayer: unsupported rank {len(t)}")
 
+    def feed_forward_mask(self, mask, itype):
+        # the reshape reinterprets (or removes) the time axis, so an
+        # incoming per-timestep mask has no meaningful image — drop it
+        return None
+
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         return (x.reshape((x.shape[0],) + tuple(self.target_shape)),
                 variables.get("state", {}))
@@ -139,6 +144,11 @@ class PermuteLayer(LayerConf):
             return InputType.convolutional(out[0], out[1], out[2])
         raise ValueError(f"PermuteLayer: unsupported rank {len(out)}")
 
+    def feed_forward_mask(self, mask, itype):
+        # the permutation moves the time axis; a [b, t] mask indexed on the
+        # old axis would mask the wrong positions — drop it
+        return None
+
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         perm = (0,) + tuple(d for d in self.dims)
         return jnp.transpose(x, perm), variables.get("state", {})
@@ -155,6 +165,10 @@ class RepeatVector(LayerConf):
 
     def output_type(self, itype: InputType) -> InputType:
         return InputType.recurrent(itype.size, self.n)
+
+    def feed_forward_mask(self, mask, itype):
+        # every repeated step is a real step: all-valid (None) downstream
+        return None
 
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         return (jnp.repeat(x[:, None, :], self.n, axis=1),

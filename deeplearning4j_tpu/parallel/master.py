@@ -102,6 +102,25 @@ class TrainingMaster:
     def fit(self, model, iterator) -> None:
         raise NotImplementedError
 
+    def _get_replicas(self, model) -> List[Any]:
+        """Replica pool: clone once per master+model, refresh params from
+        the (possibly updated) master model on later calls — re-cloning
+        every fit would re-trace every replica's jitted step (the reference
+        re-broadcasts params per split, it does not rebuild workers)."""
+        if (getattr(self, "_replicas", None) is None
+                or self._replica_src is not model
+                or len(self._replicas) != self.num_workers):
+            self._replicas = [model] + [model.clone()
+                                        for _ in range(self.num_workers - 1)]
+            self._replica_src = model
+        else:
+            for r in self._replicas[1:]:
+                r.params = jax.tree_util.tree_map(jnp.array, model.params)
+                r.state = jax.tree_util.tree_map(jnp.array, model.state)
+                r.opt_state = jax.tree_util.tree_map(jnp.array,
+                                                     model.opt_state)
+        return self._replicas
+
     def _fan_out(self, model, iterator, num_workers: Optional[int],
                  per_batch: Callable[[Any, Any, int], None]) -> int:
         """Shared map scaffolding for the evaluation/scoring surface: chunk
@@ -151,6 +170,12 @@ class TrainingMaster:
             x, y, _, lm = net._normalize_batch(batch)
             if isinstance(x, list):  # ComputationGraph batch
                 out = net.output(*x)
+                if isinstance(out, (list, tuple)) and len(out) > 1:
+                    import warnings
+                    warnings.warn(
+                        "TrainingMaster.evaluate: multi-output graph — "
+                        "only output[0]/labels[0] are evaluated; evaluate "
+                        "other heads separately", stacklevel=2)
                 out = out[0] if isinstance(out, (list, tuple)) else out
                 y0 = y[0] if isinstance(y, (list, tuple)) else y
                 lm0 = lm[0] if isinstance(lm, (list, tuple)) else lm
@@ -208,7 +233,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         parts = _chunk_batches(iterator, self.num_workers)
         self.stats.record("split", time.perf_counter() - t0)
         t0 = time.perf_counter()
-        replicas = [model] + [model.clone() for _ in range(self.num_workers - 1)]
+        replicas = self._get_replicas(model)
         self.stats.record("broadcast", time.perf_counter() - t0)
         n_rounds = (max(len(p) for p in parts) + self.averaging_frequency - 1
                     ) // self.averaging_frequency
@@ -266,7 +291,7 @@ class SharedGradientsTrainingMaster(TrainingMaster):
         from jax.flatten_util import ravel_pytree
 
         parts = _chunk_batches(iterator, self.num_workers)
-        replicas = [model] + [model.clone() for _ in range(self.num_workers - 1)]
+        replicas = self._get_replicas(model)
         acc = self.accumulator
         errors: List[Exception] = []
 
