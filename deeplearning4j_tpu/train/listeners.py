@@ -272,8 +272,8 @@ class ConvolutionalIterationListener(TrainingListener):
     """Render conv-layer activation grids to HTML every N iterations
     (reference ``RemoteConvolutionalIterationListener`` / ``WebReporter``:
     the reference posts rendered activations to the UI; here they land as
-    standalone HTML files, or are POSTed to a remote router when ``url``
-    is given)."""
+    standalone HTML files, or are POSTed to a UIServer's /activations page
+    when ``url`` is given, e.g. ``url=f"http://127.0.0.1:{ui.port}/activations"``)."""
 
     def __init__(self, probe_batch, frequency: int = 50, output_dir=None,
                  layer_index: int = 0, url: Optional[str] = None):

@@ -7,12 +7,21 @@ the dashboard is a single self-contained HTML page (inline vanilla-JS canvas
 charts, no CDN assets: this environment and many TPU pods have no egress).
 
 Endpoints:
-  GET  /                      dashboard HTML
+  GET  /                      dashboard HTML (overview + per-layer model
+                              drill-down + system sections, the
+                              ``TrainModule.java`` page set)
   GET  /train/sessions        JSON list of session ids
   GET  /train/<sid>/overview  JSON score/time/param-norm series
   GET  /train/<sid>/model     JSON per-parameter stats of the latest record
+  GET  /train/<sid>/param/<name>  JSON drill-down for one parameter:
+                              mean-magnitude/std/norm series for the param
+                              and its updates + the latest histograms
   GET  /train/<sid>/system    JSON memory series
   POST /remote                accept a posted StatsReport JSON (remote router)
+  GET  /activations           latest conv activation grids page
+                              (``ui/module/convolutional`` role)
+  POST /activations           accept {"iteration": N, "svg": ...} from
+                              ConvolutionalIterationListener(url=...)
   GET  /tsne                  embedding scatter page (``ui/module/tsne/TsneModule.java``)
   GET  /tsne/sessions         JSON list of uploaded coordinate sets
   GET  /tsne/coords/<sid>     JSON list of "x,y,label" lines
@@ -35,38 +44,93 @@ __all__ = ["UIServer", "RemoteUIStatsStorageRouter"]
 _PAGE = """<!doctype html><html><head><meta charset="utf-8">
 <title>dl4j-tpu training UI</title><style>
 body{font-family:sans-serif;margin:20px;background:#fafafa}
-h2{margin:8px 0} .chart{background:#fff;border:1px solid #ddd;margin:10px 0}
-#sessions{margin-bottom:12px}</style></head><body>
+h2{margin:8px 0} h3{margin:14px 0 4px} .chart{background:#fff;border:1px solid #ddd;margin:6px 0}
+#sessions{margin-bottom:12px} select{margin:4px 8px 4px 0}
+.row{display:flex;gap:14px;flex-wrap:wrap} a{color:#1565c0}</style></head><body>
 <h2>dl4j-tpu training</h2>
 <div id="sessions"></div>
+<div><a href="/activations">conv activation grids</a> · <a href="/tsne">embedding scatter</a></div>
 <h3>Score vs iteration</h3><canvas id="score" class="chart" width="900" height="240"></canvas>
 <h3>Parameter L2 norms</h3><canvas id="norms" class="chart" width="900" height="240"></canvas>
 <h3>Iteration time (ms)</h3><canvas id="times" class="chart" width="900" height="160"></canvas>
+<h3>Model: per-parameter drill-down</h3>
+<div>parameter: <select id="pname"></select></div>
+<div class="row">
+ <div><div>param histogram (latest)</div><canvas id="phist" class="chart" width="440" height="200"></canvas></div>
+ <div><div>update histogram (latest)</div><canvas id="uhist" class="chart" width="440" height="200"></canvas></div>
+</div>
+<div>mean magnitude: parameter (blue) vs update (red)</div>
+<canvas id="mags" class="chart" width="900" height="200"></canvas>
+<div>parameter std (blue), mean (red)</div>
+<canvas id="pstd" class="chart" width="900" height="160"></canvas>
+<h3>System</h3>
+<canvas id="mem" class="chart" width="900" height="200"></canvas>
+<div id="memlabel"></div>
 <script>
 let sid=null;
 function line(c,series,labels){const x=c.getContext('2d');x.clearRect(0,0,c.width,c.height);
- const all=series.flat(); if(!all.length)return;
+ const all=series.flat().filter(v=>v!=null&&isFinite(v)); if(!all.length)return;
  const mi=Math.min(...all),ma=Math.max(...all),r=(ma-mi)||1;
  const colors=['#1565c0','#c62828','#2e7d32','#f9a825','#6a1b9a','#00838f'];
  series.forEach((s,si)=>{x.beginPath();x.strokeStyle=colors[si%colors.length];
-  s.forEach((v,i)=>{const px=30+i*(c.width-40)/Math.max(s.length-1,1),
-   py=c.height-20-(v-mi)/r*(c.height-40); i?x.lineTo(px,py):x.moveTo(px,py);});
+  let started=false;
+  s.forEach((v,i)=>{if(v==null||!isFinite(v)){started=false;return;}
+   const px=30+i*(c.width-40)/Math.max(s.length-1,1),
+   py=c.height-20-(v-mi)/r*(c.height-40);
+   started?x.lineTo(px,py):x.moveTo(px,py);started=true;});
   x.stroke();
   if(labels&&labels[si]){x.fillStyle=colors[si%colors.length];
    x.fillText(labels[si],40+110*si,12);}});
  x.fillStyle='#333';x.fillText(ma.toPrecision(4),2,14);
  x.fillText(mi.toPrecision(4),2,c.height-22);}
+function bars(c,hist,lo,hi){const x=c.getContext('2d');x.clearRect(0,0,c.width,c.height);
+ if(!hist||!hist.length)return; const ma=Math.max(...hist)||1;
+ const w=(c.width-40)/hist.length;
+ hist.forEach((v,i)=>{const h=v/ma*(c.height-40);
+  x.fillStyle='#1565c0';x.fillRect(30+i*w,c.height-20-h,w-1,h);});
+ x.fillStyle='#333';
+ if(lo!=null)x.fillText(lo.toPrecision(3),25,c.height-6);
+ if(hi!=null)x.fillText(hi.toPrecision(3),c.width-60,c.height-6);}
+async function refreshParam(){
+ if(!sid)return; const sel=document.getElementById('pname');
+ if(!sel.value)return;
+ const d=await (await fetch('/train/'+sid+'/param/'+encodeURIComponent(sel.value))).json();
+ bars(document.getElementById('phist'),d.param_hist,d.param_min,d.param_max);
+ bars(document.getElementById('uhist'),d.update_hist,d.update_min,d.update_max);
+ line(document.getElementById('mags'),[d.param_mean_magnitude,d.update_mean_magnitude],
+      ['param','update']);
+ line(document.getElementById('pstd'),[d.param_std,d.param_mean],['std','mean']);}
 async function refresh(){
  const ss=await (await fetch('/train/sessions')).json();
  document.getElementById('sessions').textContent='sessions: '+ss.join(', ');
  if(!ss.length)return; if(!sid)sid=ss[ss.length-1];
  const o=await (await fetch('/train/'+sid+'/overview')).json();
  line(document.getElementById('score'),[o.scores]);
- const names=Object.keys(o.param_norms).slice(0,6);
- line(document.getElementById('norms'),names.map(n=>o.param_norms[n]),names);
- line(document.getElementById('times'),[o.iter_times_ms]);}
+ const names=Object.keys(o.param_norms);
+ line(document.getElementById('norms'),names.slice(0,6).map(n=>o.param_norms[n]),
+      names.slice(0,6));
+ line(document.getElementById('times'),[o.iter_times_ms]);
+ const sel=document.getElementById('pname');
+ if(sel.options.length!==names.length){const cur=sel.value;sel.innerHTML='';
+  names.forEach(n=>{const op=document.createElement('option');
+   op.value=op.text=n;sel.add(op);});
+  if(cur&&names.includes(cur))sel.value=cur;}
+ await refreshParam();
+ const sys=await (await fetch('/train/'+sid+'/system')).json();
+ const keys=[...new Set(sys.memory.flatMap(m=>Object.keys(m)))].slice(0,4);
+ line(document.getElementById('mem'),
+      keys.map(k=>sys.memory.map(m=>m[k]??null)),keys);
+ document.getElementById('memlabel').textContent='memory keys: '+keys.join(', ');}
+document.getElementById('pname').addEventListener('change',refreshParam);
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
+
+_ACT_PAGE_HEAD = """<!doctype html><html><head><meta charset="utf-8">
+<title>dl4j-tpu conv activations</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+.grid{background:#fff;border:1px solid #ddd;margin:10px 0;padding:8px}
+</style></head><body><h2>Conv activation grids</h2>
+<div><a href="/">back to training</a></div>"""
 
 _TSNE_PAGE = """<!doctype html><html><head><meta charset="utf-8">
 <title>dl4j-tpu embedding viewer</title><style>
@@ -101,6 +165,7 @@ _UPLOADED_FILE = "UploadedFile"
 class _Handler(JsonHandler):
     storage: StatsStorage = None   # set by UIServer
     tsne_sessions: dict = None     # sid -> list[str] coordinate lines
+    activations: list = None       # [{"iteration": N, "svg": ...}]
 
     def _html(self, page: str):
         data = page.encode()
@@ -122,10 +187,50 @@ class _Handler(JsonHandler):
             if parts[1] == "coords" and len(parts) == 3:
                 return self._json(self.tsne_sessions.get(unquote(parts[2]), []))
             return self._json({"error": "not found"}, 404)
+        if parts[0] == "activations":
+            chunks = [f"<div class='grid'><h3>iteration {a['iteration']}"
+                      f"</h3>{a['svg']}</div>"
+                      for a in (self.activations or [])[-12:][::-1]]
+            return self._html(_ACT_PAGE_HEAD + "".join(chunks)
+                              + "</body></html>")
         if parts[0] != "train":
             return self._json({"error": "not found"}, 404)
         if len(parts) == 2 and parts[1] == "sessions":
             return self._json(self.storage.list_session_ids())
+        if len(parts) >= 4 and parts[2] == "param":
+            sid = parts[1]
+            pname = unquote("/".join(parts[3:]))
+            recs = self.storage.get_records(sid)
+
+            def series(stats_attr, key):
+                out = []
+                for r in recs:
+                    st = getattr(r, stats_attr).get(pname)
+                    out.append(None if st is None else st.get(key))
+                return out
+
+            last_p = next((getattr(r, "param_stats").get(pname)
+                           for r in reversed(recs)
+                           if r.param_stats.get(pname)), {})
+            last_u = next((getattr(r, "update_stats").get(pname)
+                           for r in reversed(recs)
+                           if r.update_stats.get(pname)), {})
+            return self._json({
+                "iterations": [r.iteration for r in recs],
+                "param_mean_magnitude": series("param_stats",
+                                               "mean_magnitude"),
+                "param_std": series("param_stats", "std"),
+                "param_mean": series("param_stats", "mean"),
+                "param_norm2": series("param_stats", "norm2"),
+                "update_mean_magnitude": series("update_stats",
+                                                "mean_magnitude"),
+                "param_hist": last_p.get("hist"),
+                "param_min": last_p.get("min"),
+                "param_max": last_p.get("max"),
+                "update_hist": last_u.get("hist"),
+                "update_min": last_u.get("min"),
+                "update_max": last_u.get("max"),
+            })
         if len(parts) == 3:
             sid, what = parts[1], parts[2]
             recs = self.storage.get_records(sid)
@@ -150,6 +255,16 @@ class _Handler(JsonHandler):
 
     def do_POST(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if parts and parts[0] == "activations":
+            try:
+                payload = self._read_json()
+                svg = payload["svg"]
+                iteration = int(payload.get("iteration", 0))
+            except Exception as e:
+                return self._json({"error": f"bad payload: {e}"}, 400)
+            self.activations.append({"iteration": iteration, "svg": svg})
+            del self.activations[:-50]   # bounded history
+            return self._json({"ok": True})
         if parts and parts[0] == "tsne":
             n = int(self.headers.get("Content-Length", 0))
             text = self.rfile.read(n).decode("utf-8", errors="replace")
@@ -178,7 +293,8 @@ class UIServer:
     def __init__(self, port: int = 0):
         self._server = BackgroundHttpServer(_Handler, port,
                                             storage=InMemoryStatsStorage(),
-                                            tsne_sessions={})
+                                            tsne_sessions={},
+                                            activations=[])
         self._handler = self._server.httpd.RequestHandlerClass
 
     @property
