@@ -111,16 +111,23 @@ async function refresh(){
       names.slice(0,6));
  line(document.getElementById('times'),[o.iter_times_ms]);
  const sel=document.getElementById('pname');
- if(sel.options.length!==names.length){const cur=sel.value;sel.innerHTML='';
+ const have=[...sel.options].map(o=>o.value).join('\\u0000');
+ if(have!==names.join('\\u0000')){const cur=sel.value;sel.innerHTML='';
   names.forEach(n=>{const op=document.createElement('option');
    op.value=op.text=n;sel.add(op);});
   if(cur&&names.includes(cur))sel.value=cur;}
  await refreshParam();
  const sys=await (await fetch('/train/'+sid+'/system')).json();
  const keys=[...new Set(sys.memory.flatMap(m=>Object.keys(m)))].slice(0,4);
- line(document.getElementById('mem'),
-      keys.map(k=>sys.memory.map(m=>m[k]??null)),keys);
- document.getElementById('memlabel').textContent='memory keys: '+keys.join(', ');}
+ // units differ per key (kb vs bytes): normalize each series to its own
+ // max so every line is readable; the label shows the latest raw values
+ const raw=keys.map(k=>sys.memory.map(m=>m[k]??null));
+ const normed=raw.map(s=>{const mx=Math.max(...s.filter(v=>v!=null))||1;
+  return s.map(v=>v==null?null:v/mx);});
+ line(document.getElementById('mem'),normed,keys);
+ document.getElementById('memlabel').textContent='latest: '+keys.map((k,i)=>{
+  const last=[...raw[i]].reverse().find(v=>v!=null);
+  return k+'='+(last==null?'-':last.toExponential(2));}).join('  ');}
 document.getElementById('pname').addEventListener('change',refreshParam);
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
@@ -260,6 +267,11 @@ class _Handler(JsonHandler):
                 payload = self._read_json()
                 svg = payload["svg"]
                 iteration = int(payload.get("iteration", 0))
+                # stored-injection guard: the page embeds this verbatim
+                if (not isinstance(svg, str)
+                        or not svg.lstrip().lower().startswith("<svg")
+                        or "<script" in svg.lower()):
+                    raise ValueError("svg payload must be a plain <svg>")
             except Exception as e:
                 return self._json({"error": f"bad payload: {e}"}, 400)
             self.activations.append({"iteration": iteration, "svg": svg})

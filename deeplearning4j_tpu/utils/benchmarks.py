@@ -1,0 +1,96 @@
+"""Reusable benchmark configs mirroring BASELINE.md's table (LeNet-MNIST
+step time, GravesLSTM char-RNN step time, Word2Vec words/sec).  The driver's
+headline ResNet50 metric lives in ``bench.py``; these side metrics are
+invoked from there (DL4J_TPU_BENCH_SIDE=1) and from ``tools/``.
+
+All timings are steady-state: compile + warm step first, then ``n_iter``
+timed steps closed with a forced device→host fetch (block_until_ready alone
+can return early through buffer-proxying transports — BENCH_NOTES round 1).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _steady_step_ms(model, x, y, n_iter: int = 20) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    model.fit(x, y)           # compile + first step
+    step = model._get_jitted("train_step")
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        model._rng, key = jax.random.split(model._rng)
+        (model.params, model.state, model.opt_state, loss,
+         model._last_grad_stats) = step(
+            model.params, model.state, model.opt_state, key,
+            x, y, None, None)
+    float(jnp.asarray(loss))
+    return (time.perf_counter() - t0) / n_iter * 1e3
+
+
+def lenet_step_time(batch: int = 128, n_iter: int = 20) -> Dict:
+    """LeNet-MNIST training step time (zoo ``model/LeNet.java:35``)."""
+    import jax.numpy as jnp
+
+    from ..models import LeNet
+    model = LeNet().init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 28, 28, 1), dtype=np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, batch)])
+    ms = _steady_step_ms(model, x, y, n_iter)
+    return {"metric": "lenet_mnist_step_ms", "value": round(ms, 3),
+            "unit": "ms/step", "batch": batch,
+            "examples_per_sec": round(batch / ms * 1e3, 1)}
+
+
+def char_lstm_step_time(batch: int = 128, timesteps: int = 64,
+                        n_iter: int = 20) -> Dict:
+    """Char-RNN step time (zoo ``model/TextGenerationLSTM.java:34``; the
+    reference's cuDNN LSTM path, ``GravesLSTM.java:46``)."""
+    import jax.numpy as jnp
+
+    from ..models import TextGenerationLSTM
+    model = TextGenerationLSTM(timesteps=timesteps).init()
+    rng = np.random.default_rng(0)
+    vocab = 26
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, timesteps))])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        rng.integers(0, vocab, (batch, timesteps))])
+    ms = _steady_step_ms(model, x, y, n_iter)
+    return {"metric": "char_lstm_step_ms", "value": round(ms, 3),
+            "unit": "ms/step", "batch": batch, "timesteps": timesteps,
+            "tokens_per_sec": round(batch * timesteps / ms * 1e3, 1)}
+
+
+def word2vec_words_per_sec(vocab: int = 5000, n_sent: int = 20000,
+                           sent_len: int = 20, epochs: int = 1) -> Dict:
+    """Skip-gram NS throughput (parity bar: the reference's native batched
+    AggregateSkipGram hot loop, ``SkipGram.java:271-283``).  Steady state:
+    first fit compiles, second fit on reset weights is timed."""
+    from ..nlp.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    ids = np.clip(rng.zipf(1.3, size=n_sent * sent_len), 1, vocab) - 1
+    toks = ["w%d" % i for i in ids]
+    sentences = [" ".join(toks[i * sent_len:(i + 1) * sent_len])
+                 for i in range(n_sent)]
+    total = n_sent * sent_len * epochs
+    w2v = Word2Vec(sentences=sentences, layer_size=128, window=5, negative=5,
+                   epochs=epochs, seed=1, min_word_frequency=1)
+    w2v.build_vocab()
+    t0 = time.perf_counter()
+    w2v.fit()
+    cold = total / (time.perf_counter() - t0)
+    w2v.lookup_table.reset_weights()
+    t0 = time.perf_counter()
+    w2v.fit()
+    steady = total / (time.perf_counter() - t0)
+    return {"metric": "word2vec_words_per_sec", "value": round(steady, 1),
+            "unit": "words/sec", "cold_words_per_sec": round(cold, 1),
+            "vocab": vocab, "corpus_words": total}

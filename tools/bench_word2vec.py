@@ -1,42 +1,13 @@
-"""Word2Vec throughput bench (BASELINE.md words/sec target).
-
-Synthetic zipf corpus, 5k vocab / layer 128 / window 5 / negative 5 —
-the BENCH_NOTES round-1 configuration.  Reports steady-state words/sec
-(post-compile: the first fit compiles the scan kernel, then weights are
-reset and a second identical fit is timed) plus the cold number.
-Parity bar: the reference's native batched AggregateSkipGram hot loop
-(``SkipGram.java:271-283``).
-"""
-import os, sys, time
-import numpy as np
+"""Word2Vec throughput bench — thin CLI over
+deeplearning4j_tpu.utils.benchmarks.word2vec_words_per_sec (the BASELINE.md
+words/sec target; parity bar SkipGram.java:271-283)."""
+import json, os, sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.utils.benchmarks import word2vec_words_per_sec
 
-V = int(os.environ.get("W2V_VOCAB", "5000"))
-NSENT = int(os.environ.get("W2V_SENT", "20000"))
-SLEN = int(os.environ.get("W2V_SLEN", "20"))
-EPOCHS = int(os.environ.get("W2V_EPOCHS", "1"))
-
-rng = np.random.default_rng(0)
-ids = np.clip(rng.zipf(1.3, size=NSENT * SLEN), 1, V) - 1
-sents = ["w%d" % i for i in ids]
-sentences = [" ".join(sents[i * SLEN:(i + 1) * SLEN]) for i in range(NSENT)]
-total_words = NSENT * SLEN * EPOCHS
-
-w2v = Word2Vec(sentences=sentences, layer_size=128, window=5, negative=5,
-               epochs=EPOCHS, seed=1, min_word_frequency=1)
-w2v.build_vocab()
-
-# cold fit (includes the one-time scan-kernel compile)
-t0 = time.perf_counter()
-w2v.fit()
-cold = time.perf_counter() - t0
-
-# steady state: same jitted shapes (vocab unchanged), fresh weights
-w2v.lookup_table.reset_weights()
-t0 = time.perf_counter()
-w2v.fit()
-dt = time.perf_counter() - t0
-print(f"steady: {total_words/dt:.0f} words/sec ({total_words} words in "
-      f"{dt:.2f}s); cold: {total_words/cold:.0f} words/sec (compile included)")
+print(json.dumps(word2vec_words_per_sec(
+    vocab=int(os.environ.get("W2V_VOCAB", "5000")),
+    n_sent=int(os.environ.get("W2V_SENT", "20000")),
+    sent_len=int(os.environ.get("W2V_SLEN", "20")),
+    epochs=int(os.environ.get("W2V_EPOCHS", "1")))))
