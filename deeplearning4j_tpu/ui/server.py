@@ -267,11 +267,19 @@ class _Handler(JsonHandler):
                 payload = self._read_json()
                 svg = payload["svg"]
                 iteration = int(payload.get("iteration", 0))
-                # stored-injection guard: the page embeds this verbatim
+                # stored-injection guard: the page embeds this verbatim.
+                # reject the standard SVG script vectors (script tags,
+                # event-handler attributes, javascript: URLs, foreignObject)
+                low = svg.lower() if isinstance(svg, str) else ""
+                import re as _re
                 if (not isinstance(svg, str)
-                        or not svg.lstrip().lower().startswith("<svg")
-                        or "<script" in svg.lower()):
-                    raise ValueError("svg payload must be a plain <svg>")
+                        or not low.lstrip().startswith("<svg")
+                        or "<script" in low
+                        or "javascript:" in low
+                        or "<foreignobject" in low
+                        or _re.search(r"\son\w+\s*=", low)):
+                    raise ValueError("svg payload must be a plain <svg> "
+                                     "without scripts/event handlers")
             except Exception as e:
                 return self._json({"error": f"bad payload: {e}"}, 400)
             self.activations.append({"iteration": iteration, "svg": svg})

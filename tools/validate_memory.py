@@ -1,0 +1,46 @@
+import json
+import numpy as np
+import jax.numpy as jnp
+from deeplearning4j_tpu.models import ResNet50, LeNet
+from deeplearning4j_tpu.nn.conf.memory import (memory_report,
+                                               memory_report_graph,
+                                               xla_memory_report)
+
+rng = np.random.default_rng(0)
+
+net = ResNet50(num_classes=1000, compute_dtype="bfloat16",
+               input_shape=(224, 224, 3)).init()
+rep = memory_report_graph(net.conf)
+batch = 128
+x = rng.standard_normal((batch, 224, 224, 3), dtype=np.float32)
+y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+exact = xla_memory_report(net, [x], [y])
+pred = rep.total_memory_bytes(batch)
+print(json.dumps({"model": "resnet50_bf16_b128",
+                  "analytic_upper_MiB": round(pred / 2**20, 1),
+                  "xla_total_MiB": round(exact["total_bytes"] / 2**20, 1),
+                  "params": rep.total_params,
+                  "ratio": round(pred / exact["total_bytes"], 3)}))
+# param+updater accounting vs XLA argument bytes (minus the data args)
+data_bytes = x.nbytes + y.nbytes + 8
+pred_args = (rep.total_params * 4 + rep.total_updater_elems * 4)
+print(json.dumps({"check": "resnet50 params+updater vs XLA args",
+                  "pred_MiB": round(pred_args / 2**20, 1),
+                  "xla_MiB": round((exact["argument_bytes"] - data_bytes) / 2**20, 1),
+                  "rel_err": round(abs(pred_args - (exact["argument_bytes"] - data_bytes))
+                                   / (exact["argument_bytes"] - data_bytes), 4)}))
+del net
+
+net = LeNet().init()
+rep2 = memory_report(net.conf)
+x = rng.standard_normal((128, 28, 28, 1), dtype=np.float32)
+y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 128)]
+exact2 = xla_memory_report(net, x, y)
+pred2 = rep2.total_memory_bytes(128)
+data2 = x.nbytes + y.nbytes + 8
+pred_args2 = rep2.total_params * 4 + rep2.total_updater_elems * 4
+print(json.dumps({"model": "lenet_f32_b128",
+                  "analytic_upper_MiB": round(pred2 / 2**20, 1),
+                  "xla_total_MiB": round(exact2["total_bytes"] / 2**20, 1),
+                  "args_rel_err": round(abs(pred_args2 - (exact2["argument_bytes"] - data2))
+                                        / (exact2["argument_bytes"] - data2), 4)}))
