@@ -3,23 +3,78 @@
 Reference ``deeplearning4j-nlp-chinese`` (vendored ansj segmenter),
 ``deeplearning4j-nlp-japanese`` (vendored kuromoji), and
 ``deeplearning4j-nlp-korean`` TokenizerFactory wrappers.  The reference
-vendors full morphological analyzers (~20k LoC of dictionaries); the
-TPU build provides the same factory API over dictionary-less segmentation
-(per-character for Han, script-run for Japanese, whitespace+particle-strip
-for Korean) with an optional user dictionary for greedy longest-match —
-exact morphology can be plugged in by supplying a richer dictionary, the
-factory contract is what the pipeline depends on.
+vendors full morphological analyzers (~20k LoC of dictionaries each); the
+TPU build carries a small bundled high-frequency lexicon (``lexicons.py``)
+and segments by **unigram Viterbi lattice**: best[i] maximizes the summed
+word log-probabilities over any tiling of the text, with per-character OOV
+fallbacks and same-script-run candidates (so unknown katakana/latin words
+stay whole).  This resolves the classic ambiguities a greedy matcher gets
+wrong (e.g. 研究/生命/科学 vs 研究生/命/科学).  Users extend coverage by
+passing a ``dictionary`` — their entries outrank the bundled lexicon.
+
+The lattice DP is a host-side Viterbi over text positions with variable
+arcs (one per candidate word); ``utils/viterbi.py`` stays the accelerator
+path for fixed-state HMM decoding, which this deliberately is not — token
+emission is host work feeding the device pipeline.
 """
 from __future__ import annotations
 
 import re
 import unicodedata
-from typing import Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional
 
+from .lexicons import _OOV_CHAR, CHINESE_LEXICON, JAPANESE_LEXICON
 from .tokenization import TokenPreProcess, Tokenizer, TokenizerFactory
 
 __all__ = ["ChineseTokenizerFactory", "JapaneseTokenizerFactory",
-           "KoreanTokenizerFactory"]
+           "KoreanTokenizerFactory", "lattice_segment"]
+
+_USER_WORD_LOGP = -3.5   # user-dictionary entries outrank bundled words
+
+
+def lattice_segment(text: str, lexicon: Dict[str, float], *,
+                    max_len: int = 8, oov_logp: float = _OOV_CHAR,
+                    run_candidates: bool = False) -> List[str]:
+    """Unigram Viterbi word lattice: choose the tiling of ``text`` that
+    maximizes the sum of word log-probabilities.  Candidates per position:
+    every lexicon word starting there, a single-character OOV fallback,
+    and (``run_candidates``) the maximal same-script katakana/latin/digit
+    run — scored slightly above the equivalent chain of OOV chars so
+    unknown transliterations/numbers stay one token."""
+    n = len(text)
+    NEG = float("-inf")
+    best = [0.0] + [NEG] * n
+    back = [0] * (n + 1)
+    for i in range(n):
+        if best[i] == NEG:
+            continue
+        top = min(max_len, n - i)
+        for ln in range(1, top + 1):
+            w = text[i:i + ln]
+            sc = lexicon.get(w)
+            if sc is not None and best[i] + sc > best[i + ln]:
+                best[i + ln] = best[i] + sc
+                back[i + ln] = i
+        if best[i] + oov_logp > best[i + 1]:
+            best[i + 1] = best[i] + oov_logp
+            back[i + 1] = i
+        if run_candidates:
+            k = _script(text[i])
+            if k in ("kata", "latin"):
+                j = i + 1
+                while j < n and _script(text[j]) == k:
+                    j += 1
+                if j - i > 1:
+                    sc = best[i] + oov_logp * (j - i) * 0.6
+                    if sc > best[j]:
+                        best[j] = sc
+                        back[j] = i
+    out: List[str] = []
+    i = n
+    while i > 0:
+        out.append(text[back[i]:i])
+        i = back[i]
+    return out[::-1]
 
 
 def _is_han(ch: str) -> bool:
@@ -54,36 +109,19 @@ def _script(ch: str) -> str:
     return "punct"
 
 
-def _greedy_dict_segment(text: str, dictionary: Set[str],
-                         max_len: int) -> List[str]:
-    """Greedy longest-match over a user dictionary; single chars fall out
-    as themselves."""
-    out: List[str] = []
-    i = 0
-    n = len(text)
-    while i < n:
-        for ln in range(min(max_len, n - i), 1, -1):
-            if text[i:i + ln] in dictionary:
-                out.append(text[i:i + ln])
-                i += ln
-                break
-        else:
-            out.append(text[i])
-            i += 1
-    return out
-
-
 class ChineseTokenizerFactory(TokenizerFactory):
     """Reference ``ChineseTokenizerFactory.java`` (ansj).  Han runs are
-    segmented per character, or by greedy longest-match when a
-    ``dictionary`` of known words is supplied; non-Han runs tokenize like
+    segmented by the bundled-lexicon Viterbi lattice; an optional user
+    ``dictionary`` merges in with priority.  Non-Han runs tokenize like
     the default whitespace tokenizer."""
 
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
                  dictionary: Optional[Iterable[str]] = None):
         super().__init__(pre_processor)
-        self.dictionary: Set[str] = set(dictionary or ())
-        self._max_word = max((len(w) for w in self.dictionary), default=1)
+        self.lexicon: Dict[str, float] = dict(CHINESE_LEXICON)
+        for w in dictionary or ():
+            self.lexicon[w] = _USER_WORD_LOGP
+        self._max_word = max((len(w) for w in self.lexicon), default=1)
 
     def create(self, sentence: str) -> Tokenizer:
         tokens: List[str] = []
@@ -95,11 +133,8 @@ class ChineseTokenizerFactory(TokenizerFactory):
             if not run:
                 return
             if run_kind == "han":
-                if self.dictionary:
-                    tokens.extend(_greedy_dict_segment(
-                        run, self.dictionary, self._max_word))
-                else:
-                    tokens.extend(run)
+                tokens.extend(lattice_segment(run, self.lexicon,
+                                              max_len=self._max_word))
             else:
                 tokens.extend(run.split())
             run = ""
@@ -115,25 +150,36 @@ class ChineseTokenizerFactory(TokenizerFactory):
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Reference ``JapaneseTokenizerFactory.java`` (kuromoji).  Segments on
-    script-run boundaries (kanji / hiragana / katakana / latin) — the
-    standard lightweight fallback; hiragana runs commonly carry particles
-    and inflections, so they stay separate tokens."""
+    """Reference ``JapaneseTokenizerFactory.java`` (kuromoji).  The whole
+    sentence (minus spaces/punctuation) runs through the bundled-lexicon
+    Viterbi lattice: particles/auxiliaries split off content words, known
+    kanji compounds stay whole, unknown katakana/latin runs survive as
+    single tokens.  A user ``dictionary`` merges in with priority."""
+
+    def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
+                 dictionary: Optional[Iterable[str]] = None):
+        super().__init__(pre_processor)
+        self.lexicon: Dict[str, float] = dict(JAPANESE_LEXICON)
+        for w in dictionary or ():
+            self.lexicon[w] = _USER_WORD_LOGP
+        self._max_word = max((len(w) for w in self.lexicon), default=1)
 
     def create(self, sentence: str) -> Tokenizer:
         tokens: List[str] = []
         run = ""
-        run_kind = None
         for ch in sentence:
-            kind = _script(ch)
-            if kind != run_kind:
-                if run and run_kind not in ("space", "punct"):
-                    tokens.append(run)
-                run = ""
-                run_kind = kind
-            run += ch
-        if run and run_kind not in ("space", "punct"):
-            tokens.append(run)
+            if _script(ch) in ("space", "punct"):
+                if run:
+                    tokens.extend(lattice_segment(
+                        run, self.lexicon, max_len=self._max_word,
+                        run_candidates=True))
+                    run = ""
+            else:
+                run += ch
+        if run:
+            tokens.extend(lattice_segment(run, self.lexicon,
+                                          max_len=self._max_word,
+                                          run_candidates=True))
         return Tokenizer(tokens, self._pre)
 
 

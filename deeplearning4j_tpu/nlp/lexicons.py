@@ -1,0 +1,73 @@
+"""Bundled CJK lexicons for lattice segmentation (``cjk.py``).
+
+The reference vendors full morphological dictionaries (ansj for Chinese,
+kuromoji for Japanese — ~20k LoC of data each,
+``deeplearning4j-nlp-chinese/``, ``-japanese/``).  This is a deliberately
+small high-frequency core: enough for the Viterbi lattice to segment
+ordinary sentences correctly; domain users merge in their own dictionary
+through the factory argument (user entries outrank bundled ones).
+
+Scores are log-probabilities by frequency band; multi-character dictionary
+words must beat sequences of single-character/OOV fallbacks.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+# frequency bands (log-prob per word)
+_TOP = -4.0      # function words / ubiquitous
+_HIGH = -5.5     # everyday vocabulary
+_MID = -7.0      # common nouns/verbs
+_OOV_CHAR = -9.5  # per-character fallback used by the lattice
+
+
+def _band(words: str, score: float) -> Dict[str, float]:
+    return {w: score for w in words.split()}
+
+
+CHINESE_LEXICON: Dict[str, float] = {}
+CHINESE_LEXICON.update(_band(
+    "的 了 在 是 我 你 他 她 它 不 和 有 这 那 就 也 都 很 到 说 要 去 会 着 "
+    "没 看 好 自 己 上 下 大 小 多 少 人 年 月 日 中 国", _TOP))
+CHINESE_LEXICON.update(_band(
+    "我们 你们 他们 她们 什么 怎么 这个 那个 这里 那里 现在 时候 时间 今天 "
+    "明天 昨天 可以 没有 知道 觉得 认为 喜欢 希望 需要 应该 因为 所以 但是 "
+    "如果 虽然 已经 还是 非常 一起 一个 一些 大家 自己 朋友 先生 女士 孩子 "
+    "东西 事情 地方 问题 开始 结束 工作 生活 学习 使用", _HIGH))
+CHINESE_LEXICON.update(_band(
+    "中国 北京 上海 世界 国家 城市 学校 学生 老师 大学 中学 小学 医生 医院 "
+    "公司 银行 商店 飞机 火车 汽车 电脑 手机 网络 信息 新闻 电影 音乐 天气 "
+    "太阳 月亮 动物 植物 苹果 经济 发展 技术 科学 研究 教育 文化 历史 社会 "
+    "政府 语言 文字 汉语 英语 数据 计算 模型 机器 父母 家庭 生命 命运 改变", _MID))
+CHINESE_LEXICON.update(_band(
+    "计算机 办公室 出租车 图书馆 互联网 研究生 科学家 实验室", _MID))
+CHINESE_LEXICON.update(_band(
+    "人工智能 机器学习 深度学习 神经网络 自然语言", _MID))
+
+JAPANESE_LEXICON: Dict[str, float] = {}
+# particles and auxiliaries — the backbone of the lattice
+JAPANESE_LEXICON.update(_band(
+    "は が を に で と も の へ や から まで より ね よ か な", _TOP))
+JAPANESE_LEXICON.update(_band(
+    "です ます でした ました ません ない した して いる ある する き て た "
+    "し い う お ご", _TOP))
+JAPANESE_LEXICON.update(_band(
+    "私 あなた 彼 彼女 これ それ あれ ここ そこ どこ 誰 何 今 人 年 月 日 "
+    "時 分 中 上 下 大 小", _HIGH))
+JAPANESE_LEXICON.update(_band(
+    "わたし きょう あした きのう こんにちは ありがとう さようなら おはよう "
+    "ください もの こと とき ところ", _HIGH))
+JAPANESE_LEXICON.update(_band(
+    "日本 東京 大阪 京都 学校 学生 先生 大学 会社 仕事 時間 今日 明日 昨日 "
+    "電車 自動車 飛行機 天気 雨 晴れ 本 水 食事 映画 音楽 写真 電話 部屋 "
+    "家 街 国 言葉 日本語 英語 勉強 研究 科学 技術 計算 情報 世界 問題 "
+    "元気 名前 友達 家族 子供 生活 いい 良い", _MID))
+JAPANESE_LEXICON.update(_band(
+    "食べる 飲む 行く 来る 見る 聞く 話す 読む 書く 買う 作る 使う 思う "
+    "知る 分かる 食べ 飲み 行き 来 見 聞き 話し 読み 書き 買い 作り 使い "
+    "思い 知り 分かり", _MID))
+JAPANESE_LEXICON.update(_band(
+    "コンピュータ インターネット ニュース テレビ カメラ ホテル レストラン",
+    _MID))
+JAPANESE_LEXICON.update(_band(
+    "人工知能 機械学習 深層学習", _MID))

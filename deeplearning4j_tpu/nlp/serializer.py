@@ -1,18 +1,26 @@
 """WordVectorSerializer: persistence formats for embedding models.
 
-Reference ``models/embeddings/loader/WordVectorSerializer.java`` (txt, the
-original word2vec C binary format, and a zip "full model" with vocab +
-weights + config).  Formats kept wire-compatible with the ecosystem:
+Reference ``models/embeddings/loader/WordVectorSerializer.java`` (txt, csv,
+the original word2vec C binary format, gzipped variants, and a zip "full
+model" with vocab + weights + config).  Formats kept wire-compatible with
+the ecosystem:
 
 - ``write_word_vectors`` / ``read_word_vectors``: the gensim/word2vec .txt
   format — header line ``<vocab> <dim>``, then ``word v1 v2 ...`` rows.
+- ``write_csv`` / ``read_csv``: headerless ``word,v1,v2,...`` rows.
 - ``write_binary`` / ``read_binary``: word2vec C ``.bin`` (little-endian f32).
 - ``write_full_model`` / ``read_full_model``: zip of config.json +
   vocab.json + syn0/syn1/syn1neg .npy — lossless round-trip incl. Huffman
   codes and counts, so training can resume.
+- gzip: text formats write compressed when the path ends in ``.gz`` and
+  reads auto-detect the gzip magic (the reference reads compressed models
+  transparently).
+- ``load_static_model``: sniff the format (zip / gzip / binary / csv /
+  txt) and load vectors for inference — the ``loadStaticModel`` role.
 """
 from __future__ import annotations
 
+import gzip
 import io
 import json
 import struct
@@ -28,9 +36,26 @@ from .vocab import VocabCache, VocabWord
 from .word2vec import Word2Vec
 
 
+def _open_text_write(path: str):
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "wt", encoding="utf-8")
+    return open(path, "w", encoding="utf-8")
+
+
+def _is_gzip(path: str) -> bool:
+    with open(path, "rb") as f:
+        return f.read(2) == b"\x1f\x8b"
+
+
+def _open_text_read(path: str):
+    if _is_gzip(path):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, encoding="utf-8")
+
+
 def write_word_vectors(model, path: str) -> None:
     syn0 = np.asarray(model.lookup_table.syn0)
-    with open(path, "w", encoding="utf-8") as f:
+    with _open_text_write(path) as f:
         f.write(f"{syn0.shape[0]} {syn0.shape[1]}\n")
         for i in range(syn0.shape[0]):
             vec = " ".join(f"{x:.6f}" for x in syn0[i])
@@ -38,7 +63,7 @@ def write_word_vectors(model, path: str) -> None:
 
 
 def read_word_vectors(path: str) -> Word2Vec:
-    with open(path, encoding="utf-8") as f:
+    with _open_text_read(path) as f:
         header = f.readline().split()
         n, d = int(header[0]), int(header[1])
         vocab = VocabCache()
@@ -48,6 +73,34 @@ def read_word_vectors(path: str) -> Word2Vec:
             vocab.add_token(VocabWord(parts[0]))
             rows[i] = [float(x) for x in parts[1:d + 1]]
     return _assemble(vocab, rows)
+
+
+def write_csv(model, path: str) -> None:
+    """Headerless csv rows ``word,v1,...`` (reference WordVectorSerializer
+    csv flavor).  Commas in words are not representable — rejected."""
+    syn0 = np.asarray(model.lookup_table.syn0)
+    with _open_text_write(path) as f:
+        for i in range(syn0.shape[0]):
+            word = model.vocab.word_at_index(i)
+            if "," in word:
+                raise ValueError(
+                    f"word {word!r} contains a comma — csv cannot carry it; "
+                    "use the txt or binary format")
+            f.write(word + "," + ",".join(f"{x:.6f}" for x in syn0[i]) + "\n")
+
+
+def read_csv(path: str) -> Word2Vec:
+    vocab = VocabCache()
+    rows = []
+    with _open_text_read(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            parts = line.split(",")
+            vocab.add_token(VocabWord(parts[0]))
+            rows.append([float(x) for x in parts[1:]])
+    return _assemble(vocab, np.asarray(rows, dtype=np.float32))
 
 
 def write_binary(model, path: str) -> None:
@@ -85,6 +138,47 @@ def _assemble(vocab: VocabCache, rows: np.ndarray) -> Word2Vec:
     model.lookup_table = InMemoryLookupTable(vocab, rows.shape[1])
     model.lookup_table.syn0 = jnp.asarray(rows)
     return model
+
+
+def load_static_model(path: str) -> Word2Vec:
+    """Load vectors from any supported on-disk format for inference
+    (reference ``WordVectorSerializer.loadStaticModel``): sniffs zip (full
+    model), gzip (txt/csv inside), word2vec C binary, csv, and txt.
+    """
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic[:2] == b"PK":
+        return read_full_model(path)
+    if magic[:2] == b"\x1f\x8b":
+        with gzip.open(path, "rt", encoding="utf-8") as f:
+            first = f.readline()
+        return read_csv(path) if "," in first else read_word_vectors(path)
+    # uncompressed: header "n d" means txt/bin; csv has no header
+    with open(path, "rb") as f:
+        first = f.readline()
+    try:
+        text = first.decode("utf-8").strip()
+    except UnicodeDecodeError:
+        text = ""
+    parts = text.split()
+    if len(parts) == 2 and all(p.isdigit() for p in parts):
+        # txt and bin share the header; bin rows are raw little-endian f32
+        # after "word " — sniff the second line for utf-8 text
+        with open(path, "rb") as f:
+            f.readline()
+            second = f.read(256)
+        try:
+            second.decode("utf-8")
+            return read_word_vectors(path)
+        except UnicodeDecodeError as e:
+            # a multi-byte character split at the 256-byte chunk boundary is
+            # still text; only a decode failure in the interior means binary
+            if e.start >= len(second) - 4:
+                return read_word_vectors(path)
+            return read_binary(path)
+    if "," in text:
+        return read_csv(path)
+    raise ValueError(f"unrecognized word-vector format in {path!r}")
 
 
 def write_full_model(model: SequenceVectors, path: str) -> None:

@@ -54,8 +54,9 @@ def load_model_guess(path: str) -> Any:
         from .model_serializer import restore_model
         return restore_model(path)
     if kind == "word_vectors":
-        from ..nlp.serializer import read_word_vectors
-        return read_word_vectors(path)
+        # full sniffing loader: txt/csv/binary/gzip variants
+        from ..nlp.serializer import load_static_model
+        return load_static_model(path)
     if kind == "stats_log":
         from ..ui.storage import FileStatsStorage
         return FileStatsStorage(path)
