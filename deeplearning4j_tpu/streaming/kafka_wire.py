@@ -1,0 +1,379 @@
+"""Kafka wire-protocol (v0) client + dev broker.
+
+Reference ``dl4j-streaming/.../streaming/kafka/NDArrayKafkaClient.java``
+talks to a real Kafka cluster through the Kafka client library.  This
+module implements the actual **Kafka binary protocol** (Produce v0 /
+Fetch v0, message-set v0 with CRC32) over stdlib sockets, so the framework
+can interoperate with a real broker where one exists — and ships
+``MiniKafkaBroker``, an in-process single-node broker speaking the same
+frames, for dev rigs and tests (the LocalMessageBroker/TcpMessageBroker in
+``broker.py`` remain the non-Kafka transports).
+
+Protocol framing (Kafka protocol guide, v0):
+  request  = int32 size | int16 api_key | int16 api_version
+             | int32 correlation_id | string client_id | body
+  message  = int32 crc | int8 magic(0) | int8 attrs | bytes key | bytes value
+  msum crc = CRC32 over magic..value
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["KafkaWireClient", "MiniKafkaBroker", "NDArrayKafkaClient"]
+
+_API_PRODUCE = 0
+_API_FETCH = 1
+
+
+# ---------------------------------------------------------------- primitives
+def _str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def take(self, fmt: str):
+        vals = struct.unpack_from(">" + fmt, self.data, self.off)
+        self.off += struct.calcsize(">" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def string(self) -> str:
+        n = self.take("h")
+        if n < 0:            # nullable string: no payload bytes follow
+            return ""
+        s = self.data[self.off:self.off + n].decode()
+        self.off += n
+        return s
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.take("i")
+        if n < 0:
+            return None
+        b = self.data[self.off:self.off + n]
+        self.off += n
+        return b
+
+
+# ------------------------------------------------------------- message sets
+def encode_message(value: bytes, key: Optional[bytes] = None) -> bytes:
+    body = struct.pack(">bb", 0, 0) + _bytes(key) + _bytes(value)
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack(">I", crc) + body
+
+
+def encode_message_set(values: List[bytes],
+                       base_offset: int = 0) -> bytes:
+    out = b""
+    for i, v in enumerate(values):
+        msg = encode_message(v)
+        out += struct.pack(">qi", base_offset + i, len(msg)) + msg
+    return out
+
+
+def decode_message_set(data: bytes) -> List[Tuple[int, bytes]]:
+    """[(offset, value)] — raises on CRC mismatch (torn/corrupt message)."""
+    out: List[Tuple[int, bytes]] = []
+    off = 0
+    while off + 12 <= len(data):
+        offset, size = struct.unpack_from(">qi", data, off)
+        off += 12
+        if off + size > len(data):
+            break  # partial trailing message (Kafka semantics: ignore)
+        msg = data[off:off + size]
+        off += size
+        crc = struct.unpack_from(">I", msg, 0)[0]
+        if zlib.crc32(msg[4:]) & 0xFFFFFFFF != crc:
+            raise ValueError(f"message at offset {offset}: CRC mismatch")
+        r = _Reader(msg)
+        r.take("I")          # crc
+        _magic, attrs = r.take("bb")
+        if attrs & 0x07:
+            raise ValueError(
+                f"message at offset {offset}: compressed message sets "
+                f"(attrs={attrs:#x}) are not supported — produce uncompressed")
+        r.bytes_()           # key
+        value = r.bytes_()
+        out.append((offset, value or b""))
+    return out
+
+
+# ------------------------------------------------------------------ client
+class KafkaWireClient:
+    """Minimal Kafka v0 client: produce/fetch against one broker (the
+    bootstrap broker is assumed to lead the addressed partitions — the
+    single-node dev case; a full metadata round is out of scope)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "dl4j-tpu",
+                 timeout: float = 10.0):
+        self.addr = (host, port)
+        self.client_id = client_id
+        self.timeout = timeout
+        self._corr = 0
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, self.timeout)
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def _roundtrip(self, api_key: int, body: bytes) -> _Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            req = (struct.pack(">hhi", api_key, 0, corr)
+                   + _str(self.client_id) + body)
+            try:
+                sock = self._connect()
+                sock.sendall(struct.pack(">i", len(req)) + req)
+                raw = self._recv_frame(sock)
+            except Exception:
+                # a timeout / partial read leaves the stream desynced —
+                # drop the socket so the next call reconnects cleanly
+                self.close()
+                raise
+        r = _Reader(raw)
+        got = r.take("i")
+        if got != corr:
+            self.close()
+            raise IOError(f"correlation id mismatch: sent {corr} got {got}")
+        return r
+
+    def _recv_frame(self, sock: socket.socket) -> bytes:
+        hdr = self._recv_n(sock, 4)
+        (n,) = struct.unpack(">i", hdr)
+        return self._recv_n(sock, n)
+
+    @staticmethod
+    def _recv_n(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("broker closed the connection")
+            buf += chunk
+        return buf
+
+    def produce(self, topic: str, partition: int,
+                values: List[bytes]) -> int:
+        """Append messages; returns the base offset assigned."""
+        mset = encode_message_set(values)
+        body = (struct.pack(">hi", 1, int(self.timeout * 1000))  # acks=1
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">i", partition)
+                + struct.pack(">i", len(mset)) + mset)
+        r = self._roundtrip(_API_PRODUCE, body)
+        n_topics = r.take("i")
+        assert n_topics == 1
+        r.string()
+        n_parts = r.take("i")
+        assert n_parts == 1
+        _part, err, base = r.take("i"), r.take("h"), r.take("q")
+        if err:
+            raise IOError(f"produce error code {err}")
+        return base
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1 << 20) -> List[Tuple[int, bytes]]:
+        """[(offset, value)] from ``offset`` onward (may be empty)."""
+        body = (struct.pack(">iii", -1, 100, 0)
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iqi", partition, offset, max_bytes))
+        r = self._roundtrip(_API_FETCH, body)
+        n_topics = r.take("i")
+        assert n_topics == 1
+        r.string()
+        n_parts = r.take("i")
+        assert n_parts == 1
+        _part, err, _hw = r.take("i"), r.take("h"), r.take("q")
+        if err:
+            raise IOError(f"fetch error code {err}")
+        size = r.take("i")
+        mset = r.data[r.off:r.off + size]
+        return decode_message_set(mset)
+
+
+# ------------------------------------------------------------------ broker
+class MiniKafkaBroker:
+    """Single-node in-process broker speaking Produce v0 / Fetch v0 — the
+    dev/test stand-in for a real cluster (role of an embedded Kafka in the
+    reference's test rigs).  Logs live in memory per (topic, partition)."""
+
+    def __init__(self, port: int = 0):
+        self._logs: Dict[Tuple[str, int], List[bytes]] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = self._frame()
+                        if raw is None:
+                            return
+                        try:
+                            resp = outer._dispatch(raw)
+                        except (ValueError, struct.error):
+                            # malformed/corrupt request: close the
+                            # connection cleanly instead of a traceback
+                            return
+                        self.request.sendall(
+                            struct.pack(">i", len(resp)) + resp)
+                except (ConnectionError, OSError):
+                    return
+
+            def _frame(self):
+                try:
+                    hdr = KafkaWireClient._recv_n(self.request, 4)
+                except ConnectionError:
+                    return None
+                (n,) = struct.unpack(">i", hdr)
+                return KafkaWireClient._recv_n(self.request, n)
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", port),
+                                                       Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MiniKafkaBroker":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request dispatch -------------------------------------------------
+    def _dispatch(self, raw: bytes) -> bytes:
+        r = _Reader(raw)
+        api_key, _ver, corr = r.take("h"), r.take("h"), r.take("i")
+        r.string()  # client_id
+        if api_key == _API_PRODUCE:
+            return struct.pack(">i", corr) + self._produce(r)
+        if api_key == _API_FETCH:
+            return struct.pack(">i", corr) + self._fetch(r)
+        return struct.pack(">i", corr)
+
+    def _produce(self, r: _Reader) -> bytes:
+        r.take("h")  # acks
+        r.take("i")  # timeout
+        out = b""
+        n_topics = r.take("i")
+        out += struct.pack(">i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            out += _str(topic)
+            n_parts = r.take("i")
+            out += struct.pack(">i", n_parts)
+            for _ in range(n_parts):
+                part = r.take("i")
+                size = r.take("i")
+                mset = r.data[r.off:r.off + size]
+                r.off += size
+                values = [v for _, v in decode_message_set(mset)]
+                with self._lock:
+                    log = self._logs.setdefault((topic, part), [])
+                    base = len(log)
+                    log.extend(values)
+                out += struct.pack(">ihq", part, 0, base)
+        return out
+
+    def _fetch(self, r: _Reader) -> bytes:
+        r.take("i")  # replica_id
+        r.take("i")  # max_wait
+        r.take("i")  # min_bytes
+        out = b""
+        n_topics = r.take("i")
+        out += struct.pack(">i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            out += _str(topic)
+            n_parts = r.take("i")
+            out += struct.pack(">i", n_parts)
+            for _ in range(n_parts):
+                part, offset, max_bytes = r.take("i"), r.take("q"), r.take("i")
+                with self._lock:
+                    log = self._logs.get((topic, part), [])
+                    high = len(log)
+                    tail = log[offset:] if 0 <= offset <= high else None
+                if tail is None:     # Kafka error 1: OFFSET_OUT_OF_RANGE
+                    out += struct.pack(">ihq", part, 1, high)
+                    out += struct.pack(">i", 0)
+                    continue
+                chunk: List[bytes] = []
+                total = 0
+                for v in tail:
+                    total += len(v) + 38
+                    if chunk and total > max_bytes:
+                        break
+                    chunk.append(v)
+                mset = encode_message_set(chunk, base_offset=offset)
+                out += struct.pack(">ihq", part, 0, high)
+                out += struct.pack(">i", len(mset)) + mset
+        return out
+
+
+# ------------------------------------------------------- NDArray transport
+class NDArrayKafkaClient:
+    """Publish/consume NDArrays over the Kafka wire protocol (reference
+    ``NDArrayKafkaClient.java``): arrays ride as codec-serialized message
+    values; consumption is offset-tracked per client."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 partition: int = 0):
+        self._client = KafkaWireClient(host, port)
+        self.topic = topic
+        self.partition = partition
+        self._offset = 0
+
+    def publish(self, arr) -> int:
+        from .codec import serialize_array
+        return self._client.produce(self.topic, self.partition,
+                                    [serialize_array(arr)])
+
+    def publish_all(self, arrays) -> int:
+        from .codec import serialize_array
+        return self._client.produce(self.topic, self.partition,
+                                    [serialize_array(a) for a in arrays])
+
+    def poll(self, max_items: int = 64):
+        """Arrays appended since the last poll (advances this client's
+        offset — the auto-commit consumer role)."""
+        from .codec import deserialize_array
+        msgs = self._client.fetch(self.topic, self.partition, self._offset)
+        out = []
+        for off, val in msgs[:max_items]:
+            out.append(deserialize_array(val)[0])
+            self._offset = off + 1
+        return out
+
+    def close(self) -> None:
+        self._client.close()

@@ -20,3 +20,45 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+# Heavy tests (>5s on the 1-core CPU environment, mostly XLA compiles of
+# full zoo architectures).  Fast loop: pytest -m "not slow" (~6.5 min);
+# full suite ~14 min.  Centralized here so test files stay unmarked.
+_SLOW_TESTS = {
+    "test_googlenet_forward",
+    "test_two_process_training_and_crash_recovery",
+    "test_facenet_embeddings_normalized",
+    "test_resnet50_small_train_step",
+    "test_3d_transformer_training_step",
+    "test_ring_attention_exact",
+    "test_graph_fold_resnet_block",
+    "test_alexnet_forward",
+    "test_switch_transformer_block_moe",
+    "test_graph_builder_modules",
+    "test_vgg_forward",
+    "test_inception_resnet_v1_forward",
+    "test_vae_pretrain_and_generate",
+    "test_lenet_train_step",
+    "test_transformer_lm_trains_and_predicts",
+    "test_generate_tokens_greedy_recovers_cycle",
+    "test_learns_and_tracks_aux",
+    "test_gpipe_gradients_match_sequential",
+    "test_simplecnn_forward",
+    "test_sharded_moe_matches_single_device",
+    "test_seq2seq_vertices",
+    "test_transformer_incremental_decode_matches_full_forward",
+    "test_moe_layer_rnn_input",
+    "test_lenet_style_mnist_training",
+    "test_transformer_lm_trains",
+    "test_training_matches_scan",
+    "test_parameter_averaging_learns_iris",
+    "test_graph_fit_on_device",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
