@@ -1,0 +1,82 @@
+"""Distributed embedding training: partitioned corpus → per-worker
+SequenceVectors → averaged tables.
+
+Reference ``dl4j-spark-nlp``: ``SparkWord2Vec``/``SparkSequenceVectors``
+build the vocabulary on the driver, map partitions of the sentence RDD
+through per-executor SGNS training, and average the resulting word vectors
+(``Word2Vec.java:61`` mapPartitions :211).  TPU-native framing: the vocab
+is built once (one shared index space), the corpus splits into worker
+shards trained through the same bulk NS fast path, and the final tables are
+tree-averaged — the same parameter-averaging contract the TrainingMasters
+use for networks.  Workers are threads here (one process per host applies
+in real deployments; each worker's fit is dominated by its own jitted
+device dispatches).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .word2vec import Word2Vec
+
+__all__ = ["train_word2vec_distributed"]
+
+
+def train_word2vec_distributed(sentences: Sequence[str], num_workers: int = 2,
+                               **w2v_kwargs) -> Word2Vec:
+    """Train Word2Vec over ``num_workers`` corpus shards and average.
+
+    The returned model owns the shared vocabulary and the averaged
+    syn0/syn1neg tables.  Semantics mirror the reference's parameter
+    averaging: each shard trains independently from the same initial
+    weights, then tables average (weighted equally — the reference's
+    counter-weighted variant reduces to this for near-even shards).
+    """
+    if num_workers < 1:
+        raise ValueError("num_workers must be >= 1")
+    master = Word2Vec(sentences=list(sentences), **w2v_kwargs)
+    master.build_vocab()       # driver-side shared vocab (one index space)
+    if num_workers == 1:
+        master.fit()
+        return master
+
+    shards = [list(sentences)[i::num_workers] for i in range(num_workers)]
+    workers: List[Word2Vec] = []
+    for shard in shards:
+        w = Word2Vec(sentences=shard, **w2v_kwargs)
+        # share the driver's vocab + fresh identically-seeded weights so
+        # every worker starts from the same point in the same index space
+        w.vocab = master.vocab
+        from .lookup_table import InMemoryLookupTable
+        w.lookup_table = InMemoryLookupTable(
+            master.vocab, master.layer_size, seed=master.seed,
+            use_hs=master.use_hs, negative=master.negative)
+        w.lookup_table.reset_weights()
+        workers.append(w)
+
+    errors: List[Exception] = []
+
+    def run(w: Word2Vec):
+        try:
+            w.fit()
+        except Exception as e:   # surface worker crashes to the caller
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+    lt = master.lookup_table
+    for name in ("syn0", "syn1", "syn1neg"):
+        parts = [np.asarray(getattr(w.lookup_table, name))
+                 for w in workers if getattr(w.lookup_table, name) is not None]
+        if parts:
+            import jax.numpy as jnp
+            setattr(lt, name, jnp.asarray(np.mean(parts, axis=0)))
+    return master

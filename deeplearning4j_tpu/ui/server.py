@@ -48,7 +48,8 @@ h2{margin:8px 0} h3{margin:14px 0 4px} .chart{background:#fff;border:1px solid #
 #sessions{margin-bottom:12px} select{margin:4px 8px 4px 0}
 .row{display:flex;gap:14px;flex-wrap:wrap} a{color:#1565c0}</style></head><body>
 <h2>dl4j-tpu training</h2>
-<div id="sessions"></div>
+<div>session: <select id="sid"></select>
+ <label><input type="checkbox" id="compare"> compare all sessions</label></div>
 <div><a href="/activations">conv activation grids</a> · <a href="/tsne">embedding scatter</a></div>
 <h3>Score vs iteration</h3><canvas id="score" class="chart" width="900" height="240"></canvas>
 <h3>Parameter L2 norms</h3><canvas id="norms" class="chart" width="900" height="240"></canvas>
@@ -100,22 +101,33 @@ async function refreshParam(){
  line(document.getElementById('mags'),[d.param_mean_magnitude,d.update_mean_magnitude],
       ['param','update']);
  line(document.getElementById('pstd'),[d.param_std,d.param_mean],['std','mean']);}
+function syncSelect(sel,values,fallback){
+ const have=[...sel.options].map(o=>o.value).join('\\u0000');
+ if(have!==values.join('\\u0000')){const cur=sel.value;sel.innerHTML='';
+  values.forEach(v=>{const op=document.createElement('option');
+   op.value=op.text=v;sel.add(op);});
+  sel.value=(cur&&values.includes(cur))?cur:fallback(values);}}
 async function refresh(){
  const ss=await (await fetch('/train/sessions')).json();
- document.getElementById('sessions').textContent='sessions: '+ss.join(', ');
- if(!ss.length)return; if(!sid)sid=ss[ss.length-1];
- const o=await (await fetch('/train/'+sid+'/overview')).json();
- line(document.getElementById('score'),[o.scores]);
+ const ssel=document.getElementById('sid');
+ syncSelect(ssel,ss,v=>v[v.length-1]);
+ if(!ss.length)return; sid=ssel.value;
+ let o;
+ if(document.getElementById('compare').checked&&ss.length>1){
+  // multi-session compare: overlay every session's score curve
+  const all=await Promise.all(ss.map(s=>
+    fetch('/train/'+s+'/overview').then(r=>r.json())));
+  o=all[ss.indexOf(sid)];
+  line(document.getElementById('score'),all.map(a=>a.scores),ss);
+ }else{
+  o=await (await fetch('/train/'+sid+'/overview')).json();
+  line(document.getElementById('score'),[o.scores]);
+ }
  const names=Object.keys(o.param_norms);
  line(document.getElementById('norms'),names.slice(0,6).map(n=>o.param_norms[n]),
       names.slice(0,6));
  line(document.getElementById('times'),[o.iter_times_ms]);
- const sel=document.getElementById('pname');
- const have=[...sel.options].map(o=>o.value).join('\\u0000');
- if(have!==names.join('\\u0000')){const cur=sel.value;sel.innerHTML='';
-  names.forEach(n=>{const op=document.createElement('option');
-   op.value=op.text=n;sel.add(op);});
-  if(cur&&names.includes(cur))sel.value=cur;}
+ syncSelect(document.getElementById('pname'),names,v=>v[0]);
  await refreshParam();
  const sys=await (await fetch('/train/'+sid+'/system')).json();
  const keys=[...new Set(sys.memory.flatMap(m=>Object.keys(m)))].slice(0,4);
@@ -129,6 +141,8 @@ async function refresh(){
   const last=[...raw[i]].reverse().find(v=>v!=null);
   return k+'='+(last==null?'-':last.toExponential(2));}).join('  ');}
 document.getElementById('pname').addEventListener('change',refreshParam);
+document.getElementById('sid').addEventListener('change',refresh);
+document.getElementById('compare').addEventListener('change',refresh);
 refresh();setInterval(refresh,2000);
 </script></body></html>"""
 

@@ -315,3 +315,28 @@ def test_bulk_ns_degenerate_sentences():
                   min_word_frequency=1)
     w3.fit()
     assert np.isfinite(np.asarray(w3.lookup_table.syn0)).all()
+
+
+def test_distributed_word2vec_fan_out():
+    """SparkSequenceVectors role (dl4j-spark-nlp): shared vocab, partitioned
+    corpus trained per worker, tables averaged — the averaged model must
+    still separate the topics."""
+    from deeplearning4j_tpu.nlp.distributed_vectors import (
+        train_word2vec_distributed)
+    rng = np.random.default_rng(6)
+    animals = ["cat", "dog", "cow", "horse", "sheep"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk"]
+    sents = [" ".join(rng.choice(animals if rng.random() < 0.5 else tech,
+                                 size=8)) for _ in range(400)]
+    m = train_word2vec_distributed(sents, num_workers=3, layer_size=24,
+                                   window=4, negative=5, epochs=3, seed=0,
+                                   min_word_frequency=1)
+    assert m.vocab.num_words() == 10
+    assert m.similarity("cat", "dog") > m.similarity("cat", "gpu")
+    s0 = np.asarray(m.lookup_table.syn0)
+    assert np.isfinite(s0).all()
+    # single-worker path degenerates to plain fit
+    m1 = train_word2vec_distributed(sents[:50], num_workers=1, layer_size=8,
+                                    window=2, negative=3, epochs=1, seed=0,
+                                    min_word_frequency=1)
+    assert np.isfinite(np.asarray(m1.lookup_table.syn0)).all()
