@@ -72,3 +72,25 @@ class TestGoldenGraph:
         out = net.output(io["probe"])
         out = np.asarray(out[0] if isinstance(out, list) else out)
         np.testing.assert_allclose(out, io["output"], rtol=1e-5, atol=1e-6)
+
+
+def test_golden_word2vec_full_model():
+    """Format stability for the Word2Vec full-model zip (WordVectorSerializer
+    role): the committed fixture must load with identical vectors and
+    support query + resumed training.  Do NOT regenerate the fixture — add
+    version-tolerant deserialization instead."""
+    from deeplearning4j_tpu.nlp.serializer import read_full_model
+    m = read_full_model(str(RES / "golden_w2v_v1.zip"))
+    io = np.load(RES / "golden_w2v_v1_io.npz", allow_pickle=False)
+    assert list(io["words"]) == m.vocab.words()
+    np.testing.assert_allclose(np.asarray(m.get_word_vector("alpha")),
+                               io["alpha_vec"], atol=1e-6)
+    assert abs(m.similarity("alpha", "beta") - float(io["sim_ab"])) < 1e-5
+    # resume training on the restored tables must run and stay finite
+    from deeplearning4j_tpu.nlp.sentence_iterator import (
+        CollectionSentenceIterator)
+    m.sentence_iterator = CollectionSentenceIterator(
+        ["alpha beta gamma", "delta epsilon zeta"] * 10)
+    m.epochs = 1
+    m.fit()
+    assert np.isfinite(np.asarray(m.lookup_table.syn0)).all()
