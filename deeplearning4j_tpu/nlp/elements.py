@@ -193,3 +193,39 @@ def glove_epoch(w, w_ctx, b, b_ctx, hw, hwc, hb, hbc, rows_b, cols_b, xij_b,
         body, (w, w_ctx, b, b_ctx, hw, hwc, hb, hbc),
         (rows_b, cols_b, xij_b))
     return carry + (losses,)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_steps_hs(syn0, syn1, pts, cds, msk, ctxs, centers, n_valids,
+                      alphas):
+    """S sequential HS skip-gram step-batches fused into ONE dispatch.
+
+    The Huffman tables live on device (pts/cds/msk: [V, C] from
+    ``build_hs_tables``) and each step gathers its labels by center index —
+    no host-side label packing at all (the HS analogue of
+    ``skipgram_steps_ns``; reference hot loop ``SkipGram.java:271-283``
+    with ``isUseHierarchicSoftmax``).  Padded rows (>= n_valid) carry zero
+    masks and scatter zeros.
+    """
+    _, B = ctxs.shape
+
+    def body(carry, args):
+        syn0, syn1 = carry
+        ctx, center, n_valid, alpha = args
+        row_valid = (jnp.arange(B) < n_valid).astype(syn0.dtype)
+        points = pts[center]                             # (B, C)
+        codes = cds[center].astype(syn0.dtype)
+        cmask = (msk[center].astype(syn0.dtype)
+                 * row_valid[:, None])
+        v = syn0[ctx]
+        p = syn1[points]                                 # (B, C, D)
+        f = _sigmoid(jnp.einsum("bd,bcd->bc", v, p))
+        g = (1.0 - codes - f) * alpha * cmask
+        neu1e = jnp.einsum("bc,bcd->bd", g, p)
+        syn1 = syn1.at[points].add(g[..., None] * v[:, None, :])
+        syn0 = syn0.at[ctx].add(neu1e * row_valid[:, None])
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (ctxs, centers, n_valids, alphas))
+    return syn0, syn1

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .elements import (cbow_step, infer_step, skipgram_step,
-                       skipgram_steps_ns)
+                       skipgram_steps_hs, skipgram_steps_ns)
 from .lookup_table import InMemoryLookupTable
 from .vocab import VocabCache, VocabConstructor, subsample_keep_prob
 from .word_vectors import WordVectors
@@ -241,6 +241,14 @@ class SequenceVectors(WordVectors):
                 and self.negative > 0
                 and lt.table is not None and len(lt.table) > 0)
 
+    def _hs_tables(self):
+        """(code_len, (pts, cds, msk)) with the max_code_length clamp —
+        one source of truth for both the generic and bulk HS paths."""
+        vocab_words = self.vocab.vocab_words()
+        code_len = max((vw.code_length for vw in vocab_words), default=1)
+        code_len = min(max(code_len, 1), self.max_code_length)
+        return code_len, build_hs_tables(vocab_words, code_len)
+
     def _rows_per_step(self) -> int:
         """Batched rows update from stale weights (the reference's
         sequential hogwild never sees this): with a small vocabulary a big
@@ -257,13 +265,17 @@ class SequenceVectors(WordVectors):
         has_labels = (type(self)._sequence_labels
                       is not SequenceVectors._sequence_labels)
         lt = self.lookup_table
-        if self._ns_fast_eligible() and not has_labels:
-            return self._fit_bulk_ns()
+        if not has_labels and self.elements_algorithm == "skipgram":
+            if self._ns_fast_eligible():
+                return self._fit_bulk_sg("ns")
+            if self.use_hs and self.negative == 0:
+                return self._fit_bulk_sg("hs")
         rng = np.random.default_rng(self.seed)
         vocab_words = self.vocab.vocab_words()
         keep = subsample_keep_prob(self.vocab, self.sampling)
-        code_len = max((vw.code_length for vw in vocab_words), default=1)
-        code_len = min(max(code_len, 1), self.max_code_length)
+        code_len, _hs = self._hs_tables() if self.use_hs else (
+            min(max(max((vw.code_length for vw in vocab_words), default=1),
+                    1), self.max_code_length), None)
         total = max(self.vocab.total_word_count * self.epochs, 1)
         seen = 0
         syn0, syn1, syn1neg = lt.syn0, lt.syn1, lt.syn1neg
@@ -281,8 +293,7 @@ class SequenceVectors(WordVectors):
         # device-sampling fast path: NS-only skip-gram ships just the int32
         # pair indices per step; negatives come from the HBM-resident table
         fast_ns = self._ns_fast_eligible()
-        hs_tables = build_hs_tables(vocab_words, code_len) if self.use_hs \
-            else None
+        hs_tables = _hs
         key = jax.random.PRNGKey(self.seed) if fast_ns else None
         if fast_ns:
             table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
@@ -362,8 +373,10 @@ class SequenceVectors(WordVectors):
         flush(force=True)
         lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
 
-    def _fit_bulk_ns(self) -> None:
-        """Corpus-level vectorized NS skip-gram (the words/sec fast path).
+    def _fit_bulk_sg(self, mode: str) -> None:
+        """Corpus-level vectorized skip-gram (the words/sec fast path);
+        ``mode``: "ns" (device-side negative sampling) or "hs"
+        (hierarchical softmax with device-resident Huffman tables).
 
         The reference reaches throughput by running the hot loop as native
         batched ``AggregateSkipGram`` ops fed by a producer thread
@@ -378,8 +391,12 @@ class SequenceVectors(WordVectors):
            (same semantics: per-center reduced window b ~ U[0, W),
            sentence-boundary clipping, subsampling before windowing),
         3. pairs ship to the device in ~2^17-pair scan-fused dispatches
-           (``skipgram_steps_ns``: device-side negative sampling), with the
+           (``skipgram_steps_ns`` / ``skipgram_steps_hs`` — negatives are
+           sampled and Huffman labels gathered ON DEVICE), with the
            learning rate decayed at each pair's exact corpus position.
+
+        DeepWalk/Node2Vec (degree-Huffman HS over random walks) ride the
+        "hs" mode automatically.
         """
         lt = self.lookup_table
         rng = np.random.default_rng(self.seed)
@@ -391,9 +408,18 @@ class SequenceVectors(WordVectors):
         # steps — steps read fresh carry weights, so more steps never hurts
         B = self._rows_per_step()
         S = max(self.scan_steps, self._BULK_PAIRS_PER_DISPATCH // B)
-        syn0, syn1neg = lt.syn0, lt.syn1neg
-        table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
-        key = jax.random.PRNGKey(self.seed)
+        if mode == "ns":
+            syn0, syn_out = lt.syn0, lt.syn1neg
+            table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
+            key = jax.random.PRNGKey(self.seed)
+        else:
+            syn0 = lt.syn0
+            syn_out = lt.syn1 if lt.syn1 is not None \
+                else jnp.zeros_like(lt.syn0)
+            _, (pts, cds, msk) = self._hs_tables()
+            pts_dev = jnp.asarray(pts)
+            cds_dev = jnp.asarray(cds)
+            msk_dev = jnp.asarray(msk)
 
         pend: List = []      # [(ctx, cen, pos)] pair chunks awaiting dispatch
         pend_n = 0
@@ -406,16 +432,22 @@ class SequenceVectors(WordVectors):
                     positions[rows])
 
         def run_block(ctxs, cens, n_valids, steps_pos):
-            nonlocal syn0, syn1neg, key
+            nonlocal syn0, syn_out, key
             alphas = np.maximum(
                 self.min_learning_rate,
                 self.learning_rate * (1.0 - steps_pos / total)
             ).astype(np.float32)
-            key, sub = jax.random.split(key)
-            syn0, syn1neg = skipgram_steps_ns(
-                syn0, syn1neg, table_dev, jnp.asarray(ctxs),
-                jnp.asarray(cens), jnp.asarray(n_valids), sub,
-                jnp.asarray(alphas), self.negative)
+            if mode == "ns":
+                key, sub = jax.random.split(key)
+                syn0, syn_out = skipgram_steps_ns(
+                    syn0, syn_out, table_dev, jnp.asarray(ctxs),
+                    jnp.asarray(cens), jnp.asarray(n_valids), sub,
+                    jnp.asarray(alphas), self.negative)
+            else:
+                syn0, syn_out = skipgram_steps_hs(
+                    syn0, syn_out, pts_dev, cds_dev, msk_dev,
+                    jnp.asarray(ctxs), jnp.asarray(cens),
+                    jnp.asarray(n_valids), jnp.asarray(alphas))
 
         def dispatch(force=False):
             nonlocal pend, pend_n
@@ -515,7 +547,10 @@ class SequenceVectors(WordVectors):
                     flush_chunk()
             flush_chunk()
         dispatch(force=True)
-        lt.syn0, lt.syn1neg = syn0, syn1neg
+        if mode == "ns":
+            lt.syn0, lt.syn1neg = syn0, syn_out
+        else:
+            lt.syn0, lt.syn1 = syn0, syn_out
 
     def _pending_empty(self, batcher) -> bool:
         if self.elements_algorithm == "skipgram":
