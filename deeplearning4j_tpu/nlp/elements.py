@@ -229,3 +229,78 @@ def skipgram_steps_hs(syn0, syn1, pts, cds, msk, ctxs, centers, n_valids,
     (syn0, syn1), _ = jax.lax.scan(
         body, (syn0, syn1), (ctxs, centers, n_valids, alphas))
     return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("K",))
+def cbow_steps_ns(syn0, syn1neg, table, ctxw, cmask, centers, n_valids, key,
+                  alphas, K: int):
+    """S sequential NS CBOW step-batches in ONE dispatch (scan-fused
+    analogue of ``cbow_step``; reference ``AggregateCBOW``).
+
+    ctxw/cmask: (S, B, W2) window-word rows + validity; centers: (S, B).
+    Negatives sample on device from the HBM unigram table; the averaged
+    window vector trains against center + negatives and the full error
+    vector is added to every valid context row (word2vec convention).
+    """
+    S, B, _ = ctxw.shape
+    keys = jax.random.split(key, S)
+
+    def body(carry, args):
+        syn0, syn1neg = carry
+        ctx, cm, center, n_valid, k, alpha = args
+        row_valid = (jnp.arange(B) < n_valid).astype(syn0.dtype)
+        cm = cm.astype(syn0.dtype) * row_valid[:, None]
+        v_ctx = syn0[ctx]                                    # (B, W2, D)
+        denom = jnp.maximum(cm.sum(-1, keepdims=True), 1.0)
+        v = (v_ctx * cm[..., None]).sum(1) / denom           # (B, D)
+        samples = table[jax.random.randint(k, (B, K), 0, table.shape[0])]
+        neg = jnp.concatenate([center[:, None], samples], axis=1)
+        neg_label = jnp.concatenate(
+            [jnp.ones((B, 1), syn0.dtype), jnp.zeros((B, K), syn0.dtype)],
+            axis=1)
+        neg_mask = jnp.concatenate(
+            [jnp.ones((B, 1), syn0.dtype),
+             (samples != center[:, None]).astype(syn0.dtype)], axis=1)
+        neg_mask = neg_mask * row_valid[:, None]
+        n = syn1neg[neg]
+        fn = _sigmoid(jnp.einsum("bd,bkd->bk", v, n))
+        gn = (neg_label - fn) * alpha * neg_mask
+        neu1e = jnp.einsum("bk,bkd->bd", gn, n)
+        syn1neg = syn1neg.at[neg].add(gn[..., None] * v[:, None, :])
+        syn0 = syn0.at[ctx].add(neu1e[:, None, :] * cm[..., None])
+        return (syn0, syn1neg), None
+
+    (syn0, syn1neg), _ = jax.lax.scan(
+        body, (syn0, syn1neg), (ctxw, cmask, centers, n_valids, keys, alphas))
+    return syn0, syn1neg
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_steps_hs(syn0, syn1, pts, cds, msk, ctxw, cmask, centers, n_valids,
+                  alphas):
+    """S sequential HS CBOW step-batches in ONE dispatch; Huffman tables
+    resident on device, labels gathered by center index."""
+    _, B, _ = ctxw.shape
+
+    def body(carry, args):
+        syn0, syn1 = carry
+        ctx, cm, center, n_valid, alpha = args
+        row_valid = (jnp.arange(B) < n_valid).astype(syn0.dtype)
+        cm = cm.astype(syn0.dtype) * row_valid[:, None]
+        v_ctx = syn0[ctx]
+        denom = jnp.maximum(cm.sum(-1, keepdims=True), 1.0)
+        v = (v_ctx * cm[..., None]).sum(1) / denom
+        points = pts[center]
+        codes = cds[center].astype(syn0.dtype)
+        code_mask = msk[center].astype(syn0.dtype) * row_valid[:, None]
+        p = syn1[points]
+        f = _sigmoid(jnp.einsum("bd,bcd->bc", v, p))
+        g = (1.0 - codes - f) * alpha * code_mask
+        neu1e = jnp.einsum("bc,bcd->bd", g, p)
+        syn1 = syn1.at[points].add(g[..., None] * v[:, None, :])
+        syn0 = syn0.at[ctx].add(neu1e[:, None, :] * cm[..., None])
+        return (syn0, syn1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (ctxw, cmask, centers, n_valids, alphas))
+    return syn0, syn1

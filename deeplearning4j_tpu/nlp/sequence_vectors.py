@@ -20,8 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .elements import (cbow_step, infer_step, skipgram_step,
-                       skipgram_steps_hs, skipgram_steps_ns)
+from .elements import (cbow_step, cbow_steps_hs, cbow_steps_ns, infer_step,
+                       skipgram_step, skipgram_steps_hs, skipgram_steps_ns)
 from .lookup_table import InMemoryLookupTable
 from .vocab import VocabCache, VocabConstructor, subsample_keep_prob
 from .word_vectors import WordVectors
@@ -103,13 +103,12 @@ class _PairBatcher:
         return self._take(force)
 
 
-def _window_pairs(rng, W: int, N: int, sent_id=None):
-    """Vectorized skip-gram window-pair emission over N token positions:
-    per-center reduced half-width w = W - b, b ~ U[0, W) — the C original's
-    window shrink (``SkipGram.skipGram``, SkipGram.java:200-221).  Returns
-    (context_positions, center_positions).  ``sent_id``: optional [N] array;
-    pairs never cross a sentence boundary (used by the corpus-chunk bulk
-    path, where many sentences are emitted in one pass)."""
+def _window_matrix(rng, W: int, N: int, sent_id=None):
+    """Per-position context-window matrix over N token positions with the
+    C original's window shrink (half-width w = W - b, b ~ U[0, W);
+    ``SkipGram.skipGram``, SkipGram.java:200-221).  Returns
+    (positions [N, 2W] clipped in-range, valid [N, 2W]).  ``sent_id``:
+    optional [N] array; windows never cross a sentence boundary."""
     w = W - rng.integers(0, W, size=N)                   # (N,) in [1, W]
     offs = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
     pos = np.arange(N)[:, None] + offs[None, :]
@@ -117,6 +116,13 @@ def _window_pairs(rng, W: int, N: int, sent_id=None):
     valid = (np.abs(offs)[None, :] <= w[:, None]) & (pos >= 0) & (pos < N)
     if sent_id is not None:
         valid &= sent_id[posc] == sent_id[:, None]
+    return posc, valid
+
+
+def _window_pairs(rng, W: int, N: int, sent_id=None):
+    """Flattened (context_positions, center_positions) pairs from
+    :func:`_window_matrix` — the skip-gram emission."""
+    posc, valid = _window_matrix(rng, W, N, sent_id)
     cen_rows = np.broadcast_to(np.arange(N)[:, None], valid.shape)
     return posc[valid], cen_rows[valid]
 
@@ -233,13 +239,17 @@ class SequenceVectors(WordVectors):
     _BULK_CHUNK_WORDS = 1 << 18          # corpus words per vectorized emission
     _BULK_CACHE_LIMIT = 50_000_000       # max words of indexed-corpus cache
 
-    def _ns_fast_eligible(self) -> bool:
-        """NS-only skip-gram with a device-resident negative table: the
-        configuration both fast paths (in-batcher and bulk) require."""
+    def _ns_eligible(self) -> bool:
+        """Algorithm-agnostic NS fast-path condition: negative sampling
+        enabled with a device-resident unigram table (and no HS objective).
+        Single source of truth for the in-batcher and bulk gates."""
         lt = self.lookup_table
-        return (self.elements_algorithm == "skipgram" and not self.use_hs
-                and self.negative > 0
+        return (not self.use_hs and self.negative > 0
                 and lt.table is not None and len(lt.table) > 0)
+
+    def _ns_fast_eligible(self) -> bool:
+        """The in-batcher device-sampling fast path: skip-gram only."""
+        return self.elements_algorithm == "skipgram" and self._ns_eligible()
 
     def _hs_tables(self):
         """(code_len, (pts, cds, msk)) with the max_code_length clamp —
@@ -265,11 +275,13 @@ class SequenceVectors(WordVectors):
         has_labels = (type(self)._sequence_labels
                       is not SequenceVectors._sequence_labels)
         lt = self.lookup_table
-        if not has_labels and self.elements_algorithm == "skipgram":
-            if self._ns_fast_eligible():
-                return self._fit_bulk_sg("ns")
+        if not has_labels and self.elements_algorithm in ("skipgram", "cbow"):
+            bulk = self._fit_bulk_sg if self.elements_algorithm == "skipgram" \
+                else self._fit_bulk_cbow
+            if self._ns_eligible():
+                return bulk("ns")
             if self.use_hs and self.negative == 0:
-                return self._fit_bulk_sg("hs")
+                return bulk("hs")
         rng = np.random.default_rng(self.seed)
         vocab_words = self.vocab.vocab_words()
         keep = subsample_keep_prob(self.vocab, self.sampling)
@@ -400,92 +412,158 @@ class SequenceVectors(WordVectors):
         """
         lt = self.lookup_table
         rng = np.random.default_rng(self.seed)
-        keep = subsample_keep_prob(self.vocab, self.sampling)
-        total = max(self.vocab.total_word_count * self.epochs, 1)
         W = self.window
         # honor the configured batch_size (same stale-duplicate cap as the
         # generic path) and spend the rest of the dispatch budget on scan
         # steps — steps read fresh carry weights, so more steps never hurts
         B = self._rows_per_step()
         S = max(self.scan_steps, self._BULK_PAIRS_PER_DISPATCH // B)
-        if mode == "ns":
-            syn0, syn_out = lt.syn0, lt.syn1neg
-            table_dev = jnp.asarray(np.asarray(lt.table, dtype=np.int32))
-            key = jax.random.PRNGKey(self.seed)
-        else:
-            syn0 = lt.syn0
-            syn_out = lt.syn1 if lt.syn1 is not None \
-                else jnp.zeros_like(lt.syn0)
-            _, (pts, cds, msk) = self._hs_tables()
-            pts_dev = jnp.asarray(pts)
-            cds_dev = jnp.asarray(cds)
-            msk_dev = jnp.asarray(msk)
-
-        pend: List = []      # [(ctx, cen, pos)] pair chunks awaiting dispatch
-        pend_n = 0
+        state = self._bulk_device_state(mode)
 
         def emit_chunk(idxs, sent_id, positions):
             """All window pairs of one corpus chunk in one numpy pass."""
             ctx_pos, rows = _window_pairs(rng, W, idxs.size, sent_id)
-            return (idxs[ctx_pos].astype(np.int32),
-                    idxs[rows].astype(np.int32),
-                    positions[rows])
+            return (positions[rows],
+                    idxs[ctx_pos].astype(np.int32),
+                    idxs[rows].astype(np.int32))
 
-        def run_block(ctxs, cens, n_valids, steps_pos):
-            nonlocal syn0, syn_out, key
-            alphas = np.maximum(
-                self.min_learning_rate,
-                self.learning_rate * (1.0 - steps_pos / total)
-            ).astype(np.float32)
+        def run_block(fields, n_valids, alphas):
+            ctxs, cens = fields
             if mode == "ns":
-                key, sub = jax.random.split(key)
-                syn0, syn_out = skipgram_steps_ns(
-                    syn0, syn_out, table_dev, jnp.asarray(ctxs),
+                state["key"], sub = jax.random.split(state["key"])
+                state["syn0"], state["syn_out"] = skipgram_steps_ns(
+                    state["syn0"], state["syn_out"], state["table"],
+                    jnp.asarray(ctxs), jnp.asarray(cens),
+                    jnp.asarray(n_valids), sub, jnp.asarray(alphas),
+                    self.negative)
+            else:
+                state["syn0"], state["syn_out"] = skipgram_steps_hs(
+                    state["syn0"], state["syn_out"], *state["hs"],
+                    jnp.asarray(ctxs), jnp.asarray(cens),
+                    jnp.asarray(n_valids), jnp.asarray(alphas))
+
+        self._bulk_run(emit_chunk, run_block, S, B)
+        self._bulk_store(mode, state)
+
+    def _bulk_device_state(self, mode: str) -> dict:
+        """Device-resident weights + sampling/label tables for a bulk run."""
+        lt = self.lookup_table
+        if mode == "ns":
+            return {"syn0": lt.syn0, "syn_out": lt.syn1neg,
+                    "table": jnp.asarray(np.asarray(lt.table,
+                                                    dtype=np.int32)),
+                    "key": jax.random.PRNGKey(self.seed)}
+        syn_out = lt.syn1 if lt.syn1 is not None else jnp.zeros_like(lt.syn0)
+        _, (pts, cds, msk) = self._hs_tables()
+        return {"syn0": lt.syn0, "syn_out": syn_out,
+                "hs": (jnp.asarray(pts), jnp.asarray(cds),
+                       jnp.asarray(msk))}
+
+    def _bulk_store(self, mode: str, state: dict) -> None:
+        lt = self.lookup_table
+        lt.syn0 = state["syn0"]
+        if mode == "ns":
+            lt.syn1neg = state["syn_out"]
+        else:
+            lt.syn1 = state["syn_out"]
+
+    def _fit_bulk_cbow(self, mode: str) -> None:
+        """Corpus-level vectorized CBOW (same machinery as skip-gram's bulk
+        path; each row is a CENTER with its [2W] mask-padded window —
+        ``_window_matrix`` emits whole chunks in one numpy pass, and the
+        scan kernels (``cbow_steps_ns`` / ``cbow_steps_hs``) average, train
+        against the center's negatives / Huffman path, and scatter the
+        error to every valid window row)."""
+        rng = np.random.default_rng(self.seed)
+        W = self.window
+        B = self._rows_per_step()
+        # a CBOW row does ~2W gathers + scatters, several times a skip-gram
+        # pair — smaller per-dispatch row budget keeps HBM pressure sane
+        S = max(self.scan_steps, (self._BULK_PAIRS_PER_DISPATCH // 4) // B)
+        state = self._bulk_device_state(mode)
+
+        def emit_chunk(idxs, sent_id, positions):
+            posc, valid = _window_matrix(rng, W, idxs.size, sent_id)
+            return (positions, idxs[posc].astype(np.int32),
+                    valid.astype(np.uint8), idxs.astype(np.int32))
+
+        def run_block(fields, n_valids, alphas):
+            ctxw, cmask, cens = fields
+            if mode == "ns":
+                state["key"], sub = jax.random.split(state["key"])
+                state["syn0"], state["syn_out"] = cbow_steps_ns(
+                    state["syn0"], state["syn_out"], state["table"],
+                    jnp.asarray(ctxw), jnp.asarray(cmask),
                     jnp.asarray(cens), jnp.asarray(n_valids), sub,
                     jnp.asarray(alphas), self.negative)
             else:
-                syn0, syn_out = skipgram_steps_hs(
-                    syn0, syn_out, pts_dev, cds_dev, msk_dev,
-                    jnp.asarray(ctxs), jnp.asarray(cens),
-                    jnp.asarray(n_valids), jnp.asarray(alphas))
+                state["syn0"], state["syn_out"] = cbow_steps_hs(
+                    state["syn0"], state["syn_out"], *state["hs"],
+                    jnp.asarray(ctxw), jnp.asarray(cmask),
+                    jnp.asarray(cens), jnp.asarray(n_valids),
+                    jnp.asarray(alphas))
+
+        self._bulk_run(emit_chunk, run_block, S, B)
+        self._bulk_store(mode, state)
+
+    def _bulk_run(self, emit_chunk, run_block, S: int, B: int) -> None:
+        """Shared bulk-training scaffolding: epoch loop with indexed-corpus
+        caching, chunked emission, and generic (S, B[, ...])-block packing.
+
+        ``emit_chunk(idxs, sent_id, positions) -> (pos, field, ...)`` where
+        every array shares leading dim P (one entry per emitted row);
+        ``run_block(fields, n_valids, alphas)`` consumes each field packed
+        to ``(S, B) + field.shape[1:]``.  The learning rate is decayed at
+        each row's corpus position.  The forced tail spreads leftover rows
+        across scan steps in small sequential slices — a corpus smaller
+        than one dispatch must still train sequentially enough for syn0 to
+        move (the output tables start at zero).
+        """
+        rng = np.random.default_rng(self.seed + 1)   # subsampling stream
+        keep = subsample_keep_prob(self.vocab, self.sampling)
+        total = max(self.vocab.total_word_count * self.epochs, 1)
+        pend: List = []          # [(pos, field, ...)] chunks awaiting dispatch
+        pend_n = 0
+
+        def alphas_for(steps_pos):
+            return np.maximum(
+                self.min_learning_rate,
+                self.learning_rate * (1.0 - steps_pos / total)
+            ).astype(np.float32)
 
         def dispatch(force=False):
             nonlocal pend, pend_n
             per = S * B
             if pend_n < per and not (force and pend_n):
                 return
-            ctx = np.concatenate([p[0] for p in pend])
-            cen = np.concatenate([p[1] for p in pend])
-            posn = np.concatenate([p[2] for p in pend])
-            m = len(ctx) // per
+            cols = [np.concatenate([p[i] for p in pend])
+                    for i in range(len(pend[0]))]
+            posn, fields = cols[0], cols[1:]
+            m = len(posn) // per
             for i in range(m):
                 sl = slice(i * per, (i + 1) * per)
-                run_block(ctx[sl].reshape(S, B), cen[sl].reshape(S, B),
-                          np.full(S, B, dtype=np.int32),
-                          posn[sl].reshape(S, B).mean(axis=1))
-            rem = (ctx[m * per:], cen[m * per:], posn[m * per:])
+                run_block(
+                    [f[sl].reshape((S, B) + f.shape[1:]) for f in fields],
+                    np.full(S, B, dtype=np.int32),
+                    alphas_for(posn[sl].reshape(S, B).mean(axis=1)))
+            rem = [c[m * per:] for c in cols]
             if force and rem[0].size:
-                # Tail: spread the leftover pairs across the scan steps in
-                # small sequential slices (fresh carry weights each step)
-                # rather than one huge batch row-block — a corpus smaller
-                # than one dispatch must still train sequentially enough
-                # for syn0 to move (syn1neg starts at zero).
                 t = rem[0].size
                 q = max(1, -(-t // S))           # rows per step, ≤ B
-                ctxs = np.zeros((S, B), dtype=np.int32)
-                cens = np.zeros((S, B), dtype=np.int32)
+                packed = [np.zeros((S, B) + f.shape[1:], f.dtype)
+                          for f in rem[1:]]
                 n_valids = np.zeros(S, dtype=np.int32)
-                steps_pos = np.full(S, float(rem[2][-1]))
+                steps_pos = np.full(S, float(rem[0][-1]))
                 for s in range(-(-t // q)):
                     piece = slice(s * q, min((s + 1) * q, t))
                     k = piece.stop - piece.start
-                    ctxs[s, :k] = rem[0][piece]
-                    cens[s, :k] = rem[1][piece]
+                    for dst, src in zip(packed, rem[1:]):
+                        dst[s, :k] = src[piece]
                     n_valids[s] = k
-                    steps_pos[s] = rem[2][piece].mean()
-                run_block(ctxs, cens, n_valids, steps_pos)
-                rem = (rem[0][:0], rem[1][:0], rem[2][:0])
-            pend = [rem] if rem[0].size else []
+                    steps_pos[s] = rem[0][piece].mean()
+                run_block(packed, n_valids, alphas_for(steps_pos))
+                rem = [c[:0] for c in cols]
+            pend = [tuple(rem)] if rem[0].size else []
             pend_n = rem[0].size
 
         index_map = self.vocab.index_map()
@@ -547,10 +625,6 @@ class SequenceVectors(WordVectors):
                     flush_chunk()
             flush_chunk()
         dispatch(force=True)
-        if mode == "ns":
-            lt.syn0, lt.syn1neg = syn0, syn_out
-        else:
-            lt.syn0, lt.syn1 = syn0, syn_out
 
     def _pending_empty(self, batcher) -> bool:
         if self.elements_algorithm == "skipgram":

@@ -107,7 +107,7 @@ def test_unigram_table_proportions():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("algo,hs", [("skipgram", False), ("cbow", False),
-                                     ("skipgram", True)])
+                                     ("skipgram", True), ("cbow", True)])
 def test_word2vec_clusters_topics(algo, hs):
     cbow = algo == "cbow"
     w2v = Word2Vec(sentences=synthetic_corpus(), layer_size=24, window=3,
