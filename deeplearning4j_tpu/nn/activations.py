@@ -73,6 +73,12 @@ def identity(x):
 
 @register("relu")
 def relu(x):
+    # NOTE (round-2 negative result): an output-keyed custom-VJP relu
+    # (bwd mask from y>0, letting the saved residual alias the next
+    # layer's input) changed NOTHING — XLA's bytes-accessed was identical
+    # (81.886 GB for the ResNet50 step), i.e. the compiler already dedupes
+    # the relu residual against the saved output; and custom_vjp would
+    # break forward-mode jvp.  Keep the plain primitive.
     return jax.nn.relu(x)
 
 
