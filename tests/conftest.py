@@ -55,6 +55,8 @@ _SLOW_TESTS = {
     "test_training_matches_scan",
     "test_parameter_averaging_learns_iris",
     "test_graph_fit_on_device",
+    "test_dryrun_in_process_8_devices",
+    "test_poisoned_default_backend_falls_back_to_subprocess",
 }
 
 
