@@ -36,13 +36,14 @@ def train_word2vec_distributed(sentences: Sequence[str], num_workers: int = 2,
     """
     if num_workers < 1:
         raise ValueError("num_workers must be >= 1")
-    master = Word2Vec(sentences=list(sentences), **w2v_kwargs)
+    sentences = list(sentences)  # materialize once; reused by every shard
+    master = Word2Vec(sentences=sentences, **w2v_kwargs)
     master.build_vocab()       # driver-side shared vocab (one index space)
     if num_workers == 1:
         master.fit()
         return master
 
-    shards = [list(sentences)[i::num_workers] for i in range(num_workers)]
+    shards = [sentences[i::num_workers] for i in range(num_workers)]
     workers: List[Word2Vec] = []
     for shard in shards:
         w = Word2Vec(sentences=shard, **w2v_kwargs)

@@ -140,6 +140,29 @@ def _assemble(vocab: VocabCache, rows: np.ndarray) -> Word2Vec:
     return model
 
 
+def _sniffed_row_is_text(chunk: bytes) -> bool:
+    """True when the sniffed first data row parses as ``word v1 v2 ...`` —
+    packed float32 bytes can happen to decode as UTF-8, so decodability
+    alone must not route to the txt reader.  Float-parsability (not token
+    count) is the discriminator: a slightly nonconforming real txt file
+    (extra column, missing trailing newline) still routes to the txt reader
+    so its errors surface there, instead of read_binary silently loading
+    ASCII digits as packed f32 garbage."""
+    line, sep, _ = chunk.partition(b"\n")
+    toks = line.decode("utf-8", errors="replace").split()
+    if len(toks) < 2:
+        return False
+    # truncated row (no newline in the window): the last token may be cut
+    # mid-value — a float prefix still parses, raw f32 bytes don't
+    vals = toks[1:] if sep else (toks[1:-1] or [toks[-1]])
+    try:
+        for v in vals:
+            float(v)
+    except ValueError:
+        return False
+    return True
+
+
 def load_static_model(path: str) -> Word2Vec:
     """Load vectors from any supported on-disk format for inference
     (reference ``WordVectorSerializer.loadStaticModel``): sniffs zip (full
@@ -169,13 +192,14 @@ def load_static_model(path: str) -> Word2Vec:
             second = f.read(256)
         try:
             second.decode("utf-8")
-            return read_word_vectors(path)
+            looks_text = True
         except UnicodeDecodeError as e:
             # a multi-byte character split at the 256-byte chunk boundary is
             # still text; only a decode failure in the interior means binary
-            if e.start >= len(second) - 4:
-                return read_word_vectors(path)
-            return read_binary(path)
+            looks_text = e.start >= len(second) - 4
+        if looks_text and _sniffed_row_is_text(second):
+            return read_word_vectors(path)
+        return read_binary(path)
     if "," in text:
         return read_csv(path)
     raise ValueError(f"unrecognized word-vector format in {path!r}")

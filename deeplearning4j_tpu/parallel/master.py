@@ -119,6 +119,10 @@ class TrainingMaster:
                 r.state = jax.tree_util.tree_map(jnp.array, model.state)
                 r.opt_state = jax.tree_util.tree_map(jnp.array,
                                                      model.opt_state)
+                # keep LR-schedule/epoch counters in lockstep too — the
+                # master model may have been checkpoint-restored between fits
+                r.iteration = model.iteration
+                r.epoch = model.epoch
         return self._replicas
 
     def _fan_out(self, model, iterator, num_workers: Optional[int],

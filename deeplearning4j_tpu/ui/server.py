@@ -283,15 +283,32 @@ class _Handler(JsonHandler):
                 iteration = int(payload.get("iteration", 0))
                 # stored-injection guard: the page embeds this verbatim.
                 # reject the standard SVG script vectors (script tags,
-                # event-handler attributes, javascript: URLs, foreignObject)
-                low = svg.lower() if isinstance(svg, str) else ""
+                # event-handler attributes, javascript: URLs, foreignObject).
+                # scan entity-decoded forms too (&#115;cript, &Tab; — names
+                # are case-sensitive, so unescape BEFORE lowercasing), and
+                # strip the control chars browsers ignore inside URL schemes
+                import html as _html
                 import re as _re
-                if (not isinstance(svg, str)
-                        or not low.lstrip().startswith("<svg")
-                        or "<script" in low
-                        or "javascript:" in low
-                        or "<foreignobject" in low
-                        or _re.search(r"\son\w+\s*=", low)):
+                if not isinstance(svg, str):
+                    raise ValueError("svg payload must be a string")
+                variants, cur = [svg], svg
+                for _ in range(2):       # double-encoded payloads too
+                    nxt = _html.unescape(cur)
+                    if nxt == cur:       # fixpoint: no entities left
+                        break
+                    variants.append(nxt)
+                    cur = nxt
+
+                def _scripty(s: str) -> bool:
+                    low = s.lower()
+                    compact = _re.sub(r"[\x00-\x20]", "", low)
+                    return ("<script" in low
+                            or "<foreignobject" in low
+                            or "javascript:" in compact
+                            or bool(_re.search(r"[\s/\"'>]on\w+\s*=", low)))
+
+                if (not svg.lstrip()[:4].lower().startswith("<svg")
+                        or any(_scripty(s) for s in variants)):
                     raise ValueError("svg payload must be a plain <svg> "
                                      "without scripts/event handlers")
             except Exception as e:

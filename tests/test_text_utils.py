@@ -326,6 +326,24 @@ class TestSerializerFormats:
                                        np.asarray(m.lookup_table.syn0),
                                        atol=1e-5, err_msg=kind)
 
+    def test_load_static_model_ascii_binary_not_misrouted(self, tmp_path):
+        """A binary model whose packed float32 payload happens to decode as
+        UTF-8 (printable ASCII bytes) must still load as binary — the txt
+        sniff falls back when the rows don't parse as 'word v1 v2 ...'."""
+        import numpy as np
+        from deeplearning4j_tpu.nlp import serializer as S
+        row0, row1 = (np.frombuffer(b"ABCDEFGH", dtype="<f4"),
+                      np.frombuffer(b"IJKLMNOP", dtype="<f4"))
+        p = str(tmp_path / "ascii.bin")
+        with open(p, "wb") as f:
+            f.write(b"2 2\n")
+            f.write(b"aa " + row0.tobytes() + b"\n")
+            f.write(b"bb " + row1.tobytes() + b"\n")
+        m2 = S.load_static_model(p)
+        np.testing.assert_allclose(np.asarray(m2.lookup_table.syn0),
+                                   np.stack([row0, row1]))
+        assert m2.vocab.word_at_index(0) == "aa"
+
     def test_csv_rejects_comma_words(self, tmp_path):
         import pytest
         from deeplearning4j_tpu.nlp import serializer as S

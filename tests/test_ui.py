@@ -380,5 +380,23 @@ def test_activation_grid_pages():
             assert e.code == 400
         assert json.load(urllib.request.urlopen(
             f"{base}/train/sessions")) == []
+        # entity-encoded script vectors must not slip past the stored-XSS
+        # guard (the page embeds accepted svg verbatim)
+        for evil in (
+                '<svg><a xlink:href="java&#115;cript:alert(1)">x</a></svg>',
+                '<svg><img &#111;nerror=alert(1)></svg>',
+                '<svg>&lt;script&gt;&#60;script&#62;</svg>',
+                '<svg><a href="java&#9;script:alert(1)">x</a></svg>',
+                '<svg><a href="java&Tab;script:alert(1)">x</a></svg>',
+                '<svg><image href=x /onerror=alert(1)></svg>'):
+            req = urllib.request.Request(
+                f"{base}/activations",
+                data=json.dumps({"iteration": 1, "svg": evil}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError(f"expected 400 for {evil!r}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
     finally:
         server.stop()
