@@ -53,6 +53,18 @@ class ParagraphVectors(SequenceVectors):
     def _sequence_labels(self, seq_index: int) -> Sequence[str]:
         return self._docs[seq_index].labels
 
+    def _bulk_label_width(self) -> int:
+        """Docs are materialized up front, so the corpus-constant label
+        width the bulk path needs is known — labeled fits ride the same
+        corpus-level fast path as Word2Vec (DBOW via bulk skip-gram with
+        label→word pairs, DM via bulk CBOW with label columns)."""
+        return max((len(d.labels) for d in self._docs), default=0)
+
+    def _label_indices(self, seq_index: int) -> np.ndarray:
+        idx = (self.vocab.index_of(l)
+               for l in self._docs[seq_index].labels)
+        return np.array([i for i in idx if i >= 0], dtype=np.int64)
+
     def build_vocab(self, extra_labels: Sequence[str] = ()) -> None:
         super().build_vocab(extra_labels=tuple(self.labels) + tuple(extra_labels))
 

@@ -223,6 +223,18 @@ class SequenceVectors(WordVectors):
     def _sequence_labels(self, seq_index: int) -> Sequence[str]:
         return ()
 
+    # -- bulk-path label hooks (ParagraphVectors overrides) ------------------
+    def _bulk_label_width(self) -> Optional[int]:
+        """Max labels per sequence, known up front — required by the bulk
+        path because the packed device blocks need a corpus-constant label
+        width.  ``None`` = the subclass can't declare it; labeled fits fall
+        back to the per-sentence loop."""
+        return None
+
+    def _label_indices(self, seq_index: int) -> np.ndarray:
+        """Vocab indices of a sequence's labels (int64, possibly empty)."""
+        return np.zeros(0, dtype=np.int64)
+
     # -- vocab + weights -----------------------------------------------------
     def build_vocab(self, extra_labels: Sequence[str] = ()) -> None:
         ctor = VocabConstructor(self.min_word_frequency)
@@ -275,14 +287,28 @@ class SequenceVectors(WordVectors):
         has_labels = (type(self)._sequence_labels
                       is not SequenceVectors._sequence_labels)
         lt = self.lookup_table
-        if not has_labels and self.elements_algorithm in ("skipgram", "cbow"):
-            bulk = self._fit_bulk_sg if self.elements_algorithm == "skipgram" \
-                else self._fit_bulk_cbow
-            if self._ns_eligible():
-                return bulk("ns")
-            if self.use_hs and self.negative == 0:
-                return bulk("hs")
+        if self.elements_algorithm in ("skipgram", "cbow"):
+            # labeled corpora (ParagraphVectors DBOW/DM) ride the bulk path
+            # too when the subclass can declare its label width up front —
+            # DBOW is skip-gram with label→word pairs added, DM is CBOW with
+            # label columns appended to the window
+            lab_w = 0 if not has_labels else self._bulk_label_width()
+            if lab_w is not None:
+                bulk = (self._fit_bulk_sg
+                        if self.elements_algorithm == "skipgram"
+                        else self._fit_bulk_cbow)
+                if self._ns_eligible():
+                    return bulk("ns", label_width=lab_w)
+                if self.use_hs and self.negative == 0:
+                    return bulk("hs", label_width=lab_w)
+        # three independent streams, partitioned exactly like the bulk path
+        # (window draws: seed; subsampling: seed+1) so the two emissions are
+        # stream-aligned and checkable against each other (the equivalence
+        # oracle in test_nlp) — plus seed+2 for host-side negative sampling,
+        # which the bulk path does on device
         rng = np.random.default_rng(self.seed)
+        rng_sub = np.random.default_rng(self.seed + 1)
+        rng_neg = np.random.default_rng(self.seed + 2)
         vocab_words = self.vocab.vocab_words()
         keep = subsample_keep_prob(self.vocab, self.sampling)
         code_len, _hs = self._hs_tables() if self.use_hs else (
@@ -341,7 +367,7 @@ class SequenceVectors(WordVectors):
                         jnp.asarray(cens), jnp.asarray(n_valids), sub,
                         jnp.asarray(alphas), self.negative)
                 elif is_skipgram:
-                    b = batcher.drain(vocab_words, lt.table, rng,
+                    b = batcher.drain(vocab_words, lt.table, rng_neg,
                                       force=force, hs_tables=hs_tables)
                     if b is None:
                         return
@@ -352,8 +378,8 @@ class SequenceVectors(WordVectors):
                         jnp.asarray(neg), jnp.asarray(nl), jnp.asarray(nm),
                         jnp.float32(decay(seen_mean)))
                 else:
-                    b = self._drain_cbow(vocab_words, lt.table, rng, force,
-                                         hs_tables=hs_tables)
+                    b = self._drain_cbow(vocab_words, lt.table, rng_neg,
+                                         force, hs_tables=hs_tables)
                     if b is None:
                         return
                     ctxw, cmask, _center, pts, cds, cm, neg, nl, nm = b
@@ -366,6 +392,7 @@ class SequenceVectors(WordVectors):
                     return
 
         self._cbow_buf: List = []
+        self._cbow_wmax = None   # recomputed per fit (labels may change)
         for _epoch in range(self.epochs):
             for seq_idx, seq in enumerate(self._sequences()):
                 idxs = [self.vocab.index_of(t) for t in seq]
@@ -374,18 +401,21 @@ class SequenceVectors(WordVectors):
                     continue
                 seen += int(idxs.size)
                 if self.sampling > 0:
-                    idxs = idxs[rng.random(idxs.size) < keep[idxs]]
-                if idxs.size < 1:
-                    continue
+                    idxs = idxs[rng_sub.random(idxs.size) < keep[idxs]]
                 label_idxs = [self.vocab.index_of(l)
                               for l in self._sequence_labels(seq_idx)]
                 label_idxs = [l for l in label_idxs if l >= 0]
+                # unlabeled 1-token sequences can't emit pairs — skip before
+                # any window draw so the stream stays aligned with the bulk
+                # path (which skips them pre-windowing)
+                if idxs.size < (1 if label_idxs else 2):
+                    continue
                 self._emit_sequence(idxs, label_idxs, batcher, rng, seen)
                 flush()
         flush(force=True)
         lt.syn0, lt.syn1, lt.syn1neg = syn0, syn1, syn1neg
 
-    def _fit_bulk_sg(self, mode: str) -> None:
+    def _fit_bulk_sg(self, mode: str, label_width: int = 0) -> None:
         """Corpus-level vectorized skip-gram (the words/sec fast path);
         ``mode``: "ns" (device-side negative sampling) or "hs"
         (hierarchical softmax with device-resident Huffman tables).
@@ -408,7 +438,10 @@ class SequenceVectors(WordVectors):
            learning rate decayed at each pair's exact corpus position.
 
         DeepWalk/Node2Vec (degree-Huffman HS over random walks) ride the
-        "hs" mode automatically.
+        "hs" mode automatically.  With ``label_width`` > 0 (ParagraphVectors
+        DBOW) each sequence additionally emits label→word pairs — the
+        reference's PV-DBOW is exactly skip-gram with the doc label as the
+        learning row (``DBOW.java`` delegating to SkipGram aggregates).
         """
         lt = self.lookup_table
         rng = np.random.default_rng(self.seed)
@@ -420,12 +453,26 @@ class SequenceVectors(WordVectors):
         S = max(self.scan_steps, self._BULK_PAIRS_PER_DISPATCH // B)
         state = self._bulk_device_state(mode)
 
-        def emit_chunk(idxs, sent_id, positions):
-            """All window pairs of one corpus chunk in one numpy pass."""
+        def emit_chunk(idxs, sent_id, positions, labs=None):
+            """All window pairs of one corpus chunk in one numpy pass;
+            ``labs`` [N, L] (−1-padded per-token label rows) adds the DBOW
+            label→word pairs."""
             ctx_pos, rows = _window_pairs(rng, W, idxs.size, sent_id)
-            return (positions[rows],
-                    idxs[ctx_pos].astype(np.int32),
-                    idxs[rows].astype(np.int32))
+            pos_o = positions[rows]
+            ctx_o = idxs[ctx_pos].astype(np.int32)
+            cen_o = idxs[rows].astype(np.int32)
+            if labs is not None and labs.size:
+                pos_l, ctx_l, cen_l = [pos_o], [ctx_o], [cen_o]
+                for j in range(labs.shape[1]):
+                    v = labs[:, j] >= 0
+                    if v.any():
+                        pos_l.append(positions[v])
+                        ctx_l.append(labs[v, j].astype(np.int32))
+                        cen_l.append(idxs[v].astype(np.int32))
+                pos_o = np.concatenate(pos_l)
+                ctx_o = np.concatenate(ctx_l)
+                cen_o = np.concatenate(cen_l)
+            return pos_o, ctx_o, cen_o
 
         def run_block(fields, n_valids, alphas):
             ctxs, cens = fields
@@ -442,7 +489,7 @@ class SequenceVectors(WordVectors):
                     jnp.asarray(ctxs), jnp.asarray(cens),
                     jnp.asarray(n_valids), jnp.asarray(alphas))
 
-        self._bulk_run(emit_chunk, run_block, S, B)
+        self._bulk_run(emit_chunk, run_block, S, B, label_width=label_width)
         self._bulk_store(mode, state)
 
     def _bulk_device_state(self, mode: str) -> dict:
@@ -467,13 +514,16 @@ class SequenceVectors(WordVectors):
         else:
             lt.syn1 = state["syn_out"]
 
-    def _fit_bulk_cbow(self, mode: str) -> None:
+    def _fit_bulk_cbow(self, mode: str, label_width: int = 0) -> None:
         """Corpus-level vectorized CBOW (same machinery as skip-gram's bulk
         path; each row is a CENTER with its [2W] mask-padded window —
         ``_window_matrix`` emits whole chunks in one numpy pass, and the
         scan kernels (``cbow_steps_ns`` / ``cbow_steps_hs``) average, train
         against the center's negatives / Huffman path, and scatter the
-        error to every valid window row)."""
+        error to every valid window row).  With ``label_width`` > 0
+        (ParagraphVectors DM) the doc-label columns join every window row —
+        the reference's PV-DM: label participates in the context average
+        and receives the scattered error like any context word."""
         rng = np.random.default_rng(self.seed)
         W = self.window
         B = self._rows_per_step()
@@ -482,10 +532,14 @@ class SequenceVectors(WordVectors):
         S = max(self.scan_steps, (self._BULK_PAIRS_PER_DISPATCH // 4) // B)
         state = self._bulk_device_state(mode)
 
-        def emit_chunk(idxs, sent_id, positions):
+        def emit_chunk(idxs, sent_id, positions, labs=None):
             posc, valid = _window_matrix(rng, W, idxs.size, sent_id)
-            return (positions, idxs[posc].astype(np.int32),
-                    valid.astype(np.uint8), idxs.astype(np.int32))
+            ctxw = idxs[posc].astype(np.int32)
+            cmask = valid.astype(np.uint8)
+            if labs is not None and labs.size:
+                ctxw = np.hstack([ctxw, np.maximum(labs, 0).astype(np.int32)])
+                cmask = np.hstack([cmask, (labs >= 0).astype(np.uint8)])
+            return positions, ctxw, cmask, idxs.astype(np.int32)
 
         def run_block(fields, n_valids, alphas):
             ctxw, cmask, cens = fields
@@ -503,15 +557,18 @@ class SequenceVectors(WordVectors):
                     jnp.asarray(cens), jnp.asarray(n_valids),
                     jnp.asarray(alphas))
 
-        self._bulk_run(emit_chunk, run_block, S, B)
+        self._bulk_run(emit_chunk, run_block, S, B, label_width=label_width)
         self._bulk_store(mode, state)
 
-    def _bulk_run(self, emit_chunk, run_block, S: int, B: int) -> None:
+    def _bulk_run(self, emit_chunk, run_block, S: int, B: int,
+                  label_width: int = 0) -> None:
         """Shared bulk-training scaffolding: epoch loop with indexed-corpus
         caching, chunked emission, and generic (S, B[, ...])-block packing.
 
-        ``emit_chunk(idxs, sent_id, positions) -> (pos, field, ...)`` where
-        every array shares leading dim P (one entry per emitted row);
+        ``emit_chunk(idxs, sent_id, positions, labs) -> (pos, field, ...)``
+        where every array shares leading dim P (one entry per emitted row)
+        and ``labs`` is a −1-padded [N, label_width] per-token label matrix
+        (None when label_width == 0);
         ``run_block(fields, n_valids, alphas)`` consumes each field packed
         to ``(S, B) + field.shape[1:]``.  The learning rate is decayed at
         each row's corpus position.  The forced tail spreads leftover rows
@@ -568,6 +625,7 @@ class SequenceVectors(WordVectors):
 
         index_map = self.vocab.index_map()
         cache: Optional[List] = ([] if self.epochs > 1 else None)
+        L = label_width
         seen = 0
         for epoch in range(self.epochs):
             if cache is not None and epoch > 0:
@@ -575,37 +633,44 @@ class SequenceVectors(WordVectors):
             else:
                 def _index():
                     g = index_map.get
-                    for seq in self._sequences():
+                    for seq_idx, seq in enumerate(self._sequences()):
                         arr = np.fromiter((g(t, -1) for t in seq), np.int32,
                                           count=len(seq))
                         arr = arr[arr >= 0]
-                        if arr.size:
-                            yield arr
+                        if not arr.size:
+                            continue
+                        lab = np.full(L, -1, dtype=np.int64)
+                        if L:
+                            li = self._label_indices(seq_idx)[:L]
+                            lab[:len(li)] = li
+                        yield arr, lab
                 source = _index()
             # chunk buffers
             buf_i: List = []
             buf_s: List = []
             buf_p: List = []
+            buf_l: List = []
             buf_n = 0
             sent_no = 0
 
             def flush_chunk():
-                nonlocal buf_i, buf_s, buf_p, buf_n, pend_n
+                nonlocal buf_i, buf_s, buf_p, buf_l, buf_n, pend_n
                 if not buf_i:
                     return
                 out = emit_chunk(np.concatenate(buf_i),
                                  np.concatenate(buf_s),
-                                 np.concatenate(buf_p))
-                buf_i, buf_s, buf_p, buf_n = [], [], [], 0
+                                 np.concatenate(buf_p),
+                                 np.concatenate(buf_l) if L else None)
+                buf_i, buf_s, buf_p, buf_l, buf_n = [], [], [], [], 0
                 if out[0].size:
                     pend.append(out)
                     pend_n += out[0].size
                 dispatch()
 
-            for idxs in source:
+            for idxs, labrow in source:
                 if cache is not None and epoch == 0:
                     if seen + idxs.size <= self._BULK_CACHE_LIMIT:
-                        cache.append(idxs)
+                        cache.append((idxs, labrow))
                     else:
                         cache = None   # corpus too big — re-index per epoch
                 positions = seen + np.arange(idxs.size)
@@ -613,12 +678,19 @@ class SequenceVectors(WordVectors):
                 if self.sampling > 0:
                     m = rng.random(idxs.size) < keep[idxs]
                     idxs, positions = idxs[m], positions[m]
-                if idxs.size < 2:
+                # a LABELED 1-token sequence still trains (label↔word);
+                # unlabeled needs 2+ tokens for any window pair.  Gated per
+                # sequence (not corpus-wide) so mixed corpora stay
+                # stream-aligned with the generic loop's identical gate
+                min_len = 1 if (L and (labrow >= 0).any()) else 2
+                if idxs.size < min_len:
                     sent_no += 1
                     continue
                 buf_i.append(idxs)
                 buf_s.append(np.full(idxs.size, sent_no, dtype=np.int32))
                 buf_p.append(positions)
+                if L:
+                    buf_l.append(np.tile(labrow, (idxs.size, 1)))
                 buf_n += idxs.size
                 sent_no += 1
                 if buf_n >= self._BULK_CHUNK_WORDS:
@@ -664,8 +736,14 @@ class SequenceVectors(WordVectors):
         self._cbow_buf = self._cbow_buf[B:]
         n = len(take)
         # fixed window width keeps the jitted step's shapes static across
-        # batches (one XLA compilation); overly long contexts are clipped
-        Wmax = 2 * self.window + 4
+        # batches (one XLA compilation); overly long contexts are clipped.
+        # label-aware headroom so DM rows with many labels are never clipped
+        # differently from the bulk path (which carries all label columns).
+        # cached per fit — _bulk_label_width can be O(corpus)
+        Wmax = getattr(self, "_cbow_wmax", None)
+        if Wmax is None:
+            Wmax = 2 * self.window + max(4, self._bulk_label_width() or 0)
+            self._cbow_wmax = Wmax
         ctxw = np.zeros((B, Wmax), dtype=np.int32)
         cmask = np.zeros((B, Wmax), dtype=np.float32)
         center = np.zeros(B, dtype=np.int32)

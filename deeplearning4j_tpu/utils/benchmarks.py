@@ -68,29 +68,64 @@ def char_lstm_step_time(batch: int = 128, timesteps: int = 64,
             "tokens_per_sec": round(batch * timesteps / ms * 1e3, 1)}
 
 
-def word2vec_words_per_sec(vocab: int = 5000, n_sent: int = 20000,
-                           sent_len: int = 20, epochs: int = 1) -> Dict:
-    """Skip-gram NS throughput (parity bar: the reference's native batched
-    AggregateSkipGram hot loop, ``SkipGram.java:271-283``).  Steady state:
-    first fit compiles, second fit on reset weights is timed."""
-    from ..nlp.word2vec import Word2Vec
-
+def _zipf_sentences(vocab: int, n_sent: int, sent_len: int):
+    """Zipf(1.3)-distributed synthetic corpus shared by the embedding
+    benchmarks, so word2vec and PV rows measure the same token stream."""
     rng = np.random.default_rng(0)
     ids = np.clip(rng.zipf(1.3, size=n_sent * sent_len), 1, vocab) - 1
     toks = ["w%d" % i for i in ids]
-    sentences = [" ".join(toks[i * sent_len:(i + 1) * sent_len])
-                 for i in range(n_sent)]
+    return [" ".join(toks[i * sent_len:(i + 1) * sent_len])
+            for i in range(n_sent)]
+
+
+def _cold_steady_fit(model, total_words: int):
+    """(cold, steady) words/sec: first fit compiles, second fit on reset
+    weights is timed (both fits host-sync by returning final tables)."""
+    model.build_vocab()
+    t0 = time.perf_counter()
+    model.fit()
+    cold = total_words / (time.perf_counter() - t0)
+    model.lookup_table.reset_weights()
+    t0 = time.perf_counter()
+    model.fit()
+    steady = total_words / (time.perf_counter() - t0)
+    return cold, steady
+
+
+def word2vec_words_per_sec(vocab: int = 5000, n_sent: int = 20000,
+                           sent_len: int = 20, epochs: int = 1) -> Dict:
+    """Skip-gram NS throughput (parity bar: the reference's native batched
+    AggregateSkipGram hot loop, ``SkipGram.java:271-283``)."""
+    from ..nlp.word2vec import Word2Vec
+
+    sentences = _zipf_sentences(vocab, n_sent, sent_len)
     total = n_sent * sent_len * epochs
     w2v = Word2Vec(sentences=sentences, layer_size=128, window=5, negative=5,
                    epochs=epochs, seed=1, min_word_frequency=1)
-    w2v.build_vocab()
-    t0 = time.perf_counter()
-    w2v.fit()
-    cold = total / (time.perf_counter() - t0)
-    w2v.lookup_table.reset_weights()
-    t0 = time.perf_counter()
-    w2v.fit()
-    steady = total / (time.perf_counter() - t0)
+    cold, steady = _cold_steady_fit(w2v, total)
     return {"metric": "word2vec_words_per_sec", "value": round(steady, 1),
             "unit": "words/sec", "cold_words_per_sec": round(cold, 1),
             "vocab": vocab, "corpus_words": total}
+
+
+def paragraph_vectors_words_per_sec(vocab: int = 5000, n_docs: int = 20000,
+                                    doc_len: int = 20, epochs: int = 1,
+                                    seq_algo: str = "dbow") -> Dict:
+    """Labeled-sequence (doc2vec) throughput — the bulk-path analogue of
+    ``word2vec_words_per_sec`` with one unique label per document
+    (reference: PV rides the same native aggregates,
+    ``SkipGram.java:271-283``)."""
+    from ..nlp.paragraph_vectors import ParagraphVectors
+    from ..nlp.sentence_iterator import LabelledDocument
+
+    docs = [LabelledDocument(s, ["DOC_%d" % i]) for i, s in
+            enumerate(_zipf_sentences(vocab, n_docs, doc_len))]
+    total = n_docs * doc_len * epochs
+    pv = ParagraphVectors(documents=docs, sequence_algorithm=seq_algo,
+                          layer_size=128, window=5, negative=5,
+                          epochs=epochs, seed=1, min_word_frequency=1)
+    cold, steady = _cold_steady_fit(pv, total)
+    return {"metric": f"paragraph_vectors_{seq_algo}_words_per_sec",
+            "value": round(steady, 1), "unit": "words/sec",
+            "cold_words_per_sec": round(cold, 1), "vocab": vocab,
+            "n_docs": n_docs, "corpus_words": total}

@@ -317,6 +317,197 @@ def test_bulk_ns_degenerate_sentences():
     assert np.isfinite(np.asarray(w3.lookup_table.syn0)).all()
 
 
+# ---------------------------------------------------------------------------
+# bulk-emission equivalence oracle: the corpus-level vectorized pass must
+# emit exactly what the per-sentence reference path emits (reference
+# obligation: the native-aggregate fast path in SkipGram.java:271-283 is
+# semantics-preserving over the scalar loop)
+# ---------------------------------------------------------------------------
+
+def _capture_bulk_emission(model, monkeypatch):
+    """Run fit() recording every emit_chunk output of the bulk path."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    captured = []
+    orig = SequenceVectors._bulk_run
+
+    def spy(self, emit_chunk, run_block, S, B, label_width=0):
+        def spy_emit(*a):
+            out = emit_chunk(*a)
+            captured.append(out)
+            return out
+        return orig(self, spy_emit, run_block, S, B, label_width=label_width)
+
+    monkeypatch.setattr(SequenceVectors, "_bulk_run", spy)
+    model.fit()
+    monkeypatch.undo()
+    return captured
+
+
+def _capture_generic_sg_pairs(model, monkeypatch):
+    """Force the per-sentence loop and record every (ctx, center) pair."""
+    from deeplearning4j_tpu.nlp import sequence_vectors as SV
+    pairs = []
+    orig_add = SV._PairBatcher.add_many
+
+    def spy_add(self, ctx, center, seen=0):
+        c = np.asarray(ctx, dtype=np.int64)
+        t = np.broadcast_to(np.asarray(center, dtype=np.int64), c.shape)
+        pairs.append((c.copy(), t.copy()))
+        return orig_add(self, ctx, center, seen)
+
+    monkeypatch.setattr(SV._PairBatcher, "add_many", spy_add)
+    monkeypatch.setattr(type(model), "_ns_eligible", lambda self: False)
+    model.fit()
+    monkeypatch.undo()
+    return pairs
+
+
+def test_bulk_sg_emission_matches_per_sentence_oracle(monkeypatch):
+    """For a fixed seed the bulk chunk pass must emit the identical
+    (corpus-position, ctx, center) stream as a per-sentence replay — window
+    shrink draws, subsampling, and sentence-boundary clipping included."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import _window_pairs
+    from deeplearning4j_tpu.nlp.vocab import subsample_keep_prob
+    sentences = synthetic_corpus(n=300, seed=3)
+    kw = dict(layer_size=8, window=3, negative=3, sampling=1e-3, epochs=1,
+              seed=11, min_word_frequency=1)
+    w = Word2Vec(sentences=sentences, **kw)
+    w.build_vocab()
+    cap = _capture_bulk_emission(w, monkeypatch)
+    bulk = [np.concatenate([c[i] for c in cap]) for i in range(3)]
+
+    # independent per-sentence replay with the bulk stream partitioning
+    # (window draws: seed; subsampling: seed+1)
+    rng_w = np.random.default_rng(11)
+    rng_s = np.random.default_rng(12)
+    keep = subsample_keep_prob(w.vocab, w.sampling)
+    exp_pos, exp_ctx, exp_cen = [], [], []
+    seen = 0
+    for seq in w._sequences():
+        idxs = np.array([i for i in (w.vocab.index_of(t) for t in seq)
+                         if i >= 0], dtype=np.int64)
+        if idxs.size == 0:
+            continue
+        positions = seen + np.arange(idxs.size)
+        seen += idxs.size
+        m = rng_s.random(idxs.size) < keep[idxs]
+        idxs, positions = idxs[m], positions[m]
+        if idxs.size < 2:
+            continue
+        ctx_pos, rows = _window_pairs(rng_w, w.window, idxs.size)
+        exp_pos.append(positions[rows])
+        exp_ctx.append(idxs[ctx_pos])
+        exp_cen.append(idxs[rows])
+    assert np.array_equal(bulk[0], np.concatenate(exp_pos))
+    assert np.array_equal(bulk[1], np.concatenate(exp_ctx))
+    assert np.array_equal(bulk[2], np.concatenate(exp_cen))
+
+    # and the PRODUCTION per-sentence path emits the same pair multiset
+    w2 = Word2Vec(sentences=sentences, **kw)
+    w2.build_vocab()
+    gen = _capture_generic_sg_pairs(w2, monkeypatch)
+    gctx = np.concatenate([p[0] for p in gen])
+    gcen = np.concatenate([p[1] for p in gen])
+    assert np.array_equal(np.sort(bulk[1] * 10**6 + bulk[2]),
+                          np.sort(gctx * 10**6 + gcen))
+
+
+def test_bulk_dbow_emission_matches_generic(monkeypatch):
+    """PV-DBOW bulk emission (window pairs + label→word pairs) must match
+    the per-sentence path's pair multiset, subsampling included."""
+    rng = np.random.default_rng(5)
+    docs = []
+    for i in range(80):
+        pool = (["cat", "dog", "horse", "cow"] if i % 2 == 0
+                else ["cpu", "gpu", "tpu", "chip"])
+        docs.append(LabelledDocument(" ".join(rng.choice(pool, size=9)),
+                                     ["ANIMAL" if i % 2 == 0 else "TECH"]))
+    # mixed-corpus hazards: unlabeled docs and 1-token docs must gate
+    # identically (per sequence) in both paths or the streams diverge
+    docs.insert(10, LabelledDocument("cat dog horse", []))
+    docs.insert(20, LabelledDocument("cat", ["ANIMAL"]))
+    docs.insert(30, LabelledDocument("gpu", []))
+    kw = dict(layer_size=8, window=3, negative=3, sampling=1e-3, epochs=1,
+              seed=4, batch_size=128)
+    pv = ParagraphVectors(documents=docs, sequence_algorithm="dbow", **kw)
+    pv.build_vocab()
+    cap = _capture_bulk_emission(pv, monkeypatch)
+    bctx = np.concatenate([c[1] for c in cap])
+    bcen = np.concatenate([c[2] for c in cap])
+
+    pv2 = ParagraphVectors(documents=docs, sequence_algorithm="dbow", **kw)
+    pv2.build_vocab()
+    gen = _capture_generic_sg_pairs(pv2, monkeypatch)
+    gctx = np.concatenate([p[0] for p in gen])
+    gcen = np.concatenate([p[1] for p in gen])
+    assert np.array_equal(np.sort(bctx * 10**6 + bcen),
+                          np.sort(gctx * 10**6 + gcen))
+    # label rows really appear as contexts
+    lab_idx = {pv.vocab.index_of("ANIMAL"), pv.vocab.index_of("TECH")}
+    assert lab_idx & set(bctx.tolist())
+
+
+def test_bulk_dm_emission_matches_generic(monkeypatch):
+    """PV-DM bulk rows (window + label columns, mask-padded) must match the
+    per-sentence CBOW emission row-for-row as (center, sorted-ctx) multisets."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    rng = np.random.default_rng(6)
+    docs = []
+    for i in range(60):
+        pool = (["cat", "dog", "horse", "cow"] if i % 2 == 0
+                else ["cpu", "gpu", "tpu", "chip"])
+        docs.append(LabelledDocument(" ".join(rng.choice(pool, size=8)),
+                                     ["ANIMAL" if i % 2 == 0 else "TECH"]))
+    kw = dict(layer_size=8, window=2, negative=3, sampling=1e-3, epochs=1,
+              seed=8, batch_size=128)
+    pv = ParagraphVectors(documents=docs, sequence_algorithm="dm", **kw)
+    pv.build_vocab()
+    cap = _capture_bulk_emission(pv, monkeypatch)
+    bulk_rows = []
+    for pos, ctxw, cmask, cen in cap:
+        for r in range(len(cen)):
+            ctx = tuple(sorted(ctxw[r][cmask[r] > 0].tolist()))
+            bulk_rows.append((int(cen[r]), ctx))
+
+    pv2 = ParagraphVectors(documents=docs, sequence_algorithm="dm", **kw)
+    pv2.build_vocab()
+    gen_rows = []
+    orig_emit = SequenceVectors._emit_sequence
+
+    def spy_emit(self, idxs, label_idxs, batcher, rng_, seen=0):
+        before = len(self._cbow_buf)
+        orig_emit(self, idxs, label_idxs, batcher, rng_, seen)
+        for ctx, cen in self._cbow_buf[before:]:
+            gen_rows.append((int(cen), tuple(sorted(ctx))))
+
+    monkeypatch.setattr(SequenceVectors, "_emit_sequence", spy_emit)
+    monkeypatch.setattr(type(pv2), "_ns_eligible", lambda self: False)
+    pv2.fit()
+    monkeypatch.undo()
+    assert sorted(bulk_rows) == sorted(gen_rows)
+
+
+def test_paragraph_vectors_rides_bulk_path(monkeypatch):
+    """Labeled fits must not fall back to the per-sentence loop anymore."""
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    calls = []
+    orig = SequenceVectors._bulk_run
+
+    def spy(self, *a, **k):
+        calls.append(k.get("label_width", 0))
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(SequenceVectors, "_bulk_run", spy)
+    docs = [LabelledDocument("cat dog cat dog cow", ["A"]),
+            LabelledDocument("cpu gpu tpu chip cpu", ["B"])] * 10
+    for seq_algo in ("dbow", "dm"):
+        for neg in (3, 0):   # ns and hs modes
+            pv = ParagraphVectors(documents=docs, sequence_algorithm=seq_algo,
+                                  layer_size=8, negative=neg, epochs=1, seed=2)
+            pv.fit()
+    assert calls == [1, 1, 1, 1]
+
+
 def test_distributed_word2vec_fan_out():
     """SparkSequenceVectors role (dl4j-spark-nlp): shared vocab, partitioned
     corpus trained per worker, tables averaged — the averaged model must
