@@ -200,6 +200,25 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
 
         fn = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
         model._jit_cache[cache_key] = fn
+    # NOTE: the epoch pipelining below is fully effective when batch_size
+    # divides n and listeners don't read the score — the ragged-tail path
+    # (fit_tail) and score-reading listeners each host-sync per epoch.
+    try:
+        _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
+                    fn, fit_tail)
+    finally:
+        # one final sync so "fit returned" still means "training finished"
+        # (the last epoch's loss transitively waits on every queued epoch);
+        # in a finally so an aborted fit can't leave a device scalar behind
+        try:
+            model._score = float(model._score)
+        except Exception:
+            model._score = float("nan")
+    return model
+
+
+def _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
+                fn, fit_tail):
     for _ in range(epochs):
         for lst in model.listeners:
             lst.on_epoch_start(model)
@@ -212,7 +231,12 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
                       xs, ys, perm_steps)
         model.iteration += nb
         model.last_batch_size = batch_size
-        model._score = float(losses[-1])
+        # keep the score a DEVICE scalar inside the loop: a float() here
+        # would host-sync every epoch, serializing epochs against the
+        # dispatch RTT (~24 ms through a tunneled chip) instead of letting
+        # JAX's async dispatch pipeline them back to back.  Listeners that
+        # read get_score() materialize it on demand.
+        model._score = losses[-1]
         model._last_grad_stats = gstats
         for lst in model.listeners:
             lst.iteration_done(model, model.iteration, model.epoch)
@@ -222,4 +246,3 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
         for lst in model.listeners:
             lst.on_epoch_end(model)
         model.epoch += 1
-    return model

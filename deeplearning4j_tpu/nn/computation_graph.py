@@ -207,7 +207,7 @@ class ComputationGraph:
         recent training minibatch (reference ``score()`` / ``score(DataSet)``
         — same contract as MultiLayerNetwork)."""
         if dataset is None and inputs is None:
-            return self._score
+            return float(self._score)   # device scalar mid-fit_on_device
         if dataset is not None:
             inputs, labels, _, _ = self._normalize_batch(dataset)
         inputs = [jnp.asarray(x) for x in _as_list(inputs)]
@@ -387,7 +387,9 @@ class ComputationGraph:
 
     # ------------------------------------------------------------- queries
     def get_score(self) -> float:
-        return self._score
+        # may be a device scalar mid-fit_on_device (kept async so epochs
+        # pipeline); materialize on demand
+        return float(self._score)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))

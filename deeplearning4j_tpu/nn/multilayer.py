@@ -206,7 +206,7 @@ class MultiLayerNetwork:
         """Loss on a dataset; with no arguments, the score of the most recent
         training minibatch (reference ``score()`` / ``score(DataSet)``)."""
         if dataset is None and x is None:
-            return self._score
+            return float(self._score)   # device scalar mid-fit_on_device
         if dataset is not None:
             x, y, _, _ = self._normalize_batch(dataset)
         fn = self._get_jitted("score")
@@ -614,7 +614,9 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- queries
     def get_score(self) -> float:
-        return self._score
+        # may be a device scalar mid-fit_on_device (kept async so epochs
+        # pipeline); materialize on demand
+        return float(self._score)
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))
