@@ -206,14 +206,20 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
     try:
         _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
                     fn, fit_tail)
-    finally:
-        # one final sync so "fit returned" still means "training finished"
-        # (the last epoch's loss transitively waits on every queued epoch);
-        # in a finally so an aborted fit can't leave a device scalar behind
+    except BaseException:
+        # aborted fit: best-effort coercion so _score can't stay a device
+        # scalar, but the original error keeps propagating
         try:
             model._score = float(model._score)
         except Exception:
             model._score = float("nan")
+        raise
+    # one final sync so "fit returned" still means "training finished" (the
+    # last epoch's loss transitively waits on every queued epoch).  NOT
+    # exception-guarded: with async dispatch this float() is where deferred
+    # device-side failures (OOM, runtime faults) first surface, and they
+    # must raise out of fit, not become a silent nan.
+    model._score = float(model._score)
     return model
 
 

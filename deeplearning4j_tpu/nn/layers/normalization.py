@@ -52,12 +52,19 @@ def _acc_dtype(dt):
     return jnp.promote_types(dt, jnp.float32)
 
 
-def _bn_fwd_math(x, gamma, beta, eps):
+def _bn_stats(x, eps):
+    """One-pass f32 statistics: (mean, var, inv).  Shared by the XLA path
+    and the Pallas helper (ops/pallas_bn) — one copy of the E[x²]−E[x]²
+    form and its var>=0 clamp."""
     axes = tuple(range(x.ndim - 1))
     xf = x.astype(_acc_dtype(x.dtype))
     mean = jnp.mean(xf, axis=axes)
     var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
-    inv = lax.rsqrt(var + eps)
+    return mean, var, lax.rsqrt(var + eps)
+
+
+def _bn_fwd_math(x, gamma, beta, eps):
+    mean, var, inv = _bn_stats(x, eps)
     xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
     y = xhat * gamma + beta
     return y, mean, var, inv
@@ -68,10 +75,10 @@ def _bn_train_fwd(x, gamma, beta, eps):
     return (y, mean, var), (x, gamma, mean, inv)
 
 
-def _bn_train_bwd(res, cts):
-    x, gamma, mean, inv = res
-    # mean/var cotangents dropped by contract — see _bn_train_norm docstring
-    dy, _, _ = cts
+def _bn_bwd_math(x, gamma, mean, inv, dy):
+    """The hand-derived two-pass backward: (dx, dgamma, dbeta).  Shared by
+    the XLA path and the Pallas helper — one copy of the f32-accumulation
+    and cast subtleties."""
     axes = tuple(range(x.ndim - 1))
     n = x.size // x.shape[-1]
     acc = _acc_dtype(x.dtype)
@@ -84,7 +91,14 @@ def _bn_train_bwd(res, cts):
     coef = (inv * gamma.astype(acc)).astype(x.dtype)
     dx = coef * (dy - (dbeta / n).astype(x.dtype)
                  - xhat * (dgamma / n).astype(x.dtype))
-    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype), None
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+
+def _bn_train_bwd(res, cts):
+    x, gamma, mean, inv = res
+    # mean/var cotangents dropped by contract — see _bn_train_norm docstring
+    dy, _, _ = cts
+    return _bn_bwd_math(x, gamma, mean, inv, dy) + (None,)  # eps nondiff, None
 
 
 _bn_train_norm.defvjp(_bn_train_fwd, _bn_train_bwd)
@@ -109,6 +123,11 @@ class BatchNormalization(BaseLayerConf):
     lock_gamma_beta: bool = False
     gamma_init: float = 1.0
     beta_init: float = 0.0
+    # optional Pallas fused apply+activation (the CudnnBatchNormalization-
+    # Helper selection pattern); falls back to the XLA path when the kernel
+    # doesn't support the config.  Measured neutral-to-negative on ResNet50
+    # (XLA's own fusions already cover the chain — BENCH_NOTES round 3).
+    helper: Optional[str] = None
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_out == 0 or override:
@@ -140,14 +159,29 @@ class BatchNormalization(BaseLayerConf):
                 beta = jnp.zeros((x.shape[-1],), x.dtype)
             else:
                 gamma, beta = params["gamma"], params["beta"]
-            y, mean, var = _bn_train_norm(x, gamma.astype(x.dtype),
-                                          beta.astype(x.dtype), self.eps)
+            y = None
+            if self.helper == "pallas":
+                from ...ops import pallas_bn
+                act_name = self.resolved("activation", "identity")
+                backend = jax.default_backend()
+                if (backend in ("tpu", "cpu")   # no Triton path wired here
+                        and pallas_bn.supports(activation=act_name,
+                                               shape=x.shape,
+                                               itemsize=x.dtype.itemsize)):
+                    y, mean, var = pallas_bn.bn_act_train(
+                        x, gamma.astype(x.dtype), beta.astype(x.dtype),
+                        self.eps, act_name, backend == "cpu")
+                    # activation already fused in the kernel
+            if y is None:
+                y, mean, var = _bn_train_norm(x, gamma.astype(x.dtype),
+                                              beta.astype(x.dtype), self.eps)
+                y = self.act_fn(y)
             d = self.decay
             new_state = {"mean": d * state["mean"] + (1 - d) * mean.astype(
                              state["mean"].dtype),
                          "var": d * state["var"] + (1 - d) * var.astype(
                              state["var"].dtype)}
-            return self.act_fn(y), new_state
+            return y, new_state
         mean, var = state["mean"], state["var"]
         xhat = (x - mean.astype(x.dtype)) * lax.rsqrt(
             var.astype(x.dtype) + self.eps)

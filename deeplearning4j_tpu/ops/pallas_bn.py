@@ -1,0 +1,147 @@
+"""Pallas fused BatchNorm-apply(+ReLU) — the CudnnBatchNormalizationHelper
+experiment of the TPU build.
+
+Reference ``deeplearning4j-cuda/.../normalization/CudnnBatchNormalizationHelper.java:45``:
+an optional per-layer fast path, numerics-validated against the portable
+implementation.  Here the train-mode BN *apply* pass (y = act(x̂·γ + β))
+runs as one Pallas kernel over [M, C] tiles with the per-channel scale and
+shift folded to two vectors; statistics and the backward reuse the shared
+math in ``nn/layers/normalization`` (``_bn_stats`` / ``_bn_bwd_math``) with
+the activation mask folded into dy.
+
+NOTE (measured, see BENCH_NOTES round 3): on the ResNet50 flagship this
+kernel is a *negative result* — XLA already fuses the apply+ReLU(+residual
+add) into neighbouring fusions, and a Pallas custom call is a fusion
+barrier that splits those chains (1448 vs 2380 ex/s).  Kept as the
+helper-selection pattern mirror (and for nets whose elementwise chains XLA
+does not fuse), selected per layer via ``BatchNormalization(helper="pallas")``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["supports", "bn_act_train"]
+
+try:  # pallas requires a TPU-capable lowering; import tolerant for docs
+    from jax.experimental import pallas as pl
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+_ACTS = ("identity", "relu")
+
+
+def _lane_geometry(shape: Sequence[int]):
+    """(rows M', lane width C', row-fold k) of the lane-tileable [M', C']
+    view of an [..., C] tensor, or None when no valid view exists."""
+    c = int(shape[-1])
+    m = 1
+    for d in shape[:-1]:
+        m *= int(d)
+    if c % 128 == 0:
+        return m, c, 1
+    if c > 128 or 128 % c:
+        return None
+    k = 128 // c
+    if m % k:
+        return None
+    return m // k, k * c, k
+
+
+def _tile_m(m: int, c: int, itemsize: int):
+    """Largest sublane-legal (multiple of 8) row tile dividing m whose
+    [tm, c] block stays within a 4 MiB-per-operand VMEM budget, or None.
+    Mosaic requires the minor block dims tileable to (8, 128); tm < 8 is
+    rejected rather than risked (measured: tm=4 fails lowering on v5e)."""
+    budget = (4 << 20) // max(c * itemsize, 1)
+    for tm in (2048, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if tm <= budget and m % tm == 0:
+            return tm
+    return None
+
+
+def supports(*, activation: str, shape: Sequence[int],
+             itemsize: int = 4) -> bool:
+    """checkSupported: identity/relu activations and geometries with a
+    lane-tileable [M, C] view whose rows admit a sublane-legal, VMEM-sized
+    tile.  ``itemsize``: bytes per element of the input (4 covers f32; pass
+    2 for bf16 to allow larger tiles)."""
+    if not (_PALLAS_OK and activation in _ACTS and len(shape) >= 2):
+        return False
+    geo = _lane_geometry(shape)
+    if geo is None:
+        return False
+    m2, c2, _ = geo
+    return _tile_m(m2, c2, itemsize) is not None
+
+
+def _apply_kernel(x_ref, sc_ref, sh_ref, o_ref, *, relu: bool):
+    y = x_ref[...] * sc_ref[...] + sh_ref[...]
+    if relu:
+        y = jnp.maximum(y, jnp.zeros_like(y))
+    o_ref[...] = y
+
+
+@functools.partial(jax.jit, static_argnames=("relu", "interpret"))
+def _apply(x2, scale, shift, relu: bool, interpret: bool):
+    """y = act(x2 * scale + shift) over the [M', C'] lane-tiled view."""
+    m, c = x2.shape
+    tm = _tile_m(m, c, x2.dtype.itemsize)
+    return pl.pallas_call(
+        functools.partial(_apply_kernel, relu=relu),
+        grid=(m // tm,),
+        in_specs=[pl.BlockSpec((tm, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((tm, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, c), x2.dtype),
+        interpret=interpret,
+    )(x2, scale, shift)
+
+
+def _fwd_math(x, gamma, beta, eps, act, interpret):
+    from ..nn.layers.normalization import _bn_stats
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    mean, var, inv = _bn_stats(x, eps)
+    scale = (inv * gamma.astype(acc)).astype(x.dtype)
+    shift = (beta.astype(acc) - mean * inv * gamma.astype(acc)).astype(x.dtype)
+    m2, c2, k = _lane_geometry(x.shape)
+    y = _apply(x.reshape(m2, c2), jnp.tile(scale, k)[None, :],
+               jnp.tile(shift, k)[None, :], act == "relu",
+               interpret).reshape(x.shape)
+    return y, mean, var, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def bn_act_train(x, gamma, beta, eps, act: str = "relu",
+                 interpret: bool = False):
+    """Training-mode BN with the activation fused into the apply kernel.
+
+    Returns (y_post_activation, mean, var); stats are f32.  Same cotangent
+    contract as ``_bn_train_norm``: mean/var cotangents are dropped (they
+    only feed the running-stats EMA).  Callers must check :func:`supports`
+    first — unsupported geometries raise at trace time.
+    """
+    y, mean, var, _ = _fwd_math(x, gamma, beta, eps, act, interpret)
+    return y, mean, var
+
+
+def _fwd(x, gamma, beta, eps, act, interpret):
+    y, mean, var, inv = _fwd_math(x, gamma, beta, eps, act, interpret)
+    return (y, mean, var), (x, gamma, mean, inv, y)
+
+
+def _bwd(eps, act, interpret, res, cts):
+    from ..nn.layers.normalization import _bn_bwd_math
+    x, gamma, mean, inv, y = res
+    dy, _, _ = cts
+    if act == "relu":
+        dy = dy * (y > 0).astype(dy.dtype)
+    return _bn_bwd_math(x, gamma, mean, inv, dy)
+
+
+bn_act_train.defvjp(_fwd, _bwd)

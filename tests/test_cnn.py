@@ -159,6 +159,84 @@ def test_batchnorm_running_stats_update_and_inference():
     assert np.allclose(out1, out2)
 
 
+def test_pallas_bn_helper_matches_default():
+    """BatchNormalization(helper="pallas") — the CudnnBatchNormalization-
+    Helper selection-pattern mirror — must match the XLA path's forward and
+    gradients (interpret mode on CPU).  Measured a net LOSS on ResNet50
+    (Pallas custom calls are fusion barriers; BENCH_NOTES round 3), so it's
+    opt-in per layer, never a default."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.normalization import _bn_train_norm
+    from deeplearning4j_tpu.ops import pallas_bn
+
+    rng = np.random.default_rng(0)
+    for C, act in [(64, "relu"), (128, "identity"), (256, "relu")]:
+        assert pallas_bn.supports(activation=act, shape=(4, 4, 2, C))
+        x = jnp.asarray(rng.standard_normal((4, 4, 2, C)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal(C), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(C), jnp.float32)
+
+        def ref(x, g, b):
+            y, _, _ = _bn_train_norm(x, g, b, 1e-5)
+            return jnp.maximum(y, 0) if act == "relu" else y
+
+        def fused(x, g, b):
+            y, _, _ = pallas_bn.bn_act_train(x, g, b, 1e-5, act, True)
+            return y
+
+        np.testing.assert_allclose(np.asarray(fused(x, g, b)),
+                                   np.asarray(ref(x, g, b)), atol=1e-5)
+        dy = jnp.asarray(rng.standard_normal(x.shape), jnp.float32)
+        gr = jax.grad(lambda *a: jnp.sum(ref(*a) * dy), (0, 1, 2))(x, g, b)
+        gf = jax.grad(lambda *a: jnp.sum(fused(*a) * dy), (0, 1, 2))(x, g, b)
+        for a, bb in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       atol=2e-4)
+    assert not pallas_bn.supports(activation="tanh", shape=(8, 128))
+    assert not pallas_bn.supports(activation="relu", shape=(8, 96))
+    # geometries without a sublane-legal (multiple-of-8) row tile must be
+    # rejected, not crash at Mosaic lowering (measured on v5e)
+    assert not pallas_bn.supports(activation="relu", shape=(3, 64))
+    assert not pallas_bn.supports(activation="relu", shape=(4, 3, 2, 64))
+    assert pallas_bn.supports(activation="relu", shape=(16, 64))
+    # f32 2048x2048 block blows the VMEM budget (measured compile failure);
+    # the byte-aware tiling must pick a smaller legal tile instead
+    from deeplearning4j_tpu.ops.pallas_bn import _tile_m
+    assert _tile_m(2048, 2048, 4) == 512
+
+
+def test_pallas_bn_layer_wiring():
+    """BatchNormalization(helper='pallas') through the real layer/builder
+    surface: the fused path trains identically to the default, and
+    unsupported geometries fall back instead of crashing."""
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd as _Sgd
+
+    def build(helper, width):
+        conf = (NeuralNetConfiguration.builder().seed(5).activation("relu")
+                .weight_init("xavier").updater(_Sgd(learning_rate=0.05))
+                .list()
+                .layer(DenseLayer(n_out=width))
+                .layer(BatchNormalization(helper=helper))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((64, 8)).astype(np.float32)   # m2=32: supported
+    Y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    na, nb = build("pallas", 64), build(None, 64)
+    for _ in range(4):
+        na.fit(X, Y)
+        nb.fit(X, Y)
+    assert abs(na.get_score() - nb.get_score()) < 1e-4
+    # unsupported channel count (96): silent fallback, still trains
+    nc = build("pallas", 96)
+    nc.fit(X, Y)
+    assert np.isfinite(nc.get_score())
+
+
 def test_global_pooling_masked_avg():
     import jax.numpy as jnp
     layer = GlobalPoolingLayer(pooling_type="avg")
