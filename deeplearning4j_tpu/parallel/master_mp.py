@@ -1,0 +1,374 @@
+"""TrainingMaster orchestration over real OS processes.
+
+The in-process masters (``parallel/master.py``) prove the averaging /
+shared-gradients *semantics* with thread replicas; this module runs the same
+contracts with workers as separate processes — the reference's driver +
+executor-JVM topology (``ParameterAveragingTrainingMaster.java:62``,
+``SharedTrainingWrapper.java:48``).  Coordination rides the
+``TcpMessageBroker`` hub (the Aeron/Spark-transport role):
+
+- **averaging**: each worker fits its shard ``averaging_frequency`` batches
+  per round, publishes its raveled params (+ optionally updater state) as a
+  dense frame, then waits for the master's averaged frame — a synchronous
+  parameter-averaging barrier across processes.
+- **shared**: workers exchange threshold-quantized param-updates peer-to-peer
+  through ``RemoteGradientSharing`` (the SilentUpdatesMessage wire format) —
+  no barrier; the master collects worker 0's final table.
+
+``evaluate`` / ``score`` fan the dataset out over worker processes which
+return partial ``Evaluation`` JSON / loss sums for the master to merge
+(the ``SparkDl4jMultiLayer.evaluate``/``calculateScore`` map-reduce).
+
+Workers are spawned as ``python -m deeplearning4j_tpu.parallel.master_mp``
+with a job directory holding the serialized model, the shard, and a spec;
+the test rig (tests/test_masters_mp.py) pins workers to CPU devices so the
+whole topology is provable without TPU hardware — the reference's
+``local[N]`` posture (``BaseSparkTest.java:46``).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["MultiprocessMaster"]
+
+_UP = "mp.up"          # worker -> master dense frames (averaging rounds)
+_DOWN = "mp.down"      # master -> workers averaged frame
+_FINAL = "mp.final"    # shared mode: final tables
+_DONE = "mp.done"      # per-worker result json
+_GRADS = "mp.grads"    # shared mode: quantized updates (RemoteGradientSharing)
+
+
+def _encode_frame(wid: int, rnd: int, vec: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(vec))
+    return struct.pack("<ii", wid, rnd) + buf.getvalue()
+
+
+def _decode_frame(data: bytes):
+    wid, rnd = struct.unpack_from("<ii", data)
+    vec = np.load(io.BytesIO(data[8:]), allow_pickle=False)
+    return wid, rnd, vec
+
+
+def _ravel(model, with_opt: bool):
+    from jax.flatten_util import ravel_pytree
+    flat_p, unravel_p = ravel_pytree(model.params)
+    if not with_opt:
+        return np.asarray(flat_p), (unravel_p, None, flat_p.size)
+    flat_o, unravel_o = ravel_pytree(model.opt_state)
+    vec = np.concatenate([np.asarray(flat_p), np.asarray(flat_o)])
+    return vec, (unravel_p, unravel_o, flat_p.size)
+
+
+def _unravel_into(model, vec, meta) -> None:
+    import jax.numpy as jnp
+    unravel_p, unravel_o, n_p = meta
+    vec = jnp.asarray(vec)
+    model.params = unravel_p(vec[:n_p])
+    if unravel_o is not None:
+        model.opt_state = unravel_o(vec[n_p:])
+
+
+def _save_batches(path: str, batches: List[Any]) -> None:
+    arrs = {}
+    for i, (x, y) in enumerate(batches):
+        arrs[f"x{i}"] = np.asarray(x)
+        arrs[f"y{i}"] = np.asarray(y)
+    np.savez(path, n=np.int64(len(batches)), **arrs)
+
+
+def _load_batches(path: str):
+    z = np.load(path)
+    return [(z[f"x{i}"], z[f"y{i}"]) for i in range(int(z["n"]))]
+
+
+class MultiprocessMaster:
+    """Orchestrates N worker processes training one model.
+
+    ``mode``: "averaging" (ParameterAveraging contract) or "shared"
+    (SharedGradients / quantized peer-to-peer contract).
+    ``worker_env``: extra env vars for workers (the test rig passes
+    ``JAX_PLATFORMS=cpu``; production hosts would pass their chip topology).
+    """
+
+    def __init__(self, num_workers: int = 2, mode: str = "averaging",
+                 averaging_frequency: int = 5, average_updaters: bool = True,
+                 threshold: float = 1e-3, timeout: float = 300.0,
+                 worker_env: Optional[Dict[str, str]] = None):
+        if mode not in ("averaging", "shared"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.num_workers = num_workers
+        self.mode = mode
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.average_updaters = average_updaters
+        self.threshold = threshold
+        self.timeout = timeout
+        self.worker_env = dict(worker_env or {})
+        self.last_results: List[Dict[str, Any]] = []
+
+    # -- plumbing ------------------------------------------------------------
+    def _spawn(self, jobdir: str, wid: int, port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root   # drops any TPU sitecustomize hook
+        env.update(self.worker_env)
+        log = open(os.path.join(jobdir, f"worker_{wid}.log"), "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.parallel.master_mp",
+             jobdir, str(wid), str(port)],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        p._logfile = log
+        return p
+
+    def _run_job(self, model, jobdir: str, spec: Dict[str, Any],
+                 setup, run):
+        """Write the job, serve the broker, create master-side subscriptions
+        (``setup`` — BEFORE any worker can publish, the broker retains
+        nothing), spawn workers, run the master protocol (``run``), join
+        workers, return its result."""
+        from ..streaming.broker import TcpMessageBroker
+        from ..utils import model_serializer
+
+        model_serializer.write_model(model, os.path.join(jobdir, "model.zip"))
+        broker = TcpMessageBroker().serve()
+        spec = dict(spec, port=broker.port, num_workers=self.num_workers,
+                    averaging_frequency=self.averaging_frequency,
+                    average_updaters=self.average_updaters,
+                    threshold=self.threshold, timeout=self.timeout)
+        with open(os.path.join(jobdir, "spec.json"), "w") as f:
+            json.dump(spec, f)
+        done_sub = broker.subscribe(_DONE)
+        subs = setup(broker)
+        procs = [self._spawn(jobdir, w, broker.port)
+                 for w in range(self.num_workers)]
+        self._procs = procs
+        try:
+            out = run(broker, subs)
+            results: Dict[int, Dict[str, Any]] = {}
+            deadline = time.time() + self.timeout
+            while len(results) < self.num_workers:
+                payload = done_sub.poll(timeout=1.0)
+                if payload is not None:
+                    r = json.loads(payload.decode())
+                    results[int(r["wid"])] = r
+                    continue
+                self._check_liveness(jobdir)
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "workers did not report: "
+                        + self._logs_tail(jobdir))
+            for w, p in enumerate(procs):
+                rc = p.wait(timeout=30)
+                if rc != 0:
+                    raise RuntimeError(f"worker {w} rc={rc}: "
+                                       + self._logs_tail(jobdir))
+            self.last_results = [results[w] for w in range(self.num_workers)]
+            return out
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p._logfile.close()
+            broker.shutdown()
+
+    def _logs_tail(self, jobdir: str) -> str:
+        outs = []
+        for w in range(self.num_workers):
+            path = os.path.join(jobdir, f"worker_{w}.log")
+            if os.path.exists(path):
+                with open(path) as f:
+                    outs.append(f"[worker {w}] " + f.read()[-2000:])
+        return "\n".join(outs)
+
+    def _check_liveness(self, jobdir: str) -> None:
+        """Fail fast when a worker is already dead instead of burning the
+        full collection timeout."""
+        for w, p in enumerate(getattr(self, "_procs", ())):
+            rc = p.poll()
+            if rc is not None and rc != 0:
+                raise RuntimeError(f"worker {w} died (rc={rc}): "
+                                   + self._logs_tail(jobdir))
+
+    def _collect(self, sub, want: int, what: str, jobdir: str):
+        frames: Dict[int, np.ndarray] = {}
+        deadline = time.time() + self.timeout
+        while len(frames) < want:
+            payload = sub.poll(timeout=1.0)
+            if payload is not None:
+                wid, _, vec = _decode_frame(payload)
+                frames[wid] = vec
+                continue
+            self._check_liveness(jobdir)
+            if time.time() > deadline:
+                raise RuntimeError(f"timed out collecting {what}: "
+                                   + self._logs_tail(jobdir))
+        return frames
+
+    def _prepare_jobdir(self, iterator, jobdir: Optional[str]):
+        """Materialize the job directory + per-worker shards (shared by the
+        fit and evaluate/score fan-outs so sharding can't diverge)."""
+        import tempfile
+
+        from .master import _chunk_batches
+
+        jobdir = jobdir or tempfile.mkdtemp(prefix="dl4j_mp_")
+        os.makedirs(jobdir, exist_ok=True)
+        parts = _chunk_batches(iterator, self.num_workers)
+        for w, part in enumerate(parts):
+            _save_batches(os.path.join(jobdir, f"shard_{w}.npz"), part)
+        return jobdir, parts
+
+    # -- training ------------------------------------------------------------
+    def fit(self, model, iterator, jobdir: Optional[str] = None) -> None:
+        jobdir, parts = self._prepare_jobdir(iterator, jobdir)
+        n_rounds = (max((len(p) for p in parts), default=0)
+                    + self.averaging_frequency - 1) // self.averaging_frequency
+        _, meta = _ravel(model, self.average_updaters
+                         and self.mode == "averaging")
+
+        def setup(broker):
+            return broker.subscribe(
+                _UP if self.mode == "averaging" else _FINAL)
+
+        def run(broker, sub):
+            if self.mode == "averaging":
+                last = None
+                for rnd in range(n_rounds):
+                    frames = self._collect(sub, self.num_workers,
+                                           f"round {rnd}", jobdir)
+                    last = np.mean([frames[w] for w in sorted(frames)],
+                                   axis=0)
+                    broker.publish(_DOWN, _encode_frame(-1, rnd, last))
+                return last
+            frames = self._collect(sub, self.num_workers, "final tables",
+                                   jobdir)
+            return frames[0]   # worker 0's table IS the model (no master copy)
+
+        spec = {"task": "fit", "mode": self.mode, "n_rounds": n_rounds}
+        vec = self._run_job(model, jobdir, spec, setup, run)
+        if vec is not None:
+            _unravel_into(model, vec, meta)
+
+    # -- evaluation / scoring fan-out ---------------------------------------
+    def _fan_out_task(self, model, iterator, task: str,
+                      jobdir: Optional[str]):
+        jobdir, _ = self._prepare_jobdir(iterator, jobdir)
+        self._run_job(model, jobdir, {"task": task, "mode": self.mode},
+                      lambda broker: None, lambda broker, subs: None)
+        return self.last_results
+
+    def evaluate(self, model, iterator, jobdir: Optional[str] = None):
+        """Distributed classification evaluation: per-process partial
+        ``Evaluation`` objects merged on the master."""
+        from ..evaluation.classification import Evaluation
+        results = self._fan_out_task(model, iterator, "evaluate", jobdir)
+        merged = Evaluation()
+        for r in results:
+            if r.get("evaluation"):
+                merged.merge(Evaluation.from_json(r["evaluation"]))
+        return merged
+
+    def score(self, model, iterator, average: bool = True,
+              jobdir: Optional[str] = None) -> float:
+        results = self._fan_out_task(model, iterator, "score", jobdir)
+        total = sum(r["loss_sum"] for r in results)
+        n = sum(r["n_examples"] for r in results)
+        return total / max(n, 1) if average else total
+
+
+# --------------------------------------------------------------------- worker
+def _worker_main(jobdir: str, wid: int, port: int) -> None:
+    with open(os.path.join(jobdir, "spec.json")) as f:
+        spec = json.load(f)
+
+    from ..streaming.broker import TcpMessageBroker
+    from ..utils import model_serializer
+
+    broker = TcpMessageBroker(port=port)    # client endpoints only
+    model = model_serializer.restore_multi_layer_network(
+        os.path.join(jobdir, "model.zip"))
+    batches = _load_batches(os.path.join(jobdir, f"shard_{wid}.npz"))
+    result: Dict[str, Any] = {"wid": wid, "steps": 0}
+
+    task = spec["task"]
+    if task == "fit" and spec["mode"] == "averaging":
+        down = broker.subscribe(_DOWN)      # subscribe BEFORE first publish
+        _, meta = _ravel(model, spec["average_updaters"])
+        freq = spec["averaging_frequency"]
+        for rnd in range(spec["n_rounds"]):
+            for batch in batches[rnd * freq:(rnd + 1) * freq]:
+                model.fit_batch(batch)
+                result["steps"] += 1
+            vec, _ = _ravel(model, spec["average_updaters"])
+            broker.publish(_UP, _encode_frame(wid, rnd, vec))
+            # barrier timeout rides the master's configured deadline so a
+            # fast worker can't abort a round the master would still accept
+            payload = down.poll(timeout=float(spec["timeout"]))
+            if payload is None:
+                raise RuntimeError(f"worker {wid}: no averaged frame")
+            _, got_rnd, avg = _decode_frame(payload)
+            assert got_rnd == rnd, (got_rnd, rnd)
+            _unravel_into(model, avg, meta)
+    elif task == "fit":                     # shared gradients
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        from .accumulation import EncodingHandler
+        from .remote import RemoteGradientSharing
+
+        sharing = RemoteGradientSharing(
+            broker, wid, topic=_GRADS,
+            handler=EncodingHandler(initial_threshold=spec["threshold"]))
+        time.sleep(0.5)   # let every peer's subscription reach the hub
+        for batch in batches:
+            flat_before, unravel = ravel_pytree(model.params)
+            flat_before = jnp.array(flat_before)
+            model.fit_batch(batch)
+            result["steps"] += 1
+            flat_after, _ = ravel_pytree(model.params)
+            sharing.publish_update(flat_after - flat_before)
+            merged = sharing.apply_updates(flat_after, timeout=0.05)
+            model.params = unravel(merged)
+        # settle: drain stragglers so every process converges
+        time.sleep(1.0)
+        flat, unravel = ravel_pytree(model.params)
+        model.params = unravel(sharing.apply_updates(flat, timeout=0.5))
+        vec, _ = _ravel(model, False)
+        broker.publish(_FINAL, _encode_frame(wid, 0, vec))
+        result["messages_sent"] = sharing.messages_sent
+        result["messages_applied"] = sharing.messages_applied
+    elif task == "evaluate":
+        from ..evaluation.classification import Evaluation
+        ev = Evaluation()
+        for x, y in batches:
+            ev.eval(np.asarray(y), np.asarray(model.output(x)))
+        result["evaluation"] = ev.to_json()
+        result["n_examples"] = int(sum(np.asarray(x).shape[0]
+                                       for x, _ in batches))
+    elif task == "score":
+        total, n = 0.0, 0
+        for x, y in batches:
+            bs = int(np.asarray(x).shape[0])
+            total += model.score(x=x, y=y) * bs
+            n += bs
+        result["loss_sum"] = total
+        result["n_examples"] = n
+    else:
+        raise ValueError(f"unknown task {task!r}")
+
+    result["score"] = model.get_score() if task == "fit" else None
+    broker.publish(_DONE, json.dumps(result).encode())
+
+
+if __name__ == "__main__":
+    _worker_main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

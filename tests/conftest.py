@@ -57,6 +57,9 @@ _SLOW_TESTS = {
     "test_graph_fit_on_device",
     "test_dryrun_in_process_8_devices",
     "test_poisoned_default_backend_falls_back_to_subprocess",
+    "test_mp_parameter_averaging_trains",
+    "test_mp_shared_gradients_trains_and_exchanges",
+    "test_mp_evaluate_and_score_match_local",
 }
 
 

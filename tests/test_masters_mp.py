@@ -1,0 +1,93 @@
+"""TrainingMaster orchestration over real OS processes (VERDICT r2 item 5).
+
+The reference's masters span executor JVMs
+(``ParameterAveragingTrainingMaster.java:62``, ``SharedTrainingWrapper.java:48``);
+here workers are spawned Python processes on CPU devices coordinated through
+the TCP broker hub — provable without TPU hardware, the ``local[N]`` posture
+of ``BaseSparkTest.java:46``.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.master_mp import MultiprocessMaster
+
+WORKER_ENV = {"JAX_PLATFORMS": "cpu"}   # drop the axon TPU hook in children
+
+
+def _model(seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.05))
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _separable_batches(n_batches=8, bs=16, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((bs, 4)).astype(np.float32)
+        yc = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+        out.append((x, np.eye(3, dtype=np.float32)[yc]))
+    return out
+
+
+def test_mp_parameter_averaging_trains(tmp_path):
+    model = _model()
+    batches = _separable_batches()
+    before = model.score(x=batches[0][0], y=batches[0][1])
+    master = MultiprocessMaster(num_workers=2, mode="averaging",
+                                averaging_frequency=2,
+                                worker_env=WORKER_ENV)
+    master.fit(model, iter(batches), jobdir=str(tmp_path))
+    after = model.score(x=batches[0][0], y=batches[0][1])
+    assert np.isfinite(after) and after < before
+    # every batch trained exactly once, split across the two processes
+    steps = [r["steps"] for r in master.last_results]
+    assert sum(steps) == len(batches) and min(steps) > 0
+
+
+def test_mp_shared_gradients_trains_and_exchanges(tmp_path):
+    model = _model()
+    batches = _separable_batches(n_batches=10)
+    before = model.score(x=batches[0][0], y=batches[0][1])
+    master = MultiprocessMaster(num_workers=2, mode="shared",
+                                threshold=1e-4, worker_env=WORKER_ENV)
+    master.fit(model, iter(batches), jobdir=str(tmp_path))
+    after = model.score(x=batches[0][0], y=batches[0][1])
+    assert np.isfinite(after) and after < before
+    # the quantized wire path actually carried peer updates both ways
+    for r in master.last_results:
+        assert r["messages_sent"] > 0
+        assert r["messages_applied"] > 0, master.last_results
+
+
+def test_mp_evaluate_and_score_match_local(tmp_path):
+    """The cross-process map-reduce must reproduce the single-process
+    numbers exactly (same params, deterministic forward)."""
+    from deeplearning4j_tpu.evaluation.classification import Evaluation
+    model = _model()
+    batches = _separable_batches(n_batches=6)
+    master = MultiprocessMaster(num_workers=2, worker_env=WORKER_ENV)
+
+    merged = master.evaluate(model, iter(batches),
+                             jobdir=str(tmp_path / "eval"))
+    local = Evaluation()
+    for x, y in batches:
+        local.eval(y, np.asarray(model.output(x)))
+    assert merged.accuracy() == pytest.approx(local.accuracy())
+    assert merged.confusion.total() == local.confusion.total()
+
+    s_mp = master.score(model, iter(batches), jobdir=str(tmp_path / "score"))
+    xs = np.concatenate([b[0] for b in batches])
+    ys = np.concatenate([b[1] for b in batches])
+    assert s_mp == pytest.approx(model.score(x=xs, y=ys), rel=1e-5)
