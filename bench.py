@@ -101,6 +101,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
             B.word2vec_words_per_sec(),
             B.paragraph_vectors_words_per_sec(seq_algo="dbow"),
             B.paragraph_vectors_words_per_sec(seq_algo="dm")]
+    side += B.transformer_lm_step_time()                    # GPT-style, s=512
+    side += B.transformer_lm_step_time(batch=1, seq=8192,   # long-context
+                                       n_iter=3)
     with open(path, "w") as f:
         json.dump(side, f, indent=1)
     for row in side:

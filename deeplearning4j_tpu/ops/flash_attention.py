@@ -29,8 +29,29 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+def _block_live(causal: bool, qi, ki, block_q: int, block_k: int):
+    """False only for key blocks entirely above the causal diagonal —
+    shared by the forward and both backward kernels so the skip predicate
+    cannot drift between them."""
+    if not causal:
+        return True
+    return qi * block_q + block_q - 1 >= ki * block_k
+
+
+def _masked_scores(q, k, qi, ki, *, scale, causal, block_q, block_k):
+    """scale·q@kᵀ with the causal mask applied — the one definition of the
+    score block used by forward and backward (replay must match exactly)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    return s
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -41,22 +62,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: skip key blocks entirely above the diagonal.
-    run = True
-    if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_k
-
-    @pl.when(run)
+    @pl.when(_block_live(causal, qi, ki, block_q, block_k))
     def _step():
         q = q_ref[0].astype(jnp.float32)            # [block_q, d]
         k = k_ref[0].astype(jnp.float32)            # [block_k, d]
         v = v_ref[0].astype(jnp.float32)            # [block_k, d]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _masked_scores(q, k, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
 
         m_prev = m_ref[:]                            # [block_q, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -73,6 +85,173 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # logsumexp per row — the backward's softmax replay key.  The lse
+        # block spans the whole row (Mosaic tiling: a (1, block_q) slice
+        # block is not expressible), so write this q-block's slice in place.
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = (
+            m_ref[:] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd_call(qr, kr, vr, scale, causal, block_q, block_k, interpret):
+    bh, t_q, d = qr.shape
+    t_k = kr.shape[1]
+    grid = (bh, t_q // block_q, t_k // block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, t_q), lambda bh, qi, ki: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), qr.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+
+
+def _replay_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, qi, ki, *,
+                 scale, causal, block_q, block_k):
+    """Shared backward-step math: recompute the softmax block P from the
+    saved logsumexp and form dS = P∘(dP − D)·scale (FlashAttention-2 bwd).
+    lse/dd refs span the whole row; this q-block's slice is loaded here."""
+    q = q_ref[0].astype(jnp.float32)                # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)                # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)                # [block_k, d]
+    do = do_ref[0].astype(jnp.float32)              # [block_q, d]
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    dd = dd_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    s = _masked_scores(q, k, qi, ki, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k)
+    p = jnp.exp(s - lse[:, None]) * (s > NEG_INF / 2)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dd[:, None]) * scale
+    return q, k, do, p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                         dq_ref, dq_acc, *, scale, causal,
+                         block_q, block_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_live(causal, qi, ki, block_q, block_k))
+    def _step():
+        _, k, _, _, ds = _replay_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k):
+    # grid: (bh, k_blocks, q_blocks) — q innermost so dk/dv accumulate
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_live(causal, qi, ki, block_q, block_k))
+    def _step():
+        q, _, do, p, ds = _replay_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _done():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qr, kr, vr, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_call(qr, kr, vr, scale, causal, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _flash_fwd(qr, kr, vr, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_call(qr, kr, vr, scale, causal, block_q, block_k,
+                               interpret)
+    return out, (qr, kr, vr, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    qr, kr, vr, out, lse = res
+    bh, t_q, d = qr.shape
+    t_k = kr.shape[1]
+    # D = rowsum(dO ∘ O): one elementwise+reduce pass, XLA-fused
+    dd = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                 axis=-1)[:, None, :]               # (bh, 1, t_q) row form
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, t_q), lambda bh, qi, ki: (bh, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, t_q // block_q, t_k // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qr.shape, qr.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, dd)
+
+    # swapped grid: k outer, q inner (sequential) so dk/dv carry in scratch
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    row_spec2 = pl.BlockSpec((1, 1, t_q), lambda bh, ki, qi: (bh, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, t_k // block_k, t_q // block_q),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct(kr.shape, kr.dtype),
+                   jax.ShapeDtypeStruct(vr.shape, vr.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, dd)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
@@ -80,7 +259,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
-    """Flash attention over [b, h, t, d] tensors.
+    """Flash attention over [b, h, t, d] tensors — differentiable: the
+    FlashAttention-2 style backward (saved logsumexp, softmax replayed per
+    block, separate dq and dk/dv kernels) keeps training memory O(t).
 
     Falls back to ``sdpa_reference`` when shapes don't tile (t or d too small
     or not block-divisible) — same "checkSupported else fallback" contract as
@@ -103,25 +284,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
     qr = q.reshape(b * h, t_q, d)
     kr = k.reshape(b * h, t_k, d)
     vr = v.reshape(b * h, t_k, d)
-    grid = (b * h, t_q // block_q, t_k // block_k)
-
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qr, kr, vr)
+    out = _flash(qr, kr, vr, scale, causal, block_q, block_k, interpret)
     return out.reshape(b, h, t_q, d)

@@ -10,7 +10,7 @@ can return early through buffer-proxying transports — BENCH_NOTES round 1).
 from __future__ import annotations
 
 import time
-from typing import Dict
+from typing import Dict, List
 
 import numpy as np
 
@@ -129,3 +129,45 @@ def paragraph_vectors_words_per_sec(vocab: int = 5000, n_docs: int = 20000,
             "value": round(steady, 1), "unit": "words/sec",
             "cold_words_per_sec": round(cold, 1), "vocab": vocab,
             "n_docs": n_docs, "corpus_words": total}
+
+
+def transformer_lm_step_time(batch: int = 16, seq: int = 512,
+                             embed: int = 512, n_layers: int = 8,
+                             n_heads: int = 8, vocab: int = 8192,
+                             n_iter: int = 10) -> List[Dict]:
+    """TransformerLM train step time + achieved TFLOP/s, flash attention on
+    and off (VERDICT r2 item 6: the beyond-reference tier measured like the
+    parity tier).  Flops use the causal PaLM-style estimate
+    6·T·(12·L·E² + E·V) matmul + 6·L·B·S²·E attention (fwd+bwd)."""
+    import jax.numpy as jnp
+
+    from ..models import TransformerLM
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq + 1))
+    x = jnp.asarray(ids[:, :-1])
+    # direct one-hot assignment — np.eye(vocab) would materialize a
+    # vocab² identity (268 MB at vocab=8192) just to index rows from it
+    tgt = ids[:, 1:].reshape(-1)
+    onehot = np.zeros((tgt.size, vocab), dtype=np.float32)
+    onehot[np.arange(tgt.size), tgt] = 1.0
+    y = jnp.asarray(onehot.reshape(batch, seq, vocab))
+    tokens = batch * seq
+    flops = (6 * tokens * (12 * n_layers * embed * embed + embed * vocab)
+             + 6 * n_layers * batch * seq * seq * embed)
+    out = []
+    for impl in ("flash", "reference"):
+        model = TransformerLM(vocab_size=vocab, seq_len=seq, embed=embed,
+                              n_layers=n_layers, n_heads=n_heads,
+                              attn_impl=impl,
+                              compute_dtype="bfloat16").init()
+        ms = _steady_step_ms(model, x, y, n_iter)
+        out.append({
+            "metric": f"transformer_lm_step_ms[{impl},s={seq}]",
+            "value": round(ms, 3), "unit": "ms/step",
+            "batch": batch, "seq": seq, "embed": embed,
+            "n_layers": n_layers,
+            "tokens_per_sec": round(tokens / ms * 1e3, 1),
+            "achieved_tflops": round(flops / ms / 1e9, 2),
+        })
+    return out

@@ -72,6 +72,33 @@ def test_flash_attention_matches_reference(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_gradients_match_reference(causal):
+    """The FlashAttention-2 style backward (saved logsumexp, per-block
+    softmax replay, separate dq and dk/dv kernels) must produce the
+    reference VJP — the contract that makes attn_impl='flash' trainable.
+    Measured on chip: 10x faster training step than reference at seq 8192
+    (BENCH_NOTES round 3)."""
+    import jax
+    q, k, v = _qkv(b=2, h=2, t=256, d=64, seed=5)
+    do = jnp.asarray(
+        np.random.default_rng(1).standard_normal(q.shape), jnp.float32)
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=64, block_k=64,
+                                       interpret=True) * do)
+
+    def r(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, causal=causal) * do)
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4,
+                                   err_msg=f"d{name}")
+
+
 def test_flash_attention_fallback_on_odd_shapes():
     q, k, v = _qkv(t=7, d=5)
     out = flash_attention(q, k, v)  # 7 not divisible -> reference path
