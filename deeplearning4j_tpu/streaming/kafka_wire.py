@@ -1,19 +1,35 @@
-"""Kafka wire-protocol (v0) client + dev broker.
+"""Kafka wire-protocol client + dev broker (message formats v0 AND v2).
 
 Reference ``dl4j-streaming/.../streaming/kafka/NDArrayKafkaClient.java``
 talks to a real Kafka cluster through the Kafka client library.  This
-module implements the actual **Kafka binary protocol** (Produce v0 /
-Fetch v0, message-set v0 with CRC32) over stdlib sockets, so the framework
-can interoperate with a real broker where one exists — and ships
+module implements the actual **Kafka binary protocol** over stdlib
+sockets, so the framework can interoperate with a real broker — and ships
 ``MiniKafkaBroker``, an in-process single-node broker speaking the same
 frames, for dev rigs and tests (the LocalMessageBroker/TcpMessageBroker in
 ``broker.py`` remain the non-Kafka transports).
 
-Protocol framing (Kafka protocol guide, v0):
+Two on-wire generations are supported:
+
+- **v0 message sets** (Produce v0 / Fetch v0, CRC32): the legacy format —
+  removed from Apache Kafka 4.0, kept here for the mini-broker and old
+  clusters.
+- **v2 record batches** (Produce v3 / Fetch v4): varint+zigzag records,
+  CRC32C (Castagnoli) over the batch, the format every broker since 0.11
+  speaks and the only one after Kafka 4.0.  ``KafkaWireClient.negotiate()``
+  runs ApiVersions (api_key 18) and picks the newest mutually supported
+  produce/fetch pair automatically.
+
+Protocol framing (Kafka protocol guide):
   request  = int32 size | int16 api_key | int16 api_version
              | int32 correlation_id | string client_id | body
-  message  = int32 crc | int8 magic(0) | int8 attrs | bytes key | bytes value
-  msum crc = CRC32 over magic..value
+  v0 message     = int32 crc | int8 magic(0) | int8 attrs | bytes key
+                   | bytes value   (crc = CRC32 over magic..value)
+  v2 recordbatch = int64 base_offset | int32 length | int32 leader_epoch
+                   | int8 magic(2) | uint32 crc32c | int16 attrs
+                   | int32 last_offset_delta | int64 base/max_timestamp
+                   | int64 producer_id | int16 producer_epoch
+                   | int32 base_sequence | int32 n_records | records
+                   (crc32c covers attrs..records)
 """
 from __future__ import annotations
 
@@ -28,6 +44,78 @@ __all__ = ["KafkaWireClient", "MiniKafkaBroker", "NDArrayKafkaClient"]
 
 _API_PRODUCE = 0
 _API_FETCH = 1
+_API_VERSIONS = 18
+
+# what the mini-broker advertises via ApiVersions (both generations)
+_BROKER_API_VERSIONS = {_API_PRODUCE: (0, 3), _API_FETCH: (0, 4),
+                        _API_VERSIONS: (0, 0)}
+
+
+# ------------------------------------------------------------------- crc32c
+def _make_crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _make_crc32c_table()
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    crc = ~crc & 0xFFFFFFFF
+    table = _CRC32C_TABLE            # local ref: hot loop
+    for b in data:
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
+    return ~crc & 0xFFFFFFFF
+
+
+try:                                 # C implementation when available —
+    import google_crc32c as _gcrc    # the per-byte loop is ~1000x slower
+
+    def crc32c(data: bytes, crc: int = 0) -> int:
+        """CRC-32C (Castagnoli) — the v2 record-batch checksum."""
+        return _gcrc.extend(crc, bytes(data))
+except Exception:  # pragma: no cover
+    crc32c = _crc32c_py
+
+
+# ------------------------------------------------------------------ varints
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _varint(n: int) -> bytes:
+    """Zigzag varint (Kafka records use zigzag for all varint fields)."""
+    u = _zigzag(n)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data: bytes, off: int) -> Tuple[int, int]:
+    shift, u = 0, 0
+    while True:
+        b = data[off]
+        off += 1
+        u |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _unzigzag(u), off
+        shift += 7
 
 
 # ---------------------------------------------------------------- primitives
@@ -112,6 +200,73 @@ def decode_message_set(data: bytes) -> List[Tuple[int, bytes]]:
     return out
 
 
+# ------------------------------------------------------- v2 record batches
+def _encode_record(offset_delta: int, value: bytes,
+                   key: Optional[bytes] = None) -> bytes:
+    body = (b"\x00"                       # record attributes
+            + _varint(0)                  # timestamp delta
+            + _varint(offset_delta)
+            + (_varint(-1) if key is None
+               else _varint(len(key)) + key)
+            + _varint(len(value)) + value
+            + _varint(0))                 # headers count
+    return _varint(len(body)) + body
+
+
+def encode_record_batch(values: List[bytes], base_offset: int = 0) -> bytes:
+    """One v2 RecordBatch holding ``values`` (uncompressed, no producer)."""
+    records = b"".join(_encode_record(i, v) for i, v in enumerate(values))
+    after_crc = (struct.pack(">hiqqqhii", 0, len(values) - 1, 0, 0,
+                             -1, -1, -1, len(values))
+                 + records)
+    crc = crc32c(after_crc)
+    batch_tail = struct.pack(">ibI", 0, 2, crc) + after_crc
+    #                        leader_epoch, magic, crc32c
+    return struct.pack(">qi", base_offset, len(batch_tail)) + batch_tail
+
+
+def decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
+    """[(offset, value)] from a sequence of v2 RecordBatches — raises on
+    CRC32C mismatch; partial trailing batches ignored (Kafka semantics)."""
+    out: List[Tuple[int, bytes]] = []
+    off = 0
+    while off + 12 <= len(data):
+        base_offset, length = struct.unpack_from(">qi", data, off)
+        if off + 12 + length > len(data):
+            break                          # partial trailing batch
+        _epoch, magic, crc = struct.unpack_from(">ibI", data, off + 12)
+        if magic != 2:
+            raise ValueError(f"record batch at {base_offset}: magic {magic}"
+                             " (expected 2) — use decode_message_set for v0")
+        body_off = off + 12 + 9            # past leader_epoch+magic+crc
+        body = data[body_off:off + 12 + length]
+        if crc32c(body) != crc:
+            raise ValueError(
+                f"record batch at {base_offset}: CRC32C mismatch")
+        (attrs, _last_delta, _bts, _mts, _pid, _pepoch, _bseq,
+         n_records) = struct.unpack_from(">hiqqqhii", body, 0)
+        if attrs & 0x07:
+            raise ValueError(
+                f"record batch at {base_offset}: compressed batches "
+                f"(attrs={attrs:#x}) are not supported — produce uncompressed")
+        p = struct.calcsize(">hiqqqhii")
+        for _ in range(n_records):
+            rec_len, p = _read_varint(body, p)
+            end = p + rec_len
+            p += 1                         # record attributes
+            _ts, p = _read_varint(body, p)
+            odelta, p = _read_varint(body, p)
+            klen, p = _read_varint(body, p)
+            if klen >= 0:
+                p += klen
+            vlen, p = _read_varint(body, p)
+            value = body[p:p + vlen] if vlen >= 0 else b""
+            out.append((base_offset + odelta, value))
+            p = end                        # skip headers
+        off += 12 + length
+    return out
+
+
 # ------------------------------------------------------------------ client
 class KafkaWireClient:
     """Minimal Kafka v0 client: produce/fetch against one broker (the
@@ -126,6 +281,10 @@ class KafkaWireClient:
         self._corr = 0
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        # wire generation: (0, 0) = legacy message sets; negotiate() raises
+        # these to (3, 4) = v2 record batches when the broker allows
+        self.produce_version = 0
+        self.fetch_version = 0
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -137,11 +296,12 @@ class KafkaWireClient:
             self._sock.close()
             self._sock = None
 
-    def _roundtrip(self, api_key: int, body: bytes) -> _Reader:
+    def _roundtrip(self, api_key: int, body: bytes,
+                   api_version: int = 0) -> _Reader:
         with self._lock:
             self._corr += 1
             corr = self._corr
-            req = (struct.pack(">hhi", api_key, 0, corr)
+            req = (struct.pack(">hhi", api_key, api_version, corr)
                    + _str(self.client_id) + body)
             try:
                 sock = self._connect()
@@ -174,16 +334,46 @@ class KafkaWireClient:
             buf += chunk
         return buf
 
+    def api_versions(self) -> Dict[int, Tuple[int, int]]:
+        """ApiVersions (api_key 18): {api_key: (min, max)} the broker
+        supports — the capability handshake every modern client starts with."""
+        r = self._roundtrip(_API_VERSIONS, b"")
+        err = r.take("h")
+        if err:
+            raise IOError(f"api_versions error code {err}")
+        out: Dict[int, Tuple[int, int]] = {}
+        for _ in range(r.take("i")):
+            key, lo, hi = r.take("h"), r.take("h"), r.take("h")
+            out[key] = (lo, hi)
+        return out
+
+    def negotiate(self) -> "KafkaWireClient":
+        """Pick the newest mutually supported produce/fetch generation:
+        v2 record batches (Produce 3 / Fetch 4) when the broker allows,
+        legacy message sets otherwise."""
+        versions = self.api_versions()
+        if versions.get(_API_PRODUCE, (0, 0))[1] >= 3:
+            self.produce_version = 3
+        if versions.get(_API_FETCH, (0, 0))[1] >= 4:
+            self.fetch_version = 4
+        return self
+
     def produce(self, topic: str, partition: int,
                 values: List[bytes]) -> int:
-        """Append messages; returns the base offset assigned."""
-        mset = encode_message_set(values)
-        body = (struct.pack(">hi", 1, int(self.timeout * 1000))  # acks=1
-                + struct.pack(">i", 1) + _str(topic)
-                + struct.pack(">i", 1)
-                + struct.pack(">i", partition)
-                + struct.pack(">i", len(mset)) + mset)
-        r = self._roundtrip(_API_PRODUCE, body)
+        """Append messages; returns the base offset assigned.  Encodes a v2
+        RecordBatch after ``negotiate()`` (produce_version 3), a v0 message
+        set otherwise."""
+        v3 = self.produce_version >= 3
+        mset = encode_record_batch(values) if v3 \
+            else encode_message_set(values)
+        body = (struct.pack(">h", -1) if v3 else b"")  # transactional_id
+        body += (struct.pack(">hi", 1, int(self.timeout * 1000))  # acks=1
+                 + struct.pack(">i", 1) + _str(topic)
+                 + struct.pack(">i", 1)
+                 + struct.pack(">i", partition)
+                 + struct.pack(">i", len(mset)) + mset)
+        r = self._roundtrip(_API_PRODUCE, body,
+                            api_version=self.produce_version)
         n_topics = r.take("i")
         assert n_topics == 1
         r.string()
@@ -196,23 +386,43 @@ class KafkaWireClient:
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 1 << 20) -> List[Tuple[int, bytes]]:
-        """[(offset, value)] from ``offset`` onward (may be empty)."""
-        body = (struct.pack(">iii", -1, 100, 0)
-                + struct.pack(">i", 1) + _str(topic)
-                + struct.pack(">i", 1)
-                + struct.pack(">iqi", partition, offset, max_bytes))
-        r = self._roundtrip(_API_FETCH, body)
+        """[(offset, value)] from ``offset`` onward (may be empty).
+        Decodes v2 record batches after ``negotiate()`` (fetch_version 4),
+        v0 message sets otherwise."""
+        v4 = self.fetch_version >= 4
+        body = struct.pack(">iii", -1, 100, 0)
+        if v4:
+            body += struct.pack(">ib", max_bytes, 0)  # max_bytes, read_uncmt
+        body += (struct.pack(">i", 1) + _str(topic)
+                 + struct.pack(">i", 1)
+                 + struct.pack(">iqi", partition, offset, max_bytes))
+        r = self._roundtrip(_API_FETCH, body, api_version=self.fetch_version)
+        if v4:
+            r.take("i")                    # throttle_time_ms
         n_topics = r.take("i")
         assert n_topics == 1
         r.string()
         n_parts = r.take("i")
         assert n_parts == 1
         _part, err, _hw = r.take("i"), r.take("h"), r.take("q")
+        if v4:
+            r.take("q")                    # last_stable_offset
+            n_aborted = r.take("i")
+            for _ in range(max(n_aborted, 0)):
+                r.take("qq")               # producer_id, first_offset
         if err:
             raise IOError(f"fetch error code {err}")
         size = r.take("i")
         mset = r.data[r.off:r.off + size]
-        return decode_message_set(mset)
+        # dispatch on the stored magic byte, not the request version: real
+        # brokers return whatever format the log holds (old segments stay
+        # magic 0/1 even under Fetch v4)
+        records = (decode_record_batches(mset)
+                   if len(mset) > 16 and mset[16] == 2
+                   else decode_message_set(mset))
+        # real brokers return whole batches (indivisible on disk); drop the
+        # records below the requested offset so consumers never see repeats
+        return [(o, v) for o, v in records if o >= offset]
 
 
 # ------------------------------------------------------------------ broker
@@ -235,7 +445,7 @@ class MiniKafkaBroker:
                             return
                         try:
                             resp = outer._dispatch(raw)
-                        except (ValueError, struct.error):
+                        except (ValueError, struct.error, IndexError):
                             # malformed/corrupt request: close the
                             # connection cleanly instead of a traceback
                             return
@@ -274,15 +484,26 @@ class MiniKafkaBroker:
     # -- request dispatch -------------------------------------------------
     def _dispatch(self, raw: bytes) -> bytes:
         r = _Reader(raw)
-        api_key, _ver, corr = r.take("h"), r.take("h"), r.take("i")
+        api_key, ver, corr = r.take("h"), r.take("h"), r.take("i")
         r.string()  # client_id
         if api_key == _API_PRODUCE:
-            return struct.pack(">i", corr) + self._produce(r)
+            return struct.pack(">i", corr) + self._produce(r, ver)
         if api_key == _API_FETCH:
-            return struct.pack(">i", corr) + self._fetch(r)
+            return struct.pack(">i", corr) + self._fetch(r, ver)
+        if api_key == _API_VERSIONS:
+            return struct.pack(">i", corr) + self._api_versions()
         return struct.pack(">i", corr)
 
-    def _produce(self, r: _Reader) -> bytes:
+    @staticmethod
+    def _api_versions() -> bytes:
+        out = struct.pack(">hi", 0, len(_BROKER_API_VERSIONS))
+        for key, (lo, hi) in sorted(_BROKER_API_VERSIONS.items()):
+            out += struct.pack(">hhh", key, lo, hi)
+        return out
+
+    def _produce(self, r: _Reader, ver: int) -> bytes:
+        if ver >= 3:
+            r.string()   # transactional_id (nullable string)
         r.take("h")  # acks
         r.take("i")  # timeout
         out = b""
@@ -298,19 +519,33 @@ class MiniKafkaBroker:
                 size = r.take("i")
                 mset = r.data[r.off:r.off + size]
                 r.off += size
-                values = [v for _, v in decode_message_set(mset)]
+                # sniff the generation from the magic byte (offset 16 in a
+                # v2 batch; offset 16 in a v0 entry is inside the message) —
+                # Kafka brokers key on magic the same way
+                magic = mset[16] if len(mset) > 16 else 0
+                values = ([v for _, v in decode_record_batches(mset)]
+                          if magic == 2
+                          else [v for _, v in decode_message_set(mset)])
                 with self._lock:
                     log = self._logs.setdefault((topic, part), [])
                     base = len(log)
                     log.extend(values)
                 out += struct.pack(">ihq", part, 0, base)
+                if ver >= 2:
+                    out += struct.pack(">q", -1)   # log_append_time
+        if ver >= 1:
+            out += struct.pack(">i", 0)            # throttle_time_ms
         return out
 
-    def _fetch(self, r: _Reader) -> bytes:
+    def _fetch(self, r: _Reader, ver: int) -> bytes:
         r.take("i")  # replica_id
         r.take("i")  # max_wait
         r.take("i")  # min_bytes
-        out = b""
+        if ver >= 3:
+            r.take("i")  # top-level max_bytes
+        if ver >= 4:
+            r.take("b")  # isolation_level
+        out = struct.pack(">i", 0) if ver >= 1 else b""   # throttle_time
         n_topics = r.take("i")
         out += struct.pack(">i", n_topics)
         for _ in range(n_topics):
@@ -326,17 +561,24 @@ class MiniKafkaBroker:
                     tail = log[offset:] if 0 <= offset <= high else None
                 if tail is None:     # Kafka error 1: OFFSET_OUT_OF_RANGE
                     out += struct.pack(">ihq", part, 1, high)
+                    if ver >= 4:
+                        out += struct.pack(">qi", high, 0)
                     out += struct.pack(">i", 0)
                     continue
                 chunk: List[bytes] = []
                 total = 0
                 for v in tail:
-                    total += len(v) + 38
+                    total += len(v) + 70
                     if chunk and total > max_bytes:
                         break
                     chunk.append(v)
-                mset = encode_message_set(chunk, base_offset=offset)
+                mset = (encode_record_batch(chunk, base_offset=offset)
+                        if ver >= 4 and chunk
+                        else encode_message_set(chunk, base_offset=offset)
+                        if chunk else b"")
                 out += struct.pack(">ihq", part, 0, high)
+                if ver >= 4:
+                    out += struct.pack(">qi", high, 0)  # lso, aborted_txns
                 out += struct.pack(">i", len(mset)) + mset
         return out
 
@@ -348,19 +590,34 @@ class NDArrayKafkaClient:
     values; consumption is offset-tracked per client."""
 
     def __init__(self, host: str, port: int, topic: str,
-                 partition: int = 0):
+                 partition: int = 0, negotiate: bool = True):
         self._client = KafkaWireClient(host, port)
         self.topic = topic
         self.partition = partition
         self._offset = 0
+        # lazy: no I/O in the constructor (broker may not be up yet);
+        # first use runs ApiVersions and falls back to the v0 generation
+        # for brokers that don't speak it (pre-0.10 closes the connection)
+        self._want_negotiate = negotiate
+
+    def _ensure_negotiated(self) -> None:
+        if not self._want_negotiate:
+            return
+        self._want_negotiate = False
+        try:
+            self._client.negotiate()
+        except Exception:
+            self._client.close()     # resync; stay on the v0 generation
 
     def publish(self, arr) -> int:
         from .codec import serialize_array
+        self._ensure_negotiated()
         return self._client.produce(self.topic, self.partition,
                                     [serialize_array(arr)])
 
     def publish_all(self, arrays) -> int:
         from .codec import serialize_array
+        self._ensure_negotiated()
         return self._client.produce(self.topic, self.partition,
                                     [serialize_array(a) for a in arrays])
 
@@ -368,6 +625,7 @@ class NDArrayKafkaClient:
         """Arrays appended since the last poll (advances this client's
         offset — the auto-commit consumer role)."""
         from .codec import deserialize_array
+        self._ensure_negotiated()
         msgs = self._client.fetch(self.topic, self.partition, self._offset)
         out = []
         for off, val in msgs[:max_items]:
