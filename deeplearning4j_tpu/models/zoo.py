@@ -82,8 +82,11 @@ class ZooModel:
         raise NotImplementedError
 
     def pretrained(self, weights_path: Optional[str] = None):
-        """Load pretrained weights from a local checkpoint zip (the
-        reference downloads + checksums; this environment has no egress)."""
+        """Load pretrained weights (reference ``ZooModel.java:40-81``
+        downloads + checksums; this environment has no egress, so the
+        artifact is local).  Accepts a native checkpoint zip OR a Keras
+        HDF5 file — the latter routes through the import bridge and
+        transplants the weights into this zoo architecture."""
         path = weights_path or os.environ.get("DL4J_TPU_PRETRAINED_DIR")
         if not path:
             raise FileNotFoundError(
@@ -93,13 +96,81 @@ class ZooModel:
         from ..utils import model_serializer
         if os.path.isdir(path):
             path = os.path.join(path, f"{type(self).__name__.lower()}.zip")
+        with open(path, "rb") as f:
+            magic = f.read(4)
+        if magic == b"\x89HDF":
+            return self.import_pretrained(path)
         return model_serializer.restore_model(path)
+
+    def import_pretrained(self, keras_path: str):
+        """Keras-HDF5 → zoo-architecture weight transplant (the weights-
+        import bridge standing in for ``ZooModel.java``'s downloads): the
+        file is imported through the Keras bridge and its parameters are
+        grafted layer-for-layer onto this zoo model's own graph (so updater
+        / dtype / config settings stay the zoo's)."""
+        from ..modelimport.keras import import_keras_model
+        imported = import_keras_model(keras_path)
+        target = self.init()
+        _transplant_params(imported, target,
+                           what=f"{type(self).__name__} <- {keras_path}")
+        return target
 
     def _builder(self):
         b = NeuralNetConfiguration.builder().seed(self.seed)
         if self.compute_dtype:
             b = b.compute_dtype(self.compute_dtype)
         return b
+
+
+def _ordered_stateful_keys(model):
+    """Keys of layers/vertices carrying params or state, in execution
+    order: topological order for ComputationGraphs, layer index for
+    MultiLayerNetworks."""
+    has = {k for k, v in model.params.items() if v}
+    has |= {k for k, v in getattr(model, "state", {}).items() if v}
+    order = getattr(model.conf, "topological_order", None)
+    if order:
+        return [k for k in order if k in has]
+    return sorted(has, key=lambda k: int(k.split("_")[-1]))
+
+
+def _transplant_params(src, dst, what: str = "") -> None:
+    """Copy parameters and state (e.g. BN running stats) from ``src`` onto
+    ``dst`` by execution order, with shape checks — mismatches raise with
+    the offending layer named rather than silently truncating.  Params and
+    state ride the SAME layer pairing so a source layer missing optional
+    state can never shift later layers' running stats onto the wrong
+    target (state names absent on one side keep the target's values)."""
+    import jax.numpy as jnp
+
+    src_layers = _ordered_stateful_keys(src)
+    dst_layers = _ordered_stateful_keys(dst)
+    if len(src_layers) != len(dst_layers):
+        raise ValueError(
+            f"transplant {what}: source has {len(src_layers)} "
+            f"param/state-bearing layers, target {len(dst_layers)} — "
+            "architectures differ")
+    for sk, dk in zip(src_layers, dst_layers):
+        sp, dp = src.params.get(sk) or {}, dst.params.get(dk) or {}
+        if set(sp) != set(dp):
+            raise ValueError(f"transplant {what}: layer {dk} params "
+                             f"{sorted(dp)} != source {sorted(sp)}")
+        for name in sp:
+            if tuple(sp[name].shape) != tuple(dp[name].shape):
+                raise ValueError(
+                    f"transplant {what}: {dk}.{name} shape "
+                    f"{tuple(dp[name].shape)} != source "
+                    f"{tuple(sp[name].shape)}")
+            dp[name] = jnp.asarray(sp[name], dp[name].dtype)
+        ss, ds = src.state.get(sk) or {}, dst.state.get(dk) or {}
+        for name, val in ss.items():
+            if name not in ds:
+                continue              # optional state the target lacks
+            if tuple(val.shape) != tuple(ds[name].shape):
+                raise ValueError(
+                    f"transplant {what}: {dk} state '{name}' shape "
+                    f"{tuple(ds[name].shape)} != source {tuple(val.shape)}")
+            ds[name] = jnp.asarray(val, ds[name].dtype)
 
 
 @dataclass

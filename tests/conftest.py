@@ -60,6 +60,7 @@ _SLOW_TESTS = {
     "test_mp_parameter_averaging_trains",
     "test_mp_shared_gradients_trains_and_exchanges",
     "test_mp_evaluate_and_score_match_local",
+    "test_pretrained_keras_weights_bridge",
 }
 
 
