@@ -235,6 +235,24 @@ class SequenceVectors(WordVectors):
         """Vocab indices of a sequence's labels (int64, possibly empty)."""
         return np.zeros(0, dtype=np.int64)
 
+    def _raw_sentences(self):
+        """Raw sentence strings when tokenization is exactly ``str.split``
+        (enables the native corpus indexer); None otherwise."""
+        return None
+
+    def _try_native_index(self, index_map):
+        """Per-sentence int32 index arrays via the C++ corpus indexer
+        (``native_src.cpp dl4j_index_corpus`` — the DataVec/libnd4j
+        data-loader role), or None to use the Python path.  Tokenization
+        semantics are identical by construction (str.split only; Unicode
+        whitespace bails out) — the bulk-emission equivalence oracle pins
+        this.  ``index_map``: the caller's vocab map (O(V) to rebuild)."""
+        raw = self._raw_sentences()
+        if raw is None:
+            return None
+        from ..utils import native
+        return native.index_corpus(raw, index_map)
+
     # -- vocab + weights -----------------------------------------------------
     def build_vocab(self, extra_labels: Sequence[str] = ()) -> None:
         ctor = VocabConstructor(self.min_word_frequency)
@@ -631,20 +649,27 @@ class SequenceVectors(WordVectors):
             if cache is not None and epoch > 0:
                 source = cache
             else:
-                def _index():
-                    g = index_map.get
-                    for seq_idx, seq in enumerate(self._sequences()):
-                        arr = np.fromiter((g(t, -1) for t in seq), np.int32,
-                                          count=len(seq))
-                        arr = arr[arr >= 0]
-                        if not arr.size:
-                            continue
-                        lab = np.full(L, -1, dtype=np.int64)
-                        if L:
-                            li = self._label_indices(seq_idx)[:L]
-                            lab[:len(li)] = li
-                        yield arr, lab
-                source = _index()
+                native_arrs = (self._try_native_index(index_map)
+                               if L == 0 else None)
+                if native_arrs is not None:
+                    lab0 = np.full(0, -1, dtype=np.int64)
+                    # same empty-sentence skip as the Python path below
+                    source = ((a, lab0) for a in native_arrs if a.size)
+                else:
+                    def _index():
+                        g = index_map.get
+                        for seq_idx, seq in enumerate(self._sequences()):
+                            arr = np.fromiter((g(t, -1) for t in seq),
+                                              np.int32, count=len(seq))
+                            arr = arr[arr >= 0]
+                            if not arr.size:
+                                continue
+                            lab = np.full(L, -1, dtype=np.int64)
+                            if L:
+                                li = self._label_indices(seq_idx)[:L]
+                                lab[:len(li)] = li
+                            yield arr, lab
+                    source = _index()
             # chunk buffers
             buf_i: List = []
             buf_s: List = []

@@ -30,3 +30,16 @@ class Word2Vec(SequenceVectors):
             toks = self.tokenizer_factory.create(sentence).get_tokens()
             if toks:
                 yield toks
+
+    def _raw_sentences(self):
+        """Raw sentence strings for the native corpus indexer — only when
+        tokenization is exactly ``str.split`` (plain DefaultTokenizerFactory,
+        no token or sentence pre-processor), so the native and Python paths
+        cannot tokenize differently."""
+        it = self.sentence_iterator
+        if (type(self.tokenizer_factory) is DefaultTokenizerFactory
+                and self.tokenizer_factory._pre is None
+                and type(it) is CollectionSentenceIterator
+                and it.pre_processor is None):
+            return it._sentences
+        return None

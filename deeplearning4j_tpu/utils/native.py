@@ -20,7 +20,7 @@ import numpy as np
 
 __all__ = ["available", "threshold_encode_native", "threshold_decode_native",
            "bitmap_encode_native", "bitmap_decode_native", "decode_cifar",
-           "u8_to_f32", "parse_csv"]
+           "u8_to_f32", "parse_csv", "index_corpus"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -38,6 +38,7 @@ _SO = _BUILD_DIR / "libdl4j_tpu_native.so"
 _i8 = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
 _u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 _i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 
@@ -114,6 +115,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.dl4j_parse_csv.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, _f32,
         ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+    lib.dl4j_index_corpus.restype = ctypes.c_int64
+    lib.dl4j_index_corpus.argtypes = [
+        ctypes.c_char_p, _i64, ctypes.c_int64, ctypes.c_char_p,
+        ctypes.c_int64, _i32, ctypes.c_int64, _i64]
 
 
 def available() -> bool:
@@ -248,3 +253,41 @@ def parse_csv(text: bytes, delimiter: str = ",") -> np.ndarray:
     rows = [r for r in text.decode().splitlines() if r.strip()]
     return np.asarray([[float(v) for v in r.split(delimiter)] for r in rows],
                       np.float32)
+
+
+def index_corpus(sentences, index_map):
+    """Tokenize + vocab-index ``sentences`` (list of str) natively — the
+    data-loader role the reference delegates to DataVec/libnd4j.  Returns a
+    list of per-sentence int32 index arrays (views into one buffer, OOV
+    dropped), or None when the native library is unavailable or the text
+    uses Unicode whitespace (where str.split semantics require the Python
+    path).  Token semantics are EXACTLY ``str.split()`` — the bulk-emission
+    equivalence oracle in test_nlp pins this.
+    """
+    lib = _load()
+    if lib is None or not index_map:
+        return None
+    try:
+        parts = [s.encode() for s in sentences]
+    except UnicodeEncodeError:
+        return None   # lone surrogates (surrogateescape text): Python path
+    offsets = np.zeros(len(parts) + 1, np.int64)
+    np.cumsum([len(b) for b in parts], out=offsets[1:])
+    text = b"".join(parts)
+    words = [None] * len(index_map)
+    for w, i in index_map.items():
+        if not 0 <= i < len(words) or words[i] is not None:
+            return None          # non-contiguous index space: Python path
+        words[i] = w
+    blob = "\n".join(words).encode()
+    # worst case one token per 2 bytes WITHIN a sentence, but sentence
+    # boundaries consume no separator byte — hence the +n_sent term
+    cap = max((len(text) + len(parts)) // 2 + 16, 64)
+    out_idx = np.empty(cap, np.int32)
+    out_counts = np.zeros(len(parts), np.int64)
+    total = lib.dl4j_index_corpus(text, offsets, len(parts), blob,
+                                  len(blob), out_idx, cap, out_counts)
+    if total < 0:
+        return None              # unicode whitespace: fall back
+    flat = out_idx[:total]
+    return np.split(flat, np.cumsum(out_counts)[:-1].astype(np.int64))

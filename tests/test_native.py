@@ -167,3 +167,69 @@ class TestHostEncodingHandler:
         from deeplearning4j_tpu.parallel.accumulation import EncodingHandler
         with pytest.raises(ValueError, match="backend"):
             EncodingHandler(backend="gpu")
+
+
+class TestCorpusIndexer:
+    """dl4j_index_corpus — the DataVec/libnd4j data-loader role: tokenize +
+    vocab-index natively with EXACT str.split semantics (the bulk-emission
+    oracle in test_nlp additionally pins end-to-end training equivalence)."""
+
+    VOCAB = {"the": 0, "quick": 1, "brown": 2, "fox": 3, "jumps": 4,
+             "over": 5, "lazy": 6, "dog": 7}
+
+    def test_matches_str_split_semantics(self):
+        from deeplearning4j_tpu.utils import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+        sentences = ["the quick brown fox", "jumps over  the lazy dog",
+                     "", "   ", "oov words here the", "\tthe\nquick\r"]
+        arrs = native.index_corpus(sentences, self.VOCAB)
+        assert arrs is not None
+        g = self.VOCAB.get
+        for a, s in zip(arrs, sentences):
+            expect = [g(t) for t in s.split() if g(t) is not None]
+            assert a.tolist() == expect, (s, a.tolist(), expect)
+
+    def test_unicode_whitespace_bails_to_python(self):
+        from deeplearning4j_tpu.utils import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+        # ideographic space U+3000 and NBSP are str.split separators the
+        # native path must refuse rather than mis-tokenize
+        assert native.index_corpus(["a　b"], self.VOCAB) is None
+        assert native.index_corpus(["a b"], self.VOCAB) is None
+        # ordinary multibyte text without unicode spaces is fine
+        arrs = native.index_corpus(["the 快 fox"], self.VOCAB)
+        assert arrs is not None and arrs[0].tolist() == [0, 3]
+
+    def test_word2vec_training_identical_across_paths(self, monkeypatch):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.nlp import sequence_vectors as SV
+        from deeplearning4j_tpu.utils import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+        sents = ["the quick brown fox jumps", "over the lazy dog the fox"] * 30
+
+        def fit(native_on):
+            w = Word2Vec(sentences=sents, layer_size=16, window=3,
+                         negative=3, epochs=2, seed=5, min_word_frequency=1)
+            if not native_on:
+                monkeypatch.setattr(type(w), "_raw_sentences",
+                                    lambda self: None)
+            w.fit()
+            monkeypatch.undo()
+            return np.asarray(w.lookup_table.syn0)
+
+        used = []
+        orig = SV.SequenceVectors._try_native_index
+
+        def spy(self, index_map):
+            out = orig(self, index_map)
+            used.append(out is not None)
+            return out
+
+        monkeypatch.setattr(SV.SequenceVectors, "_try_native_index", spy)
+        a = fit(True)
+        assert used and used[0], "native path was not taken"
+        b = fit(False)
+        np.testing.assert_array_equal(a, b)
