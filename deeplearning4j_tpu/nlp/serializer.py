@@ -140,14 +140,19 @@ def _assemble(vocab: VocabCache, rows: np.ndarray) -> Word2Vec:
     return model
 
 
-def _sniffed_row_is_text(chunk: bytes) -> bool:
+def _sniffed_row_is_text(chunk: bytes):
     """True when the sniffed first data row parses as ``word v1 v2 ...`` —
     packed float32 bytes can happen to decode as UTF-8, so decodability
     alone must not route to the txt reader.  Float-parsability (not token
     count) is the discriminator: a slightly nonconforming real txt file
     (extra column, missing trailing newline) still routes to the txt reader
     so its errors surface there, instead of read_binary silently loading
-    ASCII digits as packed f32 garbage."""
+    ASCII digits as packed f32 garbage.
+
+    Returns ``None`` (inconclusive) when the window holds no newline and
+    only one value token whose float-parse fails: the token may be cut
+    mid-value (``1e``, ``-``), which says nothing about the format — the
+    caller should widen the window rather than route to read_binary."""
     line, sep, _ = chunk.partition(b"\n")
     toks = line.decode("utf-8", errors="replace").split()
     if len(toks) < 2:
@@ -159,7 +164,7 @@ def _sniffed_row_is_text(chunk: bytes) -> bool:
         for v in vals:
             float(v)
     except ValueError:
-        return False
+        return None if not sep and len(toks) == 2 else False
     return True
 
 
@@ -187,18 +192,26 @@ def load_static_model(path: str) -> Word2Vec:
     if len(parts) == 2 and all(p.isdigit() for p in parts):
         # txt and bin share the header; bin rows are raw little-endian f32
         # after "word " — sniff the second line for utf-8 text
-        with open(path, "rb") as f:
-            f.readline()
-            second = f.read(256)
-        try:
-            second.decode("utf-8")
-            looks_text = True
-        except UnicodeDecodeError as e:
-            # a multi-byte character split at the 256-byte chunk boundary is
-            # still text; only a decode failure in the interior means binary
-            looks_text = e.start >= len(second) - 4
-        if looks_text and _sniffed_row_is_text(second):
-            return read_word_vectors(path)
+        for window in (256, 4096, 1 << 20):
+            with open(path, "rb") as f:
+                f.readline()
+                second = f.read(window)
+            try:
+                second.decode("utf-8")
+                looks_text = True
+            except UnicodeDecodeError as e:
+                # a multi-byte character split at the chunk boundary is
+                # still text; only an interior decode failure means binary
+                looks_text = e.start >= len(second) - 4
+            if not looks_text:
+                return read_binary(path)
+            verdict = _sniffed_row_is_text(second)
+            if verdict is None and len(second) == window:
+                continue          # truncated mid-value: widen the sniff
+            # an inconclusive row that IS the whole file routes to the txt
+            # reader so its parse error surfaces there (see docstring)
+            return (read_word_vectors(path) if verdict is not False
+                    else read_binary(path))
         return read_binary(path)
     if "," in text:
         return read_csv(path)

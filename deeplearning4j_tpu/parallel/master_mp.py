@@ -12,8 +12,36 @@ executor-JVM topology (``ParameterAveragingTrainingMaster.java:62``,
   dense frame, then waits for the master's averaged frame — a synchronous
   parameter-averaging barrier across processes.
 - **shared**: workers exchange threshold-quantized param-updates peer-to-peer
-  through ``RemoteGradientSharing`` (the SilentUpdatesMessage wire format) —
-  no barrier; the master collects worker 0's final table.
+  through ``RemoteGradientSharing`` (the SilentUpdatesMessage wire format).
+  Arrival is explicit, never timed (the ``SharedTrainingWrapper.java:48``
+  registration posture): every subscription is hub-acked, a ready/go
+  barrier gates the first publish, and completion is a drain barrier —
+  each worker declares its sent-count on a flush topic — together with a
+  dense end-of-job residual frame (the quantizer's undelivered remainder)
+  — and peers drain until per-sender applied counts reach the declared
+  counts and all residuals are in.  Every final table then equals
+  init + Σ(all workers' exact deltas); the master asserts inter-worker
+  agreement within a float-noise tolerance and installs the mean.
+
+**Task retry** mirrors Spark's RDD-lineage re-execution
+(``ParameterAveragingTrainingMaster.java:62``: a lost partition is simply
+recomputed from the broadcast parameters): when a worker process exits
+without delivering its contribution — any exit code; rc==0 without a
+result is just as dead — the master respawns it with a resume spec:
+
+- averaging: restart at the current round from the last averaged frame
+  (exactly the broadcast-params re-execution contract);
+- shared: re-execute the full shard via a RESYNC handshake — the
+  replacement subscribes (hub-acked) first, then asks the master for a
+  seed built from its mirror (init + every quantized update seen, plus
+  folded residuals and per-sender sequence counts).  Per-sender FIFO +
+  sequence numbers make the seed/subscription overlap dedup exactly: no
+  update is lost or double-applied.  Semantically the retry is still
+  *at-least-once* over BATCHES (the dead incarnation's transmitted
+  updates stay in everyone's tables and the replacement re-trains the
+  whole shard), so the final-table agreement assertion is waived for the
+  run and recorded in ``last_table_spread = None``.
+- evaluate/score: stateless — the shard is simply re-executed.
 
 ``evaluate`` / ``score`` fan the dataset out over worker processes which
 return partial ``Evaluation`` JSON / loss sums for the master to merge
@@ -34,7 +62,7 @@ import struct
 import subprocess
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -45,6 +73,11 @@ _DOWN = "mp.down"      # master -> workers averaged frame
 _FINAL = "mp.final"    # shared mode: final tables
 _DONE = "mp.done"      # per-worker result json
 _GRADS = "mp.grads"    # shared mode: quantized updates (RemoteGradientSharing)
+_READY = "mp.ready"    # shared mode: worker subscriptions are hub-acked
+_GO = "mp.go"          # shared mode: master saw N readies — publishing may start
+_FLUSH = "mp.flush"    # shared mode: per-worker declared sent-counts
+_RESID = "mp.resid"    # shared mode: dense end-of-job residual flush
+_SEED = "mp.seed"      # shared mode: master -> respawned worker resync seed
 
 
 def _encode_frame(wid: int, rnd: int, vec: np.ndarray) -> bytes:
@@ -98,12 +131,24 @@ class MultiprocessMaster:
     (SharedGradients / quantized peer-to-peer contract).
     ``worker_env``: extra env vars for workers (the test rig passes
     ``JAX_PLATFORMS=cpu``; production hosts would pass their chip topology).
+    ``max_task_retries``: per-worker respawn budget before the job fails
+    (the Spark task-retry knob; re-execution semantics in the module doc).
+    ``fault_injection``: test-only hook — keys ``die_before_publish``
+    (averaging, {wid: round}), ``die_after_batches`` (shared, {wid: k}),
+    ``die_at_start`` (evaluate/score, [wid]), ``die_before_done`` /
+    ``exit_nonzero_after_done`` ([wid]), ``slow_start`` ({wid: seconds})
+    — applied only to a worker's first incarnation.
     """
+
+    _DEAD_GRACE = 2.0   # seconds a dead worker's in-flight message may lag
 
     def __init__(self, num_workers: int = 2, mode: str = "averaging",
                  averaging_frequency: int = 5, average_updaters: bool = True,
                  threshold: float = 1e-3, timeout: float = 300.0,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 max_task_retries: int = 2,
+                 agreement_tol: float = 1e-3,
+                 fault_injection: Optional[Dict[str, Any]] = None):
         if mode not in ("averaging", "shared"):
             raise ValueError(f"unknown mode {mode!r}")
         self.num_workers = num_workers
@@ -113,69 +158,108 @@ class MultiprocessMaster:
         self.threshold = threshold
         self.timeout = timeout
         self.worker_env = dict(worker_env or {})
+        self.max_task_retries = max_task_retries
+        self.agreement_tol = agreement_tol
+        self.fault_injection = dict(fault_injection or {})
         self.last_results: List[Dict[str, Any]] = []
+        self.retried_workers: set = set()
+        self.last_table_spread: Optional[float] = None
 
     # -- plumbing ------------------------------------------------------------
-    def _spawn(self, jobdir: str, wid: int, port: int) -> subprocess.Popen:
+    def _spawn(self, jobdir: str, wid: int, port: int,
+               resume_file: Optional[str] = None) -> subprocess.Popen:
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root   # drops any TPU sitecustomize hook
+        # prepend, never replace, so user-supplied PYTHONPATH dependencies
+        # stay importable — EXCEPT entries that inject a sitecustomize
+        # interpreter hook: a host hook re-run per worker (e.g. a TPU PJRT
+        # relay session claim) breaks worker device pinning, so those are
+        # deliberately dropped.  worker_env may still override wholesale.
+        prev = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and not os.path.exists(
+                    os.path.join(p, "sitecustomize.py"))
+                and not os.path.isdir(os.path.join(p, "sitecustomize"))]
+        env["PYTHONPATH"] = os.pathsep.join([pkg_root] + prev)
         env.update(self.worker_env)
-        log = open(os.path.join(jobdir, f"worker_{wid}.log"), "w")
-        p = subprocess.Popen(
-            [sys.executable, "-m", "deeplearning4j_tpu.parallel.master_mp",
-             jobdir, str(wid), str(port)],
-            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log = open(os.path.join(jobdir, f"worker_{wid}.log"), "a")
+        argv = [sys.executable, "-m", "deeplearning4j_tpu.parallel.master_mp",
+                jobdir, str(wid), str(port)]
+        if resume_file:
+            argv.append(resume_file)
+        p = subprocess.Popen(argv, env=env, stdout=log,
+                             stderr=subprocess.STDOUT)
         p._logfile = log
         return p
 
     def _run_job(self, model, jobdir: str, spec: Dict[str, Any],
-                 setup, run):
+                 setup, run,
+                 resume_payload: Optional[
+                     Callable[[int], Tuple[Dict[str, Any],
+                                           Optional[np.ndarray]]]] = None):
         """Write the job, serve the broker, create master-side subscriptions
         (``setup`` — BEFORE any worker can publish, the broker retains
         nothing), spawn workers, run the master protocol (``run``), join
-        workers, return its result."""
+        workers, return its result.  ``resume_payload(wid)`` builds the
+        (resume-spec, frame) a respawned worker restarts from."""
         from ..streaming.broker import TcpMessageBroker
         from ..utils import model_serializer
 
         model_serializer.write_model(model, os.path.join(jobdir, "model.zip"))
-        broker = TcpMessageBroker().serve()
+        # max_queue=0: the master protocol is a reliable transport (the
+        # Aeron role) — exact-count drain barriers need lossless delivery;
+        # memory is bounded by job size
+        broker = TcpMessageBroker(max_queue=0).serve()
         spec = dict(spec, port=broker.port, num_workers=self.num_workers,
                     averaging_frequency=self.averaging_frequency,
                     average_updaters=self.average_updaters,
-                    threshold=self.threshold, timeout=self.timeout)
+                    threshold=self.threshold, timeout=self.timeout,
+                    fault=self.fault_injection)
         with open(os.path.join(jobdir, "spec.json"), "w") as f:
             json.dump(spec, f)
         done_sub = broker.subscribe(_DONE)
         subs = setup(broker)
-        procs = [self._spawn(jobdir, w, broker.port)
-                 for w in range(self.num_workers)]
-        self._procs = procs
+        self._port = broker.port
+        self._resume_payload = resume_payload
+        self._retries: Dict[int, int] = {}
+        self._dead_since: Dict[int, float] = {}
+        self.retried_workers = set()
+        self._procs: Dict[int, subprocess.Popen] = {
+            w: self._spawn(jobdir, w, broker.port)
+            for w in range(self.num_workers)}
         try:
             out = run(broker, subs)
+            if spec["task"] == "fit":
+                # every fit contribution is in; a worker respawned from
+                # here on only needs to report (for evaluate/score the
+                # _DONE message IS the contribution — full re-execution)
+                self._resume_payload = \
+                    lambda wid: ({"skip_to_done": True}, None)
             results: Dict[int, Dict[str, Any]] = {}
             deadline = time.time() + self.timeout
             while len(results) < self.num_workers:
-                payload = done_sub.poll(timeout=1.0)
+                payload = done_sub.poll(timeout=0.25)
                 if payload is not None:
                     r = json.loads(payload.decode())
                     results[int(r["wid"])] = r
                     continue
-                self._check_liveness(jobdir)
+                if self._check_liveness(jobdir, satisfied=results.keys()):
+                    deadline = time.time() + self.timeout
                 if time.time() > deadline:
                     raise RuntimeError(
                         "workers did not report: "
                         + self._logs_tail(jobdir))
-            for w, p in enumerate(procs):
+            for w, p in self._procs.items():
                 rc = p.wait(timeout=30)
                 if rc != 0:
-                    raise RuntimeError(f"worker {w} rc={rc}: "
-                                       + self._logs_tail(jobdir))
+                    # its contribution was already received (the results
+                    # loop completed), so a teardown crash doesn't fail
+                    # the job — record it for the caller instead
+                    results[w]["exit_code"] = rc
             self.last_results = [results[w] for w in range(self.num_workers)]
             return out
         finally:
-            for p in procs:
+            for p in self._procs.values():
                 if p.poll() is None:
                     p.kill()
                 p._logfile.close()
@@ -190,29 +274,106 @@ class MultiprocessMaster:
                     outs.append(f"[worker {w}] " + f.read()[-2000:])
         return "\n".join(outs)
 
-    def _check_liveness(self, jobdir: str) -> None:
-        """Fail fast when a worker is already dead instead of burning the
-        full collection timeout."""
-        for w, p in enumerate(getattr(self, "_procs", ())):
-            rc = p.poll()
-            if rc is not None and rc != 0:
-                raise RuntimeError(f"worker {w} died (rc={rc}): "
-                                   + self._logs_tail(jobdir))
-
-    def _collect(self, sub, want: int, what: str, jobdir: str):
-        frames: Dict[int, np.ndarray] = {}
-        deadline = time.time() + self.timeout
-        while len(frames) < want:
-            payload = sub.poll(timeout=1.0)
-            if payload is not None:
-                wid, _, vec = _decode_frame(payload)
-                frames[wid] = vec
+    def _check_liveness(self, jobdir: str, satisfied=()) -> bool:
+        """Respawn workers that exited — ANY exit code — without delivering
+        the contribution the current phase is collecting (``satisfied``).
+        A short grace window lets a just-published in-flight message land
+        before the respawn triggers.  Returns True when someone was
+        respawned (callers extend their deadline: the replacement redoes
+        work)."""
+        respawned = False
+        now = time.time()
+        for wid, p in list(self._procs.items()):
+            if p.poll() is None or wid in satisfied:
+                self._dead_since.pop(wid, None)
                 continue
-            self._check_liveness(jobdir)
+            first = self._dead_since.setdefault(wid, now)
+            if now - first < self._DEAD_GRACE:
+                continue
+            self._dead_since.pop(wid, None)
+            self._respawn(wid, jobdir)
+            respawned = True
+        return respawned
+
+    def _respawn(self, wid: int, jobdir: str) -> None:
+        n = self._retries.get(wid, 0) + 1
+        if n > self.max_task_retries:
+            raise RuntimeError(
+                f"worker {wid} failed after {n - 1} retries: "
+                + self._logs_tail(jobdir))
+        self._retries[wid] = n
+        self.retried_workers.add(wid)
+        old = self._procs[wid]
+        if old.poll() is None:
+            old.kill()
+        old._logfile.close()
+        resume, frame = (self._resume_payload(wid)
+                         if self._resume_payload else ({}, None))
+        resume = dict(resume)
+        if frame is not None:
+            fnpy = os.path.join(jobdir, f"resume_{wid}_{n}.npy")
+            np.save(fnpy, np.asarray(frame))
+            resume["frame"] = fnpy
+        rf = os.path.join(jobdir, f"resume_{wid}_{n}.json")
+        with open(rf, "w") as f:
+            json.dump(resume, f)
+        self._procs[wid] = self._spawn(jobdir, wid, self._port,
+                                       resume_file=rf)
+
+    def _collect_loop(self, sub, want: int, what: str, jobdir: str,
+                      decode_fn,
+                      on_idle: Optional[Callable[[], None]] = None):
+        """One collection loop for every phase: poll, decode (``decode_fn``
+        returns ``(wid, value)`` or ``(None, None)`` to skip stale
+        payloads), run ``on_idle`` between polls, respawn dead workers
+        (extending the deadline — the replacement redoes work)."""
+        got: Dict[int, Any] = {}
+        deadline = time.time() + self.timeout
+        while len(got) < want:
+            payload = sub.poll(timeout=0.25)
+            if payload is not None:
+                wid, value = decode_fn(payload)
+                if wid is not None:
+                    got[wid] = value
+                continue
+            if on_idle is not None:
+                on_idle()
+            if self._check_liveness(jobdir, satisfied=got.keys()):
+                deadline = time.time() + self.timeout
             if time.time() > deadline:
                 raise RuntimeError(f"timed out collecting {what}: "
                                    + self._logs_tail(jobdir))
-        return frames
+        return got
+
+    def _collect(self, sub, want: int, what: str, jobdir: str,
+                 rnd: Optional[int] = None,
+                 on_idle: Optional[Callable[[], None]] = None):
+        """Collect one dense frame per worker; ``rnd`` filters stale frames
+        from pre-respawn incarnations."""
+        def decode_fn(payload):
+            wid, got_rnd, vec = _decode_frame(payload)
+            if rnd is not None and got_rnd != rnd:
+                return None, None
+            return wid, vec
+        return self._collect_loop(sub, want, what, jobdir, decode_fn,
+                                  on_idle)
+
+    def _collect_json(self, sub, what: str, jobdir: str,
+                      on_idle: Optional[Callable[[], None]] = None,
+                      sink: Optional[Callable[[int, Dict[str, Any]],
+                                              None]] = None
+                      ) -> Dict[int, Dict[str, Any]]:
+        """Collect one small JSON message per worker (ready / flush);
+        ``sink`` observes each message as it lands (the shared master
+        mirrors flush declarations for resync seeds)."""
+        def decode_fn(payload):
+            d = json.loads(payload.decode())
+            wid = int(d["wid"])
+            if sink is not None:
+                sink(wid, d)
+            return wid, d
+        return self._collect_loop(sub, self.num_workers, what, jobdir,
+                                  decode_fn, on_idle)
 
     def _prepare_jobdir(self, iterator, jobdir: Optional[str]):
         """Materialize the job directory + per-worker shards (shared by the
@@ -233,38 +394,172 @@ class MultiprocessMaster:
         jobdir, parts = self._prepare_jobdir(iterator, jobdir)
         n_rounds = (max((len(p) for p in parts), default=0)
                     + self.averaging_frequency - 1) // self.averaging_frequency
-        _, meta = _ravel(model, self.average_updaters
-                         and self.mode == "averaging")
+        with_opt = self.average_updaters and self.mode == "averaging"
+        vec0, meta = _ravel(model, with_opt)
 
-        def setup(broker):
-            return broker.subscribe(
-                _UP if self.mode == "averaging" else _FINAL)
-
-        def run(broker, sub):
-            if self.mode == "averaging":
-                last = None
-                for rnd in range(n_rounds):
-                    frames = self._collect(sub, self.num_workers,
-                                           f"round {rnd}", jobdir)
-                    last = np.mean([frames[w] for w in sorted(frames)],
-                                   axis=0)
-                    broker.publish(_DOWN, _encode_frame(-1, rnd, last))
-                return last
-            frames = self._collect(sub, self.num_workers, "final tables",
-                                   jobdir)
-            return frames[0]   # worker 0's table IS the model (no master copy)
-
-        spec = {"task": "fit", "mode": self.mode, "n_rounds": n_rounds}
-        vec = self._run_job(model, jobdir, spec, setup, run)
+        if self.mode == "averaging":
+            vec = self._fit_averaging(model, jobdir, n_rounds,
+                                      np.asarray(vec0))
+        else:
+            vec = self._fit_shared(model, jobdir, np.asarray(vec0))
         if vec is not None:
             _unravel_into(model, vec, meta)
+
+    def _fit_averaging(self, model, jobdir: str, n_rounds: int,
+                       vec0: np.ndarray):
+        state = {"rnd": 0, "last": vec0}
+
+        def resume_payload(wid):
+            # re-execution from the broadcast params: the respawned worker
+            # restarts at the round being collected, seeded with the last
+            # averaged frame (round 0: the initial model)
+            return {"start_round": state["rnd"]}, state["last"]
+
+        def run(broker, sub):
+            last = None
+            for rnd in range(n_rounds):
+                state["rnd"] = rnd
+                frames = self._collect(sub, self.num_workers,
+                                       f"round {rnd}", jobdir, rnd=rnd)
+                last = np.mean([frames[w] for w in sorted(frames)], axis=0)
+                state["last"] = last
+                broker.publish(_DOWN, _encode_frame(-1, rnd, last))
+            # a crash between the last barrier and the _DONE report is
+            # handled by _run_job's skip_to_done resume swap
+            return last
+
+        spec = {"task": "fit", "mode": "averaging", "n_rounds": n_rounds}
+        return self._run_job(model, jobdir, spec,
+                             lambda broker: broker.subscribe(_UP),
+                             run, resume_payload)
+
+    def _fit_shared(self, model, jobdir: str, vec0: np.ndarray):
+        from .accumulation import decode as _decode_update
+        from .remote import decode_message_bytes
+
+        state: Dict[str, Any] = {
+            "go": False, "broker": None,
+            "mirror": vec0.copy(),      # init + every quantized update seen
+            "mirror_counts": {},        # per-sender updates in the mirror
+            "resid_sum": np.zeros_like(vec0),
+            "resid_wids": set(),        # whose residuals resid_sum holds
+            "declared": {},             # flush declarations seen so far
+            "grads_sub": None, "resid_sub": None, "ready_sub": None,
+            "seed_n": 0,
+        }
+
+        def drain_mirror():
+            while True:
+                payload = state["grads_sub"].poll(timeout=0.001)
+                if payload is None:
+                    break
+                sender, seq, msg = decode_message_bytes(payload)
+                state["mirror"] = state["mirror"] + np.asarray(
+                    _decode_update(msg))
+                # per-sender FIFO (one publisher connection) makes seqs
+                # arrive dense and in order: the highest seen == the count
+                # folded into the mirror, which seeds exact dedup
+                state["mirror_counts"][sender] = max(
+                    state["mirror_counts"].get(sender, 0), seq)
+            while True:
+                payload = state["resid_sub"].poll(timeout=0.001)
+                if payload is None:
+                    break
+                r_wid, _, vec = _decode_frame(payload)
+                if r_wid not in state["resid_wids"]:
+                    state["resid_wids"].add(r_wid)
+                    state["resid_sum"] = state["resid_sum"] + vec
+
+        def serve_resyncs():
+            """Answer a respawned worker's resync request with a seed:
+            mirror + folded residuals, plus the per-sender bookkeeping the
+            replacement needs to run an exact drain barrier (module doc).
+            The replacement subscribed (hub-acked) BEFORE requesting, so
+            everything published after the seed snapshot reaches it
+            directly; sequence numbers dedup the overlap exactly."""
+            while True:
+                payload = state["ready_sub"].poll(timeout=0.001)
+                if payload is None:
+                    return
+                d = json.loads(payload.decode())
+                if not d.get("resync"):
+                    continue     # stale pre-go READY from a dead worker
+                drain_mirror()
+                w = int(d["wid"])
+                state["seed_n"] += 1
+                seed_file = os.path.join(
+                    jobdir, f"seed_{w}_{state['seed_n']}.npy")
+                np.save(seed_file, state["mirror"] + state["resid_sum"])
+                meta = {"wid": w, "file": seed_file,
+                        "resid_wids": sorted(state["resid_wids"]),
+                        "prior_sent": state["mirror_counts"].get(w, 0),
+                        "declared": {str(k): v for k, v
+                                     in state["declared"].items()},
+                        "mirror_counts": {str(k): v for k, v
+                                          in state["mirror_counts"].items()}}
+                state["broker"].publish(_SEED, json.dumps(meta).encode())
+
+        def on_idle():
+            drain_mirror()
+            serve_resyncs()
+
+        def resume_payload(wid):
+            # pre-go death: nothing was published — a clean restart.
+            # post-go death: the replacement bootstraps via resync, so no
+            # frame is shipped at spawn time (it would already be stale).
+            return ({"restart": True, "go_done": state["go"]}, None)
+
+        def setup(broker):
+            state["broker"] = broker
+            state["grads_sub"] = broker.subscribe(_GRADS, ack=True)
+            state["resid_sub"] = broker.subscribe(_RESID, ack=True)
+            state["ready_sub"] = broker.subscribe(_READY)
+            return (broker.subscribe(_FLUSH), broker.subscribe(_FINAL))
+
+        def run(broker, subs):
+            flush_sub, final_sub = subs
+            self._collect_json(state["ready_sub"], "ready barrier", jobdir)
+            broker.publish(_GO, b"go")
+            state["go"] = True
+
+            def flush_sink(wid, d):
+                state["declared"][wid] = int(d["sent"])
+            declared = self._collect_json(flush_sub, "flush counts", jobdir,
+                                          on_idle=on_idle, sink=flush_sink)
+            finals = self._collect(final_sub, self.num_workers,
+                                   "final tables", jobdir,
+                                   on_idle=on_idle)
+            tables = np.stack([finals[w] for w in sorted(finals)])
+            if not self.retried_workers:
+                # after a clean drain + dense residual flush every table is
+                # init + Σ(all exact deltas); remaining spread is float32
+                # summation-order noise, so the bound is tight
+                del declared  # counts were the barrier, not the check
+                spread = float(np.max(tables.max(axis=0) - tables.min(axis=0))
+                               ) if len(tables) > 1 else 0.0
+                if spread > self.agreement_tol:
+                    raise RuntimeError(
+                        f"shared-mode final tables diverge: spread "
+                        f"{spread:.3e} > agreement_tol "
+                        f"{self.agreement_tol:.3e}")
+                self.last_table_spread = spread
+            else:
+                # at-least-once re-execution re-applied updates; agreement
+                # is waived for the run (module doc)
+                self.last_table_spread = None
+            return tables.mean(axis=0)
+
+        spec = {"task": "fit", "mode": "shared"}
+        return self._run_job(model, jobdir, spec, setup, run, resume_payload)
 
     # -- evaluation / scoring fan-out ---------------------------------------
     def _fan_out_task(self, model, iterator, task: str,
                       jobdir: Optional[str]):
         jobdir, _ = self._prepare_jobdir(iterator, jobdir)
+        # stateless shards: a respawned worker simply re-executes
         self._run_job(model, jobdir, {"task": task, "mode": self.mode},
-                      lambda broker: None, lambda broker, subs: None)
+                      lambda broker: None, lambda broker, subs: None,
+                      resume_payload=lambda wid: ({}, None))
         return self.last_results
 
     def evaluate(self, model, iterator, jobdir: Optional[str] = None):
@@ -287,28 +582,50 @@ class MultiprocessMaster:
 
 
 # --------------------------------------------------------------------- worker
-def _worker_main(jobdir: str, wid: int, port: int) -> None:
+def _worker_main(jobdir: str, wid: int, port: int,
+                 resume_file: Optional[str] = None) -> None:
     with open(os.path.join(jobdir, "spec.json")) as f:
         spec = json.load(f)
+    resumed = resume_file is not None
+    resume: Dict[str, Any] = {}
+    if resumed:
+        with open(resume_file) as f:
+            resume = json.load(f)
+    fault = {} if resumed else spec.get("fault", {})
+    if fault.get("slow_start", {}).get(str(wid)):
+        time.sleep(float(fault["slow_start"][str(wid)]))
 
     from ..streaming.broker import TcpMessageBroker
     from ..utils import model_serializer
 
     broker = TcpMessageBroker(port=port)    # client endpoints only
+    if resume.get("skip_to_done"):
+        # predecessor crashed after its last fit contribution was
+        # collected; nothing to redo — just report
+        result = {"wid": wid, "steps": 0, "resumed": True, "skipped": True,
+                  "score": None}
+        broker.publish(_DONE, json.dumps(result).encode())
+        return
     model = model_serializer.restore_multi_layer_network(
         os.path.join(jobdir, "model.zip"))
     batches = _load_batches(os.path.join(jobdir, f"shard_{wid}.npz"))
-    result: Dict[str, Any] = {"wid": wid, "steps": 0}
+    result: Dict[str, Any] = {"wid": wid, "steps": 0, "resumed": resumed}
 
     task = spec["task"]
     if task == "fit" and spec["mode"] == "averaging":
-        down = broker.subscribe(_DOWN)      # subscribe BEFORE first publish
+        # hub-acked: registered before the first _UP publish, so the
+        # averaged reply cannot race past this subscription
+        down = broker.subscribe(_DOWN, ack=True)
         _, meta = _ravel(model, spec["average_updaters"])
+        if resume.get("frame"):
+            _unravel_into(model, np.load(resume["frame"]), meta)
         freq = spec["averaging_frequency"]
-        for rnd in range(spec["n_rounds"]):
+        for rnd in range(int(resume.get("start_round", 0)), spec["n_rounds"]):
             for batch in batches[rnd * freq:(rnd + 1) * freq]:
                 model.fit_batch(batch)
                 result["steps"] += 1
+            if fault.get("die_before_publish", {}).get(str(wid)) == rnd:
+                os._exit(3)
             vec, _ = _ravel(model, spec["average_updaters"])
             broker.publish(_UP, _encode_frame(wid, rnd, vec))
             # barrier timeout rides the master's configured deadline so a
@@ -320,34 +637,11 @@ def _worker_main(jobdir: str, wid: int, port: int) -> None:
             assert got_rnd == rnd, (got_rnd, rnd)
             _unravel_into(model, avg, meta)
     elif task == "fit":                     # shared gradients
-        import jax.numpy as jnp
-        from jax.flatten_util import ravel_pytree
-
-        from .accumulation import EncodingHandler
-        from .remote import RemoteGradientSharing
-
-        sharing = RemoteGradientSharing(
-            broker, wid, topic=_GRADS,
-            handler=EncodingHandler(initial_threshold=spec["threshold"]))
-        time.sleep(0.5)   # let every peer's subscription reach the hub
-        for batch in batches:
-            flat_before, unravel = ravel_pytree(model.params)
-            flat_before = jnp.array(flat_before)
-            model.fit_batch(batch)
-            result["steps"] += 1
-            flat_after, _ = ravel_pytree(model.params)
-            sharing.publish_update(flat_after - flat_before)
-            merged = sharing.apply_updates(flat_after, timeout=0.05)
-            model.params = unravel(merged)
-        # settle: drain stragglers so every process converges
-        time.sleep(1.0)
-        flat, unravel = ravel_pytree(model.params)
-        model.params = unravel(sharing.apply_updates(flat, timeout=0.5))
-        vec, _ = _ravel(model, False)
-        broker.publish(_FINAL, _encode_frame(wid, 0, vec))
-        result["messages_sent"] = sharing.messages_sent
-        result["messages_applied"] = sharing.messages_applied
+        _worker_shared_fit(broker, model, batches, spec, resume, fault,
+                           wid, result)
     elif task == "evaluate":
+        if wid in fault.get("die_at_start", []):
+            os._exit(3)
         from ..evaluation.classification import Evaluation
         ev = Evaluation()
         for x, y in batches:
@@ -356,6 +650,8 @@ def _worker_main(jobdir: str, wid: int, port: int) -> None:
         result["n_examples"] = int(sum(np.asarray(x).shape[0]
                                        for x, _ in batches))
     elif task == "score":
+        if wid in fault.get("die_at_start", []):
+            os._exit(3)
         total, n = 0.0, 0
         for x, y in batches:
             bs = int(np.asarray(x).shape[0])
@@ -367,8 +663,149 @@ def _worker_main(jobdir: str, wid: int, port: int) -> None:
         raise ValueError(f"unknown task {task!r}")
 
     result["score"] = model.get_score() if task == "fit" else None
+    if wid in fault.get("die_before_done", []):
+        os._exit(3)
     broker.publish(_DONE, json.dumps(result).encode())
+    if wid in fault.get("exit_nonzero_after_done", []):
+        os._exit(5)
+
+
+def _worker_shared_fit(broker, model, batches, spec, resume, fault,
+                       wid: int, result: Dict[str, Any]) -> None:
+    """Shared-gradients worker protocol — every arrival explicit:
+
+    1. hub-acked subscriptions (gradients, flush, residual, go/seed);
+    2. publish READY, wait for the master's GO.  A replacement respawned
+       after GO instead performs a RESYNC handshake: having subscribed
+       first (hub-acked), it asks the master for a seed — mirror table +
+       folded residuals + per-sender sequence counts — so nothing
+       published after the seed snapshot can be missed, and the
+       seed/subscription overlap is deduped exactly by sequence number;
+    3. train, publishing quantized updates and applying peers';
+    4. publish FLUSH declaring the TOTAL sent-count (prior incarnations
+       included, so peers' count barriers stay exact) and the handler's
+       residual as one dense frame (quantization keeps the clipped excess
+       at the sender — "delayed, never lost"; job end is where the delay
+       runs out, so the remainder ships dense exactly once);
+    5. drain until every peer's applied count (plus what the seed already
+       contained) reaches its declared count and every peer's residual is
+       accounted for, then add the residuals: each table becomes
+       init + Σ(all workers' exact deltas), so the master's agreement
+       check is a float-noise bound;
+    6. publish the final table for the master's agreement check + mean.
+    """
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from .accumulation import EncodingHandler
+    from .remote import RemoteGradientSharing
+
+    handler = EncodingHandler(initial_threshold=spec["threshold"])
+    flush_sub = broker.subscribe(_FLUSH, ack=True)
+    resid_sub = broker.subscribe(_RESID, ack=True)
+    timeout = float(spec["timeout"])
+    post_go_resume = bool(resume.get("go_done"))
+    prior_sent = 0
+    declared: Dict[int, int] = {}
+    mirror_counts: Dict[int, int] = {}
+    resids_done: set = set()
+    if not post_go_resume:
+        sharing = RemoteGradientSharing(broker, wid, topic=_GRADS,
+                                        handler=handler, ack=True)
+        go_sub = broker.subscribe(_GO, ack=True)
+        broker.publish(_READY, json.dumps({"wid": wid}).encode())
+        if go_sub.poll(timeout=timeout) is None:
+            raise RuntimeError(f"worker {wid}: no GO from master")
+    else:
+        # resync handshake: subscribe FIRST (hub-acked), then request the
+        # seed — updates published after the seed snapshot arrive on the
+        # subscription, updates before it are in the seed, and the seed's
+        # per-sender counts dedup the overlap exactly (skip_seqs)
+        grads_sub_first = broker.subscribe(_GRADS, ack=True)
+        seed_sub = broker.subscribe(_SEED, ack=True)
+        broker.publish(_READY, json.dumps(
+            {"wid": wid, "resync": True}).encode())
+        deadline = time.time() + timeout
+        meta = None
+        while meta is None:
+            payload = seed_sub.poll(timeout=1.0)
+            if payload is not None:
+                d = json.loads(payload.decode())
+                if int(d["wid"]) == wid:
+                    meta = d
+            elif time.time() > deadline:
+                raise RuntimeError(f"worker {wid}: no resync seed")
+        _, pmeta = _ravel(model, False)
+        _unravel_into(model, np.load(meta["file"]), pmeta)
+        prior_sent = int(meta["prior_sent"])
+        declared = {int(k): int(v) for k, v in meta["declared"].items()}
+        mirror_counts = {int(k): int(v)
+                         for k, v in meta["mirror_counts"].items()}
+        resids_done = set(int(w) for w in meta["resid_wids"])
+        sharing = RemoteGradientSharing(
+            broker, wid, topic=_GRADS, handler=handler,
+            seq_base=prior_sent, skip_seqs=mirror_counts,
+            sub=grads_sub_first)
+    die_after = fault.get("die_after_batches", {}).get(str(wid))
+    for i, batch in enumerate(batches):
+        if die_after == i:
+            os._exit(3)
+        flat_before, unravel = ravel_pytree(model.params)
+        flat_before = jnp.array(flat_before)
+        model.fit_batch(batch)
+        result["steps"] += 1
+        flat_after, _ = ravel_pytree(model.params)
+        sharing.publish_update(flat_after - flat_before)
+        merged = sharing.apply_updates(flat_after, timeout=0.05)
+        model.params = unravel(merged)
+    broker.publish(_FLUSH, json.dumps(
+        {"wid": wid, "sent": prior_sent + sharing.messages_sent}).encode())
+    flat, unravel = ravel_pytree(model.params)
+    flat = jnp.asarray(flat)
+    resid = sharing.handler.residual
+    resid = (np.zeros(int(flat.size), np.float32) if resid is None
+             else np.asarray(resid, np.float32))
+    broker.publish(_RESID, _encode_frame(wid, 0, resid))
+    # drain barrier: applied[p] (+ the seed's mirror_counts[p]) must reach
+    # p's declared count and p's residual must be in (directly or folded
+    # into the seed) — a respawned peer's re-flush overwrites its declared
+    # count (its earlier messages only push applied past it: >= holds)
+    resids: Dict[int, np.ndarray] = {}
+    deadline = time.time() + timeout
+    while True:
+        missing = [p for p in range(spec["num_workers"])
+                   if p != wid
+                   and (p not in declared
+                        or (p not in resids and p not in resids_done)
+                        or sharing.applied_per_peer.get(p, 0)
+                        + mirror_counts.get(p, 0) < declared[p])]
+        if not missing:
+            break
+        payload = flush_sub.poll(timeout=0.05)
+        if payload is not None:
+            d = json.loads(payload.decode())
+            declared[int(d["wid"])] = int(d["sent"])
+        payload = resid_sub.poll(timeout=0.05)
+        if payload is not None:
+            r_wid, _, r_vec = _decode_frame(payload)
+            if r_wid != wid and r_wid not in resids_done:
+                resids[r_wid] = r_vec
+        flat = sharing.apply_updates(flat, timeout=0.05)
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"worker {wid}: drain barrier incomplete, "
+                f"missing peers {missing}")
+    for p in sorted(resids):
+        flat = flat + jnp.asarray(resids[p])
+    model.params = unravel(flat)
+    vec, _ = _ravel(model, False)
+    broker.publish(_FINAL, _encode_frame(wid, 0, vec))
+    result["messages_sent"] = sharing.messages_sent
+    result["messages_applied"] = sharing.messages_applied
+    result["applied_per_peer"] = {
+        str(k): v for k, v in sorted(sharing.applied_per_peer.items())}
 
 
 if __name__ == "__main__":
-    _worker_main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    _worker_main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                 sys.argv[4] if len(sys.argv) > 4 else None)

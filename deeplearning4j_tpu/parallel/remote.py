@@ -23,16 +23,20 @@ from .accumulation import EncodingHandler, decode
 __all__ = ["encode_message_bytes", "decode_message_bytes",
            "RemoteGradientSharing"]
 
-_MAGIC = b"GUP1"
+_MAGIC = b"GUP2"
 _KINDS = ("threshold", "bitmap")
 
 
-def encode_message_bytes(worker_id: int, msg: Dict[str, Any]) -> bytes:
+def encode_message_bytes(worker_id: int, msg: Dict[str, Any],
+                         seq: int = 0) -> bytes:
     """Encoded-update message -> wire frame (the SilentUpdatesMessage
-    serialization role)."""
+    serialization role).  ``seq`` is a dense 1-based per-sender sequence
+    number: combined with per-sender FIFO delivery it lets receivers
+    dedup exactly (a resynced worker skips seq <= the count its seed
+    already contains)."""
     kind = _KINDS.index(msg["kind"])
-    head = _MAGIC + struct.pack("<iBqf", worker_id, kind, msg["size"],
-                                msg["threshold"])
+    head = _MAGIC + struct.pack("<iBqfq", worker_id, kind, msg["size"],
+                                msg["threshold"], seq)
     if msg["kind"] == "threshold":
         idx = np.ascontiguousarray(msg["idx"], np.int32)
         signs = np.ascontiguousarray(msg["signs"], np.int8)
@@ -43,12 +47,13 @@ def encode_message_bytes(worker_id: int, msg: Dict[str, Any]) -> bytes:
 
 
 def decode_message_bytes(data: bytes):
-    """Wire frame -> (worker_id, message dict)."""
+    """Wire frame -> (worker_id, seq, message dict)."""
     if data[:4] != _MAGIC:
         raise ValueError("bad gradient-update frame magic")
-    worker_id, kind, size, threshold = struct.unpack_from("<iBqf", data, 4)
-    n, = struct.unpack_from("<q", data, 4 + 17)
-    off = 4 + 17 + 8
+    worker_id, kind, size, threshold, seq = struct.unpack_from(
+        "<iBqfq", data, 4)
+    n, = struct.unpack_from("<q", data, 4 + 25)
+    off = 4 + 25 + 8
     if _KINDS[kind] == "threshold":
         idx = np.frombuffer(data, np.int32, count=n, offset=off)
         signs = np.frombuffer(data, np.int8, count=n, offset=off + 4 * n)
@@ -58,7 +63,7 @@ def decode_message_bytes(data: bytes):
         packed = np.frombuffer(data, np.uint8, count=n, offset=off)
         msg = {"kind": "bitmap", "size": size, "threshold": threshold,
                "packed": packed}
-    return worker_id, msg
+    return worker_id, seq, msg
 
 
 class RemoteGradientSharing:
@@ -68,34 +73,61 @@ class RemoteGradientSharing:
     worker id."""
 
     def __init__(self, broker, worker_id: int, topic: str = "gradients",
-                 handler: Optional[EncodingHandler] = None):
+                 handler: Optional[EncodingHandler] = None,
+                 ack: bool = False, seq_base: int = 0,
+                 skip_seqs: Optional[Dict[int, int]] = None, sub=None):
         self.broker = broker
         self.worker_id = worker_id
         self.topic = topic
         self.handler = handler or EncodingHandler()
-        self._sub = broker.subscribe(topic)
+        # ``sub``: adopt an existing subscription (a resynced worker must
+        # keep the one it opened BEFORE requesting its seed)
+        if sub is not None:
+            self._sub = sub
+        else:
+            self._sub = broker.subscribe(topic, ack=ack) if ack \
+                else broker.subscribe(topic)
+        # seq_base continues a predecessor incarnation's numbering so
+        # per-sender sequence numbers stay dense across respawns
+        self.seq_base = seq_base
+        # skip_seqs[p]: sequence numbers <= this were already folded into
+        # this worker's starting table (a resync seed) — exact dedup
+        self.skip_seqs: Dict[int, int] = dict(skip_seqs or {})
         self.messages_sent = 0
         self.messages_applied = 0
+        # per-sender applied tallies back the drain barrier: a worker knows
+        # it holds every peer update once applied[p] >= the count p
+        # declared minus what its seed already contained
+        self.applied_per_peer: Dict[int, int] = {}
 
     def publish_update(self, flat_grad) -> None:
         msg = self.handler.encode_update(flat_grad)
-        self.broker.publish(self.topic,
-                            encode_message_bytes(self.worker_id, msg))
         self.messages_sent += 1
+        self.broker.publish(
+            self.topic,
+            encode_message_bytes(self.worker_id, msg,
+                                 seq=self.seq_base + self.messages_sent))
 
     def apply_updates(self, flat_params, timeout: float = 0.0):
         """Drain pending peer messages into the flat param vector; returns
-        the updated vector (stale messages apply late — by design)."""
+        the updated vector (stale messages apply late — by design).
+        Messages whose seq is at or below the sender's ``skip_seqs`` entry
+        are already in this worker's starting table and are discarded."""
         out = jnp.asarray(flat_params)
         while True:
             payload = self._sub.poll(timeout=timeout or 0.001)
             if payload is None:
                 return out
-            sender, msg = decode_message_bytes(payload)
+            sender, seq, msg = decode_message_bytes(payload)
             if sender == self.worker_id:
                 continue      # own broadcast echo
+            if seq and seq <= self.skip_seqs.get(sender, 0):
+                continue      # already folded into the resync seed
+                # (seq==0 marks an unsequenced frame — never deduped)
             out = out + decode(msg)
             self.messages_applied += 1
+            self.applied_per_peer[sender] = \
+                self.applied_per_peer.get(sender, 0) + 1
 
     def close(self) -> None:
         if hasattr(self._sub, "close"):

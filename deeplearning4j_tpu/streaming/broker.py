@@ -31,7 +31,13 @@ class _Subscription:
 
 
 class LocalMessageBroker:
-    """Named topics; publish fans out to every subscriber's queue."""
+    """Named topics; publish fans out to every subscriber's queue.
+
+    ``max_queue=0`` makes subscriber queues unbounded — the reliable-
+    transport posture (no drop-oldest): exact-count protocols like the
+    multiprocess masters' drain barrier require lossless delivery, and
+    their memory is bounded by job size.  The default stays bounded with
+    drop-oldest so streaming consumers can't stall producers."""
 
     def __init__(self, max_queue: int = 1024):
         self.max_queue = max_queue
@@ -55,7 +61,9 @@ class LocalMessageBroker:
                 except queue.Full:
                     pass
 
-    def subscribe(self, topic: str) -> _Subscription:
+    def subscribe(self, topic: str, ack: bool = False) -> _Subscription:
+        # in-process registration is synchronous; ``ack`` exists for API
+        # parity with TcpMessageBroker (where it confirms hub registration)
         sub = _Subscription(self.max_queue)
         with self._lock:
             self._topics.setdefault(topic, []).append(sub)
@@ -73,7 +81,11 @@ class LocalMessageBroker:
 
 
 # --------------------------------------------------------------------- TCP
-# frame: op(1: 0=pub 1=sub) topic_len(2) topic payload_len(4) payload
+# frame: op(1: 0=pub 1=sub 2=sub+ack) topic_len(2) topic payload_len(4)
+# payload.  op 2 answers with one empty frame on the subscription socket
+# the moment the hub has registered the subscription — after the client
+# reads it, any subsequently published message is guaranteed to fan out
+# to this subscriber (no subscribe/publish cross-connection race).
 def _send_frame(sock: socket.socket, op: int, topic: str,
                 payload: bytes = b"") -> None:
     t = topic.encode()
@@ -95,12 +107,15 @@ class TcpMessageBroker:
     """Broker server + client in one class.  ``serve()`` starts the hub;
     clients use ``publish``/``subscribe`` pointed at host:port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_queue: int = 1024):
         self.host = host
         self.port = port
-        self._local = LocalMessageBroker()
+        self._local = LocalMessageBroker(max_queue)
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._pub_sock: Optional[socket.socket] = None
+        self._pub_lock = threading.Lock()
 
     # -- server side ---------------------------------------------------------
     def serve(self) -> "TcpMessageBroker":
@@ -125,9 +140,11 @@ class TcpMessageBroker:
                         topic = topic.decode()
                         if op == 0:
                             broker.publish(topic, payload)
-                        elif op == 1:
+                        elif op in (1, 2):
                             sub = broker.subscribe(topic)
                             subs.append((topic, sub))
+                            if op == 2:   # registration ack, before any pump
+                                sock.sendall(struct.pack("<I", 0))
                             t = threading.Thread(
                                 target=self._pump, args=(sock, sub),
                                 daemon=True)
@@ -165,12 +182,35 @@ class TcpMessageBroker:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        with self._pub_lock:
+            if self._pub_sock is not None:
+                self._pub_sock.close()
+                self._pub_sock = None
         self._local.close()
 
     # -- client side ---------------------------------------------------------
     def publish(self, topic: str, payload: bytes) -> None:
-        with socket.create_connection((self.host, self.port), timeout=5) as s:
-            _send_frame(s, 0, topic, payload)
+        """Publish over ONE persistent connection per client object: the
+        hub's handler processes a connection's frames sequentially, so a
+        sender's messages are delivered per-subscriber in publish order
+        (the FIFO the masters' sequence-number dedup relies on) — and no
+        per-message TCP setup."""
+        with self._pub_lock:
+            for attempt in (0, 1):
+                if self._pub_sock is None:
+                    self._pub_sock = socket.create_connection(
+                        (self.host, self.port), timeout=5)
+                try:
+                    _send_frame(self._pub_sock, 0, topic, payload)
+                    return
+                except (ConnectionError, OSError):
+                    # hub restarted / socket went stale: reconnect once
+                    try:
+                        self._pub_sock.close()
+                    finally:
+                        self._pub_sock = None
+                    if attempt:
+                        raise
 
     class _TcpSubscription:
         def __init__(self, sock: socket.socket):
@@ -212,7 +252,13 @@ class TcpMessageBroker:
         def close(self):
             self._sock.close()
 
-    def subscribe(self, topic: str) -> "_TcpSubscription":
+    def subscribe(self, topic: str, ack: bool = False) -> "_TcpSubscription":
         s = socket.create_connection((self.host, self.port), timeout=5)
-        _send_frame(s, 1, topic)
-        return TcpMessageBroker._TcpSubscription(s)
+        _send_frame(s, 2 if ack else 1, topic)
+        sub = TcpMessageBroker._TcpSubscription(s)
+        if ack:
+            first = sub.poll(timeout=10.0)
+            if first != b"":
+                raise RuntimeError(
+                    f"no subscription ack from hub for {topic!r}")
+        return sub

@@ -41,6 +41,95 @@ from .storage import InMemoryStatsStorage, StatsStorage
 
 __all__ = ["UIServer", "RemoteUIStatsStorageRouter"]
 
+# stored-injection guard for POST /activations: the grids page embeds the
+# accepted payload verbatim, so this must be an allowlist over parsed XML,
+# not a pattern scan of serialized text (scans miss SMIL attribute-targeting
+# like <set attributeName="onmouseover">, <use>/<image> external references,
+# and CSS url() exfil).  Element/attribute sets cover what our own listeners
+# emit (ui/components.py, evaluation/tools.py) plus benign SVG structure.
+_SVG_NS = "http://www.w3.org/2000/svg"
+_SVG_ELEMENTS = frozenset({
+    "svg", "g", "path", "rect", "circle", "ellipse", "line", "polyline",
+    "polygon", "text", "tspan", "defs", "title", "desc", "linearGradient",
+    "radialGradient", "stop", "clipPath", "mask", "marker", "symbol"})
+_SVG_ATTRS = frozenset({
+    "id", "class", "d", "x", "y", "x1", "y1", "x2", "y2", "cx", "cy", "r",
+    "rx", "ry", "points", "width", "height", "viewBox", "transform",
+    "fill", "stroke", "stroke-width", "stroke-dasharray", "stroke-linecap",
+    "stroke-linejoin", "opacity", "fill-opacity", "stroke-opacity",
+    "font-size", "font-family", "font-weight", "text-anchor",
+    "dominant-baseline", "offset", "stop-color", "stop-opacity",
+    "gradientUnits", "gradientTransform", "clip-path", "mask",
+    "marker-start", "marker-mid", "marker-end", "dx", "dy",
+    "preserveAspectRatio", "version",
+    # style passes the same per-attribute url() constraint below, so it
+    # can't carry external references; our _Chart frames use it
+    "style"})
+
+
+def _validate_activation_svg(svg) -> None:
+    """Raise ValueError unless ``svg`` is a plain vector drawing.
+
+    Layered: (1) reject DOCTYPE/PI/CDATA/comment markup outright — CDATA in
+    particular parses as inert text in XML but the HTML embedding re-reads
+    the raw bytes, where ``<![CDATA[<script>]]>`` IS a script tag; (2) scan
+    entity-decoded variants for script vectors (a ``&lt;script&gt;`` text
+    node is XML-safe but stays rejected — the stored string is the artifact,
+    not the parse); (3) parse and allowlist element/attribute names, and
+    constrain ``url()`` references to local fragments."""
+    import html as _html
+    import re as _re
+    import xml.etree.ElementTree as _ET
+
+    if not isinstance(svg, str):
+        raise ValueError("svg payload must be a string")
+    if not svg.lstrip()[:4].lower().startswith("<svg"):
+        raise ValueError("svg payload must start with <svg")
+    if "<!" in svg or "<?" in svg:
+        raise ValueError("svg payload must not contain DOCTYPE/CDATA/"
+                         "comment/processing-instruction markup")
+    variants, cur = [svg], svg
+    for _ in range(2):           # double-encoded payloads too
+        nxt = _html.unescape(cur)
+        if nxt == cur:           # fixpoint: no entities left
+            break
+        variants.append(nxt)
+        cur = nxt
+    for s in variants:
+        low = s.lower()
+        compact = _re.sub(r"[\x00-\x20]", "", low)
+        if ("<script" in low or "<foreignobject" in low
+                or "javascript:" in compact
+                or _re.search(r"[\s/\"'>]on\w+\s*=", low)):
+            raise ValueError("svg payload contains script vectors")
+    try:
+        root = _ET.fromstring(svg)
+    except _ET.ParseError as e:
+        raise ValueError(f"svg payload is not well-formed XML: {e}")
+    for el in root.iter():
+        if not isinstance(el.tag, str):      # Comment / PI nodes
+            raise ValueError("svg payload must not contain comments or "
+                             "processing instructions")
+        ns, _, local = el.tag.rpartition("}")
+        if ns and ns != "{" + _SVG_NS:
+            raise ValueError(f"non-SVG namespace element {el.tag!r}")
+        if local not in _SVG_ELEMENTS:
+            raise ValueError(f"svg element <{local}> is not allowed")
+        for name, value in el.attrib.items():
+            if name.startswith("{") or name not in _SVG_ATTRS:
+                raise ValueError(f"svg attribute {name!r} is not allowed")
+            # paint/clip references may only target local fragments
+            # (quoted FuncIRI forms like url('#id') are local too)
+            for m in _re.finditer(r"url\s*\(([^)]*)\)", value,
+                                  _re.IGNORECASE):
+                inner = m.group(1).strip().strip("'\"").strip()
+                if not inner.startswith("#"):
+                    raise ValueError(
+                        f"svg attribute {name!r} references a non-local url")
+            if _re.search(r"url\s*\([^)]*$", value, _re.IGNORECASE):
+                raise ValueError(
+                    f"svg attribute {name!r} has an unterminated url()")
+
 _PAGE = """<!doctype html><html><head><meta charset="utf-8">
 <title>dl4j-tpu training UI</title><style>
 body{font-family:sans-serif;margin:20px;background:#fafafa}
@@ -281,36 +370,7 @@ class _Handler(JsonHandler):
                 payload = self._read_json()
                 svg = payload["svg"]
                 iteration = int(payload.get("iteration", 0))
-                # stored-injection guard: the page embeds this verbatim.
-                # reject the standard SVG script vectors (script tags,
-                # event-handler attributes, javascript: URLs, foreignObject).
-                # scan entity-decoded forms too (&#115;cript, &Tab; — names
-                # are case-sensitive, so unescape BEFORE lowercasing), and
-                # strip the control chars browsers ignore inside URL schemes
-                import html as _html
-                import re as _re
-                if not isinstance(svg, str):
-                    raise ValueError("svg payload must be a string")
-                variants, cur = [svg], svg
-                for _ in range(2):       # double-encoded payloads too
-                    nxt = _html.unescape(cur)
-                    if nxt == cur:       # fixpoint: no entities left
-                        break
-                    variants.append(nxt)
-                    cur = nxt
-
-                def _scripty(s: str) -> bool:
-                    low = s.lower()
-                    compact = _re.sub(r"[\x00-\x20]", "", low)
-                    return ("<script" in low
-                            or "<foreignobject" in low
-                            or "javascript:" in compact
-                            or bool(_re.search(r"[\s/\"'>]on\w+\s*=", low)))
-
-                if (not svg.lstrip()[:4].lower().startswith("<svg")
-                        or any(_scripty(s) for s in variants)):
-                    raise ValueError("svg payload must be a plain <svg> "
-                                     "without scripts/event handlers")
+                _validate_activation_svg(svg)
             except Exception as e:
                 return self._json({"error": f"bad payload: {e}"}, 400)
             self.activations.append({"iteration": iteration, "svg": svg})

@@ -60,6 +60,12 @@ _SLOW_TESTS = {
     "test_mp_parameter_averaging_trains",
     "test_mp_shared_gradients_trains_and_exchanges",
     "test_mp_evaluate_and_score_match_local",
+    "test_mp_averaging_retry_reexecutes_dead_worker",
+    "test_mp_shared_retry_reexecutes_from_mirror",
+    "test_mp_shared_ack_protocol_exact_counts",
+    "test_mp_evaluate_retry_stateless_reexecution",
+    "test_mp_retries_exhausted_raises",
+    "test_mp_crash_windows_around_done",
     "test_pretrained_keras_weights_bridge",
 }
 

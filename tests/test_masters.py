@@ -213,15 +213,16 @@ class TestRemoteGradientSharing:
         msg = {"kind": "threshold", "size": 10, "threshold": 0.5,
                "idx": np.array([1, 7], np.int32),
                "signs": np.array([1, -1], np.int8)}
-        wid, back = decode_message_bytes(encode_message_bytes(3, msg))
-        assert wid == 3 and back["kind"] == "threshold"
+        wid, seq, back = decode_message_bytes(
+            encode_message_bytes(3, msg, seq=17))
+        assert wid == 3 and seq == 17 and back["kind"] == "threshold"
         assert back["size"] == 10
         np.testing.assert_array_equal(back["idx"], msg["idx"])
         np.testing.assert_array_equal(back["signs"], msg["signs"])
         bm = {"kind": "bitmap", "size": 8, "threshold": 0.25,
               "packed": np.array([0b01100001, 0b10], np.uint8)}
-        wid, back = decode_message_bytes(encode_message_bytes(1, bm))
-        assert back["kind"] == "bitmap"
+        wid, seq, back = decode_message_bytes(encode_message_bytes(1, bm))
+        assert back["kind"] == "bitmap" and seq == 0
         np.testing.assert_array_equal(back["packed"], bm["packed"])
 
     def _share_once(self, broker):

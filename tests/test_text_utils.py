@@ -344,6 +344,31 @@ class TestSerializerFormats:
                                    np.stack([row0, row1]))
         assert m2.vocab.word_at_index(0) == "aa"
 
+    def test_load_static_model_truncated_sniff_window_widens(self, tmp_path):
+        """A txt file whose first data row overflows the 256-byte sniff
+        window with the cut landing mid-value ('word 0.1 0.2 ... 1e|-05')
+        must widen the window instead of misrouting to read_binary
+        (ADVICE r3: a '1e' / '-' prefix fails float-parse but proves
+        nothing about the format)."""
+        import numpy as np
+        from deeplearning4j_tpu.nlp import serializer as S
+        # first value token: 255 chars, positioned so the 256-byte window
+        # (after "aa ") cuts it to a '...e-' prefix — float() fails on it
+        tok = "1." + "2" * 249 + "e-05"
+        p = str(tmp_path / "wide.txt")
+        with open(p, "w") as f:
+            f.write("2 2\n")
+            f.write(f"aa {tok} 3.5\n")
+            f.write("bb 1.0 2.0\n")
+        with open(p, "rb") as f:
+            f.readline()
+            window = f.read(256)
+        assert b"\n" not in window and window.decode().split()[-1][-2:] == "e-"
+        m2 = S.load_static_model(p)
+        np.testing.assert_allclose(
+            np.asarray(m2.lookup_table.syn0),
+            np.array([[float(tok), 3.5], [1.0, 2.0]], np.float32))
+
     def test_csv_rejects_comma_words(self, tmp_path):
         import pytest
         from deeplearning4j_tpu.nlp import serializer as S

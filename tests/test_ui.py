@@ -382,13 +382,46 @@ def test_activation_grid_pages():
             f"{base}/train/sessions")) == []
         # entity-encoded script vectors must not slip past the stored-XSS
         # guard (the page embeds accepted svg verbatim)
+        # a drawing made of the elements/attrs our listeners actually emit
+        # must pass the allowlist
+        good = ('<svg width="100" height="50" viewBox="0 0 100 50" '
+                'style="background:#fff;margin:8px 0">'
+                '<rect x="1" y="2" width="10" height="10" fill="#1f77b4"/>'
+                '<polyline points="0,0 5,5" fill="none" stroke="rgb(9,9,9)"'
+                ' stroke-width="1.5"/><g transform="translate(3,4)">'
+                '<text x="1" y="1" font-size="10" fill="url(#grad)" '
+                "stroke=\"url('#g2')\">ok"
+                '</text></g></svg>')
+        req = urllib.request.Request(
+            f"{base}/activations",
+            data=json.dumps({"iteration": 2, "svg": good}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert json.load(urllib.request.urlopen(req))["ok"]
         for evil in (
                 '<svg><a xlink:href="java&#115;cript:alert(1)">x</a></svg>',
                 '<svg><img &#111;nerror=alert(1)></svg>',
                 '<svg>&lt;script&gt;&#60;script&#62;</svg>',
                 '<svg><a href="java&#9;script:alert(1)">x</a></svg>',
                 '<svg><a href="java&Tab;script:alert(1)">x</a></svg>',
-                '<svg><image href=x /onerror=alert(1)></svg>'):
+                '<svg><image href=x /onerror=alert(1)></svg>',
+                # SMIL attribute-targeting: animates an event handler into
+                # existence without any on* attribute in the payload
+                '<svg><rect width="5" height="5">'
+                '<set attributeName="onmouseover" to="alert(1)"/>'
+                '</rect></svg>',
+                # external-reference exfil channels
+                '<svg><use href="http://evil/x.svg#p"/></svg>',
+                '<svg><image href="http://evil/x.png"/></svg>',
+                '<svg><rect width="5" height="5"'
+                ' fill="url(http://evil/f.svg#x)"/></svg>',
+                '<svg><rect width="5" height="5"'
+                ' fill="url(http://evil"/></svg>',
+                '<svg><style>rect{fill:url(http://evil)}</style></svg>',
+                # CDATA is inert in XML but raw <script> once the page
+                # embeds the stored string into HTML
+                '<svg><text><![CDATA[<script>alert(1)</script>]]>'
+                '</text></svg>',
+                '<svg><!-- c --><rect onclick="x" width="1"/></svg>'):
             req = urllib.request.Request(
                 f"{base}/activations",
                 data=json.dumps({"iteration": 1, "svg": evil}).encode(),
