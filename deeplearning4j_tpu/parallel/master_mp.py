@@ -141,6 +141,10 @@ class MultiprocessMaster:
     """
 
     _DEAD_GRACE = 2.0   # seconds a dead worker's in-flight message may lag
+    # subclasses repoint these to reuse the spawn/retry/collect machinery
+    # for other job types (nlp/distributed_vectors rides it for Word2Vec)
+    _WORKER_MODULE = "deeplearning4j_tpu.parallel.master_mp"
+    _STATELESS_TASKS = ("evaluate", "score")   # _DONE is the contribution
 
     def __init__(self, num_workers: int = 2, mode: str = "averaging",
                  averaging_frequency: int = 5, average_updaters: bool = True,
@@ -183,7 +187,7 @@ class MultiprocessMaster:
         env["PYTHONPATH"] = os.pathsep.join([pkg_root] + prev)
         env.update(self.worker_env)
         log = open(os.path.join(jobdir, f"worker_{wid}.log"), "a")
-        argv = [sys.executable, "-m", "deeplearning4j_tpu.parallel.master_mp",
+        argv = [sys.executable, "-m", self._WORKER_MODULE,
                 jobdir, str(wid), str(port)]
         if resume_file:
             argv.append(resume_file)
@@ -203,9 +207,8 @@ class MultiprocessMaster:
         workers, return its result.  ``resume_payload(wid)`` builds the
         (resume-spec, frame) a respawned worker restarts from."""
         from ..streaming.broker import TcpMessageBroker
-        from ..utils import model_serializer
 
-        model_serializer.write_model(model, os.path.join(jobdir, "model.zip"))
+        self._write_job(model, jobdir)
         # max_queue=0: the master protocol is a reliable transport (the
         # Aeron role) — exact-count drain barriers need lossless delivery;
         # memory is bounded by job size
@@ -229,9 +232,9 @@ class MultiprocessMaster:
             for w in range(self.num_workers)}
         try:
             out = run(broker, subs)
-            if spec["task"] == "fit":
+            if spec["task"] not in self._STATELESS_TASKS:
                 # every fit contribution is in; a worker respawned from
-                # here on only needs to report (for evaluate/score the
+                # here on only needs to report (for stateless tasks the
                 # _DONE message IS the contribution — full re-execution)
                 self._resume_payload = \
                     lambda wid: ({"skip_to_done": True}, None)
@@ -264,6 +267,12 @@ class MultiprocessMaster:
                     p.kill()
                 p._logfile.close()
             broker.shutdown()
+
+    def _write_job(self, model, jobdir: str) -> None:
+        """Serialize the trainee into the job directory (subclasses swap
+        the serialization format for their model family)."""
+        from ..utils import model_serializer
+        model_serializer.write_model(model, os.path.join(jobdir, "model.zip"))
 
     def _logs_tail(self, jobdir: str) -> str:
         outs = []
@@ -448,27 +457,30 @@ class MultiprocessMaster:
             "seed_n": 0,
         }
 
-        def drain_mirror():
+        def drain_mirror(settle: float = 0.001):
+            """``settle``: how long a poll gap ends the drain — resync
+            seeds use a longer window so an in-flight frame (mid-transfer
+            on the subscription socket) lands in the seed rather than
+            falling between seed and the replacement's subscription."""
             while True:
-                payload = state["grads_sub"].poll(timeout=0.001)
+                payload = state["grads_sub"].poll(timeout=settle)
                 if payload is None:
                     break
                 sender, seq, msg = decode_message_bytes(payload)
-                state["mirror"] = state["mirror"] + np.asarray(
-                    _decode_update(msg))
+                state["mirror"] += np.asarray(_decode_update(msg))
                 # per-sender FIFO (one publisher connection) makes seqs
                 # arrive dense and in order: the highest seen == the count
                 # folded into the mirror, which seeds exact dedup
                 state["mirror_counts"][sender] = max(
                     state["mirror_counts"].get(sender, 0), seq)
             while True:
-                payload = state["resid_sub"].poll(timeout=0.001)
+                payload = state["resid_sub"].poll(timeout=settle)
                 if payload is None:
                     break
                 r_wid, _, vec = _decode_frame(payload)
                 if r_wid not in state["resid_wids"]:
                     state["resid_wids"].add(r_wid)
-                    state["resid_sum"] = state["resid_sum"] + vec
+                    state["resid_sum"] += vec
 
         def serve_resyncs():
             """Answer a respawned worker's resync request with a seed:
@@ -484,7 +496,14 @@ class MultiprocessMaster:
                 d = json.loads(payload.decode())
                 if not d.get("resync"):
                     continue     # stale pre-go READY from a dead worker
-                drain_mirror()
+                # settle-drain: a frame mid-transfer on the mirror socket
+                # must land in the seed (the replacement can't receive it
+                # — it was fanned out before its subscription); 50 ms of
+                # silence on loopback means nothing is in flight.  If an
+                # extreme straggler still slips through, the replacement's
+                # drain barrier times out, and the NEXT resync sees it —
+                # self-healing at the cost of one retry.
+                drain_mirror(settle=0.05)
                 w = int(d["wid"])
                 state["seed_n"] += 1
                 seed_file = os.path.join(

@@ -118,6 +118,11 @@ def _validate_activation_svg(svg) -> None:
         for name, value in el.attrib.items():
             if name.startswith("{") or name not in _SVG_ATTRS:
                 raise ValueError(f"svg attribute {name!r} is not allowed")
+            # CSS identifier escapes (\75rl( == url() would sidestep the
+            # url() scan below; no legitimate drawing needs them
+            if "\\" in value:
+                raise ValueError(
+                    f"svg attribute {name!r} contains escape sequences")
             # paint/clip references may only target local fragments
             # (quoted FuncIRI forms like url('#id') are local too)
             for m in _re.finditer(r"url\s*\(([^)]*)\)", value,

@@ -66,6 +66,8 @@ _SLOW_TESTS = {
     "test_mp_evaluate_retry_stateless_reexecution",
     "test_mp_retries_exhausted_raises",
     "test_mp_crash_windows_around_done",
+    "test_multiprocess_word2vec_matches_thread_version",
+    "test_multiprocess_word2vec_retry",
     "test_pretrained_keras_weights_bridge",
 }
 

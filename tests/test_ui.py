@@ -417,6 +417,10 @@ def test_activation_grid_pages():
                 '<svg><rect width="5" height="5"'
                 ' fill="url(http://evil"/></svg>',
                 '<svg><style>rect{fill:url(http://evil)}</style></svg>',
+                # CSS identifier escape spelling of url( — the browser's
+                # CSS parser decodes \\75 to 'u' after the scan would miss
+                '<svg><rect width="5" height="5"'
+                ' style="fill:\\75rl(http://evil/x)"/></svg>',
                 # CDATA is inert in XML but raw <script> once the page
                 # embeds the stored string into HTML
                 '<svg><text><![CDATA[<script>alert(1)</script>]]>'
