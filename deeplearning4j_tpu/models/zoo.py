@@ -575,7 +575,13 @@ class TransformerLM(ZooModel):
     n_layers: int = 4
     n_heads: int = 8
     attn_impl: str = "auto"
+    flash_min_seq: Optional[int] = None   # 'auto' crossover override
     moe_experts: int = 0    # >0: Switch-style sparse FFN blocks
+    # integer-id targets [b, t] through the gather-based loss instead of
+    # one-hot [b, t, V] — at V=8192 the one-hot path reads an extra
+    # ~268 MB of HBM per step for the same value/gradients (measured in
+    # BENCH_NOTES "transformer campaign"); LM training should use this
+    sparse_labels: bool = False
 
     def init(self):
         from ..nn.layers.attention import (PositionalEncodingLayer,
@@ -591,9 +597,11 @@ class TransformerLM(ZooModel):
         for _ in range(self.n_layers):
             b = b.layer(TransformerBlock(n_heads=self.n_heads, causal=True,
                                          attn_impl=self.attn_impl,
+                                         flash_min_seq=self.flash_min_seq,
                                          moe_experts=self.moe_experts))
+        loss = "sparse_mcxent" if self.sparse_labels else "mcxent"
         conf = (b.layer(RnnOutputLayer(n_out=self.vocab_size,
-                                       activation="softmax", loss="mcxent"))
+                                       activation="softmax", loss=loss))
                 .set_input_type(InputType.recurrent(self.vocab_size,
                                                     self.seq_len))
                 .build())

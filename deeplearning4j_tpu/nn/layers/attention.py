@@ -11,10 +11,13 @@ Attention impl tiers (select with ``attn_impl``):
   'flash'     — pallas tiled kernel (``ops.flash_attention``), O(t) memory.
   'ring'      — ring attention over the mesh 'seq' axis (inside shard_map).
   'ulysses'   — all-to-all sequence parallelism (inside shard_map).
-  'auto'      — flash when unmasked + shapes tile, else reference.
+  'auto'      — selects by the measured crossover: reference below
+                ``DEFAULT_FLASH_MIN_SEQ`` tokens (or a masked input),
+                flash at/above it — the ``CudnnAlgoMode`` role.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -59,10 +62,29 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
 
 _ATTN_IMPLS = ("auto", "reference", "flash", "ring", "ulysses")
 
+# Measured crossover on the TPU v5e chip (BENCH_NOTES.md "transformer
+# campaign"): with the r3 128x128 kernel blocks, reference SDPA won the
+# full train step up to s=2048; with the swept block sizes
+# (ops/flash_attention._auto_blocks) flash wins at EVERY kernel-supported
+# length — full-model step ms flash/ref: 37/42 @s=128, 36/46 @s=512,
+# 64/75 @s=2048, 84/2642 @s=8192.  The default therefore sits at the
+# kernel's minimum tile (128); the env/field override remains for chips
+# where the crossover differs.  Role mirror: the reference's shape-based
+# algorithm selection (``ConvolutionLayer.java:349`` CudnnAlgoMode) —
+# "auto" selects the measured-faster algorithm by shape.
+DEFAULT_FLASH_MIN_SEQ = int(os.environ.get("DL4J_TPU_FLASH_MIN_SEQ", 128))
+
 
 def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
-                   interpret: bool = False):
-    """Dispatch [b,h,t,d] q/k/v to the selected attention implementation."""
+                   interpret: bool = False,
+                   flash_min_seq: Optional[int] = None):
+    """Dispatch [b,h,t,d] q/k/v to the selected attention implementation.
+
+    ``impl='auto'`` picks by the measured crossover: reference SDPA for
+    sequences shorter than ``flash_min_seq`` (default
+    ``DEFAULT_FLASH_MIN_SEQ``, env ``DL4J_TPU_FLASH_MIN_SEQ``), flash at or
+    above it.  Masked inputs always take the reference path (the kernel has
+    no key-padding support)."""
     from ...ops.attention import sdpa_reference
     if impl not in _ATTN_IMPLS:
         raise ValueError(f"unknown attn_impl '{impl}'; expected one of "
@@ -81,8 +103,12 @@ def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
         from ...ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, interpret=interpret)
     if impl == "auto" and mask is None:
-        from ...ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, interpret=interpret)
+        threshold = (DEFAULT_FLASH_MIN_SEQ if flash_min_seq is None
+                     else flash_min_seq)
+        if q.shape[2] >= threshold:
+            from ...ops.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal,
+                                   interpret=interpret)
     return sdpa_reference(q, k, v, mask=mask, causal=causal)
 
 
@@ -110,6 +136,9 @@ class MultiHeadAttention(BaseLayerConf):
     head_dim: int = 0           # default n_out // n_heads
     causal: bool = False
     attn_impl: str = "auto"     # reference|flash|ring|ulysses|auto
+    # 'auto' crossover override: flash at seq >= this (None = the measured
+    # DEFAULT_FLASH_MIN_SEQ / env DL4J_TPU_FLASH_MIN_SEQ)
+    flash_min_seq: Optional[int] = None
     seq_axis: str = "seq"
     has_bias: bool = True
     attn_dropout: Optional[float] = None   # retain prob on attention output
@@ -161,7 +190,8 @@ class MultiHeadAttention(BaseLayerConf):
         k = self._heads(x, p, "Wk", "bk")
         v = self._heads(x, p, "Wv", "bv")
         o = _run_attention(q, k, v, impl=self.attn_impl, causal=self.causal,
-                           mask=mask, seq_axis=self.seq_axis)
+                           mask=mask, seq_axis=self.seq_axis,
+                           flash_min_seq=self.flash_min_seq)
         b_, h, t, d = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b_, t, h * d)
         y = o @ p["Wo"]
@@ -256,6 +286,7 @@ class TransformerBlock(BaseLayerConf):
     ffn_mult: int = 4
     causal: bool = True
     attn_impl: str = "auto"
+    flash_min_seq: Optional[int] = None   # 'auto' crossover override
     seq_axis: str = "seq"
     eps: float = 1e-5
     max_cache_len: int = 512
@@ -283,6 +314,7 @@ class TransformerBlock(BaseLayerConf):
         m = MultiHeadAttention(
             n_in=self.n_in, n_out=self.n_in, n_heads=self.n_heads,
             causal=self.causal, attn_impl=self.attn_impl,
+            flash_min_seq=self.flash_min_seq,
             seq_axis=self.seq_axis, activation="identity",
             weight_init=self.weight_init, weight_dist=self.weight_dist,
             bias_init=self.bias_init, dtype=self.dtype,

@@ -27,6 +27,28 @@ from .attention import NEG_INF, sdpa_reference
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+# Chip-swept caps (BENCH_NOTES "transformer campaign", TPU v5e, d=64):
+# 128x128 ran the s=8192 fwd+bwd in 35.4 ms; 2048x512 in 13.3 ms (2.7x) —
+# bigger q-blocks amortize DMA and feed the MXU [block_q,d]@[d,block_k]
+# matmuls at useful sizes.  Caps scale down with head_dim to stay inside
+# VMEM (2048x1024 at d=64 already fails to compile).
+_BLOCK_Q_CAP = 2048 * 64
+_BLOCK_K_CAP = 512 * 64
+
+
+def _auto_blocks(t_q: int, t_k: int, d: int):
+    """Largest power-of-two divisors of the sequence lengths under the
+    VMEM-scaled caps — the measured-fastest tiling, the cuDNN algo-search
+    role (``ConvolutionLayer.java:349``) resolved by sweep instead of
+    per-call search."""
+    def pick(t, cap):
+        b = max(128, min(t, cap // max(d, 1)))
+        # round down to a power of two, then to a divisor of t
+        b = 1 << (b.bit_length() - 1)
+        while b > 128 and t % b:
+            b //= 2
+        return b
+    return pick(t_q, _BLOCK_Q_CAP), pick(t_k, _BLOCK_K_CAP)
 
 
 def _block_live(causal: bool, qi, ki, block_q: int, block_k: int):
@@ -256,8 +278,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Flash attention over [b, h, t, d] tensors — differentiable: the
     FlashAttention-2 style backward (saved logsumexp, softmax replayed per
@@ -270,8 +292,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     """
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_k)
+    auto_q, auto_k = _auto_blocks(t_q, t_k, d)
+    block_q = min(block_q, t_q) if block_q else auto_q
+    block_k = min(block_k, t_k) if block_k else auto_k
     supported = (t_q % block_q == 0 and t_k % block_k == 0
                  # head_dim must fill whole MXU lanes for the kernel's tiling
                  and d % 64 == 0

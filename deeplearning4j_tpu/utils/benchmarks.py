@@ -144,40 +144,101 @@ def paragraph_vectors_words_per_sec(vocab: int = 5000, n_docs: int = 20000,
 def transformer_lm_step_time(batch: int = 16, seq: int = 512,
                              embed: int = 512, n_layers: int = 8,
                              n_heads: int = 8, vocab: int = 8192,
-                             n_iter: int = 10) -> List[Dict]:
-    """TransformerLM train step time + achieved TFLOP/s, flash attention on
-    and off (VERDICT r2 item 6: the beyond-reference tier measured like the
-    parity tier).  Flops use the causal PaLM-style estimate
-    6·T·(12·L·E² + E·V) matmul + 6·L·B·S²·E attention (fwd+bwd)."""
+                             impls=("auto", "flash", "reference"),
+                             nbatch: int = 5, epochs: int = 2,
+                             blocks: int = 3) -> List[Dict]:
+    """TransformerLM train throughput + achieved TFLOP/s per attention impl
+    (VERDICT r2 item 6 / r3 item 1: the beyond-reference tier measured like
+    the parity tier).  Flops use the causal PaLM-style estimate
+    6·T·(12·L·E² + E·V) matmul + 6·L·B·S²·E attention (fwd+bwd).
+
+    Round-4 campaign form (BENCH_NOTES "transformer campaign"): sparse
+    integer labels (the LM-natural target — one-hot reads an extra ~268 MB
+    HBM/step at V=8192) and the device-resident epoch scan
+    (``fit_on_device``, one dispatch per epoch) so the row measures the
+    chip, not the tunnel's ~24-90 ms per-dispatch latency."""
     import jax.numpy as jnp
 
     from ..models import TransformerLM
 
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, vocab, (batch, seq + 1))
+    ids = rng.integers(0, vocab, (batch * nbatch, seq + 1))
     x = jnp.asarray(ids[:, :-1])
-    # direct one-hot assignment — np.eye(vocab) would materialize a
-    # vocab² identity (268 MB at vocab=8192) just to index rows from it
-    tgt = ids[:, 1:].reshape(-1)
-    onehot = np.zeros((tgt.size, vocab), dtype=np.float32)
-    onehot[np.arange(tgt.size), tgt] = 1.0
-    y = jnp.asarray(onehot.reshape(batch, seq, vocab))
+    y = jnp.asarray(ids[:, 1:])
     tokens = batch * seq
     flops = (6 * tokens * (12 * n_layers * embed * embed + embed * vocab)
              + 6 * n_layers * batch * seq * seq * embed)
+    steps = nbatch * epochs
     out = []
-    for impl in ("flash", "reference"):
+    for impl in impls:
         model = TransformerLM(vocab_size=vocab, seq_len=seq, embed=embed,
                               n_layers=n_layers, n_heads=n_heads,
-                              attn_impl=impl,
+                              attn_impl=impl, sparse_labels=True,
                               compute_dtype="bfloat16").init()
-        ms = _steady_step_ms(model, x, y, n_iter)
+        model.fit_on_device(x, y, batch_size=batch, epochs=1)  # compile+warm
+        times = []
+        for _ in range(blocks):
+            t0 = time.perf_counter()
+            model.fit_on_device(x, y, batch_size=batch, epochs=epochs)
+            times.append((time.perf_counter() - t0) / steps * 1e3)
+        ms = float(np.median(times))
         out.append({
             "metric": f"transformer_lm_step_ms[{impl},s={seq}]",
             "value": round(ms, 3), "unit": "ms/step",
             "batch": batch, "seq": seq, "embed": embed,
-            "n_layers": n_layers,
+            "n_layers": n_layers, "sparse_labels": True,
             "tokens_per_sec": round(tokens / ms * 1e3, 1),
             "achieved_tflops": round(flops / ms / 1e9, 2),
         })
     return out
+
+
+# Calibration (BENCH_NOTES "tunnel health"): round-2 measured ~24 ms
+# trivial-dispatch; this round measured ~90 ms on an otherwise-working
+# tunnel, and the round-3 degraded window showed 3-5x metric inflation.
+# Thresholds are deliberately loose — they flag "sick window", not drift.
+PROBE_ROUNDTRIP_HEALTHY_MS = 200.0
+PROBE_SPREAD_HEALTHY = 0.6
+
+
+def tunnel_probe(n: int = 5) -> Dict:
+    """Tunnel-health probe recorded beside every BENCH_SIDE row (VERDICT r3
+    item 2): (a) trivial-dispatch roundtrip latency — a tiny jitted op plus
+    a 512-byte host fetch; (b) a fixed 20-matmul device block timed ``n``
+    times — its spread separates device/tunnel instability from honest
+    load.  Rows carrying a probe let the next round distinguish a real
+    regression from a degraded capture window without re-reading prose
+    (the ``PerformanceListener.java:19`` role: measurements you can trust
+    round-over-round)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((1, 128), jnp.float32)
+    float(np.asarray(f(x))[0, 0])                    # compile + settle
+    lats = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        float(np.asarray(f(x))[0, 0])
+        lats.append(time.perf_counter() - t0)
+    g = jax.jit(lambda a: a @ a)
+    a = jnp.eye(1024, dtype=jnp.bfloat16)            # stable under chaining
+    float(np.asarray(g(a)[0, 0]))                    # compile + settle
+    blocks = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = a
+        for _ in range(20):
+            r = g(r)
+        float(np.asarray(r[0, 0]))                   # sync the whole chain
+        blocks.append(time.perf_counter() - t0)
+    med = float(np.median(blocks))
+    probe = {
+        "roundtrip_ms": round(float(np.median(lats)) * 1e3, 1),
+        "block_ms": round(med * 1e3, 1),
+        "block_spread": round((max(blocks) - min(blocks)) / med, 3),
+    }
+    probe["healthy"] = bool(
+        probe["roundtrip_ms"] < PROBE_ROUNDTRIP_HEALTHY_MS
+        and probe["block_spread"] < PROBE_SPREAD_HEALTHY)
+    return probe

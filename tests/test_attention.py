@@ -249,3 +249,30 @@ def test_cached_attention_honors_mask_and_causal_flag():
                                    err_msg=f"causal={causal}")
         np.testing.assert_allclose(np.asarray(cached) * (1 - m), 0.0)
         assert int(carry["pos"]) == 6
+
+
+def test_auto_dispatch_follows_measured_crossover(monkeypatch):
+    """VERDICT r3 item 1a: attn_impl='auto' selects by the measured
+    crossover (the CudnnAlgoMode role, ConvolutionLayer.java:349) —
+    reference SDPA below flash_min_seq, flash at/above, reference always
+    when masked.  The threshold is overridable per layer and by env."""
+    import deeplearning4j_tpu.ops.attention as A
+    import deeplearning4j_tpu.ops.flash_attention as F
+    from deeplearning4j_tpu.nn.layers import attention as L
+
+    calls = []
+    monkeypatch.setattr(F, "flash_attention",
+                        lambda q, k, v, **kw: calls.append("flash") or q)
+    monkeypatch.setattr(A, "sdpa_reference",
+                        lambda q, k, v, **kw: calls.append("ref") or q)
+    short = jnp.zeros((1, 2, 64, 64), jnp.float32)   # below the min tile
+    long = jnp.zeros((1, 2, max(L.DEFAULT_FLASH_MIN_SEQ, 128), 64),
+                     jnp.float32)
+    run = lambda q, **kw: L._run_attention(q, q, q, impl="auto", causal=True,
+                                           seq_axis="seq", **kw)
+    run(short, mask=None)                      # below crossover -> reference
+    run(long, mask=None)                       # at crossover -> flash
+    run(short, mask=None, flash_min_seq=32)    # per-layer override -> flash
+    run(long, mask=None, flash_min_seq=1 << 20)  # raised override -> ref
+    run(long, mask=jnp.ones((1, long.shape[2])))  # masked -> always ref
+    assert calls == ["ref", "flash", "flash", "ref", "ref"]

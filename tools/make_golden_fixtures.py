@@ -1,0 +1,104 @@
+"""Generate golden serialization fixtures (reference ``regressiontest/`` +
+dl4j-test-resources role).
+
+Run ONCE per new fixture version under the same environment the test suite
+uses (CPU backend, x64 enabled — tests/conftest.py), then COMMIT the
+outputs; later rounds must load them unchanged.  Never regenerate an
+existing fixture to make a failing test pass — that inverts the contract.
+
+    env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/make_golden_fixtures.py cnn transformer
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)    # match tests/conftest.py
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning4j_tpu import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.conf.multi_layer import \
+    NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.conf.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.utils.model_serializer import \
+    write_model  # noqa: E402
+
+RES = "tests/resources"
+
+
+def make_cnn():
+    """Conv + BatchNormalization + pooling golden model — the layer family
+    most exposed to perf work (ResNet50 campaign) and previously absent
+    from the serde-stability net."""
+    from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                              ConvolutionLayer, DenseLayer,
+                                              OutputLayer, SubsamplingLayer)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(20260731).activation("relu").weight_init("xavier")
+            .updater(Adam(learning_rate=0.01))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+            .layer(BatchNormalization())
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=10))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((16, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+    for _ in range(5):
+        net.fit_batch((x, y))          # Adam moments + BN running stats
+    write_model(net, f"{RES}/golden_cnn_v1.zip")
+    probe = jnp.asarray(rng.standard_normal((4, 8, 8, 1)), jnp.float32)
+    np.savez(f"{RES}/golden_cnn_v1_io.npz", probe=np.asarray(probe),
+             output=np.asarray(net.output(probe)))
+    print("wrote golden_cnn_v1")
+
+
+def make_transformer():
+    """Transformer golden model with an explicit KV-cache capacity
+    (max_cache_len) in the config — covers the attention-layer serde
+    surface (attn_impl/flash_min_seq fields) and incremental-decode
+    configuration."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        PositionalEncodingLayer, TransformerBlock)
+    from deeplearning4j_tpu.nn.layers.feedforward import \
+        EmbeddingSequenceLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    V, T = 32, 12
+    conf = (NeuralNetConfiguration.builder()
+            .seed(20260731).weight_init("xavier")
+            .updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_out=16))
+            .layer(PositionalEncodingLayer())
+            .layer(TransformerBlock(n_heads=2, causal=True,
+                                    attn_impl="reference",
+                                    max_cache_len=24))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(V, T))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, V, (8, T + 1))
+    x = jnp.asarray(ids[:, :-1])
+    y = jnp.asarray(np.eye(V, dtype=np.float32)[ids[:, 1:]])
+    for _ in range(5):
+        net.fit_batch((x, y))
+    write_model(net, f"{RES}/golden_transformer_v1.zip")
+    probe = jnp.asarray(rng.integers(0, V, (3, T)))
+    np.savez(f"{RES}/golden_transformer_v1_io.npz", probe=np.asarray(probe),
+             output=np.asarray(net.output(probe)))
+    print("wrote golden_transformer_v1")
+
+
+if __name__ == "__main__":
+    targets = sys.argv[1:] or ["cnn", "transformer"]
+    for t in targets:
+        {"cnn": make_cnn, "transformer": make_transformer}[t]()
