@@ -104,9 +104,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
     from deeplearning4j_tpu.utils import benchmarks as B
 
     def capture(fn, retries=1, backoff_s=30):
-        # probe BEFORE spending capture time: back off while the window is
-        # sick, then capture once and attach the probe taken adjacent to
-        # the capture (the probe must describe the data's window)
+        # probe BEFORE spending capture time (back off while the window is
+        # sick) AND after it: degradation that starts mid-capture must not
+        # ship as a healthy row
         probe = B.tunnel_probe()
         for _ in range(retries):
             if probe["healthy"]:
@@ -114,9 +114,13 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
             time.sleep(backoff_s)
             probe = B.tunnel_probe()
         rows = fn()
+        probe_after = B.tunnel_probe()
         rows = rows if isinstance(rows, list) else [rows]
+        bracket = {"before": probe, "after": probe_after,
+                   "healthy": bool(probe["healthy"]
+                                   and probe_after["healthy"])}
         for r in rows:
-            r["tunnel_probe"] = probe
+            r["tunnel_probe"] = bracket
         return rows
 
     side = []

@@ -69,6 +69,15 @@ def lattice_segment(text: str, lexicon: Dict[str, float], *,
                     if sc > best[j]:
                         best[j] = sc
                         back[j] = i
+            elif k == "han" and i + 2 <= n and _script(text[i + 1]) == "han":
+                # unknown kanji compounds decompose into 2-char units (the
+                # dominant Sino-Japanese word shape; kuromoji's search-mode
+                # heuristic makes the same bet) — scored just above two
+                # OOV singles so any real lexicon word still outranks it
+                sc = best[i] + oov_logp * 1.9
+                if sc > best[i + 2]:
+                    best[i + 2] = sc
+                    back[i + 2] = i
     out: List[str] = []
     i = n
     while i > 0:
@@ -166,21 +175,45 @@ class JapaneseTokenizerFactory(TokenizerFactory):
 
     def create(self, sentence: str) -> Tokenizer:
         tokens: List[str] = []
+
+        def flush(run):
+            # merge per lattice run: single-char kata fallbacks must not
+            # fuse across punctuation/space boundaries
+            tokens.extend(_merge_kata_singles(lattice_segment(
+                run, self.lexicon, max_len=self._max_word,
+                run_candidates=True)))
+
         run = ""
         for ch in sentence:
             if _script(ch) in ("space", "punct"):
                 if run:
-                    tokens.extend(lattice_segment(
-                        run, self.lexicon, max_len=self._max_word,
-                        run_candidates=True))
+                    flush(run)
                     run = ""
             else:
                 run += ch
         if run:
-            tokens.extend(lattice_segment(run, self.lexicon,
-                                          max_len=self._max_word,
-                                          run_candidates=True))
+            flush(run)
         return Tokenizer(tokens, self._pre)
+
+
+def _merge_kata_singles(tokens: List[str]) -> List[str]:
+    """Fuse runs of adjacent single-character katakana fallbacks into one
+    token: when a lexicon word consumes the head of a katakana compound
+    (ソフト|ウ|ェ|ア...), the orphaned chars are one unknown loanword, not
+    letters — kuromoji's unknown-word grouping does the same."""
+    out: List[str] = []
+    run = ""
+    for t in tokens:
+        if len(t) == 1 and (_is_katakana(t) or t == "ー"):
+            run += t
+        else:
+            if run:
+                out.append(run)
+                run = ""
+            out.append(t)
+    if run:
+        out.append(run)
+    return out
 
 
 _KO_PARTICLES = ("은", "는", "이", "가", "을", "를", "의", "에", "에서",
@@ -188,17 +221,46 @@ _KO_PARTICLES = ("은", "는", "이", "가", "을", "를", "의", "에", "에서
 
 
 class KoreanTokenizerFactory(TokenizerFactory):
-    """Reference ``KoreanTokenizerFactory.java``.  Korean spaces between
-    words (eojeol); tokens are whitespace-split with trailing particles
-    (josa) optionally stripped."""
+    """Reference ``KoreanTokenizerFactory.java`` (KOMORAN wrapper role).
+
+    Korean spaces between phrasal units (eojeol); each eojeol runs through
+    the bundled-lexicon Viterbi lattice so nouns split from their trailing
+    particles (josa) and the copula splits 입니|다 — the granularity of the
+    reference's own KoreanTokenizerTest gold.  Runs of unknown single
+    syllables inside one eojeol merge back into one token (an unknown stem
+    is a word, not letters).  ``morphological=False`` restores the round-3
+    behavior (whitespace tokens with trailing particles stripped) — and so
+    does passing ``strip_particles`` explicitly, so existing callers of the
+    legacy knob keep their output."""
 
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
-                 strip_particles: bool = True):
+                 strip_particles: Optional[bool] = None,
+                 morphological: Optional[bool] = None,
+                 dictionary: Optional[Iterable[str]] = None):
         super().__init__(pre_processor)
-        self.strip_particles = strip_particles
+        if morphological is None:
+            # an explicit strip_particles request is a legacy-mode opt-in
+            morphological = strip_particles is None
+        self.strip_particles = (True if strip_particles is None
+                                else strip_particles)
+        self.morphological = morphological
+        from .lexicons import KOREAN_LEXICON
+        self.lexicon: Dict[str, float] = dict(KOREAN_LEXICON)
+        for w in dictionary or ():
+            self.lexicon[w] = _USER_WORD_LOGP
+        self._max_word = max((len(w) for w in self.lexicon), default=1)
 
     def create(self, sentence: str) -> Tokenizer:
         words = re.findall(r"[\w가-힯]+", sentence)
+        if self.morphological:
+            tokens: List[str] = []
+            for w in words:
+                if not _is_hangul(w[0]):
+                    tokens.append(w)
+                    continue
+                tokens.extend(self._merge_unknown_singles(lattice_segment(
+                    w, self.lexicon, max_len=self._max_word)))
+            return Tokenizer(tokens, self._pre)
         if self.strip_particles:
             out = []
             for w in words:
@@ -210,3 +272,20 @@ class KoreanTokenizerFactory(TokenizerFactory):
                 out.append(w)
             words = out
         return Tokenizer(words, self._pre)
+
+    def _merge_unknown_singles(self, tokens: List[str]) -> List[str]:
+        """Adjacent single-syllable OOV fallbacks fuse into one unknown
+        word; lexicon singles (particles, endings) stay separate."""
+        out: List[str] = []
+        run = ""
+        for t in tokens:
+            if len(t) == 1 and t not in self.lexicon and _is_hangul(t):
+                run += t
+            else:
+                if run:
+                    out.append(run)
+                    run = ""
+                out.append(t)
+        if run:
+            out.append(run)
+        return out

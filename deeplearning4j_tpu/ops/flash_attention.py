@@ -42,6 +42,8 @@ def _auto_blocks(t_q: int, t_k: int, d: int):
     role (``ConvolutionLayer.java:349``) resolved by sweep instead of
     per-call search."""
     def pick(t, cap):
+        if t <= 128:
+            return t          # sub-tile sequences run as one block
         b = max(128, min(t, cap // max(d, 1)))
         # round down to a power of two, then to a divisor of t
         b = 1 << (b.bit_length() - 1)
