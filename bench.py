@@ -123,27 +123,35 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
             r["tunnel_probe"] = bracket
         return rows
 
+    captures = [
+        B.lenet_step_time,
+        B.char_lstm_step_time,
+        B.word2vec_words_per_sec,
+        lambda: B.paragraph_vectors_words_per_sec(seq_algo="dbow"),
+        lambda: B.paragraph_vectors_words_per_sec(seq_algo="dm"),
+        # transformer campaign rows (VERDICT r3 item 1): auto vs manual at
+        # the four headline lengths; the full matrix lives in BENCH_NOTES
+        B.transformer_lm_step_time,                        # s=512, 3 impls
+        lambda: B.transformer_lm_step_time(
+            batch=64, seq=128, impls=("auto", "reference")),
+        lambda: B.transformer_lm_step_time(
+            batch=4, seq=2048, impls=("auto", "reference")),
+        lambda: B.transformer_lm_step_time(
+            batch=1, seq=8192, impls=("auto", "flash"), nbatch=3, epochs=1),
+        lambda: B.transformer_lm_step_time(
+            batch=1, seq=8192, impls=("reference",), nbatch=2, epochs=1,
+            blocks=1),
+        # serving under load (VERDICT r3 item 8): p50/p99 + throughput,
+        # dynamic batching vs synchronous
+        B.serving_latency,
+    ]
     side = []
-    side += capture(B.lenet_step_time)
-    side += capture(B.char_lstm_step_time)
-    side += capture(B.word2vec_words_per_sec)
-    side += capture(lambda: B.paragraph_vectors_words_per_sec(
-        seq_algo="dbow"))
-    side += capture(lambda: B.paragraph_vectors_words_per_sec(seq_algo="dm"))
-    # transformer campaign rows (VERDICT r3 item 1): auto vs manual at the
-    # four headline lengths; the full measured matrix lives in BENCH_NOTES
-    side += capture(B.transformer_lm_step_time)             # s=512, 3 impls
-    side += capture(lambda: B.transformer_lm_step_time(
-        batch=64, seq=128, impls=("auto", "reference")))
-    side += capture(lambda: B.transformer_lm_step_time(
-        batch=4, seq=2048, impls=("auto", "reference")))
-    side += capture(lambda: B.transformer_lm_step_time(
-        batch=1, seq=8192, impls=("auto", "flash"), nbatch=3, epochs=1))
-    side += capture(lambda: B.transformer_lm_step_time(
-        batch=1, seq=8192, impls=("reference",), nbatch=2, epochs=1,
-        blocks=1))
-    with open(path, "w") as f:
-        json.dump(side, f, indent=1)
+    for fn in captures:
+        side += capture(fn)
+        # write after every capture so a killed run still leaves a
+        # readable (partial) artifact
+        with open(path, "w") as f:
+            json.dump(side, f, indent=1)
     for row in side:
         print(json.dumps(row))
 

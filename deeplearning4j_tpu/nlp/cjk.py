@@ -118,6 +118,25 @@ def _script(ch: str) -> str:
     return "punct"
 
 
+_MAX_WORD_CACHE: Dict[int, int] = {}
+
+
+def _factory_lexicon(base: Dict[str, float], dictionary):
+    """Share the module-level lexicon (38k+ entries after the round-4 data
+    tiers — copying per factory would be an O(lexicon) tax on every
+    instantiation) unless a user dictionary extends it; the max word
+    length is cached per base dict."""
+    if dictionary:
+        lex = dict(base)
+        for w in dictionary:
+            lex[w] = _USER_WORD_LOGP
+        return lex, max((len(w) for w in lex), default=1)
+    key = id(base)
+    if key not in _MAX_WORD_CACHE:
+        _MAX_WORD_CACHE[key] = max((len(w) for w in base), default=1)
+    return base, _MAX_WORD_CACHE[key]
+
+
 class ChineseTokenizerFactory(TokenizerFactory):
     """Reference ``ChineseTokenizerFactory.java`` (ansj).  Han runs are
     segmented by the bundled-lexicon Viterbi lattice; an optional user
@@ -127,10 +146,8 @@ class ChineseTokenizerFactory(TokenizerFactory):
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
                  dictionary: Optional[Iterable[str]] = None):
         super().__init__(pre_processor)
-        self.lexicon: Dict[str, float] = dict(CHINESE_LEXICON)
-        for w in dictionary or ():
-            self.lexicon[w] = _USER_WORD_LOGP
-        self._max_word = max((len(w) for w in self.lexicon), default=1)
+        self.lexicon, self._max_word = _factory_lexicon(CHINESE_LEXICON,
+                                                        dictionary)
 
     def create(self, sentence: str) -> Tokenizer:
         tokens: List[str] = []
@@ -168,10 +185,8 @@ class JapaneseTokenizerFactory(TokenizerFactory):
     def __init__(self, pre_processor: Optional[TokenPreProcess] = None,
                  dictionary: Optional[Iterable[str]] = None):
         super().__init__(pre_processor)
-        self.lexicon: Dict[str, float] = dict(JAPANESE_LEXICON)
-        for w in dictionary or ():
-            self.lexicon[w] = _USER_WORD_LOGP
-        self._max_word = max((len(w) for w in self.lexicon), default=1)
+        self.lexicon, self._max_word = _factory_lexicon(JAPANESE_LEXICON,
+                                                        dictionary)
 
     def create(self, sentence: str) -> Tokenizer:
         tokens: List[str] = []
@@ -245,10 +260,8 @@ class KoreanTokenizerFactory(TokenizerFactory):
                                 else strip_particles)
         self.morphological = morphological
         from .lexicons import KOREAN_LEXICON
-        self.lexicon: Dict[str, float] = dict(KOREAN_LEXICON)
-        for w in dictionary or ():
-            self.lexicon[w] = _USER_WORD_LOGP
-        self._max_word = max((len(w) for w in self.lexicon), default=1)
+        self.lexicon, self._max_word = _factory_lexicon(KOREAN_LEXICON,
+                                                        dictionary)
 
     def create(self, sentence: str) -> Tokenizer:
         words = re.findall(r"[\w가-힯]+", sentence)

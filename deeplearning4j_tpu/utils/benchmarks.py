@@ -3,9 +3,11 @@ step time, GravesLSTM char-RNN step time, Word2Vec words/sec).  The driver's
 headline ResNet50 metric lives in ``bench.py``; these side metrics are
 invoked from there (DL4J_TPU_BENCH_SIDE=1) and from ``tools/``.
 
-All timings are steady-state: compile + warm step first, then ``n_iter``
-timed steps closed with a forced device→host fetch (block_until_ready alone
-can return early through buffer-proxying transports — BENCH_NOTES round 1).
+All timings are steady-state (compile + warm first) and close on a forced
+device→host fetch — block_until_ready alone can return early through
+buffer-proxying transports (BENCH_NOTES round 1).  Training rows time the
+device-resident epoch scan (``_scan_step_ms``), the path the framework
+actually trains through.
 """
 from __future__ import annotations
 
@@ -15,47 +17,41 @@ from typing import Dict, List
 import numpy as np
 
 
-def _steady_step_ms(model, x, y, n_iter: int = 20, blocks: int = 3) -> float:
-    """Median of ``blocks`` timed n_iter-step blocks — the tunnel's
-    throughput drifts (observed 18-27 ms swings on identical LeNet steps),
-    so a single block is not a stable artifact."""
-    import jax
-    import jax.numpy as jnp
-
-    model.fit(x, y)           # compile + first step
-    step = model._get_jitted("train_step")
+def _scan_step_ms(model, x, y, batch: int, nbatch: int, epochs: int = 2,
+                  blocks: int = 3) -> float:
+    """Per-step ms through the device-resident epoch scan (fit_on_device:
+    one dispatch per epoch).  The per-step-dispatch path measures the
+    tunnel as much as the chip — its trivial-dispatch latency drifted
+    24 -> 90+ ms between rounds (BENCH_NOTES "tunnel health"), which is
+    environment, not framework."""
+    model.fit_on_device(x, y, batch_size=batch, epochs=1)   # compile+warm
+    steps = nbatch * epochs
     times = []
     for _ in range(blocks):
         t0 = time.perf_counter()
-        for _ in range(n_iter):
-            model._rng, key = jax.random.split(model._rng)
-            (model.params, model.state, model.opt_state, loss,
-             model._last_grad_stats) = step(
-                model.params, model.state, model.opt_state, key,
-                x, y, None, None)
-        float(jnp.asarray(loss))
-        times.append((time.perf_counter() - t0) / n_iter * 1e3)
+        model.fit_on_device(x, y, batch_size=batch, epochs=epochs)
+        times.append((time.perf_counter() - t0) / steps * 1e3)
     return float(np.median(times))
 
 
-def lenet_step_time(batch: int = 128, n_iter: int = 20) -> Dict:
+def lenet_step_time(batch: int = 128, nbatch: int = 50) -> Dict:
     """LeNet-MNIST training step time (zoo ``model/LeNet.java:35``)."""
     import jax.numpy as jnp
 
     from ..models import LeNet
     model = LeNet().init()
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 28, 28, 1), dtype=np.float32))
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[
-        rng.integers(0, 10, batch)])
-    ms = _steady_step_ms(model, x, y, n_iter)
+    n = batch * nbatch
+    x = jnp.asarray(rng.standard_normal((n, 28, 28, 1), dtype=np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)])
+    ms = _scan_step_ms(model, x, y, batch, nbatch)
     return {"metric": "lenet_mnist_step_ms", "value": round(ms, 3),
             "unit": "ms/step", "batch": batch,
             "examples_per_sec": round(batch / ms * 1e3, 1)}
 
 
 def char_lstm_step_time(batch: int = 128, timesteps: int = 64,
-                        n_iter: int = 20) -> Dict:
+                        nbatch: int = 30) -> Dict:
     """Char-RNN step time (zoo ``model/TextGenerationLSTM.java:34``; the
     reference's cuDNN LSTM path, ``GravesLSTM.java:46``)."""
     import jax.numpy as jnp
@@ -64,11 +60,12 @@ def char_lstm_step_time(batch: int = 128, timesteps: int = 64,
     model = TextGenerationLSTM(timesteps=timesteps).init()
     rng = np.random.default_rng(0)
     vocab = 26
+    n = batch * nbatch
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[
-        rng.integers(0, vocab, (batch, timesteps))])
+        rng.integers(0, vocab, (n, timesteps))])
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
-        rng.integers(0, vocab, (batch, timesteps))])
-    ms = _steady_step_ms(model, x, y, n_iter)
+        rng.integers(0, vocab, (n, timesteps))])
+    ms = _scan_step_ms(model, x, y, batch, nbatch)
     return {"metric": "char_lstm_step_ms", "value": round(ms, 3),
             "unit": "ms/step", "batch": batch, "timesteps": timesteps,
             "tokens_per_sec": round(batch * timesteps / ms * 1e3, 1)}
@@ -168,20 +165,14 @@ def transformer_lm_step_time(batch: int = 16, seq: int = 512,
     tokens = batch * seq
     flops = (6 * tokens * (12 * n_layers * embed * embed + embed * vocab)
              + 6 * n_layers * batch * seq * seq * embed)
-    steps = nbatch * epochs
     out = []
     for impl in impls:
         model = TransformerLM(vocab_size=vocab, seq_len=seq, embed=embed,
                               n_layers=n_layers, n_heads=n_heads,
                               attn_impl=impl, sparse_labels=True,
                               compute_dtype="bfloat16").init()
-        model.fit_on_device(x, y, batch_size=batch, epochs=1)  # compile+warm
-        times = []
-        for _ in range(blocks):
-            t0 = time.perf_counter()
-            model.fit_on_device(x, y, batch_size=batch, epochs=epochs)
-            times.append((time.perf_counter() - t0) / steps * 1e3)
-        ms = float(np.median(times))
+        ms = _scan_step_ms(model, x, y, batch, nbatch, epochs=epochs,
+                           blocks=blocks)
         out.append({
             "metric": f"transformer_lm_step_ms[{impl},s={seq}]",
             "value": round(ms, 3), "unit": "ms/step",
@@ -189,6 +180,67 @@ def transformer_lm_step_time(batch: int = 16, seq: int = 512,
             "n_layers": n_layers, "sparse_labels": True,
             "tokens_per_sec": round(tokens / ms * 1e3, 1),
             "achieved_tflops": round(flops / ms / 1e9, 2),
+        })
+    return out
+
+
+def serving_latency(concurrency: int = 16,
+                    n_requests: int = 400, model=None) -> List[Dict]:
+    """Serving under load (VERDICT r3 item 8; mirror
+    ``ParallelInference.java:32`` + ``InferenceMode.BATCHED``): p50/p99
+    single-request latency and delivered throughput at a stated
+    concurrency, batched (dynamic coalescing) vs unbatched (INPLACE
+    synchronous).  Requests are singleton feature rows fired from
+    ``concurrency`` client threads against one LeNet-sized model."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from ..models import LeNet
+    from ..parallel.inference import InferenceMode, ParallelInference
+
+    if model is None:
+        model = LeNet().init()
+    rng = np.random.default_rng(0)
+    probe = rng.standard_normal((784,)).astype(np.float32)  # LeNet takes
+    out = []                 # flat MNIST rows (feed-forward input + reshape)
+    for mode in (InferenceMode.BATCHED, InferenceMode.INPLACE):
+        pi = ParallelInference(model, inference_mode=mode,
+                               max_batch_size=32)
+        # warm every coalescing bucket so no compile lands in a timed
+        # request (XLA compiles per padded shape)
+        for b in (1, 2, 4, 8, 16, 32):
+            pi.output(np.stack([probe] * b))
+        lats: List[float] = []
+        lock = threading.Lock()
+        per_worker = n_requests // concurrency
+
+        def client():
+            mine = []
+            for _ in range(per_worker):
+                t0 = time.perf_counter()
+                np.asarray(pi.output(probe))  # host-synced result
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        pi.shutdown()
+        lats_ms = np.asarray(sorted(lats)) * 1e3
+        out.append({
+            "metric": f"serving_latency_ms[{mode.lower()},c={concurrency}]",
+            "value": round(float(np.percentile(lats_ms, 50)), 2),
+            "unit": "ms p50", "concurrency": concurrency,
+            "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+            "requests": len(lats),
+            "requests_per_sec": round(len(lats) / wall, 1),
         })
     return out
 
