@@ -33,6 +33,7 @@ Protocol framing (Kafka protocol guide):
 """
 from __future__ import annotations
 
+import gzip
 import socket
 import socketserver
 import struct
@@ -173,8 +174,11 @@ def encode_message_set(values: List[bytes],
     return out
 
 
-def decode_message_set(data: bytes) -> List[Tuple[int, bytes]]:
-    """[(offset, value)] — raises on CRC mismatch (torn/corrupt message)."""
+def decode_message_set(data: bytes, _depth: int = 0) -> List[Tuple[int, bytes]]:
+    """[(offset, value)] — raises on CRC mismatch (torn/corrupt message).
+    gzip wrapper envelopes (legacy v0 compression) unwrap ONE level — real
+    producers never nest them, and unbounded recursion on crafted input
+    would escape as RecursionError."""
     out: List[Tuple[int, bytes]] = []
     off = 0
     while off + 12 <= len(data):
@@ -190,14 +194,34 @@ def decode_message_set(data: bytes) -> List[Tuple[int, bytes]]:
         r = _Reader(msg)
         r.take("I")          # crc
         _magic, attrs = r.take("bb")
-        if attrs & 0x07:
+        codec = attrs & 0x07
+        if codec not in (_CODEC_NONE, _CODEC_GZIP):
             raise ValueError(
-                f"message at offset {offset}: compressed message sets "
-                f"(attrs={attrs:#x}) are not supported — produce uncompressed")
+                f"message at offset {offset}: "
+                f"{_CODEC_NAMES.get(codec, codec)}-compressed message sets "
+                "are not supported (this environment has gzip only)")
         r.bytes_()           # key
         value = r.bytes_()
-        out.append((offset, value or b""))
+        if codec == _CODEC_GZIP:
+            if _depth:
+                raise ValueError(f"message at offset {offset}: nested "
+                                 "compression envelopes are not valid")
+            inner = _gunzip_or_raise(value or b"", offset)
+            out.extend(decode_message_set(inner, _depth=1))
+        else:
+            out.append((offset, value or b""))
     return out
+
+
+def _gunzip_or_raise(payload: bytes, where) -> bytes:
+    """gzip.decompress with torn/corrupt streams normalized to the
+    decoder's ValueError contract (EOFError/zlib.error otherwise escape
+    the broker's malformed-request guard)."""
+    try:
+        return gzip.decompress(payload)
+    except (EOFError, OSError, zlib.error) as e:
+        raise ValueError(f"message at offset {where}: corrupt gzip "
+                         f"payload ({e})")
 
 
 # ------------------------------------------------------- v2 record batches
@@ -213,10 +237,27 @@ def _encode_record(offset_delta: int, value: bytes,
     return _varint(len(body)) + body
 
 
-def encode_record_batch(values: List[bytes], base_offset: int = 0) -> bytes:
-    """One v2 RecordBatch holding ``values`` (uncompressed, no producer)."""
+_CODEC_NONE, _CODEC_GZIP = 0, 1
+_CODEC_NAMES = {0: "none", 1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
+
+
+def encode_record_batch(values: List[bytes], base_offset: int = 0,
+                        compression: Optional[str] = None) -> bytes:
+    """One v2 RecordBatch holding ``values`` (no producer id).
+
+    ``compression="gzip"`` compresses the records section and sets the
+    codec bits in attributes (KIP-98 batch format: the batch header stays
+    uncompressed, CRC32C covers attributes..compressed-records)."""
+    if compression not in (None, "none", "gzip"):
+        raise ValueError(f"unsupported compression {compression!r} "
+                         "(stdlib provides gzip; snappy/lz4/zstd are not "
+                         "in this environment)")
     records = b"".join(_encode_record(i, v) for i, v in enumerate(values))
-    after_crc = (struct.pack(">hiqqqhii", 0, len(values) - 1, 0, 0,
+    attrs = _CODEC_NONE
+    if compression == "gzip":
+        records = gzip.compress(records)
+        attrs = _CODEC_GZIP
+    after_crc = (struct.pack(">hiqqqhii", attrs, len(values) - 1, 0, 0,
                              -1, -1, -1, len(values))
                  + records)
     crc = crc32c(after_crc)
@@ -245,22 +286,29 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
                 f"record batch at {base_offset}: CRC32C mismatch")
         (attrs, _last_delta, _bts, _mts, _pid, _pepoch, _bseq,
          n_records) = struct.unpack_from(">hiqqqhii", body, 0)
-        if attrs & 0x07:
-            raise ValueError(
-                f"record batch at {base_offset}: compressed batches "
-                f"(attrs={attrs:#x}) are not supported — produce uncompressed")
+        codec = attrs & 0x07
         p = struct.calcsize(">hiqqqhii")
+        if codec == _CODEC_GZIP:
+            recs = _gunzip_or_raise(body[p:], base_offset)
+            p = 0
+        elif codec == _CODEC_NONE:
+            recs = body
+        else:
+            raise ValueError(
+                f"record batch at {base_offset}: "
+                f"{_CODEC_NAMES.get(codec, codec)}-compressed batches are "
+                "not supported (this environment has gzip only)")
         for _ in range(n_records):
-            rec_len, p = _read_varint(body, p)
+            rec_len, p = _read_varint(recs, p)
             end = p + rec_len
             p += 1                         # record attributes
-            _ts, p = _read_varint(body, p)
-            odelta, p = _read_varint(body, p)
-            klen, p = _read_varint(body, p)
+            _ts, p = _read_varint(recs, p)
+            odelta, p = _read_varint(recs, p)
+            klen, p = _read_varint(recs, p)
             if klen >= 0:
                 p += klen
-            vlen, p = _read_varint(body, p)
-            value = body[p:p + vlen] if vlen >= 0 else b""
+            vlen, p = _read_varint(recs, p)
+            value = recs[p:p + vlen] if vlen >= 0 else b""
             out.append((base_offset + odelta, value))
             p = end                        # skip headers
         off += 12 + length
@@ -358,13 +406,18 @@ class KafkaWireClient:
             self.fetch_version = 4
         return self
 
-    def produce(self, topic: str, partition: int,
-                values: List[bytes]) -> int:
+    def produce(self, topic: str, partition: int, values: List[bytes],
+                compression: Optional[str] = None) -> int:
         """Append messages; returns the base offset assigned.  Encodes a v2
         RecordBatch after ``negotiate()`` (produce_version 3), a v0 message
-        set otherwise."""
+        set otherwise.  ``compression="gzip"`` compresses the v2 records
+        section (legacy message sets stay uncompressed — use the modern
+        path for compressed payloads)."""
         v3 = self.produce_version >= 3
-        mset = encode_record_batch(values) if v3 \
+        if compression not in (None, "none") and not v3:
+            raise ValueError("compression requires the v2 record-batch "
+                             "path — call negotiate() first")
+        mset = encode_record_batch(values, compression=compression) if v3 \
             else encode_message_set(values)
         body = (struct.pack(">h", -1) if v3 else b"")  # transactional_id
         body += (struct.pack(">hi", 1, int(self.timeout * 1000))  # acks=1
