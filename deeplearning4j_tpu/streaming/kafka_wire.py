@@ -206,22 +206,35 @@ def decode_message_set(data: bytes, _depth: int = 0) -> List[Tuple[int, bytes]]:
             if _depth:
                 raise ValueError(f"message at offset {offset}: nested "
                                  "compression envelopes are not valid")
-            inner = _gunzip_or_raise(value or b"", offset)
+            inner = _gunzip_or_raise(value or b"",
+                                     f"message at offset {offset}")
             out.extend(decode_message_set(inner, _depth=1))
         else:
             out.append((offset, value or b""))
     return out
 
 
-def _gunzip_or_raise(payload: bytes, where) -> bytes:
-    """gzip.decompress with torn/corrupt streams normalized to the
-    decoder's ValueError contract (EOFError/zlib.error otherwise escape
-    the broker's malformed-request guard)."""
+_MAX_GUNZIP = 1 << 26   # 64 MiB expansion cap — gzip-bomb guard
+
+
+def _gunzip_or_raise(payload: bytes, what: str) -> bytes:
+    """Bounded gzip decompression with torn/corrupt streams normalized to
+    the decoder's ValueError contract (EOFError/zlib.error otherwise
+    escape the broker's malformed-request guard).  The expansion cap stops
+    a small crafted bomb from materializing gigabytes before record
+    parsing ever runs."""
     try:
-        return gzip.decompress(payload)
+        d = zlib.decompressobj(wbits=31)          # gzip wrapper
+        out = d.decompress(payload, _MAX_GUNZIP)
+        if d.unconsumed_tail:
+            raise ValueError(f"{what}: gzip payload expands past "
+                             f"{_MAX_GUNZIP} bytes")
+        if not d.eof:
+            raise ValueError(f"{what}: corrupt gzip payload "
+                             "(truncated stream)")
+        return out
     except (EOFError, OSError, zlib.error) as e:
-        raise ValueError(f"message at offset {where}: corrupt gzip "
-                         f"payload ({e})")
+        raise ValueError(f"{what}: corrupt gzip payload ({e})")
 
 
 # ------------------------------------------------------- v2 record batches
@@ -289,7 +302,8 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
         codec = attrs & 0x07
         p = struct.calcsize(">hiqqqhii")
         if codec == _CODEC_GZIP:
-            recs = _gunzip_or_raise(body[p:], base_offset)
+            recs = _gunzip_or_raise(
+                body[p:], f"record batch at {base_offset}")
             p = 0
         elif codec == _CODEC_NONE:
             recs = body

@@ -310,6 +310,24 @@ class TestKafkaWire:
         with pytest.raises(ValueError, match="gzip"):
             decode_record_batches(bytes(comp))
 
+
+    def test_gzip_bomb_rejected(self):
+        """A small batch expanding past the 64 MiB cap is rejected before
+        the expansion materializes (broker OOM guard)."""
+        import gzip as _gzip
+        import struct as _struct
+        from deeplearning4j_tpu.streaming.kafka_wire import (
+            crc32c, decode_record_batches, encode_record_batch)
+        bomb = _gzip.compress(b"\x00" * (100 << 20))     # ~100 KiB wire
+        batch = bytearray(encode_record_batch([b"x"], compression="gzip"))
+        header_len = 12 + 9 + _struct.calcsize(">hiqqqhii")
+        batch = batch[:header_len] + bomb
+        _struct.pack_into(">i", batch, 8, len(batch) - 12)
+        _struct.pack_into(">I", batch, 12 + 5, crc32c(bytes(batch[12 + 9:])))
+        import pytest
+        with pytest.raises(ValueError, match="expands past"):
+            decode_record_batches(bytes(batch))
+
     def test_ndarray_client_negotiates_v2(self):
         import numpy as np
         from deeplearning4j_tpu.streaming.kafka_wire import (MiniKafkaBroker,
