@@ -152,6 +152,7 @@ class MultiprocessMaster:
                  worker_env: Optional[Dict[str, str]] = None,
                  max_task_retries: int = 2,
                  agreement_tol: float = 1e-3,
+                 workdir: Optional[str] = None,
                  fault_injection: Optional[Dict[str, Any]] = None):
         if mode not in ("averaging", "shared"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -164,6 +165,7 @@ class MultiprocessMaster:
         self.worker_env = dict(worker_env or {})
         self.max_task_retries = max_task_retries
         self.agreement_tol = agreement_tol
+        self.workdir = workdir   # parent for auto-created job directories
         self.fault_injection = dict(fault_injection or {})
         self.last_results: List[Dict[str, Any]] = []
         self.retried_workers: set = set()
@@ -391,7 +393,8 @@ class MultiprocessMaster:
 
         from .master import _chunk_batches
 
-        jobdir = jobdir or tempfile.mkdtemp(prefix="dl4j_mp_")
+        jobdir = jobdir or tempfile.mkdtemp(prefix="dl4j_mp_",
+                                            dir=self.workdir)
         os.makedirs(jobdir, exist_ok=True)
         parts = _chunk_batches(iterator, self.num_workers)
         for w, part in enumerate(parts):

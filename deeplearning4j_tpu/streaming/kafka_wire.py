@@ -45,11 +45,12 @@ __all__ = ["KafkaWireClient", "MiniKafkaBroker", "NDArrayKafkaClient"]
 
 _API_PRODUCE = 0
 _API_FETCH = 1
+_API_METADATA = 3
 _API_VERSIONS = 18
 
 # what the mini-broker advertises via ApiVersions (both generations)
 _BROKER_API_VERSIONS = {_API_PRODUCE: (0, 3), _API_FETCH: (0, 4),
-                        _API_VERSIONS: (0, 0)}
+                        _API_METADATA: (0, 0), _API_VERSIONS: (0, 0)}
 
 
 # ------------------------------------------------------------------- crc32c
@@ -331,9 +332,10 @@ def decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
 
 # ------------------------------------------------------------------ client
 class KafkaWireClient:
-    """Minimal Kafka v0 client: produce/fetch against one broker (the
-    bootstrap broker is assumed to lead the addressed partitions — the
-    single-node dev case; a full metadata round is out of scope)."""
+    """Minimal Kafka client: produce/fetch/metadata against one broker.
+    Requests go to the bootstrap broker; ``metadata()`` reports the real
+    partition leaders so callers can verify the single-node assumption
+    (cross-broker routing itself stays out of scope)."""
 
     def __init__(self, host: str, port: int, client_id: str = "dl4j-tpu",
                  timeout: float = 10.0):
@@ -407,6 +409,37 @@ class KafkaWireClient:
         for _ in range(r.take("i")):
             key, lo, hi = r.take("h"), r.take("h"), r.take("h")
             out[key] = (lo, hi)
+        return out
+
+    def metadata(self, *topics: str):
+        """Metadata v0 (api_key 3): the cluster's brokers and, per topic,
+        the leader node of every partition.  No ``topics`` = all topics.
+        Returns ``{"brokers": [(node_id, host, port)], "topics": {name:
+        {"error": code, "partitions": {partition: leader_node_id}}}}`` —
+        the round that lets a client CHECK the bootstrap-is-leader
+        assumption instead of assuming it."""
+        body = struct.pack(">i", len(topics))
+        for t in topics:
+            body += _str(t)
+        r = self._roundtrip(_API_METADATA, body)
+        brokers = []
+        for _ in range(r.take("i")):
+            node = r.take("i")
+            host = r.string()
+            brokers.append((node, host, r.take("i")))
+        out = {"brokers": brokers, "topics": {}}
+        for _ in range(r.take("i")):
+            terr = r.take("h")
+            name = r.string()
+            parts: Dict[int, int] = {}
+            for _ in range(r.take("i")):
+                _perr, pid, leader = r.take("h"), r.take("i"), r.take("i")
+                for _ in range(r.take("i")):
+                    r.take("i")               # replicas
+                for _ in range(r.take("i")):
+                    r.take("i")               # isr
+                parts[pid] = leader
+            out["topics"][name] = {"error": terr, "partitions": parts}
         return out
 
     def negotiate(self) -> "KafkaWireClient":
@@ -557,9 +590,40 @@ class MiniKafkaBroker:
             return struct.pack(">i", corr) + self._produce(r, ver)
         if api_key == _API_FETCH:
             return struct.pack(">i", corr) + self._fetch(r, ver)
+        if api_key == _API_METADATA:
+            return struct.pack(">i", corr) + self._metadata(r, ver)
         if api_key == _API_VERSIONS:
             return struct.pack(">i", corr) + self._api_versions()
         return struct.pack(">i", corr)
+
+    def _metadata(self, r: _Reader, ver: int) -> bytes:
+        """Metadata v0: this single node is broker 0 and leads every
+        partition it has a log for; unknown requested topics answer
+        error 3 (UNKNOWN_TOPIC_OR_PARTITION) rather than auto-creating.
+        v1+ layouts differ (controller_id, racks) — close cleanly instead
+        of serving a v0 body a v1 parser would silently desync on."""
+        if ver != 0:
+            raise ValueError(f"metadata v{ver} not supported")
+        wanted = [r.string() for _ in range(r.take("i"))]
+        host, port = self._server.server_address
+        out = struct.pack(">i", 1)                      # one broker
+        out += struct.pack(">i", 0) + _str(host) + struct.pack(">i", port)
+        with self._lock:
+            known: Dict[str, List[int]] = {}
+            for (topic, part) in self._logs:
+                known.setdefault(topic, []).append(part)
+        names = wanted or sorted(known)
+        out += struct.pack(">i", len(names))
+        for name in names:
+            parts = sorted(known.get(name, ()))
+            err = 0 if parts else 3     # UNKNOWN_TOPIC_OR_PARTITION
+            out += struct.pack(">h", err) + _str(name)
+            out += struct.pack(">i", len(parts))
+            for pid in parts:
+                out += struct.pack(">hii", 0, pid, 0)   # leader: node 0
+                out += struct.pack(">ii", 1, 0)         # replicas [0]
+                out += struct.pack(">ii", 1, 0)         # isr [0]
+        return out
 
     @staticmethod
     def _api_versions() -> bytes:

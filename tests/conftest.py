@@ -68,6 +68,7 @@ _SLOW_TESTS = {
     "test_mp_crash_windows_around_done",
     "test_multiprocess_word2vec_matches_thread_version",
     "test_multiprocess_word2vec_retry",
+    "test_early_stopping_over_multiprocess_master",
     "test_pretrained_keras_weights_bridge",
 }
 

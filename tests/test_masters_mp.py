@@ -216,3 +216,50 @@ def test_mp_evaluate_and_score_match_local(tmp_path):
     xs = np.concatenate([b[0] for b in batches])
     ys = np.concatenate([b[1] for b in batches])
     assert s_mp == pytest.approx(model.score(x=xs, y=ys), rel=1e-5)
+
+
+def test_early_stopping_over_multiprocess_master(tmp_path):
+    """The Spark early-stopping topology with REAL worker processes: each
+    epoch is one MultiprocessMaster job (spawn, shard, average, join) and
+    the driver scores/terminates (SparkEarlyStoppingTrainer role)."""
+    from deeplearning4j_tpu.earlystopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingMasterTrainer, InMemoryModelSaver,
+        MaxEpochsTerminationCondition)
+
+    class _Iter:
+        """Replayable batch iterator (the trainer resets per epoch)."""
+
+        def __init__(self, batches):
+            self._batches = batches
+            self._i = 0
+
+        def reset(self):
+            self._i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self._i >= len(self._batches):
+                raise StopIteration
+            self._i += 1
+            return self._batches[self._i - 1]
+
+    model = _model()
+    data = _separable_batches(n_batches=6)
+    master = MultiprocessMaster(num_workers=2, mode="averaging",
+                                averaging_frequency=2, workdir=str(tmp_path),
+                                worker_env=WORKER_ENV, timeout=120.0)
+    xs = np.concatenate([b[0] for b in data])
+    ys = np.concatenate([b[1] for b in data])
+    conf = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(_Iter([(xs, ys)])),
+        epoch_terminations=[MaxEpochsTerminationCondition(2)],
+        model_saver=InMemoryModelSaver())
+    result = EarlyStoppingMasterTrainer(conf, model, master,
+                                        _Iter(data)).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs <= 2
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)

@@ -328,6 +328,32 @@ class TestKafkaWire:
         with pytest.raises(ValueError, match="expands past"):
             decode_record_batches(bytes(batch))
 
+
+    def test_metadata_round(self):
+        """Metadata v0 (api_key 3): broker list + per-topic partition
+        leaders — the round that checks the bootstrap-is-leader assumption
+        instead of assuming it (previously a documented gap)."""
+        from deeplearning4j_tpu.streaming.kafka_wire import (KafkaWireClient,
+                                                             MiniKafkaBroker)
+        broker = MiniKafkaBroker().start()
+        try:
+            c = KafkaWireClient("127.0.0.1", broker.port)
+            c.produce("ta", 0, [b"x"])
+            c.produce("tb", 1, [b"y"])
+            md = c.metadata()
+            assert md["brokers"] == [(0, "127.0.0.1", broker.port)]
+            assert md["topics"]["ta"]["partitions"] == {0: 0}
+            assert md["topics"]["tb"]["partitions"] == {1: 0}
+            # targeted query + unknown topic -> error 3, no auto-create
+            md2 = c.metadata("ta", "nope")
+            assert md2["topics"]["ta"]["error"] == 0
+            assert md2["topics"]["nope"] == {"error": 3, "partitions": {}}
+            # advertised via ApiVersions
+            assert 3 in c.api_versions()
+            c.close()
+        finally:
+            broker.stop()
+
     def test_ndarray_client_negotiates_v2(self):
         import numpy as np
         from deeplearning4j_tpu.streaming.kafka_wire import (MiniKafkaBroker,
