@@ -393,8 +393,10 @@ class MultiprocessMaster:
 
         from .master import _chunk_batches
 
-        jobdir = jobdir or tempfile.mkdtemp(prefix="dl4j_mp_",
-                                            dir=self.workdir)
+        if jobdir is None:
+            if self.workdir:
+                os.makedirs(self.workdir, exist_ok=True)
+            jobdir = tempfile.mkdtemp(prefix="dl4j_mp_", dir=self.workdir)
         os.makedirs(jobdir, exist_ok=True)
         parts = _chunk_batches(iterator, self.num_workers)
         for w, part in enumerate(parts):
