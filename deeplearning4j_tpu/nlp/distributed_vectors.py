@@ -237,10 +237,11 @@ def _worker_main(jobdir: str, wid: int, port: int,
     model.sentence_iterator = CollectionSentenceIterator(shard)
     t0 = time.time()
     model.fit()
+    # close the clock on a host fetch — fit() only enqueues async work
+    tables = _pack_tables(model.lookup_table)
     dt = max(time.time() - t0, 1e-9)
     n_words = sum(len(s.split()) for s in shard) * model.epochs
-    broker.publish(_W2V_FINAL,
-                   _encode_frame(wid, 0, _pack_tables(model.lookup_table)))
+    broker.publish(_W2V_FINAL, _encode_frame(wid, 0, tables))
     broker.publish(_DONE, json.dumps(
         {"wid": wid, "steps": len(shard), "resumed": resume_file is not None,
          "words_per_sec": n_words / dt}).encode())

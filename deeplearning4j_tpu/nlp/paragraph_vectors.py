@@ -50,6 +50,15 @@ class ParagraphVectors(SequenceVectors):
     def _sequences(self) -> Iterable[List[str]]:
         return iter(self._tokens)
 
+    def _raw_sentences(self):
+        """Raw document contents for the native corpus indexer — only when
+        tokenization is exactly ``str.split`` (plain DefaultTokenizerFactory,
+        no pre-processor), mirroring the Word2Vec gate."""
+        if (type(self.tokenizer_factory) is DefaultTokenizerFactory
+                and self.tokenizer_factory._pre is None):
+            return [d.content for d in self._docs]
+        return None
+
     def _sequence_labels(self, seq_index: int) -> Sequence[str]:
         return self._docs[seq_index].labels
 

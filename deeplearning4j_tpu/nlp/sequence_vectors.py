@@ -649,12 +649,25 @@ class SequenceVectors(WordVectors):
             if cache is not None and epoch > 0:
                 source = cache
             else:
-                native_arrs = (self._try_native_index(index_map)
-                               if L == 0 else None)
-                if native_arrs is not None:
+                native_arrs = self._try_native_index(index_map)
+                if native_arrs is not None and L == 0:
                     lab0 = np.full(0, -1, dtype=np.int64)
                     # same empty-sentence skip as the Python path below
                     source = ((a, lab0) for a in native_arrs if a.size)
+                elif native_arrs is not None:
+                    # labeled corpora (ParagraphVectors): native-indexed
+                    # tokens joined with per-sequence label rows; the
+                    # original sequence index is kept through the
+                    # empty-sentence skip so labels stay aligned
+                    def _native_labeled():
+                        for seq_idx, a in enumerate(native_arrs):
+                            if not a.size:
+                                continue
+                            lab = np.full(L, -1, dtype=np.int64)
+                            li = self._label_indices(seq_idx)[:L]
+                            lab[:len(li)] = li
+                            yield a, lab
+                    source = _native_labeled()
                 else:
                     def _index():
                         g = index_map.get
@@ -670,23 +683,30 @@ class SequenceVectors(WordVectors):
                                 lab[:len(li)] = li
                             yield arr, lab
                     source = _index()
-            # chunk buffers
+            # chunk buffers — per-sentence work is just appends; sentence-id
+            # and label rows expand to per-token form ONCE per chunk via
+            # np.repeat (a per-sentence np.tile here measurably bounds
+            # ParagraphVectors throughput: 20k docs = 20k tiny allocations)
             buf_i: List = []
-            buf_s: List = []
+            buf_sid: List = []    # one sentence id per kept sequence
+            buf_cnt: List = []    # kept-token count per kept sequence
             buf_p: List = []
-            buf_l: List = []
+            buf_l: List = []      # one [L] label row per kept sequence
             buf_n = 0
             sent_no = 0
 
             def flush_chunk():
-                nonlocal buf_i, buf_s, buf_p, buf_l, buf_n, pend_n
+                nonlocal buf_i, buf_sid, buf_cnt, buf_p, buf_l, buf_n, pend_n
                 if not buf_i:
                     return
+                cnt = np.asarray(buf_cnt, dtype=np.int64)
                 out = emit_chunk(np.concatenate(buf_i),
-                                 np.concatenate(buf_s),
+                                 np.repeat(np.asarray(buf_sid, np.int32), cnt),
                                  np.concatenate(buf_p),
-                                 np.concatenate(buf_l) if L else None)
-                buf_i, buf_s, buf_p, buf_l, buf_n = [], [], [], [], 0
+                                 np.repeat(np.stack(buf_l, axis=0), cnt,
+                                           axis=0) if L else None)
+                buf_i, buf_sid, buf_cnt, buf_p, buf_l, buf_n = \
+                    [], [], [], [], [], 0
                 if out[0].size:
                     pend.append(out)
                     pend_n += out[0].size
@@ -712,10 +732,11 @@ class SequenceVectors(WordVectors):
                     sent_no += 1
                     continue
                 buf_i.append(idxs)
-                buf_s.append(np.full(idxs.size, sent_no, dtype=np.int32))
+                buf_sid.append(sent_no)
+                buf_cnt.append(idxs.size)
                 buf_p.append(positions)
                 if L:
-                    buf_l.append(np.tile(labrow, (idxs.size, 1)))
+                    buf_l.append(labrow)
                 buf_n += idxs.size
                 sent_no += 1
                 if buf_n >= self._BULK_CHUNK_WORDS:

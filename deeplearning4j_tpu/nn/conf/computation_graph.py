@@ -500,11 +500,13 @@ class ComputationGraphConfiguration:
         for name in self.network_outputs:
             if name not in self.vertices:
                 raise ValueError(f"network output '{name}' is not a vertex")
+        from .multi_layer import validate_layer_names
         for v in self.vertices.values():
             lc = getattr(v, "layer", None)
             # duck-typed: wrapper layers delegate to the layer they wrap
             if hasattr(lc, "apply_global_defaults"):
                 lc.apply_global_defaults(self.defaults)
+            validate_layer_names(lc)
         self.topological_order = self.topo_sort()
 
         # input types per network input

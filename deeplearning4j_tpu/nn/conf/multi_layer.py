@@ -26,6 +26,28 @@ from .preprocessors import (CnnFlatToCnnPreProcessor, CnnToFeedForwardPreProcess
 from ..layers.base import BaseLayerConf, LayerConf
 
 
+def validate_layer_names(lc, _depth: int = 0) -> None:
+    """Fail at CONFIG time on unknown activation/loss names, not at the
+    first fit() (the reference validates configs up front —
+    ``nn/conf/layers/LayerValidation.java``).  Recurses through wrapper
+    layers (Bidirectional ``fwd``, Frozen/LastTimeStep ``underlying``,
+    graph LayerVertex ``layer``)."""
+    if lc is None or _depth > 4:
+        return
+    from ..activations import get as _get_act
+    from ..losses import get as _get_loss
+    act = getattr(lc, "activation", None)
+    if isinstance(act, str):
+        _get_act(act)
+    loss = getattr(lc, "loss", None)
+    if isinstance(loss, str):
+        _get_loss(loss)
+    for attr in ("fwd", "underlying", "layer"):
+        inner = getattr(lc, attr, None)
+        if inner is not lc and isinstance(inner, LayerConf):
+            validate_layer_names(inner, _depth + 1)
+
+
 def _auto_preprocessor(prev: InputType, layer: LayerConf) -> Optional[InputPreProcessor]:
     """Insert a reshape adapter when layer families change
     (reference ``nn/conf/layers/InputTypeUtil.java`` + per-layer
@@ -100,6 +122,7 @@ class MultiLayerConfiguration:
             # delegate defaults to the layer they wrap
             if hasattr(lc, "apply_global_defaults"):
                 lc.apply_global_defaults(self.defaults)
+            validate_layer_names(lc)
         self.layer_input_types = []
         itype = self.input_type
         for i, lc in enumerate(self.layers):

@@ -11,6 +11,7 @@ actually trains through.
 """
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List
 
@@ -85,16 +86,30 @@ def _cold_steady_fit(model, total_words: int, runs: int = 3):
     """(cold, steady) words/sec: first fit compiles; steady is the MEDIAN
     of ``runs`` reset-weights re-fits — these benches are dispatch/host
     bound and swing ±40% run-to-run through the tunnel, so a single timed
-    fit is not a stable artifact (all fits host-sync on the final tables)."""
+    fit is not a stable artifact.
+
+    Every clock here closes on a HOST FETCH of the trained table
+    (``_sync_tables``), and the queue is drained before each clock starts.
+    ``fit()`` itself only enqueues async dispatches; through the axon
+    tunnel even ``block_until_ready`` returns early, so timing ``fit()``
+    alone measures ENQUEUE rate, not training throughput — the rounds 1-3
+    words/sec artifacts did exactly that and over-read by ~3x (BENCH_NOTES
+    round 4 "words/sec correction")."""
+    def _sync_tables():
+        float(np.asarray(model.lookup_table.syn0[0, 0]))
+
     model.build_vocab()
     t0 = time.perf_counter()
     model.fit()
+    _sync_tables()
     cold = total_words / (time.perf_counter() - t0)
     rates = []
     for _ in range(runs):
         model.lookup_table.reset_weights()
+        _sync_tables()                    # drain before starting the clock
         t0 = time.perf_counter()
         model.fit()
+        _sync_tables()
         rates.append(total_words / (time.perf_counter() - t0))
     return cold, float(np.median(rates))
 
@@ -251,6 +266,13 @@ def serving_latency(concurrency: int = 16,
 # Thresholds are deliberately loose — they flag "sick window", not drift.
 PROBE_ROUNDTRIP_HEALTHY_MS = 200.0
 PROBE_SPREAD_HEALTHY = 0.6
+# v5e bf16 peak ≈ 197 TF/s; the 2048^3 scan chain delivers ~80-120 TF/s in
+# a healthy window (tanh + non-pipelined chain).  Below this the chip is
+# contended/degraded and throughput rows are not comparable across windows.
+# Chip-generation-specific — override on smaller TPUs (a v2/v3 can never
+# reach the v5e floor and would read permanently unhealthy).
+PROBE_COMPUTE_HEALTHY_TFLOPS = float(
+    os.environ.get("DL4J_TPU_PROBE_HEALTHY_TFLOPS", "40"))
 
 
 def tunnel_probe(n: int = 5) -> Dict:
@@ -285,12 +307,31 @@ def tunnel_probe(n: int = 5) -> Dict:
         float(np.asarray(r[0, 0]))                   # sync the whole chain
         blocks.append(time.perf_counter() - t0)
     med = float(np.median(blocks))
+
+    # (c) device-COMPUTE throughput: one big dispatch (1000 scanned 2048^3
+    # bf16 matmuls ≈ 17.2 TFLOP), fetch-closed.  The roundtrip/block probes
+    # above are dispatch-latency-bound and stay "healthy" through windows
+    # where the chip itself delivers 3x less (observed this round: same
+    # code, 703k -> 233k words/s while roundtrip read 110 ms both times) —
+    # only a completion-timed compute block exposes that.
+    h = jax.jit(lambda a: jax.lax.scan(
+        lambda c, _: (jnp.tanh(c @ c), None), a, None, length=1000)[0])
+    c = (jnp.eye(2048, dtype=jnp.bfloat16) * 0.99
+         + jnp.full((2048, 2048), 1e-3, jnp.bfloat16))
+    float(np.asarray(h(c)[0, 0]))                    # compile + settle
+    t0 = time.perf_counter()
+    float(np.asarray(h(c)[0, 0]))
+    compute_s = time.perf_counter() - t0
+    flops = 1000 * 2 * 2048 ** 3
+
     probe = {
         "roundtrip_ms": round(float(np.median(lats)) * 1e3, 1),
         "block_ms": round(med * 1e3, 1),
         "block_spread": round((max(blocks) - min(blocks)) / med, 3),
+        "compute_tflops": round(flops / compute_s / 1e12, 1),
     }
     probe["healthy"] = bool(
         probe["roundtrip_ms"] < PROBE_ROUNDTRIP_HEALTHY_MS
-        and probe["block_spread"] < PROBE_SPREAD_HEALTHY)
+        and probe["block_spread"] < PROBE_SPREAD_HEALTHY
+        and probe["compute_tflops"] > PROBE_COMPUTE_HEALTHY_TFLOPS)
     return probe

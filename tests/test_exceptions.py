@@ -29,13 +29,13 @@ def test_missing_n_in_without_input_type():
 
 
 def test_unknown_activation_lists_available():
-    conf = _build(DenseLayer(n_out=4, activation="not_an_act"),
-                  OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
-                  itype=InputType.feed_forward(3))
-    net = MultiLayerNetwork(conf).init()
+    # validated at CONFIG time (LayerValidation.java parity), not first use
     with pytest.raises((KeyError, ValueError)) as ei:
-        net.output(np.zeros((1, 3), np.float32))
+        _build(DenseLayer(n_out=4, activation="not_an_act"),
+               OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+               itype=InputType.feed_forward(3))
     assert "not_an_act" in str(ei.value) or "activation" in str(ei.value)
+    assert "relu" in str(ei.value)   # lists what IS available
 
 
 def test_non_output_last_layer_score():
