@@ -24,7 +24,28 @@ import jax.numpy as jnp
 # "words/sec correction"), because TPU scatter serializes duplicate indices
 # while the dense product's cost is distribution-independent.  Above the
 # threshold the V-proportional matmul loses and scatter-add is kept.
+# DL4J_TPU_DENSE_TABLE_MAX_V=0 is the escape hatch that forces the scatter
+# path everywhere (e.g. if the one-hot transient OOMs a small-HBM device).
 _DENSE_TABLE_MAX_V = int(os.environ.get("DL4J_TPU_DENSE_TABLE_MAX_V", "65536"))
+# One-hot transient cap in f32 elements (~1 GB at the default).  Above it
+# the scatter path is kept regardless of V — a wide-window CBOW at high V
+# would otherwise materialize a multi-GB transient per scan step, and XLA
+# generally materializes dot operands rather than fusing the comparison in.
+_DENSE_TABLE_MAX_ELEMS = int(
+    os.environ.get("DL4J_TPU_DENSE_TABLE_MAX_ELEMS", "250000000"))
+# Matmul precision for the dense update.  Default HIGHEST: the one-hot
+# operand is exact in bf16 (0/1) but the f32 update operand is NOT — at
+# default TPU precision it is truncated to bf16, quantizing every embedding
+# gradient ~0.4% relative (measured max abs err 7.0e-3 vs 1.2e-7 for the
+# scatter on unit-scale updates; HIGHEST restores 2.4e-7).  The multi-pass
+# decomposition only applies to the small (rows, V) @ (rows, D) product, so
+# the win over scatter survives (re-measured round 5, BENCH_NOTES).
+_DENSE_TABLE_PRECISION = os.environ.get("DL4J_TPU_DENSE_TABLE_PRECISION",
+                                        "highest").lower()
+if _DENSE_TABLE_PRECISION not in ("default", "high", "highest"):
+    raise ValueError(
+        f"DL4J_TPU_DENSE_TABLE_PRECISION={_DENSE_TABLE_PRECISION!r}: "
+        "expected one of 'default', 'high', 'highest'")
 
 
 def _table_add(tab, idx, upd):
@@ -32,25 +53,24 @@ def _table_add(tab, idx, upd):
 
     ``idx``: integer rows, any shape; ``upd``: matching update rows with a
     trailing D axis.  The one-hot matmul sums duplicate-row contributions in
-    a different float order than the scatter — equal within float noise,
-    which every consumer tolerates (embedding training).
+    a different float order than the scatter — equal within float noise at
+    the default ``Precision.HIGHEST`` (set DL4J_TPU_DENSE_TABLE_PRECISION
+    to ``default`` to trade ~0.4%-relative bf16 gradient quantization for
+    a narrower matmul; measured immaterial to SGD but not bit-honest).
     """
     D = tab.shape[1]
     idx = idx.reshape(-1)
     upd = upd.reshape(idx.shape[0], D)
-    # gate on the one-hot's rows x V product as well as V: a wide-window
-    # CBOW at high V would otherwise materialize a multi-GB transient per
-    # scan step (B*Wmax rows).  1e9 f32 elements (~4 GB upper bound, and
-    # in practice fused into the matmul) covers every measured-win shape.
     if (tab.shape[0] > _DENSE_TABLE_MAX_V
-            or idx.shape[0] * tab.shape[0] > 1_000_000_000):
+            or idx.shape[0] * tab.shape[0] > _DENSE_TABLE_MAX_ELEMS):
         return tab.at[idx].add(upd)
     # f32 operands: a bf16-operand variant (exact one-hot, f32 accumulation)
     # measured SLOWER on chip — the inserted converts cost more than the
     # narrower matmul saves (BENCH_NOTES round 4 "words/sec correction").
     oh = (idx[:, None] == jnp.arange(tab.shape[0])[None, :]).astype(tab.dtype)
     return tab + jax.lax.dot_general(oh, upd, (((0,), (0,)), ((), ())),
-                                     preferred_element_type=tab.dtype)
+                                     preferred_element_type=tab.dtype,
+                                     precision=_DENSE_TABLE_PRECISION)
 
 
 def _sigmoid(x):

@@ -50,7 +50,14 @@ def get(name) -> Callable[[Array], Array]:
     if ":" in s:
         base, _, arg = s.partition(":")
         if base in _PARAMETERIZED:
-            return _PARAMETERIZED[base](float(arg))
+            try:
+                param = float(arg)
+            except ValueError:
+                raise ValueError(
+                    f"Bad parameter '{arg}' for activation '{base}': expected "
+                    f"a number (e.g. '{base}:0.3'). "
+                    f"Parameterized activations: {sorted(_PARAMETERIZED)}") from None
+            return _PARAMETERIZED[base](param)
         raise ValueError(
             f"Unknown parameterized activation '{base}'. "
             f"Available: {sorted(_PARAMETERIZED)}")

@@ -26,14 +26,20 @@ from .preprocessors import (CnnFlatToCnnPreProcessor, CnnToFeedForwardPreProcess
 from ..layers.base import BaseLayerConf, LayerConf
 
 
-def validate_layer_names(lc, _depth: int = 0) -> None:
+def validate_layer_names(lc, _seen: Optional[set] = None) -> None:
     """Fail at CONFIG time on unknown activation/loss names, not at the
     first fit() (the reference validates configs up front —
     ``nn/conf/layers/LayerValidation.java``).  Recurses through wrapper
     layers (Bidirectional ``fwd``, Frozen/LastTimeStep ``underlying``,
-    graph LayerVertex ``layer``)."""
-    if lc is None or _depth > 4:
+    graph LayerVertex ``layer``) to any depth; a visited-id set guards
+    against config cycles."""
+    if lc is None:
         return
+    if _seen is None:
+        _seen = set()
+    if id(lc) in _seen:
+        return
+    _seen.add(id(lc))
     from ..activations import get as _get_act
     from ..losses import get as _get_loss
     act = getattr(lc, "activation", None)
@@ -45,7 +51,7 @@ def validate_layer_names(lc, _depth: int = 0) -> None:
     for attr in ("fwd", "underlying", "layer"):
         inner = getattr(lc, attr, None)
         if inner is not lc and isinstance(inner, LayerConf):
-            validate_layer_names(inner, _depth + 1)
+            validate_layer_names(inner, _seen)
 
 
 def _auto_preprocessor(prev: InputType, layer: LayerConf) -> Optional[InputPreProcessor]:

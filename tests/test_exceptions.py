@@ -85,3 +85,25 @@ def test_wrong_label_width_fails_fast():
     net = MultiLayerNetwork(conf).init()
     with pytest.raises(Exception):
         net.fit(np.zeros((8, 4), np.float32), np.zeros((8, 7), np.float32))
+
+
+def test_parameterized_activation_bad_arg_names_activation():
+    # 'leakyrelu:abc' must fail naming the activation and expected form,
+    # not as a bare float() ValueError (ADVICE r4)
+    with pytest.raises(ValueError, match="leakyrelu"):
+        _build(DenseLayer(n_out=4, activation="leakyrelu:abc"),
+               OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+               itype=InputType.feed_forward(3))
+
+
+def test_deeply_nested_wrapper_validated():
+    # wrappers nested past the old depth-4 cap must still be validated at
+    # config time (ADVICE r4: visited-set recursion, no depth cap)
+    from deeplearning4j_tpu.nn.layers.recurrent import LastTimeStep
+    inner = DenseLayer(n_out=4, activation="not_an_act")
+    for _ in range(6):
+        inner = LastTimeStep(underlying=inner)
+    with pytest.raises((KeyError, ValueError), match="not_an_act|activation"):
+        _build(inner,
+               OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+               itype=InputType.feed_forward(3))
