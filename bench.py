@@ -145,36 +145,56 @@ def main():
         sys.exit(1)
 
 
+def probe_bracketed_capture(fn, probe_fn, retries=2, backoff_s=45,
+                            sleep=time.sleep):
+    """Run a capture only inside a healthy probe bracket (VERDICT r4 item
+    4).  The before-probe gates spending capture time in a sick window;
+    the after-probe catches degradation that starts mid-capture.  An
+    unhealthy bracket voids the rows and retries after ``backoff_s``;
+    when retries are exhausted the last rows are returned tagged
+    ``invalid: true`` with the failing bracket attached."""
+    rows = bracket = None
+    for attempt in range(retries + 1):
+        probe = probe_fn()
+        if not probe["healthy"] and attempt < retries:
+            sleep(backoff_s)
+            continue
+        rows = fn()
+        probe_after = probe_fn()
+        rows = rows if isinstance(rows, list) else [rows]
+        bracket = {"before": probe, "after": probe_after,
+                   "healthy": bool(probe["healthy"]
+                                   and probe_after["healthy"])}
+        if bracket["healthy"]:
+            break
+        if attempt < retries:
+            rows = None                 # void the degraded capture, retry
+            sleep(backoff_s)
+    for r in rows:
+        r["tunnel_probe"] = bracket
+        if not bracket["healthy"]:
+            r["invalid"] = True         # probe-failed: not a measurement
+    return rows
+
+
 def side_metrics(path: str = "BENCH_SIDE.json"):
     """BASELINE.md's secondary configs (LeNet / char-LSTM / Word2Vec) into a
     side JSON so round-over-round claims are reproducible, not hand-typed
     (VERDICT round-1 item 7).  Headline stdout line stays unchanged.
 
     Every capture is bracketed by a tunnel-health probe (VERDICT r3 item
-    2): when the probe reads unhealthy the capture backs off and retries
-    once in a better window; the probe used is recorded on each row, so a
-    degraded artifact is machine-distinguishable from a regression."""
+    2).  A row is publishable only from a bracket whose before AND after
+    probes read healthy: an unhealthy bracket voids the whole capture,
+    which is retried after a backoff (VERDICT r4 item 4 — a degraded-window
+    number must never ship as a headline value).  When retries are
+    exhausted the last attempt's rows ARE recorded — numbers the next
+    round can diagnose with — but carry ``"invalid": true`` plus the
+    failing bracket, so no consumer can mistake them for measurements."""
     from deeplearning4j_tpu.utils import benchmarks as B
 
-    def capture(fn, retries=1, backoff_s=30):
-        # probe BEFORE spending capture time (back off while the window is
-        # sick) AND after it: degradation that starts mid-capture must not
-        # ship as a healthy row
-        probe = B.tunnel_probe()
-        for _ in range(retries):
-            if probe["healthy"]:
-                break
-            time.sleep(backoff_s)
-            probe = B.tunnel_probe()
-        rows = fn()
-        probe_after = B.tunnel_probe()
-        rows = rows if isinstance(rows, list) else [rows]
-        bracket = {"before": probe, "after": probe_after,
-                   "healthy": bool(probe["healthy"]
-                                   and probe_after["healthy"])}
-        for r in rows:
-            r["tunnel_probe"] = bracket
-        return rows
+    def capture(fn, retries=2, backoff_s=45):
+        return probe_bracketed_capture(fn, B.tunnel_probe, retries=retries,
+                                       backoff_s=backoff_s)
 
     captures = [
         B.lenet_step_time,

@@ -1,0 +1,52 @@
+"""Probe-bracketed capture protocol (VERDICT r4 item 4): a BENCH_SIDE row
+must only publish from a healthy before+after probe bracket; exhausted
+retries tag rows ``invalid`` rather than shipping degraded-window numbers."""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from bench import probe_bracketed_capture  # noqa: E402
+
+
+def _probes(seq):
+    it = iter(seq)
+    return lambda: {"healthy": next(it)}
+
+
+def test_healthy_bracket_single_pass():
+    calls = []
+    rows = probe_bracketed_capture(
+        lambda: calls.append(1) or {"metric": "m", "value": 1},
+        _probes([True, True]), sleep=lambda s: None)
+    assert len(calls) == 1
+    assert "invalid" not in rows[0]
+    assert rows[0]["tunnel_probe"]["healthy"]
+
+
+def test_sick_before_probe_backs_off_without_capturing():
+    calls = []
+    rows = probe_bracketed_capture(
+        lambda: calls.append(1) or {"metric": "m", "value": 1},
+        _probes([False, True, True]), sleep=lambda s: None)
+    assert len(calls) == 1          # no capture spent in the sick window
+    assert "invalid" not in rows[0]
+
+
+def test_mid_capture_degradation_voids_and_retries():
+    calls = []
+    rows = probe_bracketed_capture(
+        lambda: calls.append(1) or {"metric": "m", "value": 1},
+        _probes([True, False, True, True]), sleep=lambda s: None)
+    assert len(calls) == 2          # first capture voided, second shipped
+    assert "invalid" not in rows[0]
+
+
+def test_exhausted_retries_tag_invalid():
+    calls = []
+    rows = probe_bracketed_capture(
+        lambda: calls.append(1) or [{"metric": "m", "value": 1}],
+        _probes([True, False, True, False, True, False]),
+        retries=2, sleep=lambda s: None)
+    assert len(calls) == 3
+    assert rows[0]["invalid"] is True
+    assert rows[0]["tunnel_probe"]["healthy"] is False
