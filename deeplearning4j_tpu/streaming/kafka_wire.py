@@ -45,12 +45,18 @@ __all__ = ["KafkaWireClient", "MiniKafkaBroker", "NDArrayKafkaClient"]
 
 _API_PRODUCE = 0
 _API_FETCH = 1
+_API_LIST_OFFSETS = 2
 _API_METADATA = 3
+_API_OFFSET_COMMIT = 8
+_API_OFFSET_FETCH = 9
+_API_FIND_COORDINATOR = 10
 _API_VERSIONS = 18
 
 # what the mini-broker advertises via ApiVersions (both generations)
 _BROKER_API_VERSIONS = {_API_PRODUCE: (0, 3), _API_FETCH: (0, 4),
-                        _API_METADATA: (0, 0), _API_VERSIONS: (0, 0)}
+                        _API_LIST_OFFSETS: (0, 0), _API_METADATA: (0, 0),
+                        _API_OFFSET_COMMIT: (0, 0), _API_OFFSET_FETCH: (0, 0),
+                        _API_FIND_COORDINATOR: (0, 0), _API_VERSIONS: (0, 0)}
 
 
 # ------------------------------------------------------------------- crc32c
@@ -524,6 +530,91 @@ class KafkaWireClient:
         # records below the requested offset so consumers never see repeats
         return [(o, v) for o, v in records if o >= offset]
 
+    # -- consumer-group offset management ---------------------------------
+    # The reference consumes as a managed group (groupId in the Camel route
+    # URI, DL4jServeRouteBuilder.java:55) so a restarted consumer resumes at
+    # its committed offset.  These four rounds are that capability on the
+    # wire: FindCoordinator locates the group's offset store, OffsetCommit/
+    # OffsetFetch persist and recover positions, ListOffsets resolves the
+    # log's earliest/latest watermarks for consumers with no commit yet.
+
+    def find_coordinator(self, group_id: str) -> Tuple[int, str, int]:
+        """FindCoordinator v0 (api_key 10): ``(node_id, host, port)`` of the
+        broker coordinating ``group_id``'s offsets.  Single-node rigs always
+        get the bootstrap broker back, but going through the round keeps the
+        client correct against real clusters."""
+        r = self._roundtrip(_API_FIND_COORDINATOR, _str(group_id))
+        err = r.take("h")
+        if err:
+            raise IOError(f"find_coordinator error code {err}")
+        node = r.take("i")
+        host = r.string()
+        return node, host, r.take("i")
+
+    def offset_commit(self, group_id: str, topic: str, partition: int,
+                      offset: int, metadata: str = "") -> None:
+        """OffsetCommit v0 (api_key 8): durably record ``group_id``'s next
+        read position for (topic, partition)."""
+        body = (_str(group_id)
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iq", partition, offset) + _str(metadata))
+        r = self._roundtrip(_API_OFFSET_COMMIT, body)
+        n_topics = r.take("i")
+        assert n_topics == 1
+        r.string()
+        n_parts = r.take("i")
+        assert n_parts == 1
+        _part, err = r.take("i"), r.take("h")
+        if err:
+            raise IOError(f"offset_commit error code {err}")
+
+    def offset_fetch(self, group_id: str, topic: str,
+                     partition: int) -> int:
+        """OffsetFetch v0 (api_key 9): the committed offset for
+        ``group_id`` on (topic, partition), or -1 when the group has never
+        committed there (Kafka's no-offset sentinel)."""
+        body = (_str(group_id)
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1) + struct.pack(">i", partition))
+        r = self._roundtrip(_API_OFFSET_FETCH, body)
+        n_topics = r.take("i")
+        assert n_topics == 1
+        r.string()
+        n_parts = r.take("i")
+        assert n_parts == 1
+        _part, off = r.take("i"), r.take("q")
+        r.string()                       # metadata
+        err = r.take("h")
+        if err:
+            raise IOError(f"offset_fetch error code {err}")
+        return off
+
+    def list_offsets(self, topic: str, partition: int,
+                     timestamp: int = -1) -> int:
+        """ListOffsets v0 (api_key 2): the log's latest offset (timestamp
+        -1, the high watermark = next offset to be assigned) or earliest
+        (timestamp -2).  The round a group-less or never-committed consumer
+        uses to choose its starting position."""
+        body = (struct.pack(">i", -1)    # replica_id
+                + struct.pack(">i", 1) + _str(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iqi", partition, timestamp, 1))
+        r = self._roundtrip(_API_LIST_OFFSETS, body)
+        n_topics = r.take("i")
+        assert n_topics == 1
+        r.string()
+        n_parts = r.take("i")
+        assert n_parts == 1
+        _part, err = r.take("i"), r.take("h")
+        if err:
+            raise IOError(f"list_offsets error code {err}")
+        n_offsets = r.take("i")
+        offsets = [r.take("q") for _ in range(n_offsets)]
+        if not offsets:
+            raise IOError("list_offsets returned no offsets")
+        return offsets[0]
+
 
 # ------------------------------------------------------------------ broker
 class MiniKafkaBroker:
@@ -533,6 +624,9 @@ class MiniKafkaBroker:
 
     def __init__(self, port: int = 0):
         self._logs: Dict[Tuple[str, int], List[bytes]] = {}
+        # consumer-group offset store: (group, topic, partition) ->
+        # (offset, metadata) — the __consumer_offsets topic's role
+        self._offsets: Dict[Tuple[str, str, int], Tuple[int, str]] = {}
         self._lock = threading.Lock()
         outer = self
 
@@ -592,9 +686,96 @@ class MiniKafkaBroker:
             return struct.pack(">i", corr) + self._fetch(r, ver)
         if api_key == _API_METADATA:
             return struct.pack(">i", corr) + self._metadata(r, ver)
+        if api_key == _API_LIST_OFFSETS:
+            return struct.pack(">i", corr) + self._list_offsets(r, ver)
+        if api_key == _API_OFFSET_COMMIT:
+            return struct.pack(">i", corr) + self._offset_commit(r, ver)
+        if api_key == _API_OFFSET_FETCH:
+            return struct.pack(">i", corr) + self._offset_fetch(r, ver)
+        if api_key == _API_FIND_COORDINATOR:
+            return struct.pack(">i", corr) + self._find_coordinator(r, ver)
         if api_key == _API_VERSIONS:
             return struct.pack(">i", corr) + self._api_versions()
         return struct.pack(">i", corr)
+
+    def _find_coordinator(self, r: _Reader, ver: int) -> bytes:
+        """FindCoordinator v0: a single-node broker coordinates every
+        group itself."""
+        if ver != 0:
+            raise ValueError(f"find_coordinator v{ver} not supported")
+        r.string()                                   # group_id
+        host, port = self._server.server_address
+        return (struct.pack(">h", 0) + struct.pack(">i", 0)
+                + _str(host) + struct.pack(">i", port))
+
+    def _offset_commit(self, r: _Reader, ver: int) -> bytes:
+        if ver != 0:
+            raise ValueError(f"offset_commit v{ver} not supported")
+        group = r.string()
+        out = b""
+        n_topics = r.take("i")
+        out += struct.pack(">i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            out += _str(topic)
+            n_parts = r.take("i")
+            out += struct.pack(">i", n_parts)
+            for _ in range(n_parts):
+                part, offset = r.take("i"), r.take("q")
+                meta = r.string()
+                with self._lock:
+                    self._offsets[(group, topic, part)] = (offset, meta)
+                out += struct.pack(">ih", part, 0)
+        return out
+
+    def _offset_fetch(self, r: _Reader, ver: int) -> bytes:
+        if ver != 0:
+            raise ValueError(f"offset_fetch v{ver} not supported")
+        group = r.string()
+        out = b""
+        n_topics = r.take("i")
+        out += struct.pack(">i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            out += _str(topic)
+            n_parts = r.take("i")
+            out += struct.pack(">i", n_parts)
+            for _ in range(n_parts):
+                part = r.take("i")
+                with self._lock:
+                    offset, meta = self._offsets.get(
+                        (group, topic, part), (-1, ""))
+                # no committed offset = offset -1, error 0 (Kafka contract)
+                out += struct.pack(">iq", part, offset) + _str(meta)
+                out += struct.pack(">h", 0)
+        return out
+
+    def _list_offsets(self, r: _Reader, ver: int) -> bytes:
+        if ver != 0:
+            raise ValueError(f"list_offsets v{ver} not supported")
+        r.take("i")                                  # replica_id
+        out = b""
+        n_topics = r.take("i")
+        out += struct.pack(">i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            out += _str(topic)
+            n_parts = r.take("i")
+            out += struct.pack(">i", n_parts)
+            for _ in range(n_parts):
+                part, ts = r.take("i"), r.take("q")
+                r.take("i")                          # max_num_offsets
+                with self._lock:
+                    known = (topic, part) in self._logs
+                    high = len(self._logs.get((topic, part), ()))
+                if not known:
+                    # error 3: UNKNOWN_TOPIC_OR_PARTITION, empty offsets
+                    out += struct.pack(">ihi", part, 3, 0)
+                    continue
+                offset = 0 if ts == -2 else high     # -2 earliest, -1 latest
+                out += struct.pack(">ihi", part, 0, 1)
+                out += struct.pack(">q", offset)
+        return out
 
     def _metadata(self, r: _Reader, ver: int) -> bytes:
         """Metadata v0: this single node is broker 0 and leads every
@@ -718,14 +899,24 @@ class MiniKafkaBroker:
 class NDArrayKafkaClient:
     """Publish/consume NDArrays over the Kafka wire protocol (reference
     ``NDArrayKafkaClient.java``): arrays ride as codec-serialized message
-    values; consumption is offset-tracked per client."""
+    values; consumption is offset-tracked per client.
+
+    With ``group_id`` the client consumes as a managed group member (the
+    reference's ``kafka:...&groupId=...`` route,
+    ``DL4jServeRouteBuilder.java:55``): the first poll resumes from the
+    group's committed offset (or the log's earliest when the group has
+    never committed), and every poll commits the new position after its
+    records are returned — so a restarted consumer continues exactly
+    where the previous incarnation's last completed poll left off."""
 
     def __init__(self, host: str, port: int, topic: str,
-                 partition: int = 0, negotiate: bool = True):
+                 partition: int = 0, negotiate: bool = True,
+                 group_id: Optional[str] = None):
         self._client = KafkaWireClient(host, port)
         self.topic = topic
         self.partition = partition
-        self._offset = 0
+        self.group_id = group_id
+        self._offset: Optional[int] = None if group_id else 0
         # lazy: no I/O in the constructor (broker may not be up yet);
         # first use runs ApiVersions and falls back to the v0 generation
         # for brokers that don't speak it (pre-0.10 closes the connection)
@@ -752,17 +943,47 @@ class NDArrayKafkaClient:
         return self._client.produce(self.topic, self.partition,
                                     [serialize_array(a) for a in arrays])
 
+    def _resolve_start(self) -> int:
+        """Group members resume at the committed offset; a group with no
+        commit yet starts at the log's earliest (auto.offset.reset=earliest,
+        the reference route's implicit default for training data — losing
+        the head of the stream would silently skew the model)."""
+        committed = self._client.offset_fetch(self.group_id, self.topic,
+                                              self.partition)
+        if committed >= 0:
+            return committed
+        try:
+            return self._client.list_offsets(self.topic, self.partition,
+                                             timestamp=-2)
+        except IOError:
+            return 0                     # topic not created yet
+
     def poll(self, max_items: int = 64):
-        """Arrays appended since the last poll (advances this client's
-        offset — the auto-commit consumer role)."""
+        """Arrays appended since the last poll.  Group members commit the
+        advanced position to the coordinator after the batch is decoded
+        (per-poll auto-commit: a consumer killed between polls restarts
+        with no loss and no duplication); group-less clients track the
+        offset in memory only."""
         from .codec import deserialize_array
         self._ensure_negotiated()
+        if self._offset is None:
+            self._offset = self._resolve_start()
         msgs = self._client.fetch(self.topic, self.partition, self._offset)
         out = []
         for off, val in msgs[:max_items]:
             out.append(deserialize_array(val)[0])
             self._offset = off + 1
+        if self.group_id is not None and out:
+            self.commit()
         return out
+
+    def commit(self) -> None:
+        """Commit the current position for this client's group."""
+        if self.group_id is None:
+            raise ValueError("commit() requires a group_id")
+        if self._offset is not None:
+            self._client.offset_commit(self.group_id, self.topic,
+                                       self.partition, self._offset)
 
     def close(self) -> None:
         self._client.close()

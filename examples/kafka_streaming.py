@@ -32,6 +32,19 @@ def main():
         arrays = nd.poll()
         print(f"consumed {len(arrays)} arrays; last =\n{arrays[-1]}")
         nd.close()
+
+        # managed consumer group (the reference's kafka:...&groupId=...
+        # route): commits ride the broker, so a restarted consumer resumes
+        # at the committed offset — no loss, no duplication
+        g1 = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays",
+                                group_id="trainers")
+        print("group poll 1:", [int(a[0, 0]) for a in g1.poll(max_items=2)])
+        del g1                                     # dies without cleanup
+        g2 = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays",
+                                group_id="trainers")
+        print("group poll 2 (restarted consumer):",
+              [int(a[0, 0]) for a in g2.poll()])
+        g2.close()
     finally:
         broker.stop()
 

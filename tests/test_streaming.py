@@ -1,6 +1,8 @@
 """Streaming pub/sub + serve routes (reference dl4j-streaming: Kafka
 NDArray clients, Camel serve route) and trained-model helpers."""
+import os
 import time
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -412,5 +414,115 @@ class TestKafkaWire:
             with pytest.raises(IOError, match="error code 1"):
                 c.fetch("t", 0, -1)
             c.close()
+        finally:
+            broker.stop()
+
+
+class TestConsumerGroups:
+    """Consumer-group offset management (reference consumes as a managed
+    group — ``kafka:...&groupId=...``, DL4jServeRouteBuilder.java:55):
+    FindCoordinator/OffsetCommit/OffsetFetch v0 + ListOffsets v0."""
+
+    def test_wire_quartet(self):
+        from deeplearning4j_tpu.streaming.kafka_wire import (KafkaWireClient,
+                                                             MiniKafkaBroker)
+        broker = MiniKafkaBroker().start()
+        try:
+            c = KafkaWireClient("127.0.0.1", broker.port)
+            # coordinator: the single node itself
+            node, host, port = c.find_coordinator("g1")
+            assert (node, port) == (0, broker.port)
+            # no commit yet -> -1 sentinel
+            assert c.offset_fetch("g1", "t", 0) == -1
+            c.produce("t", 0, [b"a", b"b", b"c"])
+            assert c.list_offsets("t", 0, timestamp=-2) == 0   # earliest
+            assert c.list_offsets("t", 0, timestamp=-1) == 3   # latest
+            c.offset_commit("g1", "t", 0, 2, metadata="m")
+            assert c.offset_fetch("g1", "t", 0) == 2
+            # groups are independent
+            assert c.offset_fetch("g2", "t", 0) == -1
+            # commits survive reconnects (broker-side store)
+            c.close()
+            c2 = KafkaWireClient("127.0.0.1", broker.port)
+            assert c2.offset_fetch("g1", "t", 0) == 2
+            c2.close()
+        finally:
+            broker.stop()
+
+    def test_list_offsets_unknown_topic(self):
+        from deeplearning4j_tpu.streaming.kafka_wire import (KafkaWireClient,
+                                                             MiniKafkaBroker)
+        broker = MiniKafkaBroker().start()
+        try:
+            c = KafkaWireClient("127.0.0.1", broker.port)
+            with pytest.raises(IOError, match="error code 3"):
+                c.list_offsets("nope", 0)
+            c.close()
+        finally:
+            broker.stop()
+
+    def test_group_consumer_resumes_across_instances(self):
+        import numpy as np
+        from deeplearning4j_tpu.streaming.kafka_wire import (MiniKafkaBroker,
+                                                             NDArrayKafkaClient)
+        broker = MiniKafkaBroker().start()
+        try:
+            pub = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays")
+            arrays = [np.full((2,), i, dtype=np.float32) for i in range(10)]
+            pub.publish_all(arrays)
+            a = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays",
+                                   group_id="trainers")
+            first = a.poll(max_items=4)
+            assert [int(x[0]) for x in first] == [0, 1, 2, 3]
+            # consumer dies without any clean shutdown; a new incarnation
+            # of the same group resumes exactly after the last poll
+            del a
+            b = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays",
+                                   group_id="trainers")
+            rest = b.poll()
+            assert [int(x[0]) for x in rest] == [4, 5, 6, 7, 8, 9]
+            # no-loss AND no-duplication across the restart
+            assert sorted([int(x[0]) for x in first + rest]) == list(range(10))
+            b.close()
+            pub.close()
+        finally:
+            broker.stop()
+
+    def test_group_consumer_killed_subprocess_resumes(self, tmp_path):
+        """The VERDICT r4 item-6 shape: an OS-process consumer is
+        hard-killed mid-stream (os._exit after its first committed poll,
+        no cleanup), restarts, and the stream is consumed exactly once."""
+        import subprocess
+        import sys
+        import numpy as np
+        from deeplearning4j_tpu.streaming.kafka_wire import (MiniKafkaBroker,
+                                                             NDArrayKafkaClient)
+        broker = MiniKafkaBroker().start()
+        try:
+            pub = NDArrayKafkaClient("127.0.0.1", broker.port, "arrays")
+            pub.publish_all(
+                [np.full((2,), i, dtype=np.float32) for i in range(9)])
+            prog = (
+                "import os, sys\n"
+                "from deeplearning4j_tpu.streaming.kafka_wire import "
+                "NDArrayKafkaClient\n"
+                "c = NDArrayKafkaClient('127.0.0.1', {port}, 'arrays', "
+                "group_id='proc')\n"
+                "got = c.poll(max_items={n})\n"
+                "print(' '.join(str(int(a[0])) for a in got), flush=True)\n"
+                "os._exit(9)\n"             # hard death: no close, no commit
+            )
+            env = dict(os.environ, PYTHONPATH=str(
+                Path(__file__).resolve().parents[1]), JAX_PLATFORMS="cpu")
+            outs = []
+            for n in (3, 99):              # first run dies after 3, rerun drains
+                r = subprocess.run(
+                    [sys.executable, "-c", prog.format(port=broker.port, n=n)],
+                    capture_output=True, text=True, timeout=120, env=env)
+                assert r.returncode == 9, r.stderr
+                outs.append([int(t) for t in r.stdout.split()])
+            assert outs[0] == [0, 1, 2]
+            assert outs[1] == [3, 4, 5, 6, 7, 8]
+            pub.close()
         finally:
             broker.stop()
