@@ -1,7 +1,11 @@
 """Observability: stats collection → storage → web dashboard (reference
 ``deeplearning4j-ui-parent``: StatsListener → StatsStorage → PlayUIServer)."""
-from .components import (ChartHistogram, ChartLine, ChartScatter,
-                         ComponentTable, ComponentText, render_page)
+from .components import (ChartHistogram, ChartHorizontalBar, ChartLine,
+                         ChartScatter, ChartStackedArea, ChartTimeline,
+                         ComponentDiv, ComponentTable, ComponentText,
+                         DecoratorAccordion, StyleAccordion, StyleChart,
+                         StyleDiv, StyleTable, StyleText, component_from_json,
+                         component_to_json, render_page)
 from .connection import UiConnectionInfo
 from .renders import (coords_to_csv_lines, embedding_coords,
                       render_word_scatter, upload_tsne)
@@ -14,6 +18,10 @@ __all__ = ["StatsListener", "StatsReport", "array_stats", "StatsStorage",
            "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
            "UIServer",
            "RemoteUIStatsStorageRouter", "UiConnectionInfo", "ChartLine",
-           "ChartScatter", "ChartHistogram", "ComponentTable",
-           "ComponentText", "render_page", "embedding_coords",
+           "ChartScatter", "ChartHistogram", "ChartStackedArea",
+           "ChartTimeline", "ChartHorizontalBar", "ComponentTable",
+           "ComponentText", "ComponentDiv", "DecoratorAccordion",
+           "StyleChart", "StyleTable", "StyleText", "StyleDiv",
+           "StyleAccordion", "component_to_json", "component_from_json",
+           "render_page", "embedding_coords",
            "coords_to_csv_lines", "render_word_scatter", "upload_tsne"]

@@ -282,6 +282,60 @@ class _Handler(JsonHandler):
     tsne_sessions: dict = None     # sid -> list[str] coordinate lines
     activations: list = None       # [{"iteration": N, "svg": ...}]
 
+    def _training_report(self, sid: str, recs) -> str:
+        """Server-rendered static training report BUILT FROM the component
+        DSL (the reference's ui-components consumed by its server pages):
+        the same ChartLine/ComponentTable/DecoratorAccordion objects users
+        compose standalone reports with."""
+        from .components import (ChartHistogram, ChartLine, ComponentTable,
+                                 ComponentText, DecoratorAccordion,
+                                 render_page)
+        comps = [ComponentText(f"Training report — session {sid}",
+                               size=18, bold=True)]
+        if not recs:
+            comps.append(ComponentText("no records for this session"))
+            return render_page(comps, title=f"report {sid}")
+        iters = [r.iteration for r in recs]
+        score = (ChartLine(title="score vs iteration", x_label="iteration",
+                           y_label="score")
+                 .add_series("score", iters, [r.score or 0.0 for r in recs]))
+        comps.append(score)
+        norms = ChartLine(title="parameter L2 norms", x_label="iteration")
+        series = {}
+        for r in recs:
+            for name, st in r.param_stats.items():
+                series.setdefault(name, []).append(st.get("norm2") or 0.0)
+        for name, ys in sorted(series.items()):
+            norms.add_series(name, iters[-len(ys):], ys)
+        comps.append(DecoratorAccordion(title="Parameters",
+                                        children=[norms]))
+        last = recs[-1]
+        hists = []
+        for pname, st in sorted(last.param_stats.items()):
+            h = st.get("hist")
+            if not h:
+                continue
+            ch = ChartHistogram(title=pname)
+            lo, hi = st.get("min", 0.0), st.get("max", 1.0)
+            n = len(h)
+            for i, c in enumerate(h):
+                ch.add_bin(lo + (hi - lo) * i / n,
+                           lo + (hi - lo) * (i + 1) / n, float(c))
+            hists.append(ch)
+        if hists:
+            comps.append(DecoratorAccordion(
+                title="Latest parameter histograms", children=hists,
+                default_collapsed=True))
+        comps.append(ComponentTable(
+            header=["", "value"],
+            rows=[["records", len(recs)],
+                  ["last iteration", last.iteration],
+                  ["last score", f"{(last.score or 0.0):.6g}"],
+                  ["last iter time (ms)",
+                   f"{(last.iter_time_ms or 0.0):.3g}"]],
+            title="summary"))
+        return render_page(comps, title=f"report {sid}")
+
     def _html(self, page: str):
         data = page.encode()
         self.send_response(200)
@@ -349,6 +403,8 @@ class _Handler(JsonHandler):
         if len(parts) == 3:
             sid, what = parts[1], parts[2]
             recs = self.storage.get_records(sid)
+            if what == "report":
+                return self._html(self._training_report(sid, recs))
             if what == "overview":
                 norms = {}
                 for r in recs:

@@ -437,3 +437,84 @@ def test_activation_grid_pages():
                 assert e.code == 400
     finally:
         server.stop()
+
+
+def test_ui_component_dsl_full_set():
+    """Round out the ui-components role: stacked area, timeline,
+    horizontal bar, div/accordion containers, styles."""
+    from deeplearning4j_tpu.ui import (ChartHorizontalBar, ChartStackedArea,
+                                       ChartTimeline, ComponentDiv,
+                                       ComponentText, DecoratorAccordion,
+                                       StyleChart, StyleDiv, render_page)
+    area = (ChartStackedArea(title="memory by pool", x_label="step")
+            .set_x([0, 1, 2, 3])
+            .add_series("params", [1, 1, 1, 1])
+            .add_series("activations", [0, 2, 3, 1]))
+    tl = (ChartTimeline(title="phases")
+          .add_lane("etl", [(0.0, 1.5, "load")])
+          .add_lane("train", [(1.5, 6.0, "fit"), (6.0, 7.0, "eval")]))
+    bars = (ChartHorizontalBar(title="per-class F1",
+                               style=StyleChart(width=400, height=200))
+            .add_bar("setosa", 1.0).add_bar("versicolor", 0.93)
+            .add_bar("virginica", -0.1))
+    div = ComponentDiv(style=StyleDiv(width=860, margin_px=4)).add(
+        ComponentText("grouped"), bars)
+    acc = DecoratorAccordion(title="details", default_collapsed=True).add(tl)
+    page = render_page([area, div, acc])
+    assert page.count("<svg") == 3
+    assert "polygon" in page                       # stacked area marks
+    assert "<details" in page and "open" not in page.split("<details")[1][:8]
+    assert 'width="400"' in page                   # style applied
+    assert "setosa" in page and "load" in page
+    # guardrails
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="set_x"):
+        ChartStackedArea().add_series("s", [1, 2])
+    with _pytest.raises(ValueError, match="non-negative"):
+        (ChartStackedArea().set_x([0, 1])
+         .add_series("s", [1, -2]).render())
+
+
+def test_ui_component_json_roundtrip():
+    """Components are wire objects (reference TestComponentSerialization):
+    tagged-JSON round-trip preserves the tree and renders identically."""
+    from deeplearning4j_tpu.ui import (ChartLine, ComponentDiv,
+                                       ComponentTable, ComponentText,
+                                       DecoratorAccordion, StyleText,
+                                       component_from_json, component_to_json)
+    tree = ComponentDiv().add(
+        ComponentText("hello", style=StyleText(font_size=20, bold=True)),
+        DecoratorAccordion(title="inner").add(
+            ChartLine(title="t").add_series("s", [0, 1], [2.0, 3.0]),
+            ComponentTable(["a"], [["b"]], title="tbl")))
+    s = component_to_json(tree)
+    back = component_from_json(s)
+    assert type(back) is ComponentDiv
+    assert back.render() == tree.render()
+    # nested types survive
+    assert type(back.children[0].style) is StyleText
+    assert back.children[1].children[0].series == [["s", [0.0, 1.0],
+                                                    [2.0, 3.0]]]
+
+
+def test_ui_server_report_page():
+    """The server's /train/<sid>/report page is BUILT from the component
+    DSL (ui-components consumed by server pages)."""
+    storage = InMemoryStatsStorage()
+    server = UIServer(port=0).start()
+    server.attach(storage)
+    try:
+        _train_with(storage, epochs=2, session_id="rep_sess")
+        base = f"http://127.0.0.1:{server.port}"
+        page = urllib.request.urlopen(
+            f"{base}/train/rep_sess/report").read().decode()
+        assert "Training report" in page and "rep_sess" in page
+        assert "<svg" in page                      # DSL charts rendered
+        assert "score vs iteration" in page
+        assert "<details" in page                  # accordion sections
+        assert "summary</caption>" in page or "summary" in page
+        empty = urllib.request.urlopen(
+            f"{base}/train/ghost/report").read().decode()
+        assert "no records" in empty
+    finally:
+        server.stop()
