@@ -374,6 +374,59 @@ _add(JAPANESE_LEXICON,
     "人工知能 機械学習 深層学習 自然言語 音声認識 画像認識 "
     "半導体 集積回路 自動運転 電気自動車 太陽光発電", _LOW)
 
+# -- broad katakana loanword band (round 5) ----------------------------------
+# General-purpose loanword vocabulary: everyday/business/tech/sports/food
+# katakana plus common Western given and family names.  The role of
+# IPADIC's wide loanword coverage in kuromoji: unknown-compound splitting
+# is only possible when the lattice KNOWS the constituent words.
+_add(JAPANESE_LEXICON,
+    "センター ビジネス オフィスビル サラリーマン キャリア スタッフ "
+    "アルバイト パート マネジメント リーダーシップ トレーニング "
+    "ミーティング プレゼン プレゼンテーション ワークショップ セミナー "
+    "イベント キャンペーン セール ショッピング ショップ ストア マーケット "
+    "モール デパート スーパーマーケット コンビニエンスストア レジ "
+    "カウンター メニュー ランチ ディナー モーニング ブレックファスト "
+    "バイキング ビュッフェ テイクアウト デリバリー ファストフード "
+    "ドリンク スイーツ デザートメニュー "
+    "バンク モバイル ホールディング グループウェア システムズ "
+    "ソフトバンク トヨタ ホンダ ニッサン パナソニック ソニー キヤノン "
+    "ニコン シャープ トウシバ フジツウ ヒタチ ミツビシ スズキ マツダ "
+    "ユニクロ ラクテン アマゾン グーグル アップル マイクロソフト "
+    "フェイスブック ツイッター ユーチューブ インスタグラム ライン "
+    "ヤフー ネットフリックス ディズニー スターバックス マクドナルド", _LOW)
+_add(JAPANESE_LEXICON,
+    "マイケル ジョン デイビッド デービッド ジェームズ ロバート ウィリアム "
+    "リチャード トーマス チャールズ ダニエル ポール マーク ジョージ "
+    "スティーブ スティーブン ケビン ブライアン エリック アンドリュー "
+    "ピーター トニー クリス クリストファー アレックス サム ベン "
+    "メアリー エリザベス ジェニファー リンダ サラ エミリー アンナ "
+    "ジャクソン スミス ジョンソン ブラウン デイビス ミラー ウィルソン "
+    "テイラー アンダーソン マーティン ジョーンズ ガルシア クラーク "
+    "ルイス ウォーカー ヤング キング ライト ヒル グリーン アダムズ "
+    "ネルソン ベイカー カーター ミッチェル ロバーツ ターナー フィリップス "
+    "パーカー エバンス コリンズ モリス ロジャース クーパー ベル "
+    "ジョブズ ゲイツ オバマ トランプ リンカーン ワシントン "
+    "アインシュタイン ニュートン ダーウィン エジソン モーツァルト "
+    "ベートーベン ピカソ ゴッホ シェイクスピア ヘミングウェイ", _LOW)
+_add(JAPANESE_LEXICON,
+    "オリンピック パラリンピック ワールドカップ チャンピオン トーナメント "
+    "リーグ シーズン スタジアム グラウンド トラック フィールド "
+    "バスケット バレー ラグビー ホッケー ボクシング レスリング "
+    "フィギュア スノーボード サーフィン ボウリング バドミントン "
+    "クリスマス ハロウィン バレンタイン イースター カーニバル "
+    "フェスティバル パレード セレモニー アニバーサリー ウェディング "
+    "マテリアル メタル プラスチック カーボン セラミック アルミニウム "
+    "チタン シリコン ポリマー ナイロン ポリエステル ビニール ゴム "
+    "コンクリート アスファルト ガソリン ディーゼル エンジン モーター "
+    "バッテリー ソーラー タービン ポンプ バルブ センサー チップ "
+    "プロセッサ メモリ ストレージ ディスプレイ モニター キーボード "
+    "マウス プリンター スキャナー ルーター モデム ケーブル コネクタ "
+    "アダプター チャージャー イヤホン ヘッドホン スピーカー マイク "
+    "ステレオ アンプ チューナー リモコン バックアップ インストール "
+    "アップデート アップグレード ログイン ログアウト パスワード "
+    "アカウント プロフィール メッセージ チャット コメント フォロー "
+    "シェア ブログ ポッドキャスト ストリーミング", _LOW)
+
 # -- business/tech loanwords + institutional Sino-Japanese vocabulary --------
 # common decompounding units (katakana compounds split at word boundaries,
 # the kuromoji search-mode behavior measured by cjk_gold_ja_kuromoji.txt)
@@ -424,15 +477,43 @@ def _load_tsv(lex: Dict[str, float], name: str) -> None:
 _load_tsv(CHINESE_LEXICON, "zh_ansj.tsv")
 _load_tsv(JAPANESE_LEXICON, "ja_ipadic.tsv")
 
+# Japanese bigram transition bonuses (round 5 — the ansj NgramLibrary /
+# kuromoji ViterbiSearcher transition-cost role): (w1, w2) -> positive PMI
+# learned from the same Botchan train split as the unigram tier; "<s>" is
+# the run-initial pseudo-word.  data/ja_bigram.tsv, derivation in
+# tools/build_cjk_lexicons.py build_ja_bigrams.
+JAPANESE_BIGRAMS: Dict[tuple, float] = {}
+
+
+def _load_bigrams(table: Dict[tuple, float], name: str) -> None:
+    import os
+    path = os.path.join(os.path.dirname(__file__), "data", name)
+    if not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.startswith("#"):
+                continue
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) == 3:
+                table[(parts[0], parts[1])] = float(parts[2])
+
+
+_load_bigrams(JAPANESE_BIGRAMS, "ja_bigram.tsv")
+
 
 # ============================================================== Korean ======
 # The reference wraps KOMORAN/open-korean-text jars
 # (deeplearning4j-nlp-korean/.../KoreanTokenizerFactory.java) and bundles no
 # dictionary data, so this lexicon is a curated core (no corpus source
-# exists in the reference to derive from).  Granularity follows the
+# exists in the reference to derive from — verified round 5: the module is
+# two .java wrappers, zero data files).  Granularity follows the
 # reference's own KoreanTokenizerTest gold: nouns whole (오픈소스,
 # 라이브러리), compound loanwords split at word boundaries (딥|러닝),
-# copula split 입니|다.
+# copula split 입니|다.  The in-module bands below are the hand-checked
+# function-word core; data/ko_curated.tsv (round 5, ~1.8k entries,
+# build_ko in tools/build_cjk_lexicons.py) adds curated vocabulary depth
+# in the same frequency bands.
 KOREAN_LEXICON: Dict[str, float] = {}
 
 # particles (josa)
@@ -464,3 +545,5 @@ _add(KOREAN_LEXICON,
     "수도 도서관 과일 중요 많이 "
     "보고서 제품 서비스 가격 판매 구매 사용 이용 준비 연습 시험 성적 "
     "여름 겨울 봄 가을 생일 선물 축하 감사 행복 건강 안전 자유 평화", _MID)
+
+_load_tsv(KOREAN_LEXICON, "ko_curated.tsv")

@@ -434,10 +434,16 @@ class TestCjkSegmentationQuality:
 
     def test_chinese_segmentation_f1_floor(self):
         # lexicon data derived from the ansj core dictionary (independent
-        # of this fixture's author — the r3 circularity is gone both ways)
+        # of this fixture's author — the r3 circularity is gone both ways).
+        # Round 5 grew the fixture 29 -> 226 hand-authored sentences
+        # (VERDICT r4: fixture power); measured 0.9246 — the residual is
+        # genuine lexicalization ambiguity (很多 vs 很|多, 这家 vs 这|家)
+        # where the CTB-style gold and ansj-derived lexicon legitimately
+        # disagree, not segmentation error.  Floor set from the measured
+        # value, with the old saturated 0.95 fixture retired.
         from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
         f1 = self._f1("cjk_gold_zh.txt", ChineseTokenizerFactory())
-        assert f1 >= 0.95, f"zh segmentation F1 regressed: {f1:.3f}"
+        assert f1 >= 0.90, f"zh segmentation F1 regressed: {f1:.3f}"
 
     def test_japanese_segmentation_f1_floor(self):
         from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
@@ -448,7 +454,13 @@ class TestCjkSegmentationQuality:
         """VERDICT r3 item 6: F1 on text the lexicon never saw — the
         held-out 20% of the IPADIC-tokenized kuromoji corpus (250
         sentences; the lexicon trained on the other 80%,
-        tools/build_cjk_lexicons.py).  Deterministic: measured 0.904."""
+        tools/build_cjk_lexicons.py).  Round 5 added the bigram transition
+        lattice (PMI bonuses, dev-split-selected beta — ja_bigram.tsv);
+        measured 0.9071 (up from 0.904 unigram).  The VERDICT r4 0.92
+        target was not reached: the residual errors are OOV content words
+        and IPADIC-specific function-morpheme conventions, which bigrams
+        learned from the same 46k-token novel cannot supply (error
+        analysis in the round-5 notes).  Deterministic."""
         from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
         f1 = self._f1("cjk_gold_ja_bocchan.txt", JapaneseTokenizerFactory())
         assert f1 >= 0.90, f"ja held-out F1 regressed: {f1:.3f}"
@@ -457,11 +469,14 @@ class TestCjkSegmentationQuality:
         """Hand-written gold by the kuromoji authors (search-mode compound
         decomposition — their own 'weaknesses' cases).  Fully independent;
         hard: unknown-compound splitting without a 400k dictionary.
-        Measured 0.766 (was 0.385 before the round-4 kanji-pair heuristic
-        + loanword tier)."""
+        Measured 0.8705 in round 5 (0.766 in round 4; 0.385 before the
+        round-4 kanji-pair heuristic) — the round-5 gain is the broad
+        general-purpose katakana loanword/name band in lexicons.py:
+        compound splitting needs the lattice to KNOW constituent words,
+        the role IPADIC's 400k entries play for kuromoji."""
         from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
         f1 = self._f1("cjk_gold_ja_kuromoji.txt", JapaneseTokenizerFactory())
-        assert f1 >= 0.75, f"ja decompound F1 regressed: {f1:.3f}"
+        assert f1 >= 0.85, f"ja decompound F1 regressed: {f1:.3f}"
 
     def test_korean_segmentation_f1_floor(self):
         """Korean lattice (new in round 4; the reference wraps KOMORAN).
@@ -488,7 +503,7 @@ class TestCjkSegmentationQuality:
                 fp += len(p - g)
                 fn += len(g - p)
                 n_sent += 1
-        assert n_sent >= 25
+        assert n_sent >= 100            # round-5 fixture size (r4 item 8)
         prec, rec = tp / max(tp + fp, 1), tp / max(tp + fn, 1)
         f1 = 2 * prec * rec / max(prec + rec, 1e-9)
         assert f1 >= 0.95, f"ko segmentation F1 regressed: {f1:.3f}"
@@ -502,7 +517,7 @@ class TestCjkSegmentationQuality:
                                                      KOREAN_LEXICON)
         assert len(CHINESE_LEXICON) >= 35000
         assert len(JAPANESE_LEXICON) >= 6000
-        assert len(KOREAN_LEXICON) >= 200
+        assert len(KOREAN_LEXICON) >= 2000   # round-5 curated tier (r4 item 8)
         # every entry carries a sane log-prob band
         for lex in (CHINESE_LEXICON, JAPANESE_LEXICON, KOREAN_LEXICON):
             assert all(-10.0 < s < 0.0 for s in lex.values())
@@ -518,3 +533,49 @@ class TestCjkSegmentationQuality:
             assert w in JAPANESE_LEXICON, w
         for w in ("生命", "老师", "学生"):
             assert w in CHINESE_LEXICON, w
+
+
+class TestBigramLattice:
+    """Word-state Viterbi with transition bonuses (round 5 — the ansj
+    NgramLibrary / kuromoji ViterbiSearcher transition-cost mechanism)."""
+
+    def test_transition_resolves_unigram_tie(self):
+        from deeplearning4j_tpu.nlp.cjk import lattice_segment
+        # two tilings with EQUAL unigram score; only the learned
+        # transition (B after A) breaks the tie toward A|BC
+        lex = {"ab": -5.0, "c": -5.0, "a": -5.0, "bc": -5.0}
+        uni = lattice_segment("abc", lex)
+        with_bi = lattice_segment("abc", lex,
+                                  bigrams={("a", "bc"): 2.0}, beta=1.0)
+        assert with_bi == ["a", "bc"]
+        assert set("".join(uni)) == set("abc")
+
+    def test_run_initial_transition(self):
+        from deeplearning4j_tpu.nlp.cjk import lattice_segment
+        lex = {"ab": -5.0, "c": -5.0, "a": -5.0, "bc": -5.0}
+        out = lattice_segment("abc", lex,
+                              bigrams={("<s>", "ab"): 2.0}, beta=1.0)
+        assert out == ["ab", "c"]
+
+    def test_beta_zero_equals_unigram(self):
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+        uni = JapaneseTokenizerFactory(bigram_beta=0.0)
+        assert uni.bigrams is None
+        # a sentence both configurations segment identically
+        toks = uni.create("私は学校に行きます").get_tokens()
+        assert "".join(toks) == "私は学校に行きます"
+
+    def test_bigram_table_loaded(self):
+        from deeplearning4j_tpu.nlp.lexicons import JAPANESE_BIGRAMS
+        assert len(JAPANESE_BIGRAMS) > 10000
+        assert all(v > 0 for v in JAPANESE_BIGRAMS.values())
+        # span-initial rows exist
+        assert any(k[0] == "<s>" for k in JAPANESE_BIGRAMS)
+
+    def test_zh_fixture_size(self):
+        import os
+        base = os.path.join(os.path.dirname(__file__), "resources",
+                            "cjk_gold_zh.txt")
+        n = sum(1 for line in open(base, encoding="utf-8")
+                if line.strip() and not line.startswith("#"))
+        assert n >= 200                 # round-5 fixture power (r4 weak 4)
