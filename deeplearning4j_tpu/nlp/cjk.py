@@ -68,36 +68,11 @@ def lattice_segment(text: str, lexicon: Dict[str, float], *,
     for i in range(n):
         if best[i] == NEG:
             continue
-        top = min(max_len, n - i)
-        for ln in range(1, top + 1):
-            w = text[i:i + ln]
-            sc = lexicon.get(w)
-            if sc is not None and best[i] + sc > best[i + ln]:
-                best[i + ln] = best[i] + sc
-                back[i + ln] = i
-        if best[i] + oov_logp > best[i + 1]:
-            best[i + 1] = best[i] + oov_logp
-            back[i + 1] = i
-        if run_candidates:
-            k = _script(text[i])
-            if k in ("kata", "latin"):
-                j = i + 1
-                while j < n and _script(text[j]) == k:
-                    j += 1
-                if j - i > 1:
-                    sc = best[i] + oov_logp * (j - i) * 0.6
-                    if sc > best[j]:
-                        best[j] = sc
-                        back[j] = i
-            elif k == "han" and i + 2 <= n and _script(text[i + 1]) == "han":
-                # unknown kanji compounds decompose into 2-char units (the
-                # dominant Sino-Japanese word shape; kuromoji's search-mode
-                # heuristic makes the same bet) — scored just above two
-                # OOV singles so any real lexicon word still outranks it
-                sc = best[i] + oov_logp * 1.9
-                if sc > best[i + 2]:
-                    best[i + 2] = sc
-                    back[i + 2] = i
+        for j, _w, sc in _candidates(text, i, lexicon, max_len, oov_logp,
+                                     run_candidates):
+            if best[i] + sc > best[j]:
+                best[j] = best[i] + sc
+                back[j] = i
     out: List[str] = []
     i = n
     while i > 0:
@@ -109,7 +84,7 @@ def lattice_segment(text: str, lexicon: Dict[str, float], *,
 def _candidates(text: str, i: int, lexicon: Dict[str, float],
                 max_len: int, oov_logp: float, run_candidates: bool):
     """Candidate (end, word, base_score) arcs starting at position ``i`` —
-    the same arc set both DP variants score."""
+    THE arc set (both DP variants iterate this; do not fork it)."""
     n = len(text)
     out = []
     top = min(max_len, n - i)
@@ -129,7 +104,10 @@ def _candidates(text: str, i: int, lexicon: Dict[str, float],
             if j - i > 1:
                 out.append((j, text[i:j], oov_logp * (j - i) * 0.6))
         elif k == "han" and i + 2 <= n and _script(text[i + 1]) == "han":
-            # unknown kanji pairs: see the unigram path's comment
+            # unknown kanji compounds decompose into 2-char units (the
+            # dominant Sino-Japanese word shape; kuromoji's search-mode
+            # heuristic makes the same bet) — scored just above two OOV
+            # singles so any real lexicon word still outranks it
             w = text[i:i + 2]
             if lexicon.get(w) is None:
                 out.append((i + 2, w, oov_logp * 1.9))

@@ -458,20 +458,31 @@ _add(JAPANESE_LEXICON,
 # Max-merge keeps the higher score when a word is in both tiers, so the
 # curated core cannot be downgraded by sparse corpus counts.  Derivation:
 # tools/build_cjk_lexicons.py.
-def _load_tsv(lex: Dict[str, float], name: str) -> None:
+def _iter_data_rows(name: str):
+    """Tab-split rows of a bundled data TSV; yields nothing when the file
+    is absent (packaged data missing: the curated cores alone still
+    provide the capability)."""
     import os
     path = os.path.join(os.path.dirname(__file__), "data", name)
-    if not os.path.exists(path):      # packaged data missing: curated core
-        return                        # alone still provides the capability
+    if not os.path.exists(path):
+        return
     with open(path, encoding="utf-8") as f:
         for line in f:
             if line.startswith("#"):
                 continue
-            word, _, score = line.rstrip("\n").partition("\t")
-            if word and score:
-                prev = lex.get(word)
-                s = float(score)
-                lex[word] = s if prev is None else max(prev, s)
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                yield parts
+
+
+def _load_tsv(lex: Dict[str, float], name: str) -> None:
+    for parts in _iter_data_rows(name):
+        word, score = parts[0], parts[-1]
+        prev = lex.get(word)
+        s = float(score)
+        # max-merge, same rule as _add: a data tier must not downgrade a
+        # curated-core score
+        lex[word] = s if prev is None else max(prev, s)
 
 
 _load_tsv(CHINESE_LEXICON, "zh_ansj.tsv")
@@ -486,17 +497,9 @@ JAPANESE_BIGRAMS: Dict[tuple, float] = {}
 
 
 def _load_bigrams(table: Dict[tuple, float], name: str) -> None:
-    import os
-    path = os.path.join(os.path.dirname(__file__), "data", name)
-    if not os.path.exists(path):
-        return
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            if line.startswith("#"):
-                continue
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) == 3:
-                table[(parts[0], parts[1])] = float(parts[2])
+    for parts in _iter_data_rows(name):
+        if len(parts) == 3:
+            table[(parts[0], parts[1])] = float(parts[2])
 
 
 _load_bigrams(JAPANESE_BIGRAMS, "ja_bigram.tsv")

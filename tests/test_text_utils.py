@@ -558,12 +558,20 @@ class TestBigramLattice:
         assert out == ["ab", "c"]
 
     def test_beta_zero_equals_unigram(self):
-        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
-        uni = JapaneseTokenizerFactory(bigram_beta=0.0)
-        assert uni.bigrams is None
-        # a sentence both configurations segment identically
-        toks = uni.create("私は学校に行きます").get_tokens()
-        assert "".join(toks) == "私は学校に行きます"
+        """beta=0 must reproduce the plain unigram lattice EXACTLY (both
+        DP variants iterate the same _candidates arc set)."""
+        from deeplearning4j_tpu.nlp.cjk import (JapaneseTokenizerFactory,
+                                                _merge_kata_singles,
+                                                lattice_segment)
+        fac = JapaneseTokenizerFactory(bigram_beta=0.0)
+        assert fac.bigrams is None
+        for sent in ("私は学校に行きます", "研究生命科学", "ソフトウェアを使う",
+                     "これはペンです", "東京タワーへ行った"):
+            toks = fac.create(sent).get_tokens()
+            expect = _merge_kata_singles(lattice_segment(
+                sent, fac.lexicon, max_len=fac._max_word,
+                run_candidates=True))
+            assert toks == expect, (sent, toks, expect)
 
     def test_bigram_table_loaded(self):
         from deeplearning4j_tpu.nlp.lexicons import JAPANESE_BIGRAMS
