@@ -105,8 +105,11 @@ def main():
 
     runs = max(1, int(os.environ.get("DL4J_TPU_BENCH_RUNS", "3")))
 
-    # warm epoch: compile + first execution
+    # warm: compile + first execution of BOTH programs the timed runs use
+    # (epochs=1 single-epoch scan, then the fused multi-epoch scan)
     model.fit_on_device(x, y, batch_size=batch, epochs=1)
+    if epochs > 1:
+        model.fit_on_device(x, y, batch_size=batch, epochs=epochs)
     rates = []
     for _ in range(runs):
         t0 = time.perf_counter()

@@ -200,12 +200,65 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
 
         fn = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
         model._jit_cache[cache_key] = fn
-    # NOTE: the epoch pipelining below is fully effective when batch_size
-    # divides n and listeners don't read the score — the ragged-tail path
-    # (fit_tail) and score-reading listeners each host-sync per epoch.
+    # Fused multi-epoch program (VERDICT r4 item 2): when nothing needs a
+    # per-epoch Python hook — no listeners, no ragged tail — ALL epochs run
+    # as ONE dispatch: an outer scan draws each epoch's permutation on
+    # device and inner-scans the train step, so the inter-epoch dispatch
+    # and its host work vanish entirely.  Per-epoch listeners or a tail
+    # keep the per-epoch loop below (async dispatch still pipelines it).
+    fuse = epochs > 1 and used == n and not model.listeners
+    if fuse:
+        fused_key = ("epochs_scan", nb, batch_size, epochs, shuffle,
+                     tuple(a.shape[1:] for a in xs),
+                     tuple(a.shape[1:] for a in ys))
+        fused = model._jit_cache.get(fused_key)
+        if fused is None:
+            def epochs_fn(params, state, opt_state, key, xd, yd):
+                def epoch_body(carry, _):
+                    p, s, o, k = carry
+                    k, pk, ek = jax.random.split(k, 3)
+                    perm = (jax.random.permutation(pk, n) if shuffle
+                            else jnp.arange(n)).reshape(nb, batch_size)
+
+                    def body(c, idx):
+                        p_, s_, o_, k_ = c
+                        k_, sub = jax.random.split(k_)
+                        bx = [a[idx] for a in xd]
+                        by = [a[idx] for a in yd]
+                        # gstats are DISCARDED inside the traced program:
+                        # nothing in the fused (listener-free) path reads
+                        # them, and dropping them from the outputs lets XLA
+                        # dead-code-eliminate the per-step gradient-norm
+                        # reductions (~2 full passes over every gradient
+                        # leaf per step on a large model)
+                        p_, s_, o_, loss, _g = call_step(
+                            p_, s_, o_, sub, bx, by)
+                        return (p_, s_, o_, k_), loss
+
+                    (p, s, o, _), losses = jax.lax.scan(
+                        body, (p, s, o, ek), perm)
+                    return (p, s, o, k), losses[-1]
+
+                (p, s, o, _), last_losses = jax.lax.scan(
+                    epoch_body, (params, state, opt_state, key), None,
+                    length=epochs)
+                return p, s, o, last_losses
+
+            fused = jax.jit(epochs_fn, donate_argnums=(0, 1, 2))
+            model._jit_cache[fused_key] = fused
     try:
-        _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
-                    fn, fit_tail)
+        if fuse:
+            model._rng, key = jax.random.split(model._rng)
+            (model.params, model.state, model.opt_state,
+             last_losses) = fused(model.params, model.state,
+                                  model.opt_state, key, xs, ys)
+            model.iteration += nb * epochs
+            model.last_batch_size = batch_size
+            model._score = last_losses[-1]
+            model.epoch += epochs
+        else:
+            _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size,
+                        shuffle, fn, fit_tail)
     except BaseException:
         # aborted fit: best-effort coercion so _score can't stay a device
         # scalar, but the original error keeps propagating

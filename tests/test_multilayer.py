@@ -383,3 +383,38 @@ def test_fit_on_device_matches_per_batch_loop_exactly():
     for pa, pb in zip(jax.tree_util.tree_leaves(a.params),
                       jax.tree_util.tree_leaves(b.params)):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_fit_on_device_fused_multi_epoch():
+    """Round 5: listener-free, tail-free multi-epoch fits run as ONE
+    dispatch (outer scan over epochs, in-scan permutation).  Bookkeeping
+    and learning must match the per-epoch path."""
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.train.listeners import ScoreIterationListener
+
+    def mknet():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=0.05)).list()
+                .layer(DenseLayer(n_out=12, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+    ds = next(iter(IrisDataSetIterator(batch_size=150)))
+    x, y = np.asarray(ds.features)[:128], np.asarray(ds.labels)[:128]
+
+    fused = mknet()
+    fused.fit_on_device(x, y, batch_size=32, epochs=40)   # fused eligible
+    assert ("epochs_scan", 4, 32, 40, True, ((4,),), ((3,),)) \
+        in fused._jit_cache
+    assert fused.iteration == 160 and fused.epoch == 40
+    assert np.isfinite(fused.score())
+
+    loop = mknet()
+    loop.set_listeners(ScoreIterationListener(10 ** 6))   # forces per-epoch
+    loop.fit_on_device(x, y, batch_size=32, epochs=40)
+    assert not any(k[0] == "epochs_scan" for k in loop._jit_cache)
+    # equal-quality learning, not bit-equality (key split trees differ)
+    assert fused.score() < 0.35 and loop.score() < 0.35
