@@ -49,8 +49,10 @@ class _BaseNormalizer:
         the same running-stats approach)."""
         self._begin_fit()
         for ds in self._batches(data):
-            self._update_fit(np.asarray(ds.features, np.float64),
-                             np.asarray(ds.labels, np.float64)
+            # host ETL, not a device fetch: batches come from the host
+            # iterator and the running accumulators are numpy
+            self._update_fit(np.asarray(ds.features, np.float64),  # graftlint: disable=JX003
+                             np.asarray(ds.labels, np.float64)  # graftlint: disable=JX003
                              if self.fit_labels else None)
         self._finish_fit()
         return self

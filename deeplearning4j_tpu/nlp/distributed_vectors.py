@@ -87,7 +87,8 @@ def train_word2vec_distributed(sentences: Sequence[str], num_workers: int = 2,
 
     lt = master.lookup_table
     for name in ("syn0", "syn1", "syn1neg"):
-        parts = [np.asarray(getattr(w.lookup_table, name))
+        # one-time table collection AFTER all workers joined — not hot
+        parts = [np.asarray(getattr(w.lookup_table, name))  # graftlint: disable=JX003
                  for w in workers if getattr(w.lookup_table, name) is not None]
         if parts:
             import jax.numpy as jnp

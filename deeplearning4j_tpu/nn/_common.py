@@ -255,6 +255,10 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
             model.iteration += nb * epochs
             model.last_batch_size = batch_size
             model._score = last_losses[-1]
+            # the fused program discards gradient stats (XLA DCE, see
+            # above): consumers must see "absent", not a previous
+            # non-fused fit's stale norms
+            model._last_grad_stats = None
             model.epoch += epochs
         else:
             _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size,

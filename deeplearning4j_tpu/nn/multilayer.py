@@ -434,11 +434,16 @@ class MultiLayerNetwork:
              carries) = step(
                 self.params, self.state, self.opt_state, key,
                 jnp.asarray(x)[:, sl], yc, xm, ym, carries)
-            self._score = float(loss)
+            # device scalar inside the chunk loop: a float() here would
+            # host-sync every chunk, serializing tBPTT windows against
+            # dispatch RTT; listeners reading get_score() materialize it
+            self._score = loss
             self._last_grad_stats = gstats
             self.iteration += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration, self.epoch)
+        # one sync per batch, so deferred device failures surface in fit
+        self._score = float(self._score)
 
     def _init_carries(self, batch: int):
         """Zero carries for every recurrent layer (keyed ``layer_i``)."""

@@ -272,6 +272,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     if self.average_updaters:
                         replicas[w].opt_state = jax.tree_util.tree_map(
                             jnp.array, opt_avg)
+                # async dispatch returns before the averaging runs; sync so
+                # the recorded time measures the reduction, not its dispatch
+                jax.block_until_ready(avg)
                 self.stats.record("aggregation",
                                   time.perf_counter() - t_agg)
         # model IS replicas[0]; nothing to copy back

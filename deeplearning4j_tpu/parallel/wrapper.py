@@ -247,13 +247,18 @@ class ParallelWrapper:
                 m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
                     m.params, m.state, m.opt_state, key,
                     put(x), put(y), put(mk), put(lmk))
-                m._score = float(loss)
+                # device scalar inside the batch loop (a float() here would
+                # host-sync every step); get_score() materializes on demand
+                m._score = loss
                 m.iteration += 1
                 for lst in m.listeners:
                     lst.iteration_done(m, m.iteration, m.epoch)
             for lst in m.listeners:
                 lst.on_epoch_end(m)
             m.epoch += 1
+        # one final sync: "fit returned" still means "training finished",
+        # and deferred device failures surface here instead of downstream
+        m._score = float(m._score)
         return self
 
     def average_params(self):

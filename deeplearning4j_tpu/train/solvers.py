@@ -176,7 +176,9 @@ class _BaseFullBatchOptimizer:
             opt_state = self.post_step(opt_state, flat, flat_new, g, g_new)
             return flat_new, f2, g_new, opt_state
 
-        f, g = jax.jit(value_and_grad)(flat0)
+        # called exactly once per optimize(): jit-wrapping the fresh
+        # closure would XLA-compile a program that never runs again
+        f, g = value_and_grad(flat0)
         flat = flat0
         opt_state = self.init_state(flat0, g)
         self.score_history = [float(f)]

@@ -96,6 +96,14 @@ class StyleChart:
 _COLORS = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e")
 
 
+def _esc(v) -> str:
+    """Attribute-escape a style-sourced string.  Components travel over the
+    ``component_from_json`` wire between hosts, so style fields (colors,
+    fonts) are untrusted input — unescaped they are an injection vector
+    into the rendered page's attributes."""
+    return html.escape(str(v), quote=True)
+
+
 # --------------------------------------------------------------- base class
 class _Component:
     def render(self) -> str:
@@ -126,9 +134,9 @@ class ComponentText(_Component):
     def render(self) -> str:
         st = self.style or StyleText(font_size=self.size, bold=self.bold)
         weight = "bold" if st.bold else "normal"
-        return (f'<div style="font-size:{st.font_size}px;'
-                f"font-weight:{weight};color:{st.color};"
-                f'font-family:{st.font};margin:4px 0">'
+        return (f'<div style="font-size:{_esc(st.font_size)}px;'
+                f"font-weight:{weight};color:{_esc(st.color)};"
+                f'font-family:{_esc(st.font)};margin:4px 0">'
                 f"{html.escape(self.text)}</div>")
 
 
@@ -149,8 +157,8 @@ class ComponentTable(_Component):
         st = self.style or StyleTable()
         widths = st.column_widths or []
         h = "".join(
-            f'<th style="background:{st.header_color}"'
-            + (f' width="{widths[i]}"' if i < len(widths) else "")
+            f'<th style="background:{_esc(st.header_color)}"'
+            + (f' width="{_esc(widths[i])}"' if i < len(widths) else "")
             + f">{html.escape(str(c))}</th>"
             for i, c in enumerate(self.header))
         body = "".join(
@@ -158,9 +166,9 @@ class ComponentTable(_Component):
             + "</tr>" for r in self.rows)
         cap = (f"<caption>{html.escape(self.title)}</caption>"
                if self.title else "")
-        return (f'<table border="{st.border_width}" cellpadding="4" '
+        return (f'<table border="{_esc(st.border_width)}" cellpadding="4" '
                 f'style="border-collapse:collapse;margin:8px 0;'
-                f'background:{st.background_color}">{cap}'
+                f'background:{_esc(st.background_color)}">{cap}'
                 f"<tr>{h}</tr>{body}</table>")
 
 
@@ -178,13 +186,16 @@ class ComponentDiv(_Component):
 
     def render(self) -> str:
         st = self.style or StyleDiv()
-        css = [f"margin:{st.margin_px}px"]
+        # _esc on every wire-sourced field, including declared-numeric
+        # ones: from_jsonable does not type-check, so a string can ride
+        # in where an int is expected
+        css = [f"margin:{_esc(st.margin_px)}px"]
         if st.width is not None:
-            css.append(f"width:{st.width}px")
+            css.append(f"width:{_esc(st.width)}px")
         if st.height is not None:
-            css.append(f"height:{st.height}px")
+            css.append(f"height:{_esc(st.height)}px")
         if st.float_value:
-            css.append(f"float:{st.float_value}")
+            css.append(f"float:{_esc(st.float_value)}")
         inner = "".join(c.render() for c in self.children)
         return f'<div style="{";".join(css)}">{inner}</div>'
 
@@ -209,8 +220,8 @@ class DecoratorAccordion(_Component):
         inner = "".join(c.render() for c in self.children)
         open_attr = "" if self.default_collapsed else " open"
         return (f"<details{open_attr} style='background:"
-                f"{st.background_color};margin:6px 0;padding:4px'>"
-                f"<summary style='color:{st.title_color};cursor:pointer'>"
+                f"{_esc(st.background_color)};margin:6px 0;padding:4px'>"
+                f"<summary style='color:{_esc(st.title_color)};cursor:pointer'>"
                 f"{html.escape(self.title)}</summary>{inner}</details>")
 
 
@@ -225,9 +236,9 @@ class _Chart(_Component):
     def _frame(self, inner: str, x_min, x_max, y_min, y_max) -> str:
         w, h, p, st = self._dims()
         axes = (f'<line x1="{p}" y1="{h-p}" x2="{w-p}" y2="{h-p}" '
-                f'stroke="{st.axis_stroke}"/>'
+                f'stroke="{_esc(st.axis_stroke)}"/>'
                 f'<line x1="{p}" y1="{p}" x2="{p}" y2="{h-p}" '
-                f'stroke="{st.axis_stroke}"/>'
+                f'stroke="{_esc(st.axis_stroke)}"/>'
                 f'<text x="{p}" y="{h-p+16}" font-size="10">'
                 f"{x_min:.3g}</text>"
                 f'<text x="{w-p-30}" y="{h-p+16}" font-size="10">'
@@ -235,7 +246,8 @@ class _Chart(_Component):
                 f'<text x="2" y="{h-p}" font-size="10">{y_min:.3g}</text>'
                 f'<text x="2" y="{p+8}" font-size="10">{y_max:.3g}</text>')
         t = (f'<text x="{w//2}" y="16" text-anchor="middle" '
-             f'font-size="{st.title_size}">{html.escape(self.title)}</text>'
+             f'font-size="{_esc(st.title_size)}">{html.escape(self.title)}'
+             "</text>"
              if self.title else "")
         xl = (f'<text x="{w//2}" y="{h-4}" text-anchor="middle" '
               f'font-size="11">{html.escape(self.x_label)}</text>'
@@ -258,7 +270,7 @@ class _Chart(_Component):
     def _color(self, i: int) -> str:
         st = getattr(self, "style", None) or StyleChart()
         colors = st.series_colors or _COLORS
-        return colors[i % len(colors)]
+        return _esc(colors[i % len(colors)])
 
 
 @register_serde
@@ -280,7 +292,7 @@ class ChartLine(_Chart):
         _, _, _, st = self._dims()
         pts = " ".join(f"{a:.1f},{b:.1f}" for a, b in zip(px, py))
         return (f'<polyline points="{pts}" fill="none" '
-                f'stroke="{color}" stroke-width="{st.stroke_width}"/>')
+                f'stroke="{color}" stroke-width="{_esc(st.stroke_width)}"/>')
 
     def render(self) -> str:
         if not self.series:
@@ -312,7 +324,7 @@ class ChartScatter(ChartLine):
     def _marks(self, px, py, color) -> str:
         _, _, _, st = self._dims()
         return "".join(f'<circle cx="{a:.1f}" cy="{b:.1f}" '
-                       f'r="{st.point_size}" fill="{color}"/>'
+                       f'r="{_esc(st.point_size)}" fill="{color}"/>'
                        for a, b in zip(px, py))
 
 
@@ -472,11 +484,15 @@ class ChartHorizontalBar(_Chart):
     def render(self) -> str:
         if not self.categories:
             return self._frame("", 0, 1, 0, 1)
+        # both extremes clamp to the zero baseline so all-negative (and
+        # all-positive) inputs keep the baseline and labels inside the
+        # frame; the `or` guard covers the all-zero degenerate span
         v_min = min(0.0, min(v for _, v in self.categories))
-        v_max = max(v for _, v in self.categories) or 1.0
+        v_max = max(0.0, max(v for _, v in self.categories))
+        span = (v_max - v_min) or 1.0
         w, h, p, _ = self._dims()
         bar_h = (h - 2 * p) / len(self.categories)
-        sx = lambda v: p + (v - v_min) / max(v_max - v_min, 1e-12) * (w - 2 * p)
+        sx = lambda v: p + (v - v_min) / span * (w - 2 * p)
         inner = []
         for i, (name, v) in enumerate(self.categories):
             y0 = p + i * bar_h

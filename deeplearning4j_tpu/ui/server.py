@@ -301,12 +301,17 @@ class _Handler(JsonHandler):
                  .add_series("score", iters, [r.score or 0.0 for r in recs]))
         comps.append(score)
         norms = ChartLine(title="parameter L2 norms", x_label="iteration")
+        # collect (iteration, norm) pairs while scanning: a parameter that
+        # appears in only SOME records must pair with those records'
+        # iterations, not with a same-length tail of the iteration axis
         series = {}
         for r in recs:
             for name, st in r.param_stats.items():
-                series.setdefault(name, []).append(st.get("norm2") or 0.0)
-        for name, ys in sorted(series.items()):
-            norms.add_series(name, iters[-len(ys):], ys)
+                series.setdefault(name, []).append(
+                    (r.iteration, st.get("norm2") or 0.0))
+        for name, pts in sorted(series.items()):
+            norms.add_series(name, [it for it, _ in pts],
+                            [v for _, v in pts])
         comps.append(DecoratorAccordion(title="Parameters",
                                         children=[norms]))
         last = recs[-1]

@@ -31,6 +31,7 @@ def main():
     steps = 30 if on_tpu else 10
     for _ in range(steps):
         net.fit(x, y)
+    jax.block_until_ready(net.params)   # close async dispatch before timing
     dt = time.perf_counter() - t0
     last = float(net.score((x, y)))
     toks = 4 * seq * steps / dt

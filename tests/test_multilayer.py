@@ -418,3 +418,26 @@ def test_fit_on_device_fused_multi_epoch():
     assert not any(k[0] == "epochs_scan" for k in loop._jit_cache)
     # equal-quality learning, not bit-equality (key split trees differ)
     assert fused.score() < 0.35 and loop.score() < 0.35
+
+
+def test_fit_on_device_fused_clears_stale_grad_stats():
+    """The fused multi-epoch program discards gradient stats on purpose;
+    a following consumer must see "absent" (None), not the previous
+    non-fused fit's stale norms (ISSUE 1 satellite)."""
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(11)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    from deeplearning4j_tpu.data.mnist import IrisDataSetIterator
+    ds = next(iter(IrisDataSetIterator(batch_size=150)))
+    x, y = np.asarray(ds.features)[:64], np.asarray(ds.labels)[:64]
+    net.fit(x, y)                                  # per-batch path
+    assert net._last_grad_stats is not None        # stats recorded
+    net.fit_on_device(x, y, batch_size=32, epochs=3)   # fused eligible
+    assert any(k[0] == "epochs_scan" for k in net._jit_cache)
+    assert net._last_grad_stats is None

@@ -518,3 +518,77 @@ def test_ui_server_report_page():
         assert "no records" in empty
     finally:
         server.stop()
+
+
+def test_ui_component_style_values_escaped():
+    """Style fields travel over the component_from_json wire between
+    hosts, so color/font strings are untrusted: attribute-escaping at
+    render time closes the injection vector (ISSUE 1 / ADVICE round 5)."""
+    from deeplearning4j_tpu.ui import (ChartLine, ComponentTable,
+                                       ComponentText, DecoratorAccordion,
+                                       StyleAccordion, StyleChart,
+                                       StyleTable, StyleText,
+                                       component_from_json,
+                                       component_to_json)
+    payload = '"><script>alert(1)</script>'
+    comps = [
+        ComponentText("t", style=StyleText(color=payload, font=payload)),
+        ComponentTable(["h"], [["v"]],
+                       style=StyleTable(header_color=payload,
+                                        background_color=payload)),
+        DecoratorAccordion(title="a",
+                           style=StyleAccordion(title_color=payload,
+                                                background_color=payload)),
+        ChartLine(title="c", style=StyleChart(
+            axis_stroke=payload,
+            series_colors=[payload])).add_series("s", [0, 1], [1.0, 2.0]),
+    ]
+    for c in comps:
+        # escaping must hold on direct render AND after a wire round-trip
+        for rendered in (c.render(),
+                         component_from_json(component_to_json(c)).render()):
+            assert "<script>" not in rendered
+            assert "&quot;&gt;&lt;script&gt;" in rendered
+
+
+def test_ui_chart_horizontal_bar_all_negative_layout():
+    """All-negative values: the zero baseline clamps to the right edge and
+    every bar/label coordinate stays inside the SVG (regression: the old
+    v_max==max(values) put sx(0) far outside the 540px frame)."""
+    import re
+    from deeplearning4j_tpu.ui import ChartHorizontalBar
+    bars = (ChartHorizontalBar(title="losses")
+            .add_bar("a", -5.0).add_bar("b", -2.0))
+    svg = bars.render()
+    w = 540.0  # StyleChart default width
+    for m in re.finditer(r'<rect x="([0-9.]+)" [^>]*width="([0-9.]+)"', svg):
+        x, bw = float(m.group(1)), float(m.group(2))
+        assert 0.0 <= x <= w and x + bw <= w + 0.5, (x, bw)
+    for m in re.finditer(r'<text x="([0-9.-]+)"', svg):
+        assert -0.5 <= float(m.group(1)) <= w, m.group(0)
+    # all-zero degenerate span must not divide by zero
+    z = ChartHorizontalBar().add_bar("z", 0.0).render()
+    assert "<svg" in z
+
+
+def test_ui_training_report_pairs_sparse_param_norms():
+    """_training_report pairs each parameter's norms with the iterations
+    of the records the parameter actually appeared in (regression: the
+    old code matched a same-length TAIL of the iteration axis)."""
+    from types import SimpleNamespace
+    from deeplearning4j_tpu.ui.server import _Handler
+    recs = [
+        SimpleNamespace(iteration=1, score=0.5, iter_time_ms=1.0,
+                        param_stats={"w": {"norm2": 1.0}}),
+        SimpleNamespace(iteration=2, score=0.4, iter_time_ms=1.0,
+                        param_stats={"w": {"norm2": 1.1}}),
+        SimpleNamespace(iteration=3, score=0.3, iter_time_ms=1.0,
+                        param_stats={}),   # param absent in the last record
+    ]
+    page = _Handler._training_report(None, "sid", recs)
+    norms_svg = next(s for s in page.split("<svg")
+                     if "parameter L2 norms" in s).split("</svg>")[0]
+    # x extents of the norms chart: iterations 1..2 (where `w` appeared),
+    # NOT the tail 2..3 the old pairing produced
+    assert ">1<" in norms_svg and ">2<" in norms_svg
+    assert ">3<" not in norms_svg

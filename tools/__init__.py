@@ -1,0 +1,2 @@
+# Makes `tools` importable so `python -m tools.graftlint` and
+# `from tools.graftlint import lint_source` work from the repo root.

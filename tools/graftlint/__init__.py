@@ -1,0 +1,83 @@
+"""graftlint — AST-based JAX/TPU correctness linter for deeplearning4j_tpu.
+
+Ten rules (JX001–JX010) targeting the failure modes a JAX reproduction
+actually hits: tracer leaks across the host/device boundary, Python
+control flow on tracers, hidden host syncs in hot loops, silent
+recompilation, jit impurity, and benchmark lies from async dispatch.
+
+Usage:
+    python -m tools.graftlint deeplearning4j_tpu/            # text output
+    python -m tools.graftlint --format json path/to/file.py
+    python -m tools.graftlint --write-baseline deeplearning4j_tpu/
+
+Library API:
+    from tools.graftlint import lint_source, lint_paths, Finding
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .analysis import analyze_module
+from .core import Baseline, Finding, iter_python_files, parse_pragmas
+from .rules import RULES, RULE_DOCS
+
+__all__ = ["Finding", "Baseline", "RULES", "RULE_DOCS",
+           "lint_source", "lint_file", "lint_paths", "iter_python_files"]
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None,
+                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source string; returns findings after pragma filtering."""
+    try:
+        info = analyze_module(source, path)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=e.offset or 0,
+                        rule="JX000", message=f"syntax error: {e.msg}")]
+    pragmas = parse_pragmas(source)
+    active = _active_rules(select, ignore)
+    findings: List[Finding] = []
+    for code in active:
+        findings.extend(RULES[code](info))
+    findings = [f for f in findings if not pragmas.suppressed(f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, select: Optional[Sequence[str]] = None,
+              ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(source, path, select=select, ignore=ignore)
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in iter_python_files(paths):
+        findings.extend(lint_file(p, select=select, ignore=ignore))
+    return findings
+
+
+def _active_rules(select: Optional[Sequence[str]],
+                  ignore: Optional[Sequence[str]]) -> List[str]:
+    codes = sorted(RULES)
+    if select:
+        wanted = {c.strip().upper() for c in select}
+        _check_known(wanted, "--select")
+        codes = [c for c in codes if c in wanted]
+    if ignore:
+        dropped = {c.strip().upper() for c in ignore}
+        _check_known(dropped, "--ignore")
+        codes = [c for c in codes if c not in dropped]
+    return codes
+
+
+def _check_known(codes, flag: str) -> None:
+    """A typo'd rule code selecting nothing would gate on thin air."""
+    unknown = sorted(c for c in codes if c not in RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule code(s) for {flag}: {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(RULES))})")

@@ -1,0 +1,6 @@
+"""Entry point: ``python -m tools.graftlint <paths>``."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,441 @@
+"""Shared semantic analysis for graftlint rules.
+
+Three layers, all stdlib-``ast``:
+
+1. **Import aliases** — which local names mean ``numpy`` / ``jax`` /
+   ``jax.numpy`` / ``jax.lax`` / ``jax.jit`` / ``functools.partial``,
+   resolved from the module's import statements so rules never
+   string-match on spelling conventions.
+2. **Jit scopes** — the set of function definitions whose bodies execute
+   under a JAX trace: decorated with ``@jax.jit`` (directly or via
+   ``partial``), wrapped by a ``jax.jit(f)`` call expression, passed as
+   the body of a ``jax.lax`` control-flow combinator (``scan`` /
+   ``while_loop`` / ``fori_loop`` / ``cond`` / ``switch`` /
+   ``associative_scan``) or ``jax.vmap`` / ``jax.pmap`` /
+   ``jax.grad`` / ``jax.value_and_grad`` / ``jax.checkpoint``, or
+   lexically nested inside such a function.
+3. **Taint** — a per-function fixpoint over simple assignments marking
+   which local names derive from the function's parameters (i.e. are
+   tracer-valued under jit).  Shape/static accessors (``.shape``,
+   ``.ndim``, ``.dtype``, ``.size``, ``len()``, ``isinstance()``,
+   ``type()``) BLOCK taint: branching on a traced array's *shape* is
+   legal and idiomatic, branching on its *value* is a TracerBoolError.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["ModuleInfo", "analyze_module", "TaintInfo", "taint_function",
+           "dotted_name", "call_name", "parent_chain"]
+
+# attribute accesses whose RESULT is static even when the base is traced
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                "aval", "weak_type"}
+# call targets whose result is static regardless of argument taint.
+# tree_leaves/tree_flatten/tree_structure: the returned CONTAINER's
+# truthiness/length is static (pytree structure is trace-static) — the
+# deliberate imprecision is that element access through it loses taint.
+STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "id",
+                "repr", "str.format", "tree_leaves", "tree_flatten",
+                "tree_structure"}
+
+_LAX_COMBINATORS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                    "associative_scan", "map"}
+_JAX_TRANSFORMS = {"vmap", "pmap", "grad", "value_and_grad", "checkpoint",
+                   "remat", "custom_jvp", "custom_vjp"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain as a string, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+class ModuleInfo:
+    """Resolved aliases + jit-scope membership for one parsed module."""
+
+    def __init__(self, tree: ast.Module, path: str, source: str):
+        self.tree = tree
+        self.path = path
+        self.source = source
+        self.numpy_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.lax_aliases: Set[str] = set()
+        self.jit_names: Set[str] = set()        # names bound to jax.jit itself
+        self.partial_names: Set[str] = set()
+        self.time_names: Set[str] = set()       # names bound to the time module
+        self.timer_names: Set[str] = set()      # perf_counter/time imported bare
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.jit_scopes: Set[ast.AST] = set()   # FunctionDef/AsyncFunctionDef/Lambda
+        # func -> parameter names declared static via static_argnums/names
+        # (static args are NOT tracers: branching on them is legal)
+        self.static_params: Dict[ast.AST, Set[str]] = {}
+        self._build_parents()
+        self._collect_imports()
+        self._collect_jit_scopes()
+
+    # ---------------------------------------------------------- parents
+    def _build_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    # ---------------------------------------------------------- imports
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "numpy":
+                        self.numpy_aliases.add(name)
+                    elif alias.name == "jax.numpy":
+                        self.jnp_aliases.add(alias.asname or "jax")
+                    elif alias.name == "jax.lax":
+                        self.lax_aliases.add(alias.asname or "jax")
+                    elif alias.name == "jax" or alias.name.startswith("jax."):
+                        self.jax_aliases.add(name)
+                    elif alias.name == "time":
+                        self.time_names.add(name)
+                    elif alias.name == "functools":
+                        pass  # functools.partial resolved via dotted name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if mod == "jax" and alias.name == "jit":
+                        self.jit_names.add(name)
+                    elif mod == "jax" and alias.name == "numpy":
+                        self.jnp_aliases.add(name)
+                    elif mod == "jax" and alias.name == "lax":
+                        self.lax_aliases.add(name)
+                    elif mod == "functools" and alias.name == "partial":
+                        self.partial_names.add(name)
+                    elif mod == "time" and alias.name in ("perf_counter",
+                                                          "monotonic"):
+                        self.timer_names.add(name)
+                    elif mod == "numpy":
+                        # `from numpy import asarray` — track per-name as a
+                        # numpy alias usable bare (rules check dotted paths,
+                        # so record as "name" with implicit numpy base)
+                        pass
+
+    # ----------------------------------------------------- jit detection
+    def is_jit_ref(self, node: ast.AST) -> bool:
+        """Is this expression a reference to ``jax.jit`` itself?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.jit_names
+        d = dotted_name(node)
+        if d is None:
+            return False
+        root, _, rest = d.partition(".")
+        return root in self.jax_aliases and rest == "jit"
+
+    def is_jit_call(self, node: ast.AST) -> bool:
+        """Is this a ``jax.jit(...)`` / ``jit(...)`` /
+        ``partial(jax.jit, ...)`` call expression?"""
+        if not isinstance(node, ast.Call):
+            return False
+        if self.is_jit_ref(node.func):
+            return True
+        # functools.partial(jax.jit, ...)
+        fname = call_name(node)
+        if fname and (fname in self.partial_names
+                      or fname.endswith("functools.partial")
+                      or fname == "functools.partial"):
+            return bool(node.args) and self.is_jit_ref(node.args[0])
+        return False
+
+    def _is_trace_entry_call(self, node: ast.Call) -> Tuple[bool, List[ast.AST]]:
+        """Calls whose function-valued arguments run under a trace:
+        ``jax.lax.scan(f, ...)``, ``jax.vmap(f)``, ``jax.grad(f)``…
+        Returns (is_entry, candidate function-expression args)."""
+        d = call_name(node)
+        if d is None:
+            return False, []
+        parts = d.split(".")
+        root, leaf = parts[0], parts[-1]
+        is_lax = ((root in self.lax_aliases and leaf in _LAX_COMBINATORS
+                   and (len(parts) == 1 or "lax" in parts or root == "lax"))
+                  or (root in self.jax_aliases and len(parts) >= 2
+                      and parts[1] == "lax" and leaf in _LAX_COMBINATORS))
+        is_tx = (root in self.jax_aliases and len(parts) == 2
+                 and leaf in _JAX_TRANSFORMS)
+        if not (is_lax or is_tx):
+            return False, []
+        cands: List[ast.AST] = list(node.args[:2])
+        for kw in node.keywords:
+            if kw.arg in ("f", "fun", "body_fun", "cond_fun", "body",
+                          "true_fun", "false_fun"):
+                cands.append(kw.value)
+        return True, cands
+
+    @staticmethod
+    def _static_names_from_call(call: ast.Call, func: ast.AST) -> Set[str]:
+        """Parameter names made static by static_argnums/static_argnames
+        keywords on a jit(...) call applied to ``func``."""
+        names: Set[str] = set()
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return names
+        params = [a.arg for a in (list(func.args.posonlyargs)
+                                  + list(func.args.args))]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for v in _iter_constants(kw.value):
+                    if isinstance(v, str):
+                        names.add(v)
+            elif kw.arg == "static_argnums":
+                for v in _iter_constants(kw.value):
+                    if isinstance(v, int) and 0 <= v < len(params):
+                        names.add(params[v])
+        return names
+
+    def _record_static_params(self, call: ast.Call, func: ast.AST) -> None:
+        names = self._static_names_from_call(call, func)
+        if names:
+            self.static_params.setdefault(func, set()).update(names)
+
+    def _collect_jit_scopes(self) -> None:
+        funcs_by_scope: Dict[Tuple[ast.AST, str], List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self.enclosing_function(node) or self.tree
+                funcs_by_scope.setdefault((scope, node.name), []).append(node)
+
+        def mark_name(name_node: ast.AST, at: ast.AST,
+                      jit_call: Optional[ast.Call] = None) -> None:
+            if isinstance(name_node, ast.Lambda):
+                self.jit_scopes.add(name_node)
+                return
+            if not isinstance(name_node, ast.Name):
+                return
+            scope = self.enclosing_function(at) or self.tree
+            # resolve in the enclosing scope chain, innermost first
+            cur: Optional[ast.AST] = scope
+            while cur is not None:
+                hits = funcs_by_scope.get((cur, name_node.id))
+                if hits:
+                    self.jit_scopes.update(hits)
+                    if jit_call is not None:
+                        for h in hits:
+                            self._record_static_params(jit_call, h)
+                    return
+                cur = (self.enclosing_function(cur)
+                       if cur is not self.tree else None)
+                if cur is None and scope is not self.tree:
+                    cur = self.tree
+                    scope = self.tree  # last round
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self.is_jit_ref(dec) or self.is_jit_call(dec):
+                        self.jit_scopes.add(node)
+                        if isinstance(dec, ast.Call):
+                            self._record_static_params(dec, node)
+            if isinstance(node, ast.Call):
+                if self.is_jit_call(node):
+                    for arg in node.args[:1]:
+                        mark_name(arg, node, jit_call=node)
+                    for kw in node.keywords:
+                        if kw.arg in ("fun", "f"):
+                            mark_name(kw.value, node, jit_call=node)
+                else:
+                    is_entry, cands = self._is_trace_entry_call(node)
+                    if is_entry:
+                        for c in cands:
+                            mark_name(c, node)
+
+        # lexical nesting: a function defined inside a jit scope is traced
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda))
+                        and node not in self.jit_scopes):
+                    enc = self.enclosing_function(node)
+                    if enc is not None and enc in self.jit_scopes:
+                        self.jit_scopes.add(node)
+                        changed = True
+
+    def in_jit_scope(self, node: ast.AST) -> bool:
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            if cur in self.jit_scopes:
+                return True
+            cur = self.enclosing_function(cur)
+        return False
+
+
+def _iter_constants(node: ast.AST):
+    """Yield constant values from a literal or tuple/list of literals."""
+    if isinstance(node, ast.Constant):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant):
+                yield e.value
+
+
+def analyze_module(source: str, path: str) -> ModuleInfo:
+    tree = ast.parse(source, filename=path)
+    return ModuleInfo(tree, path, source)
+
+
+# ------------------------------------------------------------------ taint
+class TaintInfo:
+    """Which expressions in a function derive from its parameters."""
+
+    def __init__(self, info: ModuleInfo, func: ast.AST):
+        self.info = info
+        self.func = func
+        self.tainted: Set[str] = set()
+        args = func.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            self.tainted.add(a.arg)
+        if args.vararg:
+            self.tainted.add(args.vararg.arg)
+        if args.kwarg:
+            self.tainted.add(args.kwarg.arg)
+        # static jit args are concrete Python values, not tracers
+        self.tainted -= info.static_params.get(func, set())
+        self._fixpoint()
+
+    def _own_statements(self) -> List[ast.AST]:
+        """Nodes belonging to this function, excluding nested functions."""
+        out: List[ast.AST] = []
+        body = self.func.body if not isinstance(self.func, ast.Lambda) else [self.func.body]
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _fixpoint(self) -> None:
+        nodes = self._own_statements()
+        for _ in range(8):
+            before = len(self.tainted)
+            for n in nodes:
+                if isinstance(n, ast.Assign):
+                    if self.expr_tainted(n.value):
+                        for t in n.targets:
+                            self._taint_target(t)
+                elif isinstance(n, ast.AugAssign):
+                    if (self.expr_tainted(n.value)
+                            or self.expr_tainted(n.target)):
+                        self._taint_target(n.target)
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    if self.expr_tainted(n.value):
+                        self._taint_target(n.target)
+                elif isinstance(n, ast.For):
+                    if self.expr_tainted(n.iter):
+                        self._taint_target(n.target)
+                elif isinstance(n, ast.withitem):
+                    if n.optional_vars is not None and self.expr_tainted(
+                            n.context_expr):
+                        self._taint_target(n.optional_vars)
+                elif isinstance(n, (ast.NamedExpr,)):
+                    if self.expr_tainted(n.value):
+                        self._taint_target(n.target)
+            if len(self.tainted) == before:
+                break
+
+    def _taint_target(self, t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            self.tainted.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._taint_target(e)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value)
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        """Does this expression's VALUE derive from a parameter, with
+        static accessors (shape/dtype/len/…) blocking propagation?"""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = call_name(node)
+            if fname:
+                leaf = fname.split(".")[-1]
+                if fname in STATIC_CALLS or leaf in STATIC_CALLS:
+                    return False
+            # a call is tainted if its function or any argument is
+            if self.expr_tainted(node.func):
+                return True
+            return (any(self.expr_tainted(a) for a in node.args)
+                    or any(self.expr_tainted(k.value)
+                           for k in node.keywords))
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) or self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return (self.expr_tainted(node.left)
+                    or any(self.expr_tainted(c) for c in node.comparators))
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return (any(self.expr_tainted(v) for v in node.values)
+                    or any(k is not None and self.expr_tainted(k)
+                           for k in node.keys))
+        if isinstance(node, ast.IfExp):
+            return (self.expr_tainted(node.body)
+                    or self.expr_tainted(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return (self.expr_tainted(node.elt)
+                    or any(self.expr_tainted(g.iter)
+                           for g in node.generators))
+        if isinstance(node, ast.DictComp):
+            return (self.expr_tainted(node.key)
+                    or self.expr_tainted(node.value)
+                    or any(self.expr_tainted(g.iter)
+                           for g in node.generators))
+        if isinstance(node, ast.NamedExpr):
+            return self.expr_tainted(node.value)
+        return False
+
+
+def taint_function(info: ModuleInfo, func: ast.AST) -> TaintInfo:
+    return TaintInfo(info, func)

@@ -1,0 +1,73 @@
+"""graftlint command-line interface."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import RULE_DOCS, lint_paths
+from .core import Baseline
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based JAX/TPU correctness linter "
+                    "(rules JX001-JX010; see tools/README.md)")
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of accepted findings "
+                        "(default: tools/graftlint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file and exit")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rule codes to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for code in sorted(RULE_DOCS):
+            print(f"{code}  {RULE_DOCS[code]}")
+        return 0
+    if not args.paths:
+        build_parser().error("the following arguments are required: paths")
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    try:
+        findings = lint_paths(args.paths, select=select, ignore=ignore)
+    except (FileNotFoundError, ValueError) as e:
+        build_parser().error(str(e))
+
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if not args.no_baseline:
+        findings = Baseline.load(args.baseline).filter(findings)
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        print(f"graftlint: {n} finding(s)" if n else "graftlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

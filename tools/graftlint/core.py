@@ -1,0 +1,170 @@
+"""graftlint core: findings, pragmas, file walking, baseline handling.
+
+The linter is deliberately dependency-free (stdlib ``ast`` + ``json``)
+so it can run in any environment the package itself runs in — including
+the minimal TPU-pod images where dev-tooling wheels are unavailable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding", "PragmaIndex", "Baseline", "iter_python_files",
+    "parse_pragmas", "RULE_CODE_RE",
+]
+
+RULE_CODE_RE = re.compile(r"JX\d{3}")
+
+# `# graftlint: disable=JX001[,JX002…]` — same line, or a standalone
+# pragma-only line applying to the next line.  `disable-file=` at any
+# column disables rules for the whole file.  Anything after the code
+# list (a justifying comment, as the docs encourage) is ignored.
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding: ``path:line:col RULE message``."""
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
+
+
+class PragmaIndex:
+    """Inline suppression pragmas for one source file.
+
+    ``# graftlint: disable=JX003`` on a line suppresses those rules for
+    that line; on a line holding only the pragma (plus whitespace) it
+    suppresses them for the following line.  ``disable-file=JX003``
+    suppresses the rules everywhere in the file.
+    """
+
+    def __init__(self, source: str):
+        self.line_rules: Dict[int, Set[str]] = {}
+        self.file_rules: Set[str] = set()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            kind, codes_raw = m.group(1), m.group(2)
+            codes = {c.strip().upper() for c in codes_raw.split(",")
+                     if c.strip()}
+            codes = {c for c in codes if RULE_CODE_RE.fullmatch(c)}
+            if not codes:
+                continue
+            if kind == "disable-file":
+                self.file_rules |= codes
+            else:
+                target = lineno
+                if text[:m.start()].strip() == "":
+                    # pragma-only line: applies to the next code line
+                    target = lineno + 1
+                self.line_rules.setdefault(target, set()).update(codes)
+                # also apply to the pragma's own line so trailing pragmas
+                # placed on the first line of a multi-line statement work
+                if target != lineno:
+                    self.line_rules.setdefault(lineno, set()).update(codes)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_rules:
+            return True
+        return finding.rule in self.line_rules.get(finding.line, set())
+
+
+def parse_pragmas(source: str) -> PragmaIndex:
+    return PragmaIndex(source)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    """Expand files/directories into a sorted list of ``.py`` files.
+
+    Raises on nonexistent or non-``.py`` file arguments: a typo'd path
+    silently linting nothing would report "clean" in a gate forever.
+    """
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif not os.path.exists(p):
+            raise FileNotFoundError(f"no such file or directory: {p}")
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise ValueError(f"not a .py file or directory: {p}")
+    return out
+
+
+def _norm(path: str) -> str:
+    return os.path.relpath(path).replace(os.sep, "/")
+
+
+class Baseline:
+    """Checked-in allowance for deliberate findings.
+
+    Format: ``{"<path>::<rule>": count}`` — line numbers are deliberately
+    NOT part of the key so unrelated edits above a baselined finding don't
+    churn the file.  A finding is absorbed while the (path, rule) budget
+    lasts; anything beyond the budget is reported.
+    """
+
+    def __init__(self, allowances: Optional[Dict[str, int]] = None):
+        self.allowances: Dict[str, int] = dict(allowances or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return cls({k: int(v) for k, v in data.get("allow", {}).items()})
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        allow: Dict[str, int] = {}
+        for f in findings:
+            key = f"{_norm(f.path)}::{f.rule}"
+            allow[key] = allow.get(key, 0) + 1
+        return cls(allow)
+
+    def save(self, path: str) -> None:
+        payload = {
+            "comment": "graftlint baseline: '<path>::<rule>': allowed count. "
+                       "Regenerate with --write-baseline; keep near-empty.",
+            "allow": dict(sorted(self.allowances.items())),
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    def filter(self, findings: Sequence[Finding]) -> List[Finding]:
+        """Return the findings NOT absorbed by the baseline."""
+        budget = dict(self.allowances)
+        kept: List[Finding] = []
+        for f in findings:
+            key = f"{_norm(f.path)}::{f.rule}"
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+            else:
+                kept.append(f)
+        return kept

@@ -1,0 +1,423 @@
+"""graftlint rule implementations JX001–JX010.
+
+Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
+registered in ``RULES``.  Rules share the jit-scope + taint machinery in
+``analysis.py``; see ``tools/README.md`` for the catalog with rationale.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, List, Optional
+
+from .analysis import (ModuleInfo, TaintInfo, call_name, dotted_name,
+                       taint_function)
+from .core import Finding
+
+__all__ = ["RULES", "RULE_DOCS"]
+
+RULES: Dict[str, Callable[[ModuleInfo], List[Finding]]] = {}
+RULE_DOCS: Dict[str, str] = {}
+
+_HOT_FUNC_RE = re.compile(r"(^|_)(fit|train|step|epoch)", re.IGNORECASE)
+
+
+def rule(code: str, doc: str):
+    def deco(fn):
+        RULES[code] = fn
+        RULE_DOCS[code] = doc
+        return fn
+    return deco
+
+
+def _finding(info: ModuleInfo, node: ast.AST, code: str, msg: str) -> Finding:
+    return Finding(path=info.path, line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), rule=code, message=msg)
+
+
+def _jit_scope_taints(info: ModuleInfo) -> Dict[ast.AST, TaintInfo]:
+    return {f: taint_function(info, f) for f in info.jit_scopes}
+
+
+def _in_loop_same_function(info: ModuleInfo, node: ast.AST) -> bool:
+    """Is ``node`` inside a for/while loop without crossing a function
+    boundary? (A jit() in a loop body recompiles per iteration only if
+    the loop actually re-executes the call.)"""
+    cur = info.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.Module)):
+            return False
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        cur = info.parent(cur)
+    return False
+
+
+# --------------------------------------------------------------------- JX001
+@rule("JX001", "host numpy call on a traced value inside a jit scope")
+def jx001(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    taints = _jit_scope_taints(info)
+    for func, taint in taints.items():
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            if info.enclosing_function(node) not in taints:
+                continue
+            fname = call_name(node)
+            if not fname:
+                continue
+            root = fname.split(".")[0]
+            if root not in info.numpy_aliases or "." not in fname:
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(taints[info.enclosing_function(node)].expr_tainted(a)
+                   for a in args):
+                out.append(_finding(
+                    info, node, "JX001",
+                    f"host-numpy call `{fname}` on a traced value inside a "
+                    "jit scope: runs at trace time on abstract tracers "
+                    "(TracerArrayConversionError) — use jax.numpy"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX002
+@rule("JX002", "Python if/while branches on a tracer value in a jit scope")
+def jx002(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    taints = _jit_scope_taints(info)
+    for func, _ in taints.items():
+        for node in ast.walk(func):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                continue
+            enc = info.enclosing_function(node)
+            if enc not in taints:
+                continue
+            if taints[enc].expr_tainted(node.test):
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression",
+                        ast.Assert: "assert"}[type(node)]
+                out.append(_finding(
+                    info, node, "JX002",
+                    f"Python `{kind}` on a tracer-derived value inside a jit "
+                    "scope: raises TracerBoolConversionError at trace time — "
+                    "use jax.lax.cond/select or jnp.where"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX003
+@rule("JX003", "host sync (.item()/float()/np.asarray) inside a training loop")
+def jx003(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    # pure-host modules have no device arrays to sync on
+    if not (info.jax_aliases or info.jnp_aliases):
+        return out
+    for func in ast.walk(info.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _HOT_FUNC_RE.search(func.name):
+            continue
+        loops = [n for n in ast.walk(func)
+                 if isinstance(n, (ast.For, ast.AsyncFor, ast.While))
+                 and info.enclosing_function(n) is func]
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                sync = _host_sync_kind(info, node)
+                if sync:
+                    out.append(_finding(
+                        info, node, "JX003",
+                        f"`{sync}` inside the loop of `{func.name}`: "
+                        "host-syncs every iteration, serializing the loop "
+                        "against dispatch RTT — keep values on device and "
+                        "materialize once after the loop"))
+    return _dedupe(out)
+
+
+def _contains_static_access(node: ast.AST) -> bool:
+    """Does the expression read a trace-static property (shape/ndim/…)?
+    ``int(x.shape[0])`` and ``int(getattr(x, "shape", ...)[0])`` are host
+    math on static metadata, not device syncs."""
+    from .analysis import STATIC_ATTRS
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return True
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if (cn == "getattr" and len(n.args) >= 2
+                    and isinstance(n.args[1], ast.Constant)
+                    and n.args[1].value in STATIC_ATTRS):
+                return True
+            if cn == "len":
+                return True
+    return False
+
+
+def _host_sync_kind(info: ModuleInfo, node: ast.Call) -> Optional[str]:
+    # x.item() — unconditional device->host sync on jax/numpy arrays
+    if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+            and not node.args and not node.keywords):
+        return ".item()"
+    fname = call_name(node)
+    if not fname:
+        return None
+    if fname in ("float", "int") and len(node.args) == 1:
+        a = node.args[0]
+        # flag only direct materialization of a stored value by bare name
+        # (float(loss), int(far)); subscripts/attributes are overwhelmingly
+        # host containers (dicts, metadata), and static-shape reads never
+        # sync at all
+        if isinstance(a, ast.Name) and not _contains_static_access(a):
+            return f"{fname}(...)"
+        return None
+    parts = fname.split(".")
+    if (parts[0] in info.numpy_aliases and len(parts) == 2
+            and parts[1] in ("asarray", "array", "asanyarray")):
+        # building an array FROM Python lists/comprehensions is host ETL,
+        # not a device fetch
+        if (node.args
+                and not isinstance(node.args[0],
+                                   (ast.Constant, ast.List, ast.Tuple,
+                                    ast.ListComp, ast.GeneratorExp))
+                and not _contains_static_access(node.args[0])):
+            return f"{fname}(...)"
+        return None
+    if parts[-1] == "device_get" and parts[0] in info.jax_aliases:
+        return f"{fname}(...)"
+    return None
+
+
+# --------------------------------------------------------------------- JX004
+@rule("JX004", "jax.jit called in a loop or invoked immediately (recompiles)")
+def jx004(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # jax.jit(f)(args): a fresh compile-cache entry per outer call when
+        # f is rebuilt each time; even when cached it re-hashes — hoist it.
+        if isinstance(node.func, ast.Call) and info.is_jit_call(node.func):
+            out.append(_finding(
+                info, node, "JX004",
+                "`jax.jit(f)(...)` invoked immediately: wrapping per call "
+                "defeats the compile cache when f is a fresh closure — "
+                "hoist the jitted callable out of the call site"))
+            continue
+        if info.is_jit_call(node) and _in_loop_same_function(info, node):
+            out.append(_finding(
+                info, node, "JX004",
+                "`jax.jit` called inside a loop: every iteration builds a "
+                "new wrapper (and recompiles when the function object is "
+                "fresh) — create the jitted function once outside the loop"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX005
+@rule("JX005", "non-hashable static_argnums/static_argnames value")
+def jx005(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(info.tree):
+        if not (isinstance(node, ast.Call) and info.is_jit_call(node)):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            bad = None
+            if isinstance(kw.value, (ast.List, ast.Set, ast.Dict,
+                                     ast.ListComp, ast.SetComp, ast.DictComp)):
+                bad = "a non-hashable literal"
+            elif isinstance(kw.value, ast.Call):
+                cn = call_name(kw.value) or ""
+                parts = cn.split(".")
+                if (parts[0] in (info.numpy_aliases | info.jnp_aliases)
+                        and parts[-1] in ("array", "asarray", "arange")):
+                    bad = "an array value"
+                elif parts[-1] in ("list", "dict", "set"):
+                    bad = "a non-hashable value"
+            if bad:
+                out.append(_finding(
+                    info, kw.value, "JX005",
+                    f"`{kw.arg}` is {bad}: jit hashes static args for its "
+                    "compile cache — pass a tuple of ints/strings"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX006
+@rule("JX006", "mutation of self/global state inside a jit scope (impurity)")
+def jx006(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for func in info.jit_scopes:
+        if isinstance(func, ast.Lambda):
+            continue
+        global_names = set()
+        for n in ast.walk(func):
+            if isinstance(n, ast.Global):
+                global_names.update(n.names)
+        for node in ast.walk(func):
+            if info.enclosing_function(node) is not func:
+                continue
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                is_self_attr = (isinstance(t, (ast.Attribute, ast.Subscript))
+                                and isinstance(base, ast.Name)
+                                and base.id == "self")
+                is_global = isinstance(t, ast.Name) and t.id in global_names
+                if is_self_attr or is_global:
+                    what = ("self attribute" if is_self_attr
+                            else f"global `{t.id}`")
+                    out.append(_finding(
+                        info, node, "JX006",
+                        f"mutating {what} inside a jit scope: the write "
+                        "happens once at trace time, then never again on "
+                        "cached executions — return the new value instead"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX007
+@rule("JX007", "bare `except:` swallows KeyboardInterrupt/SystemExit")
+def jx007(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            out.append(_finding(
+                info, node, "JX007",
+                "bare `except:` catches KeyboardInterrupt and SystemExit, "
+                "making training loops unkillable — catch `Exception` (or "
+                "narrower) instead"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX008
+@rule("JX008", "mutable default argument")
+def jx008(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(info.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            bad = None
+            if isinstance(d, (ast.List, ast.Dict, ast.Set,
+                              ast.ListComp, ast.DictComp, ast.SetComp)):
+                bad = "mutable literal"
+            elif isinstance(d, ast.Call):
+                cn = call_name(d) or ""
+                if cn in ("list", "dict", "set", "bytearray"):
+                    bad = f"`{cn}()`"
+            if bad:
+                out.append(_finding(
+                    info, d, "JX008",
+                    f"mutable default argument ({bad}): shared across every "
+                    "call — default to None and construct inside"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX009
+@rule("JX009", "timing around jax work without block_until_ready")
+def jx009(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    if not (info.jax_aliases or info.jnp_aliases):
+        return out
+    for func in ast.walk(info.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        timers: List[ast.Call] = []
+        uses_jax = False
+        synced = False
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call):
+                fname = call_name(n) or ""
+                parts = fname.split(".")
+                # only the benchmark clocks: time.time() is the deadline/
+                # timeout idiom, not a measurement
+                if ((parts[0] in info.time_names and len(parts) == 2
+                     and parts[1] in ("perf_counter", "monotonic"))
+                        or (len(parts) == 1
+                            and parts[0] in info.timer_names)):
+                    timers.append(n)
+                # fetching values (np.asarray/device_get) closes the async
+                # gap just as well as block_until_ready
+                if (len(parts) >= 2 and parts[0] in info.numpy_aliases
+                        and parts[-1] in ("asarray", "array")):
+                    synced = True
+                if parts[-1] == "device_get":
+                    synced = True
+            if isinstance(n, ast.Attribute):
+                if n.attr == "block_until_ready":
+                    synced = True
+                root = dotted_name(n)
+                if root:
+                    r = root.split(".")[0]
+                    if r in (info.jnp_aliases | info.jax_aliases
+                             | info.lax_aliases):
+                        uses_jax = True
+            if isinstance(n, ast.Name) and n.id in (info.jnp_aliases
+                                                    | info.jax_aliases):
+                uses_jax = True
+        if len(timers) >= 2 and uses_jax and not synced:
+            out.append(_finding(
+                info, timers[-1], "JX009",
+                f"`{func.name}` times jax work with no "
+                "`block_until_ready()`: async dispatch returns before the "
+                "device finishes, so this measures dispatch latency, not "
+                "compute — sync the result before reading the clock"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX010
+@rule("JX010", "float64 literal/dtype in jitted code (x64 promotion hazard)")
+def jx010(info: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for func in info.jit_scopes:
+        for node in ast.walk(func):
+            if not info.in_jit_scope(node) and info.enclosing_function(
+                    node) is not func:
+                continue
+            bad = None
+            if isinstance(node, ast.Attribute) and node.attr in (
+                    "float64", "complex128"):
+                root = dotted_name(node)
+                if root and root.split(".")[0] in (
+                        info.numpy_aliases | info.jnp_aliases
+                        | info.jax_aliases):
+                    bad = root
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)
+                  and node.value in ("float64", "complex128")):
+                par = info.parent(node)
+                # only flag dtype-ish positions: dtype= kwarg or astype arg
+                if isinstance(par, ast.keyword) and par.arg == "dtype":
+                    bad = f'"{node.value}"'
+                elif (isinstance(par, ast.Call)
+                      and isinstance(par.func, ast.Attribute)
+                      and par.func.attr in ("astype", "view")):
+                    bad = f'"{node.value}"'
+            if bad:
+                out.append(_finding(
+                    info, node, "JX010",
+                    f"{bad} inside a jit scope: without jax_enable_x64 this "
+                    "silently becomes float32; with it, it doubles HBM "
+                    "traffic and forbids TPU vector math — thread the "
+                    "model dtype through instead of hardcoding"))
+    return _dedupe(out)
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen = set()
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.path, f.line, f.col, f.rule)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
