@@ -9,6 +9,7 @@ program per train step, pytree params, mesh-sharded scale-out.
 
 __version__ = "0.1.0"
 
+from . import observability
 from .nn.conf.input_type import InputType
 from .nn.conf.multi_layer import (MultiLayerConfiguration,
                                   NeuralNetConfiguration)
@@ -23,4 +24,5 @@ __all__ = [
     "MultiLayerConfiguration",
     "NeuralNetConfiguration",
     "MultiLayerNetwork",
+    "observability",
 ]

@@ -72,10 +72,10 @@ class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
         self._start = None
 
     def initialize(self):
-        self._start = time.time()
+        self._start = time.perf_counter()
 
     def terminate(self, last_score):
-        return (time.time() - self._start) >= self.max_seconds
+        return (time.perf_counter() - self._start) >= self.max_seconds
 
 
 class MaxScoreIterationTerminationCondition(IterationTerminationCondition):

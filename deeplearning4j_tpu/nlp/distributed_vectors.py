@@ -236,11 +236,11 @@ def _worker_main(jobdir: str, wid: int, port: int,
         shard = [ln for ln in f.read().splitlines() if ln]
     from .sentence_iterator import CollectionSentenceIterator
     model.sentence_iterator = CollectionSentenceIterator(shard)
-    t0 = time.time()
+    t0 = time.perf_counter()
     model.fit()
     # close the clock on a host fetch — fit() only enqueues async work
     tables = _pack_tables(model.lookup_table)
-    dt = max(time.time() - t0, 1e-9)
+    dt = max(time.perf_counter() - t0, 1e-9)
     n_words = sum(len(s.split()) for s in shard) * model.epochs
     broker.publish(_W2V_FINAL, _encode_frame(wid, 0, tables))
     broker.publish(_DONE, json.dumps(

@@ -37,7 +37,14 @@ from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
+from ..observability.clock import monotonic_s
+from ..observability.registry import default_registry
 from ..train.listeners import TrainingListener
+
+# training-step histogram bounds: sub-ms CPU steps up to multi-second
+# XLA compiles in the "compile" phase series
+_STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 Array = jax.Array
 
@@ -62,6 +69,9 @@ class MultiLayerNetwork:
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries = None
         self._rnn_carry_batch = -1
+        # first executed train step compiles; the metrics split
+        # (training_step_seconds{phase=compile|steady}) keys off this
+        self._train_step_ran = False
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -351,6 +361,27 @@ class MultiLayerNetwork:
             return self
 
         step_fn = self._get_jitted("train_step")
+        # observability (cheap by default: plain host float math per
+        # step, instruments resolved once per fit, and the step timing
+        # closes on the loss sync _fit_one/_fit_tbptt already perform —
+        # no extra device sync is ever forced here; a disabled registry
+        # reduces all of it to one bool check)
+        reg = default_registry()
+        obs = reg.enabled
+        if obs:
+            steps_c = reg.counter("training_steps_total",
+                                  "Optimizer steps taken")
+            examples_c = reg.counter("training_examples_total",
+                                     "Training examples consumed")
+            step_h = reg.histogram(
+                "training_step_seconds",
+                "Train step wall time, split compile vs steady",
+                ("phase",), buckets=_STEP_BUCKETS)
+            etl_h = reg.histogram(
+                "training_etl_seconds",
+                "Time blocked on the data pipeline per batch",
+                buckets=_STEP_BUCKETS)
+        steady_examples, steady_s = 0, 0.0
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self)
@@ -366,15 +397,34 @@ class MultiLayerNetwork:
                     break
                 x, y, m, lm = batch
                 self.last_batch_size = int(getattr(x, "shape", (0,))[0])
+                compile_step = not self._train_step_ran
+                t_step = monotonic_s()
                 if self.conf.backprop_type == "tbptt" and \
                         getattr(x, "ndim", 2) == 3 and \
                         x.shape[1] > self.conf.tbptt_fwd_length:
                     self._fit_tbptt(step_fn, x, y, m, lm)
-                    continue
-                self._fit_one(x, y, m, lm)
+                else:
+                    self._fit_one(x, y, m, lm)
+                if obs:
+                    dt = monotonic_s() - t_step
+                    step_h.labels("compile" if compile_step
+                                  else "steady").observe(dt)
+                    etl_h.observe(self.last_etl_ms / 1e3)
+                    steps_c.inc()
+                    examples_c.inc(self.last_batch_size)
+                    if not compile_step:
+                        steady_examples += self.last_batch_size
+                        steady_s += dt
             for lst in self.listeners:
                 lst.on_epoch_end(self)
             self.epoch += 1
+        if obs and steady_s > 0:
+            # steady-state throughput: the compile-dominated first step
+            # is excluded (same convention as utils/benchmarks.py)
+            reg.gauge("training_examples_per_sec",
+                      "Training examples/sec over the last fit() "
+                      "(compile excluded where the path can tell)"
+                      ).set(steady_examples / steady_s)
         return self
 
     def fit_on_device(self, x, y, *, batch_size: int, epochs: int = 1,
@@ -444,6 +494,7 @@ class MultiLayerNetwork:
                 lst.iteration_done(self, self.iteration, self.epoch)
         # one sync per batch, so deferred device failures surface in fit
         self._score = float(self._score)
+        self._train_step_ran = True
 
     def _init_carries(self, batch: int):
         """Zero carries for every recurrent layer (keyed ``layer_i``)."""
@@ -550,6 +601,7 @@ class MultiLayerNetwork:
             None if lm is None else jnp.asarray(lm))
         self._score = float(loss)
         self._last_grad_stats = gstats
+        self._train_step_ran = True
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)

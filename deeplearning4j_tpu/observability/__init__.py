@@ -1,0 +1,54 @@
+"""deeplearning4j_tpu.observability — unified metrics + tracing.
+
+One coherent telemetry layer for the training, parallel, and serving
+tiers (the role TensorFlow's built-in metrics/tracing runtime plays,
+Abadi et al. 2016), replacing the fragmented per-module counters the
+reference stack grew (PerformanceListener wall clocks, external
+OpProfiler, UI stats storage — SURVEY §5):
+
+- :mod:`registry` — dependency-free Counter/Gauge/Histogram with label
+  sets; thread-safe; process-global default + injectable instances;
+- :mod:`exposition` — Prometheus text format + JSON snapshot (served on
+  ``/metrics`` by both HTTP servers in ``serving/``);
+- :mod:`tracer` — nested spans on monotonic clocks with cross-thread /
+  cross-process context propagation and optional Xprof bridging;
+- :mod:`events` — structured JSONL event log for offline analysis;
+- :mod:`listener` — ``MetricsListener`` publishing score/throughput/
+  grad-norm/device-memory from the ``TrainingListener`` hook points;
+- :mod:`clock` — the monotonic/wall helpers everything above (and the
+  benchmarks) source timings from.
+
+Cost model: METRICS are on by default (the registry is plain host
+arithmetic — serving ``/metrics`` and the training counters work out of
+the box) and ``default_registry().disable()`` short-circuits every
+instrument write to one bool check; TRACING is off by default (enable
+via ``DL4J_TPU_TRACE=1|xprof`` or an injected ``Tracer``).  Nothing in
+this package ever forces a device sync.
+"""
+from __future__ import annotations
+
+from .clock import monotonic_s, wall_s
+from .events import EventLog, configure_event_log, emit_event, get_event_log
+from .exposition import CONTENT_TYPE, escape_label_value, render_text
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, default_registry,
+                       set_default_registry)
+from .tracer import Span, SpanContext, Tracer, get_tracer, set_default_tracer
+
+__all__ = [
+    "CONTENT_TYPE", "Counter", "DEFAULT_BUCKETS", "EventLog", "Gauge",
+    "Histogram", "MetricsListener", "MetricsRegistry", "Span",
+    "SpanContext", "Tracer", "configure_event_log", "default_registry",
+    "emit_event", "escape_label_value", "get_event_log", "get_tracer",
+    "monotonic_s", "render_text", "set_default_registry",
+    "set_default_tracer", "wall_s",
+]
+
+
+def __getattr__(name):
+    # MetricsListener imports train.listeners, which itself uses the
+    # clock helpers here — resolve lazily to keep the import DAG acyclic
+    if name == "MetricsListener":
+        from .listener import MetricsListener
+        return MetricsListener
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

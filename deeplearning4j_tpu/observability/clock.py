@@ -1,0 +1,25 @@
+"""Shared clock helpers — the single source of timing truth.
+
+Every interval in the package (tracer spans, metric timers, benchmark
+clocks, listener throughput) reads ``monotonic_s()`` so measurements are
+immune to wall-clock steps (NTP slew, DST); ``wall_s()`` exists for
+timestamps that must be correlated with the outside world (event-log
+records, scrape timestamps).  graftlint JX011 enforces this split:
+``time.time()`` arithmetic is a lint error in library code.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["monotonic_s", "wall_s"]
+
+
+def monotonic_s() -> float:
+    """Monotonic seconds for interval measurement (never steps backwards)."""
+    return time.perf_counter()
+
+
+def wall_s() -> float:
+    """Wall-clock seconds since the epoch — timestamps only, never
+    intervals."""
+    return time.time()

@@ -1,0 +1,83 @@
+"""Structured JSONL event log for offline analysis.
+
+One JSON object per line: ``{"ts": <wall seconds>, "type": <str>, ...}``.
+Writers are thread-safe (one lock around the write; lines stay atomic)
+and the module-level sink is a no-op until :func:`configure_event_log`
+points it somewhere — the same off-by-default posture as the registry
+and tracer.  Consumers are anything that reads JSONL: pandas, jq, or
+``tools/trace_categorize.py``-style scripts.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+from .clock import wall_s
+
+__all__ = ["EventLog", "configure_event_log", "get_event_log", "emit_event"]
+
+
+class EventLog:
+    """Append-only JSONL writer."""
+
+    def __init__(self, path: str, append: bool = True):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a" if append else "w", encoding="utf-8")
+
+    def emit(self, type: str, **fields: Any) -> None:
+        record: Dict[str, Any] = {"ts": wall_s(), "type": type}
+        record.update(fields)
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @staticmethod
+    def read(path: str) -> Iterator[Dict[str, Any]]:
+        """Iterate the records of a JSONL event file."""
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+
+_default: Optional[EventLog] = None
+_lock = threading.Lock()
+
+
+def configure_event_log(path: Optional[str]) -> Optional[EventLog]:
+    """Point the process-global event sink at ``path`` (None closes and
+    disables it).  Returns the active log."""
+    global _default
+    with _lock:
+        if _default is not None:
+            _default.close()
+        _default = EventLog(path) if path else None
+    return _default
+
+
+def get_event_log() -> Optional[EventLog]:
+    return _default
+
+
+def emit_event(type: str, **fields: Any) -> None:
+    """Emit to the process-global log; silently a no-op when unconfigured."""
+    log = _default
+    if log is not None:
+        log.emit(type, **fields)

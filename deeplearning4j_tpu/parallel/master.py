@@ -16,7 +16,6 @@ the same loop body is what a multi-process DCN deployment runs per host
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -24,6 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .accumulation import EncodedGradientsAccumulator, EncodingHandler
+from ..observability.clock import monotonic_s
+from ..observability.registry import MetricsRegistry
+from ..observability.tracer import get_tracer
 
 __all__ = ["TrainingMaster", "ParameterAveragingTrainingMaster",
            "SharedGradientsTrainingMaster", "TrainingMasterStats",
@@ -33,29 +35,81 @@ __all__ = ["TrainingMaster", "ParameterAveragingTrainingMaster",
 class TrainingMasterStats:
     """Phase wall-times per fit() call (reference
     ``ParameterAveragingTrainingMasterStats`` / ``SparkTrainingStats``:
-    split/fit/aggregation/broadcast timings).  Times in seconds."""
+    split/fit/aggregation/broadcast timings).  Times in seconds.
 
-    def __init__(self):
-        self.phases: Dict[str, List[float]] = {}
+    A thin view over a metrics registry: each ``record`` lands in a
+    ``training_master_phase_seconds{phase,worker}`` histogram (per-worker
+    label for fan-out phases; master-side phases carry ``worker="-"``).
+    By default the stats own a private always-on registry so phase
+    timings survive even when the process-global registry is disabled;
+    inject the default registry (or any other) to fold them into a
+    ``/metrics`` exposition.
 
-    def record(self, phase: str, seconds: float) -> None:
-        self.phases.setdefault(phase, []).append(seconds)
+    Semantics note: fan-out phases ("fit") are recorded once per WORKER,
+    so their totals are worker-seconds (CPU-time style — ~N_workers x the
+    round wall time when workers run concurrently); master-side phases
+    (split/broadcast/aggregation) are wall time.  The per-worker rows in
+    ``stats_text`` make the distinction visible.
+    """
+
+    _HIST = "training_master_phase_seconds"
+    # phase buckets: sub-ms splits to multi-second aggregation rounds
+    _BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                10.0, 60.0)
+    _MASTER = "-"   # worker label for phases the master itself runs
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry(enabled=True)
+        self._hist = self.registry.histogram(
+            self._HIST, "TrainingMaster phase wall time",
+            ("phase", "worker"), buckets=self._BUCKETS)
+
+    def record(self, phase: str, seconds: float,
+               worker: Optional[int] = None) -> None:
+        label = self._MASTER if worker is None else str(worker)
+        self._hist.labels(phase, label).observe(seconds)
+
+    def _by_phase(self):
+        out: Dict[str, Dict[str, Any]] = {}
+        for (phase, worker), child in self._hist.samples():
+            out.setdefault(phase, {})[worker] = child
+        return out
 
     def total(self, phase: str) -> float:
-        return float(sum(self.phases.get(phase, ())))
+        return float(sum(c.sum for c in
+                         self._by_phase().get(phase, {}).values()))
 
     def as_dict(self) -> Dict[str, Dict[str, float]]:
+        """Backward-compatible shape: per-phase count/total/mean
+        aggregated over workers."""
         out = {}
-        for k, v in self.phases.items():
-            out[k] = {"count": len(v), "total_s": float(sum(v)),
-                      "mean_s": float(sum(v) / len(v))}
+        for phase, workers in self._by_phase().items():
+            count = sum(c.count for c in workers.values())
+            total = sum(c.sum for c in workers.values())
+            if count:
+                out[phase] = {"count": count, "total_s": float(total),
+                              "mean_s": float(total / count)}
         return out
 
     def stats_text(self) -> str:
-        lines = ["phase                count   total_s   mean_s"]
-        for k, d in sorted(self.as_dict().items()):
-            lines.append(f"{k:<20} {d['count']:>6} {d['total_s']:>9.3f} "
-                         f"{d['mean_s']:>8.4f}")
+        """Deterministic table: rows sorted by (phase, worker), one row
+        per (phase, worker) series plus the worker-aggregated line the
+        pre-registry format printed."""
+        by_phase = self._by_phase()
+        lines = ["phase                worker  count   total_s   mean_s"]
+        for phase, d in sorted(self.as_dict().items()):
+            lines.append(f"{phase:<20} {'all':>6} {d['count']:>6} "
+                         f"{d['total_s']:>9.3f} {d['mean_s']:>8.4f}")
+            workers = by_phase[phase]
+            if set(workers) != {self._MASTER}:
+                for w in sorted(workers, key=lambda s: (len(s), s)):
+                    c = workers[w]
+                    if not c.count:
+                        continue
+                    mean = c.sum / c.count
+                    lines.append(f"{phase:<20} {w:>6} {c.count:>6} "
+                                 f"{c.sum:>9.3f} {mean:>8.4f}")
         return "\n".join(lines)
 
 
@@ -80,6 +134,15 @@ def tree_average(param_trees: Sequence[Any], depth: int = 2):
     for t in trees[1:]:
         total = add(total, t)
     return jax.tree_util.tree_map(lambda s: s / n, total)
+
+
+def _cast_like(a, ref):
+    """Restore ``ref``'s dtype on an averaged leaf: integer leaves
+    (optax step counts) round back to ints, floats pass through."""
+    dt = getattr(ref, "dtype", None)
+    if dt is not None and jnp.issubdtype(dt, jnp.integer):
+        return jnp.round(a).astype(dt)
+    return a
 
 
 def _chunk_batches(iterator, n_workers: int) -> List[List[Any]]:
@@ -225,58 +288,90 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     updater state) are tree-averaged and re-broadcast."""
 
     def __init__(self, num_workers: int, averaging_frequency: int = 5,
-                 aggregation_depth: int = 2, average_updaters: bool = True):
+                 aggregation_depth: int = 2, average_updaters: bool = True,
+                 tracer=None):
         self.num_workers = num_workers
         self.averaging_frequency = max(1, averaging_frequency)
         self.aggregation_depth = aggregation_depth
         self.average_updaters = average_updaters
         self.stats = TrainingMasterStats()
+        self.tracer = tracer   # None -> process-global (off by default)
 
     def fit(self, model, iterator) -> None:
-        t0 = time.perf_counter()
-        parts = _chunk_batches(iterator, self.num_workers)
-        self.stats.record("split", time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        replicas = self._get_replicas(model)
-        self.stats.record("broadcast", time.perf_counter() - t0)
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        with tracer.span("master.fit", mode="averaging",
+                         workers=self.num_workers):
+            self._fit_traced(model, iterator, tracer)
+
+    def _fit_traced(self, model, iterator, tracer) -> None:
+        t0 = monotonic_s()
+        with tracer.span("master.split"):
+            parts = _chunk_batches(iterator, self.num_workers)
+        self.stats.record("split", monotonic_s() - t0)
+        t0 = monotonic_s()
+        with tracer.span("master.broadcast"):
+            replicas = self._get_replicas(model)
+        self.stats.record("broadcast", monotonic_s() - t0)
         n_rounds = (max(len(p) for p in parts) + self.averaging_frequency - 1
                     ) // self.averaging_frequency
+        ctx = tracer.current_context()   # propagated into worker threads
         for rnd in range(n_rounds):
             lo = rnd * self.averaging_frequency
             hi = lo + self.averaging_frequency
+            errors: List[Exception] = []
 
             def work(w):
-                for batch in parts[w][lo:hi]:
-                    replicas[w].fit_batch(batch)
+                t_w = monotonic_s()
+                # fit_batch syncs the loss per step, so this wall time is
+                # honest compute+dispatch, not enqueue rate
+                try:
+                    with tracer.attach(ctx), \
+                            tracer.span("master.worker_fit", worker=w,
+                                        round=rnd):
+                        for batch in parts[w][lo:hi]:
+                            replicas[w].fit_batch(batch)
+                except Exception as e:  # surface worker crashes to fit()
+                    errors.append(e)
+                self.stats.record("fit", monotonic_s() - t_w, worker=w)
 
+            # only workers with batches this round spawn: idle workers
+            # would just record meaningless ~0s fit rows
+            active = [w for w in range(self.num_workers) if parts[w][lo:hi]]
             threads = [threading.Thread(target=work, args=(w,))
-                       for w in range(self.num_workers)]
-            t_fit = time.perf_counter()
+                       for w in active]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
-            self.stats.record("fit", time.perf_counter() - t_fit)
-            active = [w for w in range(self.num_workers) if parts[w][lo:hi]]
+            if errors:
+                raise errors[0]
             if len(active) > 1:
-                t_agg = time.perf_counter()
-                avg = tree_average([replicas[w].params for w in active],
-                                   self.aggregation_depth)
-                if self.average_updaters:
-                    opt_avg = tree_average(
-                        [replicas[w].opt_state for w in active],
-                        self.aggregation_depth)
-                for w in range(self.num_workers):
-                    replicas[w].params = jax.tree_util.tree_map(
-                        jnp.array, avg)
+                t_agg = monotonic_s()
+                with tracer.span("master.aggregation", round=rnd,
+                                 participants=len(active)):
+                    avg = tree_average([replicas[w].params for w in active],
+                                       self.aggregation_depth)
                     if self.average_updaters:
-                        replicas[w].opt_state = jax.tree_util.tree_map(
-                            jnp.array, opt_avg)
-                # async dispatch returns before the averaging runs; sync so
-                # the recorded time measures the reduction, not its dispatch
-                jax.block_until_ready(avg)
-                self.stats.record("aggregation",
-                                  time.perf_counter() - t_agg)
+                        # averaging turns integer leaves (optax step
+                        # counts) into floats, which poisons the next
+                        # round's jitted update — restore original dtypes
+                        opt_avg = jax.tree_util.tree_map(
+                            _cast_like,
+                            tree_average(
+                                [replicas[w].opt_state for w in active],
+                                self.aggregation_depth),
+                            replicas[active[0]].opt_state)
+                    for w in range(self.num_workers):
+                        replicas[w].params = jax.tree_util.tree_map(
+                            jnp.array, avg)
+                        if self.average_updaters:
+                            replicas[w].opt_state = jax.tree_util.tree_map(
+                                jnp.array, opt_avg)
+                    # async dispatch returns before the averaging runs; sync
+                    # so the recorded time measures the reduction, not its
+                    # dispatch
+                    jax.block_until_ready(avg)
+                self.stats.record("aggregation", monotonic_s() - t_agg)
         # model IS replicas[0]; nothing to copy back
 
 
@@ -288,31 +383,31 @@ class SharedGradientsTrainingMaster(TrainingMaster):
     master copy; residuals carry the unsent mass."""
 
     def __init__(self, num_workers: int, threshold: float = 1e-3,
-                 handler_factory: Optional[Callable[[], EncodingHandler]] = None):
+                 handler_factory: Optional[Callable[[], EncodingHandler]] = None,
+                 tracer=None):
         self.num_workers = num_workers
         factory = handler_factory or (
             lambda: EncodingHandler(initial_threshold=threshold))
         self.accumulator = EncodedGradientsAccumulator(num_workers, factory)
+        self.tracer = tracer
 
     def fit(self, model, iterator) -> None:
         from jax.flatten_util import ravel_pytree
 
+        tracer = self.tracer if self.tracer is not None else get_tracer()
         parts = _chunk_batches(iterator, self.num_workers)
         replicas = self._get_replicas(model)
         acc = self.accumulator
         errors: List[Exception] = []
+        ctx = tracer.current_context()
 
         def work(w):
             try:
                 replica = replicas[w]
-                for batch in parts[w]:
-                    flat_before, unravel = ravel_pytree(replica.params)
-                    flat_before = jnp.array(flat_before)  # pre-donation copy
-                    replica.fit_batch(batch)
-                    flat_after, _ = ravel_pytree(replica.params)
-                    acc.store_update(w, flat_after - flat_before)
-                    merged = acc.apply_updates(w, flat_after)
-                    replica.params = unravel(merged)
+                with tracer.attach(ctx), \
+                        tracer.span("master.worker_fit", worker=w,
+                                    mode="shared"):
+                    self._work_shared(replica, parts[w], acc, w)
             except Exception as e:  # surface worker crashes to the caller
                 errors.append(e)
 
@@ -327,3 +422,16 @@ class SharedGradientsTrainingMaster(TrainingMaster):
         # final convergence pass: drain late messages into worker 0 (= model)
         flat, unravel = ravel_pytree(model.params)
         model.params = unravel(acc.apply_updates(0, flat))
+
+    @staticmethod
+    def _work_shared(replica, batches, acc, w) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        for batch in batches:
+            flat_before, unravel = ravel_pytree(replica.params)
+            flat_before = jnp.array(flat_before)  # pre-donation copy
+            replica.fit_batch(batch)
+            flat_after, _ = ravel_pytree(replica.params)
+            acc.store_update(w, flat_after - flat_before)
+            merged = acc.apply_updates(w, flat_after)
+            replica.params = unravel(merged)

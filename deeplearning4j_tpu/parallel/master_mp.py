@@ -55,6 +55,7 @@ whole topology is provable without TPU hardware — the reference's
 """
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import os
@@ -65,6 +66,10 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..observability.clock import monotonic_s
+from ..observability.registry import default_registry
+from ..observability.tracer import SpanContext, get_tracer
 
 __all__ = ["MultiprocessMaster"]
 
@@ -215,11 +220,16 @@ class MultiprocessMaster:
         # Aeron role) — exact-count drain barriers need lossless delivery;
         # memory is bounded by job size
         broker = TcpMessageBroker(max_queue=0).serve()
+        # span-context propagation to worker PROCESSES: the context rides
+        # the job spec; each worker re-roots its local spans under it
+        # (inert when tracing is off — ctx is None)
+        ctx = get_tracer().current_context()
         spec = dict(spec, port=broker.port, num_workers=self.num_workers,
                     averaging_frequency=self.averaging_frequency,
                     average_updaters=self.average_updaters,
                     threshold=self.threshold, timeout=self.timeout,
-                    fault=self.fault_injection)
+                    fault=self.fault_injection,
+                    trace=None if ctx is None else ctx.to_dict())
         with open(os.path.join(jobdir, "spec.json"), "w") as f:
             json.dump(spec, f)
         done_sub = broker.subscribe(_DONE)
@@ -293,7 +303,7 @@ class MultiprocessMaster:
         respawned (callers extend their deadline: the replacement redoes
         work)."""
         respawned = False
-        now = time.time()
+        now = monotonic_s()
         for wid, p in list(self._procs.items()):
             if p.poll() is None or wid in satisfied:
                 self._dead_since.pop(wid, None)
@@ -314,6 +324,11 @@ class MultiprocessMaster:
                 + self._logs_tail(jobdir))
         self._retries[wid] = n
         self.retried_workers.add(wid)
+        reg = default_registry()
+        if reg.enabled:
+            reg.counter("mp_worker_respawns_total",
+                        "Dead worker processes respawned by task retry",
+                        ("mode",)).labels(self.mode).inc()
         old = self._procs[wid]
         if old.poll() is None:
             old.kill()
@@ -405,19 +420,22 @@ class MultiprocessMaster:
 
     # -- training ------------------------------------------------------------
     def fit(self, model, iterator, jobdir: Optional[str] = None) -> None:
-        jobdir, parts = self._prepare_jobdir(iterator, jobdir)
-        n_rounds = (max((len(p) for p in parts), default=0)
-                    + self.averaging_frequency - 1) // self.averaging_frequency
-        with_opt = self.average_updaters and self.mode == "averaging"
-        vec0, meta = _ravel(model, with_opt)
+        with get_tracer().span("mp.fit", mode=self.mode,
+                               workers=self.num_workers):
+            jobdir, parts = self._prepare_jobdir(iterator, jobdir)
+            n_rounds = (max((len(p) for p in parts), default=0)
+                        + self.averaging_frequency - 1
+                        ) // self.averaging_frequency
+            with_opt = self.average_updaters and self.mode == "averaging"
+            vec0, meta = _ravel(model, with_opt)
 
-        if self.mode == "averaging":
-            vec = self._fit_averaging(model, jobdir, n_rounds,
-                                      np.asarray(vec0))
-        else:
-            vec = self._fit_shared(model, jobdir, np.asarray(vec0))
-        if vec is not None:
-            _unravel_into(model, vec, meta)
+            if self.mode == "averaging":
+                vec = self._fit_averaging(model, jobdir, n_rounds,
+                                          np.asarray(vec0))
+            else:
+                vec = self._fit_shared(model, jobdir, np.asarray(vec0))
+            if vec is not None:
+                _unravel_into(model, vec, meta)
 
     def _fit_averaging(self, model, jobdir: str, n_rounds: int,
                        vec0: np.ndarray):
@@ -579,12 +597,14 @@ class MultiprocessMaster:
     # -- evaluation / scoring fan-out ---------------------------------------
     def _fan_out_task(self, model, iterator, task: str,
                       jobdir: Optional[str]):
-        jobdir, _ = self._prepare_jobdir(iterator, jobdir)
-        # stateless shards: a respawned worker simply re-executes
-        self._run_job(model, jobdir, {"task": task, "mode": self.mode},
-                      lambda broker: None, lambda broker, subs: None,
-                      resume_payload=lambda wid: ({}, None))
-        return self.last_results
+        with get_tracer().span(f"mp.{task}", mode=self.mode,
+                               workers=self.num_workers):
+            jobdir, _ = self._prepare_jobdir(iterator, jobdir)
+            # stateless shards: a respawned worker simply re-executes
+            self._run_job(model, jobdir, {"task": task, "mode": self.mode},
+                          lambda broker: None, lambda broker, subs: None,
+                          resume_payload=lambda wid: ({}, None))
+            return self.last_results
 
     def evaluate(self, model, iterator, jobdir: Optional[str] = None):
         """Distributed classification evaluation: per-process partial
@@ -610,6 +630,21 @@ def _worker_main(jobdir: str, wid: int, port: int,
                  resume_file: Optional[str] = None) -> None:
     with open(os.path.join(jobdir, "spec.json")) as f:
         spec = json.load(f)
+    # re-root this process's spans under the master's context (from the
+    # job spec); a no-op unless the worker enables its tracer (e.g. via
+    # DL4J_TPU_TRACE=1 in worker_env)
+    tracer = get_tracer()
+    with contextlib.ExitStack() as stack:
+        ctx = spec.get("trace")
+        if ctx:
+            stack.enter_context(tracer.attach(SpanContext.from_dict(ctx)))
+        stack.enter_context(tracer.span("mp.worker", worker=wid,
+                                        task=spec.get("task")))
+        _worker_task(jobdir, wid, port, spec, resume_file)
+
+
+def _worker_task(jobdir: str, wid: int, port: int, spec: Dict[str, Any],
+                 resume_file: Optional[str] = None) -> None:
     resumed = resume_file is not None
     resume: Dict[str, Any] = {}
     if resumed:

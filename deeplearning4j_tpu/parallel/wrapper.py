@@ -21,6 +21,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh, shard_batch
+from ..observability.clock import monotonic_s
+from ..observability.registry import default_registry
+from ..observability.tracer import get_tracer
 
 
 def _param_specs(params, rule: Optional[Callable[[str, str, Any], P]]):
@@ -235,30 +238,59 @@ class ParallelWrapper:
         else:
             raise ValueError("fit() needs (x, y) or an iterator")
         step = self._get_step()
-        for _ in range(epochs):
-            for lst in m.listeners:
-                lst.on_epoch_start(m)
-            for raw in batches_factory():
-                trimmed = self._trim(raw)
-                if trimmed is None:
-                    continue
-                x, y, mk, lmk = trimmed
-                m._rng, key = jax.random.split(m._rng)
-                m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
-                    m.params, m.state, m.opt_state, key,
-                    put(x), put(y), put(mk), put(lmk))
-                # device scalar inside the batch loop (a float() here would
-                # host-sync every step); get_score() materializes on demand
-                m._score = loss
-                m.iteration += 1
+        # observability: counters only inside the loop (per-step TIMING
+        # would need a host sync each step — deliberately absent; the
+        # span below closes after the final score sync, so its duration
+        # is honest end-to-end wall time)
+        reg = default_registry()
+        obs = reg.enabled
+        if obs:
+            steps_c = reg.counter("training_steps_total",
+                                  "Optimizer steps taken")
+            examples_c = reg.counter("training_examples_total",
+                                     "Training examples consumed")
+        n_examples = 0
+        t_fit = monotonic_s()
+        with get_tracer().span("wrapper.fit", epochs=epochs,
+                               devices=len(self.mesh.devices.flat)):
+            for _ in range(epochs):
                 for lst in m.listeners:
-                    lst.iteration_done(m, m.iteration, m.epoch)
-            for lst in m.listeners:
-                lst.on_epoch_end(m)
-            m.epoch += 1
-        # one final sync: "fit returned" still means "training finished",
-        # and deferred device failures surface here instead of downstream
-        m._score = float(m._score)
+                    lst.on_epoch_start(m)
+                for raw in batches_factory():
+                    trimmed = self._trim(raw)
+                    if trimmed is None:
+                        continue
+                    x, y, mk, lmk = trimmed
+                    m._rng, key = jax.random.split(m._rng)
+                    m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
+                        m.params, m.state, m.opt_state, key,
+                        put(x), put(y), put(mk), put(lmk))
+                    # device scalar inside the batch loop (a float() here
+                    # would host-sync every step); get_score() materializes
+                    # on demand
+                    m._score = loss
+                    m.iteration += 1
+                    if obs:
+                        steps_c.inc()
+                        xb = x[0] if isinstance(x, (list, tuple)) else x
+                        bs = int(getattr(xb, "shape", (0,))[0])
+                        examples_c.inc(bs)
+                        n_examples += bs
+                    for lst in m.listeners:
+                        lst.iteration_done(m, m.iteration, m.epoch)
+                for lst in m.listeners:
+                    lst.on_epoch_end(m)
+                m.epoch += 1
+            # one final sync: "fit returned" still means "training finished",
+            # and deferred device failures surface here instead of downstream
+            m._score = float(m._score)
+        if obs and n_examples:
+            # whole-fit throughput, fetch-closed by the score sync above
+            dt = max(monotonic_s() - t_fit, 1e-9)
+            reg.gauge("training_examples_per_sec",
+                      "Training examples/sec over the last fit() "
+                      "(compile excluded where the path can tell)"
+                      ).set(n_examples / dt)
         return self
 
     def average_params(self):

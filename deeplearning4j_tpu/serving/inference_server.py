@@ -5,12 +5,18 @@ replaced by a plain HTTP predict endpoint over :class:`ParallelInference`).
 Endpoints:
   POST /predict  {"data": [[...], ...]}  -> {"output": [[...], ...]}
   POST /reload   {"path": "model.zip"}   -> hot-swap the served model
-  GET  /health
+  GET  /health   liveness + readiness (platform, model identity,
+                 seconds since the last successful predict)
+  GET  /metrics  Prometheus text exposition (?format=json for a snapshot)
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
+from ..observability import clock
+from ..observability.registry import default_registry
 from ..parallel.inference import (InferenceMode, InvalidInputError,
                                   ParallelInference)
 from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
@@ -22,8 +28,10 @@ class _PredictHandler(JsonHandler):
     server_ref = None
 
     def do_GET(self):
+        if self._serve_metrics():
+            return
         if self.path.rstrip("/") == "/health":
-            return self._json({"status": "ok"})
+            return self._json(self.server_ref.health())
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
@@ -41,25 +49,76 @@ class _PredictHandler(JsonHandler):
             x = np.asarray(self._read_json()["data"], dtype=np.float32)
         except Exception as e:
             return self._json({"error": str(e)}, 400)
+        srv = self.server_ref
         try:
-            out = self.server_ref.inference.output(x)
+            out = srv.inference.output(x)
         except InvalidInputError as e:  # up-front shape rejection only
             return self._json({"error": str(e)}, 400)
         except Exception as e:  # model-side failures are server errors
+            srv.consecutive_failures += 1
             return self._json({"error": str(e)}, 500)
+        srv.consecutive_failures = 0
+        srv.last_predict_mono = clock.monotonic_s()
+        reg = self._registry()
+        if reg.enabled:
+            reg.counter("inference_examples_total",
+                        "Examples served through /predict") \
+               .inc(int(x.shape[0]) if x.ndim >= 2 else 1)
         return self._json({"output": np.asarray(out).tolist()})
 
 
+def _model_identity(model, origin: str = "init") -> str:
+    name = type(model).__name__
+    try:
+        n = model.num_params()   # shape metadata only: no device sync
+        return f"{name}[params={n},from={origin}]"
+    except Exception:
+        return f"{name}[from={origin}]"
+
+
 class InferenceServer:
+    # consecutive model-side (5xx) predict failures before /health flips
+    # to unready — the circuit-breaker signal an orchestrator gates on
+    FAILURE_THRESHOLD = 3
+
     def __init__(self, model, port: int = 0,
                  inference_mode: str = InferenceMode.BATCHED,
-                 max_batch_size: int = 32):
+                 max_batch_size: int = 32, registry=None):
         self._mode = inference_mode
         self._max_batch = max_batch_size
         self.inference = ParallelInference(model, inference_mode,
                                            max_batch_size=max_batch_size)
+        from ..utils.profiling import device_platform
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.platform = device_platform()
+        self.model_id = _model_identity(model)
+        self.last_predict_mono: Optional[float] = None
+        self.consecutive_failures = 0
         self._server = BackgroundHttpServer(_PredictHandler, port,
-                                            server_ref=self)
+                                            server_ref=self,
+                                            metrics_registry=self.registry)
+
+    def health(self) -> dict:
+        """Liveness vs readiness: answering at all is liveness; readiness
+        means the serving path is actually working — a loaded model on a
+        reachable backend with fewer than FAILURE_THRESHOLD consecutive
+        model-side predict failures (a streak flips the server unready
+        until one predict succeeds).  ``status`` stays for pre-upgrade
+        clients probing ``{"status": "ok"}``."""
+        ready = (self.inference is not None
+                 and self.platform != "unknown"
+                 and self.consecutive_failures < self.FAILURE_THRESHOLD)
+        since = (None if self.last_predict_mono is None
+                 else round(clock.monotonic_s() - self.last_predict_mono, 3))
+        return {"status": "ok" if ready else "unready",
+                "live": True,
+                "ready": ready,
+                "consecutive_failures": self.consecutive_failures,
+                "platform": self.platform,
+                "model": self.model_id,
+                "inference_mode": str(self._mode),
+                "seconds_since_last_predict": since}
 
     def reload(self, path: str) -> None:
         """Hot-swap the served model from a checkpoint zip (the rolling
@@ -70,6 +129,10 @@ class InferenceServer:
         old = self.inference
         self.inference = ParallelInference(new_model, self._mode,
                                            max_batch_size=self._max_batch)
+        self.model_id = _model_identity(new_model, origin=path)
+        if self.registry.enabled:
+            self.registry.counter("inference_model_reloads_total",
+                                  "Successful hot model swaps").inc()
         old.shutdown()
 
     @property
@@ -89,3 +152,7 @@ class InferenceClient(JsonClient):
     def predict(self, data) -> np.ndarray:
         return np.asarray(self.post(
             "/predict", {"data": np.asarray(data).tolist()})["output"])
+
+    def metrics_text(self) -> str:
+        """Raw Prometheus exposition from the server's /metrics."""
+        return self.get_text("/metrics")

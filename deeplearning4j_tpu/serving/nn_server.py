@@ -9,13 +9,19 @@ stdlib ``http.server`` replaces the Play stack.  Index tier is pluggable:
 Endpoints (reference routes):
   POST /knn      {"ndarray": [...], "k": n}          query by raw vector
   POST /knnindex {"index": i, "k": n}                query by stored row index
-  GET  /health
+  GET  /health   liveness + readiness (platform, index identity,
+                 seconds since the last successful query)
+  GET  /metrics  Prometheus text exposition (?format=json for a snapshot)
 """
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
 from ..clustering.neighbors import BruteForceNN, VPTree
+from ..observability import clock
+from ..observability.registry import default_registry
 from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
 
 __all__ = ["NearestNeighborsServer", "NearestNeighborsClient"]
@@ -25,9 +31,10 @@ class _NNHandler(JsonHandler):
     server_ref = None  # type: NearestNeighborsServer
 
     def do_GET(self):
+        if self._serve_metrics():
+            return
         if self.path.rstrip("/") == "/health":
-            return self._json({"status": "ok",
-                               "points": len(self.server_ref.points)})
+            return self._json(self.server_ref.health())
         return self._json({"error": "not found"}, 404)
 
     def do_POST(self):
@@ -56,6 +63,7 @@ class _NNHandler(JsonHandler):
             return self._json({"error": f"missing field {e}"}, 400)
         except Exception as e:  # ragged vectors, k > N, ... -> client error
             return self._json({"error": str(e)}, 400)
+        srv.last_query_mono = clock.monotonic_s()
         return self._json({"results": [
             {"index": int(i), "distance": float(d)}
             for d, i in zip(dist, idx)]})
@@ -65,8 +73,9 @@ class NearestNeighborsServer:
     """Serve kNN over a points matrix [N,D]."""
 
     def __init__(self, points, port: int = 0, index: str = "brute",
-                 metric: str = "euclidean"):
+                 metric: str = "euclidean", registry=None):
         self.points = np.asarray(points, dtype=np.float32)
+        self.index_kind = index
         if index == "brute":
             self._index = BruteForceNN(self.points, metric=metric)
             self.query = lambda v, k: tuple(
@@ -76,7 +85,28 @@ class NearestNeighborsServer:
             self.query = lambda v, k: self._index.query(v, k)
         else:
             raise ValueError(f"unknown index '{index}' (brute|vptree)")
-        self._server = BackgroundHttpServer(_NNHandler, port, server_ref=self)
+        from ..utils.profiling import device_platform
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.platform = device_platform()
+        self.last_query_mono: Optional[float] = None
+        self._server = BackgroundHttpServer(_NNHandler, port, server_ref=self,
+                                            metrics_registry=self.registry)
+
+    def health(self) -> dict:
+        """Liveness vs readiness; ``status``/``points`` keys stay for
+        pre-upgrade probes."""
+        ready = len(self.points) > 0
+        since = (None if self.last_query_mono is None
+                 else round(clock.monotonic_s() - self.last_query_mono, 3))
+        return {"status": "ok" if ready else "unready",
+                "live": True,
+                "ready": ready,
+                "platform": self.platform,
+                "model": f"knn[{self.index_kind},n={len(self.points)},"
+                         f"d={self.points.shape[1] if self.points.ndim == 2 else 0}]",
+                "points": len(self.points),
+                "seconds_since_last_query": since}
 
     @property
     def port(self) -> int:

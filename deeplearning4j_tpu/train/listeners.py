@@ -11,6 +11,8 @@ import logging
 import time
 from typing import Callable, List, Optional
 
+from ..observability.clock import monotonic_s
+
 log = logging.getLogger("deeplearning4j_tpu.train")
 
 
@@ -49,7 +51,16 @@ class ScoreIterationListener(TrainingListener):
 
 class PerformanceListener(TrainingListener):
     """Throughput: samples/sec, batches/sec
-    (reference ``optimize/listeners/PerformanceListener.java:19,48-96``)."""
+    (reference ``optimize/listeners/PerformanceListener.java:19,48-96``).
+
+    Steady-state semantics: reported rates NEVER include the first
+    observed iteration — it is compile-dominated (XLA traces + compiles
+    the whole step program there), so a window containing it under-reads
+    throughput by orders of magnitude.  The baseline clock starts at the
+    first hook call (after that iteration completed) and every window is
+    measured from there on the shared monotonic clock helpers
+    (``observability.clock``), immune to wall-clock steps.
+    """
 
     def __init__(self, frequency: int = 1, report_score: bool = False,
                  batch_size_fn: Optional[Callable] = None):
@@ -63,14 +74,20 @@ class PerformanceListener(TrainingListener):
         self.last_batch_size = 0
 
     def iteration_done(self, model, iteration, epoch):
-        now = time.time()
+        now = monotonic_s()
         if self.batch_size_fn is not None:
             self.last_batch_size = self.batch_size_fn(model)
         else:
             self.last_batch_size = getattr(model, "last_batch_size", 0)
-        if self._last_time is not None and iteration % self.frequency == 0:
+        if self._last_time is None:
+            # first observation closes the compile-dominated iteration:
+            # start the steady-state clock here, report nothing yet
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration % self.frequency == 0:
             dt = max(now - self._last_time, 1e-9)
-            iters = iteration - self._last_iter
+            iters = max(iteration - self._last_iter, 1)
             self.batches_per_sec = iters / dt
             if self.last_batch_size:
                 self.samples_per_sec = self.last_batch_size * iters / dt
@@ -82,7 +99,6 @@ class PerformanceListener(TrainingListener):
             if self.report_score:
                 msg += f"; score: {model.get_score()}"
             log.info(msg)
-        if iteration % self.frequency == 0:
             self._last_time = now
             self._last_iter = iteration
 
@@ -105,11 +121,11 @@ class TimeIterationListener(TrainingListener):
     def __init__(self, iteration_count: int, frequency: int = 50):
         self.iteration_count = iteration_count
         self.frequency = max(1, frequency)
-        self.start = time.time()
+        self.start = monotonic_s()
 
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.frequency == 0 and iteration > 0:
-            elapsed = time.time() - self.start
+            elapsed = monotonic_s() - self.start
             remaining = elapsed / iteration * (self.iteration_count - iteration)
             log.info("Remaining time: %d min %d sec", remaining // 60, remaining % 60)
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.clock import monotonic_s
 from ..train.listeners import TrainingListener
 
 __all__ = ["StatsListener", "StatsReport", "array_stats"]
@@ -120,9 +121,13 @@ class StatsListener(TrainingListener):
         self._last_time: Optional[float] = None
 
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        # interval on the monotonic clock; the record keeps a wall-clock
+        # timestamp for cross-host correlation
+        now_mono = monotonic_s()
+        iter_ms = ((now_mono - self._last_time) * 1000.0
+                   if self._last_time else 0.0)
+        self._last_time = now_mono
         now = time.time()
-        iter_ms = (now - self._last_time) * 1000.0 if self._last_time else 0.0
-        self._last_time = now
         if iteration % self.frequency != 0:
             return
         flat = _flatten_params(model.params)

@@ -3,19 +3,24 @@ step time, GravesLSTM char-RNN step time, Word2Vec words/sec).  The driver's
 headline ResNet50 metric lives in ``bench.py``; these side metrics are
 invoked from there (DL4J_TPU_BENCH_SIDE=1) and from ``tools/``.
 
-All timings are steady-state (compile + warm first) and close on a forced
-device→host fetch — block_until_ready alone can return early through
-buffer-proxying transports (BENCH_NOTES round 1).  Training rows time the
-device-resident epoch scan (``_scan_step_ms``), the path the framework
-actually trains through.
+All timings are steady-state — the compile-dominated first iteration is
+always excluded (warm-up fit before any clock starts; ``_cold_steady_fit``
+reports the compile-inclusive number separately as ``cold``) — and close on
+a forced device→host fetch — block_until_ready alone can return early
+through buffer-proxying transports (BENCH_NOTES round 1).  Training rows
+time the device-resident epoch scan (``_scan_step_ms``), the path the
+framework actually trains through.  Clocks come from the same monotonic
+helpers the tracer/metrics tier uses (``observability.clock``), so bench
+rows and span histograms are directly comparable.
 """
 from __future__ import annotations
 
 import os
-import time
 from typing import Dict, List
 
 import numpy as np
+
+from ..observability.clock import monotonic_s
 
 
 def _scan_step_ms(model, x, y, batch: int, nbatch: int, epochs: int = 2,
@@ -29,9 +34,9 @@ def _scan_step_ms(model, x, y, batch: int, nbatch: int, epochs: int = 2,
     steps = nbatch * epochs
     times = []
     for _ in range(blocks):
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         model.fit_on_device(x, y, batch_size=batch, epochs=epochs)
-        times.append((time.perf_counter() - t0) / steps * 1e3)
+        times.append((monotonic_s() - t0) / steps * 1e3)
     return float(np.median(times))
 
 
@@ -99,18 +104,18 @@ def _cold_steady_fit(model, total_words: int, runs: int = 3):
         float(np.asarray(model.lookup_table.syn0[0, 0]))
 
     model.build_vocab()
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     model.fit()
     _sync_tables()
-    cold = total_words / (time.perf_counter() - t0)
+    cold = total_words / (monotonic_s() - t0)
     rates = []
     for _ in range(runs):
         model.lookup_table.reset_weights()
         _sync_tables()                    # drain before starting the clock
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         model.fit()
         _sync_tables()
-        rates.append(total_words / (time.perf_counter() - t0))
+        rates.append(total_words / (monotonic_s() - t0))
     return cold, float(np.median(rates))
 
 
@@ -233,20 +238,20 @@ def serving_latency(concurrency: int = 16,
         def client():
             mine = []
             for _ in range(per_worker):
-                t0 = time.perf_counter()
+                t0 = monotonic_s()
                 np.asarray(pi.output(probe))  # host-synced result
-                mine.append(time.perf_counter() - t0)
+                mine.append(monotonic_s() - t0)
             with lock:
                 lats.extend(mine)
 
         threads = [threading.Thread(target=client)
                    for _ in range(concurrency)]
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        wall = time.perf_counter() - t0
+        wall = monotonic_s() - t0
         pi.shutdown()
         lats_ms = np.asarray(sorted(lats)) * 1e3
         out.append({
@@ -292,20 +297,20 @@ def tunnel_probe(n: int = 5) -> Dict:
     float(np.asarray(f(x))[0, 0])                    # compile + settle
     lats = []
     for _ in range(n):
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         float(np.asarray(f(x))[0, 0])
-        lats.append(time.perf_counter() - t0)
+        lats.append(monotonic_s() - t0)
     g = jax.jit(lambda a: a @ a)
     a = jnp.eye(1024, dtype=jnp.bfloat16)            # stable under chaining
     float(np.asarray(g(a)[0, 0]))                    # compile + settle
     blocks = []
     for _ in range(n):
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         r = a
         for _ in range(20):
             r = g(r)
         float(np.asarray(r[0, 0]))                   # sync the whole chain
-        blocks.append(time.perf_counter() - t0)
+        blocks.append(monotonic_s() - t0)
     med = float(np.median(blocks))
 
     # (c) device-COMPUTE throughput: one big dispatch (1000 scanned 2048^3
@@ -324,9 +329,9 @@ def tunnel_probe(n: int = 5) -> Dict:
         c = (jnp.eye(2048, dtype=jnp.bfloat16) * 0.99
              + jnp.full((2048, 2048), 1e-3, jnp.bfloat16))
         float(np.asarray(h(c)[0, 0]))                # compile + settle
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         float(np.asarray(h(c)[0, 0]))
-        compute_s = time.perf_counter() - t0
+        compute_s = monotonic_s() - t0
         flops = 1000 * 2 * 2048 ** 3
         compute_tflops = round(flops / compute_s / 1e12, 1)
     else:

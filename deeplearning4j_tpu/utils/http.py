@@ -1,5 +1,7 @@
 """Shared HTTP plumbing for the serving tier: JSON request/response handler
-base, background-thread server lifecycle, and a JSON POST client."""
+base with built-in observability (request count/latency/error-class metrics
+per route and a ``/metrics`` exposition endpoint), background-thread server
+lifecycle, and a JSON POST client."""
 from __future__ import annotations
 
 import json
@@ -8,14 +10,99 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.request import Request, urlopen
 
-__all__ = ["JsonHandler", "BackgroundHttpServer", "JsonClient"]
+from ..observability import clock
+from ..observability.exposition import CONTENT_TYPE, render_text
+from ..observability.registry import default_registry
+
+__all__ = ["JsonHandler", "MetricsEndpointMixin", "BackgroundHttpServer",
+           "JsonClient"]
+
+# request-latency buckets: local serving sits in the 1-100 ms band;
+# keep a long tail for model (re)compiles hit by a first request
+_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 10.0)
 
 
-class JsonHandler(BaseHTTPRequestHandler):
+class MetricsEndpointMixin:
+    """Serve the registry + observe per-route request metrics.
+
+    Handlers bind ``metrics_registry`` (via ``BackgroundHttpServer``
+    handler attrs) or fall back to the process-global default registry.
+    ``GET /metrics`` renders Prometheus text format; ``GET
+    /metrics?format=json`` returns the JSON snapshot.  Every response
+    sent through ``_json``/``_serve_metrics`` records::
+
+        http_requests_total{route,method,code}
+        http_request_seconds{route}        (histogram)
+        http_errors_total{route,class}     (class = client_error|server_error)
+
+    Route labels are the matched path with query strings stripped; 404s
+    collapse into one ``<unmatched>`` series so scrapes can't be
+    cardinality-bombed by URL probing.
+    """
+
+    metrics_registry = None   # bound per-server; None -> default registry
+
+    def _registry(self):
+        return (self.metrics_registry if self.metrics_registry is not None
+                else default_registry())
+
+    def _route_label(self, code: int) -> str:
+        if code == 404:
+            return "<unmatched>"
+        base = self.path.partition("?")[0].rstrip("/")
+        return base or "/"
+
+    def _observe_request(self, code: int) -> None:
+        reg = self._registry()
+        if not reg.enabled:
+            return
+        route = self._route_label(code)
+        dur = clock.monotonic_s() - getattr(self, "_req_start_mono",
+                                            clock.monotonic_s())
+        reg.counter("http_requests_total", "HTTP requests served",
+                    ("route", "method", "code")) \
+           .labels(route, getattr(self, "command", "?") or "?",
+                   str(code)).inc()
+        reg.histogram("http_request_seconds", "HTTP request latency",
+                      ("route",), buckets=_LATENCY_BUCKETS) \
+           .labels(route).observe(dur)
+        if code >= 400:
+            cls = "server_error" if code >= 500 else "client_error"
+            reg.counter("http_errors_total", "HTTP error responses",
+                        ("route", "error_class")).labels(route, cls).inc()
+
+    def _serve_metrics(self) -> bool:
+        """Answer ``GET /metrics``; returns False when the path is not the
+        metrics endpoint (caller continues its own routing)."""
+        base, _, query = self.path.partition("?")
+        if base.rstrip("/") != "/metrics":
+            return False
+        reg = self._registry()
+        if "json" in query:
+            self._json(reg.snapshot())
+            return True
+        payload = render_text(reg).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        self._observe_request(200)
+        return True
+
+
+class JsonHandler(MetricsEndpointMixin, BaseHTTPRequestHandler):
     """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST."""
 
     def log_message(self, *a):
         pass
+
+    def handle_one_request(self):
+        # stamp BEFORE parsing so the latency histogram covers the whole
+        # request (read + handle + write), not just the handler body
+        self._req_start_mono = clock.monotonic_s()
+        super().handle_one_request()
 
     def _json(self, obj, code: int = 200):
         payload = json.dumps(obj).encode()
@@ -24,6 +111,7 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+        self._observe_request(code)
 
     def _read_json(self):
         n = int(self.headers.get("Content-Length", 0))
@@ -69,3 +157,8 @@ class JsonClient:
     def get(self, route: str) -> dict:
         with urlopen(self.url + route, timeout=self.timeout) as resp:
             return json.loads(resp.read())
+
+    def get_text(self, route: str) -> str:
+        """Raw body fetch (the Prometheus /metrics exposition is not JSON)."""
+        with urlopen(self.url + route, timeout=self.timeout) as resp:
+            return resp.read().decode("utf-8")

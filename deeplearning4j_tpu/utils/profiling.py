@@ -18,7 +18,18 @@ from ..train.listeners import TrainingListener
 
 log = logging.getLogger("deeplearning4j_tpu.profiling")
 
-__all__ = ["ProfilerListener", "trace_annotation", "device_memory_stats"]
+__all__ = ["ProfilerListener", "trace_annotation", "device_memory_stats",
+           "device_platform"]
+
+
+def device_platform() -> str:
+    """Backend platform of the default device ("cpu"/"gpu"/"tpu"), or
+    "unknown" when no backend is reachable — the serving tier's /health
+    readiness reports ride this."""
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
 
 
 class ProfilerListener(TrainingListener):
@@ -61,7 +72,9 @@ class ProfilerListener(TrainingListener):
 def trace_annotation(name: str):
     """Label a host-side region so it shows up on the Xprof timeline
     (ETL, checkpointing, eval — the reference's StatsCalculationHelper
-    phase-timing role)."""
+    phase-timing role).  For spans that should ALSO land in the metrics
+    registry / event log, use ``observability.Tracer(bridge_xprof=True)``
+    — its spans wrap the same TraceAnnotation."""
     with jax.profiler.TraceAnnotation(name):
         yield
 

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX010 has at least one fixture that MUST fire and one
+Every rule JX001–JX011 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -406,6 +406,62 @@ def test_jx010_negative_float32_and_outside_jit():
     """)
 
 
+# ---------------------------------------------------------------- JX011
+def test_jx011_positive_interval_subtraction():
+    assert "JX011" in rules_of("""
+        import time
+
+        def measure(f):
+            t0 = time.time()
+            f()
+            return time.time() - t0
+    """)
+
+
+def test_jx011_positive_propagated_sample_and_bare_import():
+    # one-hop propagation (now -> self._last) across methods, with
+    # `from time import time`
+    assert "JX011" in rules_of("""
+        from time import time
+
+        class Listener:
+            def start(self):
+                now = time()
+                self._last = now
+
+            def rate(self, n):
+                now = time()
+                return n / (now - self._last)
+    """)
+
+
+def test_jx011_negative_deadline_idiom_and_timestamps():
+    assert "JX011" not in rules_of("""
+        import time
+
+        def wait(poll, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                remaining = deadline - time.time()  # remaining, not elapsed
+                poll(remaining)
+
+        def stamp(record):
+            record["ts"] = time.time()   # timestamp: no arithmetic
+            return record
+    """)
+
+
+def test_jx011_negative_perf_counter_interval():
+    assert "JX011" not in rules_of("""
+        import time
+
+        def measure(f):
+            t0 = time.perf_counter()
+            f()
+            return time.perf_counter() - t0
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -525,7 +581,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 10
+    assert len(RULES) == 11
 
 
 def test_package_is_clean_modulo_baseline():

@@ -127,6 +127,97 @@ class TestInferenceServer:
         finally:
             server.stop()
 
+    def test_metrics_endpoint_prometheus_text(self, iris_net):
+        """ISSUE 2 acceptance: GET /metrics returns valid Prometheus text
+        including request-latency histogram buckets after a /predict."""
+        import re
+        from deeplearning4j_tpu.observability import MetricsRegistry
+        reg = MetricsRegistry()
+        server = InferenceServer(iris_net, registry=reg).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}",
+                                     timeout=60)
+            x = np.random.default_rng(5).standard_normal((3, 4)).astype(
+                np.float32)
+            client.predict(x)
+            text = client.metrics_text()
+            # every sample line is spec-shaped
+            sample_re = re.compile(
+                r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                r'(NaN|[+-]Inf|-?[0-9.e+-]+)$')
+            for line in text.strip().splitlines():
+                if line.startswith("#"):
+                    assert line.startswith(("# HELP ", "# TYPE ")), line
+                else:
+                    assert sample_re.match(line), line
+            assert "# TYPE http_request_seconds histogram" in text
+            assert 'http_request_seconds_bucket{route="/predict",le="+Inf"} 1' in text
+            assert 'http_request_seconds_count{route="/predict"} 1' in text
+            assert ('http_requests_total{code="200",method="POST",'
+                    'route="/predict"} 1') in text
+            assert "inference_examples_total 3" in text
+            # JSON snapshot flavor
+            snap = client.get("/metrics?format=json")
+            assert snap["http_request_seconds"]["type"] == "histogram"
+            # error-class counter: a malformed predict is a client error
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError):
+                client.post("/predict", {"wrong_key": 1})
+            text2 = client.metrics_text()
+            assert ('http_errors_total{error_class="client_error",'
+                    'route="/predict"} 1') in text2
+        finally:
+            server.stop()
+
+    def test_health_liveness_vs_readiness(self, iris_net):
+        """Satellite: /health reports platform, model identity, and time
+        since the last successful predict — not a bare {"status": "ok"}."""
+        server = InferenceServer(iris_net).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}",
+                                     timeout=60)
+            h = client.get("/health")
+            assert h["live"] is True and h["ready"] is True
+            assert h["status"] == "ok"            # pre-upgrade probe compat
+            assert h["platform"] in ("cpu", "tpu", "gpu")
+            assert h["model"].startswith("MultiLayerNetwork[")
+            assert h["seconds_since_last_predict"] is None
+            client.predict(np.zeros((1, 4), np.float32))
+            h2 = client.get("/health")
+            assert h2["seconds_since_last_predict"] >= 0
+            assert h2["consecutive_failures"] == 0
+            # a model-side failure streak flips readiness (circuit signal)
+            server.consecutive_failures = server.FAILURE_THRESHOLD
+            h3 = client.get("/health")
+            assert h3["live"] is True and h3["ready"] is False
+            assert h3["status"] == "unready"
+            # one successful predict closes the circuit again
+            client.predict(np.zeros((1, 4), np.float32))
+            assert client.get("/health")["ready"] is True
+        finally:
+            server.stop()
+
+
+def test_nn_server_health_and_metrics():
+    """Both servers expose the upgraded /health and the shared /metrics."""
+    from deeplearning4j_tpu.observability import MetricsRegistry
+    pts = np.random.default_rng(6).standard_normal((20, 3)).astype(np.float32)
+    reg = MetricsRegistry()
+    server = NearestNeighborsServer(pts, registry=reg).start()
+    try:
+        client = NearestNeighborsClient(f"http://127.0.0.1:{server.port}")
+        h = client.get("/health")
+        assert h["live"] is True and h["ready"] is True
+        assert h["points"] == 20                  # pre-upgrade field kept
+        assert h["model"].startswith("knn[brute,n=20")
+        assert h["seconds_since_last_query"] is None
+        client.knn(pts[3], k=2)
+        assert client.get("/health")["seconds_since_last_query"] >= 0
+        text = client.get_text("/metrics")
+        assert 'http_request_seconds_bucket{route="/knn",le="+Inf"} 1' in text
+    finally:
+        server.stop()
+
 
 def test_inference_server_hot_reload(tmp_path):
     """POST /reload swaps the served model from a checkpoint zip."""

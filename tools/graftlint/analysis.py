@@ -76,7 +76,8 @@ class ModuleInfo:
         self.jit_names: Set[str] = set()        # names bound to jax.jit itself
         self.partial_names: Set[str] = set()
         self.time_names: Set[str] = set()       # names bound to the time module
-        self.timer_names: Set[str] = set()      # perf_counter/time imported bare
+        self.timer_names: Set[str] = set()      # perf_counter/monotonic imported bare
+        self.walltime_names: Set[str] = set()   # time.time imported bare
         self.parents: Dict[ast.AST, ast.AST] = {}
         self.jit_scopes: Set[ast.AST] = set()   # FunctionDef/AsyncFunctionDef/Lambda
         # func -> parameter names declared static via static_argnums/names
@@ -137,6 +138,8 @@ class ModuleInfo:
                     elif mod == "time" and alias.name in ("perf_counter",
                                                           "monotonic"):
                         self.timer_names.add(name)
+                    elif mod == "time" and alias.name == "time":
+                        self.walltime_names.add(name)
                     elif mod == "numpy":
                         # `from numpy import asarray` — track per-name as a
                         # numpy alias usable bare (rules check dotted paths,

@@ -412,6 +412,80 @@ def jx010(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX011
+@rule("JX011", "time.time() used for interval measurement (wall clock steps)")
+def jx011(info: ModuleInfo) -> List[Finding]:
+    """Flag the elapsed-interval idiom on the wall clock: ``t0 =
+    time.time()`` later subtracted as ``time.time() - t0`` (or ``now -
+    t0`` where both derive from ``time.time()``).  Wall clocks step under
+    NTP slew/DST, so intervals must come from ``time.perf_counter()`` —
+    in-package code uses the ``observability.clock`` helpers.  The
+    deadline/timeout idiom (``deadline = time.time() + t``; ``time.time()
+    > deadline``; ``deadline - time.time()``) never subtracts a stored
+    wall-clock sample FROM a later one and stays legal, as do bare
+    timestamps (no arithmetic)."""
+    out: List[Finding] = []
+
+    def is_walltime_call(n: ast.AST) -> bool:
+        if not isinstance(n, ast.Call):
+            return False
+        fname = call_name(n) or ""
+        parts = fname.split(".")
+        if len(parts) == 2 and parts[0] in info.time_names \
+                and parts[1] == "time":
+            return True
+        return len(parts) == 1 and parts[0] in info.walltime_names
+
+    # module-wide fixpoint: names (and self.attrs) holding a bare
+    # time.time() sample, including one-hop copies (now = time.time();
+    # self._last = now)
+    assigns: List = []
+    for node in ast.walk(info.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            key = dotted_name(t)
+            if key:
+                assigns.append((key, node.value))
+    tracked: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for key, value in assigns:
+            if key in tracked:
+                continue
+            src = dotted_name(value)
+            if is_walltime_call(value) or (src and src in tracked):
+                tracked.add(key)
+                changed = True
+
+    def holds_sample(n: ast.AST) -> bool:
+        if is_walltime_call(n):
+            return True
+        name = dotted_name(n)
+        return name is not None and name in tracked
+
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            # later-sample MINUS stored-sample = elapsed interval; the
+            # right side must be a stored name (deadline math subtracts
+            # a fresh call from a derived bound, which stays legal)
+            right = dotted_name(node.right)
+            if right is not None and right in tracked \
+                    and holds_sample(node.left):
+                out.append(_finding(
+                    info, node, "JX011",
+                    "interval measured with `time.time()`: the wall clock "
+                    "steps under NTP/DST, skewing the measurement — use "
+                    "`time.perf_counter()` (observability.clock helpers) "
+                    "for durations; keep `time.time()` for timestamps and "
+                    "deadlines"))
+    return _dedupe(out)
+
+
 def _dedupe(findings: List[Finding]) -> List[Finding]:
     seen = set()
     out = []
