@@ -16,6 +16,12 @@ the line carries an explicit gate: ``regression`` is true when vs_baseline
 drops below FAIL_THRESHOLD (0.95) — a drop the median can't blame on noise.
 Env knobs: DL4J_TPU_BENCH_BATCH / _IMAGE / _DTYPE / _NBATCH / _EPOCHS /
 _RUNS for CPU smoke-testing the bench path.
+
+A second JSON line records the input-pipeline overlap benchmark
+(``input_pipeline_examples_per_sec``: multiprocess ETL + device prefetch
+vs the single-thread async iterator on an input-bound workload) so
+pipeline-overlap regressions are as driver-visible as compute ones;
+DL4J_TPU_BENCH_PIPELINE=0 suppresses it.
 """
 import json
 import os
@@ -137,6 +143,19 @@ def main():
         print(f"REGRESSION: median vs_baseline {vs_baseline:.3f} < "
               f"{FAIL_THRESHOLD} over {runs} runs", file=sys.stderr)
 
+    # input-pipeline overlap row rides along with the headline (ISSUE 3:
+    # regressions in ETL/H2D overlap must be as driver-visible as compute
+    # regressions); a second JSON line, opt-out via DL4J_TPU_BENCH_PIPELINE=0
+    if os.environ.get("DL4J_TPU_BENCH_PIPELINE", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                input_pipeline_examples_per_sec
+            print(json.dumps(input_pipeline_examples_per_sec()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "input_pipeline_examples_per_sec",
+                              "value": None, "unit": "examples/sec",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -220,6 +239,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # serving under load (VERDICT r3 item 8): p50/p99 + throughput,
         # dynamic batching vs synchronous
         B.serving_latency,
+        # input-bound pipeline overlap (ISSUE 3): async-thread baseline vs
+        # multiprocess ETL + device prefetch on a workload where ETL >= step
+        B.input_pipeline_examples_per_sec,
     ]
     side = []
     for fn in captures:

@@ -120,7 +120,7 @@ class KMeans:
             # loop cannot proceed without the values
             counts_np = np.asarray(counts)  # graftlint: disable=JX003
             if (counts_np == 0).any():
-                centers_np = np.asarray(centers)  # graftlint: disable=JX003
+                centers_np = np.asarray(centers)  # graftlint: disable=JX003,JX012
                 # graftlint: disable=JX003
                 centers_np[np.flatnonzero(counts_np == 0)[0]] = points_np[int(far)]
                 centers = jnp.asarray(centers_np)

@@ -19,6 +19,8 @@ from .dataset import (AsyncShieldDataSetIterator,
                       MultiDataSetWrapperIterator,
                       PreProcessedDataSetIterator,
                       ReconstructionDataSetIterator)
+from .pipeline import (DevicePrefetchIterator, MultiprocessETLIterator,
+                       build_input_pipeline)
 from .transforms import (ComposeTransform, CutoutTransform,
                          ImageTransform, RandomCropTransform,
                          RandomFlipTransform, TransformingDataSetIterator)
@@ -53,5 +55,6 @@ __all__ = [
     "FileSplitParallelDataSetIterator", "FloatsDataSetIterator",
     "IteratorDataSetIterator", "JointParallelDataSetIterator",
     "MultiDataSetWrapperIterator", "PreProcessedDataSetIterator",
-    "ReconstructionDataSetIterator",
+    "ReconstructionDataSetIterator", "DevicePrefetchIterator",
+    "MultiprocessETLIterator", "build_input_pipeline",
 ]

@@ -261,7 +261,12 @@ class AsyncDataSetIterator(DataSetIterator):
     ``datasets/iterator/AsyncDataSetIterator.java:30`` + MagicQueue).  The
     producer thread fills a bounded queue so host-side ETL overlaps device
     compute — the TPU equivalent of the reference's device-affinity prefetch
-    threads."""
+    threads.
+
+    Not re-entrant: one live iteration at a time.  Two concurrent
+    iterations would race two producer threads over the ONE underlying
+    iterator (interleaving/dropping batches nondeterministically), so a
+    second ``__iter__`` while the first is still running raises instead."""
 
     _SENTINEL = object()
 
@@ -274,6 +279,8 @@ class AsyncDataSetIterator(DataSetIterator):
                 "not be prefetched from a background thread")
         self.underlying = underlying
         self.queue_size = queue_size
+        self._state_lock = threading.Lock()
+        self._active = False
 
     def batch(self):
         return self.underlying.batch()
@@ -282,6 +289,22 @@ class AsyncDataSetIterator(DataSetIterator):
         self.underlying.reset()
 
     def __iter__(self):
+        with self._state_lock:
+            if self._active:
+                raise RuntimeError(
+                    "AsyncDataSetIterator is already being iterated — a "
+                    "concurrent second iteration would race two producer "
+                    "threads over one underlying iterator; finish (or "
+                    "close) the first iteration, or give each consumer its "
+                    "own wrapper")
+            self._active = True
+        try:
+            yield from self._iterate()
+        finally:
+            with self._state_lock:
+                self._active = False
+
+    def _iterate(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         err: List[BaseException] = []

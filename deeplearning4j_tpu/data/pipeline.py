@@ -1,0 +1,637 @@
+"""Device-overlapped input pipeline: k-deep device prefetch + multiprocess
+shared-memory ETL.
+
+Two composable stages sit between a host ``DataSetIterator`` and the jitted
+train step, so neither host ETL nor the host→device copy ever serializes
+with device compute (the overlapped-ETL input pipeline of *TensorFlow: A
+system for large-scale machine learning*, PAPERS.md):
+
+``MultiprocessETLIterator``
+    Worker *processes* run the numpy transform stage (``data/transforms.py``
+    et al.) outside the trainer's GIL, handing finished batches back through
+    a ring of preallocated shared-memory slabs — a zero-copy handoff (the
+    parent yields numpy views straight into the slab; the only host copy is
+    the worker writing its result).  Batch order is deterministic and worker
+    exceptions propagate to the consumer.
+
+``DevicePrefetchIterator``
+    A background thread performs ``jax.device_put`` up to ``depth`` batches
+    ahead of the consumer — replicated on the default device, or sharded over
+    a mesh via ``NamedSharding`` so ``ParallelWrapper``/SPMD training gets
+    per-device placement for free.  The H2D copy of batch *n+k* overlaps the
+    in-flight step for batch *n* instead of being paid inside it;
+    ``MultiLayerNetwork.fit`` / ``ParallelWrapper.fit`` detect the already
+    device-resident arrays and skip re-placement.
+
+Observability (rides the PR-2 registry; all instruments resolved once per
+iteration, never forcing a device sync):
+
+- ``training_etl_seconds{stage}`` histogram — per-stage waits:
+  ``fetch`` (trainer blocked on the iterator — recorded by ``fit``),
+  ``source``/``h2d`` (prefetch producer pulling + placing),
+  ``wait`` (consumer blocked on the device queue),
+  ``transform`` (worker ETL time, measured in-worker, observed parent-side),
+  ``ring`` (parent blocked on the shared-memory ring).
+- ``training_pipeline_depth{stage=device|ring}`` gauges — how full each
+  stage's buffer is (a healthy overlapped pipeline sits near its depth).
+- ``training_pipeline_starved_total{stage=device|ring}`` counters — times a
+  consumer found the buffer empty (the producer is the bottleneck).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import queue
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import AsyncShieldDataSetIterator, DataSet, DataSetIterator
+from ..observability.clock import monotonic_s
+from ..observability.registry import default_registry
+
+__all__ = ["DevicePrefetchIterator", "MultiprocessETLIterator",
+           "build_input_pipeline", "ETL_BUCKETS"]
+
+# training_etl_seconds bucket bounds — shared with nn/multilayer.py's
+# registration of the same family (the registry rejects re-registration
+# with different buckets, so there must be exactly one source of truth).
+ETL_BUCKETS: Tuple[float, ...] = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_FIELDS = ("features", "labels", "features_mask", "labels_mask")
+
+# slabs whose close() found live consumer views at teardown: kept referenced
+# so SharedMemory.__del__ never re-raises mid-GC; already unlinked, so the
+# OS frees the memory with the last unmap (normally empty — slabs close
+# cleanly when consumers drop batches before finishing the iterator)
+_UNCLOSED_SLABS: List = []
+
+
+def _etl_instruments(registry=None):
+    """(etl_histogram, depth_gauge, starved_counter) or (None,)*3 when the
+    registry is disabled — callers hold the instruments for the whole
+    iteration so the hot path is one labels() lookup + plain float math."""
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return None, None, None
+    etl = reg.histogram(
+        "training_etl_seconds",
+        "Time blocked on the data pipeline per batch, by stage",
+        ("stage",), buckets=ETL_BUCKETS)
+    depth = reg.gauge("training_pipeline_depth",
+                      "Batches buffered ahead of the consumer, by stage",
+                      ("stage",))
+    starved = reg.counter("training_pipeline_starved_total",
+                          "Times a pipeline consumer found its buffer empty",
+                          ("stage",))
+    return etl, depth, starved
+
+
+# ===================================================================== device
+class DevicePrefetchIterator(DataSetIterator):
+    """Wrap any ``DataSetIterator`` and ``jax.device_put`` up to ``depth``
+    batches ahead on a background thread.
+
+    With ``mesh=None`` batches land committed on the default device.  With a
+    ``jax.sharding.Mesh``, each array is placed with a ``NamedSharding``
+    whose leading axis maps to ``data_axis`` (optionally a time axis to
+    ``seq_axis``), and partial batches are trimmed to a multiple of the
+    data-axis size — the same policy as ``ParallelWrapper._trim``, so the
+    wrapper sees only evenly-divisible device-resident batches and skips
+    both trim and re-placement.
+
+    The yielded ``DataSet`` holds ``jax.Array`` leaves.  Downstream jitted
+    steps never donate batch arguments (only params/state/opt_state), so a
+    prefetched buffer is never invalidated by the step that consumes it.
+    Not re-entrant: one live iteration at a time (a second concurrent
+    ``__iter__`` raises rather than racing two producers over the
+    underlying iterator).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, underlying: DataSetIterator, depth: int = 2, *,
+                 mesh=None, data_axis: str = "data",
+                 seq_axis_name: Optional[str] = None,
+                 seq_axis: Optional[int] = None, registry=None):
+        if isinstance(underlying, AsyncShieldDataSetIterator):
+            raise ValueError(
+                "iterator is wrapped in AsyncShieldDataSetIterator — it must "
+                "not be prefetched from a background thread")
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.underlying = underlying
+        self.depth = depth
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.seq_axis_name = seq_axis_name
+        self.seq_axis = seq_axis
+        self._registry = registry
+        self._state_lock = threading.Lock()
+        self._active = False
+
+    def batch(self):
+        return self.underlying.batch()
+
+    def reset(self):
+        self.underlying.reset()
+
+    # ------------------------------------------------------------ placement
+    def _data_axis_size(self) -> int:
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape.get(self.data_axis, 1))
+
+    def _sharding_for(self, ndim: int):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = [None] * ndim
+        if ndim > 0:
+            spec[0] = self.data_axis
+        if (self.seq_axis_name is not None and self.seq_axis is not None
+                and ndim > self.seq_axis):
+            spec[self.seq_axis] = self.seq_axis_name
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def _place(self, ds) -> Optional[DataSet]:
+        """Host batch -> device-resident DataSet (None: sub-shard batch)."""
+        import jax
+        fields = [getattr(ds, f, None) for f in _FIELDS] \
+            if not isinstance(ds, (tuple, list)) else \
+            list(ds) + [None] * (4 - len(ds))
+        d = self._data_axis_size()
+        if d > 1:
+            n = int(np.shape(fields[0])[0])
+            keep = (n // d) * d
+            if keep == 0:
+                return None                    # smaller than the data axis
+            if keep != n:
+                fields = [None if a is None else a[:keep] for a in fields]
+        out = []
+        for a in fields:   # per-field, not per-step: this IS the prefetch stage
+            if a is None:
+                out.append(None)
+            elif self.mesh is None:
+                out.append(a if isinstance(a, jax.Array)
+                           else jax.device_put(a))  # graftlint: disable=JX012
+            else:
+                out.append(jax.device_put(  # graftlint: disable=JX012
+                    a, self._sharding_for(np.ndim(a))))
+        return DataSet(*out)
+
+    # ------------------------------------------------------------ iteration
+    def __iter__(self):
+        with self._state_lock:
+            if self._active:
+                raise RuntimeError(
+                    "DevicePrefetchIterator is already being iterated — a "
+                    "second concurrent iteration would race two producer "
+                    "threads over one underlying iterator")
+            self._active = True
+        try:
+            yield from self._run()
+        finally:
+            with self._state_lock:
+                self._active = False
+
+    def _run(self):
+        etl_h, depth_g, starved_c = _etl_instruments(self._registry)
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        err: List[BaseException] = []
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                it = iter(self.underlying)
+                while True:
+                    t0 = monotonic_s()
+                    try:
+                        ds = next(it)
+                    except StopIteration:
+                        break
+                    t1 = monotonic_s()
+                    # the device_put inside _place is ASYNC dispatch (it
+                    # enqueues the H2D copy) — the histogram records
+                    # host-side cost, the transfer overlaps the in-flight step
+                    placed = self._place(ds)
+                    t2 = monotonic_s()
+                    if etl_h is not None:
+                        etl_h.labels("source").observe(t1 - t0)
+                        etl_h.labels("h2d").observe(t2 - t1)
+                    if placed is None:
+                        continue
+                    if not _put(placed):
+                        return                 # consumer went away
+                    if depth_g is not None:
+                        depth_g.labels("device").set(q.qsize())
+            except BaseException as e:  # noqa: BLE001 - relayed to consumer
+                err.append(e)
+            finally:
+                _put(self._SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="device-prefetch")
+        t.start()
+        first_get = True
+        try:
+            while True:
+                # the very first get is empty by construction (producer
+                # warm-up), not a starvation signal
+                if starved_c is not None and q.empty() and not first_get:
+                    starved_c.labels("device").inc()
+                first_get = False
+                t0 = monotonic_s()
+                item = q.get()
+                if etl_h is not None:
+                    etl_h.labels("wait").observe(monotonic_s() - t0)
+                    depth_g.labels("device").set(q.qsize())
+                if item is self._SENTINEL:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join()
+        if err:
+            raise err[0]
+
+
+# ================================================================ multiproc
+def _mute_shm_tracking() -> None:
+    """Stop THIS process's resource tracker from adopting shared-memory
+    attachments.  In CPython < 3.13 ``SharedMemory(name=...)`` registers on
+    *attach* too, so a worker would co-own (and at exit unregister/unlink)
+    slabs the parent created and still owns — the parent's own unlink then
+    double-unregisters in the shared tracker process.  Workers are dedicated
+    processes, so the patch is process-wide and never reverted."""
+    from multiprocessing import resource_tracker
+    orig = resource_tracker.register
+
+    def register(name, rtype):
+        if rtype != "shared_memory":
+            orig(name, rtype)
+
+    resource_tracker.register = register
+
+
+def _attach_shm(name: str):
+    from multiprocessing import shared_memory
+    return shared_memory.SharedMemory(name=name)
+
+
+def _etl_worker(worker_id: int, num_workers: int, source_factory,
+                transform, seed: int, epoch: int, slot_names: Sequence[str],
+                slot_bytes: int, slots_per_worker: int, sem, result_q,
+                stop_evt) -> None:
+    """Worker-process body: iterate a private copy of the source, process
+    the interleaved shard ``seq % num_workers == worker_id``, write results
+    into this worker's ring slots.  The ETL itself is pure numpy; jax is
+    pinned to cpu up front so user code inside ``source_factory``/
+    ``transform`` can never dial the training accelerator (env changes are
+    too late for that — the config update is the reliable mechanism)."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    _mute_shm_tracking()
+    shms = [_attach_shm(slot_names[worker_id * slots_per_worker + i])
+            for i in range(slots_per_worker)]
+    local = 0
+    try:
+        source = source_factory()
+        for _ in range(epoch):
+            # replay resets so per-epoch source state (shuffle streams)
+            # matches a single-process consumer on the same epoch
+            if hasattr(source, "reset"):
+                source.reset()
+        for seq, ds in enumerate(source):
+            if stop_evt.is_set():
+                return
+            if seq % num_workers != worker_id:
+                continue
+            t0 = time.perf_counter()
+            fields = [None if a is None else np.asarray(a)
+                      for a in (ds.features, ds.labels,
+                                getattr(ds, "features_mask", None),
+                                getattr(ds, "labels_mask", None))]
+            if transform is not None:
+                rng = np.random.default_rng((seed, epoch, seq))
+                fields[0] = np.ascontiguousarray(transform(fields[0], rng))
+            etl_s = time.perf_counter() - t0
+            payload = [(f, None if a is None else np.ascontiguousarray(a))
+                       for f, a in zip(_FIELDS, fields)]
+            nbytes = sum(a.nbytes for _, a in payload if a is not None)
+            if nbytes <= slot_bytes:
+                # wait for one of OUR slots to be released by the parent;
+                # stop-aware so shutdown never deadlocks on a full ring
+                while not stop_evt.is_set():
+                    if sem.acquire(timeout=0.1):
+                        break
+                else:
+                    return
+                shm = shms[local % slots_per_worker]
+                meta, off = [], 0
+                for fname, a in payload:
+                    if a is None:
+                        continue
+                    shm.buf[off:off + a.nbytes] = a.tobytes()
+                    meta.append((fname, a.shape, a.dtype.str, off))
+                    off += a.nbytes
+                result_q.put(("slab", seq, worker_id,
+                              local % slots_per_worker, etl_s, meta))
+                local += 1
+            else:
+                # batch outgrew the preallocated slab (variable-shape
+                # transform): fall back to a pickled handoff for this batch
+                result_q.put(("inline", seq, worker_id, None, etl_s,
+                              {f: a for f, a in payload if a is not None}))
+    except BaseException:  # noqa: BLE001 - relayed to the parent
+        result_q.put(("error", worker_id, traceback.format_exc()))
+    finally:
+        result_q.put(("done", worker_id))
+        for shm in shms:
+            try:
+                shm.close()
+            except BufferError:
+                pass
+
+
+class MultiprocessETLIterator(DataSetIterator):
+    """Run host ETL (the numpy transform stage) in worker *processes*,
+    handing finished batches back through a preallocated shared-memory ring.
+
+    Each worker builds its own source from ``source_factory`` (a picklable
+    zero-argument callable returning a ``DataSetIterator``), iterates it, and
+    fully processes only the interleaved shard ``seq % num_workers ==
+    worker_id`` — the *transform* (the expensive part, e.g. a
+    ``data/transforms.ImageTransform``) is what escapes the GIL; the cheap
+    source iteration is replayed per worker to keep batch order
+    deterministic without inter-process coordination.  ``transform(features,
+    rng) -> features`` runs under ``np.random.default_rng((seed, epoch,
+    seq))`` so results are reproducible regardless of worker count or
+    scheduling.
+
+    Ring protocol: every worker owns ``slots_per_worker`` shared-memory
+    slabs used cyclically; a semaphore per worker counts free slots.  The
+    parent reorders arrivals by sequence number (deterministic order) and
+    yields ``DataSet`` batches.  The worker→parent handoff is always
+    through shared memory (no pickling); with the default
+    ``copy_out=True`` the parent materializes each batch out of the slab
+    (one memcpy) and frees the slot immediately — batches are then plain
+    owned arrays, safe to stash or hand to an async device-prefetch
+    stage.  ``copy_out=False`` removes even that memcpy: batches are
+    ZERO-COPY views into the slab, valid only until the next ``next()``
+    — the caller must consume each batch synchronously (and beware that
+    ``jax.device_put`` on the CPU backend may *alias* rather than copy an
+    aligned view: never combine ``copy_out=False`` with a prefetch queue
+    that outlives the slot).  A batch that outgrows its slab
+    (variable-shape transform) silently falls back to a pickled handoff.
+
+    Workers are spawned (never forked: the parent may hold jax/TPU state
+    and live threads) and pin jax to the cpu platform first thing
+    (``jax.config.update``), so worker-side jax use can never dial the
+    training accelerator.  Worker exceptions propagate to the consumer as
+    ``RuntimeError`` carrying the worker traceback; shutdown (normal end,
+    consumer break, or error) stops workers, joins them, and unlinks
+    every slab.
+    """
+
+    def __init__(self, source_factory: Callable[[], DataSetIterator],
+                 transform=None, *, num_workers: int = 2,
+                 slots_per_worker: int = 2, slot_bytes: Optional[int] = None,
+                 seed: int = 0, copy_out: bool = True, registry=None,
+                 join_timeout_s: float = 10.0):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        if slots_per_worker < 1:
+            raise ValueError(
+                f"slots_per_worker must be >= 1, got {slots_per_worker}")
+        self.source_factory = source_factory
+        self.transform = transform
+        self.num_workers = num_workers
+        self.slots_per_worker = slots_per_worker
+        self.slot_bytes = slot_bytes
+        self.seed = seed
+        self.copy_out = copy_out
+        self.join_timeout_s = join_timeout_s
+        self._registry = registry
+        self._epoch = 0
+        self._batch: Optional[int] = None
+        self._state_lock = threading.Lock()
+        self._active = False
+
+    def batch(self):
+        if self._batch is None:
+            self._batch = int(self.source_factory().batch())
+        return self._batch
+
+    def reset(self):
+        self._epoch += 1
+
+    # ------------------------------------------------------------ internals
+    def _probe_slot_bytes(self) -> int:
+        """Size slabs from the first (transformed) batch of a parent-side
+        probe source; later batches are at most this big for standard
+        iterators (only the final batch shrinks), and bigger ones fall back
+        to the inline path.  The result is cached on ``slot_bytes`` so
+        re-iteration (one ring per epoch) doesn't rebuild the source and
+        re-run the transform every time."""
+        if self.slot_bytes is not None:
+            return int(self.slot_bytes)
+        probe = next(iter(self.source_factory()), None)
+        if probe is None:
+            self.slot_bytes = 1
+            return 1
+        fields = [None if a is None else np.asarray(a)
+                  for a in (probe.features, probe.labels,
+                            getattr(probe, "features_mask", None),
+                            getattr(probe, "labels_mask", None))]
+        if self.transform is not None:
+            rng = np.random.default_rng((self.seed, 0, 0))
+            fields[0] = np.asarray(self.transform(fields[0], rng))
+        self.slot_bytes = max(1, sum(a.nbytes for a in fields
+                                     if a is not None))
+        return self.slot_bytes
+
+    def __iter__(self):
+        with self._state_lock:
+            if self._active:
+                raise RuntimeError(
+                    "MultiprocessETLIterator is already being iterated — a "
+                    "second concurrent iteration would tear down the ring "
+                    "under the first one")
+            self._active = True
+        try:
+            yield from self._run()
+        finally:
+            with self._state_lock:
+                self._active = False
+
+    def _run(self):
+        from multiprocessing import shared_memory
+        etl_h, depth_g, starved_c = _etl_instruments(self._registry)
+        ctx = multiprocessing.get_context("spawn")
+        slot_bytes = self._probe_slot_bytes()
+        n_slots = self.num_workers * self.slots_per_worker
+        shms = [shared_memory.SharedMemory(create=True, size=slot_bytes)
+                for _ in range(n_slots)]
+        slot_names = [s.name for s in shms]
+        sems = [ctx.Semaphore(self.slots_per_worker)
+                for _ in range(self.num_workers)]
+        result_q = ctx.Queue()
+        stop_evt = ctx.Event()
+        workers = [
+            ctx.Process(
+                target=_etl_worker,
+                args=(w, self.num_workers, self.source_factory,
+                      self.transform, self.seed, self._epoch, slot_names,
+                      slot_bytes, self.slots_per_worker, sems[w],
+                      result_q, stop_evt),
+                daemon=True, name=f"etl-worker-{w}")
+            for w in range(self.num_workers)]
+        for p in workers:
+            p.start()
+        pending_release: Optional[int] = None   # worker whose slot we hold
+
+        def _release_prev():
+            nonlocal pending_release
+            if pending_release is not None:
+                sems[pending_release].release()
+                pending_release = None
+
+        try:
+            buffer = {}
+            next_seq = 0
+            done = 0
+            failure: Optional[str] = None
+            while True:
+                starved_counted = False
+                while next_seq not in buffer:
+                    if done >= self.num_workers:
+                        break
+                    # at most one starvation event per awaited batch, not
+                    # one per 0.5 s poll cycle
+                    if (starved_c is not None and not starved_counted
+                            and result_q.empty()):
+                        starved_c.labels("ring").inc()
+                        starved_counted = True
+                    t0 = monotonic_s()
+                    try:
+                        msg = result_q.get(timeout=0.5)
+                    except queue.Empty:
+                        if not any(p.is_alive() for p in workers):
+                            done = self.num_workers
+                            failure = failure or (
+                                "ETL worker(s) died without reporting. If "
+                                "this happened at startup, make sure the "
+                                "program's entry point is guarded with "
+                                "`if __name__ == '__main__':` — "
+                                "multiprocessing spawn re-imports the main "
+                                "module (see the worker stderr above)")
+                        continue
+                    if etl_h is not None:
+                        etl_h.labels("ring").observe(monotonic_s() - t0)
+                    kind = msg[0]
+                    if kind == "done":
+                        done += 1
+                    elif kind == "error":
+                        failure = f"ETL worker {msg[1]} failed:\n{msg[2]}"
+                        stop_evt.set()
+                    else:
+                        buffer[msg[1]] = msg
+                        if depth_g is not None:
+                            depth_g.labels("ring").set(len(buffer))
+                if next_seq not in buffer:
+                    break
+                kind, seq, wid, slot, etl_s, payload = buffer.pop(next_seq)
+                if etl_h is not None:
+                    etl_h.labels("transform").observe(etl_s)
+                    depth_g.labels("ring").set(len(buffer))
+                if kind == "slab":
+                    shm = shms[wid * self.slots_per_worker + slot]
+                    arrays = {}
+                    for fname, shape, dtype, off in payload:
+                        count = int(np.prod(shape)) if shape else 1
+                        view = np.frombuffer(
+                            shm.buf, dtype=np.dtype(dtype), count=count,
+                            offset=off).reshape(shape)
+                        # copy_out: one memcpy buys an OWNED batch — the
+                        # slot recycles immediately and nothing downstream
+                        # (a prefetch queue, a zero-copy device_put alias
+                        # on the CPU backend) can observe the worker's
+                        # next write to this slab
+                        arrays[fname] = np.array(view) if self.copy_out \
+                            else view
+                    if self.copy_out:
+                        sems[wid].release()
+                        yield DataSet(*[arrays.get(f) for f in _FIELDS])
+                    else:
+                        _release_prev()
+                        ds = DataSet(*[arrays.get(f) for f in _FIELDS])
+                        arrays = None  # frame must not pin slab views past
+                        yield ds       # the consumer's lifetime for them
+                        ds = None
+                        pending_release = wid
+                else:                               # inline fallback
+                    _release_prev()
+                    yield DataSet(*[payload.get(f) for f in _FIELDS])
+                next_seq += 1
+            if failure is not None:
+                raise RuntimeError(failure)
+        finally:
+            stop_evt.set()
+            _release_prev()
+            for p in workers:
+                p.join(timeout=self.join_timeout_s)
+            for p in workers:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=1.0)
+            result_q.cancel_join_thread()
+            result_q.close()
+            for s in shms:
+                try:
+                    s.close()
+                except BufferError:
+                    # the consumer still holds a zero-copy view into this
+                    # slab (documented: views live until the next next()).
+                    # Keep the object referenced so __del__ never re-raises;
+                    # the mapping is freed when the process exits.
+                    _UNCLOSED_SLABS.append(s)
+                try:
+                    s.unlink()
+                except FileNotFoundError:
+                    pass
+
+
+# ================================================================= pipeline
+def build_input_pipeline(source_factory: Callable[[], DataSetIterator],
+                         transform=None, *, num_workers: int = 2,
+                         depth: int = 2, mesh=None, seed: int = 0,
+                         registry=None) -> DevicePrefetchIterator:
+    """The full overlapped pipeline in one call: multiprocess ETL feeding a
+    k-deep device prefetch.  ``num_workers=0`` skips the multiprocess stage
+    (the source runs on the prefetch thread — the right choice when the
+    transform is cheap or the source is not picklable)."""
+    if num_workers > 0:
+        inner: DataSetIterator = MultiprocessETLIterator(
+            source_factory, transform, num_workers=num_workers, seed=seed,
+            registry=registry)
+    else:
+        inner = source_factory()
+        if transform is not None:
+            from .transforms import TransformingDataSetIterator
+            inner = TransformingDataSetIterator(inner, transform, seed=seed)
+    return DevicePrefetchIterator(inner, depth, mesh=mesh, registry=registry)

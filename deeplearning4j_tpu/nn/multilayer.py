@@ -37,6 +37,7 @@ from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
+from ..data.pipeline import ETL_BUCKETS as _ETL_BUCKETS
 from ..observability.clock import monotonic_s
 from ..observability.registry import default_registry
 from ..train.listeners import TrainingListener
@@ -45,6 +46,15 @@ from ..train.listeners import TrainingListener
 # XLA compiles in the "compile" phase series
 _STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _on_device(a):
+    """Device placement for one batch leaf; a leaf the input pipeline
+    already placed (``DevicePrefetchIterator``) passes through untouched —
+    no second H2D copy, no resharding."""
+    if a is None or isinstance(a, jax.Array):
+        return a
+    return jnp.asarray(a)
 
 Array = jax.Array
 
@@ -379,8 +389,8 @@ class MultiLayerNetwork:
                 ("phase",), buckets=_STEP_BUCKETS)
             etl_h = reg.histogram(
                 "training_etl_seconds",
-                "Time blocked on the data pipeline per batch",
-                buckets=_STEP_BUCKETS)
+                "Time blocked on the data pipeline per batch, by stage",
+                ("stage",), buckets=_ETL_BUCKETS)
         steady_examples, steady_s = 0, 0.0
         for _ in range(epochs):
             for lst in self.listeners:
@@ -409,7 +419,7 @@ class MultiLayerNetwork:
                     dt = monotonic_s() - t_step
                     step_h.labels("compile" if compile_step
                                   else "steady").observe(dt)
-                    etl_h.observe(self.last_etl_ms / 1e3)
+                    etl_h.labels("fetch").observe(self.last_etl_ms / 1e3)
                     steps_c.inc()
                     examples_c.inc(self.last_batch_size)
                     if not compile_step:
@@ -474,16 +484,23 @@ class MultiLayerNetwork:
         T = x.shape[1]
         batch = x.shape[0]
         carries = self._init_carries(batch)
+        # one device placement per BATCH, not per chunk (JX012: the
+        # transfer belongs outside the loop); chunk slices below are
+        # device-side views of these arrays
+        x = _on_device(x)
+        y = _on_device(y)
+        mask = _on_device(mask)
+        label_mask = _on_device(label_mask)
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
-            xm = None if mask is None else jnp.asarray(mask)[:, sl]
-            ym = None if label_mask is None else jnp.asarray(label_mask)[:, sl]
-            yc = jnp.asarray(y)[:, sl] if getattr(y, "ndim", 2) == 3 else jnp.asarray(y)
+            xm = None if mask is None else mask[:, sl]
+            ym = None if label_mask is None else label_mask[:, sl]
+            yc = y[:, sl] if getattr(y, "ndim", 2) == 3 else y
             self._rng, key = jax.random.split(self._rng)
             (self.params, self.state, self.opt_state, loss, gstats,
              carries) = step(
                 self.params, self.state, self.opt_state, key,
-                jnp.asarray(x)[:, sl], yc, xm, ym, carries)
+                x[:, sl], yc, xm, ym, carries)
             # device scalar inside the chunk loop: a float() here would
             # host-sync every chunk, serializing tBPTT windows against
             # dispatch RTT; listeners reading get_score() materialize it
@@ -596,9 +613,7 @@ class MultiLayerNetwork:
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key,
-            jnp.asarray(x), jnp.asarray(y),
-            None if m is None else jnp.asarray(m),
-            None if lm is None else jnp.asarray(lm))
+            _on_device(x), _on_device(y), _on_device(m), _on_device(lm))
         self._score = float(loss)
         self._last_grad_stats = gstats
         self._train_step_ran = True

@@ -167,7 +167,10 @@ def _pipeline_seq_step(n_devices: int, devices) -> None:
     with ring attention inside each stage, DP gradient pmean, SGD update.
     Model + step come from ``demo.py`` (shared with the pipeline tests)."""
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from .demo import build_demo_inputs, make_pipelined_train_step
@@ -197,7 +200,10 @@ def _expert_parallel_step(n_devices: int, devices) -> None:
     """data×expert MoE train step: top-1 routed FFN, tiled all-to-all
     token exchange over the expert axis, DP grad reduction."""
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from .expert import init_moe_params, make_moe_train_step

@@ -42,7 +42,11 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
             return b
-    return buckets[-1]
+    # silently falling back to buckets[-1] would dispatch an UNPADDED
+    # oversize batch (a fresh XLA compile per novel size); callers split
+    # or reject before bucketing, so reaching here is a contract bug
+    raise InvalidInputError(
+        f"batch of {n} exceeds the top bucket {buckets[-1]}")
 
 
 class ParallelInference:
@@ -51,12 +55,18 @@ class ParallelInference:
     ``output(x)`` accepts a single example ``[features...]`` or a batch
     ``[n, features...]`` and returns the model output; in BATCHED mode
     concurrent callers are coalesced into one padded device batch.
+
+    Explicit ``batch_buckets`` are respected as-is; a coalesced group
+    larger than the top bucket follows ``oversize_policy``: ``"split"``
+    (default) dispatches it in top-bucket chunks so every dispatch keeps a
+    compiled shape, ``"reject"`` fails it with ``InvalidInputError``.
     """
 
     def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
                  max_batch_size: int = 32, queue_limit: int = 256,
                  nano_wait: float = 0.002,
-                 batch_buckets: Optional[Sequence[int]] = None):
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 oversize_policy: str = "split"):
         if inference_mode not in (InferenceMode.INPLACE,
                                   InferenceMode.BATCHED):
             raise ValueError(
@@ -64,14 +74,24 @@ class ParallelInference:
                 f"'{InferenceMode.INPLACE}' or '{InferenceMode.BATCHED}' "
                 "(an unrecognized mode would queue requests with no "
                 "dispatcher and hang)")
+        if oversize_policy not in ("split", "reject"):
+            raise ValueError(
+                f"unknown oversize_policy '{oversize_policy}'; expected "
+                "'split' (chunk oversize batches across dispatches) or "
+                "'reject' (fail them with InvalidInputError)")
         self.model = model
         self.mode = inference_mode
         self.max_batch_size = max_batch_size
         self.nano_wait = nano_wait
-        buckets = list(batch_buckets) if batch_buckets else [
-            b for b in (1, 2, 4, 8, 16, 32, 64, 128) if b < max_batch_size]
-        if max_batch_size not in buckets:
-            buckets.append(max_batch_size)  # top bucket must cover full batch
+        self.oversize_policy = oversize_policy
+        if batch_buckets:
+            # explicit buckets are respected as-is: a coalesced group
+            # larger than the top bucket follows oversize_policy instead
+            # of being silently dispatched unpadded
+            buckets = list(batch_buckets)
+        else:
+            buckets = [b for b in (1, 2, 4, 8, 16, 32, 64, 128)
+                       if b < max_batch_size] + [max_batch_size]
         self.buckets = sorted(buckets)
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
@@ -94,6 +114,13 @@ class ParallelInference:
         if self.mode == InferenceMode.INPLACE or self._shutdown.is_set():
             out = np.asarray(self.model.output(batch))
             return out[0] if single else out
+        if (self.oversize_policy == "reject"
+                and len(batch) > self.buckets[-1]):
+            # fail fast rather than enqueueing work the dispatcher will
+            # reject future-by-future anyway
+            raise InvalidInputError(
+                f"request batch of {len(batch)} exceeds the top bucket "
+                f"{self.buckets[-1]} (oversize_policy='reject')")
         futures = [self._submit(batch[i]) for i in range(len(batch))]
         results = np.stack([f.result() for f in futures])
         return results[0] if single else results
@@ -165,6 +192,21 @@ class ParallelInference:
                 self._run_batch(group)
 
     def _run_batch(self, pending: List) -> None:
+        top = self.buckets[-1]
+        if len(pending) > top:
+            if self.oversize_policy == "reject":
+                err = InvalidInputError(
+                    f"coalesced batch of {len(pending)} exceeds the top "
+                    f"bucket {top} (oversize_policy='reject')")
+                for _, fut in pending:
+                    if not fut.done():
+                        fut.set_exception(err)
+                return
+            # split: one dispatch per top-bucket chunk — every chunk keeps
+            # a compiled-bucket shape instead of one unpadded novel shape
+            for i in range(0, len(pending), top):
+                self._run_batch(pending[i:i + top])
+            return
         try:  # any failure must not kill the dispatch loop
             examples = np.stack([ex for ex, _ in pending])
             n = len(examples)

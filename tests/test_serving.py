@@ -85,6 +85,65 @@ class TestParallelInference:
         finally:
             pi.shutdown()
 
+    def test_oversize_batch_split_across_dispatches(self, iris_net):
+        """Explicit buckets smaller than a coalesced group: the group is
+        split into top-bucket chunks (never silently dispatched at a novel
+        unpadded shape), every future still gets its own correct row."""
+        from deeplearning4j_tpu.parallel.inference import _bucket
+        pi = ParallelInference(iris_net, InferenceMode.BATCHED,
+                               max_batch_size=16, batch_buckets=[2, 4],
+                               nano_wait=0.05)
+        x = np.random.default_rng(5).standard_normal((10, 4)).astype(
+            np.float32)
+        expected = np.asarray(iris_net.output(x))
+        try:
+            out = pi.output(x)   # coalesces up to 10 > top bucket 4
+            np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+        finally:
+            pi.shutdown()
+        with pytest.raises(Exception, match="exceeds the top bucket"):
+            _bucket(10, [2, 4])
+
+    def test_oversize_batch_rejected(self, iris_net):
+        from deeplearning4j_tpu.parallel.inference import InvalidInputError
+        pi = ParallelInference(iris_net, InferenceMode.BATCHED,
+                               max_batch_size=16, batch_buckets=[2, 4],
+                               oversize_policy="reject")
+        x = np.random.default_rng(6).standard_normal((10, 4)).astype(
+            np.float32)
+        try:
+            with pytest.raises(InvalidInputError,
+                               match="exceeds the top bucket"):
+                pi.output(x)
+            # within-bucket requests still serve
+            small = pi.output(x[:3])
+            np.testing.assert_allclose(
+                small, np.asarray(iris_net.output(x[:3])),
+                rtol=1e-5, atol=1e-6)
+        finally:
+            pi.shutdown()
+
+    def test_oversize_dispatcher_group_rejected_future_by_future(self,
+                                                                 iris_net):
+        """A coalesced group (assembled by the dispatcher, not one caller)
+        over the top bucket fails each future with InvalidInputError in
+        reject mode."""
+        from concurrent.futures import Future
+        from deeplearning4j_tpu.parallel.inference import InvalidInputError
+        pi = ParallelInference(iris_net, InferenceMode.BATCHED,
+                               max_batch_size=16, batch_buckets=[2, 4],
+                               oversize_policy="reject")
+        x = np.random.default_rng(7).standard_normal((6, 4)).astype(
+            np.float32)
+        try:
+            pending = [(x[i], Future()) for i in range(6)]
+            pi._run_batch(pending)
+            for _, fut in pending:
+                with pytest.raises(InvalidInputError):
+                    fut.result(timeout=1)
+        finally:
+            pi.shutdown()
+
 
 class TestNearestNeighborsServer:
     @pytest.mark.parametrize("index", ["brute", "vptree"])

@@ -1,9 +1,10 @@
 """graftlint — AST-based JAX/TPU correctness linter for deeplearning4j_tpu.
 
-Ten rules (JX001–JX010) targeting the failure modes a JAX reproduction
+Twelve rules (JX001–JX012) targeting the failure modes a JAX reproduction
 actually hits: tracer leaks across the host/device boundary, Python
 control flow on tracers, hidden host syncs in hot loops, silent
-recompilation, jit impurity, and benchmark lies from async dispatch.
+recompilation, jit impurity, benchmark lies from async dispatch, and
+per-iteration host↔device transfers that belong in a prefetch stage.
 
 Usage:
     python -m tools.graftlint deeplearning4j_tpu/            # text output

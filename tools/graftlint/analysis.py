@@ -78,6 +78,7 @@ class ModuleInfo:
         self.time_names: Set[str] = set()       # names bound to the time module
         self.timer_names: Set[str] = set()      # perf_counter/monotonic imported bare
         self.walltime_names: Set[str] = set()   # time.time imported bare
+        self.deviceput_names: Set[str] = set()  # jax.device_put imported bare
         self.parents: Dict[ast.AST, ast.AST] = {}
         self.jit_scopes: Set[ast.AST] = set()   # FunctionDef/AsyncFunctionDef/Lambda
         # func -> parameter names declared static via static_argnums/names
@@ -129,6 +130,8 @@ class ModuleInfo:
                     name = alias.asname or alias.name
                     if mod == "jax" and alias.name == "jit":
                         self.jit_names.add(name)
+                    elif mod == "jax" and alias.name == "device_put":
+                        self.deviceput_names.add(name)
                     elif mod == "jax" and alias.name == "numpy":
                         self.jnp_aliases.add(name)
                     elif mod == "jax" and alias.name == "lax":

@@ -486,6 +486,101 @@ def jx011(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX012
+@rule("JX012", "per-iteration host<->device transfer inside a loop")
+def jx012(info: ModuleInfo) -> List[Finding]:
+    """Flag host↔device copies paid once per loop iteration: (a) any
+    ``jax.device_put`` call inside a ``for``/``while`` body, and (b)
+    ``np.asarray``/``np.array`` on a *device-derived* name (one assigned
+    from a ``jnp.*``/``jax.*`` call in the same function) inside a loop.
+    Each such call serializes the loop against transfer+dispatch RTT — the
+    copy belongs in a prefetch stage (``data/pipeline.py``:
+    ``DevicePrefetchIterator`` overlaps H2D with the in-flight step) or
+    hoisted out of the loop.  Inside jit scopes the same spellings mean
+    different things (sharding constraints / trace-time errors already
+    covered by JX001), so jitted code is excluded."""
+    out: List[Finding] = []
+    if not (info.jax_aliases or info.jnp_aliases or info.deviceput_names):
+        return out
+
+    device_names_cache: Dict[Optional[ast.AST], set] = {}
+
+    def _device_value(node: ast.AST, tracked: set) -> bool:
+        """Does this expression produce a device array? jnp./jax. dotted
+        calls, bare device_put, or a tracked name / subscript of one."""
+        if isinstance(node, ast.Call):
+            fname = call_name(node) or ""
+            parts = fname.split(".")
+            if len(parts) >= 2 and parts[0] in (info.jnp_aliases
+                                                | info.jax_aliases):
+                return True
+            return len(parts) == 1 and parts[0] in info.deviceput_names
+        name = dotted_name(node)
+        return name is not None and name in tracked
+
+    def device_names(func: Optional[ast.AST]) -> set:
+        """Names in ``func`` (or module scope) assigned from device-valued
+        expressions, with one-hop copies, fixpointed."""
+        if func in device_names_cache:
+            return device_names_cache[func]
+        scope = func if func is not None else info.tree
+        assigns = []
+        for n in ast.walk(scope):
+            if info.enclosing_function(n) is not func:
+                continue    # nested functions track their own names
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                targets = [n.target]
+            for t in targets:
+                key = dotted_name(t)
+                if key:
+                    assigns.append((key, n.value))
+        tracked: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for key, value in assigns:
+                if key not in tracked and _device_value(value, tracked):
+                    tracked.add(key)
+                    changed = True
+        device_names_cache[func] = tracked
+        return tracked
+
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if info.in_jit_scope(node):
+            continue
+        if not _in_loop_same_function(info, node):
+            continue
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        is_dput = ((parts[-1] == "device_put" and parts[0] in info.jax_aliases)
+                   or (len(parts) == 1 and parts[0] in info.deviceput_names))
+        if is_dput:
+            out.append(_finding(
+                info, node, "JX012",
+                "`jax.device_put` inside a loop: one host->device transfer "
+                "per iteration, serialized against the step instead of "
+                "overlapping it — move placement into a prefetch stage "
+                "(data/pipeline.DevicePrefetchIterator) or hoist it out of "
+                "the loop"))
+            continue
+        if (parts[0] in info.numpy_aliases and len(parts) == 2
+                and parts[1] in ("asarray", "array", "asanyarray")
+                and node.args and isinstance(node.args[0], ast.Name)):
+            if node.args[0].id in device_names(info.enclosing_function(node)):
+                out.append(_finding(
+                    info, node, "JX012",
+                    f"`{fname}` on a device array inside a loop: "
+                    "device->host fetch every iteration, serializing the "
+                    "loop against transfer RTT — keep the value on device "
+                    "and materialize once after the loop"))
+    return _dedupe(out)
+
+
 def _dedupe(findings: List[Finding]) -> List[Finding]:
     seen = set()
     out = []
