@@ -22,6 +22,12 @@ A second JSON line records the input-pipeline overlap benchmark
 vs the single-thread async iterator on an input-bound workload) so
 pipeline-overlap regressions are as driver-visible as compute ones;
 DL4J_TPU_BENCH_PIPELINE=0 suppresses it.
+
+A third JSON line records the compilation-reuse benchmark
+(``compile_reuse``: cold first-step compile vs a clone's first step
+through the shared trace cache, plus the compile count of a
+ragged-last-batch fit under shape bucketing) so compile-cost regressions
+are tracked round over round; DL4J_TPU_BENCH_COMPILE=0 suppresses it.
 """
 import json
 import os
@@ -156,6 +162,17 @@ def main():
                               "value": None, "unit": "examples/sec",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # compilation-reuse row (ISSUE 4): cold compile vs clone reuse vs
+    # bucketed ragged fit; a third JSON line, opt-out DL4J_TPU_BENCH_COMPILE=0
+    if os.environ.get("DL4J_TPU_BENCH_COMPILE", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import compile_reuse
+            print(json.dumps(compile_reuse()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "compile_reuse", "value": None,
+                              "unit": "x cold/clone first-step",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -242,6 +259,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # input-bound pipeline overlap (ISSUE 3): async-thread baseline vs
         # multiprocess ETL + device prefetch on a workload where ETL >= step
         B.input_pipeline_examples_per_sec,
+        # compilation reuse (ISSUE 4): cold vs clone first step + bucketed
+        # ragged-fit compile count
+        B.compile_reuse,
     ]
     side = []
     for fn in captures:

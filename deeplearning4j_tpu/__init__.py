@@ -10,6 +10,18 @@ program per train step, pytree params, mesh-sharded scale-out.
 __version__ = "0.1.0"
 
 from . import observability
+from .nn.compile_cache import (persistent_cache_status,
+                               wire_persistent_cache)
+
+# opt-in persistent XLA compile cache: DL4J_TPU_COMPILE_CACHE=<dir> makes
+# process restarts reload compiled executables from disk instead of
+# recompiling (no env var -> no-op).  Best-effort: a jax version without
+# the cache flags must not break package import.
+try:
+    wire_persistent_cache()
+except Exception:  # noqa: BLE001 - import must survive any cache failure
+    pass
+
 from .nn.conf.input_type import InputType
 from .nn.conf.multi_layer import (MultiLayerConfiguration,
                                   NeuralNetConfiguration)
@@ -25,4 +37,6 @@ __all__ = [
     "NeuralNetConfiguration",
     "MultiLayerNetwork",
     "observability",
+    "persistent_cache_status",
+    "wire_persistent_cache",
 ]

@@ -21,6 +21,7 @@ from .dataset import (AsyncShieldDataSetIterator,
                       ReconstructionDataSetIterator)
 from .pipeline import (DevicePrefetchIterator, MultiprocessETLIterator,
                        build_input_pipeline)
+from .shapes import ShapePolicy, default_shape_policy
 from .transforms import (ComposeTransform, CutoutTransform,
                          ImageTransform, RandomCropTransform,
                          RandomFlipTransform, TransformingDataSetIterator)

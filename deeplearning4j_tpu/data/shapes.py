@@ -1,0 +1,297 @@
+"""Static-shape batch bucketing: pad ragged batches onto compiled shapes.
+
+XLA compiles one executable per input shape, so a partial final batch or a
+novel RNN sequence length retraces the whole train step.  ``ShapePolicy``
+pads such batches up to a *bucket* — a shape the process has already
+compiled (``auto`` mode) or a fixed ladder (explicit buckets / powers of
+two, mirroring ``ParallelInference``'s inference-side buckets) — and masks
+the padded rows out of the loss through the train step's existing
+``label_mask`` argument, so the padded step is numerically identical to the
+unpadded one (loss denominators count only rows whose mask has any weight;
+see ``nn/losses._apply_mask_and_mean``).
+
+Padded ROWS repeat the batch's last real row (keeps every forward op
+well-conditioned: no zero-mask divisions, no degenerate statistics) and
+carry a zero label mask; padded TIMESTEPS (explicit-bucket/pow2 modes only)
+are zero-masked in both the feature and label masks, the same convention
+variable-length sequence batches already use.
+
+Known caveats (the networks gate on these — ``_pad_flags``): padding is
+skipped entirely for AUX_LOSS stacks (MoE: padded rows compete for expert
+capacity even at inference, and the whole-batch load-balancing term
+defeats the label mask), for loss paths whose head ignores masks (YOLO),
+and for training when the stack contains a cross-batch layer
+(BatchNormalization trains on batch statistics, which padded rows would
+perturb — eval uses running statistics and stays safe).  Recurring eval
+paths additionally cap padding waste at 8x the real batch (auto mode).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence, Set, Tuple
+
+__all__ = ["ShapePolicy", "default_shape_policy", "next_pow2"]
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _pad_rows(a, pad: int, zero: bool = False):
+    """Append ``pad`` rows: copies of the last real row, or zeros."""
+    import jax.numpy as jnp
+    a = jnp.asarray(a)
+    tail = jnp.zeros_like(a[-1:]) if zero else a[-1:]
+    return jnp.concatenate([a] + [tail] * pad, axis=0)
+
+
+def _pad_time(a, pad: int):
+    """Append ``pad`` zero timesteps on axis 1."""
+    import jax.numpy as jnp
+    a = jnp.asarray(a)
+    shape = list(a.shape)
+    shape[1] = pad
+    return jnp.concatenate([a, jnp.zeros(shape, a.dtype)], axis=1)
+
+
+class ShapePolicy:
+    """Pad-to-bucket policy for one network.
+
+    Modes:
+      - ``auto`` (default): pad a batch up to the smallest batch size this
+        policy has already dispatched on the same path — the ragged *final*
+        batch of an epoch rides the steady batch's compiled executable.
+        Never pads the first/largest shape, so uniform workloads are
+        untouched.  Batch axis only.
+      - ``pow2``: pad the batch axis to the next power of two; 3-D inputs
+        also pad the time axis to the next power of two.
+      - ``buckets``: explicit ladders (``batch_buckets`` required,
+        ``time_buckets`` optional); a size beyond the top bucket passes
+        through unpadded (one compile, same as today).
+      - ``off``: disabled.
+
+    Thread-safe: the training masters drive replicas from worker threads.
+    """
+
+    def __init__(self, mode: str = "auto",
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 time_buckets: Optional[Sequence[int]] = None):
+        if mode not in ("auto", "pow2", "buckets", "off"):
+            raise ValueError(f"unknown shape-policy mode '{mode}'")
+        if mode == "buckets" and not batch_buckets:
+            raise ValueError("mode='buckets' needs batch_buckets")
+        self.mode = mode
+        self.batch_buckets = sorted(int(b) for b in batch_buckets) \
+            if batch_buckets else None
+        self.time_buckets = sorted(int(b) for b in time_buckets) \
+            if time_buckets else None
+        self._seen: Dict[Tuple[str, str], Set[int]] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    # ------------------------------------------------------------ targets
+    def _target(self, path: str, axis: str, n: int) -> int:
+        if self.mode == "off" or n <= 0:
+            return n
+        if self.mode == "buckets":
+            ladder = self.batch_buckets if axis == "batch" \
+                else self.time_buckets
+            if not ladder:
+                return n
+            for b in ladder:
+                if n <= b:
+                    return b
+            return n  # beyond top bucket: dispatch unpadded
+        if self.mode == "pow2":
+            return next_pow2(n)
+        # auto: smallest already-dispatched size >= n on this (path, axis)
+        with self._lock:
+            seen = self._seen.get((path, axis))
+            bigger = [s for s in seen if s >= n] if seen else []
+        return min(bigger) if bigger else n
+
+    def observe(self, path: str, n: int, axis: str = "batch") -> None:
+        """Record a dispatched size so later smaller batches pad up to it
+        (``auto`` mode); other modes derive targets from the ladder."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._seen.setdefault((path, axis), set()).add(int(n))
+
+    def target_batch(self, path: str, n: int) -> int:
+        t = self._target(path, "batch", n)
+        self.observe(path, t)
+        return t
+
+    def target_time(self, path: str, t: int) -> int:
+        # time-axis padding needs masks the auto mode must not invent for
+        # models that never used them — explicit modes only
+        if self.mode not in ("pow2", "buckets"):
+            return t
+        tt = self._target(path, "time", t)
+        self.observe(path, tt, axis="time")
+        return tt
+
+    # ------------------------------------------------------------ padding
+    def pad_train_batch(self, x, y, mask, label_mask, path: str = "train"):
+        """Pad a training batch to its bucket; returns (x, y, mask,
+        label_mask) with padded rows/timesteps loss-masked.  Passes the
+        batch through untouched when no padding applies or when padding
+        cannot be expressed safely (feature mask present but no label mask
+        — the step would fall back to the propagated mask, which padding
+        must not override)."""
+        n = int(getattr(x, "shape", (0,))[0])
+        if n == 0:
+            return x, y, mask, label_mask
+        if mask is not None and label_mask is None:
+            return x, y, mask, label_mask
+        target_b = self.target_batch(path, n)
+        ndim = getattr(x, "ndim", 2)
+        t = int(x.shape[1]) if ndim == 3 else 0
+        target_t = self.target_time(path, t) if t else 0
+        pad_b, pad_t = target_b - n, (target_t - t if t else 0)
+        if pad_b <= 0 and pad_t <= 0:
+            return x, y, mask, label_mask
+        import jax.numpy as jnp
+        y_seq = getattr(y, "ndim", 2) == 3
+        if label_mask is None:
+            label_mask = jnp.ones((n, t) if y_seq and t else (n,),
+                                  jnp.float32)
+        if pad_t > 0:
+            # padded timesteps: zeros in data and in BOTH masks (the
+            # standard variable-length convention layers already honor)
+            if mask is None:
+                mask = jnp.ones((n, t), jnp.float32)
+            x = _pad_time(x, pad_t)
+            mask = _pad_time(mask, pad_t)
+            if y_seq:
+                y = _pad_time(y, pad_t)
+            if getattr(label_mask, "ndim", 1) == 2:
+                label_mask = _pad_time(label_mask, pad_t)
+        if pad_b > 0:
+            # padded rows: edge-repeat data/feature-mask (well-conditioned
+            # forward), zero label mask (no loss/gradient contribution)
+            x = _pad_rows(x, pad_b)
+            y = _pad_rows(y, pad_b)
+            if mask is not None:
+                mask = _pad_rows(mask, pad_b)
+            label_mask = _pad_rows(label_mask, pad_b, zero=True)
+        return x, y, mask, label_mask
+
+    # recurring (per-call) eval paths bound their padding waste: in auto
+    # mode a target more than 8x the real batch (and more than 8 rows of
+    # slack) is skipped — compiling the small shape once beats paying the
+    # big bucket's compute on every call (output(1) after a 512-batch
+    # validation pass must not run a 512-row forward forever).  One-off
+    # training tails stay uncapped (a compile always dwarfs one step), and
+    # explicit ladders are respected as configured.
+    _EVAL_PAD_RATIO_CAP = 8
+
+    def _eval_target(self, path: str, n: int) -> int:
+        target = self._target(path, "batch", n)
+        if self.mode == "auto" and target > n and \
+                target > self._EVAL_PAD_RATIO_CAP * n and target - n > 8:
+            target = n
+        self.observe(path, target)
+        return target
+
+    def pad_eval_rows(self, x, path: str = "eval"):
+        """Pad an inference/eval batch's rows to the bucket.  Returns
+        (padded_x, real_n); the caller slices outputs back to ``real_n``.
+        Row-wise inference programs make this value-preserving."""
+        n = int(getattr(x, "shape", (0,))[0])
+        if n == 0:
+            return x, n
+        target = self._eval_target(path, n)
+        if target <= n:
+            return x, n
+        return _pad_rows(x, target - n), n
+
+    def pad_eval_rows_multi(self, xs, path: str = "eval"):
+        """Multi-input variant (ComputationGraph): one shared target for
+        every input.  Returns (padded_xs, real_n)."""
+        if not xs:
+            return xs, -1
+        n = int(getattr(xs[0], "shape", (0,))[0])
+        if n == 0:
+            return xs, n
+        target = self._eval_target(path, n)
+        if target <= n:
+            return xs, n
+        return [_pad_rows(x, target - n) for x in xs], n
+
+    @staticmethod
+    def _ones_label_mask(n: int, y):
+        """All-ones label mask shaped for ``y``: (n, t) for sequence
+        labels, (n,) otherwise."""
+        import jax.numpy as jnp
+        if getattr(y, "ndim", 2) == 3:
+            return jnp.ones((n, int(y.shape[1])), jnp.float32)
+        return jnp.ones((n,), jnp.float32)
+
+    def pad_score_batch(self, x, y, label_mask=None, path: str = "score"):
+        """Pad a scoring batch; returns (x, y, label_mask) where
+        label_mask is None exactly when nothing was padded (keeps the
+        steady score trace identical to the pre-policy one)."""
+        n = int(getattr(x, "shape", (0,))[0])
+        if n == 0:
+            return x, y, label_mask
+        target = self._eval_target(path, n)
+        if target <= n:
+            return x, y, label_mask
+        pad = target - n
+        if label_mask is None:
+            label_mask = self._ones_label_mask(n, y)
+        return (_pad_rows(x, pad), _pad_rows(y, pad),
+                _pad_rows(label_mask, pad, zero=True))
+
+    def pad_multi_batch(self, xs, ys, lms, path: str = "train"):
+        """Multi-input/multi-output row padding (ComputationGraph fit and
+        score): one shared target across inputs; every output head gets a
+        zero-extended label mask.  ``lms`` stays None when nothing pads."""
+        if not xs:
+            return xs, ys, lms
+        n = int(getattr(xs[0], "shape", (0,))[0])
+        if n == 0:
+            return xs, ys, lms
+        target = self.target_batch(path, n) if path == "train" \
+            else self._eval_target(path, n)
+        if target <= n:
+            return xs, ys, lms
+        pad = target - n
+        xs = [_pad_rows(x, pad) for x in xs]
+        new_lms = []
+        for oi, y in enumerate(ys):
+            lm = None if lms is None else lms[oi]
+            if lm is None:
+                lm = self._ones_label_mask(n, y)
+            new_lms.append(_pad_rows(lm, pad, zero=True))
+        ys = [_pad_rows(y, pad) for y in ys]
+        return xs, ys, new_lms
+
+
+def default_shape_policy(env: Optional[Dict[str, str]] = None) -> ShapePolicy:
+    """Policy from ``DL4J_TPU_SHAPE_BUCKETS``: ``off``, ``pow2``, a
+    comma-separated bucket ladder (``"8,16,64"``), or unset → ``auto``."""
+    raw = (env if env is not None else os.environ).get(
+        "DL4J_TPU_SHAPE_BUCKETS", "").strip().lower()
+    if not raw or raw == "auto":
+        return ShapePolicy("auto")
+    if raw in ("off", "0", "none", "disabled"):
+        return ShapePolicy("off")
+    if raw == "pow2":
+        return ShapePolicy("pow2")
+    try:
+        buckets = [int(v) for v in raw.split(",") if v.strip()]
+    except ValueError:
+        raise ValueError(
+            f"DL4J_TPU_SHAPE_BUCKETS={raw!r}: expected 'off', 'pow2', "
+            "'auto', or a comma-separated ladder like '8,16,64'")
+    return ShapePolicy("buckets", batch_buckets=buckets)

@@ -178,27 +178,40 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
     if nb == 0:
         raise ValueError(f"batch_size {batch_size} exceeds dataset ({n})")
     used = nb * batch_size
+    pol = getattr(model, "shape_policy", None)
+    if pol is not None and pol.enabled:
+        # let the per-batch path know the scan's steady batch size, so the
+        # ragged tail (fit_tail -> _fit_one) pads onto it instead of
+        # compiling a dedicated tail-sized train step
+        pol.observe("train", batch_size)
+    from .compile_cache import shared_jit
+    sig = model._topology_sig()
     cache_key = ("epoch_scan", nb, batch_size,
                  tuple(a.shape[1:] for a in xs),
                  tuple(a.shape[1:] for a in ys))
     fn = model._jit_cache.get(cache_key)
     if fn is None:
-        def epoch_fn(params, state, opt_state, key, xd, yd, perm_steps):
-            def body(carry, idx):
-                p, s, o, k = carry
-                k, sub = jax.random.split(k)
-                bx = [a[idx] for a in xd]     # one minibatch gather per step
-                by = [a[idx] for a in yd]
-                p, s, o, loss, gstats = call_step(p, s, o, sub, bx, by)
-                return (p, s, o, k), (loss, gstats)
+        def build_epoch_fn():
+            def epoch_fn(params, state, opt_state, key, xd, yd, perm_steps):
+                def body(carry, idx):
+                    p, s, o, k = carry
+                    k, sub = jax.random.split(k)
+                    bx = [a[idx] for a in xd]  # one minibatch gather per step
+                    by = [a[idx] for a in yd]
+                    p, s, o, loss, gstats = call_step(p, s, o, sub, bx, by)
+                    return (p, s, o, k), (loss, gstats)
 
-            (p, s, o, _), (losses, gstats) = jax.lax.scan(
-                body, (params, state, opt_state, key), perm_steps)
-            # listeners see the final step's gradient norms
-            gstats = jax.tree_util.tree_map(lambda a: a[-1], gstats)
-            return p, s, o, losses, gstats
+                (p, s, o, _), (losses, gstats) = jax.lax.scan(
+                    body, (params, state, opt_state, key), perm_steps)
+                # listeners see the final step's gradient norms
+                gstats = jax.tree_util.tree_map(lambda a: a[-1], gstats)
+                return p, s, o, losses, gstats
+            return epoch_fn, (0, 1, 2)
 
-        fn = jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
+        # shared across equal-topology networks (replicas): call_step only
+        # closes over the model's shared jitted step, never the model
+        fn = shared_jit((type(model).__name__, sig) + cache_key,
+                        build_epoch_fn, name="epoch_scan")
         model._jit_cache[cache_key] = fn
     # Fused multi-epoch program (VERDICT r4 item 2): when nothing needs a
     # per-epoch Python hook — no listeners, no ragged tail — ALL epochs run
@@ -244,7 +257,9 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
                     length=epochs)
                 return p, s, o, last_losses
 
-            fused = jax.jit(epochs_fn, donate_argnums=(0, 1, 2))
+            fused = shared_jit((type(model).__name__, sig) + fused_key,
+                               lambda: (epochs_fn, (0, 1, 2)),
+                               name="epochs_scan")
             model._jit_cache[fused_key] = fused
     try:
         if fuse:

@@ -1,0 +1,302 @@
+"""Compilation-reuse layer: shared trace cache + persistent XLA cache wiring.
+
+XLA compilation is the dominant fixed cost of the TPU execution model
+(PAPERS.md: the Julia-to-TPU paper reports compile times rivaling first-epoch
+runtime; the TensorFlow paper's core bet is compile-once/run-everywhere).
+Three mechanisms make that the framework default:
+
+1. **Shared trace cache** (`shared_jit`): jitted step functions are keyed by
+   a structural *topology signature* of the network configuration in a
+   process-global weak-value cache.  `MultiLayerNetwork.clone()` (and the
+   replica pools the training masters build from it) then reuse the
+   already-compiled executable instead of re-tracing an identical topology
+   once per replica.  Entries are weakly held: they live exactly as long as
+   some network's instance cache still references them.
+
+2. **Compile observability** (`InstrumentedJit`): every shared jitted
+   function counts its (re)traces into ``training_compile_total{fn}`` —
+   incremented *at trace time* via a deliberate Python side effect inside
+   the traced function, the one moment jit runs the Python body — and
+   records trace+compile wall time in ``training_compile_seconds{fn}``
+   plus an ``xla.compile`` tracer span, so recompile storms show up in
+   /metrics instead of as mystery latency.
+
+3. **Persistent compile cache** (`wire_persistent_cache`): opt-in
+   ``DL4J_TPU_COMPILE_CACHE=<dir>`` wires JAX's on-disk compilation cache at
+   package init, so a restarted process reloads executables instead of
+   recompiling the world.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from ..observability.clock import monotonic_s
+from ..observability.registry import default_registry
+from ..observability.tracer import get_tracer
+
+__all__ = ["topology_signature", "shared_jit", "InstrumentedJit",
+           "wire_persistent_cache", "persistent_cache_status",
+           "trace_cache_size", "clear_trace_cache"]
+
+# compile wall times: sub-100ms CPU toy nets up to minutes-long TPU programs
+_COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+# --------------------------------------------------------------- signature
+def _encode(obj: Any, seen: set) -> Any:
+    """Canonical, value-based encoding of a configuration object tree.
+
+    Two structurally identical configs (e.g. a ``clone()``'s deepcopy)
+    must encode identically; anything we cannot encode by value falls back
+    to an identity token, which disables sharing for that config rather
+    than risking a false cache hit.
+    """
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    oid = id(obj)
+    if oid in seen:
+        return ["@cycle"]
+    if isinstance(obj, (list, tuple)):
+        seen = seen | {oid}
+        return [_encode(v, seen) for v in obj]
+    if isinstance(obj, dict):
+        seen = seen | {oid}
+        return [["@dict"]] + sorted(
+            ([_encode(k, seen), _encode(v, seen)] for k, v in obj.items()),
+            key=lambda kv: json.dumps(kv[0], sort_keys=True))
+    if isinstance(obj, (set, frozenset)):
+        return [["@set"]] + sorted(
+            (_encode(v, seen | {oid}) for v in obj),
+            key=lambda v: json.dumps(v, sort_keys=True))
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        seen = seen | {oid}
+        return [["@dc", type(obj).__module__, type(obj).__qualname__]] + [
+            [f.name, _encode(getattr(obj, f.name), seen)]
+            for f in dataclasses.fields(obj)]
+    # dtypes / numpy scalars / small arrays (e.g. loss unit weights)
+    try:
+        import numpy as np
+        if isinstance(obj, np.dtype):
+            return ["@dtype", str(obj)]
+        if isinstance(obj, np.ndarray) or isinstance(obj, jax.Array):
+            a = np.asarray(obj)
+            return ["@arr", str(a.dtype), list(a.shape),
+                    hashlib.sha256(a.tobytes()).hexdigest()]
+    except Exception:
+        pass
+    if isinstance(obj, type):
+        return ["@type", obj.__module__, obj.__qualname__]
+    if callable(obj):
+        # named functions deepcopy to themselves, so module+qualname is a
+        # stable value key; anonymous callables fall through to identity
+        mod = getattr(obj, "__module__", None)
+        qn = getattr(obj, "__qualname__", None)
+        if mod and qn and "<locals>" not in qn and "<lambda>" not in qn:
+            return ["@fn", mod, qn]
+    # non-dataclass object with a plain __dict__: encode by value (layer
+    # confs that predate @dataclass); otherwise identity token (no sharing)
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict) and type(obj).__module__ != "builtins":
+        seen = seen | {oid}
+        return [["@obj", type(obj).__module__, type(obj).__qualname__]] + \
+            sorted(([k, _encode(v, seen)] for k, v in d.items()),
+                   key=lambda kv: kv[0])
+    return ["@id", type(obj).__qualname__, oid]
+
+
+def topology_signature(conf: Any) -> str:
+    """Structural signature of a network configuration: layer/vertex confs,
+    dtypes, optimizer spec, preprocessors — everything that determines the
+    traced program, by VALUE.  Deepcopied configs (``clone()``) produce the
+    same signature; any config edit (transfer-learning fine-tune, fold)
+    produces a different one."""
+    payload = json.dumps(_encode(conf, set()), sort_keys=True,
+                         separators=(",", ":"), default=repr)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+# ------------------------------------------------------------ shared cache
+class InstrumentedJit:
+    """A jitted callable that observes its own (re)traces.
+
+    The wrapped Python function body runs exactly once per trace — that is
+    the hook: it bumps ``training_compile_total{fn}`` and flags the calling
+    thread, so ``__call__`` can attribute the call's wall time to
+    ``training_compile_seconds{fn}`` and emit an ``xla.compile`` span.  In
+    JAX, trace+lower+compile are synchronous within the triggering call
+    (only execution is async), so that wall time is an honest compile cost.
+    """
+
+    __slots__ = ("name", "fn", "_tls", "__weakref__")
+
+    def __init__(self, fun: Callable, name: str,
+                 donate_argnums: Tuple[int, ...] = ()):
+        self.name = name
+        self._tls = threading.local()
+        holder_ref = weakref.ref(self)
+
+        def traced(*args, **kwargs):
+            holder = holder_ref()
+            if holder is not None:
+                holder._note_trace()
+            return fun(*args, **kwargs)
+
+        self.fn = jax.jit(traced, donate_argnums=donate_argnums)
+
+    def _note_trace(self) -> None:
+        self._tls.traced = True
+        reg = default_registry()
+        if reg.enabled:
+            reg.counter("training_compile_total",
+                        "XLA traces (each implies a compile unless the "
+                        "persistent cache hits)", ("fn",)
+                        ).labels(self.name).inc()
+
+    def __call__(self, *args, **kwargs):
+        self._tls.traced = False
+        t0 = monotonic_s()
+        out = self.fn(*args, **kwargs)
+        if self._tls.traced:
+            dt = monotonic_s() - t0
+            reg = default_registry()
+            if reg.enabled:
+                reg.histogram(
+                    "training_compile_seconds",
+                    "Wall time of calls that (re)traced, i.e. trace + "
+                    "compile + first dispatch", ("fn",),
+                    buckets=_COMPILE_BUCKETS).labels(self.name).observe(dt)
+            tracer = get_tracer()
+            if tracer.enabled:
+                # marker span: the compile already happened inside the call
+                # above; `seconds` carries its true duration
+                with tracer.span("xla.compile", fn=self.name,
+                                 seconds=round(dt, 4)):
+                    pass
+        return out
+
+    @property
+    def last_call_traced(self) -> bool:
+        """Did THIS thread's most recent call trigger a (re)trace?"""
+        return bool(getattr(self._tls, "traced", False))
+
+    def lower(self, *args, **kwargs):
+        """AOT lowering passthrough (memory analysis, HLO dumps)."""
+        return self.fn.lower(*args, **kwargs)
+
+
+_TRACE_CACHE: "weakref.WeakValueDictionary[Tuple, InstrumentedJit]" = \
+    weakref.WeakValueDictionary()
+_TRACE_LOCK = threading.RLock()
+
+
+def shared_jit(key: Tuple, builder: Callable[[], Tuple[Callable, Tuple]],
+               *, name: str) -> InstrumentedJit:
+    """Get-or-build a shared jitted function.
+
+    ``key`` must be a hashable structural key (network class, topology
+    signature, function kind).  ``builder`` returns ``(fun,
+    donate_argnums)`` — the builder is the single source of truth for
+    donation, so a kind's donation policy cannot drift between the builder
+    and its call sites.  ``fun`` must close over *configuration* only —
+    never over a network instance — so every equal-signature network can
+    safely execute the cached callable with its own params/state/opt_state
+    arguments.
+
+    Entries are weakly referenced: a function stays cached exactly while at
+    least one network's instance ``_jit_cache`` holds it.
+    """
+    with _TRACE_LOCK:
+        entry = _TRACE_CACHE.get(key)
+        if entry is not None:
+            reg = default_registry()
+            if reg.enabled:
+                reg.counter("training_trace_cache_hits_total",
+                            "Shared trace-cache hits (a clone/replica "
+                            "reused an already-jitted step)", ("fn",)
+                            ).labels(name).inc()
+            return entry
+        fun, donate_argnums = builder()
+        entry = InstrumentedJit(fun, name=name,
+                                donate_argnums=tuple(donate_argnums))
+        _TRACE_CACHE[key] = entry
+        return entry
+
+
+def trace_cache_size() -> int:
+    return len(_TRACE_CACHE)
+
+
+def clear_trace_cache() -> None:
+    """Drop every shared entry (tests; live networks keep their own refs)."""
+    with _TRACE_LOCK:
+        _TRACE_CACHE.clear()
+
+
+# -------------------------------------------------------- persistent cache
+_PERSISTENT_STATUS: Dict[str, Any] = {"enabled": False}
+_PERSISTENT_LOCK = threading.Lock()
+
+
+def _cache_entries(path: str) -> int:
+    try:
+        return sum(1 for f in os.listdir(path) if not f.startswith("."))
+    except OSError:
+        return 0
+
+
+def wire_persistent_cache(path: Optional[str] = None) -> Dict[str, Any]:
+    """Wire JAX's persistent (on-disk) compilation cache.
+
+    ``path`` defaults to ``$DL4J_TPU_COMPILE_CACHE``; with neither set this
+    is a no-op returning ``{"enabled": False}``.  Thresholds are lowered so
+    every entry persists (the min-compile-time default would skip the small
+    programs CPU tests produce).  Each config flag is applied best-effort —
+    older jax versions missing a flag degrade gracefully rather than
+    breaking package import.  Returns a status dict including how many
+    cache entries a previous process left behind (``existing_entries`` > 0
+    on a warm restart means the first compile of each program is a disk
+    load, not an XLA compile)."""
+    global _PERSISTENT_STATUS
+    if path is None:
+        path = os.environ.get("DL4J_TPU_COMPILE_CACHE", "")
+    if not path:
+        with _PERSISTENT_LOCK:
+            _PERSISTENT_STATUS = {"enabled": False}
+            return dict(_PERSISTENT_STATUS)
+    os.makedirs(path, exist_ok=True)
+    existing = _cache_entries(path)
+    applied = []
+    for flag, value in (
+            ("jax_compilation_cache_dir", path),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(flag, value)
+            applied.append(flag)
+        except (AttributeError, ValueError, TypeError):
+            continue
+    status = {"enabled": "jax_compilation_cache_dir" in applied,
+              "dir": path, "existing_entries": existing,
+              "applied": applied}
+    reg = default_registry()
+    if reg.enabled:
+        reg.gauge("training_persistent_cache_entries",
+                  "Entries found in the persistent XLA compile cache dir "
+                  "at wiring time").set(existing)
+    with _PERSISTENT_LOCK:
+        _PERSISTENT_STATUS = status
+        return dict(status)
+
+
+def persistent_cache_status() -> Dict[str, Any]:
+    with _PERSISTENT_LOCK:
+        return dict(_PERSISTENT_STATUS)

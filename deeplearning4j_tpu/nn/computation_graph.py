@@ -22,11 +22,13 @@ import optax
 
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, build_tx,
-                      fit_on_device_epochs)
+                      fit_on_device_epochs, hyperparam_conf)
+from .compile_cache import shared_jit, topology_signature
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      GraphVertexConf, LayerVertex)
 from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
+from ..data.shapes import default_shape_policy
 from ..train.listeners import TrainingListener
 
 Array = jax.Array
@@ -38,6 +40,165 @@ def _as_list(x) -> List:
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x]
+
+
+def _vertex_confs(conf) -> Dict[str, Any]:
+    return {name: getattr(v, "layer", None)
+            for name, v in conf.vertices.items()}
+
+
+def _graph_forward(conf, params, state, inputs: List[Array], *, train: bool,
+                   key, masks: Optional[List[Optional[Array]]] = None,
+                   exclude_outputs: bool = False):
+    """Walk the static topological order; returns (acts, new_state, masks).
+
+    acts: dict vertex-name -> activation (plus network inputs).  A free
+    function over the configuration — never touches a graph instance — so
+    the jitted programs built from it live in the process-global trace
+    cache and serve every equal-topology graph (clones, master replicas).
+    """
+    acts: Dict[str, Array] = {}
+    mask_of: Dict[str, Optional[Array]] = {}
+    for i, n in enumerate(conf.network_inputs):
+        acts[n] = inputs[i]
+        mask_of[n] = masks[i] if masks else None
+    new_state = dict(state)
+    # output vertices whose activation nothing consumes can be skipped
+    # when the caller only needs pre-output activations for the loss
+    consumed = {src for ins in conf.vertex_inputs.values() for src in ins}
+    for vi, name in enumerate(conf.topological_order):
+        v = conf.vertices[name]
+        if exclude_outputs and name in conf.network_outputs and \
+                name not in consumed and isinstance(v, LayerVertex) and \
+                hasattr(v.layer, "compute_loss"):
+            continue
+        ins = conf.vertex_inputs[name]
+        xs = [acts[s] for s in ins]
+        ms = [mask_of.get(s) for s in ins]
+        # LastTimeStepVertex keys sequence length off a *named* input mask
+        mi = getattr(v, "mask_input", None)
+        if mi:
+            ms = [mask_of.get(mi)] + ms[1:]
+        lkey = jax.random.fold_in(key, vi) if key is not None else None
+        variables = {"params": params.get(name, {}),
+                     "state": state.get(name, {})}
+        if train and conf.defaults.get("cache_mode") == "remat" and \
+                isinstance(v, LayerVertex):
+            # rematerialize per-vertex activations on the backward pass
+            # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM —
+            # SURVEY §7 "Workspaces → jax.checkpoint")
+            def _apply(vv, xx, kk, mm, _v=v):
+                return _v.apply(vv, xx, train=True, key=kk, masks=mm)
+            y, lstate = jax.checkpoint(_apply)(variables, xs, lkey, ms)
+        else:
+            y, lstate = v.apply(variables, xs, train=train, key=lkey,
+                                masks=ms)
+        acts[name] = y
+        new_state[name] = lstate
+        mask_of[name] = v.feed_forward_mask(ms, xs)
+    return acts, new_state, mask_of
+
+
+def _graph_loss(conf, params, state, inputs, labels, *, train: bool, key,
+                masks=None, label_masks=None):
+    acts, new_state, mask_of = _graph_forward(
+        conf, params, state, inputs, train=train, key=key, masks=masks,
+        exclude_outputs=True)
+    total = jnp.zeros(())
+    for oi, name in enumerate(conf.network_outputs):
+        v = conf.vertices[name]
+        if not (isinstance(v, LayerVertex) and
+                hasattr(v.layer, "compute_loss")):
+            raise ValueError(
+                f"network output '{name}' is not an output layer vertex")
+        src = conf.vertex_inputs[name][0]
+        h = acts[src]
+        lm = None
+        if label_masks is not None and oi < len(label_masks):
+            lm = label_masks[oi]
+        if lm is None:
+            lm = mask_of.get(src)
+        lkey = (jax.random.fold_in(key, 10_000 + oi)
+                if key is not None else None)
+        variables = {"params": params.get(name, {}),
+                     "state": state.get(name, {})}
+        total = total + v.compute_loss(variables, h, labels[oi],
+                                       train=train, key=lkey, mask=lm)
+    reg = jnp.zeros(())
+    for name, v in conf.vertices.items():
+        lp = params.get(name, {})
+        if lp:
+            reg = reg + v.regularization_score(lp)
+        if getattr(getattr(v, "layer", None), "AUX_LOSS", False):
+            aux = new_state.get(name, {}).get("aux_loss")
+            if aux is not None:
+                reg = reg + aux
+    return total + reg, new_state
+
+
+def _build_graph_fn(conf, tx, kind: str):
+    """Build the Python function behind one jitted graph entry point;
+    returns ``(fun, donate_argnums)``.  Closures capture only conf/tx
+    (shared-cache safe; the per-instance closure is the JX013 hazard)."""
+    outs = conf.network_outputs
+    if kind == "output":
+        def fn(params, state, xs):
+            acts, _, _ = _graph_forward(conf, params, state, xs,
+                                        train=False, key=None)
+            return [acts[o] for o in outs]
+        return fn, ()
+    if kind == "output_train":
+        def fn(params, state, xs, key):
+            acts, _, _ = _graph_forward(conf, params, state, xs,
+                                        train=True, key=key)
+            return [acts[o] for o in outs]
+        return fn, ()
+    if kind == "score":
+        def fn(params, state, xs, ys, label_masks):
+            return _graph_loss(conf, params, state, xs, ys, train=False,
+                               key=None, label_masks=label_masks)
+        return fn, ()
+    if kind == "train_step":
+        return _build_graph_train_step(conf, tx), (0, 1, 2)
+    raise KeyError(kind)
+
+
+def _build_graph_train_step(conf, tx):
+    gn_mode = conf.defaults.get("gradient_normalization")
+    gn_thr = float(conf.defaults.get(
+        "gradient_normalization_threshold", 1.0))
+    cdtype = conf.defaults.get("compute_dtype")
+    confs = _vertex_confs(conf)
+
+    def step(params, state, opt_state, key, xs, ys, masks, label_masks):
+        if cdtype is not None:
+            xs = [x.astype(cdtype) for x in xs]
+
+        def loss_fn(p):
+            if cdtype is not None:
+                p = _cast_floats(p, cdtype)
+            loss, new_state = _graph_loss(conf, p, state, xs, ys,
+                                          train=True, key=key, masks=masks,
+                                          label_masks=label_masks)
+            return loss, new_state
+        (loss, new_state), grads = \
+            jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
+        gleaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
+            if gleaves else jnp.zeros(())
+        glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
+                                  for g in jax.tree_util.tree_leaves(v)))
+                  for k, v in grads.items() if v}
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = apply_constraints_all(new_params, confs)
+        if cdtype is not None:
+            new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
+        return (new_params, new_state, new_opt, loss,
+                {"global_norm": gnorm, "layer_norms": glayer})
+
+    return step
 
 
 class ComputationGraph:
@@ -56,7 +217,11 @@ class ComputationGraph:
         self._score = float("nan")
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
+        # instance view over the process-global trace cache (compile_cache)
         self._jit_cache: Dict[Any, Any] = {}
+        self._topo_sig: Optional[str] = None
+        self._pad_safe: Optional[bool] = None
+        self.shape_policy = default_shape_policy()
 
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
@@ -89,94 +254,34 @@ class ComputationGraph:
     def _forward(self, params, state, inputs: List[Array], *, train: bool,
                  key, masks: Optional[List[Optional[Array]]] = None,
                  exclude_outputs: bool = False):
-        """Walk the static topological order; returns (acts, new_state, masks).
-
-        acts: dict vertex-name -> activation (plus network inputs).
-        """
-        conf = self.conf
-        acts: Dict[str, Array] = {}
-        mask_of: Dict[str, Optional[Array]] = {}
-        for i, n in enumerate(conf.network_inputs):
-            acts[n] = inputs[i]
-            mask_of[n] = masks[i] if masks else None
-        new_state = dict(state)
-        # output vertices whose activation nothing consumes can be skipped
-        # when the caller only needs pre-output activations for the loss
-        consumed = {src for ins in conf.vertex_inputs.values() for src in ins}
-        for vi, name in enumerate(conf.topological_order):
-            v = conf.vertices[name]
-            if exclude_outputs and name in conf.network_outputs and \
-                    name not in consumed and isinstance(v, LayerVertex) and \
-                    hasattr(v.layer, "compute_loss"):
-                continue
-            ins = conf.vertex_inputs[name]
-            xs = [acts[s] for s in ins]
-            ms = [mask_of.get(s) for s in ins]
-            # LastTimeStepVertex keys sequence length off a *named* input mask
-            mi = getattr(v, "mask_input", None)
-            if mi:
-                ms = [mask_of.get(mi)] + ms[1:]
-            lkey = jax.random.fold_in(key, vi) if key is not None else None
-            variables = {"params": params.get(name, {}),
-                         "state": state.get(name, {})}
-            if train and conf.defaults.get("cache_mode") == "remat" and \
-                    isinstance(v, LayerVertex):
-                # rematerialize per-vertex activations on the backward pass
-                # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM —
-                # SURVEY §7 "Workspaces → jax.checkpoint")
-                def _apply(vv, xx, kk, mm, _v=v):
-                    return _v.apply(vv, xx, train=True, key=kk, masks=mm)
-                y, lstate = jax.checkpoint(_apply)(variables, xs, lkey, ms)
-            else:
-                y, lstate = v.apply(variables, xs, train=train, key=lkey,
-                                    masks=ms)
-            acts[name] = y
-            new_state[name] = lstate
-            mask_of[name] = v.feed_forward_mask(ms, xs)
-        return acts, new_state, mask_of
+        """Delegate to the conf-parameterized ``_graph_forward`` (kept as a
+        method for external callers)."""
+        return _graph_forward(self.conf, params, state, inputs, train=train,
+                              key=key, masks=masks,
+                              exclude_outputs=exclude_outputs)
 
     def _loss(self, params, state, inputs, labels, *, train: bool, key,
               masks=None, label_masks=None):
-        conf = self.conf
-        acts, new_state, mask_of = self._forward(
-            params, state, inputs, train=train, key=key, masks=masks,
-            exclude_outputs=True)
-        total = jnp.zeros(())
-        for oi, name in enumerate(conf.network_outputs):
-            v = conf.vertices[name]
-            if not (isinstance(v, LayerVertex) and
-                    hasattr(v.layer, "compute_loss")):
-                raise ValueError(
-                    f"network output '{name}' is not an output layer vertex")
-            src = conf.vertex_inputs[name][0]
-            h = acts[src]
-            lm = None
-            if label_masks is not None and oi < len(label_masks):
-                lm = label_masks[oi]
-            if lm is None:
-                lm = mask_of.get(src)
-            lkey = (jax.random.fold_in(key, 10_000 + oi)
-                    if key is not None else None)
-            variables = {"params": params.get(name, {}),
-                         "state": state.get(name, {})}
-            total = total + v.compute_loss(variables, h, labels[oi],
-                                           train=train, key=lkey, mask=lm)
-        reg = jnp.zeros(())
-        for name, v in conf.vertices.items():
-            lp = params.get(name, {})
-            if lp:
-                reg = reg + v.regularization_score(lp)
-            if getattr(getattr(v, "layer", None), "AUX_LOSS", False):
-                aux = new_state.get(name, {}).get("aux_loss")
-                if aux is not None:
-                    reg = reg + aux
-        return total + reg, new_state
+        """Delegate to the conf-parameterized ``_graph_loss``."""
+        return _graph_loss(self.conf, params, state, inputs, labels,
+                           train=train, key=key, masks=masks,
+                           label_masks=label_masks)
 
     # ---------------------------------------------------------- public API
     def output(self, *inputs, train: bool = False):
         """Activations of the network outputs (reference ``output(...)``).
-        Returns a single array if one output, else a list."""
+        Returns a single array if one output, else a list.  Ragged eval
+        batches pad onto a compiled bucket and the padded rows are sliced
+        off every head (row-wise inference is value-preserving)."""
         xs = [jnp.asarray(x) for x in inputs]
+        n = -1
+        pol = self.shape_policy
+        if not train and pol is not None and pol.enabled and xs and \
+                all(getattr(x, "ndim", 1) >= 2 for x in xs) and \
+                self._pad_output_safe():
+            padded, b = pol.pad_eval_rows_multi(xs)
+            if padded is not xs:   # same list object back == nothing padded
+                xs, n = padded, b
         if train:
             self._rng, key = jax.random.split(self._rng)
             fn = self._get_jitted("output_train")
@@ -184,6 +289,9 @@ class ComputationGraph:
         else:
             fn = self._get_jitted("output")
             ys = fn(self.params, self.state, xs)
+        if n >= 0:
+            ys = [y[:n] if getattr(y, "shape", (0,))[0] > n else y
+                  for y in ys]
         return ys[0] if len(ys) == 1 else list(ys)
 
     def output_single(self, *inputs, train: bool = False) -> Array:
@@ -212,74 +320,73 @@ class ComputationGraph:
             inputs, labels, _, _ = self._normalize_batch(dataset)
         inputs = [jnp.asarray(x) for x in _as_list(inputs)]
         labels = [jnp.asarray(y) for y in _as_list(labels)]
+        lms = None
+        pol = self.shape_policy
+        if pol is not None and pol.enabled and self._pad_eval_safe():
+            # ragged scoring batch rides a compiled bucket; padded rows
+            # are masked out of every output's loss
+            inputs, labels, lms = pol.pad_multi_batch(inputs, labels, None,
+                                                      path="score")
         fn = self._get_jitted("score")
-        loss, _ = fn(self.params, self.state, inputs, labels)
+        loss, _ = fn(self.params, self.state, inputs, labels, lms)
         return float(loss)
 
+    def _topology_sig(self) -> str:
+        if self._topo_sig is None:
+            self._topo_sig = topology_signature(self.conf)
+        return self._topo_sig
+
+    def invalidate_compile_cache(self) -> "ComputationGraph":
+        """Drop compiled-function views after IN-PLACE conf edits (see
+        ``MultiLayerNetwork.invalidate_compile_cache``)."""
+        self._jit_cache = {}
+        self._topo_sig = None
+        self._pad_safe = None
+        return self
+
     def _get_jitted(self, kind: str):
-        if kind in self._jit_cache:
-            return self._jit_cache[kind]
-        outs = self.conf.network_outputs
-        if kind == "output":
-            @jax.jit
-            def fn(params, state, xs):
-                acts, _, _ = self._forward(params, state, xs, train=False,
-                                           key=None)
-                return [acts[o] for o in outs]
-        elif kind == "output_train":
-            @jax.jit
-            def fn(params, state, xs, key):
-                acts, _, _ = self._forward(params, state, xs, train=True,
-                                           key=key)
-                return [acts[o] for o in outs]
-        elif kind == "score":
-            @jax.jit
-            def fn(params, state, xs, ys):
-                return self._loss(params, state, xs, ys, train=False, key=None)
-        elif kind == "train_step":
-            fn = self._make_train_step()
-        else:
-            raise KeyError(kind)
-        self._jit_cache[kind] = fn
+        fn = self._jit_cache.get(kind)
+        if fn is None:
+            if self._tx is None and kind == "train_step":
+                self._tx = self._build_tx()
+            fn = shared_jit(
+                (type(self).__name__, self._topology_sig(), kind),
+                lambda: _build_graph_fn(self.conf, self._tx, kind),
+                name=kind)
+            self._jit_cache[kind] = fn
         return fn
 
-    def _make_train_step(self):
-        gn_mode = self.conf.defaults.get("gradient_normalization")
-        gn_thr = float(self.conf.defaults.get(
-            "gradient_normalization_threshold", 1.0))
-        cdtype = self.conf.defaults.get("compute_dtype")
-        tx = self._tx
+    def _pad_flags(self):
+        """See ``MultiLayerNetwork._pad_flags``: (row-independent
+        inference, loss-path eval safe, train safe)."""
+        if self._pad_safe is None:
+            from .layers.normalization import BatchNormalization
+            row_indep = eval_safe = train_safe = True
+            for name, v in self.conf.vertices.items():
+                lc = getattr(v, "layer", None)
+                if getattr(lc, "AUX_LOSS", False):
+                    # MoE: padded rows compete for expert capacity AND the
+                    # whole-batch aux term defeats the label mask
+                    row_indep = False
+                if name in self.conf.network_outputs and lc is not None \
+                        and not getattr(lc, "SUPPORTS_LOSS_MASK", True):
+                    eval_safe = False
+                if isinstance(hyperparam_conf(lc) or lc,
+                              BatchNormalization):
+                    train_safe = False
+            eval_safe = eval_safe and row_indep
+            train_safe = train_safe and eval_safe
+            self._pad_safe = (row_indep, eval_safe, train_safe)
+        return self._pad_safe
 
-        def step(params, state, opt_state, key, xs, ys, masks, label_masks):
-            if cdtype is not None:
-                xs = [x.astype(cdtype) for x in xs]
+    def _pad_output_safe(self) -> bool:
+        return self._pad_flags()[0]
 
-            def loss_fn(p):
-                if cdtype is not None:
-                    p = _cast_floats(p, cdtype)
-                loss, new_state = self._loss(p, state, xs, ys, train=True,
-                                             key=key, masks=masks,
-                                             label_masks=label_masks)
-                return loss, new_state
-            (loss, new_state), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(params)
-            confs = self._layer_conf_map()
-            grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
-            gleaves = jax.tree_util.tree_leaves(grads)
-            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
-                if gleaves else jnp.zeros(())
-            glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
-                                      for g in jax.tree_util.tree_leaves(v)))
-                      for k, v in grads.items() if v}
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            new_params = apply_constraints_all(new_params, confs)
-            if cdtype is not None:
-                new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
-            return (new_params, new_state, new_opt, loss,
-                    {"global_norm": gnorm, "layer_norms": glayer})
+    def _pad_eval_safe(self) -> bool:
+        return self._pad_flags()[1]
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+    def _pad_train_safe(self) -> bool:
+        return self._pad_flags()[2]
 
     def _fit_one(self, xs, ys, ms, lms) -> float:
         """One train step (shared by fit's inner loop and fit_batch)."""
@@ -290,6 +397,12 @@ class ComputationGraph:
         lms = None if lms is None else [
             None if m is None else jnp.asarray(m) for m in _as_list(lms)]
         self.last_batch_size = int(xs[0].shape[0])
+        pol = self.shape_policy
+        if pol is not None and pol.enabled and ms is None and \
+                self._pad_train_safe():
+            # ragged batches pad onto an already-compiled bucket; padded
+            # rows carry a zero label mask on EVERY output head
+            xs, ys, lms = pol.pad_multi_batch(xs, ys, lms, path="train")
         step_fn = self._get_jitted("train_step")
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
@@ -446,6 +559,12 @@ class ComputationGraph:
             other.opt_state = copy_tree(self.opt_state)
         else:
             other.init()
+        # split the parent stream per clone (identical dropout masks across
+        # data-parallel replicas would correlate their gradient noise);
+        # the deepcopied conf signs identically, so compiled steps are
+        # reused from the shared trace cache
+        self._rng, other._rng = jax.random.split(self._rng)
+        other.shape_policy = self.shape_policy
         other.iteration = self.iteration
         other.epoch = self.epoch
         return other

@@ -118,7 +118,9 @@ def _fold_mln(net):
         net.conf.layers[i] = repl
         net.params[bkey] = {}
         net.state[bkey] = {}
-    net._jit_cache = {}
+    # conf/layer edits in place: re-sign so the folded net gets its own
+    # shared-cache slot instead of the unfolded topology's programs
+    net.invalidate_compile_cache()
     return net
 
 
@@ -155,5 +157,7 @@ def _fold_graph(net):
         conf.vertices[name] = LayerVertex(layer=_replacement_activation(bn))
         net.params[name] = {}
         net.state[name] = {}
-    net._jit_cache = {}
+    # conf/layer edits in place: re-sign so the folded net gets its own
+    # shared-cache slot instead of the unfolded topology's programs
+    net.invalidate_compile_cache()
     return net

@@ -39,6 +39,10 @@ class Yolo2OutputLayer(LayerConf):
     lambda_no_obj: float = 0.5
 
     INPUT_KIND = "cnn"
+    # the YOLO loss sums over the whole grid and IGNORES the mask argument,
+    # so shape-bucketing must never pad batches through this head
+    # (data/shapes.py gates on this flag)
+    SUPPORTS_LOSS_MASK = False
 
     # ---- shape ----
     def output_type(self, itype: InputType) -> InputType:

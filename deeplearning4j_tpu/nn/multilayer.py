@@ -32,12 +32,14 @@ import optax
 
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, apply_gradient_normalization,
-                      build_tx, fit_on_device_epochs)
+                      build_tx, fit_on_device_epochs, hyperparam_conf)
+from .compile_cache import shared_jit, topology_signature
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
 from ..data.pipeline import ETL_BUCKETS as _ETL_BUCKETS
+from ..data.shapes import _pad_time, default_shape_policy
 from ..observability.clock import monotonic_s
 from ..observability.registry import default_registry
 from ..train.listeners import TrainingListener
@@ -59,6 +61,227 @@ def _on_device(a):
 Array = jax.Array
 
 
+def _layer_confs(conf) -> Dict[str, Any]:
+    return {f"layer_{i}": lc for i, lc in enumerate(conf.layers)}
+
+
+def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
+                   to_layer: Optional[int] = None, collect: bool = False,
+                   carries: Optional[Dict[str, Any]] = None,
+                   return_mask: bool = False):
+    """Trace the layer stack; returns (final_activation_or_list, new_state).
+
+    A free function over the *configuration* — it must never touch a
+    network instance, so the jitted programs built from it can live in the
+    process-global trace cache and serve every equal-topology network
+    (clones, master replicas).
+
+    carries: optional dict of recurrent-layer carries keyed ``layer_i``
+    (tBPTT chunk state / rnnTimeStep streaming state). When given, a dict
+    of the same shape is written back into ``carries`` (callers pass a
+    mutable dict and read the updated entries).
+    """
+    layers = conf.layers
+    n = len(layers) if to_layer is None else to_layer
+    new_state = dict(state)
+    acts = []
+    h = x
+    for i in range(n):
+        lc = layers[i]
+        pp = conf.preprocessor(i)
+        if pp is not None:
+            h = pp.pre_process(h, mask)
+            if mask is not None:
+                itype = conf.layer_input_types[i] if conf.layer_input_types \
+                    else None
+                mask = pp.feed_forward_mask(mask, itype)
+        lkey = jax.random.fold_in(key, i) if key is not None else None
+        variables = {"params": params.get(f"layer_{i}", {}),
+                     "state": state.get(f"layer_{i}", {})}
+        lname = f"layer_{i}"
+        if carries is not None and getattr(lc, "HAS_CARRY", False):
+            h, new_carry = lc.apply_with_carry(
+                variables, h, carries.get(lname), train=train, key=lkey,
+                mask=mask)
+            carries[lname] = new_carry
+            lstate = variables.get("state", {})
+        elif train and conf.defaults.get("cache_mode") == "remat":
+            # rematerialize per-layer activations on the backward pass
+            # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM)
+            def _apply(vv, hh, kk, mm, _lc=lc):
+                return _lc.apply(vv, hh, train=True, key=kk, mask=mm)
+            h, lstate = jax.checkpoint(_apply)(variables, h, lkey, mask)
+        else:
+            h, lstate = lc.apply(variables, h, train=train, key=lkey,
+                                 mask=mask)
+        new_state[lname] = lstate
+        if mask is not None:
+            mask = lc.feed_forward_mask(mask, None)
+        if collect:
+            acts.append(h)
+    out = acts if collect else h
+    if return_mask:
+        return out, new_state, mask
+    return out, new_state
+
+
+def _stack_loss(conf, params, state, x, y, *, train: bool, key, mask=None,
+                label_mask=None, carries=None):
+    """Forward to last layer's loss + regularization (reference
+    computeGradientAndScore, MultiLayerNetwork.java:2206).  Free function
+    over the configuration — see ``_stack_forward``."""
+    layers = conf.layers
+    n = len(layers)
+    h, new_state, pmask = _stack_forward(
+        conf, params, state, x, train=train, key=key, mask=mask,
+        to_layer=n - 1, carries=carries, return_mask=True)
+    out_conf = layers[-1]
+    if not hasattr(out_conf, "compute_loss"):
+        raise ValueError(
+            f"last layer '{out_conf.name}' is not an output layer")
+    pp = conf.preprocessor(n - 1)
+    if pp is not None:
+        h = pp.pre_process(h, mask)
+    lkey = jax.random.fold_in(key, n - 1) if key is not None else None
+    variables = {"params": params.get(f"layer_{n-1}", {}),
+                 "state": state.get(f"layer_{n-1}", {})}
+    # label mask defaults to the PROPAGATED feature mask (reference
+    # per-timestep masking when labelsMask is absent; a LastTimeStep/
+    # global-pooling layer consumes the time axis and nulls the mask)
+    lm = label_mask if label_mask is not None else pmask
+    loss = out_conf.compute_loss(variables, h, y, train=train, key=lkey,
+                                 mask=lm)
+    reg = jnp.zeros(())
+    for i, lc in enumerate(layers):
+        lp = params.get(f"layer_{i}", {})
+        if lp:
+            reg = reg + lc.regularization_score(lp)
+        if getattr(lc, "AUX_LOSS", False):
+            aux = new_state.get(f"layer_{i}", {}).get("aux_loss")
+            if aux is not None:
+                reg = reg + aux
+    return loss + reg, new_state
+
+
+def _build_stack_fn(conf, tx, kind: str):
+    """Build the Python function behind one jitted entry point.
+
+    Returns ``(fun, donate_argnums)``.  Every closure here captures only
+    ``conf``/``tx`` — structural configuration shared by all equal-signature
+    networks — never a network instance, which is what makes the functions
+    safe to place in the process-global trace cache (and is exactly the
+    hazard graftlint JX013 flags).
+    """
+    if kind == "output":
+        def fn(params, state, x):
+            return _stack_forward(conf, params, state, x, train=False,
+                                  key=None)
+        return fn, ()
+    if kind == "output_train":
+        def fn(params, state, x, key):
+            return _stack_forward(conf, params, state, x, train=True,
+                                  key=key)
+        return fn, ()
+    if kind == "score":
+        def fn(params, state, x, y, label_mask):
+            return _stack_loss(conf, params, state, x, y, train=False,
+                               key=None, label_mask=label_mask)
+        return fn, ()
+    if kind == "rnn_time_step":
+        def fn(params, state, x, carries):
+            carries = dict(carries)
+            y, _ = _stack_forward(conf, params, state, x, train=False,
+                                  key=None, carries=carries)
+            return y, carries
+        return fn, ()
+    if kind in ("train_step", "train_step_carry"):
+        return _build_train_step(conf, tx, kind == "train_step_carry"), \
+            (0, 1, 2)
+    raise KeyError(kind)
+
+
+def _build_train_step(conf, tx, with_carry: bool):
+    gn_mode = conf.defaults.get("gradient_normalization")
+    gn_thr = float(conf.defaults.get("gradient_normalization_threshold", 1.0))
+    cdtype = conf.defaults.get("compute_dtype")
+    confs = _layer_confs(conf)
+
+    def step(params, state, opt_state, key, x, y, mask, label_mask,
+             carries=None):
+        if cdtype is not None:
+            x = x.astype(cdtype)
+
+        def loss_fn(p):
+            if cdtype is not None:
+                # mixed precision: cast params for the traced stack;
+                # grads w.r.t. the f32 masters accumulate in f32 (the
+                # cast is part of the differentiated program)
+                p = _cast_floats(p, cdtype)
+            if with_carry:
+                # carry state flows INTO the chunk; gradients do not flow
+                # back across the chunk boundary (tBPTT truncation).
+                cs = dict(jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                                 carries))
+                loss, new_state = _stack_loss(
+                    conf, p, state, x, y, train=True, key=key, mask=mask,
+                    label_mask=label_mask, carries=cs)
+                return loss, (new_state, cs)
+            loss, new_state = _stack_loss(conf, p, state, x, y, train=True,
+                                          key=key, mask=mask,
+                                          label_mask=label_mask)
+            return loss, (new_state, None)
+        (loss, (new_state, new_carries)), grads = \
+            jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
+        # per-iteration gradient stats for listeners (reference
+        # ParamAndGradientIterationListener / StatsListener): computed
+        # inside the same program so they fuse with the update
+        gleaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
+            if gleaves else jnp.zeros(())
+        glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
+                                  for g in jax.tree_util.tree_leaves(v)))
+                  for k, v in grads.items() if v}
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = apply_constraints_all(new_params, confs)
+        if cdtype is not None:
+            # keep running state (BN statistics) in f32 so the step's
+            # input/output treedefs+dtypes stay fixed across iterations
+            new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
+        gstats = {"global_norm": gnorm, "layer_norms": glayer}
+        if with_carry:
+            return (new_params, new_state, new_opt, loss, gstats,
+                    new_carries)
+        return new_params, new_state, new_opt, loss, gstats
+
+    return step
+
+
+def _build_pretrain_step(conf, tx, i: int):
+    """Pretrain step for layer ``i``: the frozen prefix and running state
+    ride in as ARGUMENTS (the old per-call closure baked them in as trace
+    constants — stale after any host-side update, and re-jitted per call)."""
+    lc = conf.layers[i]
+
+    def step(p_i, opt_state, key, x, frozen, state):
+        def loss_fn(pp):
+            feats = x
+            if i > 0:
+                all_p = dict(frozen)
+                all_p[f"layer_{i}"] = pp
+                feats, _ = _stack_forward(conf, all_p, state, x,
+                                          train=False, key=None, to_layer=i)
+            variables = {"params": pp,
+                         "state": state.get(f"layer_{i}", {})}
+            return lc.pretrain_loss(variables, feats, key=key, train=True)
+        loss, grads = jax.value_and_grad(loss_fn)(p_i)
+        updates, new_opt = tx.update(grads, opt_state, p_i)
+        return optax.apply_updates(p_i, updates), new_opt, loss
+
+    return step
+
+
 class MultiLayerNetwork:
     """Sequential network: init → fit/output/score/evaluate."""
 
@@ -76,12 +299,21 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
+        # instance view over the process-global trace cache: holds strong
+        # refs to the shared jitted entries this network uses (the global
+        # cache is weak-valued, so these refs ARE the entries' lifetime)
         self._jit_cache: Dict[Any, Any] = {}
+        self._topo_sig: Optional[str] = None
+        self._pad_safe: Optional[bool] = None
+        self.shape_policy = default_shape_policy()
         self._rnn_carries = None
         self._rnn_carry_batch = -1
-        # first executed train step compiles; the metrics split
-        # (training_step_seconds{phase=compile|steady}) keys off this
-        self._train_step_ran = False
+        # did the most recent train step (re)trace?  Read from the shared
+        # InstrumentedJit after each step: the metrics split
+        # (training_step_seconds{phase=compile|steady}) keys off the REAL
+        # trace events, so a clone's cache-hit first step reads steady and
+        # a mid-fit retrace (new shape/treedef) reads compile
+        self._last_step_traced = False
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -116,100 +348,46 @@ class MultiLayerNetwork:
                  to_layer: Optional[int] = None, collect: bool = False,
                  carries: Optional[Dict[str, Any]] = None,
                  return_mask: bool = False):
-        """Trace the stack; returns (final_activation_or_list, new_state).
-
-        carries: optional dict of recurrent-layer carries keyed ``layer_i``
-        (tBPTT chunk state / rnnTimeStep streaming state). When given, a dict
-        of the same shape is written back into ``carries`` (callers pass a
-        mutable dict and read the updated entries).
-        """
-        n = len(self.layers) if to_layer is None else to_layer
-        new_state = dict(state)
-        acts = []
-        h = x
-        for i in range(n):
-            lc = self.layers[i]
-            pp = self.conf.preprocessor(i)
-            if pp is not None:
-                h = pp.pre_process(h, mask)
-                if mask is not None:
-                    itype = self.conf.layer_input_types[i] if self.conf.layer_input_types else None
-                    mask = pp.feed_forward_mask(mask, itype)
-            lkey = jax.random.fold_in(key, i) if key is not None else None
-            variables = {"params": params.get(f"layer_{i}", {}),
-                         "state": state.get(f"layer_{i}", {})}
-            lname = f"layer_{i}"
-            if carries is not None and getattr(lc, "HAS_CARRY", False):
-                h, new_carry = lc.apply_with_carry(
-                    variables, h, carries.get(lname), train=train, key=lkey,
-                    mask=mask)
-                carries[lname] = new_carry
-                lstate = variables.get("state", {})
-            elif train and self.conf.defaults.get("cache_mode") == "remat":
-                # rematerialize per-layer activations on the backward pass
-                # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM)
-                def _apply(vv, hh, kk, mm, _lc=lc):
-                    return _lc.apply(vv, hh, train=True, key=kk, mask=mm)
-                h, lstate = jax.checkpoint(_apply)(variables, h, lkey, mask)
-            else:
-                h, lstate = lc.apply(variables, h, train=train, key=lkey,
-                                     mask=mask)
-            new_state[lname] = lstate
-            if mask is not None:
-                mask = lc.feed_forward_mask(mask, None)
-            if collect:
-                acts.append(h)
-        out = acts if collect else h
-        if return_mask:
-            return out, new_state, mask
-        return out, new_state
+        """Delegate to the conf-parameterized ``_stack_forward`` (kept as a
+        method for external callers: solvers, gradient checks,
+        TransferLearningHelper)."""
+        return _stack_forward(self.conf, params, state, x, train=train,
+                              key=key, mask=mask, to_layer=to_layer,
+                              collect=collect, carries=carries,
+                              return_mask=return_mask)
 
     def _loss(self, params, state, x, y, *, train: bool, key, mask=None,
               label_mask=None, carries=None):
-        """Forward to last layer's loss + regularization (reference
-        computeGradientAndScore, MultiLayerNetwork.java:2206)."""
-        n = len(self.layers)
-        h, new_state, pmask = self._forward(
-            params, state, x, train=train, key=key, mask=mask,
-            to_layer=n - 1, carries=carries, return_mask=True)
-        out_conf = self.layers[-1]
-        if not hasattr(out_conf, "compute_loss"):
-            raise ValueError(
-                f"last layer '{out_conf.name}' is not an output layer")
-        pp = self.conf.preprocessor(n - 1)
-        if pp is not None:
-            h = pp.pre_process(h, mask)
-        lkey = jax.random.fold_in(key, n - 1) if key is not None else None
-        variables = {"params": params.get(f"layer_{n-1}", {}),
-                     "state": state.get(f"layer_{n-1}", {})}
-        # label mask defaults to the PROPAGATED feature mask (reference
-        # per-timestep masking when labelsMask is absent; a LastTimeStep/
-        # global-pooling layer consumes the time axis and nulls the mask)
-        lm = label_mask if label_mask is not None else pmask
-        loss = out_conf.compute_loss(variables, h, y, train=train, key=lkey,
-                                     mask=lm)
-        reg = jnp.zeros(())
-        for i, lc in enumerate(self.layers):
-            lp = params.get(f"layer_{i}", {})
-            if lp:
-                reg = reg + lc.regularization_score(lp)
-            if getattr(lc, "AUX_LOSS", False):
-                aux = new_state.get(f"layer_{i}", {}).get("aux_loss")
-                if aux is not None:
-                    reg = reg + aux
-        return loss + reg, new_state
+        """Delegate to the conf-parameterized ``_stack_loss``."""
+        return _stack_loss(self.conf, params, state, x, y, train=train,
+                           key=key, mask=mask, label_mask=label_mask,
+                           carries=carries)
 
     # ---------------------------------------------------------- public API
     def output(self, x, train: bool = False) -> Array:
         """Forward pass (reference ``output(INDArray, train)``). train=True
-        keeps stochastic regularization (dropout) active — MC-dropout style."""
+        keeps stochastic regularization (dropout) active — MC-dropout style.
+
+        Inference batches route through the shape policy: a ragged eval
+        batch pads up to an already-compiled bucket and the padded rows are
+        sliced off the result (row-wise inference programs make this
+        value-preserving; ``train=True`` skips padding — stochastic draws
+        and BN batch statistics are shape-dependent)."""
+        x = jnp.asarray(x)
+        pol = self.shape_policy
+        n = -1
+        if not train and pol is not None and pol.enabled and \
+                getattr(x, "ndim", 1) >= 2 and self._pad_output_safe():
+            x, n = pol.pad_eval_rows(x)
         if train:
             fn = self._get_jitted("output_train")
             self._rng, key = jax.random.split(self._rng)
-            y, _ = fn(self.params, self.state, jnp.asarray(x), key)
+            y, _ = fn(self.params, self.state, x, key)
         else:
             fn = self._get_jitted("output")
-            y, _ = fn(self.params, self.state, jnp.asarray(x))
+            y, _ = fn(self.params, self.state, x)
+        if n >= 0 and getattr(y, "shape", (0,))[0] > n:
+            y = y[:n]
         return y
 
     def feed_forward(self, x, train: bool = False) -> List[Array]:
@@ -229,97 +407,81 @@ class MultiLayerNetwork:
             return float(self._score)   # device scalar mid-fit_on_device
         if dataset is not None:
             x, y, _, _ = self._normalize_batch(dataset)
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        lm = None
+        pol = self.shape_policy
+        if pol is not None and pol.enabled and self._pad_eval_safe():
+            # ragged scoring batches ride an already-compiled bucket with
+            # the padded rows masked out of the loss (exact: the masked
+            # mean's denominator counts only rows with mask weight)
+            x, y, lm = pol.pad_score_batch(x, y)
         fn = self._get_jitted("score")
-        loss, _ = fn(self.params, self.state, jnp.asarray(x), jnp.asarray(y))
+        loss, _ = fn(self.params, self.state, x, y, lm)
         return float(loss)
 
+    def _topology_sig(self) -> str:
+        if self._topo_sig is None:
+            self._topo_sig = topology_signature(self.conf)
+        return self._topo_sig
+
+    def invalidate_compile_cache(self) -> "MultiLayerNetwork":
+        """Drop this network's compiled-function views and re-derive its
+        topology signature.  Call after mutating ``conf``/layer confs IN
+        PLACE (transfer-learning fine-tune on a live net, BN folding);
+        builder-style APIs that construct a fresh network need nothing —
+        the edited conf signs differently and lands in its own cache slot.
+        """
+        self._jit_cache = {}
+        self._topo_sig = None
+        self._pad_safe = None
+        return self
+
     def _get_jitted(self, kind: str):
-        if kind in self._jit_cache:
-            return self._jit_cache[kind]
-        if kind == "output":
-            @jax.jit
-            def fn(params, state, x):
-                return self._forward(params, state, x, train=False, key=None)
-        elif kind == "output_train":
-            @jax.jit
-            def fn(params, state, x, key):
-                return self._forward(params, state, x, train=True, key=key)
-        elif kind == "score":
-            @jax.jit
-            def fn(params, state, x, y):
-                return self._loss(params, state, x, y, train=False, key=None)
-        elif kind == "train_step":
-            fn = self._make_train_step()
-        elif kind == "train_step_carry":
-            fn = self._make_train_step(with_carry=True)
-        elif kind == "rnn_time_step":
-            @jax.jit
-            def fn(params, state, x, carries):
-                carries = dict(carries)
-                y, _ = self._forward(params, state, x, train=False, key=None,
-                                     carries=carries)
-                return y, carries
-        else:
-            raise KeyError(kind)
-        self._jit_cache[kind] = fn
+        fn = self._jit_cache.get(kind)
+        if fn is None:
+            if self._tx is None and kind in ("train_step",
+                                             "train_step_carry"):
+                self._tx = self._build_tx()
+            fn = shared_jit(
+                (type(self).__name__, self._topology_sig(), kind),
+                lambda: _build_stack_fn(self.conf, self._tx, kind),
+                name=kind)
+            self._jit_cache[kind] = fn
         return fn
 
-    def _make_train_step(self, with_carry: bool = False):
-        gn_mode = self.conf.defaults.get("gradient_normalization")
-        gn_thr = float(self.conf.defaults.get("gradient_normalization_threshold", 1.0))
-        cdtype = self.conf.defaults.get("compute_dtype")
-        tx = self._tx
+    def _pad_flags(self):
+        if self._pad_safe is None:
+            from .layers.normalization import BatchNormalization
+            # an AUX_LOSS layer (MoE) couples rows even at inference:
+            # padded rows compete for expert CAPACITY, shifting real rows'
+            # routing, and its load-balancing loss term is computed from
+            # the whole batch (the label mask cannot silence padded rows)
+            row_indep = all(not getattr(lc, "AUX_LOSS", False)
+                            for lc in self.layers)
+            eval_safe = row_indep and (
+                not self.layers or getattr(self.layers[-1],
+                                           "SUPPORTS_LOSS_MASK", True))
+            # BatchNorm additionally trains on batch statistics, which
+            # padded rows would perturb (eval uses running stats: safe)
+            train_safe = eval_safe and all(
+                not isinstance(hyperparam_conf(lc) or lc,
+                               BatchNormalization) for lc in self.layers)
+            self._pad_safe = (row_indep, eval_safe, train_safe)
+        return self._pad_safe
 
-        def step(params, state, opt_state, key, x, y, mask, label_mask,
-                 carries=None):
-            if cdtype is not None:
-                x = x.astype(cdtype)
+    def _pad_output_safe(self) -> bool:
+        """output() padding only needs row-independent inference."""
+        return self._pad_flags()[0]
 
-            def loss_fn(p):
-                if cdtype is not None:
-                    # mixed precision: cast params for the traced stack;
-                    # grads w.r.t. the f32 masters accumulate in f32 (the
-                    # cast is part of the differentiated program)
-                    p = _cast_floats(p, cdtype)
-                if with_carry:
-                    # carry state flows INTO the chunk; gradients do not flow
-                    # back across the chunk boundary (tBPTT truncation).
-                    cs = dict(jax.tree_util.tree_map(jax.lax.stop_gradient, carries))
-                    loss, new_state = self._loss(p, state, x, y, train=True,
-                                                 key=key, mask=mask,
-                                                 label_mask=label_mask, carries=cs)
-                    return loss, (new_state, cs)
-                loss, new_state = self._loss(p, state, x, y, train=True, key=key,
-                                             mask=mask, label_mask=label_mask)
-                return loss, (new_state, None)
-            (loss, (new_state, new_carries)), grads = \
-                jax.value_and_grad(loss_fn, has_aux=True)(params)
-            confs = self._layer_conf_map()
-            grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
-            # per-iteration gradient stats for listeners (reference
-            # ParamAndGradientIterationListener / StatsListener): computed
-            # inside the same program so they fuse with the update
-            gleaves = jax.tree_util.tree_leaves(grads)
-            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
-                if gleaves else jnp.zeros(())
-            glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
-                                      for g in jax.tree_util.tree_leaves(v)))
-                      for k, v in grads.items() if v}
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            new_params = apply_constraints_all(new_params, confs)
-            if cdtype is not None:
-                # keep running state (BN statistics) in f32 so the step's
-                # input/output treedefs+dtypes stay fixed across iterations
-                new_state = _cast_floats(new_state, jnp.float32,
-                                         only=cdtype)
-            gstats = {"global_norm": gnorm, "layer_norms": glayer}
-            if with_carry:
-                return (new_params, new_state, new_opt, loss, gstats,
-                        new_carries)
-            return new_params, new_state, new_opt, loss, gstats
+    def _pad_eval_safe(self) -> bool:
+        """Loss-path (score) padding additionally needs a mask-honoring
+        head — see data/shapes.py."""
+        return self._pad_flags()[1]
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+    def _pad_train_safe(self) -> bool:
+        """Training padding additionally requires no cross-batch layers
+        (BatchNorm batch statistics)."""
+        return self._pad_flags()[2]
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
             mask=None, label_mask=None) -> "MultiLayerNetwork":
@@ -407,7 +569,6 @@ class MultiLayerNetwork:
                     break
                 x, y, m, lm = batch
                 self.last_batch_size = int(getattr(x, "shape", (0,))[0])
-                compile_step = not self._train_step_ran
                 t_step = monotonic_s()
                 if self.conf.backprop_type == "tbptt" and \
                         getattr(x, "ndim", 2) == 3 and \
@@ -415,6 +576,7 @@ class MultiLayerNetwork:
                     self._fit_tbptt(step_fn, x, y, m, lm)
                 else:
                     self._fit_one(x, y, m, lm)
+                compile_step = self._last_step_traced
                 if obs:
                     dt = monotonic_s() - t_step
                     step_h.labels("compile" if compile_step
@@ -480,6 +642,13 @@ class MultiLayerNetwork:
         """
         del step_fn  # tbptt uses the carry-aware step
         step = self._get_jitted("train_step_carry")
+        pol = self.shape_policy
+        pad_on = pol is not None and pol.enabled and self._pad_train_safe()
+        if pad_on:
+            # batch-axis bucketing (ragged epoch tails) before chunking;
+            # time-axis chunk padding happens per-chunk below
+            x, y, mask, label_mask = pol.pad_train_batch(
+                x, y, mask, label_mask, path="tbptt")
         L = self.conf.tbptt_fwd_length
         T = x.shape[1]
         batch = x.shape[0]
@@ -491,16 +660,43 @@ class MultiLayerNetwork:
         y = _on_device(y)
         mask = _on_device(mask)
         label_mask = _on_device(label_mask)
+        from .layers.recurrent import Bidirectional
+        # a backward-direction RNN would consume the padded timesteps FIRST,
+        # polluting state that reaches every real timestep — never pad
+        # bidirectional chunks
+        pad_tail = pad_on and T % L != 0 and not any(
+            isinstance(lc, Bidirectional) for lc in self.layers)
+        traced = False
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
             xm = None if mask is None else mask[:, sl]
             ym = None if label_mask is None else label_mask[:, sl]
             yc = y[:, sl] if getattr(y, "ndim", 2) == 3 else y
+            if pad_tail and sl.stop - sl.start < L:
+                # final short chunk pads to the chunk length L so every
+                # T hits the ONE compiled chunk program: padded timesteps
+                # are zero in data AND feature mask, so the propagated
+                # mask excludes them from the loss; this is the last
+                # chunk, so the polluted carry is never consumed
+                pad = L - (sl.stop - sl.start)
+                xc_len = sl.stop - sl.start
+                xm = xm if xm is not None else jnp.ones(
+                    (batch, xc_len), jnp.float32)
+                xm = _pad_time(xm, pad)
+                if ym is not None and getattr(ym, "ndim", 1) == 2:
+                    ym = _pad_time(ym, pad)
+                xc = _pad_time(x[:, sl], pad)
+                if getattr(yc, "ndim", 2) == 3:
+                    yc = _pad_time(yc, pad)
+                x_chunk = xc
+            else:
+                x_chunk = x[:, sl]
             self._rng, key = jax.random.split(self._rng)
             (self.params, self.state, self.opt_state, loss, gstats,
              carries) = step(
                 self.params, self.state, self.opt_state, key,
-                x[:, sl], yc, xm, ym, carries)
+                x_chunk, yc, xm, ym, carries)
+            traced = traced or step.last_call_traced
             # device scalar inside the chunk loop: a float() here would
             # host-sync every chunk, serializing tBPTT windows against
             # dispatch RTT; listeners reading get_score() materialize it
@@ -511,7 +707,7 @@ class MultiLayerNetwork:
                 lst.iteration_done(self, self.iteration, self.epoch)
         # one sync per batch, so deferred device failures surface in fit
         self._score = float(self._score)
-        self._train_step_ran = True
+        self._last_step_traced = traced
 
     def _init_carries(self, batch: int):
         """Zero carries for every recurrent layer (keyed ``layer_i``)."""
@@ -552,24 +748,16 @@ class MultiLayerNetwork:
         lname = f"layer_{i}"
         opt = tx.init(self.params[lname])
         frozen = {k: v for k, v in self.params.items() if k != lname}
-
-        @jax.jit
-        def step(p_i, opt_state, key, x):
-            def loss_fn(pp):
-                feats = x
-                if i > 0:
-                    all_p = dict(frozen)
-                    all_p[lname] = pp
-                    feats, _ = self._forward(all_p, self.state, x,
-                                             train=False, key=None,
-                                             to_layer=i)
-                variables = {"params": pp,
-                             "state": self.state.get(lname, {})}
-                return lc.pretrain_loss(variables, feats, key=key, train=True)
-            loss, grads = jax.value_and_grad(loss_fn)(p_i)
-            updates, new_opt = tx.update(grads, opt_state, p_i)
-            return optax.apply_updates(p_i, updates), new_opt, loss
-
+        # shared-cache entry: the step closes over conf/tx only; the frozen
+        # prefix and running state ride as ARGUMENTS (the old closure baked
+        # them in as trace constants AND re-jitted per pretrain_layer call)
+        step = self._jit_cache.get(f"pretrain_{i}")
+        if step is None:
+            step = shared_jit(
+                (type(self).__name__, self._topology_sig(), "pretrain", i),
+                lambda: (_build_pretrain_step(self.conf, tx, i), ()),
+                name=f"pretrain_{i}")
+            self._jit_cache[f"pretrain_{i}"] = step
         p_i = self.params[lname]
         if epochs > 1 and not hasattr(data, "shape") and \
                 not isinstance(data, (tuple, list)) and \
@@ -583,7 +771,8 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for batch in self._pretrain_batches(data):
                 self._rng, key = jax.random.split(self._rng)
-                p_i, opt, loss = step(p_i, opt, key, jnp.asarray(batch))
+                p_i, opt, loss = step(p_i, opt, key, jnp.asarray(batch),
+                                      frozen, self.state)
                 self._score = float(loss)
                 self.iteration += 1
                 for lst in self.listeners:
@@ -610,13 +799,20 @@ class MultiLayerNetwork:
     def _fit_one(self, x, y, m, lm) -> float:
         """One train step (shared by fit's inner loop and fit_batch)."""
         step_fn = self._get_jitted("train_step")
+        pol = self.shape_policy
+        if pol is not None and pol.enabled and self._pad_train_safe():
+            # ragged batches (partial epoch tails) pad onto an
+            # already-compiled bucket; padded rows are loss-masked so the
+            # step is numerically the unpadded one (data/shapes.py)
+            x, y, m, lm = pol.pad_train_batch(x, y, m, lm)
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key,
             _on_device(x), _on_device(y), _on_device(m), _on_device(lm))
         self._score = float(loss)
         self._last_grad_stats = gstats
-        self._train_step_ran = True
+        self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
+                                              False))
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
@@ -757,6 +953,13 @@ class MultiLayerNetwork:
             other.opt_state = copy_tree(self.opt_state)
         else:
             other.init()
+        # split the parent stream per clone: giving every replica the
+        # conf-seed key would make data-parallel workers draw IDENTICAL
+        # dropout masks/shuffles (correlated noise defeats the averaging)
+        self._rng, other._rng = jax.random.split(self._rng)
+        # deepcopied conf signs identically, so the clone's first step
+        # reuses the parent's compiled executables from the shared cache
+        other.shape_policy = self.shape_policy
         other.iteration = self.iteration
         other.epoch = self.epoch
         return other

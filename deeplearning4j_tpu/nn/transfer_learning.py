@@ -5,6 +5,14 @@ GraphBuilder), ``FineTuneConfiguration.java``, ``TransferLearningHelper.java``.
 Functional-pytree twist: "copying params" is just re-keying array leaves into
 the new net's tree; freezing is the FrozenLayer wrapper (stop_gradient +
 optax.set_to_zero — see nn/layers/misc.py).
+
+Compile-cache interaction: the builders deep-copy the source conf and apply
+every edit (fine-tune overrides, nOutReplace, freezing) BEFORE constructing
+the new network, so the edited topology signs differently and lands in its
+own slot of the process-global trace cache (nn/compile_cache) — the source
+net keeps its compiled programs.  Anyone mutating a LIVE net's conf/layer
+confs directly must call ``net.invalidate_compile_cache()`` afterwards, or
+the net keeps executing the pre-edit programs.
 """
 from __future__ import annotations
 

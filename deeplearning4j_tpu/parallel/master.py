@@ -167,9 +167,12 @@ class TrainingMaster:
 
     def _get_replicas(self, model) -> List[Any]:
         """Replica pool: clone once per master+model, refresh params from
-        the (possibly updated) master model on later calls — re-cloning
-        every fit would re-trace every replica's jitted step (the reference
-        re-broadcasts params per split, it does not rebuild workers)."""
+        the (possibly updated) master model on later calls (the reference
+        re-broadcasts params per split, it does not rebuild workers).
+        Clones share the process-global trace cache (nn/compile_cache):
+        every replica executes the ONE compiled train step — replica K's
+        time-to-first-step is dispatch, not an XLA compile — and each
+        clone draws an independent RNG stream (decorrelated dropout)."""
         if (getattr(self, "_replicas", None) is None
                 or self._replica_src is not model
                 or len(self._replicas) != self.num_workers):

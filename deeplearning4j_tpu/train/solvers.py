@@ -166,8 +166,12 @@ class _BaseFullBatchOptimizer:
         value_and_grad = jax.value_and_grad(loss_flat)
         sign = self.step_function.sign
 
+        # legacy full-batch solver: the step dispatches on solver-subclass
+        # methods and bakes the (single, full) batch in as a constant, so a
+        # per-optimize() trace is the program — there is no steady-state
+        # step to share across instances
         @jax.jit
-        def step(flat, f, g, opt_state):
+        def step(flat, f, g, opt_state):  # graftlint: disable=JX013  (cold path, per-call program)
             d, opt_state = self.direction(g, opt_state)
             d = sign * d
             alpha, f_new = self.line_search.search(loss_flat, flat, f, g, d)

@@ -481,3 +481,72 @@ def tunnel_probe(n: int = 5) -> Dict:
         and (compute_tflops is None
              or compute_tflops > PROBE_COMPUTE_HEALTHY_TFLOPS))
     return probe
+
+
+def compile_reuse(hidden: int = 64, features: int = 16, classes: int = 5,
+                  batch: int = 32) -> Dict:
+    """Compilation-reuse benchmark (ISSUE 4): cold first-step compile vs a
+    ``clone()``'s first step through the shared trace cache, plus the
+    compile count of a ragged-last-batch ``fit`` under shape bucketing.
+
+    The headline ``value`` is the clone-reuse speedup (cold first-step
+    wall time / clone first-step wall time): >> 1 means replica K's
+    time-to-first-step is dispatch, not an XLA compile.  ``_fit_one``
+    host-syncs the loss, so both step timings close on device completion.
+    """
+    import jax.numpy as jnp
+
+    from .. import (InputType, MultiLayerNetwork, NeuralNetConfiguration)
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..observability.registry import default_registry
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Adam(learning_rate=0.01)).list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    reg = default_registry()
+
+    def train_step_compiles() -> float:
+        c = reg.get("training_compile_total")
+        return 0.0 if c is None else c.labels("train_step").value
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, features),
+                                        dtype=np.float32))
+    y = jnp.asarray(np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, batch)])
+
+    model = build()
+    t0 = monotonic_s()
+    model.fit_batch((x, y))                     # cold: trace + compile
+    cold_s = monotonic_s() - t0
+
+    replica = model.clone()
+    before = train_step_compiles()
+    t0 = monotonic_s()
+    replica.fit_batch((x, y))                   # shared-cache reuse
+    clone_s = monotonic_s() - t0
+    clone_compiles = train_step_compiles() - before
+
+    # ragged last batch: the tail pads onto the steady bucket, so the
+    # whole fit costs at most one extra (label-masked) compile
+    tail = max(1, batch // 3)
+    before = train_step_compiles()
+    model.fit(iter([(x, y, None, None),
+                    (x[:tail], y[:tail], None, None)]))
+    ragged_compiles = train_step_compiles() - before
+
+    speedup = cold_s / max(clone_s, 1e-9)
+    return {"metric": "compile_reuse", "value": round(speedup, 1),
+            "unit": "x cold/clone first-step",
+            "cold_first_step_ms": round(cold_s * 1e3, 1),
+            "clone_first_step_ms": round(clone_s * 1e3, 1),
+            "clone_extra_compiles": clone_compiles,
+            "ragged_fit_compiles": ragged_compiles}

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX012 has at least one fixture that MUST fire and one
+Every rule JX001–JX013 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -531,6 +531,71 @@ def test_jx012_pragma_suppresses():
     """)
 
 
+# ---------------------------------------------------------------- JX013
+def test_jx013_positive_method_local_jit_closes_over_self():
+    assert "JX013" in rules_of("""
+        import jax
+
+        class Net:
+            def make_step(self):
+                def step(params, x):
+                    return params * self.scale + x
+                return jax.jit(step)
+    """)
+
+
+def test_jx013_positive_decorated_def_inside_method():
+    assert "JX013" in rules_of("""
+        import jax
+
+        class Net:
+            def fit(self, x):
+                @jax.jit
+                def step(p):
+                    return self.forward(p, x)
+                return step(self.params)
+    """)
+
+
+def test_jx013_positive_lambda_argument():
+    assert "JX013" in rules_of("""
+        import jax
+
+        class Net:
+            def make(self):
+                return jax.jit(lambda x: x * self.scale)
+    """)
+
+
+def test_jx013_negative_self_free_closure_and_module_level():
+    assert "JX013" not in rules_of("""
+        import jax
+
+        def build_step(conf, tx):
+            def step(params, x):
+                return params * conf.scale + tx(x)
+            return jax.jit(step)
+
+        class Net:
+            def make_step(self):
+                conf = self.conf
+                def step(params, x):       # closes over conf, NOT self
+                    return params * conf.scale + x
+                return jax.jit(step)
+    """)
+
+
+def test_jx013_negative_jit_outside_methods():
+    assert "JX013" not in rules_of("""
+        import jax
+
+        def helper(f):
+            def step(x):
+                return f(x)
+            return jax.jit(step)
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -650,7 +715,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 12
+    assert len(RULES) == 13
 
 
 def test_package_is_clean_modulo_baseline():

@@ -1,4 +1,4 @@
-"""graftlint rule implementations JX001–JX010.
+"""graftlint rule implementations JX001–JX013.
 
 Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
 registered in ``RULES``.  Rules share the jit-scope + taint machinery in
@@ -578,6 +578,114 @@ def jx012(info: ModuleInfo) -> List[Finding]:
                     "device->host fetch every iteration, serializing the "
                     "loop against transfer RTT — keep the value on device "
                     "and materialize once after the loop"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX013
+@rule("JX013", "jax.jit inside an instance method over a function closing "
+               "over self (per-instance retrace hazard)")
+def jx013(info: ModuleInfo) -> List[Finding]:
+    """Flag ``jax.jit(...)`` constructed inside an instance method when the
+    traced function closes over ``self``: the jitted callable (and its
+    compile cache) is then rebuilt per instance — every ``clone()`` /
+    master replica re-traces an identical program, and per-call closures
+    defeat jit's cache entirely.  Key the step by structural config in a
+    process-global cache instead (``nn/compile_cache.shared_jit``) and pass
+    params/state as arguments.  Functions that only take ``self``-free
+    closures (module-level builders over a conf) stay legal, as does jit
+    outside methods."""
+    out: List[Finding] = []
+
+    def enclosing_self_method(node: ast.AST) -> Optional[ast.AST]:
+        """Innermost-to-outermost: any enclosing FunctionDef that is a
+        class method with a ``self`` first parameter."""
+        cur = info.enclosing_function(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = [a.arg for a in (list(cur.args.posonlyargs)
+                                        + list(cur.args.args))]
+                if args[:1] == ["self"] and isinstance(info.parent(cur),
+                                                       ast.ClassDef):
+                    return cur
+            cur = info.enclosing_function(cur)
+        return None
+
+    def closes_over_self(func: ast.AST) -> bool:
+        """Does this function reference ``self`` as a FREE variable
+        (not one of its own / a nested function's parameters)?"""
+        own = {a.arg for a in (list(func.args.posonlyargs)
+                               + list(func.args.args)
+                               + list(func.args.kwonlyargs))}
+        if "self" in own:
+            return False
+        body = func.body if not isinstance(func, ast.Lambda) \
+            else [func.body]
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                params = {a.arg for a in (list(n.args.posonlyargs)
+                                          + list(n.args.args)
+                                          + list(n.args.kwonlyargs))}
+                if "self" not in params:
+                    stack.extend(ast.iter_child_nodes(n))
+                continue
+            if isinstance(n, ast.Name) and n.id == "self":
+                return True
+            stack.extend(ast.iter_child_nodes(n))
+        return False
+
+    def local_def(name: str, at: ast.AST) -> Optional[ast.AST]:
+        """Resolve ``name`` to a FunctionDef in the enclosing function
+        scopes of ``at``, innermost first."""
+        cur = info.enclosing_function(at)
+        while cur is not None:
+            for n in ast.walk(cur):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == name \
+                        and info.enclosing_function(n) is cur:
+                    return n
+            cur = info.enclosing_function(cur)
+        return None
+
+    msg = ("`jax.jit` over a function closing over `self` inside an "
+           "instance method: the jitted callable is per-instance, so every "
+           "clone/replica re-traces an identical program — build the traced "
+           "function from structural config (conf/tx) and cache it in the "
+           "process-global trace cache (nn/compile_cache.shared_jit)")
+
+    # call form: jax.jit(f, ...) / jit(f) / partial(jax.jit, ...)
+    for node in ast.walk(info.tree):
+        if not (isinstance(node, ast.Call) and info.is_jit_call(node)):
+            continue
+        if enclosing_self_method(node) is None:
+            continue
+        cands: List[ast.AST] = list(node.args[:1])
+        for kw in node.keywords:
+            if kw.arg in ("fun", "f"):
+                cands.append(kw.value)
+        for cand in cands:
+            target = None
+            if isinstance(cand, ast.Lambda):
+                target = cand
+            elif isinstance(cand, ast.Name):
+                target = local_def(cand.id, node)
+            if target is not None and closes_over_self(target):
+                out.append(_finding(info, node, "JX013", msg))
+                break
+
+    # decorator form: @jax.jit on a def nested inside a self-method
+    for node in ast.walk(info.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(info.is_jit_ref(d) or info.is_jit_call(d)
+                   for d in node.decorator_list):
+            continue
+        if enclosing_self_method(node) is None:
+            continue
+        if closes_over_self(node):
+            out.append(_finding(info, node, "JX013", msg))
     return _dedupe(out)
 
 
