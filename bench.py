@@ -28,6 +28,12 @@ A third JSON line records the compilation-reuse benchmark
 through the shared trace cache, plus the compile count of a
 ragged-last-batch fit under shape bucketing) so compile-cost regressions
 are tracked round over round; DL4J_TPU_BENCH_COMPILE=0 suppresses it.
+
+A fourth JSON line records the checkpointing-overhead benchmark
+(``checkpoint_overhead``: per-save training stall sync vs async through
+the faulttolerance CheckpointManager, plus committed bytes and write
+rate) so checkpoint-cost regressions are driver-visible;
+DL4J_TPU_BENCH_CKPT=0 suppresses it.
 """
 import json
 import os
@@ -173,6 +179,18 @@ def main():
                               "unit": "x cold/clone first-step",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # checkpoint-overhead row (ISSUE 5): sync vs async save stall per
+    # step + write rate; a fourth JSON line, opt-out DL4J_TPU_BENCH_CKPT=0
+    if os.environ.get("DL4J_TPU_BENCH_CKPT", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                checkpoint_overhead
+            print(json.dumps(checkpoint_overhead()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "checkpoint_overhead", "value": None,
+                              "unit": "ms/save async stall (idle writer)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -262,6 +280,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # compilation reuse (ISSUE 4): cold vs clone first step + bucketed
         # ragged-fit compile count
         B.compile_reuse,
+        # checkpointing overhead (ISSUE 5): sync vs async save stall +
+        # committed-bytes write rate
+        B.checkpoint_overhead,
     ]
     side = []
     for fn in captures:

@@ -117,6 +117,29 @@ class ShapePolicy:
             bigger = [s for s in seen if s >= n] if seen else []
         return min(bigger) if bigger else n
 
+    # ------------------------------------------------- checkpoint support
+    def snapshot(self) -> Dict:
+        """JSON-serializable view of the dispatched-size history
+        (``faulttolerance`` checkpoints carry it so a resumed run makes
+        the same padding decisions — and hits the same compiled shapes —
+        as the uninterrupted one)."""
+        with self._lock:
+            return {"mode": self.mode,
+                    "batch_buckets": self.batch_buckets,
+                    "time_buckets": self.time_buckets,
+                    "seen": [[path, axis, sorted(sizes)]
+                             for (path, axis), sizes
+                             in sorted(self._seen.items())]}
+
+    def restore_state(self, snap: Dict) -> None:
+        """Merge a :meth:`snapshot`'s dispatched-size history back in
+        (mode/ladders stay as configured — only the auto-mode bucket
+        history is resume state)."""
+        with self._lock:
+            for path, axis, sizes in snap.get("seen", []):
+                self._seen.setdefault((str(path), str(axis)), set()).update(
+                    int(s) for s in sizes)
+
     def observe(self, path: str, n: int, axis: str = "batch") -> None:
         """Record a dispatched size so later smaller batches pad up to it
         (``auto`` mode); other modes derive targets from the ladder."""

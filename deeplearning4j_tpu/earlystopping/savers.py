@@ -27,7 +27,13 @@ class InMemoryModelSaver:
 
 
 class LocalFileModelSaver:
-    """Zip checkpoints on disk (reference ``LocalFileModelSaver.java``)."""
+    """Zip checkpoints on disk (reference ``LocalFileModelSaver.java``).
+
+    Writes go through ``model_serializer.write_model``, which commits via
+    the atomic temp-then-rename helper (``faulttolerance/atomic.py``): the
+    frequent ``save_latest_model`` overwrite can never leave a truncated
+    ``latestModel.zip`` behind a crash — readers always see the previous
+    complete save or the new one."""
 
     def __init__(self, directory: str):
         self.directory = directory

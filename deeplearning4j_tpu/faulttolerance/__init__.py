@@ -1,0 +1,29 @@
+"""Fault tolerance: crash-consistent checkpoints, exact resume, worker
+recovery.
+
+Three pillars (TensorFlow's production posture — PAPERS.md 1605.08695:
+cheap periodic checkpointing + automatic recovery, not per-op
+reliability):
+
+- :mod:`.atomic` — temp-then-rename commits with fsync + per-file
+  checksums: the single write path for durable state (model zips,
+  checkpoint directories); graftlint JX014 flags bypasses.
+- :mod:`.checkpoint` — :class:`CheckpointManager` (durable store:
+  manifest checksums, background double-buffered saves, retention,
+  corrupt-checkpoint skipping) and the ``fit(checkpoint=, resume_from=)``
+  integration for exact preemption-safe resume.
+- :mod:`.faults` — :class:`FaultInjector` (seeded, deterministic worker
+  fault harness) and :class:`RetryPolicy` (exponential backoff + jitter)
+  behind the training masters' retry / straggler-timeout / elastic
+  degradation machinery.
+"""
+from .atomic import atomic_file, atomic_write_bytes, atomic_write_json
+from .checkpoint import (CheckpointConfig, CheckpointManager,
+                         CorruptCheckpointError, FitCheckpointer,
+                         resume_network)
+from .faults import FaultInjector, InjectedWorkerFault, RetryPolicy
+
+__all__ = ["atomic_file", "atomic_write_bytes", "atomic_write_json",
+           "CheckpointConfig", "CheckpointManager", "CorruptCheckpointError",
+           "FitCheckpointer", "resume_network",
+           "FaultInjector", "InjectedWorkerFault", "RetryPolicy"]

@@ -1,0 +1,132 @@
+"""Deterministic fault injection + retry policy for the training masters.
+
+The reference recovers lost Spark partitions by lineage re-execution;
+our thread-based masters (``parallel/master.py``) need the same property
+— and a way to PROVE it.  :class:`FaultInjector` is a seeded, fully
+deterministic test harness the masters consult at batch boundaries:
+
+- ``fail(worker, rnd, times)``   raise before the round's first batch on
+  the next ``times`` attempts (``times=-1``: permanently);
+- ``delay(worker, rnd, seconds)`` sleep before the round's first batch
+  (straggler simulation, drives the master's straggler timeout);
+- ``drop(worker, rnd, times)``   complete the round's work but discard
+  the result (the master treats a dropped result as a failed attempt and
+  retries from the round-start snapshot).
+
+Optionally ``fail_rate`` injects seeded random failures for soak-style
+tests; everything is reproducible from the seed.
+
+:class:`RetryPolicy` owns the per-worker retry budget and seeded
+exponential backoff with jitter (decorrelated sleeps so N workers
+retrying the same dead dependency don't stampede in lockstep).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FaultInjector", "InjectedWorkerFault", "RetryPolicy"]
+
+
+class InjectedWorkerFault(RuntimeError):
+    """Raised by FaultInjector in a worker's execution path."""
+
+    def __init__(self, worker: int, rnd: int, kind: str):
+        self.worker, self.rnd, self.kind = worker, rnd, kind
+        super().__init__(
+            f"injected {kind}: worker {worker}, round {rnd}")
+
+
+class FaultInjector:
+    """Deterministic fault plans keyed by (worker, round); thread-safe by
+    construction (each plan entry is consumed by exactly one worker)."""
+
+    def __init__(self, seed: int = 0, fail_rate: float = 0.0):
+        self.seed = seed
+        self.fail_rate = float(fail_rate)
+        self._rng = np.random.default_rng(seed)
+        self._fail: Dict[Tuple[int, int], int] = {}
+        self._delay: Dict[Tuple[int, int], float] = {}
+        self._drop: Dict[Tuple[int, int], int] = {}
+        self.events: List[Tuple[str, int, int]] = []   # (kind, worker, rnd)
+
+    # ------------------------------------------------------------- plans
+    def fail(self, worker: int, rnd: int, times: int = 1) -> "FaultInjector":
+        """Worker ``worker`` raises at the start of round ``rnd`` for the
+        next ``times`` attempts (-1 = every attempt: a permanent loss)."""
+        self._fail[(worker, rnd)] = times
+        return self
+
+    def delay(self, worker: int, rnd: int, seconds: float) -> "FaultInjector":
+        """Worker ``worker`` sleeps ``seconds`` before round ``rnd``'s
+        first batch (every attempt) — straggler simulation."""
+        self._delay[(worker, rnd)] = float(seconds)
+        return self
+
+    def drop(self, worker: int, rnd: int, times: int = 1) -> "FaultInjector":
+        """Worker ``worker`` completes round ``rnd`` but its result is
+        discarded for the next ``times`` attempts."""
+        self._drop[(worker, rnd)] = times
+        return self
+
+    # ------------------------------------------------------------- hooks
+    def on_batch(self, worker: int, rnd: int, batch_index: int) -> None:
+        """Master-side hook before each batch of a worker's round chunk.
+        First-batch position carries the planned fault/delay."""
+        if batch_index != 0:
+            return
+        key = (worker, rnd)
+        delay = self._delay.get(key)
+        if delay:
+            self.events.append(("delay", worker, rnd))
+            time.sleep(delay)
+        n = self._fail.get(key, 0)
+        if n != 0:
+            if n > 0:
+                self._fail[key] = n - 1
+            self.events.append(("fail", worker, rnd))
+            raise InjectedWorkerFault(worker, rnd, "failure")
+        if self.fail_rate and self._rng.random() < self.fail_rate:
+            self.events.append(("fail", worker, rnd))
+            raise InjectedWorkerFault(worker, rnd, "random failure")
+
+    def should_drop(self, worker: int, rnd: int) -> bool:
+        """Master-side hook after a worker finishes its round chunk."""
+        key = (worker, rnd)
+        n = self._drop.get(key, 0)
+        if n == 0:
+            return False
+        if n > 0:
+            self._drop[key] = n - 1
+        self.events.append(("drop", worker, rnd))
+        return True
+
+
+class RetryPolicy:
+    """Per-worker retry budget + seeded exponential backoff with jitter.
+
+    Delay for attempt ``k`` (1-based) is ``base * 2**(k-1) * u`` with
+    ``u ~ Uniform(0.5, 1.5)`` drawn from a seeded stream — bounded, and
+    decorrelated across workers/attempts.
+    """
+
+    def __init__(self, max_retries: int = 2, backoff_s: float = 0.05,
+                 max_backoff_s: float = 5.0, seed: int = 0):
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self._rng = np.random.default_rng(seed)
+
+    def backoff(self, attempt: int) -> float:
+        """Jittered delay (seconds) before retry ``attempt`` (1-based)."""
+        base = self.backoff_s * (2.0 ** max(attempt - 1, 0))
+        return float(min(base * self._rng.uniform(0.5, 1.5),
+                         self.max_backoff_s))
+
+    def sleep(self, attempt: int, sleep=time.sleep) -> float:
+        d = self.backoff(attempt)
+        if d > 0:
+            sleep(d)
+        return d

@@ -154,7 +154,7 @@ def _cast_floats(tree, dtype, only=None):
 
 
 def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
-                         shuffle: bool, call_step, fit_tail):
+                         shuffle: bool, call_step, fit_tail, ckpt=None):
     """Shared device-resident epoch trainer behind
     ``MultiLayerNetwork.fit_on_device`` / ``ComputationGraph.fit_on_device``.
 
@@ -164,8 +164,23 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
     HBM-bound feature).  ``xs``/``ys``: lists of device arrays.
     ``call_step(p, s, o, key, bx, by)`` adapts the model's jitted train step
     to list-shaped batches; ``fit_tail(xt, yt)`` trains the ragged tail via
-    the normal per-batch path.
+    the normal per-batch path.  ``ckpt`` (a ``faulttolerance``
+    ``FitCheckpointer``) adds epoch-boundary checkpoint saves + resume —
+    it pins the per-epoch path (the fused program has no epoch
+    boundaries) and offsets the epoch loop by the restored cursor.
     """
+    try:
+        return _fit_on_device_epochs(model, xs, ys, batch_size, epochs,
+                                     shuffle, call_step, fit_tail, ckpt)
+    finally:
+        # every exit — validation raises included — must uninstall the
+        # checkpointer's SIGTERM hook and join its in-flight write
+        if ckpt is not None:
+            ckpt.close()
+
+
+def _fit_on_device_epochs(model, xs, ys, batch_size, epochs, shuffle,
+                          call_step, fit_tail, ckpt):
     n = int(xs[0].shape[0])
     for a in list(xs) + list(ys):
         if int(a.shape[0]) != n:
@@ -219,7 +234,13 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
     # device and inner-scans the train step, so the inter-epoch dispatch
     # and its host work vanish entirely.  Per-epoch listeners or a tail
     # keep the per-epoch loop below (async dispatch still pipelines it).
-    fuse = epochs > 1 and used == n and not model.listeners
+    fuse = epochs > 1 and used == n and not model.listeners \
+        and (ckpt is None or ckpt.manager is None)
+    if ckpt is not None and ckpt.start_epoch:
+        # resumed run: the restored cursor says this many epochs already
+        # landed in the checkpoint — run only the remainder
+        epochs = max(epochs - ckpt.start_epoch, 0)
+        fuse = False
     if fuse:
         fused_key = ("epochs_scan", nb, batch_size, epochs, shuffle,
                      tuple(a.shape[1:] for a in xs),
@@ -277,7 +298,7 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
             model.epoch += epochs
         else:
             _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size,
-                        shuffle, fn, fit_tail)
+                        shuffle, fn, fit_tail, ckpt)
     except BaseException:
         # aborted fit: best-effort coercion so _score can't stay a device
         # scalar, but the original error keeps propagating
@@ -296,8 +317,9 @@ def fit_on_device_epochs(model, xs, ys, batch_size: int, epochs: int,
 
 
 def _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
-                fn, fit_tail):
-    for _ in range(epochs):
+                fn, fit_tail, ckpt=None):
+    epoch0 = ckpt.start_epoch if ckpt is not None else 0
+    for ep in range(epochs):
         for lst in model.listeners:
             lst.on_epoch_start(model)
         model._rng, key, pk = jax.random.split(model._rng, 3)
@@ -324,3 +346,5 @@ def _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
         for lst in model.listeners:
             lst.on_epoch_end(model)
         model.epoch += 1
+        if ckpt is not None and ckpt.after_epoch(epoch0 + ep):
+            break   # SIGTERM: final save taken — return cleanly

@@ -422,9 +422,14 @@ class ComputationGraph:
         return self._fit_one(*self._normalize_batch(batch))
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
-            masks=None, label_masks=None) -> "ComputationGraph":
+            masks=None, label_masks=None, checkpoint=None,
+            resume_from=None) -> "ComputationGraph":
         """Train.  ``data`` may be (inputs, labels) (each an array or list of
-        arrays) or an iterable of MultiDataSet-shaped batches."""
+        arrays) or an iterable of MultiDataSet-shaped batches.
+
+        ``checkpoint``/``resume_from``: crash-consistent periodic saves and
+        exact mid-epoch resume (``faulttolerance.CheckpointConfig``; see
+        ``MultiLayerNetwork.fit``)."""
         if self.params == {}:
             self.init()
         if labels is not None:
@@ -452,18 +457,48 @@ class ComputationGraph:
         else:
             raise ValueError("fit() needs (inputs, labels) or an iterator")
 
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self)
-            for batch in batches_factory():
-                self._fit_one(*batch)
-            for lst in self.listeners:
-                lst.on_epoch_end(self)
-            self.epoch += 1
+        # constructed only after every validation raise above: the SIGTERM
+        # hook it installs must always reach the loop's finally/close()
+        ckpt = None
+        if checkpoint is not None or resume_from is not None:
+            from ..faulttolerance.checkpoint import FitCheckpointer
+            ckpt = FitCheckpointer(self, checkpoint, resume_from)
+        start_epoch = ckpt.start_epoch if ckpt is not None else 0
+        stop = False
+        try:
+            for ep in range(start_epoch, epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self)
+                # resume cursor: skip already-consumed batches of the first
+                # resumed epoch without fitting (see MultiLayerNetwork.fit)
+                skip = ckpt.skip_batches \
+                    if (ckpt is not None and ep == ckpt.start_epoch) else 0
+                seq = 0
+                for batch in batches_factory():
+                    if seq < skip:
+                        seq += 1
+                        continue
+                    self._fit_one(*batch)
+                    seq += 1
+                    if ckpt is not None and ckpt.after_batch(ep, seq):
+                        stop = True   # SIGTERM: final save taken
+                        break
+                if stop:
+                    break
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
+                self.epoch += 1
+                if ckpt is not None and ckpt.after_epoch(ep):
+                    stop = True
+                    break
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         return self
 
     def fit_on_device(self, inputs, labels, *, batch_size: int,
-                      epochs: int = 1, shuffle: bool = True
+                      epochs: int = 1, shuffle: bool = True,
+                      checkpoint=None, resume_from=None
                       ) -> "ComputationGraph":
         """Device-resident epoch training for graphs: the dataset stays in
         HBM and one jitted program scans the train step over all minibatches
@@ -472,6 +507,10 @@ class ComputationGraph:
         """
         if self.params == {}:
             self.init()
+        ckpt = None
+        if checkpoint is not None or resume_from is not None:
+            from ..faulttolerance.checkpoint import FitCheckpointer
+            ckpt = FitCheckpointer(self, checkpoint, resume_from)
         step = self._get_jitted("train_step")
         return fit_on_device_epochs(
             self, [jnp.asarray(a) for a in _as_list(inputs)],
@@ -479,7 +518,8 @@ class ComputationGraph:
             shuffle,
             call_step=lambda p, s, o, k, bx, by: step(p, s, o, k, bx, by,
                                                       None, None),
-            fit_tail=lambda xt, yt: self._fit_one(xt, yt, None, None))
+            fit_tail=lambda xt, yt: self._fit_one(xt, yt, None, None),
+            ckpt=ckpt)
 
     @staticmethod
     def _normalize_batch(b):

@@ -484,9 +484,19 @@ class MultiLayerNetwork:
         return self._pad_flags()[2]
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
-            mask=None, label_mask=None) -> "MultiLayerNetwork":
+            mask=None, label_mask=None, checkpoint=None,
+            resume_from=None) -> "MultiLayerNetwork":
         """Train. ``data`` may be (x, y) arrays or an iterable of batches
-        (the DataSetIterator role)."""
+        (the DataSetIterator role).
+
+        ``checkpoint``: a ``faulttolerance.CheckpointConfig`` — periodic
+        crash-consistent saves (params + updater + RNG + data cursor +
+        shape-policy buckets), optionally with a SIGTERM save-on-preempt
+        hook.  ``resume_from``: a checkpoint directory / store /
+        ``CheckpointManager`` — restores full training state and resumes
+        mid-epoch at the exact saved batch cursor, reproducing the
+        uninterrupted run's params (checkpointing is RNG-neutral, so runs
+        with and without it are byte-identical)."""
         from ..data.dataset import DataSet
         if self.params == {}:
             self.init()
@@ -515,6 +525,11 @@ class MultiLayerNetwork:
 
         algo = self.conf.defaults.get("optimization_algo", "sgd")
         if algo not in ("sgd", "stochastic_gradient_descent"):
+            if checkpoint is not None or resume_from is not None:
+                raise ValueError(
+                    "checkpoint=/resume_from= are only supported on the SGD "
+                    f"path; optimization_algo='{algo}' routes through the "
+                    "legacy solvers")
             # legacy full-batch solvers (reference Solver → LBFGS/CG/line
             # search, StochasticGradientDescent.java:58 being the default)
             from ..train.solvers import Solver
@@ -532,6 +547,12 @@ class MultiLayerNetwork:
                 self.epoch += 1
             return self
 
+        # constructed only after every validation raise above: the SIGTERM
+        # hook it installs must always reach the loop's finally/close()
+        ckpt = None
+        if checkpoint is not None or resume_from is not None:
+            from ..faulttolerance.checkpoint import FitCheckpointer
+            ckpt = FitCheckpointer(self, checkpoint, resume_from)
         step_fn = self._get_jitted("train_step")
         # observability (cheap by default: plain host float math per
         # step, instruments resolved once per fit, and the step timing
@@ -554,42 +575,67 @@ class MultiLayerNetwork:
                 "Time blocked on the data pipeline per batch, by stage",
                 ("stage",), buckets=_ETL_BUCKETS)
         steady_examples, steady_s = 0, 0.0
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self)
-            batches = iter(batches_factory())
-            while True:
-                t_etl = time.perf_counter()
-                batch = next(batches, None)
-                # ETL/compute boundary timing (reference lastEtlTime,
-                # MultiLayerNetwork.java:1203-1209): time blocked on the
-                # data pipeline, visible to PerformanceListener
-                self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
-                if batch is None:
+        start_epoch = ckpt.start_epoch if ckpt is not None else 0
+        stop = False
+        try:
+            for ep in range(start_epoch, epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self)
+                batches = iter(batches_factory())
+                # resume cursor: the first resumed epoch skips the batches
+                # the checkpointed run already consumed (the data-pipeline
+                # seq cursor) WITHOUT fitting or touching the RNG, so the
+                # resumed stream lines up with the uninterrupted run's
+                skip = ckpt.skip_batches \
+                    if (ckpt is not None and ep == ckpt.start_epoch) else 0
+                seq = 0
+                while True:
+                    t_etl = time.perf_counter()
+                    batch = next(batches, None)
+                    # ETL/compute boundary timing (reference lastEtlTime,
+                    # MultiLayerNetwork.java:1203-1209): time blocked on the
+                    # data pipeline, visible to PerformanceListener
+                    self.last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                    if batch is None:
+                        break
+                    if seq < skip:
+                        seq += 1
+                        continue
+                    x, y, m, lm = batch
+                    self.last_batch_size = int(getattr(x, "shape", (0,))[0])
+                    t_step = monotonic_s()
+                    if self.conf.backprop_type == "tbptt" and \
+                            getattr(x, "ndim", 2) == 3 and \
+                            x.shape[1] > self.conf.tbptt_fwd_length:
+                        self._fit_tbptt(step_fn, x, y, m, lm)
+                    else:
+                        self._fit_one(x, y, m, lm)
+                    compile_step = self._last_step_traced
+                    if obs:
+                        dt = monotonic_s() - t_step
+                        step_h.labels("compile" if compile_step
+                                      else "steady").observe(dt)
+                        etl_h.labels("fetch").observe(self.last_etl_ms / 1e3)
+                        steps_c.inc()
+                        examples_c.inc(self.last_batch_size)
+                        if not compile_step:
+                            steady_examples += self.last_batch_size
+                            steady_s += dt
+                    seq += 1
+                    if ckpt is not None and ckpt.after_batch(ep, seq):
+                        stop = True   # SIGTERM: final save taken — return
+                        break
+                if stop:
                     break
-                x, y, m, lm = batch
-                self.last_batch_size = int(getattr(x, "shape", (0,))[0])
-                t_step = monotonic_s()
-                if self.conf.backprop_type == "tbptt" and \
-                        getattr(x, "ndim", 2) == 3 and \
-                        x.shape[1] > self.conf.tbptt_fwd_length:
-                    self._fit_tbptt(step_fn, x, y, m, lm)
-                else:
-                    self._fit_one(x, y, m, lm)
-                compile_step = self._last_step_traced
-                if obs:
-                    dt = monotonic_s() - t_step
-                    step_h.labels("compile" if compile_step
-                                  else "steady").observe(dt)
-                    etl_h.labels("fetch").observe(self.last_etl_ms / 1e3)
-                    steps_c.inc()
-                    examples_c.inc(self.last_batch_size)
-                    if not compile_step:
-                        steady_examples += self.last_batch_size
-                        steady_s += dt
-            for lst in self.listeners:
-                lst.on_epoch_end(self)
-            self.epoch += 1
+                for lst in self.listeners:
+                    lst.on_epoch_end(self)
+                self.epoch += 1
+                if ckpt is not None and ckpt.after_epoch(ep):
+                    stop = True
+                    break
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         if obs and steady_s > 0:
             # steady-state throughput: the compile-dominated first step
             # is excluded (same convention as utils/benchmarks.py)
@@ -600,7 +646,8 @@ class MultiLayerNetwork:
         return self
 
     def fit_on_device(self, x, y, *, batch_size: int, epochs: int = 1,
-                      shuffle: bool = True) -> "MultiLayerNetwork":
+                      shuffle: bool = True, checkpoint=None,
+                      resume_from=None) -> "MultiLayerNetwork":
         """Device-resident epoch training: the whole dataset lives in HBM and
         ONE jitted program scans the train step across all minibatches, so an
         epoch costs a single dispatch.
@@ -612,6 +659,11 @@ class MultiLayerNetwork:
         Use plain ``fit`` when data exceeds HBM or per-iteration listener
         granularity matters: listeners here fire once per epoch with the
         recorded final-batch score (per-step hooks would force host syncs).
+
+        ``checkpoint``/``resume_from`` (``faulttolerance``): epoch-boundary
+        crash-consistent saves and exact epoch-granular resume.  A
+        checkpoint config pins the per-epoch dispatch path (the fused
+        multi-epoch program has no epoch boundaries to save at).
         """
         if self.params == {}:
             self.init()
@@ -624,13 +676,20 @@ class MultiLayerNetwork:
             raise ValueError(
                 f"fit_on_device requires the SGD path; optimization_algo="
                 f"'{algo}' routes through the legacy solvers — use fit()")
+        # constructed only after the validation raises above (its SIGTERM
+        # hook must always reach fit_on_device_epochs' finally/close())
+        ckpt = None
+        if checkpoint is not None or resume_from is not None:
+            from ..faulttolerance.checkpoint import FitCheckpointer
+            ckpt = FitCheckpointer(self, checkpoint, resume_from)
         step = self._get_jitted("train_step")
         return fit_on_device_epochs(
             self, [jnp.asarray(x)], [jnp.asarray(y)], batch_size, epochs,
             shuffle,
             call_step=lambda p, s, o, k, bx, by: step(p, s, o, k, bx[0],
                                                       by[0], None, None),
-            fit_tail=lambda xt, yt: self._fit_one(xt[0], yt[0], None, None))
+            fit_tail=lambda xt, yt: self._fit_one(xt[0], yt[0], None, None),
+            ckpt=ckpt)
 
     def _fit_tbptt(self, step_fn, x, y, mask, label_mask):
         """Truncated BPTT (reference ``doTruncatedBPTT``,

@@ -15,7 +15,9 @@ the same loop body is what a multi-process DCN deployment runs per host
 """
 from __future__ import annotations
 
+import logging
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -23,13 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from .accumulation import EncodedGradientsAccumulator, EncodingHandler
+from ..faulttolerance.faults import RetryPolicy
 from ..observability.clock import monotonic_s
-from ..observability.registry import MetricsRegistry
+from ..observability.registry import MetricsRegistry, default_registry
 from ..observability.tracer import get_tracer
 
 __all__ = ["TrainingMaster", "ParameterAveragingTrainingMaster",
            "SharedGradientsTrainingMaster", "TrainingMasterStats",
            "tree_average"]
+
+log = logging.getLogger("deeplearning4j_tpu.parallel")
 
 
 class TrainingMasterStats:
@@ -288,23 +293,149 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     """Synchronous data parallelism with periodic parameter averaging
     (reference ``ParameterAveragingTrainingMaster.java``): per split, every
     worker replica fits its partition locally, then params (and optionally
-    updater state) are tree-averaged and re-broadcast."""
+    updater state) are tree-averaged and re-broadcast.
+
+    **Worker-failure recovery** (the Spark lineage-re-execution role,
+    TensorFlow-paper posture: recover by re-execution, not per-op
+    reliability): each worker's round runs against a round-start snapshot
+    of its replica.  A failed round is retried up to ``max_retries`` times
+    with seeded exponential backoff + jitter, re-executing the chunk from
+    the snapshot (exactly-once in surviving state).  A worker exceeding
+    ``straggler_timeout_s`` — or out of retries — is marked LOST: its
+    round chunk is immediately re-chunked over the surviving workers and
+    the rest of its shard rides their queues (*elastic degradation* — the
+    fit completes on survivors instead of aborting), and it is excluded
+    from every later round, aggregation, and broadcast.  A seeded
+    :class:`~deeplearning4j_tpu.faulttolerance.FaultInjector` makes all of
+    this deterministically testable.  Emits
+    ``training_worker_retries_total`` / ``training_worker_lost_total``.
+    """
 
     def __init__(self, num_workers: int, averaging_frequency: int = 5,
                  aggregation_depth: int = 2, average_updaters: bool = True,
-                 tracer=None):
+                 tracer=None, max_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 straggler_timeout_s: Optional[float] = None,
+                 fault_injector=None, retry_seed: int = 0,
+                 elastic: bool = True):
         self.num_workers = num_workers
         self.averaging_frequency = max(1, averaging_frequency)
         self.aggregation_depth = aggregation_depth
         self.average_updaters = average_updaters
         self.stats = TrainingMasterStats()
         self.tracer = tracer   # None -> process-global (off by default)
+        self.retry_policy = RetryPolicy(max_retries=max_retries,
+                                        backoff_s=retry_backoff_s,
+                                        seed=retry_seed)
+        self.straggler_timeout_s = straggler_timeout_s
+        self.fault_injector = fault_injector
+        self.elastic = elastic
+        self.lost_workers: set = set()
+        self.retry_counts: Dict[int, int] = {}
 
     def fit(self, model, iterator) -> None:
         tracer = self.tracer if self.tracer is not None else get_tracer()
         with tracer.span("master.fit", mode="averaging",
                          workers=self.num_workers):
             self._fit_traced(model, iterator, tracer)
+
+    # ------------------------------------------------- recovery plumbing
+    @staticmethod
+    def _snapshot_replica(replica):
+        """Round-start snapshot: owned device copies (the jitted step
+        donates the live buffers) + RNG/counters, so a retry re-executes
+        the chunk from EXACTLY the state the failed attempt started at."""
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        return (copy(replica.params), copy(replica.state),
+                copy(replica.opt_state), replica._rng,
+                replica.iteration, replica.epoch)
+
+    @staticmethod
+    def _restore_replica(replica, snap) -> None:
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        p, s, o, rng, it, ep = snap
+        replica.params = copy(p)     # keep the snapshot intact for the
+        replica.state = copy(s)      # next attempt (donation again)
+        replica.opt_state = copy(o)
+        replica._rng = rng
+        replica.iteration = it
+        replica.epoch = ep
+
+    def _run_chunk(self, replica, chunk, w: int, rnd: int) -> None:
+        """Fit one worker's round chunk, consulting the fault injector at
+        batch boundaries.  fit_batch syncs the loss per step, so wall time
+        recorded around this is honest compute+dispatch."""
+        from ..faulttolerance.faults import InjectedWorkerFault
+
+        inj = self.fault_injector
+        for i, batch in enumerate(chunk):
+            if inj is not None:
+                inj.on_batch(w, rnd, i)
+            replica.fit_batch(batch)
+        if inj is not None and inj.should_drop(w, rnd):
+            raise InjectedWorkerFault(w, rnd, "dropped result")
+
+    def _count(self, name: str, doc: str) -> None:
+        reg = default_registry()
+        if reg.enabled:
+            reg.counter(name, doc, ("mode",)).labels("threads").inc()
+
+    def _retry_worker(self, replica, w, chunk, snap, rnd, tracer) -> bool:
+        """Per-worker retry with exponential backoff + jitter, restoring
+        the round-start snapshot before each attempt.  True on success."""
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.retry_policy.max_retries + 1):
+            self.retry_counts[w] = self.retry_counts.get(w, 0) + 1
+            self._count("training_worker_retries_total",
+                        "Worker round retries in the training masters")
+            self.retry_policy.sleep(attempt)
+            self._restore_replica(replica, snap)
+            try:
+                with tracer.span("master.worker_retry", worker=w,
+                                 round=rnd, attempt=attempt):
+                    self._run_chunk(replica, chunk, w, rnd)
+                return True
+            except Exception as e:
+                last = e
+        if last is not None:
+            log.warning("worker %d exhausted %d retries at round %d: %s",
+                        w, self.retry_policy.max_retries, rnd, last)
+        return False
+
+    def _run_round(self, replicas, work, rnd, tracer, ctx):
+        """Run one round's chunks on worker threads.  Returns
+        ``{w: None | Exception | "straggler"}``; straggler threads are
+        left running (their replicas are excluded from now on) and joined
+        at the end of fit."""
+        outcome: Dict[int, Any] = {}
+
+        def runner(w, chunk):
+            t_w = monotonic_s()
+            try:
+                with tracer.attach(ctx), \
+                        tracer.span("master.worker_fit", worker=w,
+                                    round=rnd):
+                    self._run_chunk(replicas[w], chunk, w, rnd)
+            except Exception as e:    # surfaced via the retry path
+                outcome[w] = e
+            else:
+                outcome[w] = None
+            finally:
+                self.stats.record("fit", monotonic_s() - t_w, worker=w)
+
+        threads = {w: threading.Thread(target=runner, args=(w, chunk))
+                   for w, chunk in work.items()}
+        for t in threads.values():
+            t.start()
+        deadline = None if self.straggler_timeout_s is None else \
+            monotonic_s() + self.straggler_timeout_s
+        for w, t in threads.items():
+            t.join(None if deadline is None
+                   else max(deadline - monotonic_s(), 0.0))
+            if t.is_alive():
+                outcome[w] = "straggler"
+                self._lingering.append(t)
+        return outcome
 
     def _fit_traced(self, model, iterator, tracer) -> None:
         t0 = monotonic_s()
@@ -315,45 +446,120 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         with tracer.span("master.broadcast"):
             replicas = self._get_replicas(model)
         self.stats.record("broadcast", monotonic_s() - t0)
-        n_rounds = (max(len(p) for p in parts) + self.averaging_frequency - 1
-                    ) // self.averaging_frequency
+        queues = [deque(p) for p in parts]
+        alive = list(range(self.num_workers))
+        self.lost_workers = set()
+        self.retry_counts = {}
+        self._lingering: List[threading.Thread] = []
+        freq = self.averaging_frequency
         ctx = tracer.current_context()   # propagated into worker threads
-        for rnd in range(n_rounds):
-            lo = rnd * self.averaging_frequency
-            hi = lo + self.averaging_frequency
-            errors: List[Exception] = []
-
-            def work(w):
-                t_w = monotonic_s()
-                # fit_batch syncs the loss per step, so this wall time is
-                # honest compute+dispatch, not enqueue rate
-                try:
-                    with tracer.attach(ctx), \
-                            tracer.span("master.worker_fit", worker=w,
-                                        round=rnd):
-                        for batch in parts[w][lo:hi]:
-                            replicas[w].fit_batch(batch)
-                except Exception as e:  # surface worker crashes to fit()
-                    errors.append(e)
-                self.stats.record("fit", monotonic_s() - t_w, worker=w)
-
-            # only workers with batches this round spawn: idle workers
-            # would just record meaningless ~0s fit rows
-            active = [w for w in range(self.num_workers) if parts[w][lo:hi]]
-            threads = [threading.Thread(target=work, args=(w,))
-                       for w in active]
-            for t in threads:
-                t.start()
-            for t in threads:
+        try:
+            self._fit_rounds(replicas, queues, alive, freq, tracer, ctx)
+        finally:
+            # join lingering straggler threads on EVERY exit path: a
+            # zombie thread must never keep mutating a replica — least of
+            # all replicas[0], which IS the caller's model — after fit()
+            # returns or raises
+            for t in self._lingering:
                 t.join()
-            if errors:
-                raise errors[0]
-            if len(active) > 1:
+        # model IS replicas[0]; with worker 0 lost, install the surviving
+        # state so fit() still ends with the trained params on the model
+        if 0 in self.lost_workers and alive:
+            src = replicas[min(alive)]
+            copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+            model.params = copy(src.params)
+            model.state = copy(src.state)
+            model.opt_state = copy(src.opt_state)
+            model.iteration = src.iteration
+            model.epoch = src.epoch
+
+    def _fit_rounds(self, replicas, queues, alive, freq, tracer,
+                    ctx) -> None:
+        """Round loop: chunk → run → retry/lose/re-chunk → aggregate,
+        until every surviving queue drains.  ``alive`` is mutated in
+        place so the caller sees the surviving set."""
+        rnd = 0
+        while True:
+            work = {}
+            for w in alive:
+                chunk = [queues[w].popleft()
+                         for _ in range(min(freq, len(queues[w])))]
+                if chunk:
+                    work[w] = chunk
+            if not work:
+                break
+            snapshots = {w: self._snapshot_replica(replicas[w])
+                         for w in work}
+            outcome = self._run_round(replicas, work, rnd, tracer, ctx)
+            ran = {w for w, res in outcome.items() if res is None}
+            lost_now = []
+            for w, res in outcome.items():
+                if res is None:
+                    continue
+                if res == "straggler":
+                    # its thread still runs — the replica can't be reused
+                    # for a retry; treat as lost for the rest of the fit
+                    log.warning("worker %d exceeded straggler timeout "
+                                "%.3fs at round %d", w,
+                                self.straggler_timeout_s, rnd)
+                    lost_now.append(w)
+                elif self._retry_worker(replicas[w], w, work[w],
+                                        snapshots[w], rnd, tracer):
+                    ran.add(w)
+                else:
+                    lost_now.append(w)
+            for w in lost_now:
+                if not self.elastic:
+                    res = outcome[w]
+                    raise res if isinstance(res, Exception) else \
+                        RuntimeError(f"worker {w} lost at round {rnd} "
+                                     "(straggler)")
+                self.lost_workers.add(w)
+                self._count("training_worker_lost_total",
+                            "Workers permanently lost (retries/straggler "
+                            "budget exhausted)")
+                alive.remove(w)
+                if not alive:
+                    res = outcome[w]
+                    raise RuntimeError(
+                        f"all {self.num_workers} workers lost by round "
+                        f"{rnd}") from (res if isinstance(res, Exception)
+                                        else None)
+                # elastic degradation: the lost worker's ROUND chunk runs
+                # on survivors now (the round's data is covered before its
+                # average), and the rest of its shard rides their queues.
+                # Each replayed batch gets the same snapshot+retry
+                # protection as a normal round — a transient survivor
+                # hiccup here must not abort the fit the recovery
+                # machinery just saved
+                with tracer.span("master.rechunk", round=rnd, worker=w,
+                                 survivors=len(alive)):
+                    survivors = sorted(alive)
+                    for i, batch in enumerate(work[w]):
+                        tw = survivors[i % len(survivors)]
+                        snap = self._snapshot_replica(replicas[tw])
+                        try:
+                            self._run_chunk(replicas[tw], [batch], tw, -1)
+                        except Exception as e:
+                            if not self._retry_worker(replicas[tw], tw,
+                                                      [batch], snap, -1,
+                                                      tracer):
+                                raise RuntimeError(
+                                    f"survivor {tw} failed while "
+                                    f"re-chunking lost worker {w}'s "
+                                    f"round {rnd}") from e
+                        ran.add(tw)
+                    for i, batch in enumerate(queues[w]):
+                        queues[survivors[i % len(survivors)]].append(batch)
+                    queues[w].clear()
+            participants = sorted(ran & set(alive))
+            if len(participants) > 1:
                 t_agg = monotonic_s()
                 with tracer.span("master.aggregation", round=rnd,
-                                 participants=len(active)):
-                    avg = tree_average([replicas[w].params for w in active],
-                                       self.aggregation_depth)
+                                 participants=len(participants)):
+                    avg = tree_average(
+                        [replicas[w].params for w in participants],
+                        self.aggregation_depth)
                     if self.average_updaters:
                         # averaging turns integer leaves (optax step
                         # counts) into floats, which poisons the next
@@ -361,10 +567,13 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                         opt_avg = jax.tree_util.tree_map(
                             _cast_like,
                             tree_average(
-                                [replicas[w].opt_state for w in active],
+                                [replicas[w].opt_state
+                                 for w in participants],
                                 self.aggregation_depth),
-                            replicas[active[0]].opt_state)
-                    for w in range(self.num_workers):
+                            replicas[participants[0]].opt_state)
+                    # broadcast to SURVIVORS only: a lost straggler's
+                    # thread may still be writing its replica
+                    for w in alive:
                         replicas[w].params = jax.tree_util.tree_map(
                             jnp.array, avg)
                         if self.average_updaters:
@@ -375,7 +584,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     # dispatch
                     jax.block_until_ready(avg)
                 self.stats.record("aggregation", monotonic_s() - t_agg)
-        # model IS replicas[0]; nothing to copy back
+            rnd += 1
 
 
 class SharedGradientsTrainingMaster(TrainingMaster):

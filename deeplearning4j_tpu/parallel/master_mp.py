@@ -158,9 +158,14 @@ class MultiprocessMaster:
                  max_task_retries: int = 2,
                  agreement_tol: float = 1e-3,
                  workdir: Optional[str] = None,
-                 fault_injection: Optional[Dict[str, Any]] = None):
+                 fault_injection: Optional[Dict[str, Any]] = None,
+                 retry_backoff_s: float = 0.1, retry_seed: int = 0):
+        from ..faulttolerance.faults import RetryPolicy
         if mode not in ("averaging", "shared"):
             raise ValueError(f"unknown mode {mode!r}")
+        self.retry_policy = RetryPolicy(max_retries=max_task_retries,
+                                        backoff_s=retry_backoff_s,
+                                        seed=retry_seed)
         self.num_workers = num_workers
         self.mode = mode
         self.averaging_frequency = max(1, averaging_frequency)
@@ -318,17 +323,33 @@ class MultiprocessMaster:
 
     def _respawn(self, wid: int, jobdir: str) -> None:
         n = self._retries.get(wid, 0) + 1
+        reg = default_registry()
         if n > self.max_task_retries:
+            # the mp topology has no surviving-replica pool to re-chunk a
+            # shard onto mid-protocol (the averaging barrier counts all N
+            # workers), so an exhausted budget fails the job — recorded as
+            # a lost worker for the shared fleet dashboards
+            if reg.enabled:
+                reg.counter("training_worker_lost_total",
+                            "Workers permanently lost (retries/straggler "
+                            "budget exhausted)", ("mode",)
+                            ).labels("mp").inc()
             raise RuntimeError(
                 f"worker {wid} failed after {n - 1} retries: "
                 + self._logs_tail(jobdir))
         self._retries[wid] = n
         self.retried_workers.add(wid)
-        reg = default_registry()
         if reg.enabled:
             reg.counter("mp_worker_respawns_total",
                         "Dead worker processes respawned by task retry",
                         ("mode",)).labels(self.mode).inc()
+            reg.counter("training_worker_retries_total",
+                        "Worker round retries in the training masters",
+                        ("mode",)).labels("mp").inc()
+        # seeded exponential backoff + jitter: a crash-looping host must
+        # not be respawned at full tilt (and N masters sharing a node
+        # shouldn't stampede in lockstep)
+        self.retry_policy.sleep(n)
         old = self._procs[wid]
         if old.poll() is None:
             old.kill()

@@ -13,12 +13,23 @@ Restoring with ``load_updater=True`` makes resume exact (the reference's
 ``saveUpdater`` flag — SURVEY §5 checkpoint/resume).  The flat
 ``coefficients.bin`` role is played by the npz key→array map: a stable,
 inspectable serialization format rather than a runtime invariant.
+
+Durability: ``write_model`` commits through the atomic temp-then-rename
+helper (``faulttolerance/atomic.py``) — a crash mid-save leaves the
+previous complete file, never a truncated zip.  A truncated or corrupt
+container raises :class:`CorruptModelError` naming the path and the
+member that failed, instead of surfacing raw ``zipfile``/``npz``
+internals.  Restore also accepts a *checkpoint directory* from the
+``faulttolerance.CheckpointManager`` store (the model payload inside it
+is this same container).
 """
 from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -26,8 +37,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import serde
+from ..faulttolerance.atomic import atomic_file
 
 _VERSION = 1
+
+__all__ = ["CorruptModelError", "write_model", "restore_model",
+           "restore_multi_layer_network", "restore_computation_graph",
+           "load_into"]
+
+
+class CorruptModelError(RuntimeError):
+    """A model container is truncated/corrupt.  Carries the ``path`` and,
+    when known, the ``member`` inside the container that failed."""
+
+    def __init__(self, path, member: Optional[str], detail: str):
+        self.path = str(path)
+        self.member = member
+        where = f"{self.path}" + (f" [{member}]" if member else "")
+        super().__init__(
+            f"corrupt or truncated model container: {where}: {detail}")
 
 
 def _flatten(tree, prefix="", out=None):
@@ -75,23 +103,92 @@ def _npz_bytes_to_leaves(data: bytes):
 
 def write_model(net, path, save_updater: bool = True) -> None:
     """Save a MultiLayerNetwork or ComputationGraph
-    (reference ``ModelSerializer.writeModel``)."""
+    (reference ``ModelSerializer.writeModel``).  The zip is staged on a
+    temp path and atomically renamed into place — a crash mid-write can
+    never leave a truncated container at ``path``."""
     meta = {
         "version": _VERSION,
-        "net_class": type(net).__name__,
+        # checkpoint snapshots are proxy objects carrying the real class
+        "net_class": getattr(net, "net_class", type(net).__name__),
         "iteration": net.iteration,
         "epoch": net.epoch,
         "has_updater": bool(save_updater and net.opt_state is not None),
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", net.conf.to_json())
-        zf.writestr("metadata.json", json.dumps(meta))
-        zf.writestr("params.npz", _tree_to_npz_bytes(net.params))
-        # state groups may be empty dicts — keep structure via params keys
-        zf.writestr("state.npz", _tree_to_npz_bytes(net.state))
-        if meta["has_updater"]:
-            leaves = jax.tree_util.tree_leaves(net.opt_state)
-            zf.writestr("updater.npz", _leaves_to_npz_bytes(leaves))
+    with atomic_file(str(path)) as tmp:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", net.conf.to_json())
+            zf.writestr("metadata.json", json.dumps(meta))
+            zf.writestr("params.npz", _tree_to_npz_bytes(net.params))
+            # state groups may be empty dicts — keep structure via params keys
+            zf.writestr("state.npz", _tree_to_npz_bytes(net.state))
+            if meta["has_updater"]:
+                leaves = jax.tree_util.tree_leaves(net.opt_state)
+                zf.writestr("updater.npz", _leaves_to_npz_bytes(leaves))
+
+
+def _read_member(zf: zipfile.ZipFile, name: str, path) -> bytes:
+    try:
+        return zf.read(name)
+    except KeyError:
+        raise CorruptModelError(path, name, "member missing from container")
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError) as e:
+        raise CorruptModelError(path, name, f"{type(e).__name__}: {e}")
+
+
+def _load_npz(data: bytes, member: str, path, loader):
+    try:
+        return loader(data)
+    except (ValueError, KeyError, OSError, zipfile.BadZipFile,
+            zlib.error, EOFError) as e:
+        raise CorruptModelError(path, member, f"{type(e).__name__}: {e}")
+
+
+def _read_container(path, load_updater: bool):
+    """Read (meta, conf, params, state, updater_leaves) from a model zip,
+    normalizing every truncation/corruption failure mode into
+    CorruptModelError."""
+    try:
+        zf = zipfile.ZipFile(path, "r")
+    except (zipfile.BadZipFile, EOFError) as e:
+        raise CorruptModelError(path, None, f"{type(e).__name__}: {e}")
+    with zf:
+        raw_meta = _read_member(zf, "metadata.json", path)
+        try:
+            meta = json.loads(raw_meta)
+        except ValueError as e:
+            raise CorruptModelError(path, "metadata.json", str(e))
+        try:
+            conf = serde.from_json(
+                _read_member(zf, "configuration.json", path).decode())
+        except CorruptModelError:
+            raise
+        except Exception as e:
+            raise CorruptModelError(path, "configuration.json",
+                                    f"{type(e).__name__}: {e}")
+        params = _load_npz(_read_member(zf, "params.npz", path),
+                           "params.npz", path, _npz_bytes_to_tree)
+        state = _load_npz(_read_member(zf, "state.npz", path),
+                          "state.npz", path, _npz_bytes_to_tree)
+        updater_leaves = None
+        if load_updater and meta.get("has_updater") and \
+                "updater.npz" in zf.namelist():
+            updater_leaves = _load_npz(
+                _read_member(zf, "updater.npz", path), "updater.npz", path,
+                _npz_bytes_to_leaves)
+    return meta, conf, params, state, updater_leaves
+
+
+def _model_payload_path(path):
+    """Accept a checkpoint DIRECTORY (faulttolerance store: the model
+    container lives at ``<dir>/model.zip``) as well as a bare zip path."""
+    p = str(path)
+    if os.path.isdir(p):
+        inner = os.path.join(p, "model.zip")
+        if os.path.isfile(inner):
+            return inner
+        raise CorruptModelError(p, "model.zip",
+                                "directory has no model.zip payload")
+    return p
 
 
 def _restore(path, expect_class: Optional[str], load_updater: bool):
@@ -100,16 +197,9 @@ def _restore(path, expect_class: Optional[str], load_updater: bool):
     from ..nn.conf.multi_layer import MultiLayerConfiguration
     from ..nn.multilayer import MultiLayerNetwork
 
-    with zipfile.ZipFile(path, "r") as zf:
-        meta = json.loads(zf.read("metadata.json"))
-        conf = serde.from_json(zf.read("configuration.json").decode())
-        params = _npz_bytes_to_tree(zf.read("params.npz"))
-        state = _npz_bytes_to_tree(zf.read("state.npz"))
-        updater_leaves = None
-        if load_updater and meta.get("has_updater") and \
-                "updater.npz" in zf.namelist():
-            updater_leaves = _npz_bytes_to_leaves(zf.read("updater.npz"))
-
+    path = _model_payload_path(path)
+    meta, conf, params, state, updater_leaves = _read_container(
+        path, load_updater)
     if expect_class and meta["net_class"] != expect_class:
         raise ValueError(
             f"saved model is a {meta['net_class']}, not a {expect_class}")
@@ -120,6 +210,28 @@ def _restore(path, expect_class: Optional[str], load_updater: bool):
     else:
         raise ValueError(f"unrecognized configuration type {type(conf)}")
     net.init()  # allocates correctly-structured trees + fresh opt state
+    _install(net, meta, params, state, updater_leaves)
+    return net
+
+
+def load_into(net, path, load_updater: bool = True) -> None:
+    """Restore a saved container INTO an existing network of the same
+    topology (params, state, optionally updater state, iteration/epoch).
+    The in-place counterpart of :func:`restore_model`, used by
+    checkpoint-resume so the caller's network object keeps training."""
+    path = _model_payload_path(path)
+    meta, _conf, params, state, updater_leaves = _read_container(
+        path, load_updater)
+    if meta["net_class"] != type(net).__name__:
+        raise ValueError(
+            f"saved model is a {meta['net_class']}, not a "
+            f"{type(net).__name__}")
+    if not net.params:
+        net.init()
+    _install(net, meta, params, state, updater_leaves)
+
+
+def _install(net, meta, params, state, updater_leaves) -> None:
     # overwrite with saved values (keep any group the save didn't know about)
     net.params = _merge_tree(net.params, params)
     net.state = _merge_tree(net.state, state)
@@ -135,7 +247,6 @@ def _restore(path, expect_class: Optional[str], load_updater: bool):
         net.opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
     net.iteration = int(meta.get("iteration", 0))
     net.epoch = int(meta.get("epoch", 0))
-    return net
 
 
 def _merge_tree(fresh, saved):

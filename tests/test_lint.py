@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX013 has at least one fixture that MUST fire and one
+Every rule JX001–JX014 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -596,6 +596,84 @@ def test_jx013_negative_jit_outside_methods():
     """)
 
 
+# ---------------------------------------------------------------- JX014
+def test_jx014_positive_zipfile_write_to_checkpoint_path():
+    assert "JX014" in rules_of("""
+        import os
+        import zipfile
+
+        def save(d, tag, payload):
+            path = os.path.join(d, f"checkpoint_{tag}.zip")
+            with zipfile.ZipFile(path, "w") as zf:
+                zf.writestr("a", payload)
+    """)
+
+
+def test_jx014_positive_open_wb_on_ckpt_name_and_savez_model_zip():
+    got = rules_of("""
+        import numpy as np
+
+        def save(d, data, arrs, ckpt_file):
+            with open(ckpt_file, "wb") as f:
+                f.write(data)
+            np.savez(d + "/bestModel.zip", **arrs)
+    """)
+    assert "JX014" in got
+
+
+def test_jx014_positive_one_hop_alias():
+    assert "JX014" in rules_of("""
+        import os
+
+        def save(d, data):
+            path = os.path.join(d, "ckpt-00000001.bin")
+            dst = path
+            with open(dst, "wb") as f:
+                f.write(data)
+    """)
+
+
+def test_jx014_negative_atomic_helper_reads_and_plain_paths():
+    assert "JX014" not in rules_of("""
+        import io
+        import zipfile
+        import numpy as np
+        from deeplearning4j_tpu.faulttolerance.atomic import atomic_file
+
+        def save(dst, arrs, log_path, ckpt_path, shard_path, data):
+            with atomic_file(dst) as tmp:          # helper: tmp is runtime
+                with zipfile.ZipFile(tmp, "w") as zf:
+                    zf.writestr("a", b"x")
+            buf = io.BytesIO()
+            np.savez(buf, **arrs)                  # in-memory buffer
+            with open(log_path, "wb") as f:        # not checkpoint-like
+                f.write(data)
+            with zipfile.ZipFile(ckpt_path, "r") as zf:    # read-only
+                zf.namelist()
+            np.savez(shard_path, **arrs)           # not checkpoint-like
+            with open(ckpt_path, "w") as f:        # text mode: manifest
+                f.write("{}")                      # writers go via helper,
+                                                   # but rule targets "wb"
+    """)
+
+
+def test_jx014_negative_same_name_in_unrelated_function():
+    # name taint is per-scope: `path` holding a checkpoint name in one
+    # function must not flag an unrelated `path` written elsewhere
+    assert "JX014" not in rules_of("""
+        import os
+
+        def a(d):
+            path = os.path.join(d, "checkpoint.zip")
+            return path
+
+        def b(d, data):
+            path = os.path.join(d, "stats.bin")
+            with open(path, "wb") as f:
+                f.write(data)
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -715,7 +793,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 13
+    assert len(RULES) == 14
 
 
 def test_package_is_clean_modulo_baseline():

@@ -99,6 +99,60 @@ def test_save_restore_graph(tmp_path):
         model_serializer.restore_multi_layer_network(p)
 
 
+def test_truncated_zip_raises_corrupt_model_error(tmp_path):
+    """Regression (ISSUE 5): a truncated container raises a clear
+    CorruptModelError naming the path, not raw zipfile/npz internals."""
+    net = iris_net()
+    p = str(tmp_path / "m.zip")
+    model_serializer.write_model(net, p)
+    blob = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(model_serializer.CorruptModelError) as ei:
+        model_serializer.restore_model(p)
+    assert p in str(ei.value)
+    assert ei.value.path == p
+
+
+def test_corrupt_member_names_the_member(tmp_path):
+    """A structurally-valid zip with a damaged/missing member reports
+    WHICH member failed."""
+    import zipfile
+
+    net = iris_net()
+    p = str(tmp_path / "m.zip")
+    model_serializer.write_model(net, p)
+    clipped = str(tmp_path / "clipped.zip")
+    with zipfile.ZipFile(p) as src, \
+            zipfile.ZipFile(clipped, "w") as dst:
+        for name in src.namelist():
+            if name != "params.npz":
+                dst.writestr(name, src.read(name))
+    with pytest.raises(model_serializer.CorruptModelError) as ei:
+        model_serializer.restore_model(clipped)
+    assert ei.value.member == "params.npz"
+    assert "params.npz" in str(ei.value)
+
+
+def test_write_model_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A crash mid-save must leave the previous complete container (the
+    atomic temp-then-rename contract), never a truncated one."""
+    net = iris_net()
+    p = str(tmp_path / "m.zip")
+    model_serializer.write_model(net, p)
+    before = open(p, "rb").read()
+
+    def boom(tree):
+        raise RuntimeError("simulated crash mid-serialize")
+
+    monkeypatch.setattr(model_serializer, "_tree_to_npz_bytes", boom)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        model_serializer.write_model(net, p)
+    assert open(p, "rb").read() == before          # old save intact
+    assert os.listdir(tmp_path) == ["m.zip"]       # no temp litter
+    model_serializer.restore_multi_layer_network(p)
+
+
 # ------------------------------------------------------------ early stopping
 
 def test_early_stopping_max_epochs():

@@ -17,7 +17,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="AST-based JAX/TPU correctness linter "
-                    "(rules JX001-JX010; see tools/README.md)")
+                    "(rules JX001-JX014; see tools/README.md)")
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,

@@ -1,4 +1,4 @@
-"""graftlint rule implementations JX001–JX013.
+"""graftlint rule implementations JX001–JX014.
 
 Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
 registered in ``RULES``.  Rules share the jit-scope + taint machinery in
@@ -686,6 +686,119 @@ def jx013(info: ModuleInfo) -> List[Finding]:
             continue
         if closes_over_self(node):
             out.append(_finding(info, node, "JX013", msg))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX014
+_CKPT_STR_RE = re.compile(
+    r"(checkpoint|ckpt|model\w*\.zip|\.ckpt)", re.IGNORECASE)
+_CKPT_NAME_RE = re.compile(r"(checkpoint|ckpt)", re.IGNORECASE)
+
+
+@rule("JX014", "raw write to a checkpoint-like path bypassing the "
+               "atomic-commit helper")
+def jx014(info: ModuleInfo) -> List[Finding]:
+    """Flag direct ``open(.., "wb")`` / ``np.savez``/``np.save`` /
+    ``zipfile.ZipFile(.., "w")`` writes whose target is a checkpoint-like
+    path (a string mentioning checkpoint/ckpt/``...model*.zip``, a name
+    spelled like one, or a name assigned from such a string): a crash
+    mid-write leaves a truncated artifact that restore explodes on.
+    Durable artifacts must commit through the atomic temp-then-rename
+    helpers (``faulttolerance/atomic.py``: ``atomic_file`` /
+    ``atomic_write_bytes`` / staged checkpoint dirs).  Reads, writes to
+    non-checkpoint paths, and in-memory buffers stay legal — as do the
+    helpers themselves, whose temp targets are runtime-derived names."""
+    out: List[Finding] = []
+
+    def expr_is_ckptish(node: ast.AST, tracked: set) -> bool:
+        """Does this expression denote a checkpoint-like path? String
+        constants / f-string parts matching the pattern, names spelled
+        like checkpoints, or names assigned from matching expressions."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and _CKPT_STR_RE.search(n.value):
+                return True
+        name = dotted_name(node)
+        if name is not None:
+            return bool(_CKPT_NAME_RE.search(name)) or name in tracked
+        return False
+
+    # per-SCOPE fixpoint: names/attrs assigned from checkpoint-like
+    # expressions, including one-hop copies (path = join(d, "ckpt.zip");
+    # dst = path).  Scoped like JX012's device tracking — a `path`
+    # holding a checkpoint name in one function must not taint an
+    # unrelated `path` in another; module-level assignments seed every
+    # function's set.
+    scope_cache: Dict[Optional[ast.AST], set] = {}
+
+    def tracked_names(func: Optional[ast.AST]) -> set:
+        if func in scope_cache:
+            return scope_cache[func]
+        scope = func if func is not None else info.tree
+        assigns = []
+        for node in ast.walk(scope):
+            if info.enclosing_function(node) is not func:
+                continue    # nested functions track their own names
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and \
+                    getattr(node, "value", None) is not None:
+                targets = [node.target]
+            for t in targets:
+                key = dotted_name(t)
+                if key:
+                    assigns.append((key, node.value))
+        tracked = set() if func is None else set(tracked_names(None))
+        changed = True
+        while changed:
+            changed = False
+            for key, value in assigns:
+                if key not in tracked and expr_is_ckptish(value, tracked):
+                    tracked.add(key)
+                    changed = True
+        scope_cache[func] = tracked
+        return tracked
+
+    def _mode_of(node: ast.Call, default: str = "r") -> Optional[str]:
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            return node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return default
+
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        target = node.args[0] if node.args else None
+        if target is None or not expr_is_ckptish(
+                target, tracked_names(info.enclosing_function(node))):
+            continue
+        bad = None
+        if fname == "open":
+            mode = _mode_of(node) or ""
+            if ("w" in mode or "x" in mode) and "b" in mode:
+                bad = f'open(.., "{mode}")'
+        elif parts[-1] == "ZipFile" and len(parts) <= 2:
+            mode = _mode_of(node) or "r"
+            if mode in ("w", "x", "a"):
+                bad = f'zipfile.ZipFile(.., "{mode}")'
+        elif parts[0] in info.numpy_aliases and len(parts) == 2 and \
+                parts[1] in ("save", "savez", "savez_compressed"):
+            bad = f"{fname}(..)"
+        if bad:
+            out.append(_finding(
+                info, node, "JX014",
+                f"{bad} writes a checkpoint-like path in place: a crash "
+                "mid-write leaves a truncated artifact restore explodes "
+                "on — commit through the atomic temp-then-rename helper "
+                "(faulttolerance/atomic.py: atomic_file / "
+                "atomic_write_bytes)"))
     return _dedupe(out)
 
 
