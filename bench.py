@@ -34,6 +34,13 @@ A fourth JSON line records the checkpointing-overhead benchmark
 the faulttolerance CheckpointManager, plus committed bytes and write
 rate) so checkpoint-cost regressions are driver-visible;
 DL4J_TPU_BENCH_CKPT=0 suppresses it.
+
+A fifth set of JSON lines records the step-time engine benchmark
+(``step_time_ms[s=...,dtype]``: steady per-step train time under the
+auto shape policy vs the off-policy reference across
+seq x {f32, bf16}, with the bucket cost model's adaptation step count)
+so the s=128 bucketing regression class and the mixed-precision win are
+tracked round over round; DL4J_TPU_BENCH_STEP=0 suppresses it.
 """
 import json
 import os
@@ -191,6 +198,20 @@ def main():
                               "unit": "ms/save async stall (idle writer)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # step-time engine row (ISSUE 6): per-step time under the auto shape
+    # policy vs off across seq x dtype, with the bucket cost model's
+    # adaptation visible; a fifth set of JSON lines, opt-out
+    # DL4J_TPU_BENCH_STEP=0
+    if os.environ.get("DL4J_TPU_BENCH_STEP", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import step_time_ms
+            for row in step_time_ms():
+                print(json.dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "step_time_ms", "value": None,
+                              "unit": "ms/step (auto policy)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -283,6 +304,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # checkpointing overhead (ISSUE 5): sync vs async save stall +
         # committed-bytes write rate
         B.checkpoint_overhead,
+        # step-time engine (ISSUE 6): auto-vs-off shape policy per-step
+        # time across seq x {f32, bf16} — the s=128 regression and the
+        # PrecisionPolicy bf16 win ride the same trajectory
+        B.step_time_ms,
     ]
     side = []
     for fn in captures:

@@ -28,6 +28,7 @@ from .nn.conf.multi_layer import (MultiLayerConfiguration,
 from .nn.conf.computation_graph import ComputationGraphConfiguration
 from .nn.computation_graph import ComputationGraph
 from .nn.multilayer import MultiLayerNetwork
+from .nn.precision import PrecisionPolicy
 
 __all__ = [
     "ComputationGraph",
@@ -36,6 +37,7 @@ __all__ = [
     "MultiLayerConfiguration",
     "NeuralNetConfiguration",
     "MultiLayerNetwork",
+    "PrecisionPolicy",
     "observability",
     "persistent_cache_status",
     "wire_persistent_cache",

@@ -24,14 +24,42 @@ and for training when the stack contains a cross-batch layer
 (BatchNormalization trains on batch statistics, which padded rows would
 perturb — eval uses running statistics and stays safe).  Recurring eval
 paths additionally cap padding waste at 8x the real batch (auto mode).
+
+**Cost model (auto mode, training paths)**: whether to pad a batch of
+size n onto an already-compiled bucket t is a rent-vs-buy decision —
+padding "rents" the big bucket at ``step_seconds x (t-n)/n`` extra
+compute per step (padded_flops/real_flops is linear in rows on the batch
+axis), compiling n natively "buys" a ``compile_seconds`` one-off.  The
+policy tracks how often each REAL size recurs per (path, axis) and pads
+only while the projected cumulative padding waste stays below the
+amortized recompile cost (the classic ski-rental rule: total overhead is
+bounded by ~2x one compile).  A one-off ragged epoch tail therefore
+always pads; a steadily recurring small shape gets its own compile after
+a bounded number of padded steps — which is exactly the s=128 class of
+regression (BENCH_SIDE r05: auto 36% slower than off) this model fixes.
+Compile/step costs come from the live observability registry
+(``training_compile_seconds`` / ``training_step_seconds{phase=steady}``)
+with env-overridable priors (``DL4J_TPU_PAD_COMPILE_S``,
+``DL4J_TPU_PAD_STEP_S``, bias ``DL4J_TPU_PAD_RECOMPILE_BIAS``).
+
+The per-(path, axis) bucket ladder is LRU-bounded
+(``DL4J_TPU_SHAPE_BUCKET_CAP``, default 16) so long multi-shape runs
+can't grow dispatch history without limit; ``training_shape_buckets``,
+``training_padding_ratio`` and ``training_padding_skipped_total`` expose
+the ladder size, the realized padding waste, and declined pads in
+/metrics.
 """
 from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, Optional, Sequence, Set, Tuple
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["ShapePolicy", "default_shape_policy", "next_pow2"]
+
+# padded/real element ratios: 1.0 = no padding, right tail = pathological
+_RATIO_BUCKETS = (1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0, 16.0)
 
 
 def next_pow2(n: int) -> int:
@@ -77,9 +105,17 @@ class ShapePolicy:
     Thread-safe: the training masters drive replicas from worker threads.
     """
 
+    #: when the registry has no measurement yet, assume a compile costs
+    #: this many seconds and a steady step this many — overridable priors
+    DEFAULT_COMPILE_S = 2.0
+    DEFAULT_STEP_S = 0.02
+
     def __init__(self, mode: str = "auto",
                  batch_buckets: Optional[Sequence[int]] = None,
-                 time_buckets: Optional[Sequence[int]] = None):
+                 time_buckets: Optional[Sequence[int]] = None,
+                 max_buckets: Optional[int] = None,
+                 compile_cost_s: Optional[float] = None,
+                 step_cost_s: Optional[float] = None):
         if mode not in ("auto", "pow2", "buckets", "off"):
             raise ValueError(f"unknown shape-policy mode '{mode}'")
         if mode == "buckets" and not batch_buckets:
@@ -89,14 +125,95 @@ class ShapePolicy:
             if batch_buckets else None
         self.time_buckets = sorted(int(b) for b in time_buckets) \
             if time_buckets else None
-        self._seen: Dict[Tuple[str, str], Set[int]] = {}
+        self.max_buckets = int(max_buckets) if max_buckets else int(
+            os.environ.get("DL4J_TPU_SHAPE_BUCKET_CAP", "16"))
+        # fixed cost overrides (tests / operators); None = live estimate
+        # from the metrics registry with env-default priors
+        self._compile_cost_s = compile_cost_s
+        self._step_cost_s = step_cost_s
+        self._recompile_bias = float(
+            os.environ.get("DL4J_TPU_PAD_RECOMPILE_BIAS", "1.0"))
+        # LRU ladders of DISPATCHED (compiled) sizes, oldest first, capped
+        # at max_buckets per (path, axis)
+        self._buckets: Dict[Tuple[str, str], "OrderedDict[int, None]"] = {}
+        # recency-bounded histogram of REQUESTED sizes (the cost model's
+        # recurrence evidence), capped at 4x the bucket cap
+        self._hist: Dict[Tuple[str, str], "OrderedDict[int, int]"] = {}
         self._lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
         return self.mode != "off"
 
+    # -------------------------------------------------------- observability
+    @staticmethod
+    def _registry():
+        from ..observability.registry import default_registry
+        return default_registry()
+
+    def _note_skip(self, path: str) -> None:
+        reg = self._registry()
+        if reg.enabled:
+            reg.counter("training_padding_skipped_total",
+                        "Pads declined by the cost model / eval cap "
+                        "(the batch dispatched at its native size)",
+                        ("path",)).labels(path).inc()
+
+    def _note_ratio(self, path: str, ratio: float) -> None:
+        reg = self._registry()
+        if reg.enabled:
+            reg.histogram("training_padding_ratio",
+                          "Padded/real element ratio per dispatched batch "
+                          "(1.0 = no padding)", ("path",),
+                          buckets=_RATIO_BUCKETS).labels(path).observe(ratio)
+
+    def _costs(self) -> Tuple[float, float]:
+        """(compile_seconds, steady_step_seconds) — measured averages from
+        the live registry where available, else env-overridable priors."""
+        compile_s, step_s = self._compile_cost_s, self._step_cost_s
+        if compile_s is not None and step_s is not None:
+            return compile_s, step_s
+        reg = self._registry()
+
+        def avg(name, want_labels, default):
+            inst = reg.get(name) if reg.enabled else None
+            if inst is None or not hasattr(inst, "samples"):
+                return default
+            tot = cnt = 0.0
+            for labels, child in inst.samples():
+                if want_labels is not None and labels != want_labels:
+                    continue
+                tot += getattr(child, "sum", 0.0)
+                cnt += getattr(child, "count", 0)
+            return tot / cnt if cnt else default
+
+        if compile_s is None:
+            compile_s = avg("training_compile_seconds", None, float(
+                os.environ.get("DL4J_TPU_PAD_COMPILE_S",
+                               str(self.DEFAULT_COMPILE_S))))
+        if step_s is None:
+            step_s = avg("training_step_seconds", ("steady",), float(
+                os.environ.get("DL4J_TPU_PAD_STEP_S",
+                               str(self.DEFAULT_STEP_S))))
+        return compile_s, step_s
+
     # ------------------------------------------------------------ targets
+    def _note_dispatch(self, path: str, axis: str, size: int) -> None:
+        """Record a dispatched size in the LRU ladder (lock held)."""
+        od = self._buckets.setdefault((path, axis), OrderedDict())
+        od.pop(size, None)
+        od[size] = None
+        while len(od) > self.max_buckets:
+            od.popitem(last=False)
+        reg = self._registry()
+        if reg.enabled:
+            total = sum(len(v) for (p, _a), v in self._buckets.items()
+                        if p == path)
+            reg.gauge("training_shape_buckets",
+                      "Live dispatched-shape buckets per path (LRU-capped "
+                      "at DL4J_TPU_SHAPE_BUCKET_CAP per axis)",
+                      ("path",)).labels(path).set(total)
+
     def _target(self, path: str, axis: str, n: int) -> int:
         if self.mode == "off" or n <= 0:
             return n
@@ -113,32 +230,79 @@ class ShapePolicy:
             return next_pow2(n)
         # auto: smallest already-dispatched size >= n on this (path, axis)
         with self._lock:
-            seen = self._seen.get((path, axis))
+            seen = self._buckets.get((path, axis))
             bigger = [s for s in seen if s >= n] if seen else []
         return min(bigger) if bigger else n
 
+    def _train_target(self, path: str, n: int) -> int:
+        """Auto-mode batch target for a TRAINING dispatch: rent (pad onto
+        the smallest compiled bucket) vs buy (compile n natively) by the
+        ski-rental rule — see the module docstring."""
+        with self._lock:
+            hist = self._hist.setdefault((path, "batch"), OrderedDict())
+            count = hist.pop(n, 0) + 1
+            hist[n] = count
+            while len(hist) > 4 * self.max_buckets:
+                hist.popitem(last=False)
+            od = self._buckets.get((path, "batch"))
+            bigger = [s for s in od if s >= n] if od else []
+        if not bigger:
+            return n                       # first/largest shape: never pad
+        target = min(bigger)
+        if target == n:
+            return n
+        waste_frac = (target - n) / n      # padded_flops/real_flops - 1
+        compile_s, step_s = self._costs()
+        if count * step_s * waste_frac >= \
+                self._recompile_bias * compile_s:
+            # this size recurs enough that its cumulative padding waste
+            # now rivals a fresh compile — stop renting, buy the bucket
+            self._note_skip(path)
+            return n
+        return target
+
     # ------------------------------------------------- checkpoint support
     def snapshot(self) -> Dict:
-        """JSON-serializable view of the dispatched-size history
-        (``faulttolerance`` checkpoints carry it so a resumed run makes
-        the same padding decisions — and hits the same compiled shapes —
-        as the uninterrupted one)."""
+        """JSON-serializable view of the dispatched-size history AND the
+        requested-size recurrence counts (``faulttolerance`` checkpoints
+        carry it so a resumed run makes the same padding decisions — and
+        hits the same compiled shapes — as the uninterrupted one)."""
         with self._lock:
             return {"mode": self.mode,
                     "batch_buckets": self.batch_buckets,
                     "time_buckets": self.time_buckets,
-                    "seen": [[path, axis, sorted(sizes)]
+                    "cap": self.max_buckets,
+                    "seen": [[path, axis, list(sizes)]
                              for (path, axis), sizes
-                             in sorted(self._seen.items())]}
+                             in sorted(self._buckets.items())],
+                    "hist": [[path, axis, [[s, c] for s, c in hist.items()]]
+                             for (path, axis), hist
+                             in sorted(self._hist.items())]}
 
     def restore_state(self, snap: Dict) -> None:
-        """Merge a :meth:`snapshot`'s dispatched-size history back in
-        (mode/ladders stay as configured — only the auto-mode bucket
-        history is resume state)."""
+        """Merge a :meth:`snapshot` back in (mode/ladders stay as
+        configured — bucket history, recurrence counts and the LRU cap are
+        resume state).  Accepts pre-cost-model snapshots (no ``hist``/
+        ``cap`` keys)."""
+        cap = snap.get("cap")
+        if cap:
+            self.max_buckets = int(cap)
         with self._lock:
             for path, axis, sizes in snap.get("seen", []):
-                self._seen.setdefault((str(path), str(axis)), set()).update(
-                    int(s) for s in sizes)
+                od = self._buckets.setdefault((str(path), str(axis)),
+                                              OrderedDict())
+                for s in sizes:            # snapshot order = LRU order
+                    od.pop(int(s), None)
+                    od[int(s)] = None
+                while len(od) > self.max_buckets:
+                    od.popitem(last=False)
+            for path, axis, pairs in snap.get("hist", []):
+                hist = self._hist.setdefault((str(path), str(axis)),
+                                             OrderedDict())
+                for s, c in pairs:
+                    hist[int(s)] = hist.pop(int(s), 0) + int(c)
+                while len(hist) > 4 * self.max_buckets:
+                    hist.popitem(last=False)
 
     def observe(self, path: str, n: int, axis: str = "batch") -> None:
         """Record a dispatched size so later smaller batches pad up to it
@@ -146,10 +310,13 @@ class ShapePolicy:
         if n <= 0:
             return
         with self._lock:
-            self._seen.setdefault((path, axis), set()).add(int(n))
+            self._note_dispatch(path, axis, int(n))
 
     def target_batch(self, path: str, n: int) -> int:
-        t = self._target(path, "batch", n)
+        if self.mode == "auto":
+            t = self._train_target(path, n)
+        else:
+            t = self._target(path, "batch", n)
         self.observe(path, t)
         return t
 
@@ -180,6 +347,8 @@ class ShapePolicy:
         t = int(x.shape[1]) if ndim == 3 else 0
         target_t = self.target_time(path, t) if t else 0
         pad_b, pad_t = target_b - n, (target_t - t if t else 0)
+        self._note_ratio(path, (target_b / n) *
+                         (target_t / t if t and target_t > t else 1.0))
         if pad_b <= 0 and pad_t <= 0:
             return x, y, mask, label_mask
         import jax.numpy as jnp
@@ -222,7 +391,10 @@ class ShapePolicy:
         if self.mode == "auto" and target > n and \
                 target > self._EVAL_PAD_RATIO_CAP * n and target - n > 8:
             target = n
+            self._note_skip(path)
         self.observe(path, target)
+        if n > 0:
+            self._note_ratio(path, target / n)
         return target
 
     def pad_eval_rows(self, x, path: str = "eval"):
@@ -284,8 +456,11 @@ class ShapePolicy:
         n = int(getattr(xs[0], "shape", (0,))[0])
         if n == 0:
             return xs, ys, lms
-        target = self.target_batch(path, n) if path == "train" \
-            else self._eval_target(path, n)
+        if path == "train":
+            target = self.target_batch(path, n)
+            self._note_ratio(path, target / n)
+        else:
+            target = self._eval_target(path, n)
         if target <= n:
             return xs, ys, lms
         pad = target - n

@@ -106,6 +106,12 @@ class Glove(WordVectors):
         chunk = min(256, max(1, -(-n_pairs // B)))
         stride = B * chunk
         pad = (-n_pairs) % stride
+        # loop-invariant hyperparameter scalars placed ONCE (JX015: a
+        # jnp.float32(...) inside the chunk loop is a device cast per
+        # dispatch)
+        lr_s = jnp.float32(self.learning_rate)
+        xmax_s = jnp.float32(self.x_max)
+        alpha_s = jnp.float32(self.alpha)
         for _epoch in range(self.epochs):
             order = rng.permutation(n_pairs)
             pr = np.concatenate([rows[order], np.zeros(pad, np.int32)])
@@ -118,6 +124,5 @@ class Glove(WordVectors):
                     jnp.asarray(pr[s:s + stride].reshape(chunk, B)),
                     jnp.asarray(pc[s:s + stride].reshape(chunk, B)),
                     jnp.asarray(px[s:s + stride].reshape(chunk, B)),
-                    jnp.float32(self.learning_rate),
-                    jnp.float32(self.x_max), jnp.float32(self.alpha))
+                    lr_s, xmax_s, alpha_s)
         self.lookup_table.syn0 = w + wc

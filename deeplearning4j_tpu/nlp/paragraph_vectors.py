@@ -117,8 +117,11 @@ class ParagraphVectors(SequenceVectors):
         for it in range(iterations):
             alpha = max(self.min_learning_rate,
                         lr * (1.0 - it / max(iterations, 1)))
+            # np scalar, not jnp: the varying learning rate rides the
+            # step's own dispatch instead of paying a device cast per
+            # iteration (JX015)
             vec = infer_step(vec, syn1, syn1neg, jnp.asarray(pts),
                              jnp.asarray(cds), jnp.asarray(cm),
                              jnp.asarray(neg), jnp.asarray(nl),
-                             jnp.asarray(nm), jnp.float32(alpha))
+                             jnp.asarray(nm), np.float32(alpha))
         return np.asarray(vec)

@@ -394,7 +394,7 @@ class SequenceVectors(WordVectors):
                         syn0, syn1, syn1neg, jnp.asarray(ctx),
                         jnp.asarray(pts), jnp.asarray(cds), jnp.asarray(cm),
                         jnp.asarray(neg), jnp.asarray(nl), jnp.asarray(nm),
-                        jnp.float32(decay(seen_mean)))
+                        np.float32(decay(seen_mean)))
                 else:
                     b = self._drain_cbow(vocab_words, lt.table, rng_neg,
                                          force, hs_tables=hs_tables)
@@ -405,7 +405,7 @@ class SequenceVectors(WordVectors):
                         syn0, syn1, syn1neg, jnp.asarray(ctxw),
                         jnp.asarray(cmask), jnp.asarray(pts), jnp.asarray(cds),
                         jnp.asarray(cm), jnp.asarray(neg), jnp.asarray(nl),
-                        jnp.asarray(nm), jnp.float32(decay(seen)))
+                        jnp.asarray(nm), np.float32(decay(seen)))
                 if force and self._pending_empty(batcher):
                     return
 

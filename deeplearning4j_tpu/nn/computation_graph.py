@@ -20,10 +20,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from . import precision as _precision
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, build_tx,
                       fit_on_device_epochs, hyperparam_conf)
 from .compile_cache import shared_jit, topology_signature
+from .multilayer import _cast_act
 from .conf.computation_graph import (ComputationGraphConfiguration,
                                      GraphVertexConf, LayerVertex)
 from .conf.updaters import Sgd, UpdaterConf
@@ -49,7 +51,7 @@ def _vertex_confs(conf) -> Dict[str, Any]:
 
 def _graph_forward(conf, params, state, inputs: List[Array], *, train: bool,
                    key, masks: Optional[List[Optional[Array]]] = None,
-                   exclude_outputs: bool = False):
+                   exclude_outputs: bool = False, precision=None):
     """Walk the static topological order; returns (acts, new_state, masks).
 
     acts: dict vertex-name -> activation (plus network inputs).  A free
@@ -80,6 +82,9 @@ def _graph_forward(conf, params, state, inputs: List[Array], *, train: bool,
         if mi:
             ms = [mask_of.get(mi)] + ms[1:]
         lkey = jax.random.fold_in(key, vi) if key is not None else None
+        if precision is not None:
+            vdt = precision.layer_dtype(getattr(v, "layer", None) or v)
+            xs = [_cast_act(x, vdt) for x in xs]
         variables = {"params": params.get(name, {}),
                      "state": state.get(name, {})}
         if train and conf.defaults.get("cache_mode") == "remat" and \
@@ -100,10 +105,10 @@ def _graph_forward(conf, params, state, inputs: List[Array], *, train: bool,
 
 
 def _graph_loss(conf, params, state, inputs, labels, *, train: bool, key,
-                masks=None, label_masks=None):
+                masks=None, label_masks=None, precision=None):
     acts, new_state, mask_of = _graph_forward(
         conf, params, state, inputs, train=train, key=key, masks=masks,
-        exclude_outputs=True)
+        exclude_outputs=True, precision=precision)
     total = jnp.zeros(())
     for oi, name in enumerate(conf.network_outputs):
         v = conf.vertices[name]
@@ -113,6 +118,10 @@ def _graph_loss(conf, params, state, inputs, labels, *, train: bool, key,
                 f"network output '{name}' is not an output layer vertex")
         src = conf.vertex_inputs[name][0]
         h = acts[src]
+        if precision is not None:
+            # head matmul in the compute dtype; the loss reductions
+            # upcast to f32 inside nn/losses
+            h = _cast_act(h, precision.layer_dtype(v.layer))
         lm = None
         if label_masks is not None and oi < len(label_masks):
             lm = label_masks[oi]
@@ -167,22 +176,37 @@ def _build_graph_train_step(conf, tx):
     gn_mode = conf.defaults.get("gradient_normalization")
     gn_thr = float(conf.defaults.get(
         "gradient_normalization_threshold", 1.0))
-    cdtype = conf.defaults.get("compute_dtype")
+    pol = _precision.resolve(conf.defaults)
     confs = _vertex_confs(conf)
+    cast_map = {}
+    if pol is not None:
+        for name, v in conf.vertices.items():
+            dt = pol.layer_dtype(getattr(v, "layer", None) or v)
+            if dt not in (None, "float32"):
+                cast_map[name] = dt
 
     def step(params, state, opt_state, key, xs, ys, masks, label_masks):
-        if cdtype is not None:
-            xs = [x.astype(cdtype) for x in xs]
+        if pol is not None:
+            xs = [_cast_act(x, pol.compute_dtype) for x in xs]
+        ls = state.get(_precision.SCALE_STATE_KEY) \
+            if pol is not None and pol.scaled else None
+        scale = ls["scale"] if ls is not None else None
 
         def loss_fn(p):
-            if cdtype is not None:
-                p = _cast_floats(p, cdtype)
+            if cast_map:
+                p = {k: (_cast_floats(v, cast_map[k]) if k in cast_map
+                         else v) for k, v in p.items()}
             loss, new_state = _graph_loss(conf, p, state, xs, ys,
                                           train=True, key=key, masks=masks,
-                                          label_masks=label_masks)
-            return loss, new_state
-        (loss, new_state), grads = \
+                                          label_masks=label_masks,
+                                          precision=pol)
+            obj = loss * scale if scale is not None else loss
+            return obj, (loss, new_state)
+        (_obj, (loss, new_state)), grads = \
             jax.value_and_grad(loss_fn, has_aux=True)(params)
+        finite = None
+        if scale is not None:
+            grads, finite = _precision.unscale_and_check(grads, scale)
         grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
         gleaves = jax.tree_util.tree_leaves(grads)
         gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
@@ -193,10 +217,17 @@ def _build_graph_train_step(conf, tx):
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_params = apply_constraints_all(new_params, confs)
-        if cdtype is not None:
-            new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
-        return (new_params, new_state, new_opt, loss,
-                {"global_norm": gnorm, "layer_norms": glayer})
+        if pol is not None:
+            new_state = _cast_floats(new_state, jnp.float32,
+                                     only=pol.compute_dtype)
+        gstats = {"global_norm": gnorm, "layer_norms": glayer}
+        if ls is not None:
+            # overflow: skip the step wholesale (nn/precision)
+            new_params, new_opt, new_state, _sel = \
+                _precision.overflow_skip(
+                    pol, ls, finite, params, new_params, opt_state,
+                    new_opt, state, new_state, gstats)
+        return new_params, new_state, new_opt, loss, gstats
 
     return step
 
@@ -234,6 +265,10 @@ class ComputationGraph:
             out = v.init(sub, itypes)
             self.params[name] = out.get("params", {})
             self.state[name] = out.get("state", {})
+        ls = _precision.init_scale_state(
+            _precision.resolve(self.conf.defaults))
+        if ls is not None:
+            self.state[_precision.SCALE_STATE_KEY] = ls
         self._tx = self._build_tx()
         self.opt_state = self._tx.init(self.params)
         return self

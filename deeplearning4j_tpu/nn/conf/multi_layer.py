@@ -297,9 +297,51 @@ class NeuralNetConfiguration:
             """Mixed precision: master params/optimizer state stay float32,
             forward+backward run in ``dt`` (normally 'bfloat16' — the TPU
             MXU's native input type).  Normalization statistics are kept
-            float32.  The reference has no equivalent (CUDA fp32); this is
-            the TPU-idiomatic fast path."""
+            float32.  The reference has no equivalent (CUDA fp32); this
+            is shorthand for :meth:`precision` — use that for loss
+            scaling or per-layer overrides."""
             self._defaults["compute_dtype"] = str(dt)
+            return self
+
+        def precision(self, policy):
+            """First-class mixed-precision policy (``nn/precision``):
+            a ``PrecisionPolicy`` instance, or a shorthand string —
+            'bfloat16' (bf16 compute / f32 masters, no scaling),
+            'float16' (f16 compute with dynamic loss scaling), 'float32'
+            (full precision).  BatchNorm and loss/softmax reductions stay
+            f32; the policy participates in the compile-cache topology
+            signature, so variants never share a trace."""
+            from ..precision import PrecisionPolicy, named_policy
+            if isinstance(policy, str):
+                policy = named_policy(policy)
+            if not isinstance(policy, PrecisionPolicy):
+                raise ValueError(
+                    "precision() takes a PrecisionPolicy or a dtype "
+                    f"shorthand string, got {type(policy).__name__}")
+            self._defaults["precision"] = policy
+            # mirror the legacy knob for consumers that only need the
+            # compute dtype (memory reports, zoo model builders)
+            if policy.compute_dtype:
+                self._defaults["compute_dtype"] = policy.compute_dtype
+            return self
+
+        def scan_layers(self, mode):
+            """Scan-over-layers control (``nn/scan_layers``): ``False``
+            (or ``0``, mirroring ``DL4J_TPU_SCAN_LAYERS=0``) disables for
+            this conf, ``True`` uses the process default minimum run
+            length (``DL4J_TPU_SCAN_MIN``, default 4), an int >= 2
+            overrides the minimum homogeneous-run length."""
+            if not isinstance(mode, (bool, int)):
+                raise ValueError("scan_layers(True|False|min_run_length)")
+            if not isinstance(mode, bool):
+                if mode == 0:
+                    mode = False       # env-flag parity: 0 means off
+                elif mode < 2:
+                    raise ValueError(
+                        "scan_layers min run length must be >= 2 "
+                        "(a 1-layer 'run' cannot scan); use False/0 to "
+                        "disable")
+            self._defaults["scan_layers"] = mode
             return self
 
         def optimization_algo(self, algo: str, max_iterations: int = 100):

@@ -30,10 +30,33 @@ _EPS = 1e-7
 
 _REGISTRY: Dict[str, Callable] = {}
 
+_LOW_PRECISION = ("bfloat16", "float16")
+
+
+def _f32_loss_inputs(fn: Callable) -> Callable:
+    """Loss reductions always run in float32: a low-precision stack keeps
+    its matmuls in bf16/f16, but the fused softmax/log-softmax and the
+    masked-mean reductions inside every loss are exactly the cancellations
+    low precision gets wrong (nn/precision.py — the PrecisionPolicy
+    contract).  Full-precision inputs pass through untouched, so f32 nets
+    are bit-identical to the pre-shim behavior."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(labels, preout, *args, **kwargs):
+        if hasattr(preout, "dtype") and str(preout.dtype) in _LOW_PRECISION:
+            preout = preout.astype(jnp.float32)
+            if hasattr(labels, "dtype") and \
+                    str(labels.dtype) in _LOW_PRECISION:
+                labels = labels.astype(jnp.float32)
+        return fn(labels, preout, *args, **kwargs)
+
+    return wrapped
+
 
 def register(name: str):
     def deco(fn):
-        _REGISTRY[name.lower()] = fn
+        _REGISTRY[name.lower()] = _f32_loss_inputs(fn)
         return fn
     return deco
 

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from . import precision as _precision
+from . import scan_layers as _scan_layers
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, apply_gradient_normalization,
                       build_tx, fit_on_device_epochs, hyperparam_conf)
@@ -65,10 +67,20 @@ def _layer_confs(conf) -> Dict[str, Any]:
     return {f"layer_{i}": lc for i, lc in enumerate(conf.layers)}
 
 
+def _cast_act(h, dtype: Optional[str]):
+    """Cast a floating activation to a policy dtype (ints — token ids —
+    pass through untouched)."""
+    if dtype is None or not hasattr(h, "dtype") or \
+            not jnp.issubdtype(h.dtype, jnp.floating) or \
+            str(h.dtype) == dtype:
+        return h
+    return h.astype(dtype)
+
+
 def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
                    to_layer: Optional[int] = None, collect: bool = False,
                    carries: Optional[Dict[str, Any]] = None,
-                   return_mask: bool = False):
+                   return_mask: bool = False, precision=None):
     """Trace the layer stack; returns (final_activation_or_list, new_state).
 
     A free function over the *configuration* — it must never touch a
@@ -80,13 +92,28 @@ def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
     (tBPTT chunk state / rnnTimeStep streaming state). When given, a dict
     of the same shape is written back into ``carries`` (callers pass a
     mutable dict and read the updated entries).
+
+    precision: resolved ``PrecisionPolicy`` for mixed-precision walks
+    (the train step passes it; inference/score paths keep their
+    full-precision numerics and pass None).
+
+    Homogeneous layer runs (identical confs repeated — a deep transformer
+    stack) execute under ``jax.lax.scan`` so the program traces ONE layer
+    body instead of N (``nn/scan_layers``); everything else walks
+    unrolled, bit-identically to the pre-scan code.
     """
     layers = conf.layers
     n = len(layers) if to_layer is None else to_layer
+    remat = bool(train and conf.defaults.get("cache_mode") == "remat")
+    runs = dict(_scan_layers.scan_runs(
+        conf, n, mask_present=mask is not None,
+        carries_present=carries is not None, collect=collect,
+        policy=precision))
     new_state = dict(state)
     acts = []
     h = x
-    for i in range(n):
+    i = 0
+    while i < n:
         lc = layers[i]
         pp = conf.preprocessor(i)
         if pp is not None:
@@ -95,6 +122,19 @@ def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
                 itype = conf.layer_input_types[i] if conf.layer_input_types \
                     else None
                 mask = pp.feed_forward_mask(mask, itype)
+        if precision is not None:
+            h = _cast_act(h, precision.layer_dtype(lc))
+        stop = runs.get(i)
+        if stop is not None:
+            # homogeneous run [i, stop): ONE traced body under lax.scan
+            h, run_states = _scan_layers.run_scan(
+                lc, [params.get(f"layer_{j}", {}) for j in range(i, stop)],
+                [state.get(f"layer_{j}", {}) for j in range(i, stop)],
+                h, key, i, train=train, mask=mask, remat=remat)
+            for off, ls in enumerate(run_states):
+                new_state[f"layer_{i + off}"] = ls
+            i = stop
+            continue
         lkey = jax.random.fold_in(key, i) if key is not None else None
         variables = {"params": params.get(f"layer_{i}", {}),
                      "state": state.get(f"layer_{i}", {})}
@@ -105,7 +145,7 @@ def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
                 mask=mask)
             carries[lname] = new_carry
             lstate = variables.get("state", {})
-        elif train and conf.defaults.get("cache_mode") == "remat":
+        elif remat:
             # rematerialize per-layer activations on the backward pass
             # (the WorkspaceMode/CacheMode role: trade FLOPs for HBM)
             def _apply(vv, hh, kk, mm, _lc=lc):
@@ -119,6 +159,7 @@ def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
             mask = lc.feed_forward_mask(mask, None)
         if collect:
             acts.append(h)
+        i += 1
     out = acts if collect else h
     if return_mask:
         return out, new_state, mask
@@ -126,7 +167,7 @@ def _stack_forward(conf, params, state, x, *, train: bool, key, mask=None,
 
 
 def _stack_loss(conf, params, state, x, y, *, train: bool, key, mask=None,
-                label_mask=None, carries=None):
+                label_mask=None, carries=None, precision=None):
     """Forward to last layer's loss + regularization (reference
     computeGradientAndScore, MultiLayerNetwork.java:2206).  Free function
     over the configuration — see ``_stack_forward``."""
@@ -134,7 +175,8 @@ def _stack_loss(conf, params, state, x, y, *, train: bool, key, mask=None,
     n = len(layers)
     h, new_state, pmask = _stack_forward(
         conf, params, state, x, train=train, key=key, mask=mask,
-        to_layer=n - 1, carries=carries, return_mask=True)
+        to_layer=n - 1, carries=carries, return_mask=True,
+        precision=precision)
     out_conf = layers[-1]
     if not hasattr(out_conf, "compute_loss"):
         raise ValueError(
@@ -142,6 +184,10 @@ def _stack_loss(conf, params, state, x, y, *, train: bool, key, mask=None,
     pp = conf.preprocessor(n - 1)
     if pp is not None:
         h = pp.pre_process(h, mask)
+    if precision is not None:
+        # the head's matmul runs in the compute dtype; the fused
+        # softmax/loss reductions upcast to f32 inside nn/losses
+        h = _cast_act(h, precision.layer_dtype(out_conf))
     lkey = jax.random.fold_in(key, n - 1) if key is not None else None
     variables = {"params": params.get(f"layer_{n-1}", {}),
                  "state": state.get(f"layer_{n-1}", {})}
@@ -203,20 +249,35 @@ def _build_stack_fn(conf, tx, kind: str):
 def _build_train_step(conf, tx, with_carry: bool):
     gn_mode = conf.defaults.get("gradient_normalization")
     gn_thr = float(conf.defaults.get("gradient_normalization_threshold", 1.0))
-    cdtype = conf.defaults.get("compute_dtype")
+    pol = _precision.resolve(conf.defaults)
     confs = _layer_confs(conf)
+    # per-layer compute dtypes, resolved once at build time (keep_f32
+    # classes and per-name overrides stay f32 — their params are never
+    # downcast, and _stack_forward casts activations to match)
+    cast_map = {}
+    if pol is not None:
+        for name, lc in confs.items():
+            dt = pol.layer_dtype(lc)
+            if dt not in (None, "float32"):
+                cast_map[name] = dt
 
     def step(params, state, opt_state, key, x, y, mask, label_mask,
              carries=None):
-        if cdtype is not None:
-            x = x.astype(cdtype)
+        if pol is not None:
+            # floating inputs only: integer token ids must reach the
+            # embedding gather exact (a bf16 cast quantizes ids > 256)
+            x = _cast_act(x, pol.compute_dtype)
+        ls = state.get(_precision.SCALE_STATE_KEY) \
+            if pol is not None and pol.scaled else None
+        scale = ls["scale"] if ls is not None else None
 
         def loss_fn(p):
-            if cdtype is not None:
-                # mixed precision: cast params for the traced stack;
-                # grads w.r.t. the f32 masters accumulate in f32 (the
-                # cast is part of the differentiated program)
-                p = _cast_floats(p, cdtype)
+            if cast_map:
+                # mixed precision: cast params per layer for the traced
+                # stack; grads w.r.t. the f32 masters accumulate in f32
+                # (the cast is part of the differentiated program)
+                p = {k: (_cast_floats(v, cast_map[k]) if k in cast_map
+                         else v) for k, v in p.items()}
             if with_carry:
                 # carry state flows INTO the chunk; gradients do not flow
                 # back across the chunk boundary (tBPTT truncation).
@@ -224,14 +285,22 @@ def _build_train_step(conf, tx, with_carry: bool):
                                                  carries))
                 loss, new_state = _stack_loss(
                     conf, p, state, x, y, train=True, key=key, mask=mask,
-                    label_mask=label_mask, carries=cs)
-                return loss, (new_state, cs)
-            loss, new_state = _stack_loss(conf, p, state, x, y, train=True,
-                                          key=key, mask=mask,
-                                          label_mask=label_mask)
-            return loss, (new_state, None)
-        (loss, (new_state, new_carries)), grads = \
+                    label_mask=label_mask, carries=cs, precision=pol)
+            else:
+                cs = None
+                loss, new_state = _stack_loss(
+                    conf, p, state, x, y, train=True, key=key, mask=mask,
+                    label_mask=label_mask, precision=pol)
+            # loss scaling happens on the objective so the whole backward
+            # pass sees scaled gradients (fp16 underflow protection); the
+            # reported loss stays unscaled
+            obj = loss * scale if scale is not None else loss
+            return obj, (loss, new_state, cs)
+        (_obj, (loss, new_state, new_carries)), grads = \
             jax.value_and_grad(loss_fn, has_aux=True)(params)
+        finite = None
+        if scale is not None:
+            grads, finite = _precision.unscale_and_check(grads, scale)
         grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
         # per-iteration gradient stats for listeners (reference
         # ParamAndGradientIterationListener / StatsListener): computed
@@ -245,11 +314,22 @@ def _build_train_step(conf, tx, with_carry: bool):
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_params = apply_constraints_all(new_params, confs)
-        if cdtype is not None:
+        if pol is not None:
             # keep running state (BN statistics) in f32 so the step's
             # input/output treedefs+dtypes stay fixed across iterations
-            new_state = _cast_floats(new_state, jnp.float32, only=cdtype)
+            new_state = _cast_floats(new_state, jnp.float32,
+                                     only=pol.compute_dtype)
         gstats = {"global_norm": gnorm, "layer_norms": glayer}
+        if ls is not None:
+            new_params, new_opt, new_state, sel = _precision.overflow_skip(
+                pol, ls, finite, params, new_params, opt_state, new_opt,
+                state, new_state, gstats)
+            if with_carry:
+                # the overflowed forward also poisoned the recurrent
+                # carries — a skipped chunk must hand the NEXT chunk its
+                # pre-step carries, or one overflow taints the rest of
+                # the sequence
+                new_carries = sel(new_carries, carries)
         if with_carry:
             return (new_params, new_state, new_opt, loss, gstats,
                     new_carries)
@@ -325,6 +405,12 @@ class MultiLayerNetwork:
             v = lc.init(sub, itype)
             self.params[f"layer_{i}"] = v.get("params", {})
             self.state[f"layer_{i}"] = v.get("state", {})
+        ls = _precision.init_scale_state(
+            _precision.resolve(self.conf.defaults))
+        if ls is not None:
+            # loss-scale carry rides the state pytree: donated through the
+            # step, checkpointed, and averaged like any training state
+            self.state[_precision.SCALE_STATE_KEY] = ls
         self._tx = self._build_tx()
         self.opt_state = self._tx.init(self.params)
         return self

@@ -1,0 +1,221 @@
+"""First-class mixed-precision policy.
+
+The reference runs CUDA fp32 end to end; the TPU-native fast path is
+bf16 compute against f32 master weights (the MXU's native input type),
+and fp16 needs loss scaling on top.  Instead of sprinkling ``.astype``
+casts through user code (the TensorFlow-paper position: dtype decisions
+belong in the SYSTEM — arxiv 1605.08695), the whole dtype story lives in
+one conf-level object:
+
+  - ``param_dtype``   master params + updater state (f32: the updater
+    accumulates in full precision regardless of compute dtype)
+  - ``compute_dtype`` forward/backward math (bf16 / f16)
+  - ``keep_f32``      layer classes whose math stays f32 inside a
+    low-precision stack (default: BatchNormalization — batch statistics
+    are variance-of-mean reductions that cancel catastrophically in
+    bf16); loss reductions and the fused softmax/log-softmax inside loss
+    functions always run f32 (``nn/losses`` upcasts low-precision
+    pre-activations at entry)
+  - ``overrides``     per-layer dtype by layer NAME (``{"layer3":
+    "float32"}`` pins one layer of an otherwise-bf16 stack)
+  - ``loss_scale``    ``None`` | fixed float | ``"dynamic"``: the loss is
+    multiplied by the scale inside the jitted step and gradients
+    unscaled after ``value_and_grad``; non-finite gradients SKIP the
+    update (params/updater/state unchanged) and halve the scale, while
+    ``growth_interval`` consecutive finite steps double it — all traced
+    into the step, zero extra dispatches.  fp16 defaults to dynamic.
+
+The policy object lives in ``conf.defaults`` and therefore participates
+in the compile-cache topology signature: an f32 and a bf16 variant of
+the same stack can never false-share a trace, while two nets with equal
+policies still share one compiled step.
+
+Dynamic-scale state rides in the network ``state`` pytree under the
+reserved ``"__precision__"`` key (a dict of three scalars), so it is
+donated through the step, checkpointed, and restored like every other
+piece of training state.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils.serde import register_serde
+
+#: reserved key in the network ``state`` pytree for loss-scale state
+SCALE_STATE_KEY = "__precision__"
+
+_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "mixed_bfloat16": "bfloat16",
+    "f16": "float16", "fp16": "float16", "float16": "float16",
+    "mixed_float16": "float16",
+    "f32": "float32", "fp32": "float32", "float32": "float32",
+}
+
+
+def _canon_dtype(dt: Optional[str]) -> Optional[str]:
+    if dt is None:
+        return None
+    s = str(dt).lower()
+    return _ALIASES.get(s, s)
+
+
+@register_serde
+@dataclass
+class PrecisionPolicy:
+    """Conf-level mixed-precision policy (see module docstring)."""
+    compute_dtype: Optional[str] = None      # None/float32 = full precision
+    param_dtype: str = "float32"
+    loss_scale: Optional[Any] = None         # None | float | "dynamic"
+    initial_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 200
+    keep_f32: Tuple[str, ...] = ("BatchNormalization",)
+    overrides: Optional[Dict[str, str]] = None   # layer name -> dtype
+
+    def __post_init__(self):
+        self.compute_dtype = _canon_dtype(self.compute_dtype)
+        self.param_dtype = _canon_dtype(self.param_dtype) or "float32"
+
+    # ----------------------------------------------------------- queries
+    @property
+    def active(self) -> bool:
+        return self.compute_dtype not in (None, "float32")
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    @property
+    def scaled(self) -> bool:
+        return self.loss_scale is not None
+
+    def layer_dtype(self, lc) -> Optional[str]:
+        """Compute dtype for one layer conf: per-name override, else f32
+        for keep_f32 classes (wrappers resolved through
+        ``hyperparam_conf``), else the stack compute dtype.  ``None`` when
+        the policy is inactive."""
+        if not self.active:
+            return None
+        name = getattr(lc, "name", None)
+        if self.overrides and name in self.overrides:
+            return _canon_dtype(self.overrides[name])
+        from ._common import hyperparam_conf
+        hc = hyperparam_conf(lc) or lc
+        kinds = {type(hc).__name__, type(lc).__name__}
+        if kinds & set(self.keep_f32):
+            return "float32"
+        return self.compute_dtype
+
+
+def named_policy(name: str) -> PrecisionPolicy:
+    """Policy from a shorthand string: ``'bfloat16'``/``'bf16'`` (no
+    scaling), ``'float16'``/``'f16'``/``'mixed_float16'`` (dynamic
+    scaling), ``'float32'`` (inactive)."""
+    dt = _canon_dtype(name)
+    if dt not in ("bfloat16", "float16", "float32"):
+        raise ValueError(
+            f"unknown precision '{name}' — use 'bfloat16', 'float16', "
+            "'float32', or a PrecisionPolicy(...)")
+    scale = "dynamic" if dt == "float16" else None
+    return PrecisionPolicy(compute_dtype=None if dt == "float32" else dt,
+                           loss_scale=scale)
+
+
+def resolve(defaults: Dict[str, Any]) -> Optional[PrecisionPolicy]:
+    """Resolved policy for a conf's ``defaults`` dict, or ``None`` for a
+    full-precision net.  Back-compat: a bare ``compute_dtype`` string
+    (the pre-policy knob) resolves to a plain bf16/f16 policy."""
+    p = defaults.get("precision")
+    if isinstance(p, str):
+        p = named_policy(p)
+    if p is None:
+        cd = _canon_dtype(defaults.get("compute_dtype"))
+        if cd and cd != "float32":
+            p = PrecisionPolicy(compute_dtype=cd)
+    if p is None or not p.active:
+        return None
+    if p.compute_dtype == "float16" and p.loss_scale is None:
+        # fp16 without scaling underflows small gradients — dynamic is
+        # the only safe default
+        p = dataclasses.replace(p, loss_scale="dynamic")
+    return p
+
+
+# ------------------------------------------------------------- step helpers
+def init_scale_state(policy: Optional[PrecisionPolicy]):
+    """Loss-scale carry for ``state[SCALE_STATE_KEY]`` (``None`` when the
+    policy needs none).  Fixed-scale policies still carry the state so
+    skip-step bookkeeping (``overflow_steps``) is observable."""
+    if policy is None or not policy.scaled:
+        return None
+    import jax.numpy as jnp
+    init = policy.initial_scale if policy.dynamic else float(policy.loss_scale)
+    return {"scale": jnp.asarray(init, jnp.float32),
+            "good_steps": jnp.asarray(0, jnp.int32),
+            "overflow_steps": jnp.asarray(0, jnp.int32)}
+
+
+def unscale_and_check(grads, scale):
+    """Undo the loss scale on the gradient tree and report whether every
+    leaf is finite — traced into the step."""
+    import jax
+    import jax.numpy as jnp
+    inv = 1.0 / scale
+    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    checks = [jnp.all(jnp.isfinite(g))
+              for g in jax.tree_util.tree_leaves(grads)]
+    finite = jnp.stack(checks).all() if checks else jnp.asarray(True)
+    return grads, finite
+
+
+def overflow_skip(policy: PrecisionPolicy, ls: Dict[str, Any], finite,
+                  params, new_params, opt_state, new_opt, state, new_state,
+                  gstats):
+    """Non-finite grads SKIP the step wholesale: params, updater state and
+    layer state all keep their pre-step values, the scale backs off, the
+    overflow counter ticks — all where-selected inside the one traced
+    program (zero extra dispatches).  Returns the selected
+    ``(new_params, new_opt, new_state, sel)``; callers with extra
+    per-step outputs (tBPTT carries) reuse ``sel`` on them."""
+    import jax
+    import jax.numpy as jnp
+
+    def sel(new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(finite, a, b), new, old)
+
+    new_params = sel(new_params, params)
+    new_opt = sel(new_opt, opt_state)
+    old_layers = {k: v for k, v in state.items() if k != SCALE_STATE_KEY}
+    new_layers = {k: v for k, v in new_state.items()
+                  if k != SCALE_STATE_KEY}
+    new_state = sel(new_layers, old_layers)
+    new_state[SCALE_STATE_KEY] = next_scale_state(policy, ls, finite)
+    gstats["loss_scale"] = ls["scale"]
+    gstats["overflow"] = jnp.where(finite, 0, 1)
+    return new_params, new_opt, new_state, sel
+
+
+def next_scale_state(policy: PrecisionPolicy, ls: Dict[str, Any], finite):
+    """Traced update of the loss-scale carry after one step whose
+    gradients were ``finite`` (a traced bool scalar)."""
+    import jax.numpy as jnp
+    scale, good = ls["scale"], ls["good_steps"]
+    overflow = ls["overflow_steps"] + jnp.where(finite, 0, 1).astype(
+        jnp.int32)
+    if not policy.dynamic:
+        return {"scale": scale, "good_steps": good,
+                "overflow_steps": overflow}
+    good = jnp.where(finite, good + 1, 0).astype(jnp.int32)
+    grow = finite & (good >= policy.growth_interval)
+    scale = jnp.where(
+        grow, scale * policy.growth_factor,
+        jnp.where(finite, scale, scale * policy.backoff_factor))
+    # never scale below 1 (pointless) or above f32 range
+    scale = jnp.clip(scale, 1.0, 2.0 ** 60)
+    good = jnp.where(grow, 0, good).astype(jnp.int32)
+    return {"scale": scale, "good_steps": good, "overflow_steps": overflow}

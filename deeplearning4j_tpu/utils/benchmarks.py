@@ -204,6 +204,86 @@ def transformer_lm_step_time(batch: int = 16, seq: int = 512,
     return out
 
 
+def step_time_ms(seqs=(128, 512, 2048), dtypes=("float32", "bfloat16"),
+                 batch: int = 16, big_mult: int = 4, embed: int = 256,
+                 n_layers: int = 4, n_heads: int = 8, vocab: int = 2048,
+                 steps: int = 20, adapt_cap: int = 2000,
+                 compile_cost_s=None, step_cost_s=None) -> List[Dict]:
+    """Per-step train time through the PER-STEP fit path under a
+    mixed-size workload, auto shape policy vs off (ISSUE 6 acceptance:
+    the s=128 bucketing regression must stay within 10% of the
+    off-policy reference).
+
+    Each row reproduces the regression scenario directly: one batch at
+    ``batch x big_mult`` compiles a large bucket, then the workload
+    settles on ``batch``-sized steps.  The pre-cost-model auto policy
+    padded EVERY small step onto the big bucket (paying ``big_mult``x
+    the flops forever); the ski-rental cost model pads only until the
+    cumulative waste rivals one compile, then gives the recurring size
+    its own executable — ``adapt_steps`` reports how many padded steps
+    that took.  The timed window starts after adaptation, so ``value``
+    is the steady per-step cost a long-running job pays.  The f32/bf16
+    sweep makes the PrecisionPolicy step-time win visible on the same
+    trajectory (``DL4J_TPU_BENCH_DTYPE``-independent: both always run).
+    """
+    import jax.numpy as jnp
+
+    from ..data.shapes import ShapePolicy
+    from ..models import TransformerLM
+
+    rng = np.random.default_rng(0)
+    out = []
+    for seq in seqs:
+        ids_big = rng.integers(0, vocab, (batch * big_mult, seq + 1))
+        ids = rng.integers(0, vocab, (batch, seq + 1))
+        xb, yb = jnp.asarray(ids_big[:, :-1]), jnp.asarray(ids_big[:, 1:])
+        x, y = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+        for dt in dtypes:
+            per_policy = {}
+            for mode in ("auto", "off"):
+                model = TransformerLM(
+                    vocab_size=vocab, seq_len=seq, embed=embed,
+                    n_layers=n_layers, n_heads=n_heads, sparse_labels=True,
+                    compute_dtype=None if dt == "float32" else dt).init()
+                model.shape_policy = ShapePolicy(
+                    mode, compile_cost_s=compile_cost_s,
+                    step_cost_s=step_cost_s)
+                model.fit_batch((xb, yb))   # the large compiled bucket
+                # adaptation: drive small steps (through fit, so the
+                # steady step-seconds histogram feeds the cost model)
+                # until the policy stops padding onto the big bucket
+                adapted = mode == "off"
+                n_adapt = 0
+                while not adapted and n_adapt < adapt_cap:
+                    chunk = min(25, adapt_cap - n_adapt)
+                    model.fit(iter([(x, y, None, None)] * chunk))
+                    n_adapt += chunk
+                    seen = {tuple(e[:2]): e[2] for e in
+                            model.shape_policy.snapshot()["seen"]}
+                    adapted = batch in (seen.get(("train", "batch")) or [])
+                model.fit_batch((x, y))     # warm the steady executable
+                t0 = monotonic_s()
+                model.fit(iter([(x, y, None, None)] * steps))
+                # _fit_one syncs the loss per step: the clock closes on
+                # device completion, not enqueue
+                ms = (monotonic_s() - t0) / steps * 1e3
+                per_policy[mode] = (ms, n_adapt)
+            auto_ms, n_adapt = per_policy["auto"]
+            off_ms, _ = per_policy["off"]
+            tag = "f32" if dt == "float32" else dt
+            out.append({
+                "metric": f"step_time_ms[s={seq},{tag}]",
+                "value": round(auto_ms, 3), "unit": "ms/step (auto policy)",
+                "off_policy_ms": round(off_ms, 3),
+                "vs_off": round(auto_ms / off_ms, 3) if off_ms else None,
+                "adapt_steps": n_adapt,
+                "batch": batch, "seq": seq, "dtype": dt,
+                "big_bucket": batch * big_mult,
+                "tokens_per_sec": round(batch * seq / auto_ms * 1e3, 1),
+            })
+    return out
+
+
 class _PipelineBenchSource:
     """Picklable source factory for the input-pipeline benchmark: every ETL
     worker regenerates the same synthetic image set (cheaper and more

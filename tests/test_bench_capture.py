@@ -50,3 +50,25 @@ def test_exhausted_retries_tag_invalid():
     assert len(calls) == 3
     assert rows[0]["invalid"] is True
     assert rows[0]["tunnel_probe"]["healthy"] is False
+
+
+def test_step_time_ms_rows():
+    """The step-time engine bench line (ISSUE 6): auto-vs-off rows per
+    (seq, dtype) with the cost-model adaptation count.  Tiny CPU config;
+    injected costs make the cost model switch to a native compile
+    immediately, exercising the adaptation loop end to end."""
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    rows = B.step_time_ms(seqs=(16,), dtypes=("float32",), batch=4,
+                          big_mult=2, embed=32, n_layers=2, n_heads=2,
+                          vocab=64, steps=2, adapt_cap=50,
+                          compile_cost_s=0.01, step_cost_s=1.0)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "step_time_ms[s=16,f32]"
+    assert row["value"] > 0 and row["off_policy_ms"] > 0
+    assert row["vs_off"] == round(row["value"] / row["off_policy_ms"], 3)
+    assert row["big_bucket"] == 8 and row["dtype"] == "float32"
+    # step cost >> compile cost: the very first small step compiles its
+    # own bucket, so adaptation needs at most one probe chunk
+    assert 0 < row["adapt_steps"] <= 25

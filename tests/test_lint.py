@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX014 has at least one fixture that MUST fire and one
+Every rule JX001–JX015 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -674,6 +674,67 @@ def test_jx014_negative_same_name_in_unrelated_function():
     """)
 
 
+# ---------------------------------------------------------------- JX015
+def test_jx015_positive_astype_on_device_value_in_loop():
+    assert "JX015" in rules_of("""
+        import jax.numpy as jnp
+
+        def train(step, batches, params):
+            xb = jnp.zeros((4, 4))
+            for b in batches:
+                xb = xb.astype(jnp.bfloat16)    # cast dispatch per step
+                params = step(params, xb)
+            return params
+    """)
+
+
+def test_jx015_positive_dtype_ctor_in_loop():
+    assert "JX015" in rules_of("""
+        import jax.numpy as jnp
+
+        def train(step, params, lr):
+            for i in range(100):
+                params = step(params, jnp.float32(lr))
+            return params
+    """)
+
+
+def test_jx015_negative_host_numpy_hoisted_and_jit():
+    assert "JX015" not in rules_of("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def etl(batches):
+            out = []
+            for b in batches:
+                out.append(b.astype(np.float32))   # host ETL: legal
+            return out
+
+        def train(step, params, batches, lr):
+            lr_s = jnp.float32(lr)                 # hoisted: placed once
+            for b in batches:
+                params = step(params, b, lr_s, np.float32(0.1))
+            return params
+
+        @jax.jit
+        def f(x):
+            for i in range(3):
+                x = x.astype(jnp.bfloat16)         # traced, not dispatched
+            return x
+    """)
+
+
+def test_jx015_pragma_suppresses():
+    assert "JX015" not in rules_of("""
+        import jax.numpy as jnp
+
+        def probe(step, params):
+            for i in range(3):
+                step(params, jnp.float32(i))  # graftlint: disable=JX015  (3-iteration probe)
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -793,7 +854,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 14
+    assert len(RULES) == 15
 
 
 def test_package_is_clean_modulo_baseline():

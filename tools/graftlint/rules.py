@@ -1,4 +1,4 @@
-"""graftlint rule implementations JX001–JX014.
+"""graftlint rule implementations JX001–JX015.
 
 Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
 registered in ``RULES``.  Rules share the jit-scope + taint machinery in
@@ -486,6 +486,56 @@ def jx011(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+def _expr_is_device_value(info: ModuleInfo, node: ast.AST,
+                          tracked: set) -> bool:
+    """Does this expression produce a device array? jnp./jax. dotted
+    calls, bare device_put, or a tracked name / subscript of one.
+    (Shared by JX012/JX015.)"""
+    if isinstance(node, ast.Call):
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        if len(parts) >= 2 and parts[0] in (info.jnp_aliases
+                                            | info.jax_aliases):
+            return True
+        return len(parts) == 1 and parts[0] in info.deviceput_names
+    name = dotted_name(node)
+    return name is not None and name in tracked
+
+
+def _device_names(info: ModuleInfo, cache: Dict[Optional[ast.AST], set],
+                  func: Optional[ast.AST]) -> set:
+    """Names in ``func`` (or module scope) assigned from device-valued
+    expressions, with one-hop copies, fixpointed.  (Shared by
+    JX012/JX015.)"""
+    if func in cache:
+        return cache[func]
+    scope = func if func is not None else info.tree
+    assigns = []
+    for n in ast.walk(scope):
+        if info.enclosing_function(n) is not func:
+            continue    # nested functions track their own names
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets = [n.target]
+        for t in targets:
+            key = dotted_name(t)
+            if key:
+                assigns.append((key, n.value))
+    tracked: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for key, value in assigns:
+            if key not in tracked and \
+                    _expr_is_device_value(info, value, tracked):
+                tracked.add(key)
+                changed = True
+    cache[func] = tracked
+    return tracked
+
+
 # --------------------------------------------------------------------- JX012
 @rule("JX012", "per-iteration host<->device transfer inside a loop")
 def jx012(info: ModuleInfo) -> List[Finding]:
@@ -505,48 +555,8 @@ def jx012(info: ModuleInfo) -> List[Finding]:
 
     device_names_cache: Dict[Optional[ast.AST], set] = {}
 
-    def _device_value(node: ast.AST, tracked: set) -> bool:
-        """Does this expression produce a device array? jnp./jax. dotted
-        calls, bare device_put, or a tracked name / subscript of one."""
-        if isinstance(node, ast.Call):
-            fname = call_name(node) or ""
-            parts = fname.split(".")
-            if len(parts) >= 2 and parts[0] in (info.jnp_aliases
-                                                | info.jax_aliases):
-                return True
-            return len(parts) == 1 and parts[0] in info.deviceput_names
-        name = dotted_name(node)
-        return name is not None and name in tracked
-
     def device_names(func: Optional[ast.AST]) -> set:
-        """Names in ``func`` (or module scope) assigned from device-valued
-        expressions, with one-hop copies, fixpointed."""
-        if func in device_names_cache:
-            return device_names_cache[func]
-        scope = func if func is not None else info.tree
-        assigns = []
-        for n in ast.walk(scope):
-            if info.enclosing_function(n) is not func:
-                continue    # nested functions track their own names
-            targets = []
-            if isinstance(n, ast.Assign):
-                targets = n.targets
-            elif isinstance(n, ast.AnnAssign) and n.value is not None:
-                targets = [n.target]
-            for t in targets:
-                key = dotted_name(t)
-                if key:
-                    assigns.append((key, n.value))
-        tracked: set = set()
-        changed = True
-        while changed:
-            changed = False
-            for key, value in assigns:
-                if key not in tracked and _device_value(value, tracked):
-                    tracked.add(key)
-                    changed = True
-        device_names_cache[func] = tracked
-        return tracked
+        return _device_names(info, device_names_cache, func)
 
     for node in ast.walk(info.tree):
         if not isinstance(node, ast.Call):
@@ -799,6 +809,67 @@ def jx014(info: ModuleInfo) -> List[Finding]:
                 "on — commit through the atomic temp-then-rename helper "
                 "(faulttolerance/atomic.py: atomic_file / "
                 "atomic_write_bytes)"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX015
+_JX015_DTYPE_CTORS = frozenset((
+    "float32", "float16", "bfloat16", "float64", "int32", "int64",
+    "int16", "int8", "uint8", "uint32", "complex64"))
+
+
+@rule("JX015", "per-iteration dtype cast inside a Python training loop "
+               "(host-side cast churn)")
+def jx015(info: ModuleInfo) -> List[Finding]:
+    """Flag dtype casts paid once per loop iteration: (a)
+    ``x.astype(...)`` on a *device-derived* name (assigned from a
+    ``jnp.*``/``jax.*`` call in the same function) inside a ``for``/
+    ``while`` body, and (b) ``jnp.float32(x)``-style dtype-constructor
+    calls inside a loop.  Each such cast is a separate XLA dispatch (or
+    an H2D copy) serialized against the step, and its output is a fresh
+    buffer the jitted step then re-reads — dtype decisions belong to the
+    conf-level ``PrecisionPolicy`` (``builder.precision(...)``), which
+    casts inputs/params INSIDE the compiled step, or hoisted out of the
+    loop.  Host numpy casts (ETL workers massaging ``np`` arrays) stay
+    legal, as does jitted code (a cast there is traced, not dispatched).
+    """
+    out: List[Finding] = []
+    if not (info.jax_aliases or info.jnp_aliases or info.deviceput_names):
+        return out
+    device_names_cache: Dict[Optional[ast.AST], set] = {}
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if info.in_jit_scope(node):
+            continue
+        if not _in_loop_same_function(info, node):
+            continue
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        if len(parts) == 2 and parts[0] in info.jnp_aliases and \
+                parts[1] in _JX015_DTYPE_CTORS and node.args:
+            out.append(_finding(
+                info, node, "JX015",
+                f"`{fname}(..)` inside a loop: one cast dispatch (or H2D "
+                "copy) per iteration — move the dtype decision into the "
+                "jitted step via the conf-level PrecisionPolicy "
+                "(builder.precision(...)) or hoist the cast out of the "
+                "loop"))
+            continue
+        if parts[-1] == "astype" and len(parts) >= 2 and \
+                isinstance(node.func, ast.Attribute):
+            recv = dotted_name(node.func.value)
+            if recv and recv in _device_names(
+                    info, device_names_cache,
+                    info.enclosing_function(node)):
+                out.append(_finding(
+                    info, node, "JX015",
+                    f"`{recv}.astype(..)` on a device array inside a "
+                    "loop: per-iteration cast churn serialized against "
+                    "the step — the compute dtype belongs inside the "
+                    "jitted step (conf-level PrecisionPolicy, "
+                    "builder.precision(...)), or cast once before the "
+                    "loop"))
     return _dedupe(out)
 
 
