@@ -41,6 +41,12 @@ auto shape policy vs the off-policy reference across
 seq x {f32, bf16}, with the bucket cost model's adaptation step count)
 so the s=128 bucketing regression class and the mixed-precision win are
 tracked round over round; DL4J_TPU_BENCH_STEP=0 suppresses it.
+
+A sixth JSON line records the elastic-runtime recovery benchmark
+(``recovery_time_ms``: wall time from an injected worker kill to the
+first post-recovery training step, sync-retry vs elastic-degradation
+paths) so recovery-latency regressions are driver-visible;
+DL4J_TPU_BENCH_RECOVERY=0 suppresses it.
 """
 import json
 import os
@@ -212,6 +218,19 @@ def main():
                               "unit": "ms/step (auto policy)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # recovery-time row (ISSUE 7): wall time from an injected worker kill
+    # to the first post-recovery step, sync-retry vs elastic-degradation
+    # paths; a sixth JSON line, opt-out DL4J_TPU_BENCH_RECOVERY=0
+    if os.environ.get("DL4J_TPU_BENCH_RECOVERY", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import recovery_time_ms
+            print(json.dumps(recovery_time_ms()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "recovery_time_ms", "value": None,
+                              "unit": "ms kill -> first post-recovery step "
+                                      "(sync retry)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -308,6 +327,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # time across seq x {f32, bf16} — the s=128 regression and the
         # PrecisionPolicy bf16 win ride the same trajectory
         B.step_time_ms,
+        # elastic runtime (ISSUE 7): injected-kill to first post-recovery
+        # step, sync retry vs elastic degradation
+        B.recovery_time_ms,
     ]
     side = []
     for fn in captures:

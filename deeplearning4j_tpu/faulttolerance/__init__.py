@@ -13,17 +13,30 @@ reliability):
   corrupt-checkpoint skipping) and the ``fit(checkpoint=, resume_from=)``
   integration for exact preemption-safe resume.
 - :mod:`.faults` — :class:`FaultInjector` (seeded, deterministic worker
-  fault harness) and :class:`RetryPolicy` (exponential backoff + jitter)
-  behind the training masters' retry / straggler-timeout / elastic
-  degradation machinery.
+  fault harness), :class:`RetryPolicy` (exponential backoff + jitter,
+  per-worker seeded streams) behind the training masters' retry /
+  straggler-timeout / elastic degradation machinery, and the
+  process-level chaos harness (:class:`ChaosSchedule` /
+  :class:`ChaosBroker`: seeded SIGKILLs, broker-link partitions,
+  mid-commit crashes).
+- :mod:`.cluster` — lease-based elastic membership over the shared
+  checkpoint store: :class:`FileLeaseStore`, :class:`ClusterMember`
+  heartbeats, :class:`ClusterCoordinator` (eviction, round-boundary
+  admission, rendezvous generation fencing).
 """
 from .atomic import atomic_file, atomic_write_bytes, atomic_write_json
 from .checkpoint import (CheckpointConfig, CheckpointManager,
                          CorruptCheckpointError, FitCheckpointer,
                          resume_network)
-from .faults import FaultInjector, InjectedWorkerFault, RetryPolicy
+from .cluster import (ClusterCoordinator, ClusterMember, ClusterView,
+                      FileLeaseStore, shard_owner)
+from .faults import (ChaosBroker, ChaosSchedule, FaultInjector,
+                     InjectedWorkerFault, RetryPolicy)
 
 __all__ = ["atomic_file", "atomic_write_bytes", "atomic_write_json",
            "CheckpointConfig", "CheckpointManager", "CorruptCheckpointError",
            "FitCheckpointer", "resume_network",
+           "ClusterCoordinator", "ClusterMember", "ClusterView",
+           "FileLeaseStore", "shard_owner",
+           "ChaosBroker", "ChaosSchedule",
            "FaultInjector", "InjectedWorkerFault", "RetryPolicy"]

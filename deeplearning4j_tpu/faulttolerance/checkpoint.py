@@ -120,6 +120,7 @@ class _Snapshot:
         self.opt_state = None if net.opt_state is None \
             else _host_copy(net.opt_state)
         self.iteration = int(net.iteration)
+        self.step = self.iteration      # dir-naming step; save() may override
         self.epoch = int(net.epoch)
         self.rng, self.rng_typed = _rng_to_np(net._rng)
         pol = getattr(net, "shape_policy", None)
@@ -159,6 +160,11 @@ class CheckpointManager:
         # crash-consistency test can SIGKILL a saver subprocess mid-stage
         self._test_slow_s = float(os.environ.get(
             "DL4J_TPU_CKPT_TEST_SLOW_S", "0") or 0)
+        # chaos-harness hook: a faults.ChaosSchedule attached here gets
+        # on_commit_stage(step, stage) between staged file writes and may
+        # hard-kill the process — proving the temp-then-rename protocol
+        # leaves only an ignorable .tmp- orphan, never a torn checkpoint
+        self.chaos = None
 
     # ------------------------------------------------------------- metrics
     def _reg(self):
@@ -190,15 +196,21 @@ class CheckpointManager:
 
     def save(self, net, *, cursor: Optional[Dict[str, int]] = None,
              metric: Optional[float] = None,
-             blocking: Optional[bool] = None) -> str:
+             blocking: Optional[bool] = None,
+             step: Optional[int] = None) -> str:
         """Checkpoint ``net`` at its current iteration.  The snapshot is
         taken synchronously (host copies; RNG-neutral); the write runs on
         the background worker unless ``blocking`` (default: the manager's
         ``background`` flag inverted).  At most one write is in flight —
-        a new save joins the previous one first.  Returns the directory
-        the checkpoint commits to."""
+        a new save joins the previous one first.  ``step`` overrides the
+        directory's step number (ElasticTrainer names checkpoints by its
+        global data cursor, which can outrun a member's own optimizer
+        iteration when it owns no batches in a window).  Returns the
+        directory the checkpoint commits to."""
         snap = _Snapshot(net)
-        final = self.path_for(snap.iteration)
+        if step is not None:
+            snap.step = int(step)
+        final = self.path_for(snap.step)
         if blocking is None:
             blocking = not self.background
         self.wait()                       # double-buffer: one in flight
@@ -241,9 +253,13 @@ class CheckpointManager:
                 save_updater=self.save_updater)
             if self._test_slow_s:
                 time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                self.chaos.on_commit_stage(snap.step, 1)
             np.save(os.path.join(tmp, "rng.npy"), snap.rng)
             if self._test_slow_s:
                 time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                self.chaos.on_commit_stage(snap.step, 2)
             state = {
                 "cursor": dict(cursor or {}),
                 "iteration": snap.iteration,
@@ -258,7 +274,7 @@ class CheckpointManager:
             files = manifest_for(tmp)
             nbytes = sum(int(v["bytes"]) for v in files.values())
             manifest = {"version": _MANIFEST_VERSION,
-                        "step": snap.iteration, "epoch": snap.epoch,
+                        "step": snap.step, "epoch": snap.epoch,
                         "iteration": snap.iteration,
                         "metric": state["metric"],
                         "wall_time": time.time(),

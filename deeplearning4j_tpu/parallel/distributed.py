@@ -12,11 +12,11 @@ fast-forward.  ``ElasticTrainer`` implements that loop for any model with
 from __future__ import annotations
 
 import os
-import tempfile
 from typing import Callable, Iterable, Optional
 
 import jax
-import numpy as np
+
+from ..observability.clock import monotonic_s
 
 __all__ = ["initialize_distributed", "global_device_mesh", "ElasticTrainer"]
 
@@ -50,93 +50,226 @@ def global_device_mesh(*, dp: Optional[int] = None, tp: int = 1, sp: int = 1):
 
 
 class ElasticTrainer:
-    """Checkpoint-restart training driver.
+    """Checkpoint-restart training driver over the durable
+    :class:`~..faulttolerance.checkpoint.CheckpointManager` store.
 
-    ``fit`` consumes ``iterator_factory()`` (a fresh batch iterable per call),
-    checkpoints atomically every ``save_freq`` steps, and on (re)start resumes
-    from the newest complete checkpoint — skipping the batches already
-    consumed.  Crash at any point loses at most ``save_freq - 1`` steps.
-    Reference analogues: ``earlystopping/saver/LocalFileModelSaver`` for the
-    artifact, Spark re-execution for the recovery semantics.
+    ``fit`` consumes ``iterator_factory()`` (a fresh batch iterable per
+    call), checkpoints atomically every ``save_freq`` steps through the
+    manager (manifest checksums, ``.tmp-`` staged commit — no ad-hoc zip
+    files), and on (re)start resumes from the newest COMPLETE checkpoint:
+    partial or checksum-corrupt directories are skipped, restore brings
+    back params + updater + RNG + the global data cursor, and already-
+    consumed batches fast-forward without touching the RNG — an
+    interrupted-then-resumed run matches the uninterrupted run exactly.
+    Crash at any point loses at most ``save_freq - 1`` steps.
+
+    **Elastic membership** (optional): pass a ``member``
+    (:class:`~..faulttolerance.cluster.ClusterMember`) — and, on exactly
+    one host, a ``coordinator`` — and the global batch sequence is
+    deterministically re-chunked over the CURRENT world size at every
+    round (= ``save_freq`` batches) boundary: batch ``i`` belongs to rank
+    ``i % world_size`` (``cluster.shard_owner``).  A killed host's lease
+    expires, the coordinator evicts it at the next boundary, and the
+    survivors' ownership map re-covers its shard; when the host restarts
+    it restores the newest complete checkpoint from the SHARED store and
+    is re-admitted at a boundary under a bumped rendezvous generation —
+    its pre-eviction incarnation can never write into the newer round.
     """
 
     def __init__(self, model, checkpoint_dir: str, save_freq: int = 10,
-                 keep_last: int = 2):
+                 keep_last: int = 2, *, manager=None, member=None,
+                 coordinator=None, background: bool = False):
+        from ..faulttolerance.checkpoint import CheckpointManager
         self.model = model
         # A mesh wrapper (ParallelWrapper) trains, but its underlying
         # network is what serializes; after restore the wrapper re-places
-        # the loaded host arrays onto the mesh.  In multi-process runs give
-        # each process its own checkpoint_dir (SPMD training is
-        # deterministic, so the replicas' checkpoints are identical).
+        # the loaded host arrays onto the mesh.  Membership-less
+        # multi-process runs give each process its own checkpoint_dir
+        # (SPMD training is deterministic, so the replicas' checkpoints
+        # are identical); membership runs SHARE one store.
         inner = getattr(model, "model", None)
         self._net = inner if (inner is not None
                               and hasattr(model, "_place")) else model
         self.dir = checkpoint_dir
         self.save_freq = max(1, save_freq)
         self.keep_last = max(1, keep_last)
+        self.manager = manager if manager is not None else CheckpointManager(
+            checkpoint_dir, keep_last=self.keep_last, background=background)
+        self.member = member
+        self.coordinator = coordinator
         self.last_restored_step = 0
-        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.last_view = None
+        self.trained_steps = 0      # batches THIS member actually fitted
+        self.replayed_steps = 0     # of those, orphan re-covers (evictions)
 
     # -- checkpoint bookkeeping ------------------------------------------
-    def _ckpt_path(self, step: int) -> str:
-        return os.path.join(self.dir, f"ckpt_{step:012d}.zip")
-
     def latest_step(self) -> int:
-        steps = [int(f[5:-4]) for f in os.listdir(self.dir)
-                 if f.startswith("ckpt_") and f.endswith(".zip")]
-        return max(steps) if steps else 0
+        """Global step of the newest COMPLETE checkpoint (0 = none);
+        corrupt/partial directories are never candidates."""
+        ckpts = self.manager.checkpoints()
+        return int(ckpts[-1][2].get("step", ckpts[-1][0])) if ckpts else 0
 
-    def _save(self, step: int) -> None:
-        from ..utils.model_serializer import write_model
-        path = self._ckpt_path(step)
-        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
-        os.close(fd)
-        try:
-            write_model(self._net, tmp, save_updater=True)
-            os.replace(tmp, path)  # atomic: no torn checkpoints
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        self._gc(step)
-
-    def _gc(self, newest: int) -> None:
-        steps = sorted(int(f[5:-4]) for f in os.listdir(self.dir)
-                       if f.startswith("ckpt_") and f.endswith(".zip"))
-        for s in steps[:-self.keep_last]:
-            os.unlink(self._ckpt_path(s))
+    def _save(self, step: int, view=None) -> None:
+        # the checkpoint records the generation of the view it was
+        # written under — the durable-path counterpart of the
+        # coordinator's accept() fence: a restore can audit WHICH
+        # rendezvous epoch produced the state it is about to adopt
+        cursor = {"batch_seq": int(step)}
+        if view is not None:
+            cursor["generation"] = int(view.generation)
+        self.manager.save(self._net, cursor=cursor, step=int(step),
+                          blocking=None)
 
     def restore_latest(self) -> int:
-        """Load newest checkpoint into the model; returns its step (0=none)."""
-        step = self.latest_step()
-        if step:
-            from ..utils.model_serializer import restore_model
-            restored = restore_model(self._ckpt_path(step), load_updater=True)
-            self._net.params = restored.params
-            self._net.state = restored.state
-            self._net.opt_state = restored.opt_state
-            self._net.iteration = restored.iteration
-            self._net.epoch = restored.epoch
+        """Restore the newest complete checkpoint into the model; returns
+        its global step (0 = fresh start).  A truncated/corrupt newest
+        checkpoint is skipped in favor of the previous complete one, and
+        ``.tmp-`` staging orphans from a crashed writer are swept."""
+        self.manager.sweep_orphans()
+        path = self.manager.latest()
+        step = 0
+        if path is not None:
+            _, state = self.manager.restore(path=path, net=self._net)
+            cursor = state.get("cursor") or {}
+            step = int(cursor.get("batch_seq", state.get("iteration", 0)))
             if self._net is not self.model:
                 self.model._place()   # re-shard restored arrays on the mesh
         self.last_restored_step = step
         return step
 
+    # -- membership -------------------------------------------------------
+    def _round_view(self, round_index: int):
+        """The membership view this round runs under: the coordinator
+        installs it (evictions/admissions + generation bump happen HERE,
+        at the round boundary), plain members read it."""
+        if self.coordinator is not None:
+            return self.coordinator.begin_round(round_index)
+        if self.member is not None:
+            return self.member.view()
+        return None
+
+    def _owner_of(self, index: int, view) -> Optional[int]:
+        """Worker id that owns global batch ``index`` under ``view``
+        (None = no view/empty view: everyone trains)."""
+        if view is None or self.member is None or not view.members:
+            return None
+        from ..faulttolerance.cluster import shard_owner
+        return view.members[shard_owner(index, view.world_size)]
+
+    def _owns(self, index: int, view) -> bool:
+        owner = self._owner_of(index, view)
+        if owner is None:
+            # no installed view: solo posture.  A member NOT in the view
+            # (pre-admission) trains nothing — its heartbeat gets it
+            # admitted at a boundary
+            return view is None or self.member is None
+        return owner == self.member.worker_id
+
+    def _replay_orphans(self, old_view, new_view, window) -> None:
+        """Batches owned by a member evicted between ``old_view`` and
+        ``new_view`` were never trained by anyone — re-cover them on this
+        member if the NEW ownership map assigns them here.  ``window``
+        retains the recent (index, batch, owner) triples this member did
+        not train, spanning the lease TTL: a member's death is only
+        *detected* when its lease expires, so every batch "covered" by
+        its zombie lease is still replayable."""
+        if old_view is None or new_view is None or not window:
+            return
+        lost = set(old_view.members) - set(new_view.members)
+        if not lost:
+            return
+        me = self.member.worker_id
+        keep = []
+        for index, batch, owner, t in window:
+            if owner in lost:
+                if self._owner_of(index, new_view) == me:
+                    self.model.fit_batch(batch)
+                    self.trained_steps += 1
+                    self.replayed_steps += 1
+                # a surviving peer replays the rest; either way the
+                # orphan is resolved — don't replay it again on a later
+                # transition
+                continue
+            keep.append((index, batch, owner, t))
+        window[:] = keep
+
+    def _is_primary(self, view) -> bool:
+        """Under membership exactly one live member — the lowest-ranked —
+        writes checkpoints into the shared store."""
+        if view is None or self.member is None:
+            return True
+        return bool(view.members) and view.members[0] == self.member.worker_id
+
     # -- training loop ----------------------------------------------------
     def fit(self, iterator_factory: Callable[[], Iterable],
             max_steps: Optional[int] = None) -> int:
-        """Run (or resume) training; returns the final global step."""
+        """Run (or resume) training; returns the final global step (the
+        cluster-wide data cursor — every member advances it identically,
+        whether or not it owned a given batch)."""
         step = self.restore_latest()
+        # the heartbeat makes this (re)joiner visible; the coordinator
+        # admits it — and counts the rejoin — at the next boundary.  A
+        # member the CALLER already started is the caller's to stop.
+        started_member = (self.member is not None
+                          and self.member._thread is None)
+        if started_member:
+            self.member.start()
         done = 0
-        for batch in iterator_factory():
-            if done < step:      # fast-forward batches already trained on
+        last_saved = step
+        self.trained_steps = 0
+        self.replayed_steps = 0
+        view = self._round_view(step // self.save_freq)
+        self.last_view = view
+        # orphan-replay window: batches this member did NOT train, kept
+        # for ~2 lease TTLs of wall time — a dead member's batches are
+        # replayable for as long as its zombie lease could have "covered"
+        # them.  (A second failure inside the same lease window can still
+        # lose the dead member's last batches to a committed cursor —
+        # exactly-once under compound faults needs acked rounds, which is
+        # the ROADMAP follow-up.)
+        window: list = [] if self.member is not None else None
+        horizon_s = (2.0 * self.member.lease_ttl_s
+                     if self.member is not None else 0.0)
+        try:
+            for batch in iterator_factory():
+                if done < step:      # fast-forward batches already trained
+                    done += 1
+                    continue
+                if max_steps is not None and done >= max_steps:
+                    break
+                if done > last_saved and done % self.save_freq == 0:
+                    # round boundary: refresh the view FIRST (evictions,
+                    # admissions, generation bump), re-cover any batches
+                    # orphaned by an eviction, and only then let the
+                    # CURRENT primary commit the cursor — a stale member
+                    # that lost its place never writes the shared store
+                    new_view = self._round_view(done // self.save_freq)
+                    self._replay_orphans(view, new_view, window)
+                    view = new_view
+                    self.last_view = view
+                    if self._is_primary(view):
+                        self._save(done, view)
+                    last_saved = done
+                if self._owns(done, view):
+                    self.model.fit_batch(batch)
+                    self.trained_steps += 1
+                elif window is not None:
+                    now = monotonic_s()
+                    window.append((done, batch,
+                                   self._owner_of(done, view), now))
+                    while window and now - window[0][3] > horizon_s:
+                        window.pop(0)
                 done += 1
-                continue
-            if max_steps is not None and done >= max_steps:
-                break
-            self.model.fit_batch(batch)
-            done += 1
-            if done % self.save_freq == 0:
-                self._save(done)
-        if done % self.save_freq != 0 and done > step:
-            self._save(done)
+            if done > last_saved:
+                if self.member is not None:
+                    new_view = self._round_view(done // self.save_freq)
+                    self._replay_orphans(view, new_view, window)
+                    view = new_view
+                    self.last_view = view
+                if self._is_primary(view):
+                    self._save(done, view)
+        finally:
+            self.manager.wait()
+            if started_member:
+                self.member.stop()
         return done

@@ -388,7 +388,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             self.retry_counts[w] = self.retry_counts.get(w, 0) + 1
             self._count("training_worker_retries_total",
                         "Worker round retries in the training masters")
-            self.retry_policy.sleep(attempt)
+            self.retry_policy.sleep(attempt, worker=w)
             self._restore_replica(replica, snap)
             try:
                 with tracer.span("master.worker_retry", worker=w,

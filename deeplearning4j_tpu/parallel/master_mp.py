@@ -62,6 +62,7 @@ import os
 import struct
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -83,6 +84,10 @@ _GO = "mp.go"          # shared mode: master saw N readies — publishing may st
 _FLUSH = "mp.flush"    # shared mode: per-worker declared sent-counts
 _RESID = "mp.resid"    # shared mode: dense end-of-job residual flush
 _SEED = "mp.seed"      # shared mode: master -> respawned worker resync seed
+_HB = "mp.hb"          # worker -> master heartbeat {wid, steps}
+_DEAD = "mp.dead"      # master -> workers: eviction notice {wid}
+
+_HB_INTERVAL_S = 0.5   # worker heartbeat period (lease renewal analogue)
 
 
 def _encode_frame(wid: int, rnd: int, vec: np.ndarray) -> bytes:
@@ -141,8 +146,11 @@ class MultiprocessMaster:
     ``fault_injection``: test-only hook — keys ``die_before_publish``
     (averaging, {wid: round}), ``die_after_batches`` (shared, {wid: k}),
     ``die_at_start`` (evaluate/score, [wid]), ``die_before_done`` /
-    ``exit_nonzero_after_done`` ([wid]), ``slow_start`` ({wid: seconds})
-    — applied only to a worker's first incarnation.
+    ``exit_nonzero_after_done`` ([wid]), ``slow_start`` ({wid: seconds}),
+    ``hang_after_batches`` ({wid: k}: the training loop wedges after k
+    batches while the heartbeat thread keeps beating — the stall
+    watchdog's test case) — applied only to a worker's first incarnation.
+    ``straggler_timeout_s``: heartbeat-stall watchdog (see attribute doc).
     """
 
     _DEAD_GRACE = 2.0   # seconds a dead worker's in-flight message may lag
@@ -159,7 +167,8 @@ class MultiprocessMaster:
                  agreement_tol: float = 1e-3,
                  workdir: Optional[str] = None,
                  fault_injection: Optional[Dict[str, Any]] = None,
-                 retry_backoff_s: float = 0.1, retry_seed: int = 0):
+                 retry_backoff_s: float = 0.1, retry_seed: int = 0,
+                 straggler_timeout_s: Optional[float] = None):
         from ..faulttolerance.faults import RetryPolicy
         if mode not in ("averaging", "shared"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -177,9 +186,17 @@ class MultiprocessMaster:
         self.agreement_tol = agreement_tol
         self.workdir = workdir   # parent for auto-created job directories
         self.fault_injection = dict(fault_injection or {})
+        # heartbeat-stall watchdog (the thread masters' straggler timeout
+        # promoted across the process boundary): a worker whose process is
+        # alive but whose heartbeats stop carrying progress for longer
+        # than this is killed and respawned.  None = off.  Must be sized
+        # well past a normal round (training + barrier waits make no
+        # "steps" progress while a worker legitimately blocks).
+        self.straggler_timeout_s = straggler_timeout_s
         self.last_results: List[Dict[str, Any]] = []
         self.retried_workers: set = set()
         self.last_table_spread: Optional[float] = None
+        self.evicted_workers: set = set()
 
     # -- plumbing ------------------------------------------------------------
     def _spawn(self, jobdir: str, wid: int, port: int,
@@ -206,6 +223,10 @@ class MultiprocessMaster:
         p = subprocess.Popen(argv, env=env, stdout=log,
                              stderr=subprocess.STDOUT)
         p._logfile = log
+        if hasattr(self, "_hb"):
+            # (re)arm the stall watchdog for this incarnation: progress
+            # clock starts at spawn, steps at -1 (= no beat seen yet)
+            self._hb[wid] = [monotonic_s(), -1]
         return p
 
     def _run_job(self, model, jobdir: str, spec: Dict[str, Any],
@@ -238,12 +259,19 @@ class MultiprocessMaster:
         with open(os.path.join(jobdir, "spec.json"), "w") as f:
             json.dump(spec, f)
         done_sub = broker.subscribe(_DONE)
+        # heartbeat intake: registered before any worker can beat
+        self._hb_sub = broker.subscribe(_HB)
+        # wid -> [last_progress_monotonic_s, steps]; seeded at spawn so a
+        # worker that wedges before its first beat still trips the watchdog
+        self._hb: Dict[int, List[float]] = {}
         subs = setup(broker)
+        self._broker = broker
         self._port = broker.port
         self._resume_payload = resume_payload
         self._retries: Dict[int, int] = {}
         self._dead_since: Dict[int, float] = {}
         self.retried_workers = set()
+        self.evicted_workers = set()
         self._procs: Dict[int, subprocess.Popen] = {
             w: self._spawn(jobdir, w, broker.port)
             for w in range(self.num_workers)}
@@ -300,18 +328,71 @@ class MultiprocessMaster:
                     outs.append(f"[worker {w}] " + f.read()[-2000:])
         return "\n".join(outs)
 
+    def _drain_heartbeats(self) -> None:
+        """Fold pending worker heartbeats into the watchdog state and the
+        ``cluster_heartbeat_age_seconds`` gauge.  The progress clock only
+        advances when ``steps`` moves: a wedged worker whose heartbeat
+        thread still beats (but whose training loop is stuck) ages out
+        exactly like a silent one."""
+        sub = getattr(self, "_hb_sub", None)
+        if sub is None:
+            return
+        now = monotonic_s()
+        while True:
+            payload = sub.poll(timeout=0.001)
+            if payload is None:
+                break
+            try:
+                d = json.loads(payload.decode())
+                wid, steps = int(d["wid"]), int(d.get("steps", 0))
+            except (ValueError, KeyError):
+                wid = None    # malformed beat (foreign payload): ignore
+            if wid is None:
+                continue
+            cur = self._hb.get(wid)
+            if cur is None or steps > cur[1]:
+                self._hb[wid] = [now, steps]
+        reg = default_registry()
+        if reg.enabled and self._hb:
+            age = reg.gauge("cluster_heartbeat_age_seconds",
+                            "Seconds since a worker last made heartbeat "
+                            "progress", ("worker",))
+            for wid, (t, _) in self._hb.items():
+                age.labels(str(wid)).set(max(0.0, now - t))
+
     def _check_liveness(self, jobdir: str, satisfied=()) -> bool:
         """Respawn workers that exited — ANY exit code — without delivering
         the contribution the current phase is collecting (``satisfied``).
         A short grace window lets a just-published in-flight message land
-        before the respawn triggers.  Returns True when someone was
-        respawned (callers extend their deadline: the replacement redoes
-        work)."""
+        before the respawn triggers.  With ``straggler_timeout_s`` set, a
+        worker whose process is ALIVE but whose heartbeats stopped
+        carrying progress for longer than the timeout is killed and
+        respawned too (the thread masters' straggler watchdog, fed by
+        process heartbeats).  Returns True when someone was respawned
+        (callers extend their deadline: the replacement redoes work)."""
+        self._drain_heartbeats()
         respawned = False
         now = monotonic_s()
         for wid, p in list(self._procs.items()):
             if p.poll() is None or wid in satisfied:
                 self._dead_since.pop(wid, None)
+                if p.poll() is None and wid not in satisfied and \
+                        self.straggler_timeout_s is not None:
+                    hb = self._hb.get(wid)
+                    if hb is not None and \
+                            now - hb[0] > self.straggler_timeout_s:
+                        reg = default_registry()
+                        if reg.enabled:
+                            reg.counter(
+                                "cluster_evictions_total",
+                                "Workers evicted from the membership view",
+                                ("reason",)).labels(
+                                    "heartbeat_stall").inc()
+                        self.evicted_workers.add(wid)
+                        p.kill()
+                        p.wait(timeout=30)
+                        self._respawn(wid, jobdir)
+                        respawned = True
                 continue
             first = self._dead_since.setdefault(wid, now)
             if now - first < self._DEAD_GRACE:
@@ -334,6 +415,16 @@ class MultiprocessMaster:
                             "Workers permanently lost (retries/straggler "
                             "budget exhausted)", ("mode",)
                             ).labels("mp").inc()
+            if self.mode == "shared":
+                # eviction notice: surviving peers drop this sender from
+                # their drain barriers IMMEDIATELY instead of spinning
+                # until their own deadline — an evicted peer never blocks
+                # the drain longer than the master's liveness verdict
+                try:
+                    self._broker.publish(
+                        _DEAD, json.dumps({"wid": wid}).encode())
+                except (ConnectionError, OSError):
+                    pass   # hub teardown is already in flight
             raise RuntimeError(
                 f"worker {wid} failed after {n - 1} retries: "
                 + self._logs_tail(jobdir))
@@ -349,7 +440,7 @@ class MultiprocessMaster:
         # seeded exponential backoff + jitter: a crash-looping host must
         # not be respawned at full tilt (and N masters sharing a node
         # shouldn't stampede in lockstep)
-        self.retry_policy.sleep(n)
+        self.retry_policy.sleep(n, worker=wid)
         old = self._procs[wid]
         if old.poll() is None:
             old.kill()
@@ -647,6 +738,40 @@ class MultiprocessMaster:
 
 
 # --------------------------------------------------------------------- worker
+def _maybe_hang(fault: Dict[str, Any], wid: int, steps: int) -> None:
+    """Fault-injection hook (NOT protocol timing): ``hang_after_batches``
+    wedges the training loop after ``steps`` batches while the heartbeat
+    thread keeps beating with a frozen count — the stall watchdog's
+    prey."""
+    if fault.get("hang_after_batches", {}).get(str(wid)) == steps:
+        time.sleep(3600)
+
+
+def _start_heartbeat(broker, wid: int,
+                     result: Dict[str, Any]) -> threading.Event:
+    """Worker-side lease analogue: publish ``{wid, steps}`` on the
+    heartbeat topic every ``_HB_INTERVAL_S`` until the returned event is
+    set.  ``steps`` rides along so the master's watchdog can tell a
+    wedged-but-alive worker (beats arrive, progress doesn't) from a
+    healthy one."""
+    stop = threading.Event()
+
+    def beat():
+        while True:
+            try:
+                broker.publish(_HB, json.dumps(
+                    {"wid": wid,
+                     "steps": int(result.get("steps", 0))}).encode())
+            except (ConnectionError, OSError):
+                return    # hub gone: the master died or is tearing down
+            if stop.wait(_HB_INTERVAL_S):
+                return
+
+    threading.Thread(target=beat, daemon=True,
+                     name=f"mp-heartbeat-{wid}").start()
+    return stop
+
+
 def _worker_main(jobdir: str, wid: int, port: int,
                  resume_file: Optional[str] = None) -> None:
     with open(os.path.join(jobdir, "spec.json")) as f:
@@ -679,17 +804,28 @@ def _worker_task(jobdir: str, wid: int, port: int, spec: Dict[str, Any],
     from ..utils import model_serializer
 
     broker = TcpMessageBroker(port=port)    # client endpoints only
-    if resume.get("skip_to_done"):
-        # predecessor crashed after its last fit contribution was
-        # collected; nothing to redo — just report
-        result = {"wid": wid, "steps": 0, "resumed": True, "skipped": True,
-                  "score": None}
-        broker.publish(_DONE, json.dumps(result).encode())
-        return
+    result: Dict[str, Any] = {"wid": wid, "steps": 0, "resumed": resumed}
+    hb_stop = _start_heartbeat(broker, wid, result)
+    try:
+        if resume.get("skip_to_done"):
+            # predecessor crashed after its last fit contribution was
+            # collected; nothing to redo — just report
+            result.update({"skipped": True, "score": None})
+            broker.publish(_DONE, json.dumps(result).encode())
+            return
+        _worker_run(broker, jobdir, wid, spec, resume, fault, result)
+    finally:
+        hb_stop.set()
+
+
+def _worker_run(broker, jobdir: str, wid: int, spec: Dict[str, Any],
+                resume: Dict[str, Any], fault: Dict[str, Any],
+                result: Dict[str, Any]) -> None:
+    from ..utils import model_serializer
+
     model = model_serializer.restore_multi_layer_network(
         os.path.join(jobdir, "model.zip"))
     batches = _load_batches(os.path.join(jobdir, f"shard_{wid}.npz"))
-    result: Dict[str, Any] = {"wid": wid, "steps": 0, "resumed": resumed}
 
     task = spec["task"]
     if task == "fit" and spec["mode"] == "averaging":
@@ -704,6 +840,7 @@ def _worker_task(jobdir: str, wid: int, port: int, spec: Dict[str, Any],
             for batch in batches[rnd * freq:(rnd + 1) * freq]:
                 model.fit_batch(batch)
                 result["steps"] += 1
+                _maybe_hang(fault, wid, result["steps"])
             if fault.get("die_before_publish", {}).get(str(wid)) == rnd:
                 os._exit(3)
             vec, _ = _ravel(model, spec["average_updaters"])
@@ -783,6 +920,7 @@ def _worker_shared_fit(broker, model, batches, spec, resume, fault,
     handler = EncodingHandler(initial_threshold=spec["threshold"])
     flush_sub = broker.subscribe(_FLUSH, ack=True)
     resid_sub = broker.subscribe(_RESID, ack=True)
+    dead_sub = broker.subscribe(_DEAD, ack=True)
     timeout = float(spec["timeout"])
     post_go_resume = bool(resume.get("go_done"))
     prior_sent = 0
@@ -834,6 +972,7 @@ def _worker_shared_fit(broker, model, batches, spec, resume, fault,
         flat_before = jnp.array(flat_before)
         model.fit_batch(batch)
         result["steps"] += 1
+        _maybe_hang(fault, wid, result["steps"])
         flat_after, _ = ravel_pytree(model.params)
         sharing.publish_update(flat_after - flat_before)
         merged = sharing.apply_updates(flat_after, timeout=0.05)
@@ -849,16 +988,16 @@ def _worker_shared_fit(broker, model, batches, spec, resume, fault,
     # drain barrier: applied[p] (+ the seed's mirror_counts[p]) must reach
     # p's declared count and p's residual must be in (directly or folded
     # into the seed) — a respawned peer's re-flush overwrites its declared
-    # count (its earlier messages only push applied past it: >= holds)
+    # count (its earlier messages only push applied past it: >= holds).
+    # A master eviction notice (_DEAD) marks a peer dead: it drops out of
+    # the barrier immediately, so an evicted peer can never hold the
+    # survivors hostage until their own deadline.
     resids: Dict[int, np.ndarray] = {}
     deadline = time.time() + timeout
     while True:
-        missing = [p for p in range(spec["num_workers"])
-                   if p != wid
-                   and (p not in declared
-                        or (p not in resids and p not in resids_done)
-                        or sharing.applied_per_peer.get(p, 0)
-                        + mirror_counts.get(p, 0) < declared[p])]
+        missing = sharing.unresolved_peers(
+            declared, spec["num_workers"], mirror_counts=mirror_counts,
+            resids_seen=resids, resids_folded=resids_done)
         if not missing:
             break
         payload = flush_sub.poll(timeout=0.05)
@@ -870,7 +1009,11 @@ def _worker_shared_fit(broker, model, batches, spec, resume, fault,
             r_wid, _, r_vec = _decode_frame(payload)
             if r_wid != wid and r_wid not in resids_done:
                 resids[r_wid] = r_vec
-        flat = sharing.apply_updates(flat, timeout=0.05)
+        payload = dead_sub.poll(timeout=0.001)
+        if payload is not None:
+            sharing.mark_dead(int(json.loads(payload.decode())["wid"]))
+        # unbounded drain here: the barrier loop carries its own deadline
+        flat = sharing.apply_updates(flat, timeout=0.05, max_messages=0)
         if time.time() > deadline:
             raise RuntimeError(
                 f"worker {wid}: drain barrier incomplete, "

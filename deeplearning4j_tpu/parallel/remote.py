@@ -72,10 +72,16 @@ class RemoteGradientSharing:
     Aeron).  All workers share one ``topic``; own messages are filtered by
     worker id."""
 
+    #: default per-call drain bound (see ``apply_updates``): high enough
+    #: that a healthy step drains everything, low enough that a flooding
+    #: peer cannot starve the caller's training step in one call
+    DEFAULT_MAX_DRAIN = 512
+
     def __init__(self, broker, worker_id: int, topic: str = "gradients",
                  handler: Optional[EncodingHandler] = None,
                  ack: bool = False, seq_base: int = 0,
-                 skip_seqs: Optional[Dict[int, int]] = None, sub=None):
+                 skip_seqs: Optional[Dict[int, int]] = None, sub=None,
+                 max_drain: Optional[int] = None):
         self.broker = broker
         self.worker_id = worker_id
         self.topic = topic
@@ -93,12 +99,18 @@ class RemoteGradientSharing:
         # skip_seqs[p]: sequence numbers <= this were already folded into
         # this worker's starting table (a resync seed) — exact dedup
         self.skip_seqs: Dict[int, int] = dict(skip_seqs or {})
+        self.max_drain = self.DEFAULT_MAX_DRAIN if max_drain is None \
+            else int(max_drain)
         self.messages_sent = 0
         self.messages_applied = 0
         # per-sender applied tallies back the drain barrier: a worker knows
         # it holds every peer update once applied[p] >= the count p
         # declared minus what its seed already contained
         self.applied_per_peer: Dict[int, int] = {}
+        # dead-peer state (fed by the master's lease/liveness authority —
+        # an eviction notice): a dead peer stops counting against the
+        # drain barrier, so an evicted sender can never hang it
+        self.dead_peers: set = set()
 
     def publish_update(self, flat_grad) -> None:
         msg = self.handler.encode_update(flat_grad)
@@ -108,16 +120,28 @@ class RemoteGradientSharing:
             encode_message_bytes(self.worker_id, msg,
                                  seq=self.seq_base + self.messages_sent))
 
-    def apply_updates(self, flat_params, timeout: float = 0.0):
+    def apply_updates(self, flat_params, timeout: float = 0.0,
+                      max_messages: Optional[int] = None):
         """Drain pending peer messages into the flat param vector; returns
         the updated vector (stale messages apply late — by design).
         Messages whose seq is at or below the sender's ``skip_seqs`` entry
-        are already in this worker's starting table and are discarded."""
+        are already in this worker's starting table and are discarded.
+
+        The drain is BOUNDED: at most ``max_messages`` (default: the
+        endpoint's ``max_drain``) payloads are consumed per call, so a
+        peer publishing faster than this worker trains cannot starve the
+        caller's step inside one "drain until momentarily empty" loop —
+        leftovers stay queued for the next call.  ``max_messages=0``
+        disables the bound (the drain-barrier loops call repeatedly and
+        bound themselves by their own deadline)."""
         out = jnp.asarray(flat_params)
-        while True:
+        limit = self.max_drain if max_messages is None else int(max_messages)
+        polled = 0
+        while limit <= 0 or polled < limit:
             payload = self._sub.poll(timeout=timeout or 0.001)
             if payload is None:
                 return out
+            polled += 1
             sender, seq, msg = decode_message_bytes(payload)
             if sender == self.worker_id:
                 continue      # own broadcast echo
@@ -128,6 +152,34 @@ class RemoteGradientSharing:
             self.messages_applied += 1
             self.applied_per_peer[sender] = \
                 self.applied_per_peer.get(sender, 0) + 1
+        return out
+
+    # ------------------------------------------------------- dead peers
+    def mark_dead(self, peer: int) -> None:
+        """Record an eviction notice from the liveness authority: ``peer``
+        will never publish again, so the drain barrier stops waiting on
+        its declared count and residual."""
+        self.dead_peers.add(int(peer))
+
+    def unresolved_peers(self, declared: Dict[int, int], num_workers: int,
+                         *, mirror_counts: Optional[Dict[int, int]] = None,
+                         resids_seen=(), resids_folded=()) -> list:
+        """Peers still blocking the drain barrier: no declared sent-count
+        yet, missing residual, or applied (+ resync-seed) count below the
+        declared count.  Peers in ``dead_peers`` are excluded — an
+        evicted sender's contribution is whatever already arrived, and
+        waiting longer cannot produce more."""
+        mirror_counts = mirror_counts or {}
+        out = []
+        for p in range(int(num_workers)):
+            if p == self.worker_id or p in self.dead_peers:
+                continue
+            if p not in declared \
+                    or (p not in resids_seen and p not in resids_folded) \
+                    or self.applied_per_peer.get(p, 0) \
+                    + mirror_counts.get(p, 0) < declared[p]:
+                out.append(p)
+        return out
 
     def close(self) -> None:
         if hasattr(self._sub, "close"):

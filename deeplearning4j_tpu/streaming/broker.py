@@ -166,10 +166,17 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 class TcpMessageBroker:
     """Broker server + client in one class.  ``serve()`` starts the hub;
-    clients use ``publish``/``subscribe`` pointed at host:port."""
+    clients use ``publish``/``subscribe`` pointed at host:port.
+
+    Client endpoints survive a hub restart: a stale/refused socket is
+    rebuilt under ``reconnect_policy`` (bounded attempts, seeded
+    exponential backoff — the ``RetryPolicy`` the training masters use),
+    counted in ``broker_reconnects_total{op}``; only an exhausted budget
+    raises, with the attempt count and last error spelled out."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_queue: int = 1024):
+                 max_queue: int = 1024, reconnect_policy=None):
+        from ..faulttolerance.faults import RetryPolicy
         self.host = host
         self.port = port
         self._local = LocalMessageBroker(max_queue)
@@ -177,15 +184,45 @@ class TcpMessageBroker:
         self._thread: Optional[threading.Thread] = None
         self._pub_sock: Optional[socket.socket] = None
         self._pub_lock = threading.Lock()
+        self.reconnect_policy = reconnect_policy if reconnect_policy \
+            is not None else RetryPolicy(max_retries=4, backoff_s=0.05,
+                                         max_backoff_s=2.0)
+        # each reconnecting endpoint draws from its OWN policy stream
+        # (worker key): the publisher is stream 0 (serialized under
+        # _pub_lock), every subscription gets the next id — concurrent
+        # reconnects (heartbeat publish vs a poll's resubscribe) never
+        # race one numpy Generator
+        self._stream_seq = 0
+        self._stream_lock = threading.Lock()
+
+    def _next_stream_id(self) -> int:
+        with self._stream_lock:
+            self._stream_seq += 1
+            return self._stream_seq
+
+    @staticmethod
+    def _count_reconnect(op: str) -> None:
+        reg = default_registry()
+        if reg.enabled:
+            reg.counter("broker_reconnects_total",
+                        "Client reconnects after a stale/refused broker "
+                        "socket", ("op",)).labels(op).inc()
 
     # -- server side ---------------------------------------------------------
     def serve(self) -> "TcpMessageBroker":
         broker = self._local
+        # live handler sockets: shutdown() severs them so clients observe
+        # the hub going away promptly (a crashed hub process resets its
+        # connections; an in-process shutdown must look the same)
+        conns = self._conns = set()
+        conns_lock = self._conns_lock = threading.Lock()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
                 subs = []
+                with conns_lock:
+                    conns.add(sock)
                 try:
                     while True:
                         head = _recv_exact(sock, 3)
@@ -213,6 +250,8 @@ class TcpMessageBroker:
                 except (ConnectionError, OSError):
                     pass
                 finally:
+                    with conns_lock:
+                        conns.discard(sock)
                     for topic, sub in subs:
                         broker.unsubscribe(topic, sub)
 
@@ -243,6 +282,14 @@ class TcpMessageBroker:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+            with self._conns_lock:
+                pending, self._conns = set(self._conns), set()
+            for sock in pending:
+                try:     # sever live client connections (crash parity)
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                sock.close()
         with self._pub_lock:
             if self._pub_sock is not None:
                 self._pub_sock.close()
@@ -255,32 +302,52 @@ class TcpMessageBroker:
         hub's handler processes a connection's frames sequentially, so a
         sender's messages are delivered per-subscriber in publish order
         (the FIFO the masters' sequence-number dedup relies on) — and no
-        per-message TCP setup."""
+        per-message TCP setup.  A stale socket (hub restart) is rebuilt
+        under the bounded ``reconnect_policy`` backoff; the budget
+        exhausting raises with the full story."""
+        policy = self.reconnect_policy
         with self._pub_lock:
-            for attempt in (0, 1):
-                if self._pub_sock is None:
-                    self._pub_sock = socket.create_connection(
-                        (self.host, self.port), timeout=5)
+            last_err: Optional[BaseException] = None
+            for attempt in range(policy.max_retries + 1):
+                if attempt:
+                    self._count_reconnect("publish")
+                    policy.sleep(attempt, worker=0)
                 try:
+                    if self._pub_sock is None:
+                        self._pub_sock = socket.create_connection(
+                            (self.host, self.port), timeout=5)
                     _send_frame(self._pub_sock, 0, topic, payload)
                     return
-                except (ConnectionError, OSError):
-                    # hub restarted / socket went stale: reconnect once
-                    try:
-                        self._pub_sock.close()
-                    finally:
-                        self._pub_sock = None
-                    if attempt:
-                        raise
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    if self._pub_sock is not None:
+                        try:
+                            self._pub_sock.close()
+                        finally:
+                            self._pub_sock = None
+            raise ConnectionError(
+                f"broker publish to {self.host}:{self.port} topic "
+                f"{topic!r} failed after {policy.max_retries} reconnect "
+                f"attempts: {last_err}") from last_err
 
     class _TcpSubscription:
-        def __init__(self, sock: socket.socket):
+        def __init__(self, sock: socket.socket, broker=None, topic: str = "",
+                     ack: bool = False):
             self._sock = sock
             self._buf = bytearray()   # partial frame survives poll timeouts
+            self._broker = broker
+            self._topic = topic
+            self._ack = ack
+            self._eof = False         # hub closed the stream (vs timeout)
+            self._closed = False
+            # dedicated backoff stream (see broker._next_stream_id)
+            self._stream_id = broker._next_stream_id() \
+                if broker is not None else 0
 
         def _fill(self, n: int, timeout: Optional[float]) -> bool:
             """Buffer until n bytes are available; False on timeout/EOF
-            with the partial data RETAINED for the next poll."""
+            with the partial data RETAINED for the next poll (EOF is
+            remembered in ``_eof`` so poll can resubscribe)."""
             import time as _time
             deadline = None if timeout is None else _time.time() + timeout
             while len(self._buf) < n:
@@ -295,12 +362,48 @@ class TcpMessageBroker:
                     chunk = self._sock.recv(65536)
                 except socket.timeout:
                     return False
+                except (ConnectionError, OSError):
+                    self._eof = True
+                    return False
                 if not chunk:
+                    self._eof = True
                     return False
                 self._buf.extend(chunk)
             return True
 
+        def _resubscribe(self) -> None:
+            """Rebuild the subscription socket after a hub restart under
+            the broker's bounded backoff.  Undelivered frames from the
+            dead hub are gone (the at-most-once contract); a partial
+            frame in the buffer is dropped WITH the stream it belonged
+            to.  Exhausting the budget raises a clear error."""
+            policy = self._broker.reconnect_policy
+            last_err: Optional[BaseException] = None
+            for attempt in range(1, policy.max_retries + 1):
+                TcpMessageBroker._count_reconnect("subscribe")
+                policy.sleep(attempt, worker=self._stream_id)
+                try:
+                    fresh = self._broker.subscribe(self._topic,
+                                                   ack=self._ack)
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = fresh._sock
+                    self._buf = bytearray()
+                    self._eof = False
+                    return
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    last_err = e
+            raise ConnectionError(
+                f"broker subscription to topic {self._topic!r} at "
+                f"{self._broker.host}:{self._broker.port} lost and not "
+                f"re-established after {policy.max_retries} reconnect "
+                f"attempts: {last_err}") from last_err
+
         def poll(self, timeout: Optional[float] = None) -> Optional[bytes]:
+            if self._eof and not self._closed and self._broker is not None:
+                self._resubscribe()
             if not self._fill(4, timeout):
                 return None
             size = struct.unpack("<I", bytes(self._buf[:4]))[0]
@@ -311,12 +414,14 @@ class TcpMessageBroker:
             return payload
 
         def close(self):
+            self._closed = True
             self._sock.close()
 
     def subscribe(self, topic: str, ack: bool = False) -> "_TcpSubscription":
         s = socket.create_connection((self.host, self.port), timeout=5)
         _send_frame(s, 2 if ack else 1, topic)
-        sub = TcpMessageBroker._TcpSubscription(s)
+        sub = TcpMessageBroker._TcpSubscription(s, broker=self, topic=topic,
+                                                ack=ack)
         if ack:
             first = sub.poll(timeout=10.0)
             if first != b"":

@@ -728,3 +728,71 @@ def checkpoint_overhead(hidden: int = 128, features: int = 64,
             "save_every": save_every,
             "checkpoint_bytes": int(nbytes),
             "write_mb_per_sec": round(nbytes / max(write_s, 1e-9) / 1e6, 1)}
+
+
+def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
+                     n_batches: int = 12, batch: int = 16) -> Dict:
+    """Recovery-time benchmark (ISSUE 7): wall time from an injected
+    worker kill to the FIRST post-recovery training step, on both
+    recovery paths of the parameter-averaging master:
+
+    - **sync retry** — a transient failure: the master restores the
+      round-start snapshot, sleeps the seeded backoff, and re-executes
+      the same worker's chunk.  Recovery = backoff + snapshot restore.
+    - **elastic degradation** — a permanent loss: the retry budget
+      exhausts and the survivors re-chunk the dead worker's round NOW.
+      Recovery = loss verdict (the last failed attempt) to the first
+      replayed batch on a survivor.
+
+    ``value`` is the sync-retry figure (the common transient case); the
+    elastic figure rides along.  Timestamps come from the
+    ``FaultInjector``'s per-worker fault/recovery bookkeeping, so the
+    measurement is the master's actual reaction time, not a loop-level
+    subtraction.
+    """
+    from ..faulttolerance.faults import FaultInjector
+    from ..nn.conf.input_type import InputType
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..parallel.master import ParameterAveragingTrainingMaster
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((batch, features)).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, batch)]
+        batches.append((x, y))
+    build().fit_batch(batches[0])               # compile + warm the cache
+
+    def run(injector, max_retries):
+        master = ParameterAveragingTrainingMaster(
+            2, averaging_frequency=2, max_retries=max_retries,
+            retry_backoff_s=0.02, fault_injector=injector)
+        master.fit(build(), iter(batches))
+        return injector.recoveries_s
+
+    retry_rec = run(FaultInjector(seed=0).fail(worker=1, rnd=1, times=1),
+                    max_retries=2)
+    elastic_rec = run(FaultInjector(seed=0).fail(worker=1, rnd=1, times=-1),
+                      max_retries=1)
+    retry_ms = retry_rec[0] * 1e3 if retry_rec else None
+    elastic_ms = elastic_rec[0] * 1e3 if elastic_rec else None
+    return {"metric": "recovery_time_ms",
+            "value": None if retry_ms is None else round(retry_ms, 2),
+            "unit": "ms kill -> first post-recovery step (sync retry)",
+            "elastic_ms": None if elastic_ms is None
+            else round(elastic_ms, 2),
+            "workers": 2, "retry_backoff_s": 0.02}

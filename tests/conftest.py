@@ -70,6 +70,13 @@ _SLOW_TESTS = {
     "test_multiprocess_word2vec_retry",
     "test_early_stopping_over_multiprocess_master",
     "test_pretrained_keras_weights_bridge",
+    # chaos soak tests (tests/test_cluster.py): spawn real OS processes
+    # and SIGKILL them mid-run; also carry the `chaos` marker so the
+    # whole harness can be run alone with `pytest -m chaos`
+    "test_chaos_sigkill_elastic_host_between_checkpoints",
+    "test_chaos_crash_mid_checkpoint_commit",
+    "test_chaos_sigkill_mp_worker_mid_round",
+    "test_mp_heartbeat_watchdog_evicts_wedged_worker",
 }
 
 

@@ -1,0 +1,120 @@
+"""Chaos soak worker for the elastic runtime (launched by
+test_cluster.py).
+
+One OS process running an ``ElasticTrainer`` fit over a fixed seeded
+workload, with an optional :class:`ChaosSchedule` attack on itself:
+
+- ``CE_CHAOS=kill:<after_s>`` — a chaos-monkey thread SIGKILLs this
+  process ``after_s`` seconds after the FIRST committed checkpoint
+  appears (so the death provably lands between checkpoints, not before
+  the first one);
+- ``CE_CHAOS=commit:<step>:<stage>`` — hard ``os._exit`` between the
+  checkpoint's staged file writes (the ``CheckpointManager.chaos``
+  hook): the commit rename never runs, recovery must skip the ``.tmp-``
+  orphan;
+- unset — run to completion.
+
+Env: CE_DIR (checkpoint store), CE_OUT (result json path), CE_BATCHES,
+CE_SAVE_FREQ, CE_STEP_SLEEP (per-batch sleep so a timed kill lands
+mid-run), CE_CHAOS.
+
+The result json carries a sha256 digest over the final raveled params:
+the chaos acceptance criterion is digest equality with the fault-free
+run — exact, not approximate, because resume restores params + updater +
+RNG + cursor.
+"""
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def build_model():
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main():
+    store = os.environ["CE_DIR"]
+    out = os.environ["CE_OUT"]
+    n_batches = int(os.environ.get("CE_BATCHES", "24"))
+    save_freq = int(os.environ.get("CE_SAVE_FREQ", "4"))
+    step_sleep = float(os.environ.get("CE_STEP_SLEEP", "0"))
+    chaos = os.environ.get("CE_CHAOS", "")
+
+    import numpy as np
+
+    from deeplearning4j_tpu.faulttolerance.faults import ChaosSchedule
+    from deeplearning4j_tpu.parallel.distributed import ElasticTrainer
+
+    model = build_model()
+    rng = np.random.default_rng(7)
+    all_batches = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((8, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        all_batches.append((x, y))
+
+    trainer = ElasticTrainer(model, store, save_freq=save_freq, keep_last=3)
+
+    if chaos.startswith("kill:"):
+        after_s = float(chaos.split(":")[1])
+        sched = ChaosSchedule(seed=0).kill_process(0, after_s)
+        pid = os.getpid()
+
+        def arm_after_first_checkpoint():
+            # the monkey clock starts only once a committed checkpoint
+            # exists: the SIGKILL lands BETWEEN checkpoints by design
+            while not any(name.startswith("ckpt-")
+                          for name in os.listdir(store)
+                          if os.path.isdir(os.path.join(store, name))):
+                time.sleep(0.02)
+            sched.start(lambda: {0: pid})
+
+        threading.Thread(target=arm_after_first_checkpoint,
+                         daemon=True).start()
+    elif chaos.startswith("commit:"):
+        _, step, stage = chaos.split(":")
+        trainer.manager.chaos = ChaosSchedule(seed=0).crash_in_commit(
+            int(step), int(stage))
+
+    def batches():
+        for b in all_batches:
+            if step_sleep:
+                time.sleep(step_sleep)
+            yield b
+
+    steps = trainer.fit(batches)
+
+    from jax.flatten_util import ravel_pytree
+    flat, _ = ravel_pytree(model.params)
+    flat = np.asarray(flat, np.float64)
+    result = {"steps": steps,
+              "resumed_from": trainer.last_restored_step,
+              "param_sum": float(flat.sum()),
+              "param_digest": hashlib.sha256(flat.tobytes()).hexdigest()}
+    with open(out, "w") as f:
+        json.dump(result, f)
+    print(f"done: {result}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX015 has at least one fixture that MUST fire and one
+Every rule JX001–JX016 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -735,6 +735,101 @@ def test_jx015_pragma_suppresses():
     """)
 
 
+# ---------------------------------------------------------------- JX016
+def test_jx016_positive_unbounded_reconnect_loop():
+    assert "JX016" in rules_of("""
+        import socket
+
+        def keep_publishing(host, port, frames):
+            while True:
+                try:
+                    sock = socket.create_connection((host, port))
+                    for f in frames:
+                        sock.sendall(f)
+                    return
+                except OSError:
+                    continue          # hammers a dead hub forever
+    """)
+
+
+def test_jx016_positive_retry_reaches_nested_try():
+    assert "JX016" in rules_of("""
+        def poll_forever(fetch):
+            while True:
+                try:
+                    return fetch()
+                except ConnectionError:
+                    fetch = fetch
+                    continue
+    """)
+
+
+def test_jx016_negative_backoff_and_budget():
+    assert "JX016" not in rules_of("""
+        import time
+
+        def with_backoff(connect, policy):
+            while True:
+                try:
+                    return connect()
+                except OSError:
+                    policy.sleep(1)       # budgeted backoff: legal
+                    continue
+
+        def with_budget(connect):
+            attempt = 0
+            while True:
+                try:
+                    return connect()
+                except OSError:
+                    attempt += 1
+                    if attempt > 3:
+                        raise
+                    continue
+
+        def bounded_for(connect, policy):
+            for attempt in range(3):      # bounded loop, not while True
+                try:
+                    return connect()
+                except OSError:
+                    continue
+    """)
+
+
+def test_jx016_negative_queue_drain_and_inner_loop():
+    assert "JX016" not in rules_of("""
+        import queue
+
+        def drain(q):
+            while True:
+                try:
+                    item = q.get_nowait()   # break, not continue: a drain
+                except queue.Empty:
+                    break
+                yield item
+
+        def outer(jobs, run):
+            while True:
+                for j in jobs:
+                    try:
+                        run(j)
+                    except RuntimeError:
+                        continue         # binds to the inner for loop
+                return
+    """)
+
+
+def test_jx016_pragma_suppresses():
+    assert "JX016" not in rules_of("""
+        def spin(connect):
+            while True:
+                try:
+                    return connect()
+                except OSError:  # graftlint: disable=JX016  (probe rig)
+                    continue
+    """)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -854,7 +949,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 15
+    assert len(RULES) == 16
 
 
 def test_package_is_clean_modulo_baseline():

@@ -185,8 +185,11 @@ class TestElasticTrainer:
         trainer = ElasticTrainer(net, str(tmp_path), save_freq=5, keep_last=2)
         trainer.fit(self._batches(), max_steps=25)
         import os
-        ckpts = [f for f in os.listdir(tmp_path) if f.startswith("ckpt_")]
+        # CheckpointManager store layout: committed ckpt-XXXXXXXX dirs
+        # under keep_last retention, no ad-hoc zip files
+        ckpts = [f for f in os.listdir(tmp_path) if f.startswith("ckpt-")]
         assert len(ckpts) == 2
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
 
 
 def test_master_phase_stats():

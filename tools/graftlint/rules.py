@@ -1,4 +1,4 @@
-"""graftlint rule implementations JX001–JX015.
+"""graftlint rule implementations JX001–JX016.
 
 Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
 registered in ``RULES``.  Rules share the jit-scope + taint machinery in
@@ -871,6 +871,91 @@ def jx015(info: ModuleInfo) -> List[Finding]:
                     "builder.precision(...)), or cast once before the "
                     "loop"))
     return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX016
+_JX016_BACKOFF_CALLS = ("sleep", "backoff", "wait")
+_JX016_BUDGET_NAME_RE = re.compile(
+    r"attempt|retr|tries|budget|deadline|remaining", re.IGNORECASE)
+
+
+def _jx016_names_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+@rule("JX016", "unbounded retry loop: while True + except + continue with "
+               "no backoff and no attempt budget")
+def jx016(info: ModuleInfo) -> List[Finding]:
+    """Flag ``while True`` loops that retry on exception — an ``except``
+    handler ending the iteration with ``continue`` — with neither a
+    backoff call (``sleep``/``backoff``/``wait``) nor an attempt budget
+    (a comparison on an attempt/retry/deadline-style name) anywhere in
+    the loop body.  Such a loop hammers a dead dependency at full tilt
+    forever: a hub restart becomes a busy-wait stampede, and the caller
+    can never distinguish "still retrying" from "never coming back".
+    Bound it with ``faulttolerance.RetryPolicy`` (budgeted, seeded
+    exponential backoff) or an explicit deadline."""
+    out: List[Finding] = []
+    for loop in ast.walk(info.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        test = loop.test
+        if not (isinstance(test, ast.Constant) and test.value is True
+                or isinstance(test, ast.Constant) and test.value == 1):
+            continue
+        # retry shape: a Continue inside an except handler whose nearest
+        # enclosing loop is THIS while (a continue bound to an inner
+        # for/while retries that loop, not this one)
+        retry_node = None
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            for stmt in ast.walk(sub):
+                if isinstance(stmt, ast.Continue) and \
+                        _nearest_loop(info, stmt) is loop:
+                    retry_node = sub
+                    break
+            if retry_node is not None:
+                break
+        if retry_node is None:
+            continue
+        has_backoff = any(
+            isinstance(sub, ast.Call) and (call_name(sub) or "").split(
+                ".")[-1] in _JX016_BACKOFF_CALLS
+            for sub in ast.walk(loop))
+        has_budget = any(
+            isinstance(sub, ast.Compare) and any(
+                _JX016_BUDGET_NAME_RE.search(n)
+                for n in _jx016_names_in(sub))
+            for sub in ast.walk(loop))
+        if has_backoff or has_budget:
+            continue
+        out.append(_finding(
+            info, retry_node, "JX016",
+            "unbounded retry: `while True` re-enters on exception with no "
+            "backoff call and no attempt budget in the loop — a dead "
+            "dependency is hammered forever at full tilt; bound it with "
+            "faulttolerance.RetryPolicy (budgeted seeded backoff) or an "
+            "explicit deadline/attempt counter"))
+    return _dedupe(out)
+
+
+def _nearest_loop(info: ModuleInfo, node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing for/while of ``node`` without crossing a
+    function boundary."""
+    cur = info.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.Module)):
+            return None
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        cur = info.parent(cur)
+    return None
 
 
 def _dedupe(findings: List[Finding]) -> List[Finding]:
