@@ -47,6 +47,13 @@ A sixth JSON line records the elastic-runtime recovery benchmark
 first post-recovery training step, sync-retry vs elastic-degradation
 paths) so recovery-latency regressions are driver-visible;
 DL4J_TPU_BENCH_RECOVERY=0 suppresses it.
+
+A seventh set of JSON lines records the serving-engine benchmark
+(``serve_latency_ms[impl,c=...]``: p50/p99 + delivered req/s from
+closed-loop clients at concurrency {1, 16, 64}, continuous-batching
+engine vs the per-request baseline, with the engine's post-warmup
+recompile count — must stay 0) so serving-throughput regressions are
+driver-visible; DL4J_TPU_BENCH_SERVE=0 suppresses it.
 """
 import json
 import os
@@ -231,6 +238,19 @@ def main():
                                       "(sync retry)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # serving-engine row (ISSUE 8): closed-loop p50/p99 + req/s at
+    # concurrency {1,16,64}, continuous-batching engine vs per-request
+    # baseline; a seventh set of JSON lines, opt-out DL4J_TPU_BENCH_SERVE=0
+    if os.environ.get("DL4J_TPU_BENCH_SERVE", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import serve_latency_ms
+            for row in serve_latency_ms():
+                print(json.dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "serve_latency_ms", "value": None,
+                              "unit": "ms p50",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -330,6 +350,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # elastic runtime (ISSUE 7): injected-kill to first post-recovery
         # step, sync retry vs elastic degradation
         B.recovery_time_ms,
+        # serving engine (ISSUE 8): continuous batching vs per-request,
+        # closed-loop clients at c in {1,16,64}, zero-recompile-verified
+        B.serve_latency_ms,
     ]
     side = []
     for fn in captures:

@@ -56,7 +56,8 @@ import threading
 from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple
 
-__all__ = ["ShapePolicy", "default_shape_policy", "next_pow2"]
+__all__ = ["ShapePolicy", "default_shape_policy", "next_pow2",
+           "serving_buckets"]
 
 # padded/real element ratios: 1.0 = no padding, right tail = pathological
 _RATIO_BUCKETS = (1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0, 16.0)
@@ -67,6 +68,30 @@ def next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def serving_buckets(max_batch: int,
+                    ladder: Optional[Sequence[int]] = None) -> list:
+    """The inference-side batch-bucket ladder: powers of two capped by
+    ``max_batch`` (which is always the top bucket, pow2 or not).
+
+    ONE definition shared by ``ParallelInference`` and the serving
+    engine, so every serving path dispatches the same compiled shape set
+    — a request padded here rides an executable some other front-end
+    already compiled, and steady-state serving stays at zero new XLA
+    compiles beyond this ladder.  An explicit ``ladder`` is respected
+    as-is (sorted, deduplicated).
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if ladder:
+        return sorted({int(b) for b in ladder})
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    return out + [int(max_batch)]
 
 
 def _pad_rows(a, pad: int, zero: bool = False):

@@ -353,6 +353,18 @@ class CheckpointManager:
         ckpts = self.checkpoints()
         return ckpts[-1][1] if ckpts else None
 
+    def latest_complete(self, after_step: int = -1
+                        ) -> Optional[Tuple[int, str]]:
+        """Newest manifest-verified checkpoint strictly newer than
+        ``after_step``: ``(step, path)`` or None.  The serving tier's
+        train→serve promotion poll: a watcher holding the step it already
+        serves asks "is there anything newer and COMPLETE?" — corrupt or
+        still-staging directories never answer yes."""
+        ckpts = self.checkpoints()
+        if ckpts and ckpts[-1][0] > int(after_step):
+            return ckpts[-1][0], ckpts[-1][1]
+        return None
+
     def sweep_orphans(self) -> int:
         """Remove ``.tmp-`` staging leftovers from crashed writers."""
         from .atomic import discard_orphans

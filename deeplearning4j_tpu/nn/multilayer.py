@@ -223,6 +223,16 @@ def _build_stack_fn(conf, tx, kind: str):
             return _stack_forward(conf, params, state, x, train=False,
                                   key=None)
         return fn, ()
+    if kind == "serve":
+        # the serving engine's forward: identical program to "output" but
+        # with the input batch donated — the engine builds a fresh padded
+        # device batch per dispatch and never rereads it, so XLA may alias
+        # the buffer into activations (one less live HBM copy per batch).
+        # CPU doesn't implement donation and warns per compile; skip there.
+        def fn(params, state, x):
+            return _stack_forward(conf, params, state, x, train=False,
+                                  key=None)
+        return fn, (() if jax.default_backend() == "cpu" else (2,))
     if kind == "output_train":
         def fn(params, state, x, key):
             return _stack_forward(conf, params, state, x, train=True,

@@ -16,7 +16,11 @@ OpProfiler, UI stats storage — SURVEY §5):
 - :mod:`listener` — ``MetricsListener`` publishing score/throughput/
   grad-norm/device-memory from the ``TrainingListener`` hook points;
 - :mod:`clock` — the monotonic/wall helpers everything above (and the
-  benchmarks) source timings from.
+  benchmarks) source timings from;
+- :mod:`quantiles` — sliding-window exact quantiles (``LatencyWindow``),
+  the live p50/p99 read the serving tier's SLO admission control gates
+  on (registry histograms answer scrape-interval questions, not
+  "what is the p99 right now").
 
 Cost model: METRICS are on by default (the registry is plain host
 arithmetic — serving ``/metrics`` and the training counters work out of
@@ -30,6 +34,7 @@ from __future__ import annotations
 from .clock import monotonic_s, wall_s
 from .events import EventLog, configure_event_log, emit_event, get_event_log
 from .exposition import CONTENT_TYPE, escape_label_value, render_text
+from .quantiles import LatencyWindow
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, default_registry,
                        set_default_registry)
@@ -37,7 +42,8 @@ from .tracer import Span, SpanContext, Tracer, get_tracer, set_default_tracer
 
 __all__ = [
     "CONTENT_TYPE", "Counter", "DEFAULT_BUCKETS", "EventLog", "Gauge",
-    "Histogram", "MetricsListener", "MetricsRegistry", "Span",
+    "Histogram", "LatencyWindow", "MetricsListener", "MetricsRegistry",
+    "Span",
     "SpanContext", "Tracer", "configure_event_log", "default_registry",
     "emit_event", "escape_label_value", "get_event_log", "get_tracer",
     "monotonic_s", "render_text", "set_default_registry",

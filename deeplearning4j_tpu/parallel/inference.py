@@ -84,15 +84,13 @@ class ParallelInference:
         self.max_batch_size = max_batch_size
         self.nano_wait = nano_wait
         self.oversize_policy = oversize_policy
-        if batch_buckets:
-            # explicit buckets are respected as-is: a coalesced group
-            # larger than the top bucket follows oversize_policy instead
-            # of being silently dispatched unpadded
-            buckets = list(batch_buckets)
-        else:
-            buckets = [b for b in (1, 2, 4, 8, 16, 32, 64, 128)
-                       if b < max_batch_size] + [max_batch_size]
-        self.buckets = sorted(buckets)
+        # explicit buckets are respected as-is: a coalesced group larger
+        # than the top bucket follows oversize_policy instead of being
+        # silently dispatched unpadded.  The default ladder is the shared
+        # serving ladder (data/shapes.serving_buckets) so this front-end
+        # and the continuous-batching engine compile ONE shape set.
+        from ..data.shapes import serving_buckets
+        self.buckets = serving_buckets(max_batch_size, batch_buckets)
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
         self._submit_lock = threading.Lock()  # orders submits vs shutdown

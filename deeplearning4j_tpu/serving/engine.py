@@ -1,0 +1,812 @@
+"""Continuous-batching serving engine: scheduler, admission control,
+zero-downtime weight hot-swap.
+
+The per-request serving path (HTTP handler → ``ParallelInference`` →
+future) tops out at the dispatch rate of one coalescing queue whose
+dispatcher idles while the device runs.  This module is the production
+tier the reference stack splits into a dedicated model server (and
+TensorFlow's train/serve split argues for, PAPERS.md 1605.08695):
+
+**Continuous batching** (:class:`ServingEngine`): requests enter one
+bounded queue; a dispatcher thread forms the next batch *while the
+previous one executes on device*, so the device never waits for a batch
+to fill and a batch never waits for a straggler timer once the device is
+free.  Batches are padded onto the shared inference bucket ladder
+(``data/shapes.serving_buckets`` — the same compiled-shape set
+``ParallelInference`` uses), executed through the process-global trace
+cache (``nn/compile_cache.shared_jit``, kind ``"serve"``) on
+device-resident weights with the input buffer donated.  After
+:meth:`warmup` compiles the bucket set once, steady-state serving
+performs **zero new XLA compiles** (`steady_recompiles` counts any
+violation; the bench asserts it stays 0).
+
+**Admission control** (:class:`AdmissionController`): a queue-depth
+limit sheds load *before* it queues (429 + ``Retry-After``), per-model
+p50/p99 SLO targets are tracked over a sliding window
+(``observability.quantiles.LatencyWindow``) and surfaced — with queue
+saturation — through the readiness side of ``/health``, so an
+orchestrator routes away from a drowning replica instead of piling on.
+Shed/queue-depth/batch-fill land on the Prometheus registry.
+
+**Hot swap** (:meth:`ServingEngine.promote_latest` / :meth:`watch`): the
+engine serves from an immutable model *slot* (weights + compiled
+forward + version); promotion restores the newest manifest-complete
+checkpoint from a ``CheckpointManager`` directory into a fresh slot and
+swaps the reference atomically.  In-flight batches finish on the slot
+they snapshotted; every later batch executes the new one — no restart,
+no mixed-weights batch, and corrupt checkpoints are skipped by the
+manifest verification the checkpoint store already does.  Same-topology
+promotions reuse the already-compiled forward through the shared trace
+cache: a weight swap costs zero compiles.
+
+HTTP front-end: :class:`ServingServer` (``/predict``, ``/reload``,
+``/watch``, ``/health``, ``/metrics``) over the bounded
+``BackgroundHttpServer``.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.shapes import serving_buckets
+from ..faulttolerance.checkpoint import CheckpointManager
+from ..observability import clock
+from ..observability.quantiles import LatencyWindow
+from ..observability.registry import default_registry
+from ..parallel.inference import InvalidInputError
+from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
+
+__all__ = ["ServingEngine", "ServingServer", "ServingClient",
+           "AdmissionController", "SLOConfig", "ShedError"]
+
+log = logging.getLogger("deeplearning4j_tpu.serving")
+
+# engine-side request latency (enqueue -> result): sub-ms batched hits to
+# multi-second cold outliers
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 10.0)
+# batch fill = real rows / bucket rows per dispatch (1.0 = perfectly full)
+_FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class ShedError(RuntimeError):
+    """Request refused by admission control.  ``status`` is the HTTP code
+    the serving layer maps it to (429 queue-full / 503 unready) and
+    ``retry_after_s`` the client backoff hint."""
+
+    def __init__(self, detail: str, status: int = 429,
+                 retry_after_s: float = 1.0):
+        super().__init__(detail)
+        self.status = int(status)
+        self.retry_after_s = float(retry_after_s)
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Per-model latency SLO: targets in milliseconds over a sliding
+    window of recent requests.  ``None`` targets never breach.
+    ``min_samples`` gates flapping on an idle or freshly-started server
+    (no verdict until the window holds that many requests)."""
+
+    p50_target_ms: Optional[float] = None
+    p99_target_ms: Optional[float] = None
+    window: int = 512
+    min_samples: int = 32
+
+
+class AdmissionController:
+    """Queue-depth load shedding + sliding-window SLO tracking.
+
+    ``admit(n, depth)`` is the gate every request passes BEFORE
+    enqueueing: past ``queue_limit`` the request is shed immediately
+    (429 + ``Retry-After``) — a full queue signals the device is already
+    behind, and queueing deeper only converts overload into timeout
+    storms.  ``observe(seconds)`` feeds the SLO window; ``status()`` is
+    the readiness payload ``/health`` embeds."""
+
+    def __init__(self, queue_limit: int = 256,
+                 slo: Optional[SLOConfig] = None,
+                 retry_after_s: float = 1.0, registry=None):
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.queue_limit = int(queue_limit)
+        self.slo = slo or SLOConfig()
+        self.retry_after_s = float(retry_after_s)
+        self._registry = registry
+        self._window = LatencyWindow(self.slo.window)
+
+    def _reg(self):
+        return self._registry if self._registry is not None \
+            else default_registry()
+
+    def _count_shed(self, reason: str) -> None:
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter("serving_shed_total",
+                        "Requests shed by admission control",
+                        ("reason",)).labels(reason).inc()
+
+    def admit(self, n: int, depth: int) -> None:
+        """Admit ``n`` rows given current queue ``depth`` or raise
+        :class:`ShedError`."""
+        if depth + n > self.queue_limit:
+            self._count_shed("queue_full")
+            raise ShedError(
+                f"queue at limit ({depth}/{self.queue_limit} + {n} rows)",
+                status=429, retry_after_s=self.retry_after_s)
+
+    def shed_unready(self, detail: str) -> ShedError:
+        """Build (and count) the 503 shed for a model-less engine."""
+        self._count_shed("unready")
+        return ShedError(detail, status=503,
+                         retry_after_s=self.retry_after_s)
+
+    def observe(self, seconds: float) -> None:
+        self._window.observe(seconds)
+        reg = self._reg()
+        if reg.enabled:
+            reg.histogram("serving_request_seconds",
+                          "Engine request latency, enqueue to result",
+                          buckets=_LATENCY_BUCKETS).observe(seconds)
+
+    def slo_ok(self) -> bool:
+        """True until the window holds ``min_samples`` requests whose
+        p50/p99 breach a configured target."""
+        slo = self.slo
+        if slo.p50_target_ms is None and slo.p99_target_ms is None:
+            return True
+        snap = self._window.snapshot()
+        if len(self._window) < slo.min_samples or snap["p50"] is None:
+            return True
+        if slo.p50_target_ms is not None and \
+                snap["p50"] * 1e3 > slo.p50_target_ms:
+            return False
+        if slo.p99_target_ms is not None and \
+                snap["p99"] * 1e3 > slo.p99_target_ms:
+            return False
+        return True
+
+    def status(self, depth: int) -> dict:
+        snap = self._window.snapshot()
+        return {
+            "queue_depth": depth,
+            "queue_limit": self.queue_limit,
+            "saturated": depth >= self.queue_limit,
+            "slo_ok": self.slo_ok(),
+            "p50_ms": None if snap["p50"] is None
+            else round(snap["p50"] * 1e3, 3),
+            "p99_ms": None if snap["p99"] is None
+            else round(snap["p99"] * 1e3, 3),
+            "slo_p50_target_ms": self.slo.p50_target_ms,
+            "slo_p99_target_ms": self.slo.p99_target_ms,
+            "requests_observed": snap["count"],
+        }
+
+
+class _ModelSlot:
+    """Immutable serving snapshot: weights + compiled forward + identity.
+    The dispatcher reads ONE slot reference per batch, so a hot-swap can
+    never mix weights within a batch — in-flight batches finish on the
+    slot they captured, later batches see the new one."""
+
+    __slots__ = ("version", "model", "model_id", "fn", "params", "state",
+                 "feature_shape", "step")
+
+    def __init__(self, version: int, model, origin: str,
+                 step: Optional[int] = None):
+        self.version = version
+        self.model = model
+        self.step = step
+        self.fn, self.params, self.state = _serve_fn(model)
+        self.feature_shape = _feature_shape(model)
+        name = type(model).__name__
+        try:
+            n = model.num_params()    # shape metadata only: no device sync
+            self.model_id = f"{name}[params={n},v={version},from={origin}]"
+        except Exception:
+            self.model_id = f"{name}[v={version},from={origin}]"
+
+    def forward(self, batch):
+        out = self.fn(self.params, self.state, batch)
+        # network kinds return (y, state); plain callables return y
+        return out[0] if isinstance(out, tuple) else out
+
+
+def _serve_fn(model) -> Tuple:
+    """(fn, params, state) for one slot.  Networks serve through the
+    shared trace cache (kind ``"serve"``: the ``output`` program with the
+    input donated) on their live device-resident params; anything else —
+    test doubles, exported callables — falls back to ``model.output``
+    executed as-is."""
+    get_jitted = getattr(model, "_get_jitted", None)
+    if get_jitted is not None:
+        try:
+            return get_jitted("serve"), model.params, model.state
+        except KeyError:
+            return get_jitted("output"), model.params, model.state
+    if not callable(getattr(model, "output", None)):
+        raise TypeError(
+            f"{type(model).__name__} is not servable: needs _get_jitted "
+            "(framework networks) or an output(batch) method")
+    return (lambda params, state, x: model.output(x)), None, None
+
+
+def _feature_shape(model) -> Optional[Tuple[int, ...]]:
+    try:
+        return tuple(model.conf.input_type.shape(-1)[1:])
+    except Exception:
+        return None
+
+
+class _Request:
+    __slots__ = ("row", "future", "t_enqueue")
+
+    def __init__(self, row):
+        self.row = row
+        self.future: Future = Future()
+        self.t_enqueue = clock.monotonic_s()
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over one served model slot.
+
+    ``predict(x)`` admits, enqueues, and blocks on the result; the
+    dispatcher thread drains the queue into bucket-padded batches as fast
+    as the device finishes them.  See the module docstring for the
+    batching/admission/hot-swap design.
+    """
+
+    def __init__(self, model=None, *, max_batch_size: int = 32,
+                 queue_limit: int = 256, nano_wait: float = 0.0,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 slo: Optional[SLOConfig] = None,
+                 admission: Optional[AdmissionController] = None,
+                 checkpoint_dir: Optional[str] = None, registry=None):
+        self.buckets = serving_buckets(max_batch_size, batch_buckets)
+        self.max_batch_size = int(max_batch_size)
+        self.nano_wait = float(nano_wait)
+        self.checkpoint_dir = checkpoint_dir
+        self._registry = registry
+        self.admission = admission if admission is not None else \
+            AdmissionController(queue_limit=queue_limit, slo=slo,
+                                registry=registry)
+        # bounded twice: admission sheds above queue_limit, and the queue
+        # itself caps at limit + one bucket so a racing burst between the
+        # admission read and the put can never grow memory without bound
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.admission.queue_limit + self.buckets[-1])
+        self._slot: Optional[_ModelSlot] = None
+        self._slot_lock = threading.Lock()
+        self._version = 0
+        self._warm = False
+        self.steady_recompiles = 0       # traces seen AFTER warmup: keep 0
+        self.batches_dispatched = 0
+        self._shutdown = threading.Event()
+        self._submit_lock = threading.Lock()
+        self._watch_stop: Optional[threading.Event] = None
+        self._watch_thread: Optional[threading.Thread] = None
+        if model is not None:
+            self.hot_swap(model, origin="init")
+        elif checkpoint_dir:
+            if self.promote_latest() is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint to serve in {checkpoint_dir}")
+        self._dispatcher = threading.Thread(
+            target=self._serve_loop, daemon=True, name="dl4j-serve-dispatch")
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------- metrics
+    def _reg(self):
+        return self._registry if self._registry is not None \
+            else default_registry()
+
+    def _note_batch(self, real: int, bucket: int, traced: bool) -> None:
+        self.batches_dispatched += 1
+        if traced and self._warm:
+            self.steady_recompiles += 1
+        reg = self._reg()
+        if not reg.enabled:
+            return
+        reg.histogram("serving_batch_fill",
+                      "Real rows / bucket rows per dispatched batch",
+                      buckets=_FILL_BUCKETS).observe(real / bucket)
+        reg.counter("serving_batches_total",
+                    "Batches dispatched by the continuous-batching "
+                    "scheduler").inc()
+        reg.gauge("serving_queue_depth",
+                  "Requests waiting in the engine queue"
+                  ).set(self._queue.qsize())
+        if traced and self._warm:
+            reg.counter("serving_steady_recompiles_total",
+                        "XLA traces observed after warmup — should stay 0 "
+                        "(a novel shape escaped the bucket ladder)").inc()
+
+    # ---------------------------------------------------------- model slot
+    @property
+    def slot(self) -> Optional[_ModelSlot]:
+        with self._slot_lock:
+            return self._slot
+
+    @property
+    def model_version(self) -> int:
+        return self._version
+
+    def hot_swap(self, model, origin: str = "swap",
+                 step: Optional[int] = None) -> int:
+        """Install ``model`` as the serving slot; returns the new version.
+        In-flight batches keep executing the slot they already snapshot;
+        every batch formed after this call sees the new weights."""
+        with self._slot_lock:
+            self._version += 1
+            self._slot = _ModelSlot(self._version, model, origin, step=step)
+            version = self._version
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter("serving_model_reloads_total",
+                        "Successful model slot swaps").inc()
+            reg.gauge("serving_model_version",
+                      "Version of the currently served slot").set(version)
+        log.info("serving slot v%d installed (%s)", version,
+                 self._slot.model_id)
+        return version
+
+    def promote_latest(self, directory: Optional[str] = None
+                       ) -> Optional[int]:
+        """Promote the newest COMPLETE checkpoint from ``directory``
+        (default: the engine's ``checkpoint_dir``) into the serving slot.
+        Corrupt/partial checkpoints are skipped by manifest verification;
+        returns the promoted step, or None when nothing newer than the
+        currently-served step exists."""
+        directory = directory or self.checkpoint_dir
+        if not directory:
+            raise ValueError("promote_latest needs a checkpoint directory "
+                             "(constructor checkpoint_dir or argument)")
+        cur = self.slot
+        after = -1 if cur is None or cur.step is None else int(cur.step)
+        mgr = CheckpointManager(directory, registry=self._registry)
+        newest = mgr.latest_complete(after_step=after)
+        if newest is None:
+            return None
+        step, path = newest
+        model, _ = mgr.restore(path=path)
+        self.hot_swap(model, origin=path, step=step)
+        if directory == self.checkpoint_dir or self.checkpoint_dir is None:
+            self.checkpoint_dir = directory
+        return step
+
+    def watch(self, directory: Optional[str] = None,
+              interval_s: float = 2.0) -> None:
+        """Start (or retarget) the checkpoint watcher: poll ``directory``
+        every ``interval_s`` and promote whenever a newer complete
+        checkpoint commits — continuous train→serve promotion."""
+        directory = directory or self.checkpoint_dir
+        if not directory:
+            raise ValueError("watch needs a checkpoint directory")
+        self.checkpoint_dir = directory
+        self.stop_watch()
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.promote_latest(directory)
+                except Exception:
+                    log.exception("checkpoint watch promotion failed "
+                                  "(still serving v%d)", self._version)
+
+        self._watch_stop = stop
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="dl4j-serve-watch")
+        self._watch_thread.start()
+
+    def stop_watch(self) -> None:
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+            self._watch_thread.join(timeout=5)
+            self._watch_stop = self._watch_thread = None
+
+    @property
+    def watching(self) -> bool:
+        return self._watch_thread is not None and \
+            self._watch_thread.is_alive()
+
+    # -------------------------------------------------------------- serving
+    def warmup(self) -> int:
+        """Compile the bucket set (one forward per bucket) so no client
+        request ever pays a compile; returns the number of buckets warmed.
+        After a successful warmup, any further trace increments
+        ``steady_recompiles``.  Needs a slot whose model declares an input
+        type; without one the first live request per bucket warms it
+        instead — and the steady-recompile alarm stays DISARMED, since
+        those unavoidable first-per-bucket traces are not violations."""
+        slot = self.slot
+        if slot is None:
+            raise self.admission.shed_unready("no model installed")
+        warmed = 0
+        if slot.feature_shape is not None:
+            probe = np.zeros(slot.feature_shape, np.float32)
+            for b in self.buckets:
+                np.asarray(slot.forward(_pad_rows_np(
+                    np.stack([probe]), b)))
+                warmed += 1
+            self._warm = True
+        return warmed
+
+    def predict(self, x, timeout: Optional[float] = 60.0):
+        """Serve ``x`` (one example or a batch); blocks for the result.
+        Raises :class:`ShedError` when admission refuses,
+        :class:`InvalidInputError` on a shape mismatch."""
+        rows, single = self._validate(x)
+        slot = self.slot
+        if slot is None:
+            raise self.admission.shed_unready("no model installed")
+        self.admission.admit(len(rows), self._queue.qsize())
+        reqs = self._submit_all(rows)
+        out = np.stack([r.future.result(timeout=timeout)[0] for r in reqs])
+        now = clock.monotonic_s()
+        for r in reqs:
+            self.admission.observe(now - r.t_enqueue)
+        return out[0] if single else out
+
+    def predict_versioned(self, x, timeout: Optional[float] = 60.0):
+        """Like :meth:`predict` but returns ``(output, versions)`` where
+        ``versions[i]`` is the slot version that computed row ``i`` —
+        the observable the hot-swap tests (and cache-invalidation
+        clients) key on."""
+        rows, single = self._validate(x)
+        if self.slot is None:
+            raise self.admission.shed_unready("no model installed")
+        self.admission.admit(len(rows), self._queue.qsize())
+        reqs = self._submit_all(rows)
+        pairs = [r.future.result(timeout=timeout) for r in reqs]
+        now = clock.monotonic_s()
+        for r in reqs:
+            self.admission.observe(now - r.t_enqueue)
+        out = np.stack([p for p, _ in pairs])
+        versions = [v for _, v in pairs]
+        return (out[0], versions[:1]) if single else (out, versions)
+
+    def _validate(self, x) -> Tuple[np.ndarray, bool]:
+        x = np.asarray(x, dtype=np.float32)
+        slot = self.slot
+        expected = slot.feature_shape if slot is not None else None
+        ndim = len(expected) if expected is not None else 1
+        single = x.ndim == ndim
+        batch = x[None] if single else x
+        if expected is not None and tuple(batch.shape[1:]) != expected:
+            raise InvalidInputError(
+                f"expected feature shape {expected}, got "
+                f"{tuple(batch.shape[1:])}")
+        return batch, single
+
+    def _submit_all(self, rows) -> List[_Request]:
+        """Enqueue every row or none: a mid-batch queue.Full (a burst
+        racing past admission) cancels the rows already enqueued before
+        the ShedError propagates, so the dispatcher never computes
+        orphaned work whose caller already saw a 429 and will retry."""
+        reqs: List[_Request] = []
+        try:
+            for row in rows:
+                reqs.append(self._submit(row))
+        except ShedError:
+            for r in reqs:
+                r.future.cancel()
+            raise
+        return reqs
+
+    def _submit(self, row: np.ndarray) -> _Request:
+        req = _Request(row)
+        with self._submit_lock:
+            if self._shutdown.is_set():
+                raise RuntimeError("ServingEngine shut down")
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                # burst raced past admission into the slack band
+                self.admission._count_shed("queue_full")
+                raise ShedError(
+                    "queue at hard limit", status=429,
+                    retry_after_s=self.admission.retry_after_s)
+        return req
+
+    # ----------------------------------------------------------- dispatcher
+    def _serve_loop(self) -> None:
+        top = self.buckets[-1]
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if first is None:
+                continue
+            pending = [first]
+            # continuous batching: drain whatever arrived while the last
+            # batch ran — under load that IS the batch, no timer needed.
+            # nano_wait (off by default) optionally holds an empty-queue
+            # dispatch for stragglers: it trades lone-request latency for
+            # fill, and measured closed-loop it loses at every
+            # concurrency, so only enable it for known-bursty arrivals
+            if self.nano_wait and self._queue.qsize() == 0:
+                self._shutdown.wait(self.nano_wait)
+            while len(pending) < top:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is not None:
+                    pending.append(nxt)
+            # group by feature shape: one malformed row (models without a
+            # declared input type skip up-front validation) must not fail
+            # the requests coalesced with it
+            groups: dict = {}
+            for req in pending:
+                groups.setdefault(tuple(np.shape(req.row)),
+                                  []).append(req)
+            for group in groups.values():
+                self._run_batch(group)
+
+    def _run_batch(self, pending: List[_Request]) -> None:
+        # rows cancelled by a failed multi-row submit never reach device
+        pending = [r for r in pending if not r.future.cancelled()]
+        if not pending:
+            return
+        slot = self.slot       # ONE snapshot: no mixed-weights batch
+        try:
+            if slot is None:
+                raise RuntimeError("no model installed")
+            rows = np.stack([r.row for r in pending])
+            n = len(rows)
+            bucket = next(b for b in self.buckets if n <= b)
+            batch = _pad_rows_np(rows, bucket)
+            last_traced = getattr(slot.fn, "last_call_traced", None)
+            out = np.asarray(slot.forward(batch))[:n]
+            traced = bool(slot.fn.last_call_traced) \
+                if last_traced is not None else False
+            self._note_batch(n, bucket, traced)
+            for req, row in zip(pending, out):
+                if not req.future.done():
+                    req.future.set_result((row, slot.version))
+        except Exception as e:   # any failure must not kill the dispatcher
+            for req in pending:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    # ------------------------------------------------------------ lifecycle
+    def ready(self) -> Tuple[bool, dict]:
+        """(ready, admission_status): ready means a slot is installed, the
+        queue is below its shed limit, and the SLO window is not in
+        breach — the readiness circuit ``/health`` reports."""
+        depth = self._queue.qsize()
+        status = self.admission.status(depth)
+        slot = self.slot
+        ready = (slot is not None and not status["saturated"]
+                 and status["slo_ok"])
+        return ready, status
+
+    def stats(self) -> dict:
+        slot = self.slot
+        ready, admission = self.ready()
+        return {
+            "ready": ready,
+            "model": None if slot is None else slot.model_id,
+            "model_version": self._version,
+            "serving_step": None if slot is None else slot.step,
+            "buckets": list(self.buckets),
+            "batches_dispatched": self.batches_dispatched,
+            "steady_recompiles": self.steady_recompiles,
+            "watching": self.watching,
+            "checkpoint_dir": self.checkpoint_dir,
+            "admission": admission,
+        }
+
+    def shutdown(self) -> None:
+        self.stop_watch()
+        with self._submit_lock:
+            self._shutdown.set()
+        try:
+            self._queue.put_nowait(None)     # wake the dispatcher
+        except queue.Full:
+            pass
+        self._dispatcher.join(timeout=5)
+        while True:                          # unblock stranded callers
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("ServingEngine shut down"))
+
+
+def _pad_rows_np(rows: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad a host batch up to ``bucket`` rows by repeating the last real
+    row (same well-conditioned-forward convention as
+    ``ShapePolicy``/``ParallelInference``)."""
+    if len(rows) >= bucket:
+        return rows
+    return np.concatenate(
+        [rows, np.repeat(rows[-1:], bucket - len(rows), axis=0)])
+
+
+# --------------------------------------------------------------------- HTTP
+class _EngineHandler(JsonHandler):
+    server_ref = None    # type: ServingServer
+
+    def do_GET(self):
+        if self._serve_metrics():
+            return
+        if self.path.rstrip("/") == "/health":
+            return self._json(self.server_ref.health())
+        return self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        route = self.path.rstrip("/")
+        srv = self.server_ref
+        if route == "/predict":
+            return self._predict(srv)
+        if route == "/reload":
+            return self._reload(srv)
+        if route == "/watch":
+            return self._watch(srv)
+        return self._json({"error": "not found"}, 404)
+
+    def _predict(self, srv):
+        try:
+            x = np.asarray(self._read_json()["data"], dtype=np.float32)
+        except Exception as e:
+            return self._json({"error": str(e)}, 400)
+        try:
+            out, versions = srv.engine.predict_versioned(x)
+        except ShedError as e:
+            return self._json(
+                {"error": str(e)}, e.status,
+                headers={"Retry-After": max(1, round(e.retry_after_s))})
+        except InvalidInputError as e:
+            return self._json({"error": str(e)}, 400)
+        except Exception as e:    # model-side failure: server error
+            srv.consecutive_failures += 1
+            return self._json({"error": str(e)}, 500)
+        srv.consecutive_failures = 0
+        srv.last_predict_mono = clock.monotonic_s()
+        reg = self._registry()
+        if reg.enabled:
+            # len(versions) is exactly the number of examples served
+            # (x.shape[0] would miscount a single multi-dim example)
+            reg.counter("inference_examples_total",
+                        "Examples served through /predict") \
+               .inc(len(versions))
+        body = {"output": np.asarray(out).tolist(),
+                "model_version": versions[0] if len(set(versions)) == 1
+                else sorted(set(versions))}
+        return self._json(body)
+
+    def _reload(self, srv):
+        try:
+            body = self._read_json() if \
+                int(self.headers.get("Content-Length", 0)) else {}
+            if "path" in body:
+                from ..utils.model_serializer import restore_model
+                version = srv.engine.hot_swap(
+                    restore_model(body["path"]), origin=body["path"])
+                return self._json({"ok": True, "version": version})
+            step = srv.engine.promote_latest(body.get("dir"))
+            if step is None:
+                return self._json({"ok": True, "promoted": False,
+                                   "version": srv.engine.model_version})
+            return self._json({"ok": True, "promoted": True, "step": step,
+                               "version": srv.engine.model_version})
+        except Exception as e:
+            return self._json({"error": str(e)}, 400)
+
+    def _watch(self, srv):
+        try:
+            body = self._read_json() if \
+                int(self.headers.get("Content-Length", 0)) else {}
+            if body.get("stop"):
+                srv.engine.stop_watch()
+                return self._json({"ok": True, "watching": False})
+            srv.engine.watch(body.get("dir"),
+                             interval_s=float(body.get("interval_s", 2.0)))
+            return self._json({"ok": True, "watching": True})
+        except Exception as e:
+            return self._json({"error": str(e)}, 400)
+
+
+class ServingServer:
+    """HTTP front-end over a :class:`ServingEngine`.
+
+    Endpoints::
+
+      POST /predict  {"data": [...]}            -> {"output", "model_version"}
+                     429/503 + Retry-After when admission sheds
+      POST /reload   {"path": zip} | {"dir"?: ckpt store} -> promote/swap
+      POST /watch    {"dir"?, "interval_s"?} | {"stop": true}
+      GET  /health   liveness + readiness (queue/SLO/model identity)
+      GET  /metrics  Prometheus text (?format=json snapshot)
+    """
+
+    FAILURE_THRESHOLD = 3     # consecutive 5xx predicts flip readiness
+
+    def __init__(self, model=None, port: int = 0, *,
+                 engine: Optional[ServingEngine] = None,
+                 max_batch_size: int = 32, queue_limit: int = 256,
+                 slo: Optional[SLOConfig] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 watch_interval_s: Optional[float] = None,
+                 max_concurrent: int = 64, registry=None, warmup: bool = True):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.engine = engine if engine is not None else ServingEngine(
+            model, max_batch_size=max_batch_size, queue_limit=queue_limit,
+            slo=slo, checkpoint_dir=checkpoint_dir, registry=registry)
+        if warmup and self.engine.slot is not None:
+            try:
+                self.engine.warmup()
+            except Exception:
+                log.exception("serving warmup failed; buckets will "
+                              "compile lazily on first use")
+        if watch_interval_s is not None:
+            self.engine.watch(interval_s=watch_interval_s)
+        from ..utils.profiling import device_platform
+        self.platform = device_platform()
+        self.consecutive_failures = 0
+        self.last_predict_mono: Optional[float] = None
+        self._server = BackgroundHttpServer(
+            _EngineHandler, port, max_concurrent=max_concurrent,
+            server_ref=self, metrics_registry=self.registry)
+
+    def health(self) -> dict:
+        engine_ready, admission = self.engine.ready()
+        circuit_ok = self.consecutive_failures < self.FAILURE_THRESHOLD
+        ready = engine_ready and circuit_ok
+        since = (None if self.last_predict_mono is None
+                 else round(clock.monotonic_s() - self.last_predict_mono, 3))
+        slot = self.engine.slot
+        return {"status": "ok" if ready else "unready",
+                "live": True,
+                "ready": ready,
+                "consecutive_failures": self.consecutive_failures,
+                "platform": self.platform,
+                "model": None if slot is None else slot.model_id,
+                "model_version": self.engine.model_version,
+                "serving_step": None if slot is None else slot.step,
+                "watching": self.engine.watching,
+                "admission": admission,
+                "seconds_since_last_predict": since}
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> "ServingServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.engine.shutdown()
+
+
+class ServingClient(JsonClient):
+    def predict(self, data) -> np.ndarray:
+        return np.asarray(self.post(
+            "/predict", {"data": np.asarray(data).tolist()})["output"])
+
+    def predict_versioned(self, data):
+        body = self.post("/predict", {"data": np.asarray(data).tolist()})
+        return np.asarray(body["output"]), body["model_version"]
+
+    def reload(self, path: Optional[str] = None,
+               directory: Optional[str] = None) -> dict:
+        body = {}
+        if path:
+            body["path"] = path
+        if directory:
+            body["dir"] = directory
+        return self.post("/reload", body)

@@ -121,11 +121,24 @@ class InferenceServer:
                 "seconds_since_last_predict": since}
 
     def reload(self, path: str) -> None:
-        """Hot-swap the served model from a checkpoint zip (the rolling
-        model-update story: new requests hit the new model, the old
-        batcher drains first)."""
+        """Hot-swap the served model from a checkpoint zip — or, given a
+        ``CheckpointManager`` store directory, from its newest COMPLETE
+        checkpoint (corrupt/staging directories are skipped by manifest
+        verification; the same promotion rule the continuous-batching
+        engine's ``/reload`` applies)."""
+        import os
+
+        from ..faulttolerance.checkpoint import CheckpointManager
         from ..utils.model_serializer import restore_model
-        new_model = restore_model(path)
+        if os.path.isdir(path):
+            mgr = CheckpointManager(path, registry=self.registry)
+            newest = mgr.latest_complete()
+            if newest is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint to promote in {path}")
+            new_model, _ = mgr.restore(path=newest[1])
+        else:
+            new_model = restore_model(path)
         old = self.inference
         self.inference = ParallelInference(new_model, self._mode,
                                            max_batch_size=self._max_batch)

@@ -443,8 +443,7 @@ class _Handler(JsonHandler):
             del self.activations[:-50]   # bounded history
             return self._json({"ok": True})
         if parts and parts[0] == "tsne":
-            n = int(self.headers.get("Content-Length", 0))
-            text = self.rfile.read(n).decode("utf-8", errors="replace")
+            text = self._read_body().decode("utf-8", errors="replace")
             lines = [ln.strip() for ln in text.splitlines() if ln.strip()]
             if len(parts) == 2 and parts[1] == "upload":
                 self.tsne_sessions[_UPLOADED_FILE] = lines

@@ -424,10 +424,6 @@ def serving_latency(concurrency: int = 16,
     concurrency, batched (dynamic coalescing) vs unbatched (INPLACE
     synchronous).  Requests are singleton feature rows fired from
     ``concurrency`` client threads against one LeNet-sized model."""
-    import threading
-
-    import jax.numpy as jnp
-
     from ..models import LeNet
     from ..parallel.inference import InferenceMode, ParallelInference
 
@@ -443,29 +439,11 @@ def serving_latency(concurrency: int = 16,
         # request (XLA compiles per padded shape)
         for b in (1, 2, 4, 8, 16, 32):
             pi.output(np.stack([probe] * b))
-        lats: List[float] = []
-        lock = threading.Lock()
-        per_worker = n_requests // concurrency
-
-        def client():
-            mine = []
-            for _ in range(per_worker):
-                t0 = monotonic_s()
-                np.asarray(pi.output(probe))  # host-synced result
-                mine.append(monotonic_s() - t0)
-            with lock:
-                lats.extend(mine)
-
-        threads = [threading.Thread(target=client)
-                   for _ in range(concurrency)]
-        t0 = monotonic_s()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = monotonic_s() - t0
+        lats, wall, _ = _closed_loop(
+            lambda: np.asarray(pi.output(probe)),  # host-synced result
+            concurrency, n_requests)
         pi.shutdown()
-        lats_ms = np.asarray(sorted(lats)) * 1e3
+        lats_ms = np.asarray(lats) * 1e3
         out.append({
             "metric": f"serving_latency_ms[{mode.lower()},c={concurrency}]",
             "value": round(float(np.percentile(lats_ms, 50)), 2),
@@ -474,6 +452,108 @@ def serving_latency(concurrency: int = 16,
             "requests": len(lats),
             "requests_per_sec": round(len(lats) / wall, 1),
         })
+    return out
+
+
+def _closed_loop(call, concurrency: int, n_requests: int):
+    """Closed-loop load: ``concurrency`` client threads each issue
+    ``n_requests // concurrency`` back-to-back requests.  Returns
+    (sorted latencies in seconds, wall seconds, error count)."""
+    import threading
+
+    lats: List[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    per_worker = max(1, n_requests // concurrency)
+
+    def client():
+        mine = []
+        errs = 0
+        for _ in range(per_worker):
+            t0 = monotonic_s()
+            try:
+                call()
+            except Exception:
+                errs += 1
+                continue
+            mine.append(monotonic_s() - t0)
+        with lock:
+            lats.extend(mine)
+            errors[0] += errs
+
+    threads = [threading.Thread(target=client)
+               for _ in range(concurrency)]
+    t0 = monotonic_s()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = monotonic_s() - t0
+    return sorted(lats), wall, errors[0]
+
+
+def serve_latency_ms(concurrencies=(1, 16, 64), n_requests: int = 384,
+                     model=None, max_batch: int = 32,
+                     queue_limit: int = 1024) -> List[Dict]:
+    """Serving-engine bench (ISSUE 8): p50/p99 single-request latency and
+    delivered req/s from closed-loop clients, the continuous-batching
+    :class:`serving.ServingEngine` vs the per-request baseline (one
+    synchronous forward per request — the pre-engine serving path), at
+    each stated concurrency.  Engine rows carry ``vs_per_request``
+    (req/s ratio — the acceptance gate at c=16) and
+    ``steady_recompiles`` (XLA traces after warmup, which the warmed
+    bucket ladder must keep at 0)."""
+    from ..models import LeNet
+    from ..parallel.inference import InferenceMode, ParallelInference
+    from ..serving.engine import ServingEngine
+
+    if model is None:
+        model = LeNet().init()
+    try:
+        feat = tuple(model.conf.input_type.shape(-1)[1:])
+    except Exception:
+        feat = (784,)
+    probe = np.random.default_rng(0).standard_normal(feat).astype(np.float32)
+
+    def rows_for(impl: str, call, concurrency: int, extra: Dict) -> Dict:
+        lats, wall, errs = _closed_loop(call, concurrency, n_requests)
+        lats_ms = np.asarray(lats) * 1e3
+        return {
+            "metric": f"serve_latency_ms[{impl},c={concurrency}]",
+            "value": round(float(np.percentile(lats_ms, 50)), 2),
+            "unit": "ms p50", "impl": impl, "concurrency": concurrency,
+            "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+            "requests": len(lats), "errors": errs,
+            "requests_per_sec": round(len(lats) / wall, 1),
+            **extra,
+        }
+
+    out: List[Dict] = []
+    baseline_rps: Dict[int, float] = {}
+    # per-request baseline: every request pays its own synchronous forward
+    pi = ParallelInference(model, InferenceMode.INPLACE)
+    pi.output(probe)                       # compile the singleton shape
+    for c in concurrencies:
+        row = rows_for("per_request", lambda: pi.output(probe), c, {})
+        baseline_rps[c] = row["requests_per_sec"]
+        out.append(row)
+    pi.shutdown()
+
+    engine = ServingEngine(model, max_batch_size=max_batch,
+                           queue_limit=queue_limit)
+    try:
+        engine.warmup()                    # compile the bucket ladder
+        for c in concurrencies:
+            row = rows_for("engine", lambda: engine.predict(probe), c, {})
+            if baseline_rps.get(c):
+                row["vs_per_request"] = round(
+                    row["requests_per_sec"] / baseline_rps[c], 2)
+            # read AFTER the loop: these count the timed window's work
+            row["steady_recompiles"] = engine.steady_recompiles
+            row["batches_dispatched"] = engine.batches_dispatched
+            out.append(row)
+    finally:
+        engine.shutdown()
     return out
 
 

@@ -1,14 +1,36 @@
 """Shared HTTP plumbing for the serving tier: JSON request/response handler
 base with built-in observability (request count/latency/error-class metrics
 per route and a ``/metrics`` exposition endpoint), background-thread server
-lifecycle, and a JSON POST client."""
+lifecycle with bounded handler concurrency, and a keep-alive JSON client.
+
+Concurrency model: ``ThreadingHTTPServer`` spawns one thread per
+connection with no cap — under a connection flood that is an unbounded
+thread (and memory) blowup.  ``BackgroundHttpServer`` bounds BOTH
+resources, because keep-alive makes them distinct: ``max_concurrent``
+caps requests being *handled* at once (an over-cap request gets a proper
+``503 + Retry-After`` on its own connection, which stays open — an idle
+pooled connection never holds a handling slot), while a higher
+connection cap (default ``4 x max_concurrent``) bounds handler *threads*
+against raw connection floods with a minimal socket-level 503 before any
+thread spawns.  ``http_inflight_requests`` (requests mid-handler) and
+``http_shed_total{scope=request|connection}`` make the pressure
+scrape-visible.
+
+``JsonClient`` holds one persistent ``http.client.HTTPConnection`` per
+calling thread (keep-alive), with a single bounded reconnect when a
+pooled connection turns out stale (server restarted, idle timeout) —
+so a concurrency bench measures the server, not TCP handshakes."""
 from __future__ import annotations
 
+import http.client
+import io
 import json
+import socket
 import threading
+import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.request import Request, urlopen
+from urllib.parse import urlsplit
 
 from ..observability import clock
 from ..observability.exposition import CONTENT_TYPE, render_text
@@ -83,49 +105,212 @@ class MetricsEndpointMixin:
             self._json(reg.snapshot())
             return True
         payload = render_text(reg).encode("utf-8")
-        self.send_response(200)
-        self.send_header("Content-Type", CONTENT_TYPE)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+            return True
         self._observe_request(200)
         return True
 
 
 class JsonHandler(MetricsEndpointMixin, BaseHTTPRequestHandler):
-    """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST."""
+    """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST.
+
+    HTTP/1.1 so keep-alive clients (``JsonClient``'s per-thread pooled
+    connections) reuse one socket across requests; every response path
+    here sends ``Content-Length``, which 1.1 persistence requires.  Idle
+    connections are dropped after ``timeout`` so abandoned sockets can't
+    pin handler threads (and concurrency-cap slots) forever."""
+
+    protocol_version = "HTTP/1.1"
+    timeout = 65
 
     def log_message(self, *a):
         pass
+
+    def _request_gauge(self):
+        return self._registry().gauge(
+            "http_inflight_requests",
+            "Requests currently being handled (capped at max_concurrent)")
+
+    def parse_request(self):
+        ok = super().parse_request()
+        if not ok:
+            return False
+        # per-REQUEST concurrency slot: taken after a full request line
+        # arrives (an idle keep-alive connection holds nothing), shed
+        # in-protocol so the client's pooled connection survives the 503
+        slots = getattr(self.server, "request_slots", None)
+        if slots is not None:
+            if not slots.acquire(blocking=False):
+                self.server.count_shed("request")
+                self._json({"error": "server at concurrency cap"}, 503,
+                           headers={"Retry-After": "1"})
+                return False
+            self._slot_held = True
+            if self._registry().enabled:
+                self._request_gauge().inc()
+        return True
 
     def handle_one_request(self):
         # stamp BEFORE parsing so the latency histogram covers the whole
         # request (read + handle + write), not just the handler body
         self._req_start_mono = clock.monotonic_s()
-        super().handle_one_request()
+        self._slot_held = False
+        self._body_read = False
+        try:
+            super().handle_one_request()
+        finally:
+            if self._slot_held:
+                self._slot_held = False
+                self.server.request_slots.release()
+                if self._registry().enabled:
+                    self._request_gauge().dec()
 
-    def _json(self, obj, code: int = 200):
+    # largest request body worth draining to keep a connection alive; a
+    # bigger one is cheaper to abandon than to read
+    _DRAIN_CAP = 1 << 20
+
+    def _drain_unread_body(self) -> None:
+        """Consume an unread request body before responding.  HTTP/1.1
+        keep-alive makes this mandatory: a response sent with body bytes
+        still in the socket (shed 503s, 404 routes) would desync the
+        client's pooled connection — the leftover body parses as the next
+        request line.  Oversized bodies close the connection instead."""
+        if getattr(self, "_body_read", False):
+            return
+        self._body_read = True
+        try:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+        except (TypeError, ValueError):
+            n = 0
+        if n <= 0:
+            return
+        if n > self._DRAIN_CAP:
+            self.close_connection = True
+            return
+        try:
+            self.rfile.read(n)
+        except OSError:
+            self.close_connection = True
+
+    def _json(self, obj, code: int = 200, headers: Optional[dict] = None):
+        self._drain_unread_body()     # keep-alive: never strand body bytes
         payload = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError):
+            # the client gave up (timeout under overload) — a dead socket
+            # is routine there, not a handler error worth a stack trace
+            self.close_connection = True
+            return
         self._observe_request(code)
 
-    def _read_json(self):
+    def _read_body(self) -> bytes:
+        """Read the request body.  ALWAYS consume the body through this
+        (or ``_read_json``) rather than ``self.rfile`` directly — it
+        marks the body consumed so the keep-alive drain in ``_json``
+        doesn't block re-reading bytes that are already gone."""
+        self._body_read = True
         n = int(self.headers.get("Content-Length", 0))
-        return json.loads(self.rfile.read(n))
+        return self.rfile.read(n)
+
+    def _read_json(self):
+        return json.loads(self._read_body())
+
+
+# connection-level shed response: written straight to the socket before
+# any handler thread exists, so a flood can't allocate per-request state
+_SHED_BODY = b'{"error": "server at concurrency cap"}'
+_SHED_RESPONSE = (b"HTTP/1.1 503 Service Unavailable\r\n"
+                  b"Retry-After: 1\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(_SHED_BODY)).encode() +
+                  b"\r\nConnection: close\r\n\r\n" + _SHED_BODY)
+
+
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a request-handling cap and a connection
+    (thread) cap.
+
+    ``request_slots`` (``max_concurrent``) is taken per REQUEST by the
+    handler (see ``JsonHandler.parse_request``) — keep-alive connections
+    idling between requests hold no slot, and an over-cap request gets a
+    proper in-protocol 503 + Retry-After.  The connection cap bounds
+    handler threads themselves: past it, the accepted socket gets a raw
+    503 and closes before any thread spawns (flood containment).
+    """
+
+    metrics_registry = None
+
+    def __init__(self, addr, handler, max_concurrent: int,
+                 max_connections: Optional[int] = None):
+        self.max_concurrent = int(max_concurrent)
+        self.max_connections = int(max_connections) if max_connections \
+            else max(4 * self.max_concurrent, 64)
+        self.request_slots = threading.BoundedSemaphore(self.max_concurrent)
+        self._conn_slots = threading.BoundedSemaphore(self.max_connections)
+        super().__init__(addr, handler)
+
+    def _registry(self):
+        reg = getattr(self, "metrics_registry", None)
+        return reg if reg is not None else default_registry()
+
+    def count_shed(self, scope: str) -> None:
+        reg = self._registry()
+        if reg.enabled:
+            reg.counter("http_shed_total",
+                        "Requests/connections shed at a concurrency cap "
+                        "(503 + Retry-After)", ("scope",)
+                        ).labels(scope).inc()
+
+    def process_request(self, request, client_address):
+        if not self._conn_slots.acquire(blocking=False):
+            self.count_shed("connection")
+            try:
+                request.sendall(_SHED_RESPONSE)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_slots.release()
 
 
 class BackgroundHttpServer:
-    """Owns a ThreadingHTTPServer on a daemon thread; binds the given handler
-    class with extra attributes (the per-instance state the handler needs)."""
+    """Owns a bounded ThreadingHTTPServer on a daemon thread; binds the
+    given handler class with extra attributes (the per-instance state the
+    handler needs).  ``max_concurrent`` caps requests being handled at
+    once (in-protocol 503 + Retry-After past it); ``max_connections``
+    (default 4x) caps handler threads against connection floods."""
 
-    def __init__(self, handler_base, port: int = 0, **handler_attrs):
+    def __init__(self, handler_base, port: int = 0,
+                 max_concurrent: int = 64,
+                 max_connections: Optional[int] = None, **handler_attrs):
         handler = type(f"Bound{handler_base.__name__}", (handler_base,),
                        dict(handler_attrs))
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.httpd = _BoundedThreadingHTTPServer(
+            ("127.0.0.1", port), handler, max_concurrent=max_concurrent,
+            max_connections=max_connections)
+        # the shed path and the inflight gauge report into the same
+        # registry the handlers bind
+        self.httpd.metrics_registry = handler_attrs.get("metrics_registry")
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -144,21 +329,101 @@ class BackgroundHttpServer:
 
 
 class JsonClient:
+    """JSON-over-HTTP client with per-thread persistent connections.
+
+    One ``http.client.HTTPConnection`` (or ``HTTPSConnection`` for
+    ``https://`` URLs) per calling thread, reused across requests
+    (keep-alive).  A stale pooled connection — the server restarted or
+    closed the idle socket — gets ONE bounded reconnect, and only when a
+    retry cannot double-execute: the failure happened while SENDING on a
+    reused connection (nothing reached the server), or the method is an
+    idempotent GET.  A POST whose bytes may have been delivered (send
+    succeeded but the response failed, or any timeout) always propagates
+    the error — serving requests are not assumed idempotent.  Error
+    responses raise :class:`urllib.error.HTTPError` with
+    ``.code``/``.headers``, matching the previous ``urlopen`` behavior
+    callers already handle."""
+
     def __init__(self, url: str, timeout: float = 10.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        parts = urlsplit(self.url if "//" in self.url
+                         else "http://" + self.url)
+        self._https = parts.scheme == "https"
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or (443 if self._https else 80)
+        # base-URL path prefix (reverse proxy / mounted sub-path) rides
+        # in front of every route, matching the old urlopen(url + route)
+        self._base_path = parts.path.rstrip("/")
+        self._tls = threading.local()
+
+    # ------------------------------------------------------- connection pool
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            cls = http.client.HTTPSConnection if self._https \
+                else http.client.HTTPConnection
+            conn = cls(self._host, self._port, timeout=self.timeout)
+            self._tls.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._tls.conn = None
+
+    def close(self) -> None:
+        """Close this thread's pooled connection (idle cleanup)."""
+        self._drop_conn()
+
+    # -------------------------------------------------------------- requests
+    def _request(self, method: str, route: str,
+                 body: Optional[bytes] = None) -> bytes:
+        headers = {"Content-Type": "application/json"} if body else {}
+        for attempt in (0, 1):
+            reused = getattr(self._tls, "conn", None) is not None
+            conn = self._conn()
+            sent = False
+            try:
+                conn.request(method, self._base_path + route, body=body,
+                             headers=headers)
+                sent = True               # bytes may now be at the server
+                resp = conn.getresponse()
+                data = resp.read()        # drain fully: keeps the socket
+            except socket.timeout:        # reusable for the next request
+                self._drop_conn()
+                raise                     # possibly delivered: never retried
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop_conn()
+                # ONE reconnect, only when it cannot double-execute: a
+                # send-phase failure on a REUSED (stale keep-alive) socket
+                # never reached the server, and GETs are idempotent.  A
+                # POST that failed after sending propagates — the server
+                # may already be acting on it.
+                retriable = reused and (not sent or method == "GET")
+                if attempt or not retriable:
+                    raise
+                continue
+            if resp.will_close:
+                self._drop_conn()
+            if resp.status >= 400:
+                raise urllib.error.HTTPError(
+                    self.url + route, resp.status, resp.reason,
+                    resp.headers, io.BytesIO(data))
+            return data
+        raise RuntimeError("unreachable")  # pragma: no cover
 
     def post(self, route: str, body: dict) -> dict:
-        req = Request(self.url + route, data=json.dumps(body).encode(),
-                      headers={"Content-Type": "application/json"})
-        with urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        return json.loads(self._request(
+            "POST", route, json.dumps(body).encode()))
 
     def get(self, route: str) -> dict:
-        with urlopen(self.url + route, timeout=self.timeout) as resp:
-            return json.loads(resp.read())
+        return json.loads(self._request("GET", route))
 
     def get_text(self, route: str) -> str:
         """Raw body fetch (the Prometheus /metrics exposition is not JSON)."""
-        with urlopen(self.url + route, timeout=self.timeout) as resp:
-            return resp.read().decode("utf-8")
+        return self._request("GET", route).decode("utf-8")

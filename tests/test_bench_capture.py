@@ -52,6 +52,41 @@ def test_exhausted_retries_tag_invalid():
     assert rows[0]["tunnel_probe"]["healthy"] is False
 
 
+def test_serve_latency_ms_rows():
+    """The serving-engine bench line (ISSUE 8): per-impl rows (engine vs
+    per-request) at each concurrency, with p50/p99 + req/s, the engine's
+    vs_per_request ratio, and a compile-counter-verified zero-recompile
+    steady state.  Tiny CPU config."""
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rows = B.serve_latency_ms(concurrencies=(2,), n_requests=32,
+                              model=net, max_batch=8)
+    assert [r["metric"] for r in rows] == [
+        "serve_latency_ms[per_request,c=2]", "serve_latency_ms[engine,c=2]"]
+    for row in rows:
+        assert row["value"] > 0 and row["p99_ms"] >= row["value"]
+        assert row["requests_per_sec"] > 0
+        assert row["errors"] == 0 and row["unit"] == "ms p50"
+    engine_row = rows[1]
+    assert engine_row["vs_per_request"] > 0
+    # the warmed bucket ladder held: no steady-state XLA recompiles
+    assert engine_row["steady_recompiles"] == 0
+    assert engine_row["batches_dispatched"] > 0
+
+
 def test_step_time_ms_rows():
     """The step-time engine bench line (ISSUE 6): auto-vs-off rows per
     (seq, dtype) with the cost-model adaptation count.  Tiny CPU config;

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX016 has at least one fixture that MUST fire and one
+Every rule JX001–JX017 has at least one fixture that MUST fire and one
 that MUST stay silent; the gate test makes every future PR re-lint the
 whole package without separate CI wiring.
 """
@@ -830,6 +830,76 @@ def test_jx016_pragma_suppresses():
     """)
 
 
+# ---------------------------------------------------------------- JX017
+_SERVING_PATH = "deeplearning4j_tpu/serving/fix.py"
+
+
+def rules_at(src: str, path: str):
+    return {f.rule for f in lint_source(textwrap.dedent(src), path)}
+
+
+def test_jx017_positive_unbounded_queues_in_serving_scope():
+    src = """
+        import queue
+        import multiprocessing as mp
+        from queue import Queue
+
+        def build():
+            a = queue.Queue()          # unbounded
+            b = mp.Queue()             # unbounded
+            c = Queue()                # unbounded (from-import)
+            return a, b, c
+    """
+    findings = lint_source(textwrap.dedent(src), _SERVING_PATH)
+    assert sum(f.rule == "JX017" for f in findings) == 3
+
+
+def test_jx017_positive_streaming_and_parallel_scope():
+    src = """
+        import queue
+
+        q = queue.PriorityQueue()
+    """
+    for path in ("deeplearning4j_tpu/streaming/fix.py",
+                 "deeplearning4j_tpu/parallel/fix.py"):
+        assert "JX017" in rules_at(src, path)
+
+
+def test_jx017_negative_bounded_or_deliberate():
+    assert "JX017" not in rules_at("""
+        import queue
+        import multiprocessing as mp
+
+        def build(limit):
+            a = queue.Queue(maxsize=limit)    # keyword bound
+            b = queue.Queue(256)              # positional bound
+            c = mp.Queue(maxsize=0)           # deliberate unboundedness
+            return a, b, c
+    """, _SERVING_PATH)
+
+
+def test_jx017_negative_out_of_scope_module():
+    # ETL/data modules size queues to their prefetch depth — out of scope
+    assert "JX017" not in rules_at("""
+        import queue
+
+        q = queue.Queue()
+    """, "deeplearning4j_tpu/data/fix.py")
+    assert "JX017" not in rules_of("""
+        import queue
+
+        q = queue.Queue()
+    """)
+
+
+def test_jx017_pragma_suppresses():
+    assert "JX017" not in rules_at("""
+        import queue
+
+        q = queue.Queue()  # graftlint: disable=JX017  (drained every tick)
+    """, _SERVING_PATH)
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -949,7 +1019,7 @@ def test_syntax_error_reported_not_crashed():
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
     assert set(RULES) == set(RULE_DOCS)
-    assert len(RULES) == 16
+    assert len(RULES) == 17
 
 
 def test_package_is_clean_modulo_baseline():

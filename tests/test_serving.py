@@ -278,6 +278,339 @@ def test_nn_server_health_and_metrics():
         server.stop()
 
 
+def _small_net(seed):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class _BlockingModel:
+    """Test double: forward blocks until released (drives the engine's
+    queue into saturation deterministically)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def output(self, x):
+        self.gate.wait(timeout=30)
+        return np.zeros((len(np.atleast_2d(x)), 2), np.float32)
+
+
+class TestServingEngine:
+    def test_engine_matches_model_zero_steady_recompiles(self, iris_net):
+        from deeplearning4j_tpu.serving import ServingEngine
+        eng = ServingEngine(iris_net, max_batch_size=8, queue_limit=64)
+        try:
+            assert eng.warmup() == 4          # ladder 1,2,4,8
+            rng = np.random.default_rng(0)
+            for n in (1, 3, 5, 8, 2, 7):      # ragged sizes ride buckets
+                x = rng.standard_normal((n, 4)).astype(np.float32)
+                np.testing.assert_allclose(
+                    eng.predict(x), np.asarray(iris_net.output(x)),
+                    rtol=1e-5, atol=1e-6)
+            single = eng.predict(x[0])
+            assert single.shape == (3,)
+            # steady state stayed on the warmed bucket set
+            assert eng.steady_recompiles == 0
+            assert eng.stats()["ready"] is True
+        finally:
+            eng.shutdown()
+
+    def test_admission_sheds_at_queue_limit_and_recovers(self):
+        from deeplearning4j_tpu.serving import ServingEngine, ShedError
+        model = _BlockingModel()
+        eng = ServingEngine(model, max_batch_size=1, queue_limit=2,
+                            nano_wait=0.0)
+        results = []
+
+        def call():
+            results.append(eng.predict(np.zeros(4, np.float32),
+                                       timeout=30))
+
+        threads = [threading.Thread(target=call) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            # dispatcher holds one request on the blocked forward; wait
+            # until the queue holds the other two (the shed limit)
+            deadline = 500
+            while eng._queue.qsize() < 2 and deadline:
+                threading.Event().wait(0.02)
+                deadline -= 1
+            assert eng._queue.qsize() >= 2
+            with pytest.raises(ShedError) as ei:
+                eng.predict(np.zeros(4, np.float32))
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s > 0
+            # saturation flips the readiness circuit
+            ready, status = eng.ready()
+            assert ready is False and status["saturated"] is True
+            # release: queue drains, readiness recovers, requests serve
+            model.gate.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert len(results) == 3
+            ready, status = eng.ready()
+            assert ready is True and status["saturated"] is False
+            assert eng.predict(np.zeros(4, np.float32)).shape == (2,)
+        finally:
+            model.gate.set()
+            eng.shutdown()
+
+    def test_promote_latest_skips_corrupt_and_watch_promotes(self, tmp_path):
+        from deeplearning4j_tpu.faulttolerance import CheckpointManager
+        from deeplearning4j_tpu.serving import ServingEngine
+        mgr = CheckpointManager(tmp_path, background=False)
+        net_a, net_b = _small_net(1), _small_net(99)
+        mgr.save(net_a, step=1)
+        p2 = mgr.save(net_b, step=2)
+        # tamper step 2 AFTER commit: checksum mismatch = corrupt
+        with open(f"{p2}/model.zip", "r+b") as f:
+            f.write(b"\x00\x00garbage")
+        eng = ServingEngine(checkpoint_dir=str(tmp_path), max_batch_size=4)
+        try:
+            # corrupt newest skipped: step 1 serves
+            assert eng.slot.step == 1
+            x = np.ones((2, 4), np.float32)
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(net_a.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            # nothing newer and complete -> no-op
+            assert eng.promote_latest() is None
+            # a complete step 3 promotes (watch mode drives it)
+            eng.watch(interval_s=0.05)
+            assert eng.watching
+            mgr.save(net_b, step=3)
+            deadline = 200
+            while eng.model_version < 2 and deadline:
+                threading.Event().wait(0.05)
+                deadline -= 1
+            assert eng.slot.step == 3
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(net_b.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            eng.stop_watch()
+            assert not eng.watching
+        finally:
+            eng.shutdown()
+
+
+class TestServingServerHotSwapUnderLoad:
+    def test_hot_swap_under_load_zero_failures_no_mixed_weights(
+            self, tmp_path):
+        """ISSUE 8 acceptance: concurrent /predict traffic across a
+        /reload weight swap yields zero failed requests, and every
+        response matches exactly the weights of the version it reports —
+        versions only move forward (no mixed-weights batch)."""
+        import urllib.error
+        from deeplearning4j_tpu.faulttolerance import CheckpointManager
+        from deeplearning4j_tpu.serving import ServingClient, ServingServer
+        mgr = CheckpointManager(tmp_path, background=False)
+        net_a, net_b = _small_net(1), _small_net(99)
+        mgr.save(net_a, step=1)
+        server = ServingServer(checkpoint_dir=str(tmp_path),
+                               max_batch_size=8, queue_limit=256).start()
+        x = np.ones((1, 4), np.float32)
+        expected = {1: np.asarray(net_a.output(x))[0],
+                    2: np.asarray(net_b.output(x))[0]}
+        records, failures = [], []
+
+        def client_loop():
+            client = ServingClient(f"http://127.0.0.1:{server.port}",
+                                   timeout=60)
+            mine = []
+            for _ in range(60):
+                try:
+                    out, version = client.predict_versioned(x)
+                    mine.append((int(version), out[0]))
+                except urllib.error.HTTPError as e:
+                    failures.append(e.code)
+            records.append(mine)
+
+        threads = [threading.Thread(target=client_loop) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            # let traffic establish on v1, then promote net_b mid-flight
+            threading.Event().wait(0.1)
+            mgr.save(net_b, step=2)
+            admin = ServingClient(f"http://127.0.0.1:{server.port}",
+                                  timeout=60)
+            res = admin.reload()
+            assert res["promoted"] is True and res["step"] == 2
+            for t in threads:
+                t.join(timeout=60)
+            assert failures == []                 # zero dropped requests
+            seen_versions = set()
+            for mine in records:
+                last_v = 0
+                for version, out in mine:
+                    seen_versions.add(version)
+                    # response matches EXACTLY the weights it claims
+                    np.testing.assert_allclose(out, expected[version],
+                                               rtol=1e-5, atol=1e-6)
+                    assert version >= last_v      # never serves backwards
+                    last_v = version
+            assert seen_versions == {1, 2}        # both models served
+            h = admin.get("/health")
+            assert h["ready"] is True and h["model_version"] == 2
+            assert h["serving_step"] == 2
+        finally:
+            server.stop()
+
+    def test_http_shed_maps_to_429_with_retry_after(self):
+        import urllib.error
+        from deeplearning4j_tpu.serving import ServingEngine, ServingServer, \
+            ServingClient
+        model = _BlockingModel()
+        eng = ServingEngine(model, max_batch_size=1, queue_limit=1,
+                            nano_wait=0.0)
+        server = ServingServer(engine=eng, warmup=False).start()
+        client = ServingClient(f"http://127.0.0.1:{server.port}", timeout=30)
+        row = np.zeros(4, np.float32).tolist()
+        results = []
+
+        def call():
+            try:
+                results.append(client_bg.post("/predict", {"data": row}))
+            except Exception as e:
+                results.append(e)
+
+        client_bg = ServingClient(f"http://127.0.0.1:{server.port}",
+                                  timeout=30)
+        t1 = threading.Thread(target=call)
+        t2 = threading.Thread(target=call)
+        try:
+            t1.start()
+            t2.start()
+            # wait until one request occupies the dispatcher AND one fills
+            # the queue — only then is the next predict guaranteed to shed
+            # (a silent timeout here would turn the 429 probe into a
+            # 30s blocking predict on a slow host)
+            deadline = 500
+            while deadline and eng._queue.qsize() < 1:
+                threading.Event().wait(0.02)
+                deadline -= 1
+            assert eng._queue.qsize() >= 1   # queue_limit=1: next must shed
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.post("/predict", {"data": row})
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            h = client.get("/health")
+            assert h["ready"] is False
+            assert h["admission"]["saturated"] is True
+            model.gate.set()
+            t1.join(timeout=30)
+            t2.join(timeout=30)
+            assert client.get("/health")["ready"] is True
+        finally:
+            model.gate.set()
+            server.stop()
+
+
+class TestHttpPlumbing:
+    def test_json_client_reuses_persistent_connection(self, iris_net):
+        server = InferenceServer(iris_net).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}",
+                                     timeout=60)
+            client.get("/health")
+            conn1 = client._tls.conn
+            assert conn1 is not None          # pooled after first request
+            client.get("/health")
+            assert client._tls.conn is conn1  # keep-alive reuse, no redial
+        finally:
+            server.stop()
+
+    def test_bounded_server_sheds_past_concurrency_cap(self):
+        import urllib.error
+        from deeplearning4j_tpu.observability import MetricsRegistry
+        from deeplearning4j_tpu.utils.http import (BackgroundHttpServer,
+                                                   JsonHandler)
+        gate = threading.Event()
+        reg = MetricsRegistry()
+
+        class _SlowHandler(JsonHandler):
+            hold = None
+
+            def do_GET(self):
+                self.hold.wait(timeout=30)
+                return self._json({"ok": True})
+
+            def do_POST(self):
+                # deliberately never reads the body: the keep-alive
+                # drain in _json must consume it for the connection
+                return self._json({"pong": True})
+
+        server = BackgroundHttpServer(_SlowHandler, max_concurrent=1,
+                                      hold=gate, metrics_registry=reg).start()
+        url = f"http://127.0.0.1:{server.port}"
+        first = []
+
+        def slow_call():
+            from deeplearning4j_tpu.utils.http import JsonClient
+            first.append(JsonClient(url, timeout=30).get("/x"))
+
+        t = threading.Thread(target=slow_call)
+        try:
+            t.start()
+            # wait for the slow request to occupy the single slot
+            deadline = 100
+            while deadline:
+                g = reg.get("http_inflight_requests")
+                if g is not None and g.value >= 1:
+                    break
+                threading.Event().wait(0.02)
+                deadline -= 1
+            from deeplearning4j_tpu.utils.http import JsonClient
+            shed_client = JsonClient(url, timeout=30)
+            # a POST shed at the request cap: the unread body must be
+            # drained or the pooled keep-alive connection desyncs
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                shed_client.post("/p", {"data": list(range(100))})
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            conn_after_shed = shed_client._tls.conn
+            gate.set()
+            t.join(timeout=30)
+            assert first and first[0]["ok"] is True
+            # SAME pooled connection serves the retry cleanly (no
+            # leftover body bytes parsed as a request line), including a
+            # handler that never reads its body
+            assert shed_client.post("/p", {"data": [1]})["pong"] is True
+            assert shed_client._tls.conn is conn_after_shed
+            shed = reg.counter("http_shed_total", "", ("scope",))
+            assert shed.labels("request").value >= 1
+            # idle keep-alive connections hold no handling slot
+            assert reg.get("http_inflight_requests").value == 0
+        finally:
+            gate.set()
+            server.stop()
+
+
+def test_inference_server_promotes_from_checkpoint_dir(tmp_path):
+    """The legacy per-request server's /reload accepts a CheckpointManager
+    store directory and promotes its newest complete checkpoint."""
+    from deeplearning4j_tpu.faulttolerance import CheckpointManager
+    mgr = CheckpointManager(tmp_path, background=False)
+    net_a, net_b = _small_net(1), _small_net(99)
+    mgr.save(net_b, step=5)
+    server = InferenceServer(net_a, inference_mode="INPLACE").start()
+    try:
+        client = InferenceClient(f"http://127.0.0.1:{server.port}",
+                                 timeout=60)
+        x = np.ones((2, 4), np.float32)
+        client.post("/reload", {"path": str(tmp_path)})
+        np.testing.assert_allclose(client.predict(x),
+                                   np.asarray(net_b.output(x)), rtol=1e-5)
+    finally:
+        server.stop()
+
+
 def test_inference_server_hot_reload(tmp_path):
     """POST /reload swaps the served model from a checkpoint zip."""
     from deeplearning4j_tpu.serving.inference_server import (InferenceClient,

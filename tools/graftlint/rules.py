@@ -1,4 +1,4 @@
-"""graftlint rule implementations JX001–JX016.
+"""graftlint rule implementations JX001–JX017.
 
 Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
 registered in ``RULES``.  Rules share the jit-scope + taint machinery in
@@ -941,6 +941,73 @@ def jx016(info: ModuleInfo) -> List[Finding]:
             "dependency is hammered forever at full tilt; bound it with "
             "faulttolerance.RetryPolicy (budgeted seeded backoff) or an "
             "explicit deadline/attempt counter"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX017
+# scope: the request-path modules where an unbounded producer queue is a
+# memory blowup under load (serving front-ends, streaming brokers,
+# parallel dispatchers) — ETL/data modules size queues to their own
+# prefetch depth and stay out of scope
+_JX017_PATH_RE = re.compile(r"(^|[/\\])(serving|streaming|parallel)[/\\]")
+_JX017_QUEUE_CLASSES = frozenset(("Queue", "LifoQueue", "PriorityQueue",
+                                  "JoinableQueue"))
+_JX017_QUEUE_MODULES = frozenset(("queue", "multiprocessing", "mp"))
+
+
+@rule("JX017", "queue constructed without an explicit maxsize in a "
+               "serving/streaming/parallel module")
+def jx017(info: ModuleInfo) -> List[Finding]:
+    """Flag ``queue.Queue()`` / ``multiprocessing.Queue()`` (and
+    Lifo/Priority/Joinable variants) constructed with neither a
+    positional size nor a ``maxsize=`` keyword, in modules under
+    ``serving/``, ``streaming/``, or ``parallel/``.  Those modules sit on
+    the request path: an unbounded queue there lets any
+    producer-faster-than-consumer imbalance (slow device, dead consumer,
+    request flood) grow host memory without limit until the process
+    OOMs — the failure surfaces far from the queue that caused it.
+    Bound the queue and shed/block at the bound (what admission control
+    exists for).  An explicit ``maxsize=0`` stays legal — it spells the
+    same unboundedness, but *deliberately*."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX017_PATH_RE.search(path):
+        return out
+    # alias map for `import queue as q` / `import multiprocessing as mp`
+    # plus names bound by `from queue import Queue [as Q]`
+    mod_aliases = set(_JX017_QUEUE_MODULES)
+    bare_names = set()
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("queue", "multiprocessing"):
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("queue", "multiprocessing"):
+                for a in node.names:
+                    if a.name in _JX017_QUEUE_CLASSES:
+                        bare_names.add(a.asname or a.name)
+    for node in ast.walk(info.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        is_queue_ctor = (
+            (len(parts) == 2 and parts[0] in mod_aliases
+             and parts[1] in _JX017_QUEUE_CLASSES)
+            or (len(parts) == 1 and parts[0] in bare_names))
+        if not is_queue_ctor:
+            continue
+        if node.args or any(kw.arg == "maxsize" for kw in node.keywords):
+            continue
+        out.append(_finding(
+            info, node, "JX017",
+            f"`{fname}()` without an explicit maxsize in a "
+            "serving/streaming/parallel module: an unbounded producer "
+            "queue turns any producer/consumer imbalance into unbounded "
+            "host-memory growth under load — pass maxsize and shed or "
+            "block at the bound (maxsize=0 spells deliberate "
+            "unboundedness)"))
     return _dedupe(out)
 
 
