@@ -54,6 +54,12 @@ closed-loop clients at concurrency {1, 16, 64}, continuous-batching
 engine vs the per-request baseline, with the engine's post-warmup
 recompile count — must stay 0) so serving-throughput regressions are
 driver-visible; DL4J_TPU_BENCH_SERVE=0 suppresses it.
+
+An eighth JSON line records the linter wall-time benchmark
+(``lint_time_ms``: one full-package graftlint run — 17 module rules off
+a shared per-file parse plus the whole-program concurrency pass
+JX018-JX021) so rule additions can't silently blow up developer-loop
+latency; DL4J_TPU_BENCH_LINT=0 suppresses it.
 """
 import json
 import os
@@ -251,6 +257,19 @@ def main():
                               "unit": "ms p50",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # lint wall-time row (ISSUE 9): full-package graftlint — 17 module
+    # rules + the whole-program concurrency pass — so a rule addition
+    # that blows up the developer-loop latency is driver-visible; an
+    # eighth JSON line, opt-out DL4J_TPU_BENCH_LINT=0
+    if os.environ.get("DL4J_TPU_BENCH_LINT", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import lint_time_ms
+            print(json.dumps(lint_time_ms()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "lint_time_ms", "value": None,
+                              "unit": "ms full-package graftlint",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -353,6 +372,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # serving engine (ISSUE 8): continuous batching vs per-request,
         # closed-loop clients at c in {1,16,64}, zero-recompile-verified
         B.serve_latency_ms,
+        # lint wall time (ISSUE 9): full-package graftlint incl. the
+        # whole-program concurrency pass — developer-loop latency
+        B.lint_time_ms,
     ]
     side = []
     for fn in captures:

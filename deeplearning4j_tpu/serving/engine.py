@@ -60,7 +60,8 @@ from ..observability import clock
 from ..observability.quantiles import LatencyWindow
 from ..observability.registry import default_registry
 from ..parallel.inference import InvalidInputError
-from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
+from ..utils.http import (BackgroundHttpServer, JsonClient, JsonHandler,
+                          PredictCircuitMixin)
 
 __all__ = ["ServingEngine", "ServingServer", "ServingClient",
            "AdmissionController", "SLOConfig", "ShedError"]
@@ -285,8 +286,11 @@ class ServingEngine:
         self._slot_lock = threading.Lock()
         self._version = 0
         self._warm = False
-        self.steady_recompiles = 0       # traces seen AFTER warmup: keep 0
-        self.batches_dispatched = 0
+        # dispatch counters are written by the dispatcher thread and read
+        # by callers (stats/bench): one lock keeps increments lossless
+        self._stats_lock = threading.Lock()
+        self._steady_recompiles = 0      # traces seen AFTER warmup: keep 0
+        self._batches_dispatched = 0
         self._shutdown = threading.Event()
         self._submit_lock = threading.Lock()
         self._watch_stop: Optional[threading.Event] = None
@@ -306,10 +310,21 @@ class ServingEngine:
         return self._registry if self._registry is not None \
             else default_registry()
 
+    @property
+    def steady_recompiles(self) -> int:
+        with self._stats_lock:
+            return self._steady_recompiles
+
+    @property
+    def batches_dispatched(self) -> int:
+        with self._stats_lock:
+            return self._batches_dispatched
+
     def _note_batch(self, real: int, bucket: int, traced: bool) -> None:
-        self.batches_dispatched += 1
-        if traced and self._warm:
-            self.steady_recompiles += 1
+        with self._stats_lock:
+            self._batches_dispatched += 1
+            if traced and self._warm:
+                self._steady_recompiles += 1
         reg = self._reg()
         if not reg.enabled:
             return
@@ -670,10 +685,9 @@ class _EngineHandler(JsonHandler):
         except InvalidInputError as e:
             return self._json({"error": str(e)}, 400)
         except Exception as e:    # model-side failure: server error
-            srv.consecutive_failures += 1
+            srv.note_predict_result(False)
             return self._json({"error": str(e)}, 500)
-        srv.consecutive_failures = 0
-        srv.last_predict_mono = clock.monotonic_s()
+        srv.note_predict_result(True)
         reg = self._registry()
         if reg.enabled:
             # len(versions) is exactly the number of examples served
@@ -718,7 +732,7 @@ class _EngineHandler(JsonHandler):
             return self._json({"error": str(e)}, 400)
 
 
-class ServingServer:
+class ServingServer(PredictCircuitMixin):
     """HTTP front-end over a :class:`ServingEngine`.
 
     Endpoints::
@@ -755,8 +769,7 @@ class ServingServer:
             self.engine.watch(interval_s=watch_interval_s)
         from ..utils.profiling import device_platform
         self.platform = device_platform()
-        self.consecutive_failures = 0
-        self.last_predict_mono: Optional[float] = None
+        self._init_predict_circuit()
         self._server = BackgroundHttpServer(
             _EngineHandler, port, max_concurrent=max_concurrent,
             server_ref=self, metrics_registry=self.registry)

@@ -19,7 +19,8 @@ from ..observability import clock
 from ..observability.registry import default_registry
 from ..parallel.inference import (InferenceMode, InvalidInputError,
                                   ParallelInference)
-from ..utils.http import BackgroundHttpServer, JsonClient, JsonHandler
+from ..utils.http import (BackgroundHttpServer, JsonClient, JsonHandler,
+                          PredictCircuitMixin)
 
 __all__ = ["InferenceServer", "InferenceClient"]
 
@@ -55,10 +56,9 @@ class _PredictHandler(JsonHandler):
         except InvalidInputError as e:  # up-front shape rejection only
             return self._json({"error": str(e)}, 400)
         except Exception as e:  # model-side failures are server errors
-            srv.consecutive_failures += 1
+            srv.note_predict_result(False)
             return self._json({"error": str(e)}, 500)
-        srv.consecutive_failures = 0
-        srv.last_predict_mono = clock.monotonic_s()
+        srv.note_predict_result(True)
         reg = self._registry()
         if reg.enabled:
             reg.counter("inference_examples_total",
@@ -76,7 +76,7 @@ def _model_identity(model, origin: str = "init") -> str:
         return f"{name}[from={origin}]"
 
 
-class InferenceServer:
+class InferenceServer(PredictCircuitMixin):
     # consecutive model-side (5xx) predict failures before /health flips
     # to unready — the circuit-breaker signal an orchestrator gates on
     FAILURE_THRESHOLD = 3
@@ -93,8 +93,7 @@ class InferenceServer:
             else default_registry()
         self.platform = device_platform()
         self.model_id = _model_identity(model)
-        self.last_predict_mono: Optional[float] = None
-        self.consecutive_failures = 0
+        self._init_predict_circuit()
         self._server = BackgroundHttpServer(_PredictHandler, port,
                                             server_ref=self,
                                             metrics_registry=self.registry)

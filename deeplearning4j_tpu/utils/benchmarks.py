@@ -876,3 +876,48 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
             "elastic_ms": None if elastic_ms is None
             else round(elastic_ms, 2),
             "workers": 2, "retry_backoff_s": 0.02}
+
+
+def lint_time_ms(paths=None, runs: int = 2) -> Dict:
+    """graftlint wall-time benchmark (ISSUE 9): one full-package run
+    through the public ``lint_paths`` API — 17 module rules off the
+    shared per-file parse plus the whole-program concurrency pass
+    (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
+    rule addition that blows up its wall time is a latency regression
+    exactly like a slow train step; this row makes it round-over-round
+    visible.  ``value`` is the MEDIAN of ``runs`` runs (process-cache
+    effects make the first run the slowest)."""
+    import sys
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # import under a TEMPORARY path entry: leaving the repo root on
+    # sys.path would let its top-level packages (tools, tests, bench)
+    # shadow a host application's same-named modules forever after
+    added = repo_root not in sys.path
+    if added:
+        sys.path.insert(0, repo_root)
+    try:
+        from tools.graftlint import PROGRAM_RULES, RULES, \
+            iter_python_files, lint_paths
+    finally:
+        if added:
+            sys.path.remove(repo_root)
+    if paths is None:
+        paths = [os.path.join(repo_root, "deeplearning4j_tpu")]
+    n_files = len(list(iter_python_files(paths)))
+    times = []
+    findings = []
+    for _ in range(max(1, runs)):
+        t0 = monotonic_s()
+        findings = lint_paths(paths)
+        times.append((monotonic_s() - t0) * 1e3)
+    return {
+        "metric": "lint_time_ms",
+        "value": round(float(np.median(times)), 1),
+        "unit": "ms full-package graftlint",
+        "files": n_files,
+        "rules": len(RULES) + len(PROGRAM_RULES),
+        "findings": len(findings),
+        "runs": len(times),
+        "spread_ms": round(max(times) - min(times), 1),
+    }

@@ -36,8 +36,32 @@ from ..observability import clock
 from ..observability.exposition import CONTENT_TYPE, render_text
 from ..observability.registry import default_registry
 
-__all__ = ["JsonHandler", "MetricsEndpointMixin", "BackgroundHttpServer",
-           "JsonClient"]
+__all__ = ["JsonHandler", "MetricsEndpointMixin", "PredictCircuitMixin",
+           "BackgroundHttpServer", "JsonClient"]
+
+
+class PredictCircuitMixin:
+    """Consecutive-failure readiness circuit shared by the serving
+    front-ends: a streak of model-side predict failures flips /health
+    unready until one success.  ONE implementation — the two servers
+    must never diverge on circuit semantics.  Handler threads report
+    outcomes concurrently, so the lock keeps failure streaks lossless
+    (N racing ``+=`` must reach the circuit threshold, not lose
+    increments)."""
+
+    def _init_predict_circuit(self) -> None:
+        self.consecutive_failures = 0
+        self.last_predict_mono: Optional[float] = None
+        self._health_lock = threading.Lock()
+
+    def note_predict_result(self, ok: bool) -> None:
+        """Record one predict outcome from a handler thread."""
+        with self._health_lock:
+            if ok:
+                self.consecutive_failures = 0
+                self.last_predict_mono = clock.monotonic_s()
+            else:
+                self.consecutive_failures += 1
 
 # request-latency buckets: local serving sits in the 1-100 ms band;
 # keep a long tail for model (re)compiles hit by a first request
@@ -324,7 +348,17 @@ class BackgroundHttpServer:
         return self
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        # BaseServer.shutdown() blocks on an event that only
+        # serve_forever() sets on exit — calling it on a never-started
+        # server would hang forever, so it only runs when the serve
+        # thread exists.  Joining it stops new ACCEPTS; per-connection
+        # handler threads are daemon and untracked, so a request already
+        # executing may still be mid-flight after stop() returns —
+        # teardown that mutates handler-visible state must tolerate that
+        if self._thread is not None:
+            self.httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
         self.httpd.server_close()
 
 

@@ -107,3 +107,22 @@ def test_step_time_ms_rows():
     # step cost >> compile cost: the very first small step compiles its
     # own bucket, so adaptation needs at most one probe chunk
     assert 0 < row["adapt_steps"] <= 25
+
+
+def test_lint_time_ms_row():
+    """The lint wall-time bench line (ISSUE 9): row shape + a sane
+    measurement over a small path subset (the full-package budget is
+    asserted in test_lint.py; here the row contract is what's tested)."""
+    from pathlib import Path
+
+    from deeplearning4j_tpu.utils import benchmarks as B
+    subset = str(Path(__file__).resolve().parents[1]
+                 / "deeplearning4j_tpu" / "serving")
+    row = B.lint_time_ms(paths=[subset], runs=1)
+    assert row["metric"] == "lint_time_ms"
+    assert row["unit"].startswith("ms")
+    assert row["value"] > 0
+    assert row["files"] >= 3          # serving/ has engine + 2 servers
+    assert row["rules"] == 21
+    assert row["findings"] == 0       # the swept package stays clean
+    assert row["runs"] == 1

@@ -1,14 +1,19 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX017 has at least one fixture that MUST fire and one
-that MUST stay silent; the gate test makes every future PR re-lint the
-whole package without separate CI wiring.
+Every rule JX001–JX021 has at least one fixture that MUST fire and one
+that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
+additionally unit-tests its thread-entry / guarded-by / lock-order
+inference layers.  The gate test makes every future PR re-lint the whole
+package without separate CI wiring, and the wall-time budget test keeps
+the full run inside the developer loop.
 """
 import json
+import os
 import subprocess
 import sys
 import textwrap
+import time
 from pathlib import Path
 
 import pytest
@@ -17,8 +22,8 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
-from tools.graftlint import (Baseline, RULE_DOCS, RULES,  # noqa: E402
-                             lint_paths, lint_source)
+from tools.graftlint import (Baseline, PROGRAM_RULES,  # noqa: E402
+                             RULE_DOCS, RULES, lint_paths, lint_source)
 
 PKG = REPO_ROOT / "deeplearning4j_tpu"
 BASELINE = REPO_ROOT / "tools" / "graftlint" / "baseline.json"
@@ -900,6 +905,719 @@ def test_jx017_pragma_suppresses():
     """, _SERVING_PATH)
 
 
+# ---------------------------------------------------------------- JX018
+def test_jx018_positive_unguarded_increment_from_thread():
+    got = findings("""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self.batches = 0
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self._note()
+
+            def _note(self):
+                self.batches += 1        # dispatcher thread, no lock
+
+            def stats(self):
+                return self.batches      # caller thread
+    """, select=["JX018"])
+    assert len(got) == 1 and got[0].rule == "JX018"
+
+
+def test_jx018_positive_inconsistent_guarding():
+    # guarded write in one method, bare write in another: the discipline
+    # exists and this mutation skips it
+    assert "JX018" in rules_of("""
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.version = 0
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.version += 1
+
+            def reset(self):
+                self.version = 0         # skips the lock others hold
+    """)
+
+
+def test_jx018_negative_consistent_guard_and_no_threads():
+    assert "JX018" not in rules_of("""
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+
+        class SingleThreaded:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1              # no thread entry: legal
+    """)
+
+
+def test_jx018_negative_aliased_import_and_injected_lock():
+    # lock recognition must resolve `import threading as th` exactly like
+    # spawn detection does, and an injected lock (ctor parameter) is a
+    # lock because it is USED as one — neither may fire on guarded code
+    assert "JX018" not in rules_of("""
+        import threading as th
+
+        class AliasGuarded:
+            def __init__(self):
+                self._lock = th.Lock()
+                self.n = 0
+                self._t = None
+
+            def start(self):
+                self._t = th.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+
+        class InjectedLock:
+            def __init__(self, lock):
+                self._lock = lock
+                self.n = 0
+                self._t = None
+
+            def start(self):
+                import threading
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.n += 1
+
+            def read(self):
+                with self._lock:
+                    return self.n
+    """)
+
+
+def test_jx020_positive_under_aliased_import():
+    # the lock-order graph must see th.Lock() attrs or aliased modules
+    # silently disable deadlock detection
+    assert "JX020" in rules_of("""
+        import threading as th
+
+        class AB:
+            def __init__(self):
+                self.a = th.Lock()
+                self.b = th.Lock()
+
+            def fwd(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def bwd(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+
+
+def test_jx018_negative_thread_private_and_safe_attrs():
+    assert "JX018" not in rules_of("""
+        import queue
+        import threading
+
+        class Private:
+            def __init__(self):
+                self.progress = 0
+                self.results = queue.Queue(8)   # thread-safe primitive
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                self.progress += 1       # only the thread touches it
+                self.results.put(1)
+    """)
+
+
+def test_jx018_positive_handler_shared_server_counter():
+    # handler classes run one instance per connection: `self` is private
+    # but the server ref is shared across concurrent request threads
+    assert "JX018" in rules_of("""
+        class _H(JsonHandler):
+            server_ref = None
+
+            def do_POST(self):
+                srv = self.server_ref
+                srv.failures += 1
+    """)
+
+
+def test_jx018_negative_handler_local_receiver():
+    # a receiver built fresh in the handler is single-threaded
+    assert "JX018" not in rules_of("""
+        class _H(JsonHandler):
+            def do_POST(self):
+                r = Reader(self.rfile)
+                r.off += 4
+    """)
+
+
+def test_jx018_pragma_suppresses():
+    assert "JX018" not in rules_of("""
+        import threading
+
+        class E:
+            def __init__(self):
+                t = threading.Thread(target=self._loop, daemon=True)
+                t.start()
+
+            def _loop(self):
+                self.n += 1  # graftlint: disable=JX018  (monotonic, torn reads fine)
+
+            def read(self):
+                return self.n
+    """)
+
+
+# ---------------------------------------------------------------- JX019
+def test_jx019_positive_self_attr_thread_never_joined():
+    got = findings("""
+        import threading
+
+        class Pump:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                pass                     # no join anywhere
+    """, select=["JX019"])
+    assert len(got) == 1
+
+
+def test_jx019_positive_local_thread_and_chained_start():
+    got = findings("""
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(target=fn)
+            t.start()                    # local, never joined
+
+        def also_leaks(fn):
+            threading.Thread(target=fn).start()   # unbound handle
+    """, select=["JX019"])
+    assert len(got) == 2
+
+
+def test_jx019_positive_timer_without_cancel():
+    assert "JX019" in rules_of("""
+        import threading
+
+        class Delayed:
+            def arm(self):
+                self._timer = threading.Timer(5.0, self._fire)
+                self._timer.start()
+
+            def _fire(self):
+                pass
+    """)
+
+
+def test_jx019_negative_daemon_joined_escaping_and_submit():
+    assert "JX019" not in rules_of("""
+        import threading
+
+        class Clean:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                self._w = threading.Thread(target=self._run)
+                self._w.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._w.join()
+
+        def handed_to_caller(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t                     # caller's to join
+
+        def pooled(pool, fn):
+            pool.submit(fn)              # executor owns the lifecycle
+    """)
+
+
+def test_jx019_negative_computed_daemon_flag_is_unresolvable():
+    # daemon=<expr> can't be resolved statically: the fact drops on the
+    # quiet side (possibly-daemon), never a loud false positive
+    assert "JX019" not in rules_of("""
+        import threading
+
+        class Configurable:
+            def __init__(self, cfg):
+                self._cfg = cfg
+
+            def start(self, flag):
+                self._t = threading.Thread(target=self._run,
+                                           daemon=flag)
+                self._t.start()
+                self._u = threading.Thread(target=self._run)
+                self._u.daemon = self._cfg.daemonize
+                self._u.start()
+
+            def _run(self):
+                pass
+    """)
+
+
+def test_jx019_negative_double_buffer_alias_join():
+    # the CheckpointManager idiom: the handle swaps through a local
+    # before joining — still a join on the teardown path
+    assert "JX019" not in rules_of("""
+        import threading
+
+        class Writer:
+            def save(self):
+                t = threading.Thread(target=self._write)
+                self._worker = t
+                t.start()
+
+            def _write(self):
+                pass
+
+            def wait(self):
+                t, self._worker = self._worker, None
+                if t is not None:
+                    t.join()
+    """)
+
+
+def test_jx019_pragma_suppresses():
+    assert "JX019" not in rules_of("""
+        import threading
+
+        def spin(fn):
+            t = threading.Thread(target=fn)  # graftlint: disable=JX019  (process-lifetime pump)
+            t.start()
+    """)
+
+
+# ---------------------------------------------------------------- JX020
+def test_jx020_positive_opposite_nesting_same_class():
+    got = findings("""
+        import threading
+
+        class Transfer:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def debit(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def credit(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """, select=["JX020"])
+    assert len(got) == 1
+    assert "cycle" in got[0].message
+
+
+def test_jx020_positive_cross_class_one_hop_call():
+    # A holds its lock while calling into B (which takes B's lock); B
+    # holds its lock while calling back into A — opposite orders across
+    # two classes, resolved through constructor-typed attributes
+    assert "JX020" in rules_of("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+                self._b = B()
+
+            def fwd(self):
+                with self._la:
+                    self._b.take_b()
+
+            def take_a(self):
+                with self._la:
+                    pass
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+                self._a = A()
+
+            def take_b(self):
+                with self._lb:
+                    pass
+
+            def back(self):
+                with self._lb:
+                    self._a.take_a()
+    """)
+
+
+def test_jx020_negative_consistent_order_and_single_lock():
+    assert "JX020" not in rules_of("""
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def three(self):
+                with self._b:
+                    pass                 # alone: no edge back
+    """)
+
+
+# ---------------------------------------------------------------- JX021
+def test_jx021_positive_membership_outside_guard():
+    got = findings("""
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._d[k] = v
+
+            def get(self, k):
+                if k in self._d:         # unguarded check...
+                    return self._d[k]    # ...then act
+                return None
+    """, select=["JX021"])
+    assert len(got) == 1
+
+
+def test_jx021_negative_pair_under_guard_or_no_discipline():
+    assert "JX021" not in rules_of("""
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._d[k] = v
+
+            def get(self, k):
+                with self._lock:
+                    if k in self._d:
+                        return self._d[k]
+                return None
+
+        class NoLocks:
+            def __init__(self):
+                self._d = {}
+
+            def get(self, k):
+                if k in self._d:         # no inferred guard: no
+                    return self._d[k]    # discipline to violate
+                return None
+    """)
+
+
+def test_jx021_positive_qsize_gated_get():
+    assert "JX021" in rules_of("""
+        import queue
+        import threading
+
+        class Drain:
+            def __init__(self):
+                self._q = queue.Queue(8)
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                pass
+
+            def take(self):
+                if not self._q.empty():
+                    return self._q.get()   # sibling consumer can win
+                return None
+    """)
+
+
+def test_jx021_negative_get_nowait_drain():
+    assert "JX021" not in rules_of("""
+        import queue
+        import threading
+
+        class Drain:
+            def __init__(self):
+                self._q = queue.Queue(8)
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                pass
+
+            def take(self):
+                try:
+                    return self._q.get_nowait()
+                except queue.Empty:
+                    return None
+    """)
+
+
+def test_jx021_pragma_suppresses():
+    assert "JX021" not in rules_of("""
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._d[k] = v
+
+            def get(self, k):
+                if k in self._d:  # graftlint: disable=JX021  (single-threaded reader)
+                    return self._d[k]
+                return None
+    """)
+
+
+# ------------------------------------ whole-program analysis layer units
+def _program_of(src: str, path: str = "mod.py"):
+    from tools.graftlint.analysis import analyze_module
+    from tools.graftlint.program import build_program
+    return build_program([analyze_module(textwrap.dedent(src), path)])
+
+
+def _entries(prog, cls_name: str):
+    cls = next(c for c in prog.classes if c.name == cls_name)
+    return {getattr(f, "name", "<lambda>") for f in cls.entry_funcs}
+
+
+def test_thread_entry_direct_target_and_closure():
+    prog = _program_of("""
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self._helper()
+
+            def _helper(self):
+                pass
+
+            def untouched(self):
+                pass
+    """)
+    assert _entries(prog, "W") == {"_loop", "_helper"}
+
+
+def test_thread_entry_bound_method_one_hop_wrapper_and_submit():
+    prog = _program_of("""
+        import threading
+
+        class W:
+            def a(self):
+                fn = self._loop_a        # one-hop alias
+                threading.Thread(target=fn).start()
+
+            def b(self, pool):
+                pool.submit(self._loop_b)
+
+            def c(self):
+                def runner():
+                    self._loop_c()
+                t = threading.Timer(1.0, runner)
+                t.start()
+                t.cancel()
+
+            def _loop_a(self):
+                pass
+
+            def _loop_b(self):
+                pass
+
+            def _loop_c(self):
+                pass
+    """)
+    got = _entries(prog, "W")
+    assert {"_loop_a", "_loop_b", "_loop_c", "runner"} <= got
+
+
+def test_thread_entry_cross_class_constructor_typed():
+    prog = _program_of("""
+        import threading
+
+        class Worker:
+            def run(self):
+                self.steps = 1
+
+            def idle(self):
+                pass
+
+        def launch():
+            w = Worker()
+            threading.Thread(target=w.run).start()
+    """)
+    assert _entries(prog, "Worker") == {"run"}
+
+
+def test_guarded_by_with_scope_and_try_finally():
+    prog = _program_of("""
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.m = 0
+
+            def with_scope(self):
+                with self._lock:
+                    self.n += 1
+
+            def try_finally(self):
+                self._lock.acquire()
+                try:
+                    self.m += 1
+                finally:
+                    self._lock.release()
+
+            def after_release(self):
+                self._lock.acquire()
+                self._lock.release()
+                self.m += 1              # NOT guarded here
+    """)
+    cls = prog.classes[0]
+    assert cls.guards("n") == {"_lock"}
+    assert cls.guards("m") == {"_lock"}
+    unguarded_m = [a for a in cls.accesses
+                   if a.attr == "m" and a.write and not a.held
+                   and not a.in_init]
+    assert len(unguarded_m) == 1         # only the after-release write
+
+
+def test_guarded_by_property_aliased_lock():
+    prog = _program_of("""
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            @property
+            def lock(self):
+                return self._lock
+
+            def bump(self):
+                with self.lock:          # alias guards the same token
+                    self.n += 1
+    """)
+    assert prog.classes[0].guards("n") == {"_lock"}
+
+
+def test_lock_order_graph_edges_and_cycle_detection():
+    from tools.graftlint.program import find_lock_cycles
+    prog = _program_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    edges = prog.lock_edges()
+    labels = {(a.label(), b.label()) for a, b, _, _ in edges}
+    assert ("T._a", "T._b") in labels and ("T._b", "T._a") in labels
+    cycles = find_lock_cycles(edges)
+    assert len(cycles) == 1
+    assert {n.label() for n in cycles[0][0]} == {"T._a", "T._b"}
+
+
+def test_lock_order_no_cycle_negative():
+    from tools.graftlint.program import find_lock_cycles
+    prog = _program_of("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def chain(self):
+                with self._a:
+                    with self._b:
+                        with self._c:
+                            pass
+    """)
+    assert find_lock_cycles(prog.lock_edges()) == []
+
+
 # ------------------------------------------------------------- pragmas
 def test_pragma_same_line_suppresses():
     assert "JX007" not in rules_of("""
@@ -986,6 +1704,130 @@ def test_baseline_round_trips_through_json(tmp_path):
     assert Baseline.load(str(tmp_path / "missing.json")).allowances == {}
 
 
+def test_baseline_reports_stale_entries(tmp_path):
+    """Ratchet: allowances matching no finding come back as stale so the
+    suppression can't outlive its bug and silently absorb a new one."""
+    src = textwrap.dedent("""
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    f = tmp_path / "m.py"
+    f.write_text(src)
+    found = lint_paths([str(f)])
+    import os
+    key = f"{os.path.relpath(found[0].path)}::JX007".replace(os.sep, "/")
+    live = Baseline({key: 1, "gone/file.py::JX003": 2})
+    kept, stale = live.apply(found)
+    assert kept == []
+    assert stale == ["gone/file.py::JX003"]
+    # an entry matching SOME findings is live even when over-budgeted
+    over = Baseline({key: 5})
+    kept, stale = over.apply(found)
+    assert kept == [] and stale == []
+
+
+def test_cli_stale_baseline_errors(tmp_path):
+    # run FROM tmp_path so the fabricated key's path resolves against
+    # the cwd, the way real repo-root runs resolve repo-relative keys
+    clean = tmp_path / "ok.py"
+    clean.write_text("def f(a, xs=None):\n    return a\n")
+    bl = tmp_path / "baseline.json"
+    Baseline({"gone/file.py::JX008": 1}).save(str(bl))
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT))
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "--baseline", str(bl), "ok.py"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert r.returncode == 2
+    assert "stale baseline" in r.stderr
+    assert "gone/file.py::JX008" in r.stderr
+
+
+def test_cli_stale_ratchet_stands_down_outside_baseline_cwd(tmp_path):
+    """Baseline keys are relative to the cwd they were written from; a
+    run from a DIFFERENT directory cannot resolve them, so live
+    allowances must not be escalated into exit-2 'stale' errors."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    f = proj / "m.py"
+    f.write_text("def f(a, xs=[]):\n    return a\n")   # JX008 finding
+    bl = proj / "baseline.json"
+    # the live m.py key proves the cwd mismatch, which must also shield
+    # the deleted-file key from being misjudged through the wrong cwd
+    Baseline({"m.py::JX008": 1, "gone.py::JX019": 1}).save(str(bl))
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT))
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "--baseline", str(bl), str(proj)],
+        capture_output=True, text=True, cwd=str(elsewhere), env=env)
+    # the allowance can't absorb its finding from this cwd (findings
+    # report, exit 1) — but it is live, not stale: no exit-2 escalation
+    assert r.returncode == 1, r.stderr
+    assert "stale" not in r.stderr
+
+
+def test_cli_stale_ratchet_resolves_unlinted_keys_at_baseline_root(
+        tmp_path):
+    """An allowance for a file OUTSIDE the linted subset must be judged
+    against the baseline's own root: from a parent-dir cwd a live file
+    used to read as deleted (bogus exit 2), while a genuinely deleted
+    file must still ratchet from any cwd."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "m.py").write_text("x = 1\n")
+    (proj / "other.py").write_text("def f(a, xs=[]):\n    return a\n")
+    bl = proj / "baseline.json"
+    Baseline({"other.py::JX008": 1, "gone.py::JX019": 1}).save(str(bl))
+    env = dict(os.environ, PYTHONPATH=str(REPO_ROOT))
+    # lint ONLY m.py, from the parent directory
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "--baseline", str(bl), "proj/m.py"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "gone.py::JX019" in r.stderr      # deleted: ratchets anywhere
+    assert "other.py::JX008" not in r.stderr  # live: never misread
+
+
+def test_cli_stale_ratchet_skipped_on_rule_subsets(tmp_path):
+    """--select/--ignore runs never execute the rules some allowances
+    target, so they must not classify those allowances as stale."""
+    f = tmp_path / "m.py"
+    f.write_text("def f(a, xs=[]):\n    return a\n")   # JX008 finding
+    bl = tmp_path / "baseline.json"
+    Baseline.from_findings(lint_paths([str(f)])).save(str(bl))
+    # full run: allowance matches, clean exit
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "--baseline", str(bl), str(f)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+    assert r.returncode == 0, r.stderr
+    # subset run that never executes JX008: the allowance matches
+    # nothing, but must NOT be reported stale
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--select", "JX007",
+         "--baseline", str(bl), str(f)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+    assert r.returncode == 0, r.stderr
+    assert "stale" not in r.stderr
+
+
+def test_cli_write_baseline_rejects_changed_only(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--write-baseline",
+         "--changed-only", "HEAD", str(f)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+    assert r.returncode == 2
+    assert "full run" in r.stderr
+
+
 # ------------------------------------------------------------------ CLI
 def test_cli_text_and_json_and_exit_codes(tmp_path):
     bad = tmp_path / "bad.py"
@@ -1016,17 +1858,143 @@ def test_syntax_error_reported_not_crashed():
     assert [f.rule for f in got] == ["JX000"]
 
 
+def test_cli_sarif_output(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(a, xs=[]):\n    return a\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--format", "sarif", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert [rule["id"] for rule in run["tool"]["driver"]["rules"]] == ["JX008"]
+    res = run["results"][0]
+    assert res["ruleId"] == "JX008"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 1
+    # clean run: valid SARIF with zero results, exit 0
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--format", "sarif", str(good)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT))
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["runs"][0]["results"] == []
+
+
+def test_cli_changed_only_lints_only_changed_files(tmp_path):
+    """CI fast path: --changed-only <ref> restricts linting to files
+    changed vs the ref (plus untracked), so a PR touching one module
+    doesn't re-lint the world on every push."""
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "PATH": subprocess.os.environ["PATH"],
+           "HOME": str(tmp_path),
+           # the CLI resolves git against the LINTED tree (the tmp
+           # repo), so the linter package must come in via PYTHONPATH
+           "PYTHONPATH": str(REPO_ROOT)}
+
+    def git(*args):
+        r = subprocess.run(["git", *args], cwd=str(tmp_path), env=env,
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return r
+
+    git("init", "-q")
+    (tmp_path / "stable.py").write_text("def f(a, xs=[]):\n    return a\n")
+    (tmp_path / "touched.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # change one committed file, add one untracked file — both with
+    # findings; the stable (committed, unchanged) file also has one
+    (tmp_path / "touched.py").write_text("def g(b, m={}):\n    return b\n")
+    (tmp_path / "fresh.py").write_text("def h(c, s=set()):\n    return c\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--changed-only", "HEAD", "--format", "json",
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    data = json.loads(r.stdout)
+    hit_files = {Path(d["path"]).name for d in data}
+    assert hit_files == {"touched.py", "fresh.py"}
+    assert all(d["rule"] == "JX008" for d in data)
+    # from a SUBDIRECTORY the same set must be found: ls-files scopes to
+    # its cwd, so the CLI roots both git commands at the repo toplevel
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--changed-only", "HEAD", "--format", "json", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(sub), env=env)
+    data = json.loads(r.stdout)
+    assert {Path(d["path"]).name for d in data} == {"touched.py",
+                                                    "fresh.py"}
+    # from inside a DIFFERENT git repo: git must be anchored at the
+    # linted tree, not the cwd — resolving the cwd's repo used to diff
+    # the wrong repo, intersect nothing, and report a false "clean"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--changed-only", "HEAD", "--format", "json", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), env=env)
+    data = json.loads(r.stdout)
+    assert {Path(d["path"]).name for d in data} == {"touched.py",
+                                                    "fresh.py"}
+    # with nothing changed, the run is clean without linting anything
+    git("add", "-A")
+    git("commit", "-qm", "all in")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--no-baseline",
+         "--changed-only", "HEAD", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env)
+    assert r.returncode == 0
+    assert "no changed" in r.stdout
+
+
 # ------------------------------------------------------------- the gate
 def test_every_rule_has_docs():
-    assert set(RULES) == set(RULE_DOCS)
+    assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
+    assert not set(RULES) & set(PROGRAM_RULES)
     assert len(RULES) == 17
+    assert len(PROGRAM_RULES) == 4
 
 
-def test_package_is_clean_modulo_baseline():
-    """THE tier-1 gate: every future PR re-lints the whole package."""
+@pytest.fixture(scope="module")
+def package_lint():
+    """ONE timed full-package run shared by the gate, ratchet, and
+    wall-time budget tests (the run itself is the expensive part)."""
+    t0 = time.perf_counter()
     found = lint_paths([str(PKG)])
-    kept = Baseline.load(str(BASELINE)).filter(found)
+    elapsed = time.perf_counter() - t0
+    return found, elapsed
+
+
+def test_package_is_clean_modulo_baseline(package_lint):
+    """THE tier-1 gate: every future PR re-lints the whole package."""
+    found, _ = package_lint
+    kept, _stale = Baseline.load(str(BASELINE)).apply(found)
     assert kept == [], "\n".join(f.format() for f in kept)
+
+
+def test_package_baseline_has_no_stale_entries(package_lint):
+    """The ratchet: a baseline entry matching no finding means the
+    suppressed bug was fixed — the allowance must be deleted."""
+    found, _ = package_lint
+    _, stale = Baseline.load(str(BASELINE)).apply(found)
+    assert stale == [], stale
+
+
+def test_full_package_lint_within_time_budget(package_lint):
+    """The linter is part of the developer loop (tier-1 + bench): a rule
+    addition that blows up wall time is a regression.  The budget is ~6x
+    the current measured full-package time, so it trips on complexity
+    blowups (quadratic walks), not CI jitter."""
+    _, elapsed = package_lint
+    assert elapsed < 25.0, f"full-package graftlint took {elapsed:.1f}s"
 
 
 def test_baseline_is_near_empty():

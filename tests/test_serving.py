@@ -475,10 +475,25 @@ class TestServingServerHotSwapUnderLoad:
         results = []
 
         def call():
-            try:
-                results.append(client_bg.post("/predict", {"data": row}))
-            except Exception as e:
-                results.append(e)
+            # a background caller can itself get shed: its admission
+            # check races the dispatcher's dequeue of the other request
+            # (queue_limit=1).  Retry transient 429s until admitted so
+            # the steady saturated state (1 executing + 1 queued) is
+            # actually reached — only the MAIN probe below asserts shed.
+            for _ in range(500):
+                try:
+                    results.append(client_bg.post("/predict",
+                                                  {"data": row}))
+                    return
+                except urllib.error.HTTPError as e:
+                    if e.code != 429:
+                        results.append(e)
+                        return
+                    threading.Event().wait(0.02)
+                except Exception as e:
+                    results.append(e)
+                    return
+            results.append(RuntimeError("never admitted past the shed"))
 
         client_bg = ServingClient(f"http://127.0.0.1:{server.port}",
                                   timeout=30)
@@ -645,3 +660,80 @@ def test_inference_server_hot_reload(tmp_path):
         np.testing.assert_allclose(client.predict(x), after, rtol=1e-5)
     finally:
         server.stop()
+
+
+class TestConcurrencyRegressions:
+    """Races surfaced by the graftlint whole-program concurrency pass
+    (JX018, PR 9): dispatch counters and the predict-failure circuit are
+    mutated from background/handler threads while other threads read
+    them — each increment must survive arbitrary interleavings."""
+
+    def test_engine_dispatch_counters_lossless_under_concurrency(self):
+        from deeplearning4j_tpu.serving import ServingEngine
+        eng = ServingEngine(max_batch_size=4, queue_limit=16)
+        try:
+            threads_n, per_thread = 8, 250
+
+            def hammer():
+                for _ in range(per_thread):
+                    eng._note_batch(1, 4, traced=False)
+
+            ts = [threading.Thread(target=hammer) for _ in range(threads_n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            # unguarded `+=` loses updates under this interleaving; the
+            # stats lock makes the count exact
+            assert eng.batches_dispatched == threads_n * per_thread
+            assert eng.steady_recompiles == 0
+        finally:
+            eng.shutdown()
+
+    def test_predict_failure_streak_counts_every_concurrent_failure(self):
+        from deeplearning4j_tpu.serving import ServingEngine
+        from deeplearning4j_tpu.serving.engine import ServingServer
+        eng = ServingEngine(max_batch_size=4, queue_limit=16)
+        srv = ServingServer(engine=eng, warmup=False)
+        try:
+            threads_n, per_thread = 8, 250
+
+            def fail_hammer():
+                for _ in range(per_thread):
+                    srv.note_predict_result(False)
+
+            ts = [threading.Thread(target=fail_hammer)
+                  for _ in range(threads_n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert srv.consecutive_failures == threads_n * per_thread
+            # one success resets the streak and stamps the clock
+            srv.note_predict_result(True)
+            assert srv.consecutive_failures == 0
+            assert srv.last_predict_mono is not None
+        finally:
+            srv.stop()
+
+    def test_inference_server_failure_circuit_lossless(self, iris_net):
+        server = InferenceServer(iris_net)
+        try:
+            threads_n, per_thread = 8, 250
+
+            def fail_hammer():
+                for _ in range(per_thread):
+                    server.note_predict_result(False)
+
+            ts = [threading.Thread(target=fail_hammer)
+                  for _ in range(threads_n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert server.consecutive_failures == threads_n * per_thread
+            assert server.health()["ready"] is False
+            server.note_predict_result(True)
+            assert server.consecutive_failures == 0
+        finally:
+            server.stop()

@@ -1,14 +1,27 @@
 """graftlint — AST-based JAX/TPU correctness linter for deeplearning4j_tpu.
 
-Twelve rules (JX001–JX012) targeting the failure modes a JAX reproduction
-actually hits: tracer leaks across the host/device boundary, Python
-control flow on tracers, hidden host syncs in hot loops, silent
-recompilation, jit impurity, benchmark lies from async dispatch, and
-per-iteration host↔device transfers that belong in a prefetch stage.
+Two phases over one shared parse:
+
+* **Module rules** (JX001–JX017): per-file failure modes a JAX
+  reproduction actually hits — tracer leaks across the host/device
+  boundary, Python control flow on tracers, hidden host syncs in hot
+  loops, silent recompilation, jit impurity, benchmark lies from async
+  dispatch, per-iteration host↔device transfers, non-atomic checkpoint
+  writes, unbounded retries and queues.
+* **Whole-program concurrency rules** (JX018–JX021): package-scope
+  analysis (``program.py``) that infers thread-entry functions,
+  lock-guarded attributes, and the global lock-order graph, then checks
+  lock discipline — inconsistent guarding of shared attributes, leaked
+  non-daemon threads, lock-order cycles, and check-then-act races.
+
+Each file is parsed and walked ONCE; every module rule runs off the
+shared ``ModuleInfo`` index and the program rules run off the same
+parses.
 
 Usage:
     python -m tools.graftlint deeplearning4j_tpu/            # text output
-    python -m tools.graftlint --format json path/to/file.py
+    python -m tools.graftlint --format json|sarif path/to/file.py
+    python -m tools.graftlint --changed-only HEAD~1 deeplearning4j_tpu/
     python -m tools.graftlint --write-baseline deeplearning4j_tpu/
 
 Library API:
@@ -16,31 +29,30 @@ Library API:
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
-from .analysis import analyze_module
-from .core import Baseline, Finding, iter_python_files, parse_pragmas
-from .rules import RULES, RULE_DOCS
+from .analysis import ModuleInfo, analyze_module
+from .core import (Baseline, Finding, iter_python_files, parse_pragmas,
+                   to_sarif)
+from .program import build_program
+from .rules import PROGRAM_RULES, RULES, RULE_DOCS
 
-__all__ = ["Finding", "Baseline", "RULES", "RULE_DOCS",
-           "lint_source", "lint_file", "lint_paths", "iter_python_files"]
+__all__ = ["Finding", "Baseline", "RULES", "PROGRAM_RULES", "RULE_DOCS",
+           "lint_source", "lint_file", "lint_paths", "iter_python_files",
+           "to_sarif"]
 
 
 def lint_source(source: str, path: str = "<string>",
                 select: Optional[Sequence[str]] = None,
                 ignore: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Lint one source string; returns findings after pragma filtering."""
-    try:
-        info = analyze_module(source, path)
-    except SyntaxError as e:
-        return [Finding(path=path, line=e.lineno or 1, col=e.offset or 0,
-                        rule="JX000", message=f"syntax error: {e.msg}")]
-    pragmas = parse_pragmas(source)
-    active = _active_rules(select, ignore)
-    findings: List[Finding] = []
-    for code in active:
-        findings.extend(RULES[code](info))
-    findings = [f for f in findings if not pragmas.suppressed(f)]
+    """Lint one source string (module rules + a one-module program pass);
+    returns findings after pragma filtering."""
+    findings, parsed = _parse_and_run_module_rules(
+        source, path, _active_rules(select, ignore))
+    if parsed is not None:
+        info, pragmas = parsed
+        findings.extend(_run_program_rules(
+            [info], {path: pragmas}, _active_program_rules(select, ignore)))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -55,15 +67,73 @@ def lint_file(path: str, select: Optional[Sequence[str]] = None,
 def lint_paths(paths: Sequence[str],
                select: Optional[Sequence[str]] = None,
                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint files/directories: ONE parse per file shared by every module
+    rule and the whole-program concurrency pass."""
+    module_rules = _active_rules(select, ignore)
+    program_rules = _active_program_rules(select, ignore)
     findings: List[Finding] = []
+    infos: List[ModuleInfo] = []
+    pragma_index: Dict[str, object] = {}
     for p in iter_python_files(paths):
-        findings.extend(lint_file(p, select=select, ignore=ignore))
+        with open(p, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        file_findings, parsed = _parse_and_run_module_rules(
+            source, p, module_rules)
+        findings.extend(file_findings)
+        if parsed is not None:
+            info, pragmas = parsed
+            infos.append(info)
+            pragma_index[p] = pragmas
+    findings.extend(_run_program_rules(infos, pragma_index, program_rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def _parse_and_run_module_rules(
+        source: str, path: str, codes: Sequence[str]
+) -> Tuple[List[Finding], Optional[Tuple[ModuleInfo, object]]]:
+    try:
+        info = analyze_module(source, path)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=e.offset or 0,
+                        rule="JX000", message=f"syntax error: {e.msg}")], None
+    pragmas = parse_pragmas(source)
+    findings: List[Finding] = []
+    for code in codes:
+        findings.extend(RULES[code](info))
+    findings = [f for f in findings if not pragmas.suppressed(f)]
+    return findings, (info, pragmas)
+
+
+def _run_program_rules(infos: Sequence[ModuleInfo], pragma_index: Dict,
+                       codes: Sequence[str]) -> List[Finding]:
+    if not codes or not infos:
+        return []
+    program = build_program(infos)
+    findings: List[Finding] = []
+    for code in codes:
+        findings.extend(PROGRAM_RULES[code](program))
+    kept = []
+    for f in findings:
+        pragmas = pragma_index.get(f.path)
+        if pragmas is not None and pragmas.suppressed(f):
+            continue
+        kept.append(f)
+    return kept
 
 
 def _active_rules(select: Optional[Sequence[str]],
                   ignore: Optional[Sequence[str]]) -> List[str]:
-    codes = sorted(RULES)
+    return _filter_codes(sorted(RULES), select, ignore)
+
+
+def _active_program_rules(select: Optional[Sequence[str]],
+                          ignore: Optional[Sequence[str]]) -> List[str]:
+    return _filter_codes(sorted(PROGRAM_RULES), select, ignore)
+
+
+def _filter_codes(codes: List[str], select: Optional[Sequence[str]],
+                  ignore: Optional[Sequence[str]]) -> List[str]:
     if select:
         wanted = {c.strip().upper() for c in select}
         _check_known(wanted, "--select")
@@ -77,8 +147,9 @@ def _active_rules(select: Optional[Sequence[str]],
 
 def _check_known(codes, flag: str) -> None:
     """A typo'd rule code selecting nothing would gate on thin air."""
-    unknown = sorted(c for c in codes if c not in RULES)
+    known = set(RULES) | set(PROGRAM_RULES)
+    unknown = sorted(c for c in codes if c not in known)
     if unknown:
         raise ValueError(
             f"unknown rule code(s) for {flag}: {', '.join(unknown)} "
-            f"(known: {', '.join(sorted(RULES))})")
+            f"(known: {', '.join(sorted(known))})")

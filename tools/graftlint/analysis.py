@@ -84,9 +84,38 @@ class ModuleInfo:
         # func -> parameter names declared static via static_argnums/names
         # (static args are NOT tracers: branching on them is legal)
         self.static_params: Dict[ast.AST, Set[str]] = {}
+        # one full-tree walk, indexed by node type: rules iterate
+        # ``nodes(ast.Call)`` instead of each re-walking the whole tree
+        self._node_index: Dict[type, List[ast.AST]] = {}
+        self._taint_cache: Dict[ast.AST, "TaintInfo"] = {}
         self._build_parents()
         self._collect_imports()
         self._collect_jit_scopes()
+
+    # ------------------------------------------------------------ node index
+    def nodes(self, *types: type) -> List[ast.AST]:
+        """All nodes of the given type(s), from ONE cached full-tree walk
+        (document order).  The shared index is what lets every rule run
+        off a single parse+walk per module instead of re-walking."""
+        if not self._node_index:
+            index: Dict[type, List[ast.AST]] = {}
+            for node in ast.walk(self.tree):
+                index.setdefault(type(node), []).append(node)
+            self._node_index = index
+        if len(types) == 1:
+            return self._node_index.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._node_index.get(t, []))
+        return out
+
+    def taint(self, func: ast.AST) -> "TaintInfo":
+        """Memoized per-function taint analysis (JX001/JX002 both need
+        every jit scope's taints; compute each once per module)."""
+        ti = self._taint_cache.get(func)
+        if ti is None:
+            ti = self._taint_cache[func] = TaintInfo(self, func)
+        return ti
 
     # ---------------------------------------------------------- parents
     def _build_parents(self) -> None:

@@ -4,11 +4,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
-from . import RULE_DOCS, lint_paths
-from .core import Baseline
+from . import RULE_DOCS, lint_paths, to_sarif
+from .core import Baseline, iter_python_files
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -16,10 +17,12 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST-based JAX/TPU correctness linter "
-                    "(rules JX001-JX014; see tools/README.md)")
+        description="AST-based JAX/TPU correctness linter: module rules "
+                    "JX001-JX017 + whole-program concurrency rules "
+                    "JX018-JX021 (see tools/README.md)")
     p.add_argument("paths", nargs="*", help="files or directories to lint")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline JSON of accepted findings "
                         "(default: tools/graftlint/baseline.json)")
@@ -27,6 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="report every finding, ignoring the baseline")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline file and exit")
+    p.add_argument("--changed-only", metavar="GIT_REF", default=None,
+                   help="lint only files changed vs GIT_REF (plus "
+                        "untracked) — CI fast path; the whole-program "
+                        "pass sees only the changed subset, so run a "
+                        "full lint before merging")
     p.add_argument("--select", default=None,
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--ignore", default=None,
@@ -34,6 +42,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
+
+
+def _changed_files(ref: str, files: Sequence[str]) -> List[str]:
+    """Intersect ``files`` with paths changed vs ``ref`` (committed,
+    staged, working tree) plus untracked files."""
+    if not files:
+        return []
+    # anchor git at the LINTED tree, not the process cwd: a CI step (or
+    # operator) standing in a different repo would otherwise diff that
+    # repo, intersect nothing, and report "clean" on real findings
+    anchor = os.path.realpath(files[0])
+    anchor = os.path.dirname(anchor) or "."
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True, cwd=anchor)
+    if top.returncode != 0:
+        raise RuntimeError(
+            "--changed-only: the linted paths are not inside a git "
+            f"repository: {top.stderr.strip()}")
+    root = top.stdout.strip()
+    changed: set = set()
+    # both commands run FROM the repo root: `ls-files --others` scopes
+    # (and relativizes) to its cwd, so running it where the operator
+    # happens to stand would silently drop untracked files elsewhere in
+    # the repo — rooting it makes every output line root-relative
+    # core.quotepath=off: default git quotes non-ASCII names into octal
+    # escape strings that would never match a real path
+    for cmd in (["git", "-c", "core.quotepath=off", "diff",
+                 "--name-only", ref, "--"],
+                ["git", "-c", "core.quotepath=off", "ls-files",
+                 "--others", "--exclude-standard"]):
+        r = subprocess.run(cmd, capture_output=True, text=True, cwd=root)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only: `{' '.join(cmd)}` failed: "
+                f"{r.stderr.strip()}")
+        for line in r.stdout.splitlines():
+            if line.strip():
+                # realpath BOTH sides: git prints the physical root, while
+                # the linted paths may come through a symlink (/tmp on
+                # macOS) — logical-vs-physical mismatch must not turn
+                # into an empty intersection
+                changed.add(os.path.realpath(
+                    os.path.join(root, line.strip())))
+    return [f for f in files if os.path.realpath(f) in changed]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -46,9 +98,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         build_parser().error("the following arguments are required: paths")
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
+    if args.write_baseline and args.changed_only is not None:
+        build_parser().error(
+            "--write-baseline with --changed-only would overwrite the "
+            "whole baseline from a changed-files subset; regenerate from "
+            "a full run")
     try:
-        findings = lint_paths(args.paths, select=select, ignore=ignore)
-    except (FileNotFoundError, ValueError) as e:
+        files = list(iter_python_files(args.paths))
+        if args.changed_only is not None:
+            files = _changed_files(args.changed_only, files)
+            if not files:
+                if args.format == "text":
+                    print("graftlint: clean (no changed .py files)")
+                elif args.format == "json":
+                    print("[]")
+                else:
+                    print(json.dumps(to_sarif([], RULE_DOCS), indent=2))
+                return 0
+        findings = lint_paths(files, select=select, ignore=ignore)
+    except (FileNotFoundError, ValueError, RuntimeError) as e:
         build_parser().error(str(e))
 
     if args.write_baseline:
@@ -56,16 +124,65 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")
         return 0
 
+    stale: List[str] = []
     if not args.no_baseline:
-        findings = Baseline.load(args.baseline).filter(findings)
+        findings, stale = Baseline.load(args.baseline).apply(findings)
 
     if args.format == "json":
         print(json.dumps([f.to_json() for f in findings], indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings, RULE_DOCS), indent=2))
     else:
         for f in findings:
             print(f.format())
         n = len(findings)
         print(f"graftlint: {n} finding(s)" if n else "graftlint: clean")
+
+    # the ratchet: a baseline entry matching nothing means the suppressed
+    # finding was fixed — the allowance must be deleted, not left armed to
+    # absorb the next regression.  It can only judge what this run could
+    # have seen: --changed-only subsets and --select/--ignore runs skip
+    # it entirely, and an allowance is stale only when its file was
+    # actually linted (or no longer exists at all — deleted/moved files
+    # can never match again).
+    if stale:
+        linted = {os.path.relpath(f).replace(os.sep, "/") for f in files}
+        abs_linted = {os.path.abspath(f).replace(os.sep, "/")
+                      for f in files}
+        # baseline keys are relative to the cwd the baseline was written
+        # from.  A key that names a linted file but only as a path SUFFIX
+        # (not an exact cwd-relative match) proves this run's cwd is NOT
+        # that cwd — no key can be judged from here, so the whole ratchet
+        # stands down rather than misread live entries as deleted.
+        paths = [k.rsplit("::", 1)[0] for k in stale]
+        if any(p not in linted
+               and any(a.endswith("/" + p) for a in abs_linted)
+               for p in paths):
+            stale = []
+        else:
+            # the deleted-file branch resolves keys against the BASELINE
+            # file's own repo root (keys are written repo-root-relative
+            # by convention), not the process cwd — from a parent dir a
+            # live allowance for an unlinted file would otherwise read as
+            # deleted.  Outside git the baseline's directory is the best
+            # available anchor.
+            bl_dir = os.path.dirname(os.path.abspath(args.baseline)) or "."
+            top = subprocess.run(
+                ["git", "rev-parse", "--show-toplevel"],
+                capture_output=True, text=True, cwd=bl_dir)
+            root = top.stdout.strip() if top.returncode == 0 else bl_dir
+            stale = [k for k, p in zip(stale, paths)
+                     if p in linted
+                     or not os.path.exists(os.path.join(root, p))]
+    if stale and args.changed_only is None and not select and not ignore:
+        print("graftlint: stale baseline entr{} (no matching finding — "
+              "remove from {}):".format(
+                  "y" if len(stale) == 1 else "ies", args.baseline),
+              file=sys.stderr)
+        for key in stale:
+            print(f"  {key}", file=sys.stderr)
+        return 2
+
     return 1 if findings else 0
 
 

@@ -11,11 +11,11 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Finding", "PragmaIndex", "Baseline", "iter_python_files",
-    "parse_pragmas", "RULE_CODE_RE",
+    "parse_pragmas", "to_sarif", "RULE_CODE_RE",
 ]
 
 RULE_CODE_RE = re.compile(r"JX\d{3}")
@@ -119,6 +119,45 @@ def _norm(path: str) -> str:
     return os.path.relpath(path).replace(os.sep, "/")
 
 
+def to_sarif(findings: Sequence[Finding],
+             rule_docs: Optional[Dict[str, str]] = None) -> dict:
+    """SARIF 2.1.0 document for CI annotation (GitHub code scanning et
+    al.): one run, one result per finding, rule metadata from the
+    catalog."""
+    rule_docs = rule_docs or {}
+    seen_rules = sorted({f.rule for f in findings})
+    rules = [{"id": code,
+              "shortDescription": {"text": rule_docs.get(code, code)}}
+             for code in seen_rules]
+    rule_index = {code: i for i, code in enumerate(seen_rules)}
+    results = [{
+        "ruleId": f.rule,
+        "ruleIndex": rule_index[f.rule],
+        "level": "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": _norm(f.path),
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line),
+                           "startColumn": max(1, f.col + 1)},
+            }
+        }],
+    } for f in findings]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
 class Baseline:
     """Checked-in allowance for deliberate findings.
 
@@ -159,12 +198,24 @@ class Baseline:
 
     def filter(self, findings: Sequence[Finding]) -> List[Finding]:
         """Return the findings NOT absorbed by the baseline."""
+        return self.apply(findings)[0]
+
+    def apply(self, findings: Sequence[Finding]
+              ) -> "Tuple[List[Finding], List[str]]":
+        """(kept findings, stale allowance keys).  A stale key is a
+        baseline entry no current finding matches at all — the suppressed
+        bug was fixed (or the file moved), so the suppression must be
+        deleted rather than lie in wait to absorb a NEW bug.  The ratchet:
+        baselines can only shrink."""
         budget = dict(self.allowances)
+        matched: Set[str] = set()
         kept: List[Finding] = []
         for f in findings:
             key = f"{_norm(f.path)}::{f.rule}"
             if budget.get(key, 0) > 0:
                 budget[key] -= 1
+                matched.add(key)
             else:
                 kept.append(f)
-        return kept
+        stale = sorted(k for k in self.allowances if k not in matched)
+        return kept, stale

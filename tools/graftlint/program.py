@@ -1,0 +1,1030 @@
+"""Whole-program concurrency model for graftlint.
+
+Module-local rules (JX001–JX017) see one file at a time; the concurrency
+rule pack (JX018–JX021) needs facts that only exist at package scope:
+*which functions run on background threads*, *which lock protects which
+attribute*, and *in what order locks nest across classes*.  This module
+builds that model from the already-parsed :class:`ModuleInfo` set — one
+parse per file, shared with the module rules.
+
+Three layers:
+
+1. **Thread entries** — for every class, the set of functions that
+   execute on a spawned thread: targets of ``threading.Thread(...)`` /
+   ``threading.Timer`` / ``multiprocessing.Process`` /
+   ``executor.submit(...)``, resolved through bound methods
+   (``target=self._loop``), bare/local functions, one-hop local aliases
+   (``fn = self._loop; Thread(target=fn)``), lambdas, and — program-wide
+   — methods of *other* classes reached through a constructor-typed
+   variable (``w = Worker(); Thread(target=w.run)``).  The entry set is
+   closed over same-class ``self.m()`` calls, so a helper two calls below
+   the thread target is still "on the thread".
+
+2. **Guarded-by inference** — every ``self.<attr>`` access is recorded
+   with the set of class locks held at that point: ``with self._lock:``
+   scopes, sequential ``acquire()``/``release()`` pairs (including the
+   ``acquire(); try: ... finally: release()`` idiom), and
+   property-aliased locks (``@property def lock: return self._lock``).
+   A lock that guards a write to an attribute is that attribute's
+   *inferred guard*.
+
+3. **Lock-order graph** — acquiring lock B while holding lock A adds the
+   edge A→B; calls made while holding a lock add one-hop edges into the
+   locks the callee acquires (same-class ``self.m()`` and
+   attribute-typed ``self.peer.m()``).  A cycle in this graph is a
+   potential deadlock (JX020).
+
+Everything is stdlib-``ast``; imprecision is deliberately on the *quiet*
+side (unresolvable targets/receivers are dropped, not guessed) so
+findings stay actionable.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .analysis import ModuleInfo, call_name, dotted_name
+
+__all__ = ["ProgramModel", "ClassModel", "AttrAccess", "ThreadSpawn",
+           "LockNode", "build_program", "find_lock_cycles"]
+
+# threading/multiprocessing constructors that create LOCKS (guard tokens)
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"))
+# constructors whose objects are internally synchronized: attributes
+# holding these are thread-safe by construction and never JX018 targets
+_SAFE_CTORS = frozenset(("Event", "Queue", "LifoQueue", "PriorityQueue",
+                         "JoinableQueue", "SimpleQueue", "Barrier",
+                         "local")) | _LOCK_CTORS
+_THREADING_MODULES = frozenset(("threading", "multiprocessing", "mp",
+                                "queue"))
+# thread-handle methods whose receiver use is lifecycle, not an escape
+_HANDLE_ATTRS = frozenset(("start", "join", "cancel", "daemon",
+                           "setDaemon", "is_alive", "name", "ident"))
+
+
+def _daemonish(v: ast.AST) -> bool:
+    """True when ``v`` sets (or MAY set) daemon: a truthy constant, or a
+    non-constant expression (``daemon=flag``) whose runtime value we
+    cannot resolve — the unknown drops on the quiet side, so JX019 never
+    fires on a possibly-daemon thread."""
+    return not isinstance(v, ast.Constant) or bool(v.value)
+
+
+@dataclass
+class AttrAccess:
+    """One ``self.<attr>`` access with its lock context."""
+    attr: str
+    node: ast.AST                 # anchor for findings (lineno/col)
+    func: ast.AST                 # innermost enclosing function def
+    write: bool
+    aug: bool = False             # read-modify-write (x += 1)
+    subscript: bool = False       # container item write (self.d[k] = v)
+    held: FrozenSet[str] = frozenset()
+    in_init: bool = False
+
+
+@dataclass
+class ThreadSpawn:
+    """One thread/timer/process/submit creation site."""
+    node: ast.Call
+    kind: str                     # "thread" | "timer" | "process" | "submit"
+    func: ast.AST                 # function the spawn happens in
+    daemon: Optional[bool]        # None/False = non-daemon; True also
+                                  # covers unresolvable daemon= exprs
+    targets: List[ast.AST] = field(default_factory=list)   # resolved defs
+    # unresolved cross-object targets: (receiver local name, method name)
+    foreign: List[Tuple[str, str]] = field(default_factory=list)
+    binding: Optional[str] = None          # local var name, if bound
+    self_attr: Optional[str] = None        # self.<attr> it is stored to
+    started: bool = False
+    joined: bool = False
+    escapes: bool = False         # returned / yielded / passed / aliased
+
+
+@dataclass(frozen=True)
+class LockNode:
+    """A lock identity in the program lock-order graph."""
+    cls: str
+    attr: str
+    path: str
+
+    def label(self) -> str:
+        return f"{self.cls}.{self.attr}"
+
+
+# ---------------------------------------------------------------- helpers
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_subscript(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Subscript):
+        return _self_attr(node.value)
+    return None
+
+
+def _self_method_call(n: ast.Call) -> Optional[str]:
+    if isinstance(n.func, ast.Attribute) and \
+            isinstance(n.func.value, ast.Name) and n.func.value.id == "self":
+        return n.func.attr
+    return None
+
+
+def _unpack_pairs(stmt: ast.Assign) -> List[Tuple[ast.AST, ast.AST]]:
+    """Element-wise (target, value) pairs, unpacking parallel tuple
+    assignments like ``t, self._w = self._w, None``."""
+    pairs: List[Tuple[ast.AST, ast.AST]] = []
+    for t in stmt.targets:
+        if isinstance(t, (ast.Tuple, ast.List)) and \
+                isinstance(stmt.value, (ast.Tuple, ast.List)) and \
+                len(t.elts) == len(stmt.value.elts):
+            pairs.extend(zip(t.elts, stmt.value.elts))
+        else:
+            pairs.append((t, stmt.value))
+    return pairs
+
+
+def _repr_of(node: ast.AST) -> Optional[str]:
+    """Stable textual identity for key/receiver matching."""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return None
+
+
+def _acquire_release(stmt: ast.stmt, lock_of, which: str) -> Optional[str]:
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        c = stmt.value
+        if isinstance(c.func, ast.Attribute) and c.func.attr == which:
+            return lock_of(c.func.value)
+    return None
+
+
+class ClassModel:
+    """Per-class concurrency facts extracted from one module."""
+
+    def __init__(self, info: ModuleInfo, node: ast.ClassDef):
+        self.info = info
+        self.node = node
+        self.name = node.name
+        self.path = info.path
+        self.methods: Dict[str, ast.AST] = {}
+        self.lock_attrs: Set[str] = set()
+        self.safe_attrs: Set[str] = set()
+        self.lock_aliases: Dict[str, str] = {}   # property name -> lock attr
+        self.attr_ctor: Dict[str, str] = {}      # attr -> ClassName string
+        self.accesses: List[AttrAccess] = []
+        self.spawns: List[ThreadSpawn] = []
+        self.entry_funcs: Set[ast.AST] = set()
+        # lock-order facts: (held lock attr, acquired lock attr, site)
+        self.lock_edges: List[Tuple[str, str, ast.AST]] = []
+        # (held frozenset, call node, receiver expr string, method name)
+        self.calls_while_held: List[
+            Tuple[FrozenSet[str], ast.Call, str, str]] = []
+        # func def -> lock attrs it acquires anywhere in its body
+        self.func_locks: Dict[ast.AST, Set[str]] = {}
+        # check-then-act candidates: (If/While node, kind, attr/queue expr,
+        # key repr or None, held locks at the check)
+        self.check_then_act: List[
+            Tuple[ast.AST, str, str, Optional[str], FrozenSet[str]]] = []
+        # aug-assigns through a non-self receiver: (target node, held,
+        # func) — the shared-state shape in handler classes, where `self`
+        # is per-connection and shared state arrives via the server ref
+        self.foreign_augs: List[
+            Tuple[ast.Attribute, FrozenSet[str], ast.AST]] = []
+        # HTTP-handler classes run one instance per connection: every
+        # request method is effectively a thread entry
+        self.is_handler = any(
+            "Handler" in (dotted_name(b) or "").split(".")[-1]
+            for b in node.bases)
+
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+        self._collect_attr_kinds()
+        self._collect_lock_aliases()
+        for m in self.methods.values():
+            _MethodWalker(self, m).run()
+        for m in self.methods.values():
+            for spawn in scan_spawns(self.info, m, cls=self):
+                self.spawns.append(spawn)
+        self._resolve_entries()
+
+    # -------------------------------------------------------- attr kinds
+    def _collect_attr_kinds(self) -> None:
+        """Classify ``self.X = <ctor>()`` assignments: locks, thread-safe
+        primitives, and program-class-typed attributes."""
+        # resolve module aliases the same way spawn detection does:
+        # `import threading as th` must qualify th.Lock() exactly like
+        # th.Thread() — asymmetry here turned fully locked classes into
+        # JX018 false positives and silenced JX020/JX021
+        mods, _ = _thread_aliases(self.info)
+        thread_mods = _THREADING_MODULES | mods
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if not isinstance(n, ast.Assign) or \
+                        not isinstance(n.value, ast.Call):
+                    continue
+                ctor = call_name(n.value) or ""
+                parts = ctor.split(".")
+                leaf = parts[-1]
+                qualified = (len(parts) >= 2 and parts[0] in thread_mods)
+                for tgt, val in _unpack_pairs(n):
+                    if val is not n.value:
+                        continue
+                    attr = _self_attr(tgt)
+                    # `conns_lock = self._conns_lock = threading.Lock()`
+                    # chains: every target of the Assign gets the kind
+                    if attr is None:
+                        continue
+                    if leaf in _LOCK_CTORS and (qualified or len(parts) == 1):
+                        self.lock_attrs.add(attr)
+                    elif leaf in _SAFE_CTORS and (qualified
+                                                  or len(parts) == 1):
+                        self.safe_attrs.add(attr)
+                    elif len(parts) == 1 and leaf[:1].isupper():
+                        # plain ClassName(...) — resolved program-wide
+                        self.attr_ctor[attr] = leaf
+        # usage-typed locks: an attr entered as a `with self.X:` context
+        # or used as an acquire()/release() receiver IS a lock however it
+        # was constructed (injected via a ctor parameter, built by a
+        # helper).  Guards this infers only SUPPRESS findings, so a
+        # non-lock context manager misread as a lock errs quiet.
+        # Property names are skipped — the alias pass maps them onto
+        # their backing attr so each lock keeps ONE token.
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                attr = None
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr is not None and attr not in self.safe_attrs \
+                                and attr not in self.methods:
+                            self.lock_attrs.add(attr)
+                    continue
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in ("acquire", "release"):
+                    attr = _self_attr(n.func.value)
+                if attr is not None and attr not in self.safe_attrs \
+                        and attr not in self.methods:
+                    self.lock_attrs.add(attr)
+
+    def _collect_lock_aliases(self) -> None:
+        """``@property def lock(self): return self._lock`` makes
+        ``with self.lock:`` guard the same token as ``self._lock``."""
+        for name, m in self.methods.items():
+            if not isinstance(m, ast.FunctionDef):
+                continue
+            if not any(isinstance(d, ast.Name) and d.id == "property"
+                       for d in m.decorator_list):
+                continue
+            body = [s for s in m.body
+                    if not (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))]
+            if len(body) == 1 and isinstance(body[0], ast.Return):
+                attr = _self_attr(body[0].value)
+                if attr in self.lock_attrs:
+                    self.lock_aliases[name] = attr
+
+    # ----------------------------------------------------- thread entries
+    def _resolve_entries(self) -> None:
+        for spawn in self.spawns:
+            self.entry_funcs.update(spawn.targets)
+        self.close_entries()
+
+    def close_entries(self) -> None:
+        """Close the entry set over same-class ``self.m()`` calls: a
+        helper called from a thread-entry function runs on the thread."""
+        changed = True
+        while changed:
+            changed = False
+            for f in list(self.entry_funcs):
+                for n in ast.walk(f):
+                    if isinstance(n, ast.Call):
+                        m = _self_method_call(n)
+                        if m and m in self.methods and \
+                                self.methods[m] not in self.entry_funcs:
+                            self.entry_funcs.add(self.methods[m])
+                            changed = True
+
+    # ---------------------------------------------------------- inference
+    def guards(self, attr: str) -> Set[str]:
+        """Locks inferred to guard ``attr``: any lock held at a non-init
+        write, or held at two or more accesses."""
+        out: Set[str] = set()
+        counts: Dict[str, int] = {}
+        for a in self.accesses:
+            if a.attr != attr:
+                continue
+            for lk in a.held:
+                counts[lk] = counts.get(lk, 0) + 1
+                if a.write and not a.in_init:
+                    out.add(lk)
+        out.update(lk for lk, c in counts.items() if c >= 2)
+        return out
+
+    def attrs(self) -> Set[str]:
+        return {a.attr for a in self.accesses}
+
+    def joins_attr(self, attr: str) -> bool:
+        """Is ``self.<attr>.join()`` (or ``.cancel()``) called anywhere in
+        the class — directly, or through a local alias assigned from the
+        attribute (the ``t, self._worker = self._worker, None; t.join()``
+        double-buffer idiom)?"""
+        for m in self.methods.values():
+            local_aliases: Set[str] = set()
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign):
+                    for tgt, val in _unpack_pairs(n):
+                        if _self_attr(val) == attr and \
+                                isinstance(tgt, ast.Name):
+                            local_aliases.add(tgt.id)
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in ("join", "cancel"):
+                    base = n.func.value
+                    if _self_attr(base) == attr:
+                        return True
+                    if isinstance(base, ast.Name) and \
+                            base.id in local_aliases:
+                        return True
+        return False
+
+    def daemonizes_attr(self, attr: str) -> bool:
+        """``self.<attr>.daemon = True`` / ``.setDaemon(True)`` anywhere."""
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign):
+                    for tgt, val in _unpack_pairs(n):
+                        if isinstance(tgt, ast.Attribute) and \
+                                tgt.attr == "daemon" and \
+                                _self_attr(tgt.value) == attr and \
+                                _daemonish(val):
+                            return True
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "setDaemon" and \
+                        _self_attr(n.func.value) == attr and n.args and \
+                        _daemonish(n.args[0]):
+                    return True
+        return False
+
+    def starts_attr(self, attr: str) -> bool:
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "start" and \
+                        _self_attr(n.func.value) == attr:
+                    return True
+        return False
+
+
+class _MethodWalker:
+    """Walk one method recording attr accesses, lock context, lock-order
+    edges, calls-under-lock, and check-then-act shapes."""
+
+    def __init__(self, cls: ClassModel, method: ast.AST):
+        self.cls = cls
+        self.method = method
+        self.in_init = getattr(method, "name", "") == "__init__"
+
+    def run(self) -> None:
+        self.cls.func_locks.setdefault(self.method, set())
+        self._block(self.method.body, set(), self.method)
+
+    # ------------------------------------------------------------ helpers
+    def _lock_token(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a with-context / acquire receiver to a class lock
+        attr, through property aliases."""
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        if attr in self.cls.lock_attrs:
+            return attr
+        return self.cls.lock_aliases.get(attr)
+
+    def _acquired(self, lock: str, held: Set[str], site: ast.AST) -> None:
+        self.cls.func_locks.setdefault(self.method, set()).add(lock)
+        for h in held:
+            if h != lock:
+                self.cls.lock_edges.append((h, lock, site))
+
+    # -------------------------------------------------------------- walk
+    def _block(self, stmts: Sequence[ast.stmt], held: Set[str],
+               func: ast.AST) -> None:
+        held = set(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly: Set[str] = set()
+                for item in stmt.items:
+                    self._expr(item.context_expr, held, func)
+                    lk = self._lock_token(item.context_expr)
+                    if lk is not None:
+                        self._acquired(lk, held | newly, stmt)
+                        newly.add(lk)
+                self._block(stmt.body, held | newly, func)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._expr(stmt.test, held, func)
+                self._check_then_act(stmt, held, func)
+                self._block(stmt.body, held, func)
+                self._block(stmt.orelse, held, func)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._target(stmt.target, held, func)
+                self._expr(stmt.iter, held, func)
+                self._block(stmt.body, held, func)
+                self._block(stmt.orelse, held, func)
+            elif isinstance(stmt, ast.Try):
+                # the acquire(); try: ... finally: release() idiom: the
+                # sequential acquire above already put the lock in `held`
+                self._block(stmt.body, held, func)
+                for h in stmt.handlers:
+                    self._block(h.body, held, func)
+                self._block(stmt.orelse, held, func)
+                self._block(stmt.finalbody, held, func)
+                for s in stmt.finalbody:
+                    rl = _acquire_release(s, self._lock_token, "release")
+                    if rl is not None:
+                        held.discard(rl)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs LATER (thread bodies, callbacks): its
+                # accesses carry no lock from the defining scope
+                self._block(stmt.body, set(), stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            else:
+                lk = _acquire_release(stmt, self._lock_token, "acquire")
+                if lk is not None:
+                    self._acquired(lk, held, stmt)
+                    held.add(lk)
+                    continue
+                rl = _acquire_release(stmt, self._lock_token, "release")
+                if rl is not None:
+                    held.discard(rl)
+                    continue
+                self._stmt(stmt, held, func)
+
+    def _stmt(self, stmt: ast.stmt, held: Set[str], func: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                self._target(t, held, func)
+            self._expr(stmt.value, held, func)
+        elif isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            sub = _self_subscript(stmt.target)
+            if attr is not None:
+                self._record(attr, stmt, func, held, write=True, aug=True)
+            elif sub is not None:
+                self._record(sub, stmt, func, held, write=True,
+                             aug=True, subscript=True)
+            else:
+                if isinstance(stmt.target, ast.Attribute):
+                    self.cls.foreign_augs.append(
+                        (stmt.target, frozenset(held), func))
+                self._target(stmt.target, held, func)
+            self._expr(stmt.value, held, func)
+        elif isinstance(stmt, ast.AnnAssign):
+            self._target(stmt.target, held, func)
+            if stmt.value is not None:
+                self._expr(stmt.value, held, func)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                attr = _self_attr(t)
+                sub = _self_subscript(t)
+                if attr is not None:
+                    self._record(attr, t, func, held, write=True)
+                elif sub is not None:
+                    self._record(sub, t, func, held, write=True,
+                                 subscript=True)
+                else:
+                    self._expr(t, held, func)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if stmt.value is not None:
+                self._expr(stmt.value, held, func)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._expr(stmt.exc, held, func)
+        elif isinstance(stmt, ast.Assert):
+            self._expr(stmt.test, held, func)
+
+    def _target(self, t: ast.AST, held: Set[str], func: ast.AST) -> None:
+        attr = _self_attr(t)
+        if attr is not None:
+            self._record(attr, t, func, held, write=True)
+            return
+        sub = _self_subscript(t)
+        if sub is not None:
+            self._record(sub, t, func, held, write=True, subscript=True)
+            self._expr(t.slice, held, func)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held, func)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value, held, func)
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            self._expr(t.value, held, func)
+
+    def _expr(self, node: ast.AST, held: Set[str], func: ast.AST) -> None:
+        """Record reads and calls-under-lock in an expression subtree."""
+        if node is None:
+            return
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and n.value.id == "self":
+                if n.attr in self.cls.methods or \
+                        n.attr in self.cls.lock_attrs or \
+                        n.attr in self.cls.lock_aliases:
+                    continue
+                # receiver of a method call (self.x.foo()) is a read of x
+                self._record(n.attr, n, func, held, write=False)
+            elif isinstance(n, ast.Call) and held and \
+                    isinstance(n.func, ast.Attribute):
+                recv = dotted_name(n.func.value)
+                if recv is not None:
+                    self.cls.calls_while_held.append(
+                        (frozenset(held), n, recv, n.func.attr))
+
+    def _record(self, attr: str, node: ast.AST, func: ast.AST,
+                held: Set[str], write: bool, aug: bool = False,
+                subscript: bool = False) -> None:
+        self.cls.accesses.append(AttrAccess(
+            attr=attr, node=node, func=func, write=write, aug=aug,
+            subscript=subscript, held=frozenset(held),
+            in_init=self.in_init and func is self.method))
+
+    # -------------------------------------------------- check-then-act
+    def _check_then_act(self, stmt: ast.AST, held: Set[str],
+                        func: ast.AST) -> None:
+        test = stmt.test
+        # membership check on a self container: `if k in self._d:`
+        for cmp_node in [n for n in ast.walk(test)
+                         if isinstance(n, ast.Compare)]:
+            if len(cmp_node.ops) != 1 or not isinstance(
+                    cmp_node.ops[0], (ast.In, ast.NotIn)):
+                continue
+            attr = _self_attr(cmp_node.comparators[0])
+            if attr is None or attr in self.cls.safe_attrs:
+                continue
+            key = _repr_of(cmp_node.left)
+            if key is None:
+                continue
+            if _branch_uses_key(stmt, attr, key):
+                self.cls.check_then_act.append(
+                    (stmt, "membership", attr, key, frozenset(held)))
+        # qsize()/empty()-gated get on a queue-like receiver.  Held locks
+        # are given the benefit of the doubt: a lock-disciplined drain is
+        # only racy against consumers that skip the lock, which JX018
+        # covers from the attribute side.
+        gated = _queue_gate(test)
+        if gated is not None and not held:
+            for n in ast.walk(stmt):
+                if n is test:
+                    continue
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in ("get", "get_nowait") and \
+                        _repr_of(n.func.value) == gated:
+                    self.cls.check_then_act.append(
+                        (stmt, "queue", gated, None, frozenset(held)))
+                    break
+
+
+def _branch_uses_key(stmt: ast.AST, attr: str, key: str) -> bool:
+    """Does the If/While body (or orelse) index/pop ``self.<attr>`` with
+    the same key expression the test checked?"""
+    for part in list(getattr(stmt, "body", [])) + list(
+            getattr(stmt, "orelse", [])):
+        for n in ast.walk(part):
+            if isinstance(n, ast.Subscript) and \
+                    _self_attr(n.value) == attr and \
+                    _repr_of(n.slice) == key:
+                return True
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("pop", "remove") and \
+                    _self_attr(n.func.value) == attr and n.args and \
+                    _repr_of(n.args[0]) == key:
+                return True
+    return False
+
+
+def _queue_gate(test: ast.AST) -> Optional[str]:
+    """If the test gates on ``X.qsize()`` / ``X.empty()``, return the
+    receiver expression string."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("qsize", "empty"):
+            return _repr_of(n.func.value)
+    return None
+
+
+# ------------------------------------------------------- spawn detection
+def _thread_aliases(info: ModuleInfo) -> Tuple[Set[str], Dict[str, str]]:
+    """(module aliases for threading/multiprocessing, bare-name map
+    name -> Thread|Timer|Process from from-imports)."""
+    cached = getattr(info, "_thread_aliases", None)
+    if cached is not None:
+        return cached
+    mods: Set[str] = set()
+    bare: Dict[str, str] = {}
+    for node in info.nodes(ast.Import):
+        for a in node.names:
+            if a.name in ("threading", "multiprocessing") or \
+                    a.name.startswith("multiprocessing."):
+                mods.add(a.asname or a.name.split(".")[0])
+    for node in info.nodes(ast.ImportFrom):
+        if node.module in ("threading", "multiprocessing",
+                           "multiprocessing.context"):
+            for a in node.names:
+                if a.name in ("Thread", "Timer", "Process"):
+                    bare[a.asname or a.name] = a.name
+    info._thread_aliases = (mods, bare)
+    return mods, bare
+
+
+def scan_spawns(info: ModuleInfo, func: ast.AST,
+                cls: Optional[ClassModel] = None) -> List[ThreadSpawn]:
+    """Thread/timer/process/submit creation sites in ``func`` (including
+    its nested defs), with target resolution and lifecycle facts
+    (started / joined / daemonized / escaping)."""
+    mods, bare = _thread_aliases(info)
+    spawns: List[ThreadSpawn] = []
+    for n in ast.walk(func):
+        if not isinstance(n, ast.Call):
+            continue
+        kind = None
+        target_expr: Optional[ast.AST] = None
+        fname = call_name(n) or ""
+        parts = fname.split(".")
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "submit":
+            kind = "submit"
+            target_expr = n.args[0] if n.args else None
+        elif (len(parts) == 2 and parts[0] in mods and
+              parts[1] in ("Thread", "Timer", "Process")) or \
+                (len(parts) == 1 and parts[0] in bare):
+            leaf = bare[parts[0]] if len(parts) == 1 else parts[1]
+            kind = {"Thread": "thread", "Timer": "timer",
+                    "Process": "process"}[leaf]
+            if kind == "timer" and len(n.args) > 1:
+                target_expr = n.args[1]
+        if kind is None:
+            continue
+        daemon: Optional[bool] = None
+        for kw in n.keywords:
+            if kw.arg == "target" and target_expr is None:
+                target_expr = kw.value
+            elif kw.arg == "function" and kind == "timer" and \
+                    target_expr is None:
+                target_expr = kw.value
+            elif kw.arg == "daemon":
+                daemon = _daemonish(kw.value)
+        spawn = ThreadSpawn(node=n, kind=kind, func=func, daemon=daemon)
+        scope = info.enclosing_function(n) or func
+        if target_expr is not None:
+            _resolve_target(target_expr, info, cls, scope, spawn)
+        _finalize_spawn(info, spawn, scope, cls)
+        spawns.append(spawn)
+    return spawns
+
+
+def _resolve_target(expr: ast.AST, info: ModuleInfo,
+                    cls: Optional[ClassModel], scope: ast.AST,
+                    spawn: ThreadSpawn, hops: int = 1) -> None:
+    """Resolve a spawn target expression onto function-def nodes:
+    ``self.m`` → method; bare name → local def / one-hop local alias /
+    module-level def; ``obj.m`` → recorded as foreign for program-level
+    resolution; lambda → the lambda plus any ``self.m()`` it calls."""
+    if isinstance(expr, ast.Lambda):
+        spawn.targets.append(expr)
+        if cls is not None:
+            for n in ast.walk(expr.body):
+                if isinstance(n, ast.Call):
+                    m = _self_method_call(n)
+                    if m and m in cls.methods:
+                        spawn.targets.append(cls.methods[m])
+        return
+    attr = _self_attr(expr)
+    if attr is not None:
+        if cls is not None and attr in cls.methods:
+            spawn.targets.append(cls.methods[attr])
+        return
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        spawn.foreign.append((expr.value.id, expr.attr))
+        return
+    if not isinstance(expr, ast.Name):
+        return
+    name = expr.id
+    # local def in the enclosing function chain
+    cur: Optional[ast.AST] = scope
+    while cur is not None:
+        for n in ast.walk(cur):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == name and \
+                    info.enclosing_function(n) is cur:
+                spawn.targets.append(n)
+                return
+        cur = info.enclosing_function(cur)
+    # one-hop local alias: fn = self._loop / fn = other_fn
+    if hops > 0:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign):
+                for tgt, val in _unpack_pairs(n):
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        _resolve_target(val, info, cls, scope, spawn,
+                                        hops=hops - 1)
+                        return
+    # module-level def
+    for n in info.tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                n.name == name:
+            spawn.targets.append(n)
+            return
+    if cls is not None and name in cls.methods:
+        spawn.targets.append(cls.methods[name])
+
+
+def _finalize_spawn(info: ModuleInfo, spawn: ThreadSpawn, scope: ast.AST,
+                    cls: Optional[ClassModel]) -> None:
+    """Bind the spawn to its variable and derive lifecycle facts."""
+    par = info.parent(spawn.node)
+    if isinstance(par, ast.Attribute) and par.attr == "start":
+        spawn.started = True           # Thread(...).start() chained
+    if isinstance(par, ast.Assign):
+        for tgt, val in _unpack_pairs(par):
+            if val is spawn.node:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    spawn.self_attr = attr
+                elif isinstance(tgt, ast.Name):
+                    spawn.binding = tgt.id
+
+    b = spawn.binding
+    if b is not None:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id == b:
+                if n.func.attr == "start":
+                    spawn.started = True
+                elif n.func.attr in ("join", "cancel"):
+                    spawn.joined = True
+                elif n.func.attr == "setDaemon" and n.args and \
+                        _daemonish(n.args[0]):
+                    spawn.daemon = True
+            elif isinstance(n, ast.Assign):
+                for tgt, val in _unpack_pairs(n):
+                    if isinstance(val, ast.Name) and val.id == b:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            spawn.self_attr = attr
+                        else:
+                            spawn.escapes = True   # aliased away: quiet
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon" and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == b and _daemonish(val):
+                        spawn.daemon = True
+            elif isinstance(n, ast.Name) and n.id == b and \
+                    isinstance(n.ctx, ast.Load):
+                p = info.parent(n)
+                if isinstance(p, ast.Attribute) and \
+                        p.attr in _HANDLE_ATTRS:
+                    continue
+                if isinstance(p, ast.Assign) and p.value is n:
+                    continue                       # handled above
+                if isinstance(p, (ast.Return, ast.Yield, ast.Tuple,
+                                  ast.List, ast.Set, ast.Dict, ast.Call,
+                                  ast.keyword, ast.Starred)):
+                    spawn.escapes = True
+
+    if spawn.self_attr is not None and cls is not None:
+        a = spawn.self_attr
+        spawn.started = spawn.started or cls.starts_attr(a)
+        spawn.joined = spawn.joined or cls.joins_attr(a)
+        if cls.daemonizes_attr(a):
+            spawn.daemon = True
+
+
+# --------------------------------------------------------------- program
+class ProgramModel:
+    """All :class:`ClassModel` s across the linted module set, with
+    program-wide resolution (cross-class thread targets, attribute-typed
+    call edges) and the global lock-order graph."""
+
+    def __init__(self, infos: Sequence[ModuleInfo]):
+        self.infos = list(infos)
+        self.classes: List[ClassModel] = []
+        self.by_name: Dict[str, List[ClassModel]] = {}
+        # spawns in module-level functions, outside any class
+        self.module_spawns: List[Tuple[ModuleInfo, ThreadSpawn]] = []
+        for info in self.infos:
+            class_funcs: Set[ast.AST] = set()
+            for node in info.nodes(ast.ClassDef):
+                cm = ClassModel(info, node)
+                self.classes.append(cm)
+                self.by_name.setdefault(cm.name, []).append(cm)
+                class_funcs.update(ast.walk(node))
+            for fn in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+                if fn in class_funcs:
+                    continue
+                if info.enclosing_function(fn) is not None:
+                    continue     # nested defs covered by the parent walk
+                for spawn in scan_spawns(info, fn):
+                    self.module_spawns.append((info, spawn))
+        self._resolve_foreign_targets()
+        self._edges: Optional[List[Tuple[LockNode, LockNode, ast.AST,
+                                         str]]] = None
+
+    # ------------------------------------------------- cross-class entry
+    def _resolve_foreign_targets(self) -> None:
+        """``w = Worker(...); Thread(target=w.run)`` marks ``Worker.run``
+        (and its same-class closure) as a thread entry."""
+        all_spawns = [(cls, s) for cls in self.classes
+                      for s in cls.spawns]
+        all_spawns += [(None, s) for _, s in self.module_spawns]
+        for owner, spawn in all_spawns:
+            for recv, meth in spawn.foreign:
+                tname = _local_ctor_type(spawn.func, recv)
+                if tname is None and owner is not None:
+                    tname = owner.attr_ctor.get(recv)
+                for target_cls in self.by_name.get(tname or "", []):
+                    m = target_cls.methods.get(meth)
+                    if m is not None:
+                        spawn.targets.append(m)
+                        target_cls.entry_funcs.add(m)
+                        target_cls.close_entries()
+
+    # ------------------------------------------------------- lock graph
+    def lock_edges(self) -> List[Tuple[LockNode, LockNode, ast.AST, str]]:
+        """(held, acquired, site, path) edges of the program lock-order
+        graph: within-class nesting plus one-hop call edges."""
+        if self._edges is not None:
+            return self._edges
+        edges: List[Tuple[LockNode, LockNode, ast.AST, str]] = []
+        for cls in self.classes:
+            for h, l, site in cls.lock_edges:
+                edges.append((LockNode(cls.name, h, cls.path),
+                              LockNode(cls.name, l, cls.path),
+                              site, cls.path))
+            for held, call, recv, meth in cls.calls_while_held:
+                for callee_cls, callee in self._resolve_call(
+                        cls, recv, meth):
+                    for lk in callee_cls.func_locks.get(callee, ()):
+                        for h in held:
+                            src = LockNode(cls.name, h, cls.path)
+                            tgt = LockNode(callee_cls.name, lk,
+                                           callee_cls.path)
+                            if src != tgt:
+                                edges.append((src, tgt, call, cls.path))
+        self._edges = edges
+        return edges
+
+    def _resolve_call(self, cls: ClassModel, recv: str,
+                      meth: str) -> List[Tuple[ClassModel, ast.AST]]:
+        out: List[Tuple[ClassModel, ast.AST]] = []
+        tname: Optional[str] = None
+        if recv == "self":
+            m = cls.methods.get(meth)
+            if m is not None:
+                out.append((cls, m))
+            return out
+        parts = recv.split(".")
+        if len(parts) == 2 and parts[0] == "self":
+            tname = cls.attr_ctor.get(parts[1])
+        if tname is not None:
+            for target_cls in self.by_name.get(tname, []):
+                m = target_cls.methods.get(meth)
+                if m is not None:
+                    out.append((target_cls, m))
+        return out
+
+
+def receiver_is_shared(func: ast.AST, target: ast.Attribute) -> bool:
+    """Is the receiver of ``<recv>.attr += 1`` shared state?  True when
+    the receiver chain roots at ``self`` or a function parameter, or at a
+    local aliased FROM a ``self.…`` chain (``srv = self.server_ref``).
+    Locals built fresh in the function (``r = Reader(data)``) are
+    private — their mutation is single-threaded."""
+    recv = dotted_name(target.value)
+    if recv is None:
+        return False
+    root = recv.split(".")[0]
+    if root == "self":
+        return True
+    args = getattr(func, "args", None)
+    if args is not None:
+        params = {a.arg for a in (list(args.posonlyargs) + list(args.args)
+                                  + list(args.kwonlyargs))}
+        if root in params and root != "self":
+            return True
+    for n in ast.walk(func):
+        if isinstance(n, ast.Assign):
+            for tgt, val in _unpack_pairs(n):
+                if isinstance(tgt, ast.Name) and tgt.id == root:
+                    v = dotted_name(val)
+                    return bool(v) and v.split(".")[0] == "self"
+    return False
+
+
+def _local_ctor_type(func: ast.AST, name: str) -> Optional[str]:
+    """Type of local ``name`` when assigned ``name = ClassName(...)``."""
+    for n in ast.walk(func):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            ctor = call_name(n.value) or ""
+            if "." in ctor or not ctor[:1].isupper():
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return ctor
+    return None
+
+
+def build_program(infos: Sequence[ModuleInfo]) -> ProgramModel:
+    return ProgramModel(infos)
+
+
+# --------------------------------------------------------- cycle finding
+def find_lock_cycles(edges: Sequence[Tuple[LockNode, LockNode, ast.AST,
+                                           str]]
+                     ) -> List[Tuple[List[LockNode], ast.AST, str]]:
+    """Cycles of length >= 2 in the lock-order graph (Tarjan SCCs).
+    Returns (cycle node list, representative site, path) per cycle."""
+    graph: Dict[LockNode, Set[LockNode]] = {}
+    site_of: Dict[Tuple[LockNode, LockNode], Tuple[ast.AST, str]] = {}
+    for a, b, site, path in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        site_of.setdefault((a, b), (site, path))
+
+    index: Dict[LockNode, int] = {}
+    low: Dict[LockNode, int] = {}
+    on_stack: Set[LockNode] = set()
+    stack: List[LockNode] = []
+    sccs: List[List[LockNode]] = []
+    counter = [0]
+
+    def strongconnect(v: LockNode) -> None:
+        work = [(v, iter(sorted(graph[v], key=lambda n: n.label())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append(
+                        (w, iter(sorted(graph[w],
+                                        key=lambda x: x.label()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) >= 2:
+                    sccs.append(scc)
+
+    for v in sorted(graph, key=lambda n: n.label()):
+        if v not in index:
+            strongconnect(v)
+
+    out: List[Tuple[List[LockNode], ast.AST, str]] = []
+    for scc in sccs:
+        nodes = sorted(scc, key=lambda n: n.label())
+        site, path = None, None
+        for a in nodes:
+            for b in nodes:
+                if (a, b) in site_of:
+                    site, path = site_of[(a, b)]
+                    break
+            if site is not None:
+                break
+        out.append((nodes, site, path))
+    return out

@@ -1,8 +1,12 @@
-"""graftlint rule implementations JX001–JX017.
+"""graftlint rule implementations.
 
-Each rule is a function ``rule(info: ModuleInfo) -> list[Finding]``
-registered in ``RULES``.  Rules share the jit-scope + taint machinery in
-``analysis.py``; see ``tools/README.md`` for the catalog with rationale.
+Module-local rules JX001–JX017 are functions ``rule(info: ModuleInfo) ->
+list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
+machinery in ``analysis.py`` (memoized per module, so every rule runs off
+one parse and one tree walk).  The whole-program concurrency pack
+JX018–JX021 is registered in ``PROGRAM_RULES`` and runs once over the
+:class:`~tools.graftlint.program.ProgramModel` built from every linted
+module.  See ``tools/README.md`` for the catalog with rationale.
 """
 from __future__ import annotations
 
@@ -10,13 +14,14 @@ import ast
 import re
 from typing import Callable, Dict, List, Optional
 
-from .analysis import (ModuleInfo, TaintInfo, call_name, dotted_name,
-                       taint_function)
+from .analysis import ModuleInfo, TaintInfo, call_name, dotted_name
 from .core import Finding
+from .program import ProgramModel, find_lock_cycles, receiver_is_shared
 
-__all__ = ["RULES", "RULE_DOCS"]
+__all__ = ["RULES", "PROGRAM_RULES", "RULE_DOCS"]
 
 RULES: Dict[str, Callable[[ModuleInfo], List[Finding]]] = {}
+PROGRAM_RULES: Dict[str, Callable[[ProgramModel], List[Finding]]] = {}
 RULE_DOCS: Dict[str, str] = {}
 
 _HOT_FUNC_RE = re.compile(r"(^|_)(fit|train|step|epoch)", re.IGNORECASE)
@@ -30,13 +35,26 @@ def rule(code: str, doc: str):
     return deco
 
 
+def program_rule(code: str, doc: str):
+    def deco(fn):
+        PROGRAM_RULES[code] = fn
+        RULE_DOCS[code] = doc
+        return fn
+    return deco
+
+
 def _finding(info: ModuleInfo, node: ast.AST, code: str, msg: str) -> Finding:
     return Finding(path=info.path, line=getattr(node, "lineno", 1),
                    col=getattr(node, "col_offset", 0), rule=code, message=msg)
 
 
+def _finding_at(path: str, node: ast.AST, code: str, msg: str) -> Finding:
+    return Finding(path=path, line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), rule=code, message=msg)
+
+
 def _jit_scope_taints(info: ModuleInfo) -> Dict[ast.AST, TaintInfo]:
-    return {f: taint_function(info, f) for f in info.jit_scopes}
+    return {f: info.taint(f) for f in info.jit_scopes}
 
 
 def _in_loop_same_function(info: ModuleInfo, node: ast.AST) -> bool:
@@ -113,9 +131,7 @@ def jx003(info: ModuleInfo) -> List[Finding]:
     # pure-host modules have no device arrays to sync on
     if not (info.jax_aliases or info.jnp_aliases):
         return out
-    for func in ast.walk(info.tree):
-        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for func in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
         if not _HOT_FUNC_RE.search(func.name):
             continue
         loops = [n for n in ast.walk(func)
@@ -193,9 +209,7 @@ def _host_sync_kind(info: ModuleInfo, node: ast.Call) -> Optional[str]:
 @rule("JX004", "jax.jit called in a loop or invoked immediately (recompiles)")
 def jx004(info: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in info.nodes(ast.Call):
         # jax.jit(f)(args): a fresh compile-cache entry per outer call when
         # f is rebuilt each time; even when cached it re-hashes — hoist it.
         if isinstance(node.func, ast.Call) and info.is_jit_call(node.func):
@@ -218,8 +232,8 @@ def jx004(info: ModuleInfo) -> List[Finding]:
 @rule("JX005", "non-hashable static_argnums/static_argnames value")
 def jx005(info: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
-    for node in ast.walk(info.tree):
-        if not (isinstance(node, ast.Call) and info.is_jit_call(node)):
+    for node in info.nodes(ast.Call):
+        if not info.is_jit_call(node):
             continue
         for kw in node.keywords:
             if kw.arg not in ("static_argnums", "static_argnames"):
@@ -286,8 +300,8 @@ def jx006(info: ModuleInfo) -> List[Finding]:
 @rule("JX007", "bare `except:` swallows KeyboardInterrupt/SystemExit")
 def jx007(info: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
-    for node in ast.walk(info.tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
+    for node in info.nodes(ast.ExceptHandler):
+        if node.type is None:
             out.append(_finding(
                 info, node, "JX007",
                 "bare `except:` catches KeyboardInterrupt and SystemExit, "
@@ -300,10 +314,8 @@ def jx007(info: ModuleInfo) -> List[Finding]:
 @rule("JX008", "mutable default argument")
 def jx008(info: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
-    for node in ast.walk(info.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-            continue
+    for node in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda):
         for d in list(node.args.defaults) + [
                 d for d in node.args.kw_defaults if d is not None]:
             bad = None
@@ -328,9 +340,7 @@ def jx009(info: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
     if not (info.jax_aliases or info.jnp_aliases):
         return out
-    for func in ast.walk(info.tree):
-        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for func in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
         timers: List[ast.Call] = []
         uses_jax = False
         synced = False
@@ -440,7 +450,7 @@ def jx011(info: ModuleInfo) -> List[Finding]:
     # time.time() sample, including one-hop copies (now = time.time();
     # self._last = now)
     assigns: List = []
-    for node in ast.walk(info.tree):
+    for node in info.nodes(ast.Assign, ast.AnnAssign):
         targets = []
         if isinstance(node, ast.Assign):
             targets = node.targets
@@ -468,8 +478,8 @@ def jx011(info: ModuleInfo) -> List[Finding]:
         name = dotted_name(n)
         return name is not None and name in tracked
 
-    for node in ast.walk(info.tree):
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+    for node in info.nodes(ast.BinOp):
+        if isinstance(node.op, ast.Sub):
             # later-sample MINUS stored-sample = elapsed interval; the
             # right side must be a stored name (deadline math subtracts
             # a fresh call from a derived bound, which stays legal)
@@ -558,9 +568,7 @@ def jx012(info: ModuleInfo) -> List[Finding]:
     def device_names(func: Optional[ast.AST]) -> set:
         return _device_names(info, device_names_cache, func)
 
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in info.nodes(ast.Call):
         if info.in_jit_scope(node):
             continue
         if not _in_loop_same_function(info, node):
@@ -666,8 +674,8 @@ def jx013(info: ModuleInfo) -> List[Finding]:
            "process-global trace cache (nn/compile_cache.shared_jit)")
 
     # call form: jax.jit(f, ...) / jit(f) / partial(jax.jit, ...)
-    for node in ast.walk(info.tree):
-        if not (isinstance(node, ast.Call) and info.is_jit_call(node)):
+    for node in info.nodes(ast.Call):
+        if not info.is_jit_call(node):
             continue
         if enclosing_self_method(node) is None:
             continue
@@ -686,9 +694,7 @@ def jx013(info: ModuleInfo) -> List[Finding]:
                 break
 
     # decorator form: @jax.jit on a def nested inside a self-method
-    for node in ast.walk(info.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for node in info.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
         if not any(info.is_jit_ref(d) or info.is_jit_call(d)
                    for d in node.decorator_list):
             continue
@@ -780,9 +786,7 @@ def jx014(info: ModuleInfo) -> List[Finding]:
                 return kw.value.value
         return default
 
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in info.nodes(ast.Call):
         fname = call_name(node) or ""
         parts = fname.split(".")
         target = node.args[0] if node.args else None
@@ -837,9 +841,7 @@ def jx015(info: ModuleInfo) -> List[Finding]:
     if not (info.jax_aliases or info.jnp_aliases or info.deviceput_names):
         return out
     device_names_cache: Dict[Optional[ast.AST], set] = {}
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in info.nodes(ast.Call):
         if info.in_jit_scope(node):
             continue
         if not _in_loop_same_function(info, node):
@@ -900,9 +902,7 @@ def jx016(info: ModuleInfo) -> List[Finding]:
     Bound it with ``faulttolerance.RetryPolicy`` (budgeted, seeded
     exponential backoff) or an explicit deadline."""
     out: List[Finding] = []
-    for loop in ast.walk(info.tree):
-        if not isinstance(loop, ast.While):
-            continue
+    for loop in info.nodes(ast.While):
         test = loop.test
         if not (isinstance(test, ast.Constant) and test.value is True
                 or isinstance(test, ast.Constant) and test.value == 1):
@@ -977,19 +977,16 @@ def jx017(info: ModuleInfo) -> List[Finding]:
     # plus names bound by `from queue import Queue [as Q]`
     mod_aliases = set(_JX017_QUEUE_MODULES)
     bare_names = set()
-    for node in ast.walk(info.tree):
-        if isinstance(node, ast.Import):
+    for node in info.nodes(ast.Import):
+        for a in node.names:
+            if a.name in ("queue", "multiprocessing"):
+                mod_aliases.add(a.asname or a.name)
+    for node in info.nodes(ast.ImportFrom):
+        if node.module in ("queue", "multiprocessing"):
             for a in node.names:
-                if a.name in ("queue", "multiprocessing"):
-                    mod_aliases.add(a.asname or a.name)
-        elif isinstance(node, ast.ImportFrom):
-            if node.module in ("queue", "multiprocessing"):
-                for a in node.names:
-                    if a.name in _JX017_QUEUE_CLASSES:
-                        bare_names.add(a.asname or a.name)
-    for node in ast.walk(info.tree):
-        if not isinstance(node, ast.Call):
-            continue
+                if a.name in _JX017_QUEUE_CLASSES:
+                    bare_names.add(a.asname or a.name)
+    for node in info.nodes(ast.Call):
         fname = call_name(node) or ""
         parts = fname.split(".")
         is_queue_ctor = (
@@ -1008,6 +1005,191 @@ def jx017(info: ModuleInfo) -> List[Finding]:
             "host-memory growth under load — pass maxsize and shed or "
             "block at the bound (maxsize=0 spells deliberate "
             "unboundedness)"))
+    return _dedupe(out)
+
+
+# ===================================================================== #
+# Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
+# ProgramModel built from every linted module — see program.py for the   #
+# thread-entry / guarded-by / lock-order machinery they share.           #
+# ===================================================================== #
+
+
+# --------------------------------------------------------------------- JX018
+@program_rule("JX018", "shared attribute written from a background thread "
+                       "with inconsistent lock guarding")
+def jx018(program: ProgramModel) -> List[Finding]:
+    """For every class that spawns threads: an instance attribute written
+    from a thread-entry function and also accessed from the caller side
+    must be *consistently* guarded.  Fires at each unguarded mutation
+    (outside ``__init__``) when either (a) some other access of the same
+    attribute IS lock-guarded — the discipline exists, the mutation skips
+    it — or (b) the unguarded mutation is a read-modify-write
+    (``self.x += 1``), which loses updates under any interleaving
+    regardless of discipline.  Lock/queue/event-typed attributes are
+    internally synchronized and exempt; plain single assignments with no
+    guard evidence anywhere stay legal (flag-style publication).
+
+    HTTP-handler classes get a second arm: the framework runs one
+    handler instance per connection, so ``self`` is private but the
+    server reference every request shares is not — an unguarded
+    ``srv.counter += 1`` there loses updates across concurrent
+    requests.  Receivers built fresh in the function (parsers, local
+    accumulators) are single-threaded and stay legal."""
+    out: List[Finding] = []
+    for cls in program.classes:
+        if cls.is_handler:
+            for target, held, func in cls.foreign_augs:
+                if held or not receiver_is_shared(func, target):
+                    continue
+                recv = dotted_name(target.value) or "?"
+                out.append(_finding_at(
+                    cls.path, target, "JX018",
+                    f"unguarded read-modify-write to "
+                    f"`{recv}.{target.attr}` in handler `{cls.name}`: "
+                    "request handlers run one thread per connection, and "
+                    f"`{recv}` is shared server state — concurrent "
+                    "requests lose updates; guard the counter with a "
+                    "lock on the server object"))
+        if not cls.entry_funcs:
+            continue
+        for attr in sorted(cls.attrs()):
+            if attr in cls.lock_attrs or attr in cls.safe_attrs:
+                continue
+            acc = [a for a in cls.accesses if a.attr == attr]
+            writes = [a for a in acc if a.write and not a.in_init]
+            entry_writes = [w for w in writes if w.func in cls.entry_funcs]
+            if not entry_writes:
+                continue
+            outside = [a for a in acc
+                       if a.func not in cls.entry_funcs and not a.in_init]
+            if not outside:
+                continue           # thread-private state
+            guarded = [a for a in acc if a.held]
+            unguarded_muts = [w for w in writes if not w.held]
+            if not guarded:
+                # no discipline to be inconsistent WITH: only the
+                # always-unsafe read-modify-writes fire
+                unguarded_muts = [w for w in unguarded_muts if w.aug]
+            if not unguarded_muts:
+                continue
+            guards = sorted({lk for a in guarded for lk in a.held})
+            for w in unguarded_muts:
+                how = ("read-modify-write" if w.aug else
+                       "item write" if w.subscript else "write")
+                why = (f"other accesses hold self.{guards[0]}"
+                       if guards else
+                       "a concurrent increment loses updates")
+                out.append(_finding_at(
+                    cls.path, w.node, "JX018",
+                    f"unguarded {how} to `self.{attr}` in "
+                    f"`{cls.name}`: the attribute is written from a "
+                    f"thread-entry function and read from other threads, "
+                    f"but this mutation holds no lock ({why}) — guard "
+                    "every access with one lock, or make the attribute a "
+                    "thread-safe primitive / registry metric"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX019
+@program_rule("JX019", "non-daemon background thread started but never "
+                       "joined on any shutdown/close/__exit__ path")
+def jx019(program: ProgramModel) -> List[Finding]:
+    """A non-daemon thread (``daemon=`` unset or False) that is
+    ``start()``-ed but has no ``join()`` (or Timer ``cancel()``) anywhere
+    on the owning class — or, for a function-local thread, in the
+    creating function — keeps the interpreter alive after main exits and
+    leaks a runner that can keep mutating shared state after its owner
+    is logically gone.  Threads handed to the caller (returned, passed
+    on, stored in containers) are the caller's to join and stay legal,
+    as do ``executor.submit`` tasks (the executor owns their
+    lifecycle)."""
+    out: List[Finding] = []
+    spawns = [(cls.path, cls, s)
+              for cls in program.classes for s in cls.spawns]
+    spawns += [(info.path, None, s) for info, s in program.module_spawns]
+    for path, cls, s in spawns:
+        if s.kind == "submit":
+            continue
+        if s.daemon:
+            continue
+        if not s.started or s.joined:
+            continue
+        if s.self_attr is None and s.escapes:
+            continue
+        where = (f"self.{s.self_attr}" if s.self_attr is not None
+                 else s.binding or "an unbound handle")
+        cleanup = "join()" if s.kind != "timer" else "cancel()/join()"
+        out.append(_finding_at(
+            path, s.node, "JX019",
+            f"non-daemon {s.kind} ({where}) started but never joined: "
+            "no shutdown/close/__exit__ path calls "
+            f"{cleanup}, so process exit hangs on it and the runner can "
+            "outlive its owner — join it on the teardown path, or mark "
+            "it daemon=True if it owns no in-flight state"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX020
+@program_rule("JX020", "lock-order cycle across nested acquisitions "
+                       "(potential deadlock)")
+def jx020(program: ProgramModel) -> List[Finding]:
+    """Acquiring lock B while holding lock A orders A before B.  If the
+    program's lock-order graph — nested ``with`` scopes plus one-hop
+    calls into methods that acquire locks (same-class and
+    constructor-typed attributes) — contains a cycle, two threads
+    entering the cycle from different sides deadlock.  One finding per
+    cycle, anchored at one participating acquisition."""
+    out: List[Finding] = []
+    for nodes, site, path in find_lock_cycles(program.lock_edges()):
+        labels = [n.label() for n in nodes]
+        out.append(_finding_at(
+            path, site, "JX020",
+            "lock-order cycle: " + " -> ".join(labels + [labels[0]])
+            + " — two threads taking these locks in opposite orders "
+            "deadlock; impose one global acquisition order (or collapse "
+            "to a single lock)"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX021
+@program_rule("JX021", "check-then-act on a shared container outside its "
+                       "inferred guard")
+def jx021(program: ProgramModel) -> List[Finding]:
+    """``if k in self._d: ... self._d[k]`` is two operations; between
+    them another thread can remove the key (KeyError) or replace the
+    value.  Fires when the container attribute HAS an inferred lock
+    guard (so the class does practice locking around it) but the
+    check-then-act sequence runs without it.  Also fires on
+    ``qsize()``/``empty()``-gated ``get`` in thread-spawning classes:
+    the queue's internal lock makes each call atomic but not the pair —
+    a sibling consumer wins the race and the gated ``get`` blocks
+    forever.  Use ``with lock:`` around the pair, ``dict.get``/``pop``
+    with a default, or ``get_nowait`` + ``except Empty``."""
+    out: List[Finding] = []
+    for cls in program.classes:
+        for node, kind, target, key, held in cls.check_then_act:
+            if kind == "membership":
+                guards = cls.guards(target)
+                if not guards or held & guards:
+                    continue
+                out.append(_finding_at(
+                    cls.path, node, "JX021",
+                    f"check-then-act on `self.{target}` outside its "
+                    f"inferred guard (self.{sorted(guards)[0]}): the key "
+                    "can vanish between the membership test and the "
+                    "access — hold the guard across the pair, or use "
+                    ".get()/.pop() with a default"))
+            else:
+                if not cls.entry_funcs:
+                    continue
+                out.append(_finding_at(
+                    cls.path, node, "JX021",
+                    f"`{target}.qsize()/.empty()`-gated get: the check "
+                    "and the get are two operations, and a sibling "
+                    "consumer can drain the queue between them, blocking "
+                    "this get forever — use get_nowait() and handle "
+                    "queue.Empty"))
     return _dedupe(out)
 
 
