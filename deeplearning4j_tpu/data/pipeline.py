@@ -199,6 +199,15 @@ class DevicePrefetchIterator(DataSetIterator):
 
     def _run(self):
         etl_h, depth_g, starved_c = _etl_instruments(self._registry)
+        # per-stage children resolved once, off the per-batch path (JX022)
+        if etl_h is not None:
+            src_h, h2d_h, wait_h = (etl_h.labels("source"),
+                                    etl_h.labels("h2d"),
+                                    etl_h.labels("wait"))
+            depth_dev = depth_g.labels("device")
+            starved_dev = starved_c.labels("device")
+        else:
+            src_h = h2d_h = wait_h = depth_dev = starved_dev = None
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
         err: List[BaseException] = []
@@ -227,15 +236,15 @@ class DevicePrefetchIterator(DataSetIterator):
                     # host-side cost, the transfer overlaps the in-flight step
                     placed = self._place(ds)
                     t2 = monotonic_s()
-                    if etl_h is not None:
-                        etl_h.labels("source").observe(t1 - t0)
-                        etl_h.labels("h2d").observe(t2 - t1)
+                    if src_h is not None:
+                        src_h.observe(t1 - t0)
+                        h2d_h.observe(t2 - t1)
                     if placed is None:
                         continue
                     if not _put(placed):
                         return                 # consumer went away
-                    if depth_g is not None:
-                        depth_g.labels("device").set(q.qsize())
+                    if depth_dev is not None:
+                        depth_dev.set(q.qsize())
             except BaseException as e:  # noqa: BLE001 - relayed to consumer
                 err.append(e)
             finally:
@@ -249,14 +258,14 @@ class DevicePrefetchIterator(DataSetIterator):
             while True:
                 # the very first get is empty by construction (producer
                 # warm-up), not a starvation signal
-                if starved_c is not None and q.empty() and not first_get:
-                    starved_c.labels("device").inc()
+                if starved_dev is not None and q.empty() and not first_get:
+                    starved_dev.inc()
                 first_get = False
                 t0 = monotonic_s()
                 item = q.get()
-                if etl_h is not None:
-                    etl_h.labels("wait").observe(monotonic_s() - t0)
-                    depth_g.labels("device").set(q.qsize())
+                if wait_h is not None:
+                    wait_h.observe(monotonic_s() - t0)
+                    depth_dev.set(q.qsize())
                 if item is self._SENTINEL:
                     break
                 yield item
@@ -482,6 +491,14 @@ class MultiprocessETLIterator(DataSetIterator):
     def _run(self):
         from multiprocessing import shared_memory
         etl_h, depth_g, starved_c = _etl_instruments(self._registry)
+        # per-stage children resolved once, off the per-batch path (JX022)
+        if etl_h is not None:
+            ring_h = etl_h.labels("ring")
+            transform_h = etl_h.labels("transform")
+            depth_ring = depth_g.labels("ring")
+            starved_ring = starved_c.labels("ring")
+        else:
+            ring_h = transform_h = depth_ring = starved_ring = None
         ctx = multiprocessing.get_context("spawn")
         slot_bytes = self._probe_slot_bytes()
         n_slots = self.num_workers * self.slots_per_worker
@@ -523,9 +540,9 @@ class MultiprocessETLIterator(DataSetIterator):
                         break
                     # at most one starvation event per awaited batch, not
                     # one per 0.5 s poll cycle
-                    if (starved_c is not None and not starved_counted
+                    if (starved_ring is not None and not starved_counted
                             and result_q.empty()):
-                        starved_c.labels("ring").inc()
+                        starved_ring.inc()
                         starved_counted = True
                     t0 = monotonic_s()
                     try:
@@ -541,8 +558,8 @@ class MultiprocessETLIterator(DataSetIterator):
                                 "multiprocessing spawn re-imports the main "
                                 "module (see the worker stderr above)")
                         continue
-                    if etl_h is not None:
-                        etl_h.labels("ring").observe(monotonic_s() - t0)
+                    if ring_h is not None:
+                        ring_h.observe(monotonic_s() - t0)
                     kind = msg[0]
                     if kind == "done":
                         done += 1
@@ -551,14 +568,14 @@ class MultiprocessETLIterator(DataSetIterator):
                         stop_evt.set()
                     else:
                         buffer[msg[1]] = msg
-                        if depth_g is not None:
-                            depth_g.labels("ring").set(len(buffer))
+                        if depth_ring is not None:
+                            depth_ring.set(len(buffer))
                 if next_seq not in buffer:
                     break
                 kind, seq, wid, slot, etl_s, payload = buffer.pop(next_seq)
-                if etl_h is not None:
-                    etl_h.labels("transform").observe(etl_s)
-                    depth_g.labels("ring").set(len(buffer))
+                if transform_h is not None:
+                    transform_h.observe(etl_s)
+                    depth_ring.set(len(buffer))
                 if kind == "slab":
                     shm = shms[wid * self.slots_per_worker + slot]
                     arrays = {}

@@ -152,6 +152,7 @@ class ShapePolicy:
             if time_buckets else None
         self.max_buckets = int(max_buckets) if max_buckets else int(
             os.environ.get("DL4J_TPU_SHAPE_BUCKET_CAP", "16"))
+        self.last_pad_ratio = 1.0
         # fixed cost overrides (tests / operators); None = live estimate
         # from the metrics registry with env-default priors
         self._compile_cost_s = compile_cost_s
@@ -185,6 +186,10 @@ class ShapePolicy:
                         ("path",)).labels(path).inc()
 
     def _note_ratio(self, path: str, ratio: float) -> None:
+        # cheap host-side copy of the most recent padded/real ratio: the
+        # health monitor's padding-drift detector reads it per step
+        # without a registry round-trip
+        self.last_pad_ratio = float(ratio)
         reg = self._registry()
         if reg.enabled:
             reg.histogram("training_padding_ratio",

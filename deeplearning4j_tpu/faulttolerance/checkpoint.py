@@ -557,6 +557,9 @@ class FitCheckpointer:
         self._preempted = False
         self._old_handler = None
         self.preempt_saved: Optional[str] = None
+        # set by the fit loop's _StepForensics: flushes buffered step
+        # records into the flight recorder before a preemption dump
+        self.pre_dump = None
         if self.manager is not None and config.save_on_preempt:
             import signal
             try:
@@ -568,6 +571,25 @@ class FitCheckpointer:
 
     def _on_sigterm(self, signum, frame):
         self._preempted = True
+
+    def _dump_preempt(self) -> None:
+        """Commit the flight-recorder window next to the preemption
+        checkpoint: the final-seconds forensics (recent steps, spans,
+        metric snapshots) that explain what the run was doing when the
+        scheduler pulled it.  Best-effort — the preemption save itself
+        must never be jeopardized by a forensics write."""
+        from ..observability.recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        if rec is None or not rec.enabled:
+            return
+        try:
+            if self.pre_dump is not None:
+                self.pre_dump()   # drain buffered step records first
+            rec.record("train", "preempted", saved=self.preempt_saved,
+                       iteration=int(self.net.iteration))
+            rec.dump("preempt", directory=self.manager.directory)
+        except Exception:
+            pass
 
     def _save(self, fit_epoch: int, batch_seq: int,
               blocking: bool = False) -> str:
@@ -596,6 +618,7 @@ class FitCheckpointer:
         if self._preempted:
             self.preempt_saved = self._save(fit_epoch, batch_seq,
                                             blocking=True)
+            self._dump_preempt()
             return True
         return False
 
@@ -616,6 +639,7 @@ class FitCheckpointer:
             self._save(fit_epoch + 1, 0)
         if self._preempted:
             self.preempt_saved = self._save(fit_epoch + 1, 0, blocking=True)
+            self._dump_preempt()
             return True
         return False
 

@@ -50,6 +50,16 @@ __all__ = ["FaultInjector", "InjectedWorkerFault", "RetryPolicy",
            "ChaosSchedule", "ChaosBroker"]
 
 
+def _record_fault(type: str, **fields) -> None:
+    """Mirror an injected fault into the flight recorder's ``cluster``
+    channel — a chaos soak's dump shows the faults interleaved with the
+    heartbeats and evictions they caused."""
+    from ..observability.recorder import get_flight_recorder
+    rec = get_flight_recorder()
+    if rec is not None:
+        rec.record("cluster", type, **fields)
+
+
 class InjectedWorkerFault(RuntimeError):
     """Raised by FaultInjector in a worker's execution path."""
 
@@ -109,16 +119,20 @@ class FaultInjector:
         delay = self._delay.get(key)
         if delay:
             self.events.append(("delay", worker, rnd))
+            _record_fault("injected_delay", worker=worker, round=rnd,
+                          seconds=delay)
             time.sleep(delay)
         n = self._fail.get(key, 0)
         if n != 0:
             if n > 0:
                 self._fail[key] = n - 1
             self.events.append(("fail", worker, rnd))
+            _record_fault("injected_fail", worker=worker, round=rnd)
             self.last_fault_s[worker] = monotonic_s()
             raise InjectedWorkerFault(worker, rnd, "failure")
         if self.fail_rate and self._rng.random() < self.fail_rate:
             self.events.append(("fail", worker, rnd))
+            _record_fault("injected_fail", worker=worker, round=rnd)
             self.last_fault_s[worker] = monotonic_s()
             raise InjectedWorkerFault(worker, rnd, "random failure")
         self._mark_recovered(worker, rnd)
@@ -324,6 +338,15 @@ class ChaosSchedule:
                 os.kill(pid, signal.SIGKILL)
                 with self._lock:
                     self.events.append(("kill", worker, pid, after_s))
+                # the killing side is the one that survives to dump: the
+                # chaos fault lands on the cluster channel alongside the
+                # victim's final heartbeats
+                _record_fault("chaos_kill", worker=worker, pid=pid,
+                              after_s=after_s)
+                from ..observability.recorder import get_flight_recorder
+                rec = get_flight_recorder()
+                if rec is not None:
+                    rec.maybe_dump("chaos_fault")
             except (OSError, ProcessLookupError):
                 with self._lock:
                     self.events.append(("kill_miss", worker, after_s))

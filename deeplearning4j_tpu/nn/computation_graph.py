@@ -31,6 +31,7 @@ from .conf.computation_graph import (ComputationGraphConfiguration,
 from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
 from ..data.shapes import default_shape_policy
+from ..observability.clock import monotonic_s
 from ..train.listeners import TrainingListener
 
 Array = jax.Array
@@ -246,6 +247,8 @@ class ComputationGraph:
         self.last_batch_size = 0
         self.listeners: List[TrainingListener] = []
         self._score = float("nan")
+        self._last_grad_stats = None
+        self._last_step_traced = False
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
         # instance view over the process-global trace cache (compile_cache)
@@ -444,6 +447,8 @@ class ComputationGraph:
             self.params, self.state, self.opt_state, key, xs, ys, ms, lms)
         self._score = float(loss)
         self._last_grad_stats = gstats
+        self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
+                                              False))
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
@@ -498,6 +503,14 @@ class ComputationGraph:
         if checkpoint is not None or resume_from is not None:
             from ..faulttolerance.checkpoint import FitCheckpointer
             ckpt = FitCheckpointer(self, checkpoint, resume_from)
+        from ..observability.health import get_health_monitor
+        from ..observability.recorder import get_flight_recorder
+        from .multilayer import _StepForensics
+        rec = get_flight_recorder()
+        rec_on = rec is not None and rec.enabled
+        mon = get_health_monitor()
+        forensics = _StepForensics(self, rec, mon, ckpt) \
+            if (rec_on or mon is not None) else None
         start_epoch = ckpt.start_epoch if ckpt is not None else 0
         stop = False
         try:
@@ -513,8 +526,15 @@ class ComputationGraph:
                     if seq < skip:
                         seq += 1
                         continue
+                    t_step = monotonic_s()
                     self._fit_one(*batch)
                     seq += 1
+                    t_end = monotonic_s()
+                    if forensics is not None and forensics.step(
+                            ep, seq, self._last_step_traced,
+                            t_end - t_step, t_end):
+                        stop = True   # opt-in health stop: clean return
+                        break
                     if ckpt is not None and ckpt.after_batch(ep, seq):
                         stop = True   # SIGTERM: final save taken
                         break
@@ -526,7 +546,28 @@ class ComputationGraph:
                 if ckpt is not None and ckpt.after_epoch(ep):
                     stop = True
                     break
+        except Exception as e:
+            if rec_on:   # crash forensics before the exception propagates
+                if forensics is not None:
+                    try:
+                        forensics.flush()
+                    except Exception:
+                        pass   # forensics must not mask the real error
+                rec.record("train", "fit_exception",
+                           error=f"{type(e).__name__}: {e}",
+                           iteration=int(self.iteration))
+                rec.maybe_dump(
+                    "fit_exception",
+                    directory=(ckpt.manager.directory
+                               if ckpt is not None and ckpt.manager
+                               is not None else None))
+            raise
         finally:
+            if forensics is not None:
+                try:
+                    forensics.flush()
+                except Exception:
+                    pass
             if ckpt is not None:
                 ckpt.close()
         return self

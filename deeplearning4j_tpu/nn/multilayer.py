@@ -42,7 +42,7 @@ from .conf.updaters import Sgd, UpdaterConf
 from .layers.base import BaseLayerConf
 from ..data.pipeline import ETL_BUCKETS as _ETL_BUCKETS
 from ..data.shapes import _pad_time, default_shape_policy
-from ..observability.clock import monotonic_s
+from ..observability.clock import monotonic_s, wall_s
 from ..observability.registry import default_registry
 from ..train.listeners import TrainingListener
 
@@ -59,6 +59,121 @@ def _on_device(a):
     if a is None or isinstance(a, jax.Array):
         return a
     return jnp.asarray(a)
+
+
+class _StepForensics:
+    """Per-step flight-recorder + health-monitor feed for the fit loops
+    (shared by MultiLayerNetwork and ComputationGraph), amortized.
+
+    Processing a step — a recorder dict build plus the monitor's EWMA
+    updates — is only a few microseconds warm, but the train loop runs
+    that Python cache-cold right after each multi-ms XLA dispatch, which
+    inflates every call ~4x and blows the <2% overhead budget on small
+    steps.  So :meth:`step` only captures a raw tuple (and, every
+    ``grad_check_every``-th step, a *reference* to the still-on-device
+    grad stats — the host fetch is deferred too) and :meth:`flush`
+    drains the buffer through ``record()``/``observe_step()`` in a tight
+    warm loop every ``FLUSH_EVERY`` steps.  A non-finite loss flushes
+    IMMEDIATELY, so NaN detection and its checkpoint/stop reaction keep
+    per-step latency; the statistical detectors see the identical
+    stream a few steps late.  Every dump path flushes first: the fit
+    loops flush on exception and in their ``finally``, and the
+    checkpointer's preemption dump calls the ``pre_dump`` hook this
+    helper installs — buffered steps can never miss an artifact."""
+
+    FLUSH_EVERY = 16
+    __slots__ = ("net", "rec", "ring", "mon", "ckpt", "pol", "_buf",
+                 "_grad_every", "_wall0", "_saved_kinds")
+
+    def __init__(self, net, rec, mon, ckpt):
+        self.net = net
+        self.rec = rec if (rec is not None and rec.enabled) else None
+        self.ring = self.rec.channel("train") \
+            if self.rec is not None else None
+        self.mon = mon
+        self.ckpt = ckpt
+        pol = getattr(net, "shape_policy", None)
+        self.pol = pol if hasattr(pol, "last_pad_ratio") else None
+        self._grad_every = mon.config.grad_check_every \
+            if mon is not None else 0
+        # wall = mono + _wall0: record timestamps derive from the step
+        # end the loop already clocked, saving a wall read per step
+        self._wall0 = wall_s() - monotonic_s()
+        self._buf: list = []
+        self._saved_kinds: set = set()
+        if ckpt is not None:
+            ckpt.pre_dump = self.flush
+
+    def step(self, ep: int, seq: int, compile_step: bool,
+             dt: float, t_end: float) -> bool:
+        """Capture one fitted step (``t_end`` = the loop's monotonic
+        step-end read); returns True when the monitor's opt-in
+        ``stop_training`` policy says to halt the fit."""
+        net = self.net
+        loss = net._score
+        every = self._grad_every
+        pol = self.pol
+        buf = self._buf
+        buf.append(
+            (t_end, net.iteration, ep, seq, net.last_batch_size,
+             loss, dt, compile_step,
+             net._last_grad_stats
+             if every > 0 and net.iteration % every == 0 else None,
+             pol.last_pad_ratio if pol is not None else None))
+        # loss - loss is 0.0 for finite loss, NaN for nan/±inf: the
+        # non-finite check without a function call
+        if len(buf) >= self.FLUSH_EVERY or loss - loss != 0.0:
+            return self.flush()
+        return False
+
+    def flush(self) -> bool:
+        """Drain buffered steps into the recorder ring and the monitor;
+        returns the monitor's stop verdict."""
+        buf = self._buf
+        mon = self.mon
+        if not buf:
+            return mon.should_stop() if mon is not None else False
+        self._buf = []
+        rec, ckpt, ring = self.rec, self.ckpt, self.ring
+        wall0 = self._wall0
+        for t_end, it, ep, seq, bs, loss, dt, comp, gref, pad in buf:
+            if ring is not None:
+                # literal-dict append onto the hoisted ring: same record
+                # shape record() builds, minus the wrapper overhead
+                ring.append({"ts": wall0 + t_end, "type": "step",
+                             "iteration": it, "epoch": ep, "score": loss,
+                             "batch": bs, "step_s": round(dt, 6),
+                             "compile": comp})
+            if mon is None:
+                continue
+            grad_norm = None
+            if gref is not None:
+                try:
+                    grad_norm = float(gref["global_norm"])
+                except (KeyError, TypeError, ValueError):
+                    grad_norm = None
+            eps = bs / dt if dt > 0 and not comp else None
+            detections = mon.observe_step(
+                loss=loss, grad_norm=grad_norm, examples_per_sec=eps,
+                padding_ratio=pad, step=it)
+            if detections and ckpt is not None and \
+                    mon.config.checkpoint_on_detection and \
+                    ckpt.manager is not None and \
+                    any(d.kind not in self._saved_kinds
+                        for d in detections):
+                self._saved_kinds.update(d.kind for d in detections)
+                try:
+                    # ONE immediate save per detection kind marks the
+                    # incident step durably without letting a sticky NaN
+                    # (re-detected every dedupe_s) rotate the manager's
+                    # keep_last window past every pre-incident checkpoint
+                    ckpt._save(ep, seq)
+                    mon.checkpoint_saves += 1
+                except Exception:
+                    pass   # a failed emergency save must not kill the fit
+        if rec is not None:
+            rec.snapshot_metrics()   # internally time-throttled
+        return mon.should_stop() if mon is not None else False
 
 Array = jax.Array
 
@@ -657,6 +772,16 @@ class MultiLayerNetwork:
         # reduces all of it to one bool check)
         reg = default_registry()
         obs = reg.enabled
+        # runtime forensics: the flight recorder keeps the recent-step
+        # window for crash dumps; the health monitor (when installed)
+        # watches the step signals for NaNs/spikes/throughput collapse
+        from ..observability.health import get_health_monitor
+        from ..observability.recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        rec_on = rec is not None and rec.enabled
+        mon = get_health_monitor()
+        forensics = _StepForensics(self, rec, mon, ckpt) \
+            if (rec_on or mon is not None) else None
         if obs:
             steps_c = reg.counter("training_steps_total",
                                   "Optimizer steps taken")
@@ -666,10 +791,12 @@ class MultiLayerNetwork:
                 "training_step_seconds",
                 "Train step wall time, split compile vs steady",
                 ("phase",), buckets=_STEP_BUCKETS)
-            etl_h = reg.histogram(
+            etl_fetch_h = reg.histogram(
                 "training_etl_seconds",
                 "Time blocked on the data pipeline per batch, by stage",
-                ("stage",), buckets=_ETL_BUCKETS)
+                ("stage",), buckets=_ETL_BUCKETS).labels("fetch")
+            step_compile_h = step_h.labels("compile")
+            step_steady_h = step_h.labels("steady")
         steady_examples, steady_s = 0, 0.0
         start_epoch = ckpt.start_epoch if ckpt is not None else 0
         stop = False
@@ -707,17 +834,23 @@ class MultiLayerNetwork:
                     else:
                         self._fit_one(x, y, m, lm)
                     compile_step = self._last_step_traced
+                    t_end = monotonic_s()
+                    dt = t_end - t_step
                     if obs:
-                        dt = monotonic_s() - t_step
-                        step_h.labels("compile" if compile_step
-                                      else "steady").observe(dt)
-                        etl_h.labels("fetch").observe(self.last_etl_ms / 1e3)
+                        (step_compile_h if compile_step
+                         else step_steady_h).observe(dt)
+                        etl_fetch_h.observe(self.last_etl_ms / 1e3)
                         steps_c.inc()
                         examples_c.inc(self.last_batch_size)
                         if not compile_step:
                             steady_examples += self.last_batch_size
                             steady_s += dt
                     seq += 1
+                    if forensics is not None and \
+                            forensics.step(ep, seq, compile_step, dt,
+                                           t_end):
+                        stop = True   # opt-in health stop: clean return
+                        break
                     if ckpt is not None and ckpt.after_batch(ep, seq):
                         stop = True   # SIGTERM: final save taken — return
                         break
@@ -729,7 +862,31 @@ class MultiLayerNetwork:
                 if ckpt is not None and ckpt.after_epoch(ep):
                     stop = True
                     break
+        except Exception as e:
+            # unhandled fit exception: commit the flight-recorder window
+            # BEFORE propagating — the artifact that explains the crash
+            # must exist even if the process dies on the way up
+            if rec_on:
+                if forensics is not None:
+                    try:
+                        forensics.flush()
+                    except Exception:
+                        pass   # forensics must not mask the real error
+                rec.record("train", "fit_exception",
+                           error=f"{type(e).__name__}: {e}",
+                           iteration=int(self.iteration))
+                rec.maybe_dump(
+                    "fit_exception",
+                    directory=(ckpt.manager.directory
+                               if ckpt is not None and ckpt.manager
+                               is not None else None))
+            raise
         finally:
+            if forensics is not None:
+                try:
+                    forensics.flush()
+                except Exception:
+                    pass
             if ckpt is not None:
                 ckpt.close()
         if obs and steady_s > 0:

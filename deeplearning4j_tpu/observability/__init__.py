@@ -20,7 +20,15 @@ OpProfiler, UI stats storage — SURVEY §5):
 - :mod:`quantiles` — sliding-window exact quantiles (``LatencyWindow``),
   the live p50/p99 read the serving tier's SLO admission control gates
   on (registry histograms answer scrape-interval questions, not
-  "what is the p99 right now").
+  "what is the p99 right now");
+- :mod:`recorder` — the flight recorder: bounded ring buffers of recent
+  spans/events/metric snapshots per subsystem channel, dumped as atomic
+  checksummed JSON artifacts on crashes, preemptions, evictions, and
+  SLO breaches (``/debug/flightrecorder`` on both HTTP servers);
+- :mod:`health` — streaming anomaly detection (NaN loss/grads, EWMA
+  spike, throughput regression, padding drift, serving p99/shed-rate)
+  that flips ``/health`` to ``degraded``, can trigger an immediate
+  checkpoint save, and (opt-in) stops training.
 
 Cost model: METRICS are on by default (the registry is plain host
 arithmetic — serving ``/metrics`` and the training counters work out of
@@ -34,20 +42,29 @@ from __future__ import annotations
 from .clock import monotonic_s, wall_s
 from .events import EventLog, configure_event_log, emit_event, get_event_log
 from .exposition import CONTENT_TYPE, escape_label_value, render_text
-from .quantiles import LatencyWindow
+from .health import (Detection, HealthConfig, HealthMonitor,
+                     HealthTermination, get_health_monitor,
+                     set_health_monitor)
+from .quantiles import LatencyWindow, bucket_quantile
+from .recorder import (FlightRecorder, get_flight_recorder, load_dump,
+                       set_flight_recorder)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, default_registry,
                        set_default_registry)
 from .tracer import Span, SpanContext, Tracer, get_tracer, set_default_tracer
 
 __all__ = [
-    "CONTENT_TYPE", "Counter", "DEFAULT_BUCKETS", "EventLog", "Gauge",
-    "Histogram", "LatencyWindow", "MetricsListener", "MetricsRegistry",
-    "Span",
-    "SpanContext", "Tracer", "configure_event_log", "default_registry",
-    "emit_event", "escape_label_value", "get_event_log", "get_tracer",
+    "CONTENT_TYPE", "Counter", "DEFAULT_BUCKETS", "Detection", "EventLog",
+    "FlightRecorder", "Gauge", "HealthConfig", "HealthMonitor",
+    "HealthTermination", "Histogram", "LatencyWindow", "MetricsListener",
+    "MetricsRegistry", "Span",
+    "SpanContext", "Tracer", "bucket_quantile", "configure_event_log",
+    "default_registry",
+    "emit_event", "escape_label_value", "get_event_log",
+    "get_flight_recorder", "get_health_monitor", "get_tracer", "load_dump",
     "monotonic_s", "render_text", "set_default_registry",
-    "set_default_tracer", "wall_s",
+    "set_default_tracer", "set_flight_recorder", "set_health_monitor",
+    "wall_s",
 ]
 
 

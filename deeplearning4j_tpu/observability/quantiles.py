@@ -14,10 +14,40 @@ Thread-safe: request threads observe, the health/admission path reads.
 """
 from __future__ import annotations
 
+import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["LatencyWindow"]
+__all__ = ["LatencyWindow", "bucket_quantile"]
+
+
+def bucket_quantile(cumulative: Sequence[Tuple[float, int]],
+                    q: float) -> Optional[float]:
+    """Nearest-rank quantile from cumulative histogram buckets
+    ``[(upper_bound, cumulative_count), ...]`` (the
+    ``Histogram.cumulative_buckets()`` shape, ending at ``(+Inf, n)``).
+
+    Returns the upper bound of the bucket containing the rank — an upper
+    estimate whose error is bounded by the bucket width, the same answer
+    Prometheus' ``histogram_quantile`` gives at the bucket edge.  The
+    ``+Inf`` bucket clamps to the largest finite bound (there is no
+    meaningful upper edge beyond it).  None while the histogram is empty.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    if not cumulative:
+        return None
+    total = cumulative[-1][1]
+    if total <= 0:
+        return None
+    rank = max(1, math.ceil(q * total))
+    largest_finite = None
+    for bound, count in cumulative:
+        if bound != float("inf"):
+            largest_finite = bound
+        if count >= rank:
+            return bound if bound != float("inf") else largest_finite
+    return largest_finite
 
 
 class LatencyWindow:

@@ -317,18 +317,27 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view of every time series (the /metrics?format=json
-        payload and the offline-analysis sidecar of the Prometheus text)."""
+        payload and the offline-analysis sidecar of the Prometheus text).
+        Histogram samples carry derived ``p50``/``p99`` summaries
+        (nearest-rank over the bucket counts — an upper estimate bounded
+        by bucket width) so dashboards consuming the JSON exposition
+        don't re-implement quantile math; the Prometheus text format is
+        unchanged."""
+        from .quantiles import bucket_quantile
         out: Dict[str, Any] = {}
         for m in self.collect():
             samples = []
             for values, child in m.samples():
                 labels = dict(zip(m.labelnames, values))
                 if m.kind == "histogram":
+                    cum = child.cumulative_buckets()
                     samples.append({
                         "labels": labels,
                         "buckets": [[b if b != float("inf") else "+Inf", c]
-                                    for b, c in child.cumulative_buckets()],
-                        "sum": child.sum, "count": child.count})
+                                    for b, c in cum],
+                        "sum": child.sum, "count": child.count,
+                        "p50": bucket_quantile(cum, 0.50),
+                        "p99": bucket_quantile(cum, 0.99)})
                 else:
                     samples.append({"labels": labels, "value": child.value})
             out[m.name] = {"type": m.kind, "help": m.help,

@@ -257,6 +257,12 @@ class Tracer:
                .labels(sp.name).observe(sp.duration_s)
         if self._event_log is not None:
             self._event_log.emit("span", **sp.to_dict())
+        # finished spans also land in the flight recorder's span ring so
+        # a crash dump carries the recent execution timeline
+        from .recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        if rec is not None:
+            rec.record_span(sp)
 
 
 # env opt-in: DL4J_TPU_TRACE=1 enables the default tracer at import time

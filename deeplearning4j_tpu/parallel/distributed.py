@@ -17,6 +17,7 @@ from typing import Callable, Iterable, Optional
 import jax
 
 from ..observability.clock import monotonic_s
+from ..observability.recorder import get_flight_recorder
 
 __all__ = ["initialize_distributed", "global_device_mesh", "ElasticTrainer"]
 
@@ -178,6 +179,14 @@ class ElasticTrainer:
         lost = set(old_view.members) - set(new_view.members)
         if not lost:
             return
+        rec = get_flight_recorder()
+        if rec is not None:
+            # membership transition forensics: who fell out, at which
+            # generation, and how many orphaned batches this member holds
+            rec.record("cluster", "members_lost",
+                       lost=sorted(lost),
+                       generation=int(new_view.generation),
+                       window=len(window))
         me = self.member.worker_id
         keep = []
         for index, batch, owner, t in window:
@@ -253,6 +262,11 @@ class ElasticTrainer:
                 if self._owns(done, view):
                     self.model.fit_batch(batch)
                     self.trained_steps += 1
+                    rec = get_flight_recorder()
+                    if rec is not None:
+                        rec.record("train", "elastic_step", step=done,
+                                   worker=(None if self.member is None
+                                           else self.member.worker_id))
                 elif window is not None:
                     now = monotonic_s()
                     window.append((done, batch,
@@ -268,6 +282,15 @@ class ElasticTrainer:
                     self.last_view = view
                 if self._is_primary(view):
                     self._save(done, view)
+        except Exception as e:
+            rec = get_flight_recorder()
+            if rec is not None:
+                # the crash artifact lands in the shared checkpoint
+                # store: the one place every incarnation can reach
+                rec.record("train", "elastic_fit_exception",
+                           error=f"{type(e).__name__}: {e}", step=done)
+                rec.maybe_dump("elastic_fit_exception", directory=self.dir)
+            raise
         finally:
             self.manager.wait()
             if started_member:

@@ -27,6 +27,7 @@ import numpy as np
 from .accumulation import EncodedGradientsAccumulator, EncodingHandler
 from ..faulttolerance.faults import RetryPolicy
 from ..observability.clock import monotonic_s
+from ..observability.recorder import get_flight_recorder
 from ..observability.registry import MetricsRegistry, default_registry
 from ..observability.tracer import get_tracer
 
@@ -518,6 +519,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 self._count("training_worker_lost_total",
                             "Workers permanently lost (retries/straggler "
                             "budget exhausted)")
+                rec = get_flight_recorder()
+                if rec is not None:
+                    # the loss record carries the degradation context a
+                    # post-mortem needs: which round, who survives
+                    rec.record("cluster", "worker_lost", worker=w,
+                               round=rnd, survivors=len(alive) - 1,
+                               straggler=outcome[w] == "straggler")
+                    rec.maybe_dump("worker_lost")
                 alive.remove(w)
                 if not alive:
                     res = outcome[w]

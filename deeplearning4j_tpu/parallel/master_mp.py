@@ -69,6 +69,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..observability.clock import monotonic_s
+from ..observability.recorder import get_flight_recorder
 from ..observability.registry import default_registry
 from ..observability.tracer import SpanContext, get_tracer
 
@@ -338,6 +339,7 @@ class MultiprocessMaster:
         if sub is None:
             return
         now = monotonic_s()
+        rec = get_flight_recorder()
         while True:
             payload = sub.poll(timeout=0.001)
             if payload is None:
@@ -352,6 +354,10 @@ class MultiprocessMaster:
             cur = self._hb.get(wid)
             if cur is None or steps > cur[1]:
                 self._hb[wid] = [now, steps]
+                if rec is not None:
+                    # the heartbeat trail is what an eviction dump replays
+                    rec.record("cluster", "heartbeat", worker=wid,
+                               steps=steps)
         reg = default_registry()
         if reg.enabled and self._hb:
             age = reg.gauge("cluster_heartbeat_age_seconds",
@@ -373,6 +379,13 @@ class MultiprocessMaster:
         self._drain_heartbeats()
         respawned = False
         now = monotonic_s()
+        reg = default_registry()
+        # registry child resolved BEFORE the per-worker loop (JX022: the
+        # cached-child idiom — name/label lookups don't belong in loops)
+        evict_c = reg.counter(
+            "cluster_evictions_total",
+            "Workers evicted from the membership view",
+            ("reason",)).labels("heartbeat_stall") if reg.enabled else None
         for wid, p in list(self._procs.items()):
             if p.poll() is None or wid in satisfied:
                 self._dead_since.pop(wid, None)
@@ -381,14 +394,10 @@ class MultiprocessMaster:
                     hb = self._hb.get(wid)
                     if hb is not None and \
                             now - hb[0] > self.straggler_timeout_s:
-                        reg = default_registry()
-                        if reg.enabled:
-                            reg.counter(
-                                "cluster_evictions_total",
-                                "Workers evicted from the membership view",
-                                ("reason",)).labels(
-                                    "heartbeat_stall").inc()
+                        if evict_c is not None:
+                            evict_c.inc()
                         self.evicted_workers.add(wid)
+                        self._record_eviction(wid, hb, now, jobdir)
                         p.kill()
                         p.wait(timeout=30)
                         self._respawn(wid, jobdir)
@@ -401,6 +410,21 @@ class MultiprocessMaster:
             self._respawn(wid, jobdir)
             respawned = True
         return respawned
+
+    def _record_eviction(self, wid: int, hb, now: float,
+                         jobdir: str) -> None:
+        """Watchdog eviction forensics: the coordinator commits the
+        flight-recorder window (incl. the evicted worker's heartbeat
+        trail on the cluster channel) into the job directory — the
+        artifact that says WHY worker ``wid`` was killed, written by the
+        surviving side before the respawn even starts."""
+        rec = get_flight_recorder()
+        if rec is None or not rec.enabled:
+            return
+        rec.record("cluster", "watchdog_eviction", worker=wid,
+                   stalled_s=round(now - hb[0], 3), steps=hb[1],
+                   timeout_s=self.straggler_timeout_s)
+        rec.maybe_dump("watchdog_eviction", directory=jobdir)
 
     def _respawn(self, wid: int, jobdir: str) -> None:
         n = self._retries.get(wid, 0) + 1

@@ -57,7 +57,10 @@ import numpy as np
 from ..data.shapes import serving_buckets
 from ..faulttolerance.checkpoint import CheckpointManager
 from ..observability import clock
+from ..observability.events import emit_event
+from ..observability.health import get_health_monitor
 from ..observability.quantiles import LatencyWindow
+from ..observability.recorder import get_flight_recorder
 from ..observability.registry import default_registry
 from ..parallel.inference import InvalidInputError
 from ..utils.http import (BackgroundHttpServer, JsonClient, JsonHandler,
@@ -113,18 +116,29 @@ class AdmissionController:
 
     def __init__(self, queue_limit: int = 256,
                  slo: Optional[SLOConfig] = None,
-                 retry_after_s: float = 1.0, registry=None):
+                 retry_after_s: float = 1.0, registry=None, health=None):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.queue_limit = int(queue_limit)
         self.slo = slo or SLOConfig()
         self.retry_after_s = float(retry_after_s)
         self._registry = registry
+        self._health = health
         self._window = LatencyWindow(self.slo.window)
+        # SLO breach edge state: slo_ok() is polled by health probes from
+        # many threads; the transition (not the steady state) is the
+        # incident that triggers events + a flight-recorder dump
+        self._slo_lock = threading.Lock()
+        self._slo_was_ok = True
+        self.slo_breaches = 0
 
     def _reg(self):
         return self._registry if self._registry is not None \
             else default_registry()
+
+    def _mon(self):
+        return self._health if self._health is not None \
+            else get_health_monitor()
 
     def _count_shed(self, reason: str) -> None:
         reg = self._reg()
@@ -132,6 +146,9 @@ class AdmissionController:
             reg.counter("serving_shed_total",
                         "Requests shed by admission control",
                         ("reason",)).labels(reason).inc()
+        mon = self._mon()
+        if mon is not None:
+            mon.observe_request(shed=True)
 
     def admit(self, n: int, depth: int) -> None:
         """Admit ``n`` rows given current queue ``depth`` or raise
@@ -155,23 +172,65 @@ class AdmissionController:
             reg.histogram("serving_request_seconds",
                           "Engine request latency, enqueue to result",
                           buckets=_LATENCY_BUCKETS).observe(seconds)
+        mon = self._mon()
+        if mon is not None:
+            mon.observe_request(seconds=seconds)
 
     def slo_ok(self) -> bool:
         """True until the window holds ``min_samples`` requests whose
-        p50/p99 breach a configured target."""
+        p50/p99 breach a configured target.  The ok→breach *edge* is the
+        incident: it emits a structured event, lands in the health
+        monitor, and commits a flight-recorder dump (rate-limited) —
+        the forensics artifact is on disk while the breach window is
+        still in memory."""
         slo = self.slo
         if slo.p50_target_ms is None and slo.p99_target_ms is None:
             return True
         snap = self._window.snapshot()
         if len(self._window) < slo.min_samples or snap["p50"] is None:
-            return True
-        if slo.p50_target_ms is not None and \
-                snap["p50"] * 1e3 > slo.p50_target_ms:
-            return False
-        if slo.p99_target_ms is not None and \
-                snap["p99"] * 1e3 > slo.p99_target_ms:
-            return False
-        return True
+            ok = True
+        else:
+            ok = not (
+                (slo.p50_target_ms is not None
+                 and snap["p50"] * 1e3 > slo.p50_target_ms)
+                or (slo.p99_target_ms is not None
+                    and snap["p99"] * 1e3 > slo.p99_target_ms))
+        with self._slo_lock:
+            edge = ok != self._slo_was_ok
+            self._slo_was_ok = ok
+            if edge and not ok:
+                self.slo_breaches += 1
+        if edge:
+            self._note_slo_edge(ok, snap)
+        return ok
+
+    def _note_slo_edge(self, ok: bool, snap: dict) -> None:
+        p50 = None if snap["p50"] is None else round(snap["p50"] * 1e3, 3)
+        p99 = None if snap["p99"] is None else round(snap["p99"] * 1e3, 3)
+        reg = self._reg()
+        if reg.enabled and not ok:
+            reg.counter("serving_slo_breaches_total",
+                        "SLO-window breach edges (ok -> breached)").inc()
+        emit_event("slo_breach" if not ok else "slo_recovered",
+                   p50_ms=p50, p99_ms=p99,
+                   p50_target_ms=self.slo.p50_target_ms,
+                   p99_target_ms=self.slo.p99_target_ms)
+        rec = get_flight_recorder()
+        if rec is not None:
+            rec.record("serving",
+                       "slo_breach" if not ok else "slo_recovered",
+                       p50_ms=p50, p99_ms=p99,
+                       p50_target_ms=self.slo.p50_target_ms,
+                       p99_target_ms=self.slo.p99_target_ms)
+            if not ok:
+                rec.maybe_dump("slo_breach")
+        if not ok:
+            mon = self._mon()
+            if mon is not None:
+                mon.note_slo_breach(
+                    f"serving SLO breached: p50 {p50} ms / p99 {p99} ms "
+                    f"over targets {self.slo.p50_target_ms}/"
+                    f"{self.slo.p99_target_ms} ms", value=p99)
 
     def status(self, depth: int) -> dict:
         snap = self._window.snapshot()
@@ -325,6 +384,11 @@ class ServingEngine:
             self._batches_dispatched += 1
             if traced and self._warm:
                 self._steady_recompiles += 1
+        rec = get_flight_recorder()
+        if rec is not None:
+            rec.record("serving", "dispatch", rows=real, bucket=bucket,
+                       traced=traced, version=self._version,
+                       depth=self._queue.qsize())
         reg = self._reg()
         if not reg.enabled:
             return
@@ -588,6 +652,16 @@ class ServingEngine:
                 if not req.future.done():
                     req.future.set_result((row, slot.version))
         except Exception as e:   # any failure must not kill the dispatcher
+            rec = get_flight_recorder()
+            if rec is not None:
+                # serve-side fault forensics: the window around a failed
+                # dispatch is dumped (rate-limited; needs a configured
+                # dump directory) before callers even see the exception
+                rec.record("serving", "batch_error",
+                           error=f"{type(e).__name__}: {e}",
+                           rows=len(pending),
+                           version=None if slot is None else slot.version)
+                rec.maybe_dump("serve_exception")
             for req in pending:
                 if not req.future.done():
                     req.future.set_exception(e)
@@ -655,6 +729,8 @@ class _EngineHandler(JsonHandler):
 
     def do_GET(self):
         if self._serve_metrics():
+            return
+        if self._serve_flightrecorder():
             return
         if self.path.rstrip("/") == "/health":
             return self._json(self.server_ref.health())
@@ -781,9 +857,21 @@ class ServingServer(PredictCircuitMixin):
         since = (None if self.last_predict_mono is None
                  else round(clock.monotonic_s() - self.last_predict_mono, 3))
         slot = self.engine.slot
-        return {"status": "ok" if ready else "unready",
+        # three states: ok / degraded / unready.  Degraded = still
+        # serving but the health monitor confirmed an anomaly (NaN run,
+        # loss spike, SLO breach…) — an orchestrator keeps routing here
+        # but a human gets paged with the reasons attached
+        status = "ok" if ready else "unready"
+        health_status = None
+        mon = get_health_monitor()
+        if mon is not None:
+            health_status = mon.status()
+            if ready and health_status["state"] == "degraded":
+                status = "degraded"
+        return {"status": status,
                 "live": True,
                 "ready": ready,
+                "health": health_status,
                 "consecutive_failures": self.consecutive_failures,
                 "platform": self.platform,
                 "model": None if slot is None else slot.model_id,

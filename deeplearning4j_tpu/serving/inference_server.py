@@ -31,6 +31,8 @@ class _PredictHandler(JsonHandler):
     def do_GET(self):
         if self._serve_metrics():
             return
+        if self._serve_flightrecorder():
+            return
         if self.path.rstrip("/") == "/health":
             return self._json(self.server_ref.health())
         return self._json({"error": "not found"}, 404)
@@ -110,9 +112,20 @@ class InferenceServer(PredictCircuitMixin):
                  and self.consecutive_failures < self.FAILURE_THRESHOLD)
         since = (None if self.last_predict_mono is None
                  else round(clock.monotonic_s() - self.last_predict_mono, 3))
-        return {"status": "ok" if ready else "unready",
+        # third state between ok and unready: the health monitor
+        # confirmed an anomaly but the serving path still works
+        from ..observability.health import get_health_monitor
+        status = "ok" if ready else "unready"
+        health_status = None
+        mon = get_health_monitor()
+        if mon is not None:
+            health_status = mon.status()
+            if ready and health_status["state"] == "degraded":
+                status = "degraded"
+        return {"status": status,
                 "live": True,
                 "ready": ready,
+                "health": health_status,
                 "consecutive_failures": self.consecutive_failures,
                 "platform": self.platform,
                 "model": self.model_id,

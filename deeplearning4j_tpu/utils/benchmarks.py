@@ -880,7 +880,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 17 module rules off the
+    through the public ``lint_paths`` API — 18 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression
@@ -920,4 +920,124 @@ def lint_time_ms(paths=None, runs: int = 2) -> Dict:
         "findings": len(findings),
         "runs": len(times),
         "spread_ms": round(max(times) - min(times), 1),
+    }
+
+
+def obs_overhead_ms(hidden: int = 256, features: int = 128,
+                    classes: int = 10, batch: int = 128,
+                    n_batches: int = 10,
+                    runs: int = 21, isolate: bool = False) -> Dict:
+    """Observability-overhead benchmark (ISSUE 10): steady-state per-step
+    train time with the runtime-forensics layer (flight recorder + health
+    monitor) installed vs absent.  The fit loop's forensics feed
+    (``_StepForensics``) captures one raw tuple per step and drains the
+    buffer through the recorder ring and the monitor's EWMA detectors in
+    warm batches — ~10us/step flat — so the target is <2%; this row
+    keeps that claim measured instead of asserted, round over round.
+    The workload is sized so the step does real compute (~3 ms on the
+    1-core CPU test host, MLP 128->256->256->10 at batch 128): a
+    dispatch-dominated sub-ms toy step would bill the flat microsecond
+    cost against a denominator no real training run has.
+    Shared-host noise between whole fits dwarfs the ~10us/step effect,
+    so the design is PAIRED over SHORT fits: each round runs both arms
+    back to back (order alternating to cancel cache-warmth bias) and
+    the overhead is the median of the per-round deltas.  Chunks are kept
+    to tens of milliseconds so both arms of a pair land inside one host
+    drift window (~100 ms scheduler/freq timescale on the test host) —
+    longer fits let drift straddle a pair and leak into the deltas;
+    independent medians would report the drift, not the overhead.  ``isolate=True`` (bench.py uses it) reruns the
+    measurement in a fresh interpreter: by the 9th JSON line the bench
+    process carries the headline run's multi-MB heap, and LLC pressure
+    from that unrelated residue inflates the cache-cold Python deltas
+    ~2-3x — a microbenchmark of the forensics layer must not bill it
+    for another benchmark's memory."""
+    if isolate:
+        import subprocess
+        import sys
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        code = (
+            "import json\n"
+            "from deeplearning4j_tpu.utils.benchmarks import "
+            "obs_overhead_ms\n"
+            f"print(json.dumps(obs_overhead_ms(hidden={hidden}, "
+            f"features={features}, classes={classes}, batch={batch}, "
+            f"n_batches={n_batches}, runs={runs})))\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, env=env,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "isolated obs_overhead_ms run failed: "
+                + proc.stderr.strip()[-300:])
+        import json as _json
+        row = _json.loads(proc.stdout.strip().splitlines()[-1])
+        row["isolated"] = True
+        return row
+    from ..nn.conf.input_type import InputType
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..observability.health import HealthMonitor, set_health_monitor
+    from ..observability.recorder import FlightRecorder, set_flight_recorder
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(features)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(13)
+    batches = [(rng.standard_normal((batch, features)).astype(np.float32),
+                np.eye(classes, dtype=np.float32)[
+                    rng.integers(0, classes, batch)])
+               for _ in range(n_batches)]
+    net.fit(iter(batches[:2]), epochs=1)          # compile + warm
+
+    def timed(enabled: bool) -> float:
+        prev_rec = set_flight_recorder(
+            FlightRecorder(capacity=256) if enabled else None)
+        prev_mon = set_health_monitor(HealthMonitor() if enabled else None)
+        try:
+            t0 = monotonic_s()
+            net.fit(iter(batches), epochs=1)
+            return (monotonic_s() - t0) / n_batches * 1e3
+        finally:
+            set_flight_recorder(prev_rec)
+            set_health_monitor(prev_mon)
+
+    off_t, on_t, deltas = [], [], []
+    for i in range(max(1, runs)):
+        # alternate arm order: the second fit of a pair runs cache-warmer,
+        # so a fixed order would systematically bias the deltas
+        if i % 2 == 0:
+            off = timed(False)
+            on = timed(True)
+        else:
+            on = timed(True)
+            off = timed(False)
+        off_t.append(off)
+        on_t.append(on)
+        deltas.append(on - off)
+    off_ms = float(np.median(off_t))
+    on_ms = float(np.median(on_t))
+    overhead_ms = float(np.median(deltas))
+    overhead_pct = overhead_ms / off_ms * 100.0 if off_ms > 0 else None
+    return {
+        "metric": "obs_overhead_ms",
+        "value": round(on_ms, 3),
+        "unit": "ms/step recorder+monitor enabled",
+        "off_ms": round(off_ms, 3),
+        "overhead_ms": round(overhead_ms, 3),
+        "overhead_pct": None if overhead_pct is None
+        else round(overhead_pct, 2),
+        "target_pct": 2.0,
+        "steps": n_batches,
+        "runs": max(1, runs),
     }

@@ -30,7 +30,7 @@ import threading
 import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 from ..observability import clock
 from ..observability.exposition import CONTENT_TYPE, render_text
@@ -117,6 +117,38 @@ class MetricsEndpointMixin:
             cls = "server_error" if code >= 500 else "client_error"
             reg.counter("http_errors_total", "HTTP error responses",
                         ("route", "error_class")).labels(route, cls).inc()
+
+    def _serve_flightrecorder(self) -> bool:
+        """Answer ``GET /debug/flightrecorder``; returns False when the
+        path is not the flight-recorder endpoint (caller continues its
+        own routing).  Plain GET returns the live in-memory window
+        (channels, spans, metric snapshots); ``?dump=1`` additionally
+        commits it to an atomic checksummed artifact and returns the
+        path — the manual trigger for "grab me the evidence NOW".
+        ONE implementation on the mixin so every server that exposes
+        ``/metrics`` exposes the same forensics route."""
+        base, _, query = self.path.partition("?")
+        if base.rstrip("/") != "/debug/flightrecorder":
+            return False
+        from ..observability.recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        if rec is None or not rec.enabled:
+            self._json({"enabled": False,
+                        "error": "no flight recorder installed"}, 503)
+            return True
+        # dump only on an affirmative value: writing an artifact is a
+        # side effect, so ?dump=0 / ?dump=false must stay the live view
+        dump_vals = parse_qs(query).get("dump", [])
+        if dump_vals and dump_vals[-1].lower() not in ("0", "false", "no", ""):
+            try:
+                path = rec.dump("manual")
+            except Exception as e:
+                self._json({"ok": False, "error": str(e)}, 500)
+                return True
+            self._json({"ok": True, "path": path})
+            return True
+        self._json(rec.view())
+        return True
 
     def _serve_metrics(self) -> bool:
         """Answer ``GET /metrics``; returns False when the path is not the

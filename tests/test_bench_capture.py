@@ -109,6 +109,27 @@ def test_step_time_ms_rows():
     assert 0 < row["adapt_steps"] <= 25
 
 
+def test_obs_overhead_ms_row():
+    """The observability-overhead bench line (ISSUE 10): row shape for
+    the paired recorder+monitor on-vs-off measurement.  A tiny run keeps
+    the test fast; the <2% claim itself is a steady-state property of
+    the full bench.py run (target_pct documents it in the row), not
+    something a 2-round CI sample could assert without flaking."""
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    row = B.obs_overhead_ms(n_batches=12, runs=2)
+    assert row["metric"] == "obs_overhead_ms"
+    assert row["unit"].startswith("ms/step")
+    assert row["value"] > 0 and row["off_ms"] > 0
+    # the paired-delta median can dip negative under host noise, but it
+    # must stay a small fraction of the step itself
+    assert isinstance(row["overhead_ms"], float)
+    assert abs(row["overhead_ms"]) < row["value"]
+    assert row["overhead_pct"] is not None
+    assert row["target_pct"] == 2.0
+    assert row["steps"] == 12 and row["runs"] == 2
+
+
 def test_lint_time_ms_row():
     """The lint wall-time bench line (ISSUE 9): row shape + a sane
     measurement over a small path subset (the full-package budget is
@@ -123,6 +144,6 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 21
+    assert row["rules"] == 22
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1

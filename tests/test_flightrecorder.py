@@ -1,0 +1,710 @@
+"""Runtime forensics (ISSUE 10): FlightRecorder ring/dump/checksum
+semantics, EventLog size-based rotation, derived JSON p50/p99 exposition,
+HealthMonitor streaming detectors (NaN, spike, throughput regression,
+padding drift, serving p99/shed-rate — plus the noisy-but-healthy
+false-positive posture), and the dump-on-fault triggers: unhandled fit
+exceptions, SIGTERM preemption (subprocess), watchdog eviction of a
+wedged worker (chaos), ChaosSchedule SIGKILL, serving SLO breaches, and
+the manual ``/debug/flightrecorder`` route on the HTTP servers."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import (EventLog, MetricsRegistry,
+                                              bucket_quantile,
+                                              configure_event_log,
+                                              render_text)
+from deeplearning4j_tpu.observability.health import (HealthConfig,
+                                                     HealthMonitor,
+                                                     set_health_monitor)
+from deeplearning4j_tpu.observability.recorder import (DUMP_PREFIX,
+                                                       FlightRecorder,
+                                                       load_dump,
+                                                       set_flight_recorder)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def tiny_net(seed=42):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.02)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_batches(n=10, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((batch, 4), dtype=np.float32),
+             np.eye(3, dtype=np.float32)[rng.integers(0, 3, batch)])
+            for _ in range(n)]
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """A fresh process-global recorder with a dump directory, restored
+    on exit (the module-level default recorder has no directory, so
+    auto-triggers in OTHER tests can never litter the disk)."""
+    rec = FlightRecorder(capacity=64, directory=str(tmp_path / "frec"),
+                         min_dump_interval_s=0.0)
+    prev = set_flight_recorder(rec)
+    try:
+        yield rec
+    finally:
+        set_flight_recorder(prev)
+
+
+@pytest.fixture
+def monitor():
+    """Install a process-global HealthMonitor (isolated registry) and
+    restore the previous one on exit."""
+    mon = HealthMonitor(HealthConfig(warmup_steps=3),
+                        registry=MetricsRegistry(enabled=True))
+    prev = set_health_monitor(mon)
+    try:
+        yield mon
+    finally:
+        set_health_monitor(prev)
+
+
+# ------------------------------------------------------- FlightRecorder core
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_dropped_accounting(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("train", "step", i=i)
+        items = rec.channel("train").items()
+        assert len(items) == 8
+        assert [r["i"] for r in items] == list(range(12, 20))
+        assert rec.channel("train").dropped == 12
+
+    def test_dump_roundtrip_checksum_valid(self, tmp_path):
+        rec = FlightRecorder(capacity=8, directory=str(tmp_path))
+        rec.record("train", "step", i=1, score=0.5)
+        rec.record("serving", "dispatch", rows=4)
+        rec.record_span({"name": "fit", "duration_s": 0.1})
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("t_total", "doc").inc(3)
+        rec.snapshot_metrics(registry=reg)
+        path = rec.dump("unit_test", snapshot=False)
+        assert os.path.basename(path).startswith(DUMP_PREFIX)
+        payload = load_dump(path)
+        assert payload["reason"] == "unit_test"
+        assert payload["pid"] == os.getpid()
+        assert [r["type"] for r in payload["channels"]["train"]] == ["step"]
+        assert payload["channels"]["serving"][0]["rows"] == 4
+        assert payload["spans"][0]["name"] == "fit"
+        snap = payload["metric_snapshots"][0]["metrics"]
+        assert snap["t_total"]["samples"][0]["value"] == 3
+
+    def test_corrupt_artifact_detected(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path))
+        rec.record("train", "step", i=1)
+        path = rec.dump("corrupt_me")
+        blob = Path(path).read_text()
+        Path(path).write_text(blob.replace('"i": 1', '"i": 2'))
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            load_dump(path)
+        # verify=False still reads it (the "I know, show me anyway" path)
+        assert load_dump(path, verify=False)["channels"]["train"]
+
+    def test_maybe_dump_needs_directory_and_rate_limits(self, tmp_path):
+        rec = FlightRecorder()           # no directory anywhere
+        rec.record("train", "step", i=1)
+        assert rec.maybe_dump("no_home") is None
+        rec = FlightRecorder(directory=str(tmp_path),
+                             min_dump_interval_s=60.0)
+        rec.record("train", "step", i=1)
+        first = rec.maybe_dump("burst")
+        assert first is not None
+        assert rec.maybe_dump("burst") is None          # rate-limited
+        assert rec.maybe_dump("other_reason") is not None   # per-reason
+        assert len(rec.dumps) == 2
+
+    def test_disabled_recorder_is_inert(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path), enabled=False)
+        rec.record("train", "step", i=1)
+        rec.record_span({"name": "s"})
+        rec.snapshot_metrics(registry=MetricsRegistry(enabled=True))
+        assert rec.dump("nope") is None
+        assert len(rec.channel("train")) == 0
+        rec.enable()
+        rec.record("train", "step", i=2)
+        assert len(rec.channel("train")) == 1
+
+    def test_concurrent_record_and_dump(self, tmp_path):
+        rec = FlightRecorder(capacity=128, directory=str(tmp_path),
+                             min_dump_interval_s=0.0)
+        errors = []
+
+        def writer(w):
+            try:
+                for i in range(500):
+                    rec.record(f"chan{w % 2}", "step", w=w, i=i)
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(8)]
+        for t in threads:
+            t.start()
+        paths = [rec.dump(f"mid_flight_{k}") for k in range(3)]
+        for t in threads:
+            t.join()
+        assert errors == []
+        for p in paths:                     # every mid-flight dump is valid
+            load_dump(p)
+        assert len(rec.channel("chan0")) == 128
+        r = rec.channel("chan0")
+        assert r.dropped == 4 * 500 - 128
+
+    def test_view_shape(self, tmp_path):
+        rec = FlightRecorder(capacity=4, directory=str(tmp_path))
+        rec.record("train", "step", i=1)
+        view = rec.view()
+        assert view["enabled"] is True
+        assert view["channels"]["train"][0]["i"] == 1
+        json.dumps(view)                    # the /debug payload is JSON-able
+
+
+# ------------------------------------------------------- EventLog rotation
+
+class TestEventLogRotation:
+    def test_rotates_and_reads_across_segments_in_order(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with EventLog(path, max_bytes=300, max_files=10) as log:
+            for i in range(40):
+                log.emit("tick", seq=i)
+        segments = EventLog.segments(path)
+        assert len(segments) > 2
+        assert segments[-1] == path          # active file last
+        # one continuous stream, oldest first, nothing lost or spliced
+        seqs = [r["seq"] for r in EventLog.read(path)]
+        assert seqs == list(range(40))
+        for seg in segments:                 # every segment is whole JSONL
+            for line in Path(seg).read_text().splitlines():
+                json.loads(line)
+
+    def test_max_files_drops_oldest(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with EventLog(path, max_bytes=120, max_files=2) as log:
+            for i in range(60):
+                log.emit("tick", seq=i)
+        assert set(EventLog.segments(path)) == {path + ".1", path}
+        seqs = [r["seq"] for r in EventLog.read(path)]
+        assert seqs == sorted(seqs)          # still ordered…
+        assert seqs[-1] == 59                # …ends at the newest record
+        assert seqs[0] > 0                   # …and the oldest fell off
+
+    def test_no_max_bytes_never_rotates(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with EventLog(path) as log:
+            for i in range(200):
+                log.emit("tick", seq=i)
+        assert EventLog.segments(path) == [path]
+        assert len(list(EventLog.read(path))) == 200
+
+    def test_configured_log_rotates_and_emit_mirrors_to_recorder(
+            self, tmp_path, recorder):
+        path = str(tmp_path / "events.jsonl")
+        configure_event_log(path, max_bytes=200, max_files=20)
+        try:
+            from deeplearning4j_tpu.observability import emit_event
+            for i in range(30):
+                emit_event("tick", seq=i)
+        finally:
+            configure_event_log(None)
+        assert len(EventLog.segments(path)) > 1
+        assert [r["seq"] for r in EventLog.read(path)] == list(range(30))
+        # every emit also landed in the recorder's crash window
+        ring = recorder.channel("events").items()
+        assert [r["seq"] for r in ring] == list(range(30))
+
+
+# ---------------------------------------------------- JSON p50/p99 summaries
+
+class TestDerivedQuantiles:
+    def test_bucket_quantile_nearest_rank(self):
+        cum = [(0.1, 5), (0.5, 9), (1.0, 9), (float("inf"), 10)]
+        assert bucket_quantile(cum, 0.50) == 0.1
+        assert bucket_quantile(cum, 0.90) == 0.5
+        assert bucket_quantile(cum, 0.99) == 1.0     # +Inf clamps to 1.0
+        assert bucket_quantile([], 0.5) is None
+        assert bucket_quantile([(0.1, 0), (float("inf"), 0)], 0.5) is None
+        with pytest.raises(ValueError):
+            bucket_quantile(cum, 1.5)
+
+    def test_json_snapshot_carries_p50_p99(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("t_req_seconds", "doc", buckets=(0.1, 0.5, 1.0))
+        for v in (0.05,) * 5 + (0.4,) * 4 + (2.0,):
+            h.observe(v)
+        sample = reg.snapshot()["t_req_seconds"]["samples"][0]
+        assert sample["p50"] == 0.1
+        assert sample["p99"] == 1.0
+        assert sample["count"] == 10
+
+    def test_prometheus_text_unchanged(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("t_req_seconds", "doc", buckets=(0.1, 1.0)).observe(0.2)
+        text = render_text(reg)
+        assert "p50" not in text and "p99" not in text
+        assert 'le="0.1"' in text
+
+
+# ----------------------------------------------------------- HealthMonitor
+
+def _mon(**cfg):
+    return HealthMonitor(HealthConfig(**cfg),
+                         registry=MetricsRegistry(enabled=True),
+                         recorder=FlightRecorder())
+
+
+class TestHealthMonitor:
+    def test_nan_loss_detected_sticky_degraded(self):
+        mon = _mon()
+        dets = mon.observe_step(loss=float("nan"), step=7)
+        assert [d.kind for d in dets] == ["nan_loss"]
+        assert mon.state() == "degraded"
+        assert any("nan_loss" in r for r in mon.reasons())
+        # sticky: a NaN does not age out (cooldown only clears spikes)
+        mon.config = HealthConfig(degraded_cooldown_s=0.0)
+        assert mon.state() == "degraded"
+        mon.clear()
+        assert mon.state() == "ok" and mon.reasons() == []
+
+    def test_nan_grad_detected(self):
+        mon = _mon()
+        dets = mon.observe_step(loss=0.5, grad_norm=float("inf"), step=3)
+        assert [d.kind for d in dets] == ["nan_grad"]
+        assert mon._reg().counter(
+            "health_detections_total", "doc",
+            ("kind",)).labels("nan_grad").value == 1
+
+    def test_loss_spike_detected_after_warmup_seeded(self):
+        mon = _mon(warmup_steps=10, ewma_alpha=0.2, z_threshold=6.0)
+        rng = np.random.default_rng(11)
+        for step in range(30):
+            assert mon.observe_step(
+                loss=1.0 + rng.normal(0.0, 0.01), step=step) == []
+        dets = mon.observe_step(loss=50.0, step=30)
+        assert [d.kind for d in dets] == ["loss_spike"]
+        assert "EWMA std devs" in dets[0].reason
+
+    def test_grad_spike_and_dedupe_window_merges(self):
+        mon = _mon(warmup_steps=5, ewma_alpha=0.2, z_threshold=6.0,
+                   dedupe_s=300.0)
+        rng = np.random.default_rng(5)
+        for step in range(20):
+            mon.observe_step(grad_norm=3.0 + rng.normal(0.0, 0.05),
+                             step=step)
+        first = mon.observe_step(grad_norm=500.0, step=20)
+        assert [d.kind for d in first] == ["grad_spike"]
+        # same-kind repeats inside the window merge into ONE incident
+        # (NaNs fire unconditionally, so they exercise the merge path)
+        assert [d.kind for d in
+                mon.observe_step(grad_norm=float("nan"), step=21)] \
+            == ["nan_grad"]
+        assert mon.observe_step(grad_norm=float("nan"), step=22) == []
+        assert mon._by_kind["nan_grad"].count == 2
+
+    def test_throughput_regression(self):
+        mon = _mon(ewma_alpha=0.5, throughput_warmup=5,
+                   throughput_floor_ratio=0.5)
+        for step in range(10):
+            assert mon.observe_step(examples_per_sec=1000.0,
+                                    step=step) == []
+        out = []
+        for step in range(10, 20):
+            out += mon.observe_step(examples_per_sec=10.0, step=step)
+        assert [d.kind for d in out] == ["throughput_regression"]
+        assert out[0].value < out[0].threshold
+
+    def test_padding_drift(self):
+        mon = _mon(warmup_steps=5, ewma_alpha=0.5, padding_drift=0.25)
+        for step in range(5):
+            assert mon.observe_step(padding_ratio=1.0, step=step) == []
+        out = []
+        for step in range(5, 20):
+            out += mon.observe_step(padding_ratio=2.0, step=step)
+        assert [d.kind for d in out] == ["padding_drift"]
+
+    def test_noisy_but_healthy_stream_no_false_positives(self):
+        """The false-positive posture: 300 steps of realistically noisy
+        but healthy signals produce ZERO detections under defaults."""
+        mon = _mon()
+        rng = np.random.default_rng(42)
+        for step in range(300):
+            dets = mon.observe_step(
+                loss=2.0 + rng.normal(0.0, 0.3),
+                grad_norm=5.0 + rng.normal(0.0, 1.0),
+                examples_per_sec=1000.0 + rng.normal(0.0, 100.0),
+                padding_ratio=1.1 + rng.normal(0.0, 0.02),
+                step=step)
+            assert dets == [], (step, dets)
+        assert mon.state() == "ok"
+
+    def test_serving_p99_and_shed_rate_detectors(self):
+        mon = _mon(serving_min_samples=4, p99_target_ms=1.0,
+                   shed_rate_threshold=0.5)
+        out = []
+        for _ in range(4):
+            out += mon.observe_request(seconds=0.05)
+        assert [d.kind for d in out] == ["serving_p99"]
+        mon2 = _mon(serving_min_samples=4, shed_rate_threshold=0.5)
+        out = []
+        for _ in range(4):
+            out += mon2.observe_request(shed=True)
+        assert [d.kind for d in out] == ["shed_rate"]
+
+    def test_checkpoint_hook_fires_once_per_incident(self):
+        saved = []
+        mon = _mon(dedupe_s=300.0)
+        mon.bind_checkpoint(lambda det: saved.append(det.kind))
+        mon.observe_step(loss=float("nan"))
+        mon.observe_step(loss=float("nan"))     # merged: no second save
+        assert saved == ["nan_loss"]
+        assert mon.checkpoint_saves == 1
+
+    def test_stop_training_opt_in(self):
+        mon = _mon()                             # default: keep going
+        mon.observe_step(loss=float("nan"))
+        assert mon.should_stop() is False
+        mon2 = _mon(stop_training=True)
+        mon2.observe_step(loss=float("nan"))
+        assert mon2.should_stop() is True
+        mon2.clear()
+        assert mon2.should_stop() is False
+
+    def test_detection_lands_in_recorder_and_status(self):
+        rec = FlightRecorder()
+        mon = HealthMonitor(HealthConfig(),
+                            registry=MetricsRegistry(enabled=True),
+                            recorder=rec)
+        mon.observe_step(loss=float("nan"), step=12)
+        ring = rec.channel("health").items()
+        assert ring[0]["kind"] == "nan_loss" and ring[0]["step"] == 12
+        status = mon.status()
+        assert status["state"] == "degraded"
+        assert status["detections"][0]["kind"] == "nan_loss"
+        json.dumps(status)                  # the /health embed is JSON-able
+
+
+# --------------------------------------------- fit integration (in-process)
+
+class TestFitIntegration:
+    def test_unhandled_fit_exception_dumps_window(self, recorder):
+        from deeplearning4j_tpu.train.listeners import TrainingListener
+
+        class Boom(TrainingListener):
+            def iteration_done(self, model, iteration, epoch):
+                if iteration == 3:
+                    raise RuntimeError("boom")
+
+        net = tiny_net()
+        net.set_listeners(Boom())
+        with pytest.raises(RuntimeError, match="boom"):
+            net.fit(iter(make_batches(6)), epochs=1)
+        assert len(recorder.dumps) == 1
+        payload = load_dump(recorder.dumps[0])
+        assert payload["reason"] == "fit_exception"
+        train = payload["channels"]["train"]
+        assert [r["type"] for r in train[:2]] == ["step", "step"]
+        assert train[-1]["type"] == "fit_exception"
+        assert "boom" in train[-1]["error"]
+
+    def test_nan_batch_detected_checkpointed_and_stopped(self, tmp_path,
+                                                         recorder):
+        """ISSUE 10 acceptance: an injected NaN step is caught by the
+        monitor, triggers an immediate checkpoint save, and (opt-in)
+        stops training cleanly."""
+        from deeplearning4j_tpu.faulttolerance import (CheckpointConfig,
+                                                       CheckpointManager)
+        store = str(tmp_path / "store")
+        # warmup far past the run length: the statistical detectors stay
+        # unarmed on real (noisy) training signals; the NaN check is
+        # unconditional and is the one under test
+        mon = HealthMonitor(
+            HealthConfig(warmup_steps=100, stop_training=True),
+            registry=MetricsRegistry(enabled=True), recorder=recorder)
+        prev = set_health_monitor(mon)
+        try:
+            batches = make_batches(10)
+            bad_x = np.full_like(batches[6][0], np.nan)
+            batches[6] = (bad_x, batches[6][1])
+            net = tiny_net()
+            net.fit(iter(batches),
+                    epochs=1,
+                    checkpoint=CheckpointConfig(directory=store,
+                                                background=False))
+        finally:
+            set_health_monitor(prev)
+        assert net.iteration == 7                # halted AT the NaN step
+        kinds = {d["kind"] for d in mon.status()["detections"]}
+        assert "nan_loss" in kinds
+        assert mon.state() == "degraded"
+        assert mon.checkpoint_saves >= 1         # the emergency save
+        mgr = CheckpointManager(store, background=False)
+        assert mgr.latest() is not None
+        # the detection is in the recorder's health channel for the dump
+        ring = recorder.channel("health").items()
+        assert any(r["kind"] == "nan_loss" for r in ring)
+
+    def test_healthy_fit_with_monitor_unaffected(self, recorder):
+        mon = HealthMonitor(HealthConfig(),
+                            registry=MetricsRegistry(enabled=True))
+        prev = set_health_monitor(mon)
+        try:
+            net = tiny_net()
+            net.fit(iter(make_batches(8)), epochs=1)
+        finally:
+            set_health_monitor(prev)
+        assert net.iteration == 8
+        assert mon.state() == "ok"
+        assert mon.status()["steps_observed"] == 8
+        assert recorder.dumps == []              # nothing went wrong
+        steps = recorder.channel("train").items()
+        assert len(steps) == 8 and steps[-1]["iteration"] == 8
+
+
+# ------------------------------------------------ serving-side integration
+
+class TestServingIntegration:
+    def test_slo_breach_edge_dumps_and_degrades(self, recorder):
+        from deeplearning4j_tpu.serving.engine import (AdmissionController,
+                                                       SLOConfig)
+        mon = _mon()
+        ac = AdmissionController(
+            slo=SLOConfig(p99_target_ms=1.0, min_samples=4), health=mon)
+        for _ in range(4):
+            ac.observe(0.050)                    # 50 ms >> 1 ms target
+        assert ac.slo_ok() is False
+        assert ac.slo_breaches == 1
+        assert ac.slo_ok() is False              # steady state: no new edge
+        assert ac.slo_breaches == 1
+        # the breach edge committed the window to disk…
+        assert len(recorder.dumps) == 1
+        payload = load_dump(recorder.dumps[0])
+        assert payload["reason"] == "slo_breach"
+        serving = payload["channels"]["serving"]
+        assert serving[-1]["type"] == "slo_breach"
+        assert serving[-1]["p99_ms"] > 1.0
+        # …and landed in the health monitor
+        kinds = {d["kind"] for d in mon.status()["detections"]}
+        assert "slo_breach" in kinds or "serving_p99" in kinds
+        assert mon.state() == "degraded"
+
+    def test_shed_feeds_health_monitor(self):
+        from deeplearning4j_tpu.serving.engine import (AdmissionController,
+                                                       ShedError)
+        mon = _mon(serving_min_samples=4, shed_rate_threshold=0.5)
+        ac = AdmissionController(queue_limit=1, health=mon)
+        for _ in range(4):
+            with pytest.raises(ShedError):
+                ac.admit(1, depth=1)             # queue full: shed
+        kinds = {d["kind"] for d in mon.status()["detections"]}
+        assert "shed_rate" in kinds
+
+    def test_serving_server_health_embeds_degraded(self, monitor):
+        from deeplearning4j_tpu.serving import ServingEngine, ServingServer
+        eng = ServingEngine()                    # no model: unready
+        srv = ServingServer(engine=eng, warmup=False)
+        try:
+            monitor.observe_step(loss=float("nan"))
+            h = srv.health()
+            assert h["status"] == "unready"      # unready wins over degraded
+            assert h["health"]["state"] == "degraded"
+            assert any("nan_loss" in r for r in h["health"]["reasons"])
+        finally:
+            eng.shutdown()
+
+
+# --------------------------------------------- HTTP route + /health flip
+
+class TestHttpRoutes:
+    def test_debug_flightrecorder_view_dump_and_degraded_health(
+            self, recorder, monitor):
+        from deeplearning4j_tpu.parallel import InferenceMode
+        from deeplearning4j_tpu.serving import InferenceClient, \
+            InferenceServer
+        recorder.record("train", "step", i=1)
+        server = InferenceServer(
+            tiny_net(), inference_mode=InferenceMode.INPLACE).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}")
+            view = client.get("/debug/flightrecorder")
+            assert view["enabled"] is True
+            assert view["channels"]["train"][0]["i"] == 1
+            res = client.get("/debug/flightrecorder?dump=1")
+            assert res["ok"] is True
+            assert load_dump(res["path"])["reason"] == "manual"
+            # a NaN detection flips /health ok -> degraded with reasons
+            assert client.get("/health")["status"] == "ok"
+            monitor.observe_step(loss=float("nan"))
+            h = client.get("/health")
+            assert h["status"] == "degraded"
+            assert h["ready"] is True            # degraded still serves
+            assert any("nan_loss" in r for r in h["health"]["reasons"])
+            monitor.clear()
+            assert client.get("/health")["status"] == "ok"
+        finally:
+            server.stop()
+
+    def test_debug_flightrecorder_503_without_recorder(self):
+        from deeplearning4j_tpu.parallel import InferenceMode
+        from deeplearning4j_tpu.serving import InferenceClient, \
+            InferenceServer
+        prev = set_flight_recorder(None)
+        server = InferenceServer(
+            tiny_net(), inference_mode=InferenceMode.INPLACE).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                client.get("/debug/flightrecorder")
+            assert err.value.code == 503
+        finally:
+            server.stop()
+            set_flight_recorder(prev)
+
+
+# ----------------------------------------------------- dump-on-fault paths
+
+def test_sigterm_preemption_dumps_next_to_checkpoint(tmp_path):
+    """ISSUE 10 acceptance: a fit killed by SIGTERM leaves a complete,
+    checksum-valid flight-recorder artifact next to the preemption
+    checkpoint, containing the final window's train-step records."""
+    store = str(tmp_path / "store")
+    child = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {str(REPO_ROOT)!r})
+from tests.test_flightrecorder import make_batches, tiny_net
+from deeplearning4j_tpu.faulttolerance import CheckpointConfig
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+class Ready(TrainingListener):
+    def iteration_done(self, model, iteration, epoch):
+        if iteration == 1:
+            print("READY", flush=True)
+        time.sleep(0.01)           # keep the fit alive for the signal
+
+def batches():
+    while True:
+        yield from make_batches(50)
+
+net = tiny_net()
+net.set_listeners(Ready())
+net.fit(batches(), epochs=1,
+        checkpoint=CheckpointConfig(directory={store!r},
+                                    save_on_preempt=True,
+                                    background=False))
+print("CLEAN-RETURN", flush=True)
+"""],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=str(REPO_ROOT)), cwd=str(REPO_ROOT))
+    try:
+        assert "READY" in child.stdout.readline()
+        child.send_signal(signal.SIGTERM)
+        out, _ = child.communicate(timeout=120)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert child.returncode == 0, out
+    assert "CLEAN-RETURN" in out
+    dumps = [f for f in os.listdir(store)
+             if f.startswith(DUMP_PREFIX + "preempt")]
+    assert len(dumps) == 1, os.listdir(store)
+    payload = load_dump(os.path.join(store, dumps[0]))   # checksum-valid
+    assert payload["reason"] == "preempt"
+    train = payload["channels"]["train"]
+    steps = [r for r in train if r["type"] == "step"]
+    assert steps and steps[-1]["iteration"] >= 1
+    assert train[-1]["type"] == "preempted"
+    assert train[-1]["saved"]            # the preemption checkpoint path
+    # the checkpoint the dump sits next to is itself restorable
+    from deeplearning4j_tpu.faulttolerance import CheckpointManager
+    assert CheckpointManager(store, background=False).latest() is not None
+
+
+def test_chaos_sigkill_triggers_fault_dump(recorder):
+    """A ChaosSchedule SIGKILL (the chaos-harness fault) lands on the
+    recorder's cluster channel and commits a dump from the surviving
+    (killing) side."""
+    from deeplearning4j_tpu.faulttolerance.faults import ChaosSchedule
+    victim = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+    sched = ChaosSchedule(seed=1).kill_process(0, 0.2)
+    sched.start(lambda: {0: victim.pid} if victim.poll() is None else {})
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                not any(e[0] == "kill" for e in sched.events):
+            time.sleep(0.05)
+    finally:
+        sched.stop()
+        if victim.poll() is None:
+            victim.kill()
+        victim.wait()
+    assert any(e[0] == "kill" for e in sched.events), sched.events
+    assert len(recorder.dumps) == 1
+    payload = load_dump(recorder.dumps[0])
+    assert payload["reason"] == "chaos_fault"
+    cluster = payload["channels"]["cluster"]
+    assert any(r["type"] == "chaos_kill" and r["pid"] == victim.pid
+               for r in cluster)
+
+
+@pytest.mark.chaos
+def test_watchdog_eviction_dumps_evicted_workers_channel(tmp_path,
+                                                         recorder):
+    """ISSUE 10 acceptance: when the master_mp watchdog kills a wedged
+    worker, the surviving coordinator commits a flight-recorder dump
+    into the job directory whose cluster channel carries the evicted
+    worker's heartbeat trail and the eviction record itself."""
+    from deeplearning4j_tpu.parallel.master_mp import MultiprocessMaster
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(8):
+        x = rng.standard_normal((16, 4)).astype(np.float32)
+        yc = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+        batches.append((x, np.eye(3, dtype=np.float32)[yc]))
+    model = tiny_net(seed=7)
+    master = MultiprocessMaster(
+        num_workers=2, mode="averaging", averaging_frequency=2,
+        worker_env={"JAX_PLATFORMS": "cpu"}, retry_backoff_s=0.05,
+        straggler_timeout_s=8.0,
+        fault_injection={"hang_after_batches": {"1": 1}})
+    jobdir = str(tmp_path / "job")
+    master.fit(model, iter(batches), jobdir=jobdir)
+    assert 1 in master.evicted_workers
+    # the fault hook rides the worker spec, so a respawned incarnation
+    # can wedge and be evicted again — at least one dump, maybe two
+    dumps = sorted(f for f in os.listdir(jobdir)
+                   if f.startswith(DUMP_PREFIX + "watchdog_eviction"))
+    assert dumps, os.listdir(jobdir)
+    payload = load_dump(os.path.join(jobdir, dumps[0]))  # checksum-valid
+    assert payload["reason"] == "watchdog_eviction"
+    cluster = payload["channels"]["cluster"]
+    evictions = [r for r in cluster if r["type"] == "watchdog_eviction"]
+    assert evictions and evictions[0]["worker"] == 1
+    assert evictions[0]["stalled_s"] >= 8.0
+    # the evicted worker's own heartbeat trail is IN the artifact
+    assert any(r["type"] == "heartbeat" and r["worker"] == 1
+               for r in cluster)

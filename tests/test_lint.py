@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX021 has at least one fixture that MUST fire and one
+Every rule JX001–JX022 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -903,6 +903,62 @@ def test_jx017_pragma_suppresses():
 
         q = queue.Queue()  # graftlint: disable=JX017  (drained every tick)
     """, _SERVING_PATH)
+
+
+# ---------------------------------------------------------------- JX022
+def test_jx022_positive_registry_lookup_in_loop():
+    src = """
+        def consume(messages, reg):
+            for m in messages:
+                reg.counter("broker_messages_total", "doc").inc()
+
+        def poll(reg):
+            while True:
+                reg.gauge("queue_depth", "doc").set(1)
+    """
+    fs = findings(src)
+    assert sum(f.rule == "JX022" for f in fs) == 2
+
+
+def test_jx022_positive_constant_labels_in_loop():
+    assert "JX022" in rules_of("""
+        def run(batches, etl_h):
+            for b in batches:
+                etl_h.labels("fetch").observe(0.1)
+    """)
+
+
+def test_jx022_negative_cached_child_and_varying_labels():
+    assert "JX022" not in rules_of("""
+        def run(batches, reg):
+            c = reg.counter("training_steps_total", "doc")
+            age = reg.gauge("hb_age", "doc", ("worker",))
+            for i, b in enumerate(batches):
+                c.inc()
+                age.labels(str(i)).set(1.0)   # varying label: legal
+    """)
+
+
+def test_jx022_negative_lookup_outside_loop_and_non_registry():
+    assert "JX022" not in rules_of("""
+        import collections
+
+        def setup(reg):
+            return reg.histogram("x_seconds", "doc")
+
+        def tally(items):
+            for it in items:
+                c = collections.Counter(it)     # not a registry lookup
+            return c
+    """)
+
+
+def test_jx022_pragma_suppresses():
+    assert "JX022" not in rules_of("""
+        def run(batches, reg):
+            for b in batches:
+                reg.counter("x_total", "d").inc()  # graftlint: disable=JX022  (cold loop)
+    """)
 
 
 # ---------------------------------------------------------------- JX018
@@ -1959,7 +2015,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 17
+    assert len(RULES) == 18
     assert len(PROGRAM_RULES) == 4
 
 

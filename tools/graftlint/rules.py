@@ -1,7 +1,7 @@
 """graftlint rule implementations.
 
-Module-local rules JX001–JX017 are functions ``rule(info: ModuleInfo) ->
-list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
+Module-local rules JX001–JX017 and JX022 are functions ``rule(info:
+ModuleInfo) -> list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
 machinery in ``analysis.py`` (memoized per module, so every rule runs off
 one parse and one tree walk).  The whole-program concurrency pack
 JX018–JX021 is registered in ``PROGRAM_RULES`` and runs once over the
@@ -1005,6 +1005,55 @@ def jx017(info: ModuleInfo) -> List[Finding]:
             "host-memory growth under load — pass maxsize and shed or "
             "block at the bound (maxsize=0 spells deliberate "
             "unboundedness)"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX022
+_JX022_FACTORIES = frozenset(("counter", "gauge", "histogram"))
+
+
+@rule("JX022", "registry child lookup inside a per-iteration loop "
+               "(cache the child before the loop)")
+def jx022(info: ModuleInfo) -> List[Finding]:
+    """Flag metric-child resolution paid once per loop iteration:
+    ``reg.counter(name, ...)`` / ``.gauge(...)`` / ``.histogram(...)``
+    (recognized by the string-literal series name every registry lookup
+    passes) and constant-argument ``.labels(...)`` calls inside a
+    ``for``/``while`` body.  Each lookup is a dict probe + lock + (first
+    time) child construction on the hot path; the observability
+    registry's whole cost model rests on resolving children ONCE and
+    paying only ``inc()/set()/observe()`` per event — the cached-child
+    idiom PR 2 applied by hand.  ``.labels(...)`` with a *varying*
+    argument (a per-worker id, a shard name computed in the loop) is the
+    reason ``.labels`` exists and stays legal; only fully-constant label
+    sets are hoistable and flagged."""
+    out: List[Finding] = []
+    for node in info.nodes(ast.Call):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if not _in_loop_same_function(info, node):
+            continue
+        if func.attr in _JX022_FACTORIES:
+            first = node.args[0] if node.args else None
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                out.append(_finding(
+                    info, node, "JX022",
+                    f"`.{func.attr}({first.value!r}, ...)` inside a loop: "
+                    "the name->series lookup (dict probe + lock) runs "
+                    "every iteration — resolve the child once before the "
+                    "loop and call only inc()/set()/observe() per event"))
+        elif func.attr == "labels":
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if args and all(isinstance(a, ast.Constant) for a in args):
+                out.append(_finding(
+                    info, node, "JX022",
+                    "`.labels(...)` with constant labels inside a loop: "
+                    "the labelset->child lookup repeats every iteration "
+                    "for the same child — hoist the `.labels(...)` result "
+                    "out of the loop (varying label values are the legal "
+                    "use and stay in)"))
     return _dedupe(out)
 
 
