@@ -65,6 +65,13 @@ A ninth JSON line records the observability-overhead benchmark
 (``obs_overhead_ms``: steady-state per-step train time with the flight
 recorder + health monitor enabled vs disabled — the <2% overhead claim,
 measured not asserted); DL4J_TPU_BENCH_OBS=0 suppresses it.
+
+A tenth set of JSON lines records the autoregressive-generation benchmark
+(``decode_tokens_per_sec[mix]``: delivered tokens/sec from the
+slot-batched continuous-batching decode engine vs the naive per-token
+full re-forward baseline, on prefill-heavy and decode-heavy mixes, with
+the engine's post-warmup recompile count — must stay 0);
+DL4J_TPU_BENCH_DECODE=0 suppresses it.
 """
 import json
 import os
@@ -262,7 +269,7 @@ def main():
                               "unit": "ms p50",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
-    # lint wall-time row (ISSUE 9): full-package graftlint — 17 module
+    # lint wall-time row (ISSUE 9): full-package graftlint — 19 module
     # rules + the whole-program concurrency pass — so a rule addition
     # that blows up the developer-loop latency is driver-visible; an
     # eighth JSON line, opt-out DL4J_TPU_BENCH_LINT=0
@@ -289,6 +296,21 @@ def main():
         except Exception as e:  # never let the side row break the headline
             print(json.dumps({"metric": "obs_overhead_ms", "value": None,
                               "unit": "ms/step recorder+monitor enabled",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
+    # generation row (ISSUE 11): tokens/sec from the continuous-batching
+    # decode engine vs the naive per-token re-forward, prefill-heavy and
+    # decode-heavy mixes; a tenth set of JSON lines, opt-out
+    # DL4J_TPU_BENCH_DECODE=0
+    if os.environ.get("DL4J_TPU_BENCH_DECODE", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                decode_tokens_per_sec
+            for row in decode_tokens_per_sec():
+                print(json.dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "decode_tokens_per_sec",
+                              "value": None, "unit": "tokens/sec",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
     # side metrics run even on regressed runs — they're the diagnosis data
@@ -400,6 +422,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # monitor per-step cost vs bare training — the <2% claim;
         # isolated so this process's accumulated heap can't inflate it
         lambda: B.obs_overhead_ms(isolate=True),
+        # generation engine (ISSUE 11): continuous-batching decode vs
+        # naive per-token re-forward, prefill-heavy + decode-heavy mixes,
+        # zero-recompile-verified
+        B.decode_tokens_per_sec,
     ]
     side = []
     for fn in captures:

@@ -57,7 +57,7 @@ from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["ShapePolicy", "default_shape_policy", "next_pow2",
-           "serving_buckets"]
+           "serving_buckets", "prefill_buckets"]
 
 # padded/real element ratios: 1.0 = no padding, right tail = pathological
 _RATIO_BUCKETS = (1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0, 16.0)
@@ -92,6 +92,42 @@ def serving_buckets(max_batch: int,
         out.append(b)
         b <<= 1
     return out + [int(max_batch)]
+
+
+def prefill_buckets(max_len: int,
+                    ladder: Optional[Sequence[int]] = None,
+                    min_bucket: int = 8) -> list:
+    """The generation-side prompt-length ladder: powers of two from
+    ``min_bucket`` capped by ``max_len`` (which is always the top bucket,
+    pow2 or not).
+
+    This is the decode twin of :func:`serving_buckets`, bucketing the
+    TIME axis instead of the batch axis: a ragged prompt pads up to the
+    smallest bucket that holds it and rides a prefill program compiled
+    at warmup, so steady-state generation never traces a novel prompt
+    shape.  The ladder tops out at the engine's full cache capacity
+    because mid-flight weight migration re-prefills a sequence from its
+    complete history — the top bucket must hold the longest sequence the
+    cache can, not just the longest *prompt* admission allows.  An
+    explicit ``ladder`` is respected as-is (sorted, deduplicated,
+    capped entries dropped).
+    """
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if ladder:
+        out = sorted({int(b) for b in ladder if int(b) <= max_len})
+        if not out:
+            raise ValueError(f"explicit ladder {list(ladder)} has no "
+                             f"bucket <= max_len {max_len}")
+        if out[-1] != max_len:
+            out.append(int(max_len))
+        return out
+    out = []
+    b = max(1, int(min_bucket))
+    while b < max_len:
+        out.append(b)
+        b <<= 1
+    return out + [int(max_len)]
 
 
 def _pad_rows(a, pad: int, zero: bool = False):

@@ -1,0 +1,34 @@
+"""Autoregressive generation subsystem: ring KV cache, two-program
+prefill/decode, iteration-level continuous batching.
+
+The training side runs a transformer LM at full tilt; this package is the
+serving side of the same model: a decode engine that turns the layer-level
+carry primitives (``nn/layers/attention.py``: ``init_carry`` /
+``attend_cached`` / ``apply_with_carry``) into whole-model token
+generation.  Design pillars (the TensorFlow-paper bar, PAPERS.md
+1605.08695 — a small fixed program set with all dynamism as data):
+
+- **Slot ring KV cache** (:mod:`.cache`): one preallocated carry pytree
+  per layer, slot-batched ``[max_slots, ..., max_seq, ...]``; requests
+  borrow a slot for their lifetime and vacate it mid-flight.
+- **Two steady-state programs** (:mod:`.programs`): bucketed *prefill*
+  (one request, prompt padded onto the ``data/shapes.prefill_buckets``
+  ladder, KV installed into its slot) and a fixed-shape one-token
+  *decode* step over the full slot batch with per-slot positions — new
+  ``"prefill"``/``"decode"`` kinds in the process-global trace cache,
+  zero recompiles after warmup.
+- **Traced sampling** (:mod:`.sampling`): greedy / temperature / top-k /
+  top-p as data inside the programs, with per-slot RNG streams keyed by
+  (request seed, token index) — a request's tokens are bit-identical
+  whether it runs alone or joins a running batch.
+- **Iteration-level continuous batching** (:mod:`.engine`): new requests
+  prefill into free slots and join the running decode batch at step
+  boundaries; finished sequences (EOS / token budget) vacate their slot
+  the step they finish; the serving tier streams tokens per step.
+"""
+from .engine import (GenerationConfig, GenerationEngine, GenerationResult,
+                     StaticSlotSource)
+from .sampling import sample_tokens
+
+__all__ = ["GenerationConfig", "GenerationEngine", "GenerationResult",
+           "StaticSlotSource", "sample_tokens"]

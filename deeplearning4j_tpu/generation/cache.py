@@ -1,0 +1,120 @@
+"""Slot ring: the preallocated, slot-batched KV cache plus its host-side
+allocator.
+
+Device side: ONE carry pytree per carried layer, allocated once at
+engine construction — attention layers hold ``k``/``v``
+``[max_slots, heads, max_seq, head_dim]`` plus a ``[max_slots, max_seq]``
+validity mask and a ``[max_slots]`` position vector; positional encoding
+holds the position vector alone; plain RNN layers hold their
+``[max_slots, f]`` state rows.  Nothing is ever reallocated or zeroed
+wholesale: a slot is *reused* by overwriting its position, validity row,
+and (lazily, as decoding writes) its KV — stale bytes from the previous
+occupant are mask-dead by construction (``programs.install_carry``).
+
+Host side: a free-list allocator that always hands out the LOWEST free
+slot index (deterministic allocation order makes engine tests and
+forensic dumps reproducible) and an **occupancy trail** — a bounded ring
+of (install/vacate) events with request identity, position, and reason —
+which is exactly what a decode-step exception dump needs to reconstruct
+"who was in which slot with how much context" at the moment of death.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..observability.clock import monotonic_s, wall_s
+from .programs import carried_layers, _fresh_carry
+
+__all__ = ["SlotRing"]
+
+
+class SlotRing:
+    """Device cache pytree + free-slot bookkeeping for one engine."""
+
+    def __init__(self, conf, max_slots: int, max_seq: int,
+                 trail_len: int = 256):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_seq < 2:
+            raise ValueError(f"max_seq must be >= 2, got {max_seq}")
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        self.caches: Dict[str, Any] = {}
+        for name, lc in carried_layers(conf).items():
+            carry = _fresh_carry(lc, self.max_slots, self.max_seq)
+            if isinstance(carry, dict) and "pos" in carry and \
+                    getattr(carry["pos"], "ndim", 0) == 0:
+                # vectorize the stream position: one entry per slot
+                carry = dict(carry, pos=jnp.zeros((self.max_slots,),
+                                                  jnp.int32))
+            self.caches[name] = carry
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.max_slots))
+        heapq.heapify(self._free)
+        self._occupants: Dict[int, Any] = {}
+        self._trail: deque = deque(maxlen=trail_len)
+
+    # ------------------------------------------------------------ allocation
+    def acquire(self, occupant: Any) -> Optional[int]:
+        """Claim the lowest free slot for ``occupant``; None when full."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = heapq.heappop(self._free)
+            self._occupants[slot] = occupant
+        return slot
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._occupants:
+                del self._occupants[slot]
+                heapq.heappush(self._free, slot)
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return len(self._occupants)
+
+    def occupants(self) -> Dict[int, Any]:
+        """Snapshot of {slot: occupant} (engine iterates per decode step)."""
+        with self._lock:
+            return dict(self._occupants)
+
+    # -------------------------------------------------------- occupancy trail
+    def note(self, event: str, slot: int, request_id: str,
+             pos: Optional[int] = None, **fields: Any) -> None:
+        """Append one install/vacate/migrate event to the bounded trail."""
+        rec = {"ts": wall_s(), "mono": round(monotonic_s(), 6),
+               "event": event, "slot": int(slot), "request": request_id}
+        if pos is not None:
+            rec["pos"] = int(pos)
+        rec.update(fields)
+        with self._lock:
+            self._trail.append(rec)
+
+    def trail(self) -> List[dict]:
+        with self._lock:
+            return list(self._trail)
+
+    def occupancy_snapshot(self) -> dict:
+        """The forensics payload a decode-exception dump attaches: who
+        holds which slot right now, plus the recent install/vacate trail."""
+        with self._lock:
+            occupants = {str(s): (r.debug_id() if hasattr(r, "debug_id")
+                                  else repr(r))
+                         for s, r in self._occupants.items()}
+            return {"max_slots": self.max_slots,
+                    "active": len(self._occupants),
+                    "free": len(self._free),
+                    "occupants": occupants,
+                    "trail": list(self._trail)}

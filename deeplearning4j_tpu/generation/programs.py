@@ -1,0 +1,174 @@
+"""The two generation programs: bucketed prefill + fixed-shape decode.
+
+Both are built by ``nn/multilayer._build_stack_fn`` delegation (jit kinds
+``"prefill"`` and ``"decode"`` in the process-global trace cache), so they
+ride the same infrastructure as every other compiled entry point:
+value-keyed topology signatures (equal-topology hot-swaps reuse the
+compiled programs — a weight swap costs zero compiles), ``InstrumentedJit``
+trace counters (``training_compile_total{fn=prefill|decode}``), and
+instance ``_jit_cache`` lifetime.
+
+**Prefill** (one request per call, prompt padded onto the
+``data/shapes.prefill_buckets`` ladder): runs the full layer stack with
+fresh length-T carries (``_stack_forward``'s carry walk — the same code
+path tBPTT and ``rnn_time_step`` use), samples the first token from the
+last *real* prompt position, and installs the carries into the caller's
+slot-batched cache at row ``slot`` with the slot's position set to the
+TRUE prompt length (padded tail entries stay mask-invalid, so the next
+decode write lands exactly where the prompt ends).  One compile per
+prompt bucket, all taken at warmup.
+
+**Decode** (fixed shape, the whole slot batch every step): one token per
+slot through the stack with the slot-batched carries (vector per-slot
+positions — see ``MultiHeadAttention.attend_cached``), traced sampling,
+returns next tokens + updated caches.  ONE compile, ever: slot count,
+cache capacity and every sampling knob are shapes or data.  Inactive
+slots compute garbage rows that touch nothing (row-independent stacks
+only — the engine gates on that), which is what buys mid-flight
+joins/vacates without a single recompile.
+
+Cache donation: the slot cache is the dominant HBM tenant; both programs
+donate it so XLA updates in place (CPU skips donation — unimplemented
+there, warns per compile).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import sample_tokens
+
+__all__ = ["build_generation_fn", "fresh_carries", "install_carry",
+           "carried_layers"]
+
+# log-prob floor for softmax-headed models: keeps log() finite on exact
+# zeros without perturbing the sampling order of reachable tokens
+_LOG_FLOOR = 1e-30
+
+
+def carried_layers(conf) -> dict:
+    """``{layer_name: conf}`` for every HAS_CARRY layer in the stack."""
+    return {f"layer_{i}": lc for i, lc in enumerate(conf.layers)
+            if getattr(lc, "HAS_CARRY", False)}
+
+
+def _fresh_carry(lc, batch: int, max_len: int):
+    """Length-aware zero carry; layers predating the ``max_len``
+    parameter (plain RNNs — their carries have no sequence axis) keep
+    their two-argument signature.  The fallback is only legal for
+    carries WITHOUT a sequence axis: a KV-style carry sized by its conf
+    default instead of ``max_len`` would silently clamp writes past its
+    capacity onto the last cache row (wrong tokens, no error) — refuse
+    loudly instead."""
+    try:
+        return lc.init_carry(batch, jnp.float32, max_len=max_len)
+    except TypeError:
+        carry = lc.init_carry(batch, jnp.float32)
+    if isinstance(carry, dict):
+        for key, leaf in carry.items():
+            if getattr(leaf, "ndim", 0) >= 3 and \
+                    leaf.shape[2] != max_len:
+                raise ValueError(
+                    f"{type(lc).__name__}.init_carry ignored max_len="
+                    f"{max_len}: its '{key}' cache has capacity "
+                    f"{leaf.shape[2]} — the layer (or its wrapper) must "
+                    "accept init_carry(batch, dtype, max_len=...) to be "
+                    "generatable")
+    return carry
+
+
+def fresh_carries(conf, batch: int, max_len: int) -> dict:
+    return {name: _fresh_carry(lc, batch, max_len)
+            for name, lc in carried_layers(conf).items()}
+
+
+def install_carry(cache: dict, carry: dict, slot, length):
+    """Write one freshly-prefilled carry (batch=1, prompt bucket T) into
+    the slot-batched cache at row ``slot``.
+
+    Keyed by the carry schema: ``pos`` entries are set to the TRUE prompt
+    ``length`` (not the padded bucket — this is the off-by-one class the
+    parity tests pin), ``m`` validity rows are written full-width so a
+    previous occupant's stale validity can never leak into the new
+    sequence, KV blocks (seq axis 2) slice in at the row origin, and any
+    other leaf (RNN ``h``/``c`` state) row-writes.  Stale K/V beyond the
+    prompt stays in HBM but is mask-dead — the ring reuses slots without
+    ever zeroing the big tensors.
+    """
+    out = {}
+    for key, leaf in carry.items():
+        dst = cache[key]
+        if key == "pos":
+            out[key] = dst.at[slot].set(length.astype(dst.dtype))
+        elif key == "m":
+            row = jnp.zeros((dst.shape[1],), dst.dtype)
+            row = jax.lax.dynamic_update_slice(
+                row, leaf[0].astype(dst.dtype),
+                (jnp.zeros((), jnp.int32),))
+            out[key] = dst.at[slot].set(row)
+        elif getattr(leaf, "ndim", 0) >= 3:
+            # KV block [1, h, T, d] -> cache [S, h, M, d] at (slot, 0...)
+            z = jnp.zeros((), jnp.int32)
+            idx = (slot.astype(jnp.int32),) + (z,) * (dst.ndim - 1)
+            out[key] = jax.lax.dynamic_update_slice(
+                dst, leaf.astype(dst.dtype), idx)
+        else:
+            out[key] = dst.at[slot].set(leaf[0].astype(dst.dtype))
+    return out
+
+
+def _head_logp(conf, probs):
+    """Log-probabilities from the stack output: a softmax head emits
+    probabilities (log them — the shift by logsumexp cancels in
+    sampling), anything else is treated as raw logits."""
+    if getattr(conf.layers[-1], "activation", None) == "softmax":
+        return jnp.log(jnp.clip(probs, _LOG_FLOOR))
+    return probs
+
+
+def build_generation_fn(conf, kind: str):
+    """Builder for ``_build_stack_fn``: returns ``(fun, donate_argnums)``.
+    Closures capture only ``conf`` — never a network instance — so the
+    programs live in the process-global trace cache and serve every
+    equal-topology slot (hot-swapped checkpoints included)."""
+    from ..nn.multilayer import _stack_forward
+
+    if kind == "prefill":
+        def prefill(params, state, tokens, mask, caches, slot, length,
+                    key, temp, top_k, top_p):
+            """tokens [1, T] ids (T = prompt bucket), mask [1, T] validity,
+            slot/length scalars, key [2] uint32, sampling knobs scalars.
+            Returns (first sampled token (), new caches)."""
+            T = tokens.shape[1]
+            carries = fresh_carries(conf, 1, T)
+            probs, _ = _stack_forward(conf, params, state, tokens,
+                                      train=False, key=None, mask=mask,
+                                      carries=carries)
+            # distribution for the token AFTER the last real prompt token
+            last = jnp.take(probs[0], length - 1, axis=0)        # [V]
+            logp = _head_logp(conf, last)
+            tok = sample_tokens(logp[None], key[None], temp[None],
+                                top_k[None], top_p[None])[0]
+            new_caches = {name: install_carry(caches[name], carries[name],
+                                              slot, length)
+                          for name in caches}
+            return tok, new_caches
+        return prefill, (() if jax.default_backend() == "cpu" else (4,))
+
+    if kind == "decode":
+        def decode(params, state, tokens, caches, keys, temp, top_k,
+                   top_p):
+            """tokens [S] (each slot's newest token), caches the
+            slot-batched carry pytree (vector ``pos``), keys [S, 2],
+            sampling knobs [S].  Returns (next tokens [S], new caches)."""
+            carries = {name: (dict(c) if isinstance(c, dict) else c)
+                       for name, c in caches.items()}
+            probs, _ = _stack_forward(conf, params, state, tokens[:, None],
+                                      train=False, key=None,
+                                      carries=carries)
+            logp = _head_logp(conf, probs[:, -1, :])             # [S, V]
+            toks = sample_tokens(logp, keys, temp, top_k, top_p)
+            return toks, carries
+        return decode, (() if jax.default_backend() == "cpu" else (3,))
+
+    raise KeyError(kind)

@@ -1,0 +1,64 @@
+"""Traced token sampling: greedy / temperature / top-k / top-p as DATA.
+
+One function, fully shape-polymorphic over the slot batch, with every
+sampling knob a per-slot array argument — so the decode program compiles
+ONCE and serves any mix of greedy and stochastic requests in the same
+batch (a trace-constant temperature would mean one compile per knob
+combination, exactly the recompile class the two-program design exists
+to kill).
+
+Per-slot RNG: each row samples from its own raw ``[2] uint32`` threefry
+key.  The engine derives keys as ``(request_seed, token_index)``, which
+makes a request's stream a pure function of its own seed and position —
+independent of slot assignment, batch composition, or joins/vacates
+around it.  That is what makes the continuous-batching determinism
+guarantee (same tokens alone or batched) testable at the bit level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens"]
+
+
+def sample_tokens(logp, keys, temperature, top_k, top_p):
+    """Sample one token per row.  All arguments are data, never trace
+    constants.
+
+    logp:        [S, V] unnormalized log-probabilities (any per-row
+                 constant shift cancels in the softmax).
+    keys:        [S, 2] uint32 — one raw threefry key per row.
+    temperature: [S] float; ``<= 0`` means greedy (argmax, RNG unused).
+    top_k:       [S] int; ``<= 0`` disables the top-k filter.
+    top_p:       [S] float; ``>= 1`` disables the nucleus filter.
+
+    Returns [S] int32 sampled token ids.  Filtering happens in sorted
+    space (descending logp): top-k keeps ranks < k, top-p keeps the
+    shortest prefix whose temperature-scaled mass reaches p (the top
+    token always survives), then a per-row Gumbel-max draw picks from
+    the surviving set — equivalent to renormalized categorical sampling
+    without materializing a second softmax.
+    """
+    logp = logp.astype(jnp.float32)
+    V = logp.shape[-1]
+    order = jnp.argsort(-logp, axis=-1)                      # desc ranks
+    sorted_lp = jnp.take_along_axis(logp, order, axis=-1)
+    ranks = jnp.arange(V)[None, :]
+    k_eff = jnp.where(top_k > 0, top_k, V).astype(jnp.int32)[:, None]
+    keep = ranks < k_eff
+    t_eff = jnp.where(temperature > 0.0, temperature,
+                      1.0).astype(jnp.float32)[:, None]
+    probs = jax.nn.softmax(sorted_lp / t_eff, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose PRECEDING cumulative mass is still below p: the
+    # first token past the threshold is included, the rest cut
+    keep = keep & ((cum - probs) < top_p.astype(jnp.float32)[:, None])
+    keep = keep.at[:, 0].set(True)                  # top-1 always legal
+    masked = jnp.where(keep, sorted_lp / t_eff, -jnp.inf)
+    gumbel = jax.vmap(
+        lambda k: jax.random.gumbel(k, (V,), jnp.float32))(keys)
+    choice = jnp.argmax(masked + gumbel, axis=-1)   # Gumbel-max draw
+    sampled = jnp.take_along_axis(order, choice[:, None], axis=-1)[:, 0]
+    greedy = order[:, 0]
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)

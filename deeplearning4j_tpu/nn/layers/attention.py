@@ -214,9 +214,15 @@ class MultiHeadAttention(BaseLayerConf):
         return self.act_fn(y), variables.get("state", {})
 
     # ---- KV-cache incremental decoding -----------------------------------
-    def init_carry(self, batch: int, dtype=jnp.float32):
+    def init_carry(self, batch: int, dtype=jnp.float32,
+                   max_len: Optional[int] = None):
+        """Zero carry.  ``max_len`` overrides the cache capacity (the
+        generation subsystem sizes prefill carries to the prompt bucket
+        and slot caches to the engine's ``max_seq``); ``attend_cached``
+        derives the capacity from the carry itself, so carries of any
+        length ride the same code."""
         h, d = self._dims()
-        L = self.max_cache_len
+        L = self.max_cache_len if max_len is None else int(max_len)
         return {"k": jnp.zeros((batch, h, L, d), dtype),
                 "v": jnp.zeros((batch, h, L, d), dtype),
                 "m": jnp.zeros((batch, L), jnp.float32),   # cache validity
@@ -227,27 +233,59 @@ class MultiHeadAttention(BaseLayerConf):
         full prefix (``sdpa_reference`` with q_offset — one SDPA
         implementation).  Honors self.causal and key-padding masks; masked
         positions are recorded invalid in the cache.  Returns
-        (y [b,t,n_out], new_carry)."""
+        (y [b,t,n_out], new_carry).
+
+        ``carry["pos"]`` is a scalar (every row at the same stream
+        position — tBPTT chunks, ``rnn_time_step``) or a ``[b]`` vector
+        (per-row positions — the generation engine's slot-batched decode,
+        where every slot sits at its own sequence offset).  The vector
+        form supports single-token steps only (t == 1): causality then
+        reduces to the written-prefix mask, so one fixed-shape decode
+        program serves every slot mix."""
         from ...ops.attention import sdpa_reference
         q = self._heads(x, p, "Wq", "bq")                 # [b,h,t,d]
         k_new = self._heads(x, p, "Wk", "bk")
         v_new = self._heads(x, p, "Wv", "bv")
         pos = carry["pos"]
-        L = self.max_cache_len
+        L = carry["k"].shape[2]        # capacity from the carry, not conf
         t = q.shape[2]
-        z = jnp.zeros((), pos.dtype)   # index dtypes must match under x64
-        k = jax.lax.dynamic_update_slice(
-            carry["k"], k_new.astype(carry["k"].dtype), (z, z, pos, z))
-        v = jax.lax.dynamic_update_slice(
-            carry["v"], v_new.astype(carry["v"].dtype), (z, z, pos, z))
         b_ = x.shape[0]
         chunk_valid = (jnp.ones((b_, t), jnp.float32) if mask is None
                        else mask.astype(jnp.float32))
-        m = jax.lax.dynamic_update_slice(carry["m"], chunk_valid, (z, pos))
-        written = (jnp.arange(L) < pos + t).astype(jnp.float32)   # [L]
-        key_mask = m * written[None, :]                            # [b, L]
-        o = sdpa_reference(q, k.astype(q.dtype), v.astype(q.dtype),
-                           mask=key_mask, causal=self.causal, q_offset=pos)
+        if getattr(pos, "ndim", 0) == 1:
+            if t != 1:
+                raise ValueError(
+                    "per-row vector pos carries support single-token decode "
+                    f"only (t=1), got a {t}-step chunk")
+            z = jnp.zeros((), pos.dtype)
+            k = jax.vmap(lambda c, n, p_: jax.lax.dynamic_update_slice(
+                c, n, (z, p_, z)))(carry["k"],
+                                   k_new.astype(carry["k"].dtype), pos)
+            v = jax.vmap(lambda c, n, p_: jax.lax.dynamic_update_slice(
+                c, n, (z, p_, z)))(carry["v"],
+                                   v_new.astype(carry["v"].dtype), pos)
+            m = jax.vmap(lambda mm, cv, p_: jax.lax.dynamic_update_slice(
+                mm, cv, (p_,)))(carry["m"], chunk_valid, pos)
+            written = (jnp.arange(L)[None, :]
+                       < (pos + t)[:, None]).astype(jnp.float32)   # [b, L]
+            key_mask = m * written
+            # t == 1: the single query sits at the newest position, so the
+            # written-prefix mask IS the causal mask — no q_offset needed
+            o = sdpa_reference(q, k.astype(q.dtype), v.astype(q.dtype),
+                               mask=key_mask, causal=False)
+        else:
+            z = jnp.zeros((), pos.dtype)   # index dtypes must match (x64)
+            k = jax.lax.dynamic_update_slice(
+                carry["k"], k_new.astype(carry["k"].dtype), (z, z, pos, z))
+            v = jax.lax.dynamic_update_slice(
+                carry["v"], v_new.astype(carry["v"].dtype), (z, z, pos, z))
+            m = jax.lax.dynamic_update_slice(carry["m"], chunk_valid,
+                                             (z, pos))
+            written = (jnp.arange(L) < pos + t).astype(jnp.float32)   # [L]
+            key_mask = m * written[None, :]                            # [b, L]
+            o = sdpa_reference(q, k.astype(q.dtype), v.astype(q.dtype),
+                               mask=key_mask, causal=self.causal,
+                               q_offset=pos)
         o = o.transpose(0, 2, 1, 3).reshape(b_, t, -1)
         y = o @ p["Wo"]
         if self.has_bias:
@@ -384,8 +422,9 @@ class TransformerBlock(BaseLayerConf):
         return x + ff, st if st else variables.get("state", {})
 
     # ---- KV-cache incremental decoding -----------------------------------
-    def init_carry(self, batch: int, dtype=jnp.float32):
-        return self._mha().init_carry(batch, dtype)
+    def init_carry(self, batch: int, dtype=jnp.float32,
+                   max_len: Optional[int] = None):
+        return self._mha().init_carry(batch, dtype, max_len=max_len)
 
     def apply_with_carry(self, variables, x, carry, *, train=False,
                          key=None, mask=None):
@@ -420,9 +459,13 @@ class PositionalEncodingLayer(LayerConf):
 
     @staticmethod
     def _pe(t, e, offset, dtype):
-        pos = (offset + jnp.arange(t, dtype=jnp.float32))[:, None]
-        i = jnp.arange(e, dtype=jnp.float32)[None, :]
-        angle = pos / jnp.power(10000.0, (2 * (i // 2)) / e)
+        """Sinusoidal table for ``t`` steps starting at ``offset`` —
+        a scalar (one shared stream position: [t, e]) or a ``[b]`` vector
+        (per-row positions, the slot-batched decode step: [b, t, e])."""
+        offset = jnp.asarray(offset, jnp.float32)
+        pos = offset[..., None] + jnp.arange(t, dtype=jnp.float32)
+        i = jnp.arange(e, dtype=jnp.float32)
+        angle = pos[..., None] / jnp.power(10000.0, (2 * (i // 2)) / e)
         return jnp.where(i % 2 == 0, jnp.sin(angle),
                          jnp.cos(angle)).astype(dtype)
 
@@ -430,7 +473,8 @@ class PositionalEncodingLayer(LayerConf):
         b, t, e = x.shape
         return x + self._pe(t, e, 0.0, x.dtype), variables.get("state", {})
 
-    def init_carry(self, batch: int, dtype=jnp.float32):
+    def init_carry(self, batch: int, dtype=jnp.float32,
+                   max_len: Optional[int] = None):
         return {"pos": jnp.zeros((), jnp.int32)}
 
     def apply_with_carry(self, variables, x, carry, *, train=False,

@@ -36,7 +36,13 @@ class FrozenLayer(LayerConf):
     def HAS_CARRY(self):
         return getattr(self.underlying, "HAS_CARRY", False)
 
-    def init_carry(self, batch, dtype=jnp.float32):
+    def init_carry(self, batch, dtype=jnp.float32, max_len=None):
+        # forward the generation-side capacity override to carry layers
+        # that take it (attention KV caches are sized by max_len, not
+        # their conf default); plain RNN carries keep the 2-arg form
+        if max_len is not None:
+            return self.underlying.init_carry(batch, dtype,
+                                              max_len=max_len)
         return self.underlying.init_carry(batch, dtype)
 
     def apply_with_carry(self, variables, x, carry, *, train=False, key=None,

@@ -368,6 +368,14 @@ def _build_stack_fn(conf, tx, kind: str):
     if kind in ("train_step", "train_step_carry"):
         return _build_train_step(conf, tx, kind == "train_step_carry"), \
             (0, 1, 2)
+    if kind in ("prefill", "decode"):
+        # autoregressive generation programs (bucketed prompt prefill +
+        # fixed-shape slot-batch decode): built in generation/programs.py,
+        # registered here so they ride the same process-global trace
+        # cache, instance _jit_cache lifetime, and compile counters as
+        # every other entry point
+        from ..generation.programs import build_generation_fn
+        return build_generation_fn(conf, kind)
     raise KeyError(kind)
 
 

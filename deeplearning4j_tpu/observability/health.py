@@ -87,6 +87,10 @@ class HealthConfig:
     serving_min_samples: int = 32
     p99_target_ms: Optional[float] = None
     shed_rate_threshold: float = 0.5
+    # generation detectors: time-to-first-token and inter-token latency
+    # p99 over their own sliding windows (the decode engine feeds them)
+    ttft_p99_target_ms: Optional[float] = None
+    itl_p99_target_ms: Optional[float] = None
     # reaction policy
     degraded_cooldown_s: float = 300.0   # non-sticky detections age out
     dedupe_s: float = 30.0               # same-kind merge window
@@ -176,6 +180,8 @@ class HealthMonitor:
         self._pad_baseline: Optional[float] = None
         self._steps = 0
         self._latency = LatencyWindow(self.config.serving_window)
+        self._ttft = LatencyWindow(self.config.serving_window)
+        self._itl = LatencyWindow(self.config.serving_window)
         self._shed_ring: collections.deque = collections.deque(
             maxlen=self.config.serving_window)
         self._detections: collections.deque = collections.deque(maxlen=64)
@@ -360,6 +366,38 @@ class HealthMonitor:
                     f"p99 {p99 * 1e3:.1f} ms over target "
                     f"{cfg.p99_target_ms:.1f} ms",
                     value=p99 * 1e3, threshold=cfg.p99_target_ms)
+                if d is not None:
+                    out.append(d)
+        return out
+
+    def observe_generation(self, ttft_s: Optional[float] = None,
+                           itl_s: Optional[float] = None
+                           ) -> List[Detection]:
+        """Feed one generation latency sample: time-to-first-token
+        (request admitted → first token emitted, covers queue wait +
+        prefill) and/or inter-token latency (one decode-step boundary to
+        the next for a sequence).  Each has its own sliding-window p99
+        detector so a decode tier drowning in prefills pages on TTFT
+        while steady decode stays green — and vice versa."""
+        cfg = self.config
+        out: List[Detection] = []
+        for window, sample, target, kind, label in (
+                (self._ttft, ttft_s, cfg.ttft_p99_target_ms,
+                 "generation_ttft_p99", "time-to-first-token"),
+                (self._itl, itl_s, cfg.itl_p99_target_ms,
+                 "generation_itl_p99", "inter-token latency")):
+            if sample is None:
+                continue
+            window.observe(sample)
+            if target is None or len(window) < cfg.serving_min_samples:
+                continue
+            p99 = window.quantile(0.99)
+            if p99 is not None and p99 * 1e3 > target:
+                d = self._detect(
+                    kind,
+                    f"generation {label} p99 {p99 * 1e3:.1f} ms over "
+                    f"target {target:.1f} ms",
+                    value=p99 * 1e3, threshold=target)
                 if d is not None:
                     out.append(d)
         return out

@@ -1,12 +1,13 @@
 """Serving tier (reference layer 9: the dedicated model-server split —
-continuous-batching engine, nearest-neighbors REST server, streaming
-predict routes)."""
-from .engine import (AdmissionController, SLOConfig, ServingClient,
-                     ServingEngine, ServingServer, ShedError)
+continuous-batching engine, autoregressive generation front-end,
+nearest-neighbors REST server, streaming predict routes)."""
+from .engine import (AdmissionController, GenerationClient, SLOConfig,
+                     ServingClient, ServingEngine, ServingServer, ShedError)
 from .inference_server import InferenceClient, InferenceServer
 from .nn_server import NearestNeighborsClient, NearestNeighborsServer
 
 __all__ = ["NearestNeighborsServer", "NearestNeighborsClient",
            "InferenceServer", "InferenceClient",
            "ServingEngine", "ServingServer", "ServingClient",
-           "AdmissionController", "SLOConfig", "ShedError"]
+           "GenerationClient", "AdmissionController", "SLOConfig",
+           "ShedError"]

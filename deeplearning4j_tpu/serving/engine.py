@@ -67,7 +67,8 @@ from ..utils.http import (BackgroundHttpServer, JsonClient, JsonHandler,
                           PredictCircuitMixin)
 
 __all__ = ["ServingEngine", "ServingServer", "ServingClient",
-           "AdmissionController", "SLOConfig", "ShedError"]
+           "GenerationClient", "AdmissionController", "SLOConfig",
+           "ShedError"]
 
 log = logging.getLogger("deeplearning4j_tpu.serving")
 
@@ -327,7 +328,8 @@ class ServingEngine:
                  batch_buckets: Optional[Sequence[int]] = None,
                  slo: Optional[SLOConfig] = None,
                  admission: Optional[AdmissionController] = None,
-                 checkpoint_dir: Optional[str] = None, registry=None):
+                 checkpoint_dir: Optional[str] = None, registry=None,
+                 generation=None):
         self.buckets = serving_buckets(max_batch_size, batch_buckets)
         self.max_batch_size = int(max_batch_size)
         self.nano_wait = float(nano_wait)
@@ -336,6 +338,7 @@ class ServingEngine:
         self.admission = admission if admission is not None else \
             AdmissionController(queue_limit=queue_limit, slo=slo,
                                 registry=registry)
+        self.generation = None
         # bounded twice: admission sheds above queue_limit, and the queue
         # itself caps at limit + one bucket so a racing burst between the
         # admission read and the put can never grow memory without bound
@@ -363,6 +366,19 @@ class ServingEngine:
         self._dispatcher = threading.Thread(
             target=self._serve_loop, daemon=True, name="dl4j-serve-dispatch")
         self._dispatcher.start()
+        # autoregressive generation (opt-in): a GenerationConfig spins up
+        # the continuous-batching decode engine over THIS engine's slot —
+        # it follows every hot_swap/promote through the slot_source and
+        # surfaces its readiness alongside the predict path's.  Built
+        # LAST: its decode thread polls the slot from construction, so
+        # every engine field must already exist.
+        if generation is not None:
+            from ..generation.engine import (GenerationConfig,
+                                             GenerationEngine)
+            cfg = generation if isinstance(generation, GenerationConfig) \
+                else GenerationConfig()
+            self.generation = GenerationEngine(lambda: self.slot, cfg,
+                                               registry=registry)
 
     # ------------------------------------------------------------- metrics
     def _reg(self):
@@ -511,10 +527,15 @@ class ServingEngine:
         if slot.feature_shape is not None:
             probe = np.zeros(slot.feature_shape, np.float32)
             for b in self.buckets:
-                np.asarray(slot.forward(_pad_rows_np(
+                np.asarray(slot.forward(_pad_rows_np(  # graftlint: disable=JX023  (warmup: blocking per bucket compile is the point)
                     np.stack([probe]), b)))
                 warmed += 1
             self._warm = True
+        if self.generation is not None:
+            # the generation program set (prefill ladder + decode step)
+            # warms with the predict buckets so a mixed predict+generate
+            # workload starts at zero steady-state compiles everywhere
+            warmed += self.generation.warmup()
         return warmed
 
     def predict(self, x, timeout: Optional[float] = 60.0):
@@ -669,14 +690,23 @@ class ServingEngine:
     # ------------------------------------------------------------ lifecycle
     def ready(self) -> Tuple[bool, dict]:
         """(ready, admission_status): ready means a slot is installed, the
-        queue is below its shed limit, and the SLO window is not in
-        breach — the readiness circuit ``/health`` reports."""
+        queue is below its shed limit, the SLO window is not in breach —
+        and, when generation is enabled, the decode tier has admission
+        room and its inter-token SLO holds — the readiness circuit
+        ``/health`` reports."""
         depth = self._queue.qsize()
         status = self.admission.status(depth)
         slot = self.slot
         ready = (slot is not None and not status["saturated"]
                  and status["slo_ok"])
+        if self.generation is not None:
+            ready = ready and self.generation.ready()
         return ready, status
+
+    def generation_status(self) -> Optional[dict]:
+        """The generation block ``/health``/``stats`` embed (None when
+        generation is disabled)."""
+        return None if self.generation is None else self.generation.status()
 
     def stats(self) -> dict:
         slot = self.slot
@@ -692,9 +722,12 @@ class ServingEngine:
             "watching": self.watching,
             "checkpoint_dir": self.checkpoint_dir,
             "admission": admission,
+            "generation": self.generation_status(),
         }
 
     def shutdown(self) -> None:
+        if self.generation is not None:
+            self.generation.shutdown()
         self.stop_watch()
         with self._submit_lock:
             self._shutdown.set()
@@ -741,11 +774,83 @@ class _EngineHandler(JsonHandler):
         srv = self.server_ref
         if route == "/predict":
             return self._predict(srv)
+        if route == "/generate":
+            return self._generate(srv)
         if route == "/reload":
             return self._reload(srv)
         if route == "/watch":
             return self._watch(srv)
         return self._json({"error": "not found"}, 404)
+
+    def _generate(self, srv):
+        gen = srv.engine.generation
+        if gen is None:
+            return self._json({"error": "generation not enabled on this "
+                               "server"}, 404)
+        try:
+            body = self._read_json()
+            tokens = body["tokens"]
+            kw = {}
+            for name, cast in (("max_new_tokens", int),
+                               ("temperature", float), ("top_k", int),
+                               ("top_p", float), ("seed", int),
+                               ("eos_id", int)):
+                if body.get(name) is not None:
+                    kw[name] = cast(body[name])
+            stream = bool(body.get("stream", False))
+        except Exception as e:
+            return self._json({"error": str(e)}, 400)
+        try:
+            if not stream:
+                res = gen.generate(tokens, **kw)
+                srv.note_predict_result(True)
+                return self._json({"tokens": res.tokens,
+                                   "model_versions": res.versions,
+                                   "finish": res.finish,
+                                   "request_id": res.request_id})
+            req = gen.submit(tokens, **kw)
+        except ShedError as e:
+            return self._json(
+                {"error": str(e)}, e.status,
+                headers={"Retry-After": max(1, round(e.retry_after_s))})
+        except InvalidInputError as e:
+            return self._json({"error": str(e)}, 400)
+        except Exception as e:
+            srv.note_predict_result(False)
+            return self._json({"error": str(e)}, 500)
+        # streaming: one NDJSON chunk per decode-step token; a client
+        # that disconnects mid-stream cancels the request, so its slot
+        # vacates at the next step boundary instead of decoding to the
+        # token budget for nobody.  The wait is patient while the
+        # request is alive (TTFT legitimately includes queue time), and
+        # the stream ALWAYS terminates with a done/error event — a
+        # truncated chunked body would be ambiguous to a line reader
+        def events():
+            while True:
+                try:
+                    ev = req.events.get(timeout=5.0)
+                except queue.Empty:  # graftlint: disable=JX016  (get(timeout=5) IS the backoff; exits when the request finishes)
+                    if not (req.future.done() or req.cancelled.is_set()):
+                        continue
+                    try:
+                        # the terminal event may have landed between the
+                        # timeout and the done() observation — drain it
+                        # rather than reporting a finished request as an
+                        # error
+                        ev = req.events.get_nowait()
+                    except queue.Empty:
+                        yield {"error": "generation ended without a "
+                                        "terminal event"}
+                        return
+                yield ev
+                if ev.get("done") or "error" in ev:
+                    return
+        try:
+            if not self._stream_json_lines(events()):
+                req.cancelled.set()
+        except Exception:
+            req.cancelled.set()
+            raise
 
     def _predict(self, srv):
         try:
@@ -829,12 +934,14 @@ class ServingServer(PredictCircuitMixin):
                  slo: Optional[SLOConfig] = None,
                  checkpoint_dir: Optional[str] = None,
                  watch_interval_s: Optional[float] = None,
-                 max_concurrent: int = 64, registry=None, warmup: bool = True):
+                 max_concurrent: int = 64, registry=None, warmup: bool = True,
+                 generation=None):
         self.registry = registry if registry is not None \
             else default_registry()
         self.engine = engine if engine is not None else ServingEngine(
             model, max_batch_size=max_batch_size, queue_limit=queue_limit,
-            slo=slo, checkpoint_dir=checkpoint_dir, registry=registry)
+            slo=slo, checkpoint_dir=checkpoint_dir, registry=registry,
+            generation=generation)
         if warmup and self.engine.slot is not None:
             try:
                 self.engine.warmup()
@@ -879,6 +986,7 @@ class ServingServer(PredictCircuitMixin):
                 "serving_step": None if slot is None else slot.step,
                 "watching": self.engine.watching,
                 "admission": admission,
+                "generation": self.engine.generation_status(),
                 "seconds_since_last_predict": since}
 
     @property
@@ -892,6 +1000,26 @@ class ServingServer(PredictCircuitMixin):
     def stop(self) -> None:
         self._server.stop()
         self.engine.shutdown()
+
+
+class GenerationClient(JsonClient):
+    """Client for the ``POST /generate`` route: :meth:`generate` blocks
+    for the finished sequence; :meth:`stream` yields one event per
+    generated token as the decode loop emits them (and cancels the
+    server-side request when the caller abandons the iterator)."""
+
+    @staticmethod
+    def _body(tokens, **kw):
+        body = {"tokens": [int(t) for t in np.asarray(tokens).reshape(-1)]}
+        body.update({k: v for k, v in kw.items() if v is not None})
+        return body
+
+    def generate(self, tokens, **kw) -> dict:
+        return self.post("/generate", self._body(tokens, **kw))
+
+    def stream(self, tokens, **kw):
+        yield from self.stream_lines(
+            "/generate", self._body(tokens, stream=True, **kw))
 
 
 class ServingClient(JsonClient):

@@ -95,10 +95,23 @@ class InferenceServer(PredictCircuitMixin):
             else default_registry()
         self.platform = device_platform()
         self.model_id = _model_identity(model)
+        # optional generation readiness feed: attach_generation() lets a
+        # decode engine surface its slot/SLO readiness in THIS server's
+        # /health too (the legacy front-end has no /generate route, but
+        # an orchestrator probing it still sees the generation tier)
+        self.generation = None
         self._init_predict_circuit()
         self._server = BackgroundHttpServer(_PredictHandler, port,
                                             server_ref=self,
                                             metrics_registry=self.registry)
+
+    def attach_generation(self, engine) -> "InferenceServer":
+        """Surface a :class:`~..generation.engine.GenerationEngine`'s
+        readiness (slots available AND decode SLO ok) in this server's
+        ``/health`` payload — generation unreadiness flips readiness the
+        same way the predict circuit does."""
+        self.generation = engine
+        return self
 
     def health(self) -> dict:
         """Liveness vs readiness: answering at all is liveness; readiness
@@ -110,6 +123,10 @@ class InferenceServer(PredictCircuitMixin):
         ready = (self.inference is not None
                  and self.platform != "unknown"
                  and self.consecutive_failures < self.FAILURE_THRESHOLD)
+        gen_status = None
+        if self.generation is not None:
+            gen_status = self.generation.status()
+            ready = ready and gen_status["ready"]
         since = (None if self.last_predict_mono is None
                  else round(clock.monotonic_s() - self.last_predict_mono, 3))
         # third state between ok and unready: the health monitor
@@ -130,6 +147,7 @@ class InferenceServer(PredictCircuitMixin):
                 "platform": self.platform,
                 "model": self.model_id,
                 "inference_mode": str(self._mode),
+                "generation": gen_status,
                 "seconds_since_last_predict": since}
 
     def reload(self, path: str) -> None:

@@ -557,6 +557,89 @@ def serve_latency_ms(concurrencies=(1, 16, 64), n_requests: int = 384,
     return out
 
 
+def decode_tokens_per_sec(model=None, max_slots: int = 8,
+                          max_seq: int = 128,
+                          mixes=(("decode_heavy", 12, 8, 48),
+                                 ("prefill_heavy", 12, 96, 8)),
+                          ) -> List[Dict]:
+    """Generation-engine bench (ISSUE 11): delivered tokens/sec from the
+    slot-batched continuous-batching :class:`generation.GenerationEngine`
+    vs the naive pre-subsystem baseline — one FULL re-forward per token,
+    one request at a time — on a prefill-heavy mix (long prompts, short
+    continuations: the prefill ladder dominates) and a decode-heavy mix
+    (short prompts, long continuations: the fixed-shape decode step
+    dominates).  Engine rows carry ``vs_naive`` (the acceptance gate:
+    batching `max_slots` sequences through ONE decode program per step
+    must beat re-running the stack per token) and ``steady_recompiles``,
+    which the warmed two-program set must keep at 0.
+
+    The naive baseline runs at a FIXED padded shape (history padded to
+    ``max_seq``) so it pays one compile, not one per history length —
+    the comparison is engine-vs-dispatch-pattern, not engine-vs-
+    recompile-storm.  Greedy sampling on both sides keeps the token
+    streams comparable (the bench asserts throughput, the test suite
+    asserts the streams match)."""
+    from ..generation import GenerationConfig, GenerationEngine
+    from ..models import TransformerLM
+
+    if model is None:
+        model = TransformerLM(vocab_size=64, seq_len=max_seq, embed=64,
+                              n_layers=2, n_heads=4).init()
+    rng = np.random.default_rng(0)
+    vocab = model.conf.layers[-1].n_out
+
+    def naive_tokens(prompt, n) -> list:
+        """Per-token full re-forward at one padded shape."""
+        hist = list(prompt)
+        out = []
+        for _ in range(n):
+            padded = np.zeros((1, max_seq), np.int32)
+            padded[0, :len(hist)] = hist
+            probs = np.asarray(model.output(padded))
+            tok = int(probs[0, len(hist) - 1].argmax())
+            out.append(tok)
+            hist.append(tok)
+        return out
+
+    rows: List[Dict] = []
+    engine = GenerationEngine.for_model(
+        model, GenerationConfig(max_slots=max_slots, max_seq=max_seq,
+                                queue_limit=4096))
+    try:
+        engine.warmup()
+        naive_tokens([1], 1)                 # compile the naive shape too
+        for mix, n_requests, prompt_len, new_tokens in mixes:
+            prompts = [rng.integers(0, vocab, prompt_len).tolist()
+                       for _ in range(n_requests)]
+            t0 = monotonic_s()
+            total_naive = sum(len(naive_tokens(p, new_tokens))
+                              for p in prompts)
+            naive_wall = monotonic_s() - t0
+            t0 = monotonic_s()
+            reqs = [engine.submit(p, max_new_tokens=new_tokens)
+                    for p in prompts]
+            results = [r.future.result(timeout=600) for r in reqs]
+            engine_wall = monotonic_s() - t0
+            total = sum(len(r.tokens) for r in results)
+            tps = total / engine_wall
+            naive_tps = total_naive / naive_wall
+            rows.append({
+                "metric": f"decode_tokens_per_sec[{mix}]",
+                "value": round(tps, 1),
+                "unit": "tokens/sec", "mix": mix,
+                "requests": n_requests, "prompt_len": prompt_len,
+                "new_tokens": new_tokens, "max_slots": max_slots,
+                "tokens": total,
+                "naive_tokens_per_sec": round(naive_tps, 1),
+                "vs_naive": round(tps / naive_tps, 2) if naive_tps else None,
+                "steady_recompiles": engine.steady_recompiles,
+                "decode_steps": engine.decode_steps,
+            })
+    finally:
+        engine.shutdown()
+    return rows
+
+
 # Calibration (BENCH_NOTES "tunnel health"): round-2 measured ~24 ms
 # trivial-dispatch; this round measured ~90 ms on an otherwise-working
 # tunnel, and the round-3 degraded window showed 3-5x metric inflation.
@@ -880,7 +963,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 18 module rules off the
+    through the public ``lint_paths`` API — 19 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression

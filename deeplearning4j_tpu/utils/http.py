@@ -221,6 +221,12 @@ class JsonHandler(MetricsEndpointMixin, BaseHTTPRequestHandler):
         self._body_read = False
         try:
             super().handle_one_request()
+        except (ConnectionResetError, BrokenPipeError):
+            # a client tearing down its socket between keep-alive
+            # requests (an abandoned generation stream's dedicated
+            # connection, a killed client) is routine under load — end
+            # the handler quietly instead of stack-tracing per socket
+            self.close_connection = True
         finally:
             if self._slot_held:
                 self._slot_held = False
@@ -284,6 +290,32 @@ class JsonHandler(MetricsEndpointMixin, BaseHTTPRequestHandler):
 
     def _read_json(self):
         return json.loads(self._read_body())
+
+    def _stream_json_lines(self, events) -> bool:
+        """Send a chunked HTTP/1.1 response of newline-delimited JSON
+        objects, one chunk per event, flushed as produced — the
+        token-streaming transport for ``POST /generate``.  Chunked
+        framing keeps the connection keep-alive-clean (the client knows
+        where the stream ends without a Content-Length).  Returns False
+        when the client went away mid-stream (dead sockets are routine
+        for an abandoned generation — the caller cancels the work, no
+        stack trace)."""
+        self._drain_unread_body()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for ev in events:
+                data = (json.dumps(ev) + "\n").encode()
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
+            return False
+        self._observe_request(200)
+        return True
 
 
 # connection-level shed response: written straight to the socket before
@@ -486,6 +518,34 @@ class JsonClient:
     def post(self, route: str, body: dict) -> dict:
         return json.loads(self._request(
             "POST", route, json.dumps(body).encode()))
+
+    def stream_lines(self, route: str, body: dict):
+        """POST and yield newline-delimited JSON objects as they arrive
+        (the chunked streaming responses ``_stream_json_lines`` sends).
+        Uses a DEDICATED connection, not the keep-alive pool: a stream
+        can outlive many pooled requests, and abandoning one mid-body
+        must never leave a desynced socket behind for the next caller —
+        closing the private connection also signals the server the
+        client is gone (it cancels the work)."""
+        cls = http.client.HTTPSConnection if self._https \
+            else http.client.HTTPConnection
+        conn = cls(self._host, self._port, timeout=self.timeout)
+        try:
+            conn.request("POST", self._base_path + route,
+                         body=json.dumps(body).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                data = resp.read()
+                raise urllib.error.HTTPError(
+                    self.url + route, resp.status, resp.reason,
+                    resp.headers, io.BytesIO(data))
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            conn.close()
 
     def get(self, route: str) -> dict:
         return json.loads(self._request("GET", route))

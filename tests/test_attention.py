@@ -279,3 +279,160 @@ def test_auto_dispatch_follows_measured_crossover(monkeypatch):
     run(long, mask=None, flash_min_seq=1 << 20)  # raised override -> ref
     run(long, mask=jnp.ones((1, long.shape[2])))  # masked -> always ref
     assert calls == ["ref", "flash", "flash", "ref", "ref"]
+
+
+# ------------------------------------------- carry-primitive parity (ISSUE 11)
+# The generation subsystem's correctness rests on apply_with_carry being
+# EXACTLY the causal forward evaluated incrementally.  These pin the
+# contract per layer, token by token, including the positional-encoding
+# offset off-by-one class and bf16 under PrecisionPolicy.
+
+def _token_by_token(lc, v, x, mask=None):
+    """Run x through apply_with_carry one token at a time; concat outputs."""
+    carry = lc.init_carry(x.shape[0], jnp.float32)
+    steps = []
+    for i in range(x.shape[1]):
+        m = None if mask is None else mask[:, i:i + 1]
+        y, carry = lc.apply_with_carry(v, x[:, i:i + 1], carry, mask=m)
+        steps.append(np.asarray(y))
+    return np.concatenate(steps, axis=1), carry
+
+
+def test_mha_carry_token_by_token_matches_full_causal():
+    lc = MultiHeadAttention(n_in=8, n_out=8, n_heads=2, causal=True,
+                            attn_impl="reference", activation="identity",
+                            max_cache_len=16)
+    v = lc.init(jax.random.PRNGKey(1), None)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 7, 8)),
+                    jnp.float32)
+    full, _ = lc.apply(v, x)
+    inc, carry = _token_by_token(lc, v, x)
+    np.testing.assert_allclose(inc, np.asarray(full), rtol=2e-3, atol=2e-4)
+    assert int(carry["pos"]) == 7
+
+
+def test_transformer_block_carry_token_by_token_matches_full():
+    lc = TransformerBlock(n_in=8, n_heads=2, ffn_mult=2, causal=True,
+                          attn_impl="reference")
+    v = lc.init(jax.random.PRNGKey(2), None)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((3, 6, 8)),
+                    jnp.float32)
+    full, _ = lc.apply(v, x)
+    inc, carry = _token_by_token(lc, v, x)
+    np.testing.assert_allclose(inc, np.asarray(full), rtol=2e-3, atol=2e-4)
+    assert int(carry["pos"]) == 6
+
+
+def test_positional_encoding_carry_offset_off_by_one_class():
+    """The classic generation bug: after consuming T tokens, the NEXT
+    token must read sinusoid table row T — not T-1 (repeats a position)
+    nor T+1 (skips one).  Pinned directly against the full-sequence
+    table, plus chunked-stream parity."""
+    lc = PositionalEncodingLayer()
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 9, 6)),
+                    jnp.float32)
+    full, _ = lc.apply({}, x)
+    # chunked: 4 tokens then 5 — concatenation must equal the full pass
+    carry = lc.init_carry(2)
+    y1, carry = lc.apply_with_carry({}, x[:, :4], carry)
+    assert int(carry["pos"]) == 4
+    y2, carry = lc.apply_with_carry({}, x[:, 4:], carry)
+    assert int(carry["pos"]) == 9
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(y1), np.asarray(y2)], axis=1),
+        np.asarray(full), rtol=1e-6, atol=1e-6)
+    # the off-by-one pin: a single token at offset t reads exactly row t
+    for t in (0, 4, 8):
+        one, _ = lc.apply_with_carry({}, x[:, t:t + 1],
+                                     {"pos": jnp.asarray(t, jnp.int32)})
+        np.testing.assert_allclose(np.asarray(one),
+                                   np.asarray(full[:, t:t + 1]),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"offset {t}")
+
+
+def test_positional_encoding_vector_offsets_per_row():
+    """The slot-batched decode form: a [b] position vector addresses each
+    row's own table offset in one call."""
+    lc = PositionalEncodingLayer()
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((3, 12, 6)),
+                    jnp.float32)
+    full, _ = lc.apply({}, x)
+    offs = [0, 5, 11]
+    xt = jnp.stack([x[i, o][None] for i, o in enumerate(offs)])  # [3,1,6]
+    y, carry = lc.apply_with_carry(
+        {}, xt, {"pos": jnp.asarray(offs, jnp.int32)})
+    for i, o in enumerate(offs):
+        np.testing.assert_allclose(np.asarray(y[i]),
+                                   np.asarray(full[i, o:o + 1]),
+                                   rtol=1e-6, atol=1e-6, err_msg=f"row {i}")
+    np.testing.assert_array_equal(np.asarray(carry["pos"]),
+                                  np.asarray(offs) + 1)
+
+
+def test_mha_vector_pos_decode_matches_per_row_scalar_carries():
+    """The fixed-shape decode step's core primitive: one single-token
+    apply_with_carry over a slot batch whose rows sit at DIFFERENT
+    positions must equal each row decoded alone with a scalar-pos carry."""
+    lc = MultiHeadAttention(n_in=8, n_out=8, n_heads=2, causal=True,
+                            attn_impl="reference", activation="identity",
+                            max_cache_len=16)
+    v = lc.init(jax.random.PRNGKey(5), None)
+    rng = np.random.default_rng(5)
+    lens = [3, 6, 1]
+    hist = jnp.asarray(rng.standard_normal((3, 6, 8)), jnp.float32)
+    xt = jnp.asarray(rng.standard_normal((3, 1, 8)), jnp.float32)
+    refs, rows = [], {"k": [], "v": [], "m": []}
+    for i, L in enumerate(lens):
+        c = lc.init_carry(1, jnp.float32)
+        _, c = lc.apply_with_carry(v, hist[i:i + 1, :L], c)   # prefill
+        y_ref, c_ref = lc.apply_with_carry(v, xt[i:i + 1], c)  # ref decode
+        refs.append(np.asarray(y_ref))
+        for key in rows:
+            rows[key].append(c[key][0])
+        assert int(c_ref["pos"]) == L + 1
+    batch_carry = {key: jnp.stack(rows[key]) for key in rows}
+    batch_carry["pos"] = jnp.asarray(lens, jnp.int32)
+    y_vec, c_vec = lc.apply_with_carry(v, xt, batch_carry)
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(y_vec[i:i + 1]), refs[i],
+                                   rtol=2e-3, atol=2e-4, err_msg=f"row {i}")
+    np.testing.assert_array_equal(np.asarray(c_vec["pos"]),
+                                  np.asarray(lens) + 1)
+
+
+def test_mha_vector_pos_rejects_multi_token_chunks():
+    lc = MultiHeadAttention(n_in=8, n_out=8, n_heads=2, causal=True,
+                            attn_impl="reference", activation="identity",
+                            max_cache_len=16)
+    v = lc.init(jax.random.PRNGKey(6), None)
+    x = jnp.zeros((2, 3, 8), jnp.float32)
+    carry = lc.init_carry(2, jnp.float32)
+    carry = dict(carry, pos=jnp.zeros((2,), jnp.int32))
+    with pytest.raises(ValueError, match="single-token"):
+        lc.apply_with_carry(v, x, carry)
+
+
+def test_transformer_carry_parity_bf16_precision_policy():
+    """Incremental decode parity must survive mixed precision: a bf16
+    PrecisionPolicy stack's rnn_time_step token loop matches its full
+    forward within bf16 tolerance (both paths cast identically)."""
+    lb = (NeuralNetConfiguration.builder().seed(11)
+          .weight_init("xavier").precision("bfloat16").list()
+          .layer(PositionalEncodingLayer())
+          .layer(TransformerBlock(n_heads=2, ffn_mult=2, causal=True,
+                                  attn_impl="reference"))
+          .layer(RnnOutputLayer(n_out=5, activation="softmax",
+                                loss="mcxent")))
+    net = MultiLayerNetwork(
+        lb.set_input_type(InputType.recurrent(6, 10)).build()).init()
+    x = np.random.default_rng(12).standard_normal((2, 10, 6)).astype(
+        np.float32)
+    full = np.asarray(net.output(x), np.float32)
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(x[:, t:t + 1]), np.float32)[:, 0]
+             for t in range(10)]
+    inc = np.stack(steps, axis=1)
+    # bf16 has ~3 decimal digits; the softmax head keeps rows comparable
+    np.testing.assert_allclose(inc, full, rtol=0.06, atol=0.02)
+    assert (inc.argmax(-1) == full.argmax(-1)).mean() > 0.9

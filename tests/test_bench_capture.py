@@ -144,6 +144,35 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 22
+    assert row["rules"] == 23
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
+
+
+def test_decode_tokens_per_sec_rows():
+    """The generation bench line (ISSUE 11): one row per mix
+    (decode-heavy / prefill-heavy) with engine + naive tokens/sec, the
+    vs_naive ratio, and the counter-verified zero-recompile steady
+    state.  Tiny CPU config — the engine-beats-naive acceptance gate is
+    asserted at the real bench scale, where the naive baseline pays 48
+    full-sequence forwards per request; at this toy scale only the row
+    contract and the recompile counter are stable."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    lm = TransformerLM(vocab_size=17, seq_len=32, embed=16, n_layers=2,
+                       n_heads=2).init()
+    rows = B.decode_tokens_per_sec(model=lm, max_slots=2, max_seq=32,
+                                   mixes=(("decode_heavy", 3, 4, 6),
+                                          ("prefill_heavy", 3, 20, 3)))
+    assert [r["metric"] for r in rows] == [
+        "decode_tokens_per_sec[decode_heavy]",
+        "decode_tokens_per_sec[prefill_heavy]"]
+    for row in rows:
+        assert row["unit"] == "tokens/sec"
+        assert row["value"] > 0 and row["naive_tokens_per_sec"] > 0
+        assert row["vs_naive"] > 0
+        assert row["tokens"] == row["requests"] * row["new_tokens"]
+        assert row["decode_steps"] > 0
+        # the warmed two-program set held across the whole mixed run
+        assert row["steady_recompiles"] == 0

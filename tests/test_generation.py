@@ -1,0 +1,668 @@
+"""Autoregressive generation subsystem (ISSUE 11): ring KV cache,
+two-program prefill/decode, iteration-level continuous batching.
+
+The acceptance spine:
+
+* steady-state generation uses EXACTLY two compiled programs (one
+  bucketed prefill per prompt bucket + one fixed-shape decode), counter-
+  verified across a mixed workload of ragged prompts, mid-flight joins
+  and completions — ``serving_steady_recompiles_total`` stays 0;
+* continuous batching is proven at the engine level: a late request
+  joins a RUNNING decode batch and its token stream is bit-identical to
+  the same request run alone (per-slot RNG streams);
+* hot-swap safety: a weight swap during active decode migrates every
+  sequence onto the new weights at a step boundary — no sequence mixes
+  weight versions, reported versions never move backwards (the PR 8
+  swap contract extended to the decode path, under concurrent
+  streaming HTTP clients);
+* admission/health: slot exhaustion sheds with
+  ``serving_shed_total{reason="no_slots"}``, generation readiness rides
+  both servers' ``/health``, and a decode-step exception commits a
+  flight-recorder dump carrying the slot occupancy trail.
+"""
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.shapes import prefill_buckets
+from deeplearning4j_tpu.generation import (GenerationConfig,
+                                           GenerationEngine, sample_tokens)
+from deeplearning4j_tpu.models import TransformerLM
+from deeplearning4j_tpu.observability import MetricsRegistry
+from deeplearning4j_tpu.observability.registry import default_registry
+from deeplearning4j_tpu.parallel.inference import InvalidInputError
+from deeplearning4j_tpu.serving.engine import ShedError
+
+VOCAB = 17
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """One tiny causal LM for the whole module: every engine built over
+    it shares the process-global prefill/decode programs, so the compile
+    cost is paid once."""
+    return TransformerLM(vocab_size=VOCAB, seq_len=32, embed=16,
+                         n_layers=2, n_heads=2).init()
+
+
+def naive_greedy(net, history, n):
+    """The pre-subsystem serving path: one FULL re-forward per token."""
+    hist = [int(t) for t in history]
+    out = []
+    for _ in range(n):
+        probs = np.asarray(net.output(np.asarray([hist], np.int32)))
+        tok = int(probs[0, len(hist) - 1].argmax())
+        out.append(tok)
+        hist.append(tok)
+    return out
+
+
+def compiles(fn):
+    c = default_registry().get("training_compile_total")
+    return 0.0 if c is None else c.labels(fn).value
+
+
+def wait_until(pred, timeout_s=30.0, interval_s=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+# ------------------------------------------------------------ bucket ladder
+class TestPrefillBuckets:
+    def test_pow2_ladder_tops_out_at_capacity(self):
+        assert prefill_buckets(256) == [8, 16, 32, 64, 128, 256]
+        # a non-pow2 capacity is still the top bucket (migration must be
+        # able to re-prefill the longest sequence the cache holds)
+        assert prefill_buckets(48) == [8, 16, 32, 48]
+        assert prefill_buckets(8) == [8]
+        assert prefill_buckets(4) == [4]
+
+    def test_explicit_ladder_sorted_deduped_capped(self):
+        assert prefill_buckets(64, [32, 8, 8, 999]) == [8, 32, 64]
+        with pytest.raises(ValueError):
+            prefill_buckets(16, [999])
+        with pytest.raises(ValueError):
+            prefill_buckets(0)
+
+
+# ---------------------------------------------------------- traced sampling
+class TestSampleTokens:
+    def _logp(self, rows=2, seed=0):
+        rng = np.random.default_rng(seed)
+        return np.asarray(rng.standard_normal((rows, VOCAB)) * 3.0,
+                          np.float32)
+
+    def _keys(self, rows, seed=7):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, 2 ** 32, (rows, 2), dtype=np.uint32)
+
+    def test_zero_temperature_is_argmax(self):
+        lp = self._logp(4)
+        toks = np.asarray(sample_tokens(
+            lp, self._keys(4), np.zeros(4, np.float32),
+            np.zeros(4, np.int32), np.ones(4, np.float32)))
+        np.testing.assert_array_equal(toks, lp.argmax(-1))
+
+    def test_top_k_one_and_tiny_top_p_collapse_to_argmax(self):
+        lp = self._logp(3, seed=1)
+        t = np.full(3, 0.9, np.float32)
+        k1 = np.asarray(sample_tokens(lp, self._keys(3), t,
+                                      np.ones(3, np.int32),
+                                      np.ones(3, np.float32)))
+        np.testing.assert_array_equal(k1, lp.argmax(-1))
+        p0 = np.asarray(sample_tokens(lp, self._keys(3), t,
+                                      np.zeros(3, np.int32),
+                                      np.full(3, 1e-6, np.float32)))
+        np.testing.assert_array_equal(p0, lp.argmax(-1))
+
+    def test_top_k_restricts_support(self):
+        lp = self._logp(1, seed=2)
+        allowed = set(np.argsort(-lp[0])[:3].tolist())
+        for ks in range(40):
+            tok = int(np.asarray(sample_tokens(
+                lp, self._keys(1, seed=ks), np.full(1, 1.5, np.float32),
+                np.full(1, 3, np.int32), np.ones(1, np.float32)))[0])
+            assert tok in allowed
+
+    def test_same_key_same_token_key_dependence_exists(self):
+        lp = self._logp(1, seed=3)
+        # hot temperature -> near-uniform draw, so distinct keys must
+        # surface distinct tokens within a handful of seeds
+        args = (np.full(1, 8.0, np.float32), np.zeros(1, np.int32),
+                np.ones(1, np.float32))
+        a = np.asarray(sample_tokens(lp, self._keys(1, seed=5), *args))
+        b = np.asarray(sample_tokens(lp, self._keys(1, seed=5), *args))
+        np.testing.assert_array_equal(a, b)
+        draws = {int(np.asarray(sample_tokens(
+            lp, self._keys(1, seed=s), *args))[0]) for s in range(25)}
+        assert len(draws) > 1          # the key actually drives the draw
+
+    def test_row_independent_of_batch_composition(self):
+        """The continuous-batching determinism primitive: a row's draw
+        depends only on its own (logp, key, knobs), never on who else is
+        in the slot batch."""
+        lp = self._logp(3, seed=4)
+        keys = self._keys(3, seed=6)
+        t = np.asarray([0.8, 1.2, 0.0], np.float32)
+        k = np.asarray([0, 5, 0], np.int32)
+        p = np.asarray([0.9, 1.0, 1.0], np.float32)
+        full = np.asarray(sample_tokens(lp, keys, t, k, p))
+        for i in range(3):
+            alone = np.asarray(sample_tokens(
+                lp[i:i + 1], keys[i:i + 1], t[i:i + 1], k[i:i + 1],
+                p[i:i + 1]))
+            assert int(alone[0]) == int(full[i]), f"row {i}"
+
+
+# ------------------------------------------------------------------- engine
+class TestGenerationEngine:
+    def test_greedy_matches_naive_reforward(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=4, max_seq=32))
+        try:
+            eng.warmup()
+            prompt = [3, 1, 4, 1, 5]
+            res = eng.generate(prompt, max_new_tokens=8)
+            assert res.tokens == naive_greedy(lm, prompt, 8)
+            assert res.finish == "length"
+            assert res.prompt_len == 5
+            assert eng.steady_recompiles == 0
+        finally:
+            eng.shutdown()
+
+    def test_two_programs_zero_recompiles_across_mixed_workload(self, lm):
+        """The acceptance counter-check: after warmup the ENTIRE mixed
+        workload — ragged prompt lengths spanning every bucket,
+        stochastic + greedy requests, mid-flight joins, EOS and budget
+        completions — executes on the warmed program set.  Verified two
+        ways: the engine's own post-warmup trace counter AND the global
+        per-fn compile counter deltas."""
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=3, max_seq=32, queue_limit=64))
+        reg = default_registry()
+        try:
+            warmed = eng.warmup()
+            # exactly two steady-state program KINDS: one prefill per
+            # bucket (8/16/32) plus ONE decode over the full slot batch
+            assert warmed == len(eng.buckets) + 1
+            pf0, dec0 = compiles("prefill"), compiles("decode")
+            steady0 = reg.get("serving_steady_recompiles_total")
+            steady0 = 0.0 if steady0 is None else steady0.value
+            rng = np.random.default_rng(0)
+            reqs = []
+            for i, plen in enumerate([1, 5, 8, 9, 16, 17, 2, 26]):
+                reqs.append(eng.submit(
+                    rng.integers(0, VOCAB, plen).tolist(),
+                    max_new_tokens=4 + (i % 3),
+                    temperature=0.0 if i % 2 else 0.9,
+                    top_k=0 if i % 3 else 5, seed=100 + i,
+                    eos_id=int(rng.integers(0, VOCAB)) if i == 3 else None))
+                if i == 4:          # stagger: later submits join mid-run
+                    wait_until(lambda: any(r.out_tokens for r in reqs))
+            results = [r.future.result(timeout=60) for r in reqs]
+            assert all(r.finish in ("eos", "length") for r in results)
+            assert eng.steady_recompiles == 0
+            assert compiles("prefill") == pf0
+            assert compiles("decode") == dec0
+            steady = reg.get("serving_steady_recompiles_total")
+            assert (0.0 if steady is None else steady.value) == steady0
+            assert eng.tokens_generated == sum(len(r.tokens)
+                                               for r in results)
+        finally:
+            eng.shutdown()
+
+    def test_late_join_matches_solo_run_bit_level(self, lm):
+        """The continuous-batching acceptance: request R streamed into a
+        RUNNING decode batch produces exactly the tokens R produces on an
+        idle engine — and the running batch never restarted."""
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=4, max_seq=32))
+        try:
+            eng.warmup()
+            kw = dict(max_new_tokens=10, temperature=0.85, top_k=6,
+                      top_p=0.95, seed=424242)
+            prompt = [2, 7, 1, 8]
+            solo = eng.generate(prompt, **kw)
+
+            long_req = eng.submit([5, 3], max_new_tokens=26,
+                                  temperature=0.7, seed=1)
+            assert wait_until(lambda: len(long_req.out_tokens) >= 3)
+            assert not long_req.future.done()   # genuinely mid-flight
+            steps_before = eng.decode_steps
+            joined = eng.submit(prompt, **kw)
+            late = joined.future.result(timeout=60)
+            long_res = long_req.future.result(timeout=60)
+            assert late.tokens == solo.tokens   # bit-level determinism
+            assert long_res.finish == "length"
+            # the running batch kept stepping; nothing restarted
+            assert eng.decode_steps > steps_before
+            assert eng.steady_recompiles == 0
+        finally:
+            eng.shutdown()
+
+    def test_eos_vacates_slot_mid_flight_and_trail_records_it(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=2, max_seq=32))
+        try:
+            eng.warmup()
+            prompt = [3, 1, 4, 1, 5]
+            ref = naive_greedy(lm, prompt, 8)
+            eos = ref[3]                   # stop at its first occurrence
+            res = eng.generate(prompt, max_new_tokens=8, eos_id=eos)
+            assert res.finish == "eos"
+            assert res.tokens == ref[:ref.index(eos) + 1]
+            assert wait_until(lambda: eng.ring.free_slots == 2)
+            events = [(e["event"], e["reason"]) if "reason" in e
+                      else e["event"] for e in eng.ring.trail()]
+            assert "install" in events
+            assert ("vacate", "eos") in events
+        finally:
+            eng.shutdown()
+
+    def test_stream_yields_per_token_events_and_cancel_vacates(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=1, max_seq=32))
+        try:
+            eng.warmup()
+            events = list(eng.stream([4, 2], max_new_tokens=5))
+            assert [e["index"] for e in events[:-1]] == list(range(5))
+            assert all("token" in e and "model_version" in e
+                       for e in events[:-1])
+            assert events[-1]["done"] and events[-1]["finish"] == "length"
+            assert events[-1]["tokens"] == [e["token"] for e in events[:-1]]
+            # abandoning the iterator cancels the request -> slot vacates
+            it = eng.stream([1, 2, 3], max_new_tokens=28)
+            first = next(it)
+            assert "token" in first
+            it.close()
+            assert wait_until(lambda: eng.ring.free_slots == 1)
+        finally:
+            eng.shutdown()
+
+    def test_admission_sheds_no_slots_with_metric_and_retry_after(self, lm):
+        reg = MetricsRegistry()
+        # start=False: no decode thread, so the join queue provably holds
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=1, queue_limit=2, max_seq=32),
+            registry=reg, start=False)
+        try:
+            eng.submit([1], max_new_tokens=4)
+            eng.submit([2], max_new_tokens=4)
+            assert eng.ready() is False     # queue at its shed limit
+            with pytest.raises(ShedError) as ei:
+                eng.submit([3], max_new_tokens=4)
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s > 0
+            shed = reg.get("serving_shed_total")
+            assert shed is not None and shed.labels("no_slots").value == 1
+        finally:
+            eng.shutdown()
+
+    def test_unready_sheds_503_and_invalid_inputs_400_class(self, lm):
+        reg = MetricsRegistry()
+        eng = GenerationEngine(lambda: None, GenerationConfig(max_seq=32),
+                               registry=reg, start=False)
+        try:
+            with pytest.raises(ShedError) as ei:
+                eng.submit([1])
+            assert ei.value.status == 503
+            assert reg.get("serving_shed_total").labels("unready").value == 1
+        finally:
+            eng.shutdown()
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_seq=32), start=False)
+        try:
+            with pytest.raises(InvalidInputError):
+                eng.submit([])
+            with pytest.raises(InvalidInputError):
+                eng.submit([1], max_new_tokens=0)
+            with pytest.raises(InvalidInputError):
+                eng.submit([1] * 30, max_new_tokens=8)   # 38 > max_seq 32
+        finally:
+            eng.shutdown()
+
+    def test_decode_slo_breach_flips_readiness(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=2, max_seq=32,
+                                 itl_slo_ms=1e-7, slo_min_samples=4))
+        try:
+            eng.warmup()
+            assert eng.ready() is True      # no samples yet: SLO vacuous
+            eng.generate([1, 2], max_new_tokens=8)
+            assert eng.decode_slo_ok() is False
+            assert eng.ready() is False
+            assert eng.status()["decode_slo_ok"] is False
+            assert eng.status()["itl_p99_ms"] > 0
+        finally:
+            eng.shutdown()
+
+    def test_decode_exception_dumps_occupancy_trail_and_loop_survives(
+            self, lm, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.observability import (FlightRecorder,
+                                                      load_dump)
+        from deeplearning4j_tpu.observability.recorder import \
+            set_flight_recorder
+        rec = FlightRecorder(directory=str(tmp_path),
+                             min_dump_interval_s=0.0)
+        prev = set_flight_recorder(rec)
+        orig = lm._get_jitted
+        fail = threading.Event()
+        fail.set()
+
+        def patched(kind):
+            fn = orig(kind)
+            if kind == "decode" and fail.is_set():
+                def boom(*a, **k):
+                    raise RuntimeError("injected decode fault")
+                return boom
+            return fn
+
+        monkeypatch.setattr(lm, "_get_jitted", patched)
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=2, max_seq=32))
+        try:
+            req = eng.submit([1, 2, 3], max_new_tokens=6, seed=9)
+            with pytest.raises(RuntimeError, match="injected decode"):
+                req.future.result(timeout=60)
+            assert wait_until(lambda: rec.dumps)
+            payload = load_dump(rec.dumps[0])     # checksum-verified
+            assert payload["reason"] == "decode_exception"
+            errs = [r for r in payload["channels"]["decode"]
+                    if r["type"] == "decode_error"]
+            assert errs
+            occ = errs[0]["occupancy"]
+            assert occ["active"] == 1 and occ["max_slots"] == 2
+            assert any(t["event"] == "install" and t["request"] == req.id
+                       for t in occ["trail"])
+            assert req.id in " ".join(occ["occupants"].values())
+            # the decode loop survived the fault: clear the injection and
+            # the next request serves normally from a clean ring
+            fail.clear()
+            res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=60)
+            assert res.finish == "length"
+            assert eng.ring.active_slots == 0
+        finally:
+            set_flight_recorder(prev)
+            eng.shutdown()
+
+    def test_refuses_non_generatable_stacks(self):
+        from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                        NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=4, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        ff = MultiLayerNetwork(conf).init()
+        eng = GenerationEngine.for_model(ff, GenerationConfig(max_seq=16),
+                                         start=False)
+        try:
+            with pytest.raises(ValueError, match="carry-capable"):
+                eng.warmup()
+        finally:
+            eng.shutdown()
+        # a LIVE engine over the same stack must fail the submitted
+        # request with the real reason — not drop it into a silent
+        # client timeout (the popped request must never vanish)
+        eng = GenerationEngine.for_model(ff, GenerationConfig(max_seq=16))
+        try:
+            req = eng.submit([1, 2], max_new_tokens=2)
+            with pytest.raises(ValueError, match="carry-capable"):
+                req.future.result(timeout=30)
+        finally:
+            eng.shutdown()
+
+    def test_fresh_carry_capacity_forwarded_or_refused_loudly(self):
+        """The engine sizes KV caches by max_seq, not the layer's conf
+        default: wrappers must forward max_len (FrozenLayer does), and a
+        carry layer that silently ignores it is refused instead of
+        clamping writes past its capacity into wrong tokens."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.generation.programs import _fresh_carry
+        from deeplearning4j_tpu.nn.layers.attention import TransformerBlock
+        from deeplearning4j_tpu.nn.layers.misc import FrozenLayer
+        frozen = FrozenLayer(underlying=TransformerBlock(
+            n_in=8, n_heads=2, causal=True, attn_impl="reference"))
+        assert frozen.HAS_CARRY
+        carry = _fresh_carry(frozen, 2, 7)
+        assert carry["k"].shape[2] == 7          # max_len, not conf
+
+        class LegacyKV:
+            def init_carry(self, batch, dtype=jnp.float32):
+                return {"k": jnp.zeros((batch, 2, 512, 4)),
+                        "pos": jnp.zeros((), jnp.int32)}
+
+        with pytest.raises(ValueError, match="ignored max_len"):
+            _fresh_carry(LegacyKV(), 2, 64)
+
+    def test_rewarm_during_active_decode_never_touches_live_kv(self, lm):
+        """An operator re-warm while sequences are decoding must trace
+        against scratch buffers: slot 0's live KV/pos stay untouched and
+        the stream still matches the greedy oracle exactly."""
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=2, max_seq=32))
+        try:
+            eng.warmup()
+            prompt = [3, 1, 4, 1]
+            req = eng.submit(prompt, max_new_tokens=14)
+            assert wait_until(lambda: len(req.out_tokens) >= 2)
+            eng.warmup()                       # mid-flight re-warm
+            res = req.future.result(timeout=60)
+            assert res.tokens == naive_greedy(lm, prompt, 14)
+        finally:
+            eng.shutdown()
+
+    def test_non_integer_prompt_is_invalid_input_not_500_class(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_seq=32), start=False)
+        try:
+            with pytest.raises(InvalidInputError, match="integer token"):
+                eng.submit(["a", "b"])
+        finally:
+            eng.shutdown()
+
+    def test_generate_timeout_cancels_and_frees_the_slot(self, lm):
+        eng = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=1, max_seq=32), start=False)
+        try:
+            with pytest.raises(FuturesTimeout):
+                eng.generate([1, 2], max_new_tokens=4, timeout=0.05)
+            # the abandoned request is cancelled: once the (late-started)
+            # decode loop picks it up, it vacates instead of decoding
+            eng._thread.start()
+            assert wait_until(lambda: eng._pending.qsize() == 0)
+            assert eng.ring is None or eng.ring.active_slots == 0
+        finally:
+            eng.shutdown()
+
+
+# -------------------------------------------------- serving-tier integration
+class TestServingIntegration:
+    def test_generate_route_blocking_streaming_and_health(self, lm):
+        from deeplearning4j_tpu.serving import (GenerationClient,
+                                                ServingServer)
+        server = ServingServer(
+            lm, max_batch_size=4,
+            generation=GenerationConfig(max_slots=2, max_seq=32)).start()
+        try:
+            client = GenerationClient(f"http://127.0.0.1:{server.port}",
+                                      timeout=60)
+            prompt = [3, 1, 4]
+            expect = naive_greedy(lm, prompt, 6)
+            body = client.generate(prompt, max_new_tokens=6)
+            assert body["tokens"] == expect
+            assert body["finish"] == "length"
+            assert body["model_versions"] == [1] * 6
+            # streaming: one NDJSON event per token, then the done record
+            events = list(client.stream(prompt, max_new_tokens=6))
+            assert [e["token"] for e in events[:-1]] == expect
+            assert [e["index"] for e in events[:-1]] == list(range(6))
+            assert events[-1]["done"] and events[-1]["tokens"] == expect
+            # /health carries the generation readiness block
+            h = client.get("/health")
+            assert h["ready"] is True
+            assert h["generation"]["ready"] is True
+            assert h["generation"]["max_slots"] == 2
+            assert h["generation"]["steady_recompiles"] == 0
+            assert server.engine.stats()["generation"]["warm"] is True
+            # bad requests map to 400-class, not 500
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.generate([], max_new_tokens=2)
+            assert ei.value.code == 400
+            # client-shaped garbage is 400-class too — it must never
+            # charge the server's failure circuit as a 500
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.post("/generate", {"tokens": ["x", "y"]})
+            assert ei.value.code == 400
+            assert client.get("/health")["ready"] is True
+        finally:
+            server.stop()
+
+    def test_generate_route_404_when_generation_disabled(self, lm):
+        from deeplearning4j_tpu.serving import (GenerationClient,
+                                                ServingServer)
+        import urllib.error
+        server = ServingServer(lm, max_batch_size=4).start()
+        try:
+            client = GenerationClient(f"http://127.0.0.1:{server.port}",
+                                      timeout=60)
+            assert client.get("/health")["generation"] is None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                client.generate([1, 2], max_new_tokens=2)
+            assert ei.value.code == 404
+        finally:
+            server.stop()
+
+    def test_hot_swap_during_active_decode_migrates_without_mixing(
+            self, lm):
+        """ISSUE 11 hot-swap acceptance, the PR 8 contract extended to
+        the decode path: a weight swap while streaming clients hold
+        active slots must (a) never mix weight versions inside one
+        sequence — every token matches exactly the weights of the
+        version it reports, verified against per-version greedy oracles
+        on the request's own history — (b) never move versions
+        backwards, and (c) cost zero steady-state recompiles (same
+        topology: the programs are value-keyed on conf, not params)."""
+        import jax
+        from deeplearning4j_tpu.serving import (GenerationClient,
+                                                ServingServer)
+        net_b = lm.clone()
+        net_b.params = jax.tree_util.tree_map(lambda a: a * 1.07,
+                                              net_b.params)
+        server = ServingServer(
+            lm, max_batch_size=4,
+            generation=GenerationConfig(max_slots=4, max_seq=32)).start()
+        gen = server.engine.generation
+        prompts = [[3, 1, 4, 1], [9, 2, 6], [5, 3, 5, 8, 9]]
+        streams, failures = [[] for _ in prompts], []
+
+        def client_loop(i):
+            client = GenerationClient(f"http://127.0.0.1:{server.port}",
+                                      timeout=120)
+            try:
+                for ev in client.stream(prompts[i], max_new_tokens=20):
+                    if "error" in ev:
+                        failures.append(ev["error"])
+                        return
+                    if not ev.get("done"):
+                        streams[i].append((ev["token"],
+                                           ev["model_version"]))
+            except Exception as e:       # noqa: BLE001 - recorded, asserted
+                failures.append(repr(e))
+
+        threads = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(len(prompts))]
+        try:
+            for t in threads:
+                t.start()
+            # swap once every stream is genuinely mid-decode
+            assert wait_until(
+                lambda: all(len(s) >= 2 for s in streams), timeout_s=60)
+            assert server.engine.hot_swap(net_b) == 2
+            for t in threads:
+                t.join(timeout=120)
+            assert failures == []
+            assert gen.steady_recompiles == 0     # same-topology swap
+            mixed_seen = 0
+            for i, stream in enumerate(streams):
+                toks = [t for t, _ in stream]
+                vers = [v for _, v in stream]
+                assert len(toks) == 20
+                assert vers == sorted(vers)       # never moves backwards
+                k = vers.index(2) if 2 in vers else len(toks)
+                if 0 < k < len(toks):
+                    mixed_seen += 1
+                # v1-era tokens match net_a's greedy oracle, v2-era
+                # tokens match net_b's continued from the v1 history —
+                # exactly "no sequence mixes weights in its KV cache"
+                assert toks[:k] == naive_greedy(lm, prompts[i], k)
+                if k < len(toks):
+                    assert toks[k:] == naive_greedy(
+                        net_b, prompts[i] + toks[:k], len(toks) - k)
+            assert mixed_seen >= 1    # the swap really landed mid-flight
+            h = GenerationClient(f"http://127.0.0.1:{server.port}",
+                                 timeout=60).get("/health")
+            assert h["model_version"] == 2
+            assert h["generation"]["ready"] is True
+        finally:
+            server.stop()
+
+    def test_inference_server_attach_generation_readiness(self, lm):
+        from deeplearning4j_tpu.serving import (InferenceClient,
+                                                InferenceServer)
+        gen = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=1, queue_limit=1, max_seq=32),
+            start=False)
+        server = InferenceServer(lm).attach_generation(gen).start()
+        try:
+            client = InferenceClient(f"http://127.0.0.1:{server.port}",
+                                     timeout=60)
+            h = client.get("/health")
+            assert h["ready"] is True and h["generation"]["ready"] is True
+            # saturate the (never-drained) join queue: generation
+            # unreadiness must flip the whole server's readiness
+            gen.submit([1], max_new_tokens=4)
+            h = client.get("/health")
+            assert h["generation"]["ready"] is False
+            assert h["ready"] is False and h["status"] == "unready"
+        finally:
+            server.stop()
+            gen.shutdown()
+
+
+# ------------------------------------------------------- health integration
+def test_health_monitor_ttft_and_itl_p99_detectors():
+    """The decode tier's latency signals ride the PR 10 monitor: each
+    stream has its own sliding-window p99 detector with its own target,
+    so prefill pressure (TTFT) and decode pressure (ITL) page
+    independently."""
+    from deeplearning4j_tpu.observability.health import (HealthConfig,
+                                                         HealthMonitor)
+    cfg = HealthConfig(ttft_p99_target_ms=50.0, itl_p99_target_ms=5.0,
+                       serving_min_samples=8)
+    mon = HealthMonitor(config=cfg, registry=MetricsRegistry())
+    # healthy: both streams inside their targets -> no detections
+    for _ in range(16):
+        assert mon.observe_generation(ttft_s=0.01, itl_s=0.001) == []
+    # TTFT breaches alone: the ITL stream stays green
+    dets = []
+    for _ in range(16):
+        dets += mon.observe_generation(ttft_s=0.2)
+    assert any(d.kind == "generation_ttft_p99" for d in dets)
+    assert not any(d.kind == "generation_itl_p99" for d in dets)
+    assert mon.status()["state"] == "degraded"
+    # ITL breaches independently
+    mon2 = HealthMonitor(config=cfg, registry=MetricsRegistry())
+    dets = []
+    for _ in range(16):
+        dets += mon2.observe_generation(itl_s=0.05)
+    assert any(d.kind == "generation_itl_p99" for d in dets)

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX022 has at least one fixture that MUST fire and one
+Every rule JX001–JX023 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -959,6 +959,95 @@ def test_jx022_pragma_suppresses():
             for b in batches:
                 reg.counter("x_total", "d").inc()  # graftlint: disable=JX022  (cold loop)
     """)
+
+
+# ---------------------------------------------------------------- JX023
+_GENERATION_PATH = "deeplearning4j_tpu/generation/fix.py"
+
+
+def test_jx023_positive_per_token_syncs_in_decode_scope():
+    src = """
+        import numpy as np
+
+        def decode_tokens(model, tok, n):
+            out = []
+            for _ in range(n):
+                logits = model.decode(tok)
+                tok = float(logits)              # per-token host sync
+                out.append(tok)
+            return out
+
+        def drain(engine):
+            while engine.alive():
+                dev = engine.poll()
+                host = np.asarray(dev)           # per-token host sync
+                yield host
+
+        def emit(rows):
+            for r in rows:
+                yield r.item()                   # per-token host sync
+    """
+    for path in (_GENERATION_PATH, _SERVING_PATH):
+        fs = lint_source(textwrap.dedent(src), path)
+        assert sum(f.rule == "JX023" for f in fs) == 3, path
+
+
+def test_jx023_negative_out_of_scope_path():
+    # the identical per-token sync outside generation//serving/ is JX003
+    # territory (training loops) or legal ETL — JX023 stays silent
+    assert "JX023" not in rules_at("""
+        import numpy as np
+
+        def decode_tokens(model, tok, n):
+            out = []
+            for _ in range(n):
+                tok = float(model.decode(tok))
+                out.append(tok)
+            return out
+    """, "deeplearning4j_tpu/data/fix.py")
+
+
+def test_jx023_negative_batched_materialization_at_step_boundary():
+    # the engine contract: ONE np.asarray per decode step for the whole
+    # slot batch, host-side int() on the already-materialized array rows
+    assert "JX023" not in rules_at("""
+        import numpy as np
+
+        def decode_step(model, toks, caches, occupants):
+            out_dev, caches = model.decode(toks, caches)
+            out = np.asarray(out_dev)            # once per STEP: legal
+            for slot, req in occupants.items():
+                req.emit(int(out[slot]))         # host array row, no sync
+            return caches
+    """, _GENERATION_PATH)
+
+
+def test_jx023_negative_host_only_module_and_list_etl():
+    # pure-host modules (no jax/numpy import) have nothing to sync on,
+    # and np.asarray FROM a list literal is host ETL, not a device fetch
+    assert "JX023" not in rules_at("""
+        def drain(q):
+            while True:
+                ev = q.get()
+                yield ev.item()
+    """, _GENERATION_PATH)
+    assert "JX023" not in rules_at("""
+        import numpy as np
+
+        def pack(rows):
+            for r in rows:
+                yield np.asarray([1, 2, 3])
+    """, _GENERATION_PATH)
+
+
+def test_jx023_pragma_suppresses():
+    assert "JX023" not in rules_at("""
+        import numpy as np
+
+        def warmup(model, buckets):
+            for b in buckets:
+                np.asarray(model.forward(b))  # graftlint: disable=JX023  (warmup: block per compile)
+    """, _SERVING_PATH)
 
 
 # ---------------------------------------------------------------- JX018
@@ -2015,7 +2104,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 18
+    assert len(RULES) == 19
     assert len(PROGRAM_RULES) == 4
 
 

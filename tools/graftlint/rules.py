@@ -1057,6 +1057,55 @@ def jx022(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX023
+# scope: the request-path modules where a repeated device->host sync
+# multiplies by tokens generated, not by requests served — the decode
+# tier (generation/) and the serving front-ends that drive it (serving/)
+_JX023_PATH_RE = re.compile(r"(^|[/\\])(generation|serving)[/\\]")
+
+
+@rule("JX023", "host sync (.item()/float()/np.asarray) inside a per-token "
+               "loop in a generation/serving module")
+def jx023(info: ModuleInfo) -> List[Finding]:
+    """Flag ``float()`` / ``int()`` / ``.item()`` / ``np.asarray()`` on
+    device-derived values inside a ``for``/``while`` body in modules
+    under ``generation/`` or ``serving/``.  The decode loop is the
+    tightest loop in the whole serving stack — one iteration per
+    GENERATED TOKEN, for every active sequence — so a sync there pays
+    the full dispatch round-trip (~24 ms behind this environment's
+    tunnel) per token instead of overlapping the next step's dispatch:
+    at 8 slots that single line caps the tier at ~40 tokens/s no matter
+    how fast the chip is.  The engine's contract is ONE materialization
+    per step boundary for the whole slot batch (``_decode_step``'s
+    batched ``np.asarray``); anything per-token inside a loop is the
+    naive re-forward pattern this subsystem exists to replace.  JX003
+    is the same defect class for training loops; this rule covers the
+    request path, where the loop is bounded by a user's token budget,
+    not an epoch count.  Deliberate syncs (a warmup loop blocking on
+    each bucket's compile) carry a pragma with justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX023_PATH_RE.search(path):
+        return out
+    # pure-host modules (HTTP plumbing with no jax/numpy) can't sync
+    if not (info.jax_aliases or info.jnp_aliases or info.numpy_aliases):
+        return out
+    for node in info.nodes(ast.Call):
+        if not _in_loop_same_function(info, node):
+            continue
+        sync = _host_sync_kind(info, node)
+        if sync:
+            out.append(_finding(
+                info, node, "JX023",
+                f"`{sync}` inside a per-token loop in a "
+                "generation/serving module: pays a device->host "
+                "round-trip every iteration of the request path's "
+                "hottest loop — batch the materialization once per "
+                "decode-step boundary (or pragma a deliberate "
+                "warmup-blocking sync)"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
