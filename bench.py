@@ -56,7 +56,7 @@ recompile count — must stay 0) so serving-throughput regressions are
 driver-visible; DL4J_TPU_BENCH_SERVE=0 suppresses it.
 
 An eighth JSON line records the linter wall-time benchmark
-(``lint_time_ms``: one full-package graftlint run — 18 module rules off
+(``lint_time_ms``: one full-package graftlint run — 20 module rules off
 a shared per-file parse plus the whole-program concurrency pass
 JX018-JX021) so rule additions can't silently blow up developer-loop
 latency; DL4J_TPU_BENCH_LINT=0 suppresses it.
@@ -72,6 +72,12 @@ slot-batched continuous-batching decode engine vs the naive per-token
 full re-forward baseline, on prefill-heavy and decode-heavy mixes, with
 the engine's post-warmup recompile count — must stay 0);
 DL4J_TPU_BENCH_DECODE=0 suppresses it.
+
+An eleventh JSON line records the ZeRO-3 sharded-training benchmark
+(``sharded_step_time_ms``: per-step train time sharded vs replicated at
+a fixed global batch on the same mesh, with per-device parameter bytes
+showing the ~1/dp memory win and the compile-counter-verified single
+trace shared by both paths); DL4J_TPU_BENCH_SHARD=0 suppresses it.
 """
 import json
 import os
@@ -269,7 +275,7 @@ def main():
                               "unit": "ms p50",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
-    # lint wall-time row (ISSUE 9): full-package graftlint — 19 module
+    # lint wall-time row (ISSUE 9): full-package graftlint — 20 module
     # rules + the whole-program concurrency pass — so a rule addition
     # that blows up the developer-loop latency is driver-visible; an
     # eighth JSON line, opt-out DL4J_TPU_BENCH_LINT=0
@@ -311,6 +317,20 @@ def main():
         except Exception as e:  # never let the side row break the headline
             print(json.dumps({"metric": "decode_tokens_per_sec",
                               "value": None, "unit": "tokens/sec",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
+    # sharded-training row (ISSUE 12): ZeRO-3 sharded vs replicated step
+    # time at fixed global batch + per-device param bytes (~1/dp);
+    # an eleventh JSON line, opt-out DL4J_TPU_BENCH_SHARD=0
+    if os.environ.get("DL4J_TPU_BENCH_SHARD", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                sharded_step_time_ms
+            print(json.dumps(sharded_step_time_ms()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "sharded_step_time_ms",
+                              "value": None,
+                              "unit": "ms/step (ZeRO-3 sharded)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
     # side metrics run even on regressed runs — they're the diagnosis data
@@ -426,6 +446,9 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # naive per-token re-forward, prefill-heavy + decode-heavy mixes,
         # zero-recompile-verified
         B.decode_tokens_per_sec,
+        # sharded training (ISSUE 12): ZeRO-3 sharded vs replicated step
+        # time + the 1/dp per-device param-bytes win, single-trace-verified
+        B.sharded_step_time_ms,
     ]
     side = []
     for fn in captures:

@@ -63,6 +63,8 @@ from ..observability.tracer import get_tracer
 __all__ = ["CheckpointManager", "CheckpointConfig", "CorruptCheckpointError",
            "FitCheckpointer", "resume_network"]
 
+_SHARD_FILE_RE = re.compile(r"^shards-p(\d{2,})\.npz$")
+
 log = logging.getLogger("deeplearning4j_tpu.faulttolerance")
 
 _CKPT_RE = re.compile(r"^ckpt-(\d{8,})$")
@@ -125,6 +127,153 @@ class _Snapshot:
         self.rng, self.rng_typed = _rng_to_np(net._rng)
         pol = getattr(net, "shape_policy", None)
         self.shape_policy = pol.snapshot() if pol is not None else None
+
+
+def _tree_items(tree, prefix: str = ""):
+    """Flatten a nested-dict pytree to sorted ``('layer_0/W', leaf)``
+    pairs — the stable key space the sharded checkpoint format indexes
+    params by."""
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            sub = f"{prefix}/{k}" if prefix else str(k)
+            out.extend(_tree_items(tree[k], sub))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def _copy_dict_tree(tree):
+    """Structural copy of a nested-dict pytree (dicts copied, leaves
+    shared) — the staging target for restore_sharded's swap-on-success."""
+    if isinstance(tree, dict):
+        return {k: _copy_dict_tree(v) for k, v in tree.items()}
+    return tree
+
+
+def _parent_of(tree, key: str) -> Tuple[dict, str]:
+    parts = key.split("/")
+    node = tree
+    for p in parts[:-1]:
+        nxt = node.get(p) if isinstance(node, dict) else None
+        if not isinstance(nxt, dict):
+            raise ValueError(f"checkpoint param key {key!r} does not match "
+                             "the target network's param tree")
+        node = nxt
+    if not isinstance(node, dict) or parts[-1] not in node:
+        raise ValueError(f"checkpoint param key {key!r} does not match "
+                         "the target network's param tree")
+    return node, parts[-1]
+
+
+def _get_tree_item(tree, key: str):
+    node, leaf = _parent_of(tree, key)
+    return node[leaf]
+
+
+def _set_tree_item(tree, key: str, value) -> None:
+    node, leaf = _parent_of(tree, key)
+    node[leaf] = value
+
+
+def _leaf_blocks(leaf) -> Tuple[Optional[int], List[Tuple[int, np.ndarray]]]:
+    """``(sharded_dim, [(start, host_block), ...])`` for the shards of one
+    leaf THIS process holds, deduped across replica devices (tp/sp axes
+    hold copies of the same block).  Replicated / host leaves yield
+    ``(None, [(0, whole)])``.  Blocks are owned host copies — the next
+    train step may donate the source buffers.
+
+    The format indexes blocks by ONE sharded dim (the ZeRO-3 layout);
+    a leaf partitioned over two or more axes (a TP ``param_rule``
+    composed with dp) cannot be represented — refuse at save time
+    rather than dedupe away the extra axis and commit a store every
+    restore rejects."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        return None, [(0, np.array(leaf))]
+    gshape = tuple(np.shape(leaf))
+    dim = None
+    blocks: Dict[int, np.ndarray] = {}
+    for s in shards:
+        bshape = tuple(np.shape(s.data))
+        if dim is None and bshape != gshape:
+            cut = [i for i, (b, g) in enumerate(zip(bshape, gshape))
+                   if b != g]
+            if len(cut) > 1:
+                raise NotImplementedError(
+                    f"save_sharded: leaf sharded over {len(cut)} axes "
+                    f"(shard {bshape} of {gshape}) — the sharded "
+                    "checkpoint format indexes one sharded dim per leaf "
+                    "(the ZeRO-3 layout); save TP-sharded params through "
+                    "the dense path")
+            dim = cut[0]
+        start = 0
+        if dim is not None and len(s.index) > dim:
+            start = int(s.index[dim].start or 0)
+        if start not in blocks:
+            blocks[start] = np.array(s.data)
+    return dim, sorted(blocks.items())
+
+
+class _ShardedSnapshot:
+    """Host snapshot of a SHARDED network for ``save_sharded``: the model
+    container is written param-less; each param / updater leaf is captured
+    as this process's local shard blocks only — the global arrays never
+    materialize on one host (the 1/dp memory story holds through the
+    checkpoint path too).  RNG-neutral like :class:`_Snapshot`."""
+
+    def __init__(self, net, process_index: int, process_count: int,
+                 save_updater: bool = True):
+        import jax
+        self.net_class = type(net).__name__
+        self.conf = net.conf
+        self.params = {}            # model.zip carries conf+state only
+        self.state = _host_copy(net.state)
+        self.opt_state = None
+        self.iteration = int(net.iteration)
+        self.step = self.iteration
+        self.epoch = int(net.epoch)
+        self.rng, self.rng_typed = _rng_to_np(net._rng)
+        pol = getattr(net, "shape_policy", None)
+        self.shape_policy = pol.snapshot() if pol is not None else None
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        primary = self.process_index == 0
+        mesh_desc = None
+        topo_params: Dict[str, Any] = {}
+        self.blocks: List[Tuple[str, str, Optional[int],
+                                List[Tuple[int, np.ndarray]]]] = []
+        for key, leaf in _tree_items(net.params):
+            dim, blocks = _leaf_blocks(leaf)
+            sh = getattr(leaf, "sharding", None)
+            if mesh_desc is None and sh is not None and \
+                    getattr(sh, "mesh", None) is not None:
+                mesh_desc = {"axes": list(sh.mesh.axis_names),
+                             "shape": [int(sh.mesh.shape[a])
+                                       for a in sh.mesh.axis_names]}
+            topo_params[key] = {"shape": [int(n) for n in np.shape(leaf)],
+                                "dtype": str(np.dtype(leaf.dtype)),
+                                "dim": dim}
+            if dim is not None or primary:
+                # replicated leaves are identical everywhere: only the
+                # primary writes them (no process_count-fold duplication)
+                self.blocks.append(("param", key, dim, blocks))
+        topo_opt: List[Dict[str, Any]] = []
+        opt_leaves = [] if (net.opt_state is None or not save_updater) \
+            else jax.tree_util.tree_leaves(net.opt_state)
+        for i, leaf in enumerate(opt_leaves):
+            dim, blocks = _leaf_blocks(leaf)
+            topo_opt.append({"shape": [int(n) for n in np.shape(leaf)],
+                             "dtype": str(np.dtype(
+                                 getattr(leaf, "dtype", np.asarray(leaf).dtype))),
+                             "dim": dim})
+            if dim is not None or primary:
+                self.blocks.append(("opt", str(i), dim, blocks))
+        self.topology = {"version": 1,
+                         "process_count": self.process_count,
+                         "mesh": mesh_desc,
+                         "params": topo_params,
+                         "opt": topo_opt}
 
 
 class CheckpointManager:
@@ -226,6 +375,60 @@ class CheckpointManager:
             t.start()
         return final
 
+    def save_sharded(self, net, *, cursor: Optional[Dict[str, int]] = None,
+                     metric: Optional[float] = None,
+                     blocking: Optional[bool] = None,
+                     step: Optional[int] = None,
+                     process_index: Optional[int] = None,
+                     process_count: Optional[int] = None) -> str:
+        """Shard-aware checkpoint of a mesh-sharded ``net`` (the ZeRO-3
+        ``parallel.sharded.ShardedTrainer`` layout): the model container
+        is written WITHOUT params, and every param/updater leaf is saved
+        as this process's local shard blocks (``shards-pNN.npz`` + index)
+        plus a ``topology.json`` manifest (mesh shape, per-leaf sharded
+        dim, global shapes/dtypes).  The global arrays never materialize
+        on one host.  Restore with :meth:`restore_sharded` — onto ANY
+        mesh topology (portable resharding, arXiv:2112.01075).
+
+        Multi-host note: the format indexes ``process_count`` shard
+        files, but the single-commit flow below is the one-process (all
+        shards addressable) writer; a multi-host save needs each process
+        to stage its shard file and a barrier before the primary's
+        commit — refuse rather than silently write a torn store."""
+        import jax
+        if process_index is None:
+            process_index = jax.process_index()
+        if process_count is None:
+            process_count = jax.process_count()
+        if process_index != 0 or process_count > 1:
+            # a primary-only commit in a multi-process world would record
+            # process_count shard files in topology.json but write ONE —
+            # a torn checkpoint every restore refuses; refuse up front
+            raise NotImplementedError(
+                "multi-host save_sharded needs a staged-write barrier "
+                "(every process's shard file must land before the "
+                "primary commits) — route multi-process saves through "
+                "the elastic coordinator")
+        snap = _ShardedSnapshot(net, process_index, process_count,
+                                save_updater=self.save_updater)
+        if step is not None:
+            snap.step = int(step)
+        final = self.path_for(snap.step)
+        if blocking is None:
+            blocking = not self.background
+        self.wait()                       # double-buffer: one in flight
+        if blocking:
+            self._write_sharded(snap, final, cursor, metric, mode="sync")
+        else:
+            t = threading.Thread(
+                target=self._write_guarded,
+                args=(snap, final, cursor, metric, self._write_sharded),
+                daemon=False, name="dl4j-ckpt-writer")
+            with self._lock:
+                self._worker = t
+            t.start()
+        return final
+
     def wait(self) -> None:
         """Block until any in-flight background write commits."""
         with self._lock:
@@ -233,9 +436,11 @@ class CheckpointManager:
         if t is not None:
             t.join()
 
-    def _write_guarded(self, snap, final, cursor, metric) -> None:
+    def _write_guarded(self, snap, final, cursor, metric,
+                       writer=None) -> None:
         try:
-            self._write(snap, final, cursor, metric, mode="async")
+            (writer or self._write)(snap, final, cursor, metric,
+                                    mode="async")
         except Exception as e:
             self.last_error = e
             log.exception("background checkpoint to %s failed", final)
@@ -260,27 +465,88 @@ class CheckpointManager:
                 time.sleep(self._test_slow_s)
             if self.chaos is not None:
                 self.chaos.on_commit_stage(snap.step, 2)
-            state = {
-                "cursor": dict(cursor or {}),
-                "iteration": snap.iteration,
-                "epoch": snap.epoch,
-                "rng_typed": bool(snap.rng_typed),
-                "shape_policy": snap.shape_policy,
-                "metric": None if metric is None else float(metric),
-            }
-            with open(os.path.join(tmp, "training_state.json"), "w",
-                      encoding="utf-8") as f:
-                json.dump(state, f, sort_keys=True, indent=1)
-            files = manifest_for(tmp)
-            nbytes = sum(int(v["bytes"]) for v in files.values())
-            manifest = {"version": _MANIFEST_VERSION,
-                        "step": snap.step, "epoch": snap.epoch,
-                        "iteration": snap.iteration,
-                        "metric": state["metric"],
-                        "wall_time": time.time(),
-                        "files": files}
-            atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
-            commit_dir(tmp, final)
+            nbytes = self._finish_staging(tmp, final, snap, cursor, metric)
+        self._observe_write(monotonic_s() - t0, nbytes, mode)
+        try:
+            self._apply_retention()
+        except OSError:
+            log.warning("checkpoint retention sweep failed in %s",
+                        self.directory, exc_info=True)
+
+    def _finish_staging(self, tmp: str, final: str, snap, cursor,
+                        metric, sharded: bool = False) -> int:
+        """Write training_state.json + the checksum manifest into a staged
+        checkpoint dir, then commit it with ONE rename.  Returns committed
+        bytes.  Shared by the dense and sharded writers."""
+        state = {
+            "cursor": dict(cursor or {}),
+            "iteration": snap.iteration,
+            "epoch": snap.epoch,
+            "rng_typed": bool(snap.rng_typed),
+            "shape_policy": snap.shape_policy,
+            "metric": None if metric is None else float(metric),
+        }
+        if sharded:
+            state["sharded"] = True
+        with open(os.path.join(tmp, "training_state.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(state, f, sort_keys=True, indent=1)
+        files = manifest_for(tmp)
+        nbytes = sum(int(v["bytes"]) for v in files.values())
+        manifest = {"version": _MANIFEST_VERSION,
+                    "step": snap.step, "epoch": snap.epoch,
+                    "iteration": snap.iteration,
+                    "metric": state["metric"],
+                    "wall_time": time.time(),
+                    "files": files}
+        if sharded:
+            manifest["sharded"] = True
+        atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
+        commit_dir(tmp, final)
+        return nbytes
+
+    def _write_sharded(self, snap: "_ShardedSnapshot", final: str, cursor,
+                       metric, mode: str) -> None:
+        from ..utils import model_serializer
+
+        t0 = monotonic_s()
+        with get_tracer().span("checkpoint.write_sharded",
+                               step=snap.iteration, mode=mode):
+            tmp = staging_dir(final)
+            # param-less container: conf + replicated layer state + meta
+            model_serializer.write_model(
+                snap, os.path.join(tmp, "model.zip"), save_updater=False)
+            np.save(os.path.join(tmp, "rng.npy"), snap.rng)
+            atomic_write_json(os.path.join(tmp, "topology.json"),
+                              snap.topology)
+            # same crash-consistency probes as the dense writer: the slow
+            # hook widens the staging window for SIGKILL tests, the chaos
+            # stages hard-kill between staged writes (shards-after-
+            # container and manifest-after-shards are the two torn-store
+            # windows the temp-then-rename protocol must survive)
+            if self._test_slow_s:
+                time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                self.chaos.on_commit_stage(snap.step, 1)
+            arrays: Dict[str, np.ndarray] = {}
+            index: List[Dict[str, Any]] = []
+            for kind, leaf_key, dim, blocks in snap.blocks:
+                for start, block in blocks:
+                    name = f"b{len(index)}"
+                    arrays[name] = block
+                    index.append({"name": name, "kind": kind,
+                                  "leaf": leaf_key, "dim": dim,
+                                  "start": int(start)})
+            pidx = snap.process_index
+            np.savez(os.path.join(tmp, f"shards-p{pidx:02d}.npz"), **arrays)
+            atomic_write_json(os.path.join(tmp, f"shards-p{pidx:02d}.json"),
+                              index)
+            if self._test_slow_s:
+                time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                self.chaos.on_commit_stage(snap.step, 2)
+            nbytes = self._finish_staging(tmp, final, snap, cursor, metric,
+                                          sharded=True)
         self._observe_write(monotonic_s() - t0, nbytes, mode)
         try:
             self._apply_retention()
@@ -394,6 +660,10 @@ class CheckpointManager:
         except CorruptCheckpointError:
             self._count_restore("corrupt")
             raise
+        if os.path.isfile(os.path.join(path, "topology.json")):
+            raise ValueError(
+                f"{path} is a SHARDED checkpoint (its model container "
+                "carries no params) — use restore_sharded()")
         if net is None:
             net = model_serializer.restore_model(
                 os.path.join(path, "model.zip"), load_updater=load_updater)
@@ -401,6 +671,153 @@ class CheckpointManager:
             model_serializer.load_into(
                 net, os.path.join(path, "model.zip"),
                 load_updater=load_updater)
+        state = _read_training_state(path)
+        _apply_training_state(net, state)
+        _apply_rng(net, path, state)
+        self._count_restore("ok")
+        return net, state
+
+    def restore_sharded(self, path: Optional[str] = None, net=None, *,
+                        mesh=None, min_shard_size: Optional[int] = None,
+                        load_updater: bool = True):
+        """Restore a :meth:`save_sharded` checkpoint, RESHARDING onto any
+        mesh topology: shard blocks from every process file are
+        reassembled host-side into global leaves, then re-placed with the
+        ZeRO-3 layout rule for ``mesh``'s data-axis size — a dp=4
+        checkpoint restores onto a dp=2 or dp=8 mesh with bitwise-equal
+        global params (reassembly and re-placement move bytes, never
+        arithmetic).  This is also the elastic-rejoin path for sharded
+        models: the surviving world size just becomes the new mesh.
+
+        ``mesh=None`` leaves the restored leaves unsharded on the default
+        device (wrap in a ``ShardedTrainer`` to place them later).
+        ``min_shard_size`` feeds the layout rule (default: the trainer's
+        default threshold).  Returns ``(net, training_state)`` like
+        :meth:`restore`.  Refuses partial/corrupt checkpoints — a shard
+        file failing its manifest checksum raises
+        :class:`CorruptCheckpointError`."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.mesh import (DATA_AXIS, DEFAULT_MIN_SHARD_SIZE,
+                                     place_sharded, zero3_spec)
+        from ..utils import model_serializer
+
+        if path is None:
+            path = self.latest()
+            if path is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoint found in {self.directory}")
+        try:
+            self.validate(path)
+        except CorruptCheckpointError:
+            self._count_restore("corrupt")
+            raise
+        tpath = os.path.join(path, "topology.json")
+        if not os.path.isfile(tpath):
+            raise ValueError(
+                f"{path} is not a sharded checkpoint (no topology.json) — "
+                "use restore()")
+        with open(tpath, encoding="utf-8") as f:
+            topo = json.load(f)
+
+        # ---- gather every process's blocks ---------------------------
+        shard_files = sorted(n for n in os.listdir(path)
+                             if _SHARD_FILE_RE.match(n))
+        want = int(topo.get("process_count", 1))
+        if len(shard_files) != want:
+            self._count_restore("corrupt")
+            raise CorruptCheckpointError(
+                path, f"expected {want} shard file(s), found "
+                      f"{len(shard_files)}")
+        blocks: Dict[Tuple[str, str], List[Tuple[int, np.ndarray]]] = {}
+        dims: Dict[Tuple[str, str], Optional[int]] = {}
+        for fname in shard_files:
+            ipath = os.path.join(path, fname[:-len(".npz")] + ".json")
+            if not os.path.isfile(ipath):
+                self._count_restore("corrupt")
+                raise CorruptCheckpointError(path, f"{fname} has no index")
+            with open(ipath, encoding="utf-8") as f:
+                index = json.load(f)
+            with np.load(os.path.join(path, fname)) as z:
+                for entry in index:
+                    k = (entry["kind"], entry["leaf"])
+                    dims[k] = entry["dim"]
+                    bl = blocks.setdefault(k, [])
+                    start = int(entry["start"])
+                    if all(s != start for s, _ in bl):
+                        bl.append((start, z[entry["name"]]))
+
+        def assemble(kind: str, leaf_key: str, spec: Dict[str, Any]):
+            k = (kind, leaf_key)
+            if k not in blocks:
+                self._count_restore("corrupt")
+                raise CorruptCheckpointError(
+                    path, f"no shard blocks for {kind} leaf {leaf_key}")
+            dim = dims[k]
+            parts = sorted(blocks[k])
+            arr = parts[0][1] if dim is None else np.concatenate(
+                [b for _, b in parts], axis=dim)
+            if list(arr.shape) != list(spec["shape"]):
+                self._count_restore("corrupt")
+                raise CorruptCheckpointError(
+                    path, f"{kind} leaf {leaf_key}: reassembled shape "
+                          f"{list(arr.shape)} != manifest {spec['shape']}")
+            return arr
+
+        # ---- the target network --------------------------------------
+        mzip = os.path.join(path, "model.zip")
+        if net is None:
+            net = model_serializer.restore_model(mzip, load_updater=False)
+        else:
+            model_serializer.load_into(net, mzip, load_updater=False)
+
+        # ---- re-placement under the NEW topology ---------------------
+        ms = DEFAULT_MIN_SHARD_SIZE if min_shard_size is None \
+            else int(min_shard_size)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            dp = mesh.shape.get(DATA_AXIS, 1)
+
+            def place(arr):
+                return place_sharded(arr, NamedSharding(
+                    mesh, zero3_spec(arr.shape, dp, ms)))
+        else:
+            place = jnp.asarray
+
+        # stage EVERYTHING (params and updater) before touching the net,
+        # then swap in one block — a mid-restore mismatch (renamed layer,
+        # wrong shapes, different updater config) must never leave a
+        # caller's live net half old, half new
+        staged = _copy_dict_tree(net.params)
+        for key, spec in topo.get("params", {}).items():
+            cur = _get_tree_item(staged, key)
+            if list(np.shape(cur)) != list(spec["shape"]):
+                raise ValueError(
+                    f"checkpoint param {key!r} has shape {spec['shape']} "
+                    f"but the target network's is {list(np.shape(cur))} — "
+                    "topology mismatch")
+            arr = assemble("param", key, spec)
+            _set_tree_item(staged, key, place(arr))
+        opt_specs = topo.get("opt") or []
+        staged_opt = None
+        if load_updater and opt_specs:
+            if net._tx is None:
+                net._tx = net._build_tx()
+            template = net.opt_state if net.opt_state is not None \
+                else net._tx.init(net.params)
+            treedef = jax.tree_util.tree_structure(template)
+            fresh = jax.tree_util.tree_leaves(template)
+            if len(fresh) != len(opt_specs):
+                raise ValueError(
+                    f"updater state mismatch: saved {len(opt_specs)} "
+                    f"leaves, model needs {len(fresh)}")
+            staged_opt = jax.tree_util.tree_unflatten(
+                treedef, [place(assemble("opt", str(i), spec))
+                          for i, spec in enumerate(opt_specs)])
+        net.params = staged
+        if staged_opt is not None:
+            net.opt_state = staged_opt
         state = _read_training_state(path)
         _apply_training_state(net, state)
         _apply_rng(net, path, state)

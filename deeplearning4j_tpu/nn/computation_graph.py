@@ -624,6 +624,14 @@ class ComputationGraph:
         return sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(self.params))
 
+    def param_bytes(self, per_device: bool = False) -> int:
+        """Parameter memory: global bytes, or with ``per_device=True`` the
+        bytes ONE device holds — a ZeRO-3 sharded graph (``parallel/
+        sharded.py`` NamedSharding layout) reports ~1/dp of global."""
+        from ..parallel.sharded import param_bytes, per_device_param_bytes
+        return per_device_param_bytes(self.params) if per_device \
+            else param_bytes(self.params)
+
     def evaluate(self, iterator_or_x, y=None):
         from ..evaluation.classification import Evaluation
         return self._evaluate_with(Evaluation(), iterator_or_x, y)

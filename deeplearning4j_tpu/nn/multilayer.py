@@ -1210,6 +1210,14 @@ class MultiLayerNetwork:
         return sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(self.params))
 
+    def param_bytes(self, per_device: bool = False) -> int:
+        """Parameter memory: global bytes, or with ``per_device=True`` the
+        bytes ONE device holds — a ZeRO-3 sharded net (``parallel/
+        sharded.py`` NamedSharding layout) reports ~1/dp of global."""
+        from ..parallel.sharded import param_bytes, per_device_param_bytes
+        return per_device_param_bytes(self.params) if per_device \
+            else param_bytes(self.params)
+
     def params_flat(self) -> np.ndarray:
         """Flat param vector — serialization/compat view, NOT a runtime
         invariant (see SURVEY §7 'hardest parts')."""

@@ -34,6 +34,17 @@ Dynamic-scale state rides in the network ``state`` pytree under the
 reserved ``"__precision__"`` key (a dict of three scalars), so it is
 donated through the step, checkpointed, and restored like every other
 piece of training state.
+
+**Sharded masters** (ZeRO-3, ``parallel/sharded.py``): because the
+masters are simply the param pytree, laying params out with a
+``NamedSharding`` over the data axis makes them *sharded* masters with
+no code here changing — the in-step per-layer cast produces the bf16
+compute values (GSPMD may all-gather in bf16, halving the gather
+bytes), gradients unscale/accumulate against the f32 shard, and the
+updater applies its f32 update to the local shard only.  Tier-1 pins
+this composition: a bf16 sharded run is bit-identical to the bf16
+replicated run, and the masters never leave full precision
+(``tests/test_sharded.py::test_sharded_masters_bf16_matches_replicated``).
 """
 from __future__ import annotations
 

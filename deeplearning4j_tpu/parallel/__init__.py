@@ -14,9 +14,11 @@ from .layer import DistributedLayerTrainer
 from .master import (ParameterAveragingTrainingMaster,
                      SharedGradientsTrainingMaster, TrainingMaster,
                      TrainingMasterStats, tree_average)
-from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh, shard_batch
+from .mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh,
+                   place_sharded, shard_batch, shard_params, zero3_spec)
 from .pipeline import gpipe, stack_stage_params
 from .sequence import ring_self_attention, ulysses_attention
+from .sharded import (ShardedTrainer, param_bytes, per_device_param_bytes)
 from .wrapper import ParallelWrapper, megatron_dense_rule
 
 __all__ = [
@@ -28,6 +30,8 @@ __all__ = [
     "global_device_mesh", "gpipe", "initialize_distributed", "make_mesh",
     "megatron_dense_rule", "ring_self_attention", "shard_batch",
     "stack_stage_params", "threshold_decode", "threshold_encode",
+    "ShardedTrainer", "shard_params", "zero3_spec", "place_sharded",
+    "param_bytes", "per_device_param_bytes",
     "tree_average", "ulysses_attention", "init_moe_params",
     "make_moe_train_step", "moe_ffn", "TrainingMasterStats",
     "RemoteGradientSharing", "encode_message_bytes", "decode_message_bytes",

@@ -24,10 +24,17 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
+#: ZeRO-3 layout threshold: param leaves with fewer elements replicate
+#: (sharding a bias saves nothing and adds a collective)
+DEFAULT_MIN_SHARD_SIZE = 1024
+
 
 def make_mesh(n_devices: Optional[int] = None, *, dp: Optional[int] = None,
               tp: int = 1, sp: int = 1, devices=None) -> Mesh:
-    """Build a (data, model, seq) mesh. dp defaults to filling all devices."""
+    """Build a (data, model, seq) mesh. dp defaults to filling all devices;
+    an explicit ``dp`` smaller than the device count takes the first
+    ``dp*tp*sp`` devices (sub-meshes of one device set share trace-cache
+    entries, so a dp=2 and a dp=4 run compile from ONE trace)."""
     if devices is None:
         devices = jax.devices()
     if n_devices is None:
@@ -37,7 +44,13 @@ def make_mesh(n_devices: Optional[int] = None, *, dp: Optional[int] = None,
         if n_devices % (tp * sp):
             raise ValueError(f"{n_devices} devices not divisible by tp*sp={tp*sp}")
         dp = n_devices // (tp * sp)
-    arr = np.array(devices).reshape(dp, tp, sp)
+    need = dp * tp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh dp*tp*sp = {dp}*{tp}*{sp} = {need} oversubscribes the "
+            f"{len(devices)} available device(s) — lower dp (or tp/sp), or "
+            "pass more devices=")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
@@ -58,4 +71,57 @@ def shard_batch(mesh: Mesh, x, *, seq_axis: Optional[int] = None):
     if x is None:
         return None
     sh = NamedSharding(mesh, batch_spec(np.ndim(x), seq_axis=seq_axis))
-    return jax.device_put(x, sh)
+    return place_sharded(x, sh)
+
+
+def zero3_spec(shape: Sequence[int], dp: int, min_size: int) -> P:
+    """ZeRO-3 row-sharding rule for ONE parameter leaf: the first axis
+    divisible by the data-axis size is sharded over ``data``; leaves with
+    fewer than ``min_size`` elements (biases, scalars, norms) replicate —
+    sharding them saves nothing and costs a collective per step."""
+    if dp <= 1 or int(np.prod(shape, dtype=np.int64)) < max(min_size, dp):
+        return P()
+    for i, n in enumerate(shape):
+        if n >= dp and n % dp == 0:
+            spec = [None] * len(shape)
+            spec[i] = DATA_AXIS
+            return P(*spec)
+    return P()
+
+
+def shard_params(mesh: Mesh, pytree, min_size: int = DEFAULT_MIN_SHARD_SIZE):
+    """NamedSharding pytree for a param (or param-shaped) pytree: each
+    leaf row-sharded over the ``data`` axis per :func:`zero3_spec`, with
+    a replicated fallback for sub-threshold leaves.  Shared by the
+    ZeRO-3 trainer (``parallel/sharded.py``), checkpoint resharding, and
+    the tests that pin the layout rules."""
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(
+            mesh, zero3_spec(np.shape(leaf), dp, min_size)), pytree)
+
+
+def place_sharded(x, sharding: NamedSharding):
+    """``device_put`` onto a NamedSharding, with a per-shard fallback.
+
+    Some backends (the CPU backend under multi-process
+    ``jax.distributed``, PR 7's recorded limitation) don't implement a
+    direct ``device_put`` onto a multi-process NamedSharding.  Rather
+    than crash mid-fit, fall back to placing each addressable shard on
+    its own device and assembling with
+    ``jax.make_array_from_single_device_arrays`` — semantically the same
+    placement, built from the primitives every backend has."""
+    if x is None:
+        return None
+    try:
+        return jax.device_put(x, sharding)
+    except Exception as direct_err:
+        host = np.asarray(x)
+        try:
+            idx_map = sharding.addressable_devices_indices_map(host.shape)
+            arrs = [jax.device_put(host[idx], d)
+                    for d, idx in idx_map.items()]
+            return jax.make_array_from_single_device_arrays(
+                host.shape, sharding, arrs)
+        except Exception:
+            raise direct_err

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, batch_spec, make_mesh, shard_batch
+from .mesh import (DATA_AXIS, MODEL_AXIS, batch_spec, make_mesh,
+                   place_sharded, shard_batch, zero3_spec)
 from ..observability.clock import monotonic_s
 from ..observability.registry import default_registry
 from ..observability.tracer import get_tracer
@@ -35,6 +36,29 @@ def _param_specs(params, rule: Optional[Callable[[str, str, Any], P]]):
         out[lname] = {pname: rule(lname, pname, leaf)
                       for pname, leaf in lp.items()}
     return out
+
+
+def place_opt_state(opt_state, param_treedef, place_param_tree: Callable,
+                    place_other: Callable):
+    """Walk an optax state pytree: subtrees shaped exactly like the params
+    (mu/nu/trace...) are placed by ``place_param_tree``; every other leaf
+    (step counts, scalars) by ``place_other``.  Container structure
+    (NamedTuples, tuples, lists, dicts) is preserved.  Shared by the
+    replicated wrapper and the ZeRO-3 sharded trainer."""
+    def walk(o):
+        if jax.tree_util.tree_structure(o) == param_treedef:
+            return place_param_tree(o)
+        if isinstance(o, tuple) and hasattr(o, "_fields"):  # NamedTuple
+            return type(o)(*[walk(c) for c in o])
+        if isinstance(o, tuple):
+            return tuple(walk(c) for c in o)
+        if isinstance(o, list):
+            return [walk(c) for c in o]
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in o.items()}
+        return place_other(o)
+
+    return walk(opt_state)
 
 
 def megatron_dense_rule(params) -> Callable[[str, str, Any], P]:
@@ -93,43 +117,35 @@ class ParallelWrapper:
         to_sh = lambda spec: NamedSharding(mesh, spec)
         self.param_shardings = jax.tree_util.tree_map(
             to_sh, pspecs, is_leaf=lambda x: isinstance(x, P))
-        m.params = jax.tree_util.tree_map(jax.device_put, m.params,
+        # place_sharded: direct device_put with the per-shard assembly
+        # fallback for backends where a multi-process NamedSharding put
+        # is unimplemented (the CPU rig limitation PR 7 recorded)
+        m.params = jax.tree_util.tree_map(place_sharded, m.params,
                                           self.param_shardings)
         repl = NamedSharding(mesh, P())
-        m.state = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), m.state)
+        m.state = jax.tree_util.tree_map(
+            lambda a: place_sharded(a, repl), m.state)
         # optimizer state: subtrees shaped like params (optax mu/nu/trace...)
         # get the param sharding; everything else (counts) is replicated
         param_treedef = jax.tree_util.tree_structure(m.params)
 
         def zero1_sharding(leaf):
-            """First dim divisible by the data-axis size gets sharded;
-            otherwise replicate (small biases, scalars)."""
+            """The shared ZeRO layout rule, threshold 0 (ZeRO-1 shards
+            every divisible optimizer leaf; biases/scalars replicate
+            because no axis divides)."""
             d = self.mesh.shape.get(DATA_AXIS, 1)
-            shp = getattr(leaf, "shape", ())
-            for i, n in enumerate(shp):
-                if n % d == 0 and n >= d:
-                    spec = [None] * len(shp)
-                    spec[i] = DATA_AXIS
-                    return NamedSharding(mesh, P(*spec))
-            return NamedSharding(mesh, P())
+            return NamedSharding(
+                mesh, zero3_spec(getattr(leaf, "shape", ()), d, 0))
 
-        def place_opt(o):
-            if jax.tree_util.tree_structure(o) == param_treedef:
-                if self.shard_optimizer_state and self.param_rule is None:
-                    return jax.tree_util.tree_map(
-                        lambda a: jax.device_put(a, zero1_sharding(a)), o)
-                return jax.tree_util.tree_map(jax.device_put, o, self.param_shardings)
-            if isinstance(o, tuple) and hasattr(o, "_fields"):  # NamedTuple state
-                return type(o)(*[place_opt(c) for c in o])
-            if isinstance(o, tuple):
-                return tuple(place_opt(c) for c in o)
-            if isinstance(o, list):
-                return [place_opt(c) for c in o]
-            if isinstance(o, dict):
-                return {k: place_opt(v) for k, v in o.items()}
-            return jax.device_put(o, repl)
-
-        m.opt_state = place_opt(m.opt_state)
+        if self.shard_optimizer_state and self.param_rule is None:
+            place_param_tree = lambda o: jax.tree_util.tree_map(
+                lambda a: place_sharded(a, zero1_sharding(a)), o)
+        else:
+            place_param_tree = lambda o: jax.tree_util.tree_map(
+                place_sharded, o, self.param_shardings)
+        m.opt_state = place_opt_state(
+            m.opt_state, param_treedef, place_param_tree,
+            lambda o: place_sharded(o, repl))
 
     # ---- model duck-typing (EarlyStoppingTrainer & friends) ----------
     @property

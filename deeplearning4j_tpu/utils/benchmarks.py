@@ -16,7 +16,7 @@ rows and span histograms are directly comparable.
 from __future__ import annotations
 
 import os
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -963,7 +963,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 19 module rules off the
+    through the public ``lint_paths`` API — 20 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression
@@ -1123,4 +1123,104 @@ def obs_overhead_ms(hidden: int = 256, features: int = 128,
         "target_pct": 2.0,
         "steps": n_batches,
         "runs": max(1, runs),
+    }
+
+
+def sharded_step_time_ms(hidden: int = 512, features: int = 256,
+                         classes: int = 32, batch: int = 64,
+                         steps: int = 12, warm: int = 2,
+                         dp: Optional[int] = None,
+                         min_shard_size: Optional[int] = None) -> Dict:
+    """ZeRO-3 sharded-training benchmark (ISSUE 12): steady per-step
+    train time through ``parallel.ShardedTrainer`` (params + updater
+    state row-sharded over the data axis; reduce-scatter gradients,
+    shard-local update, XLA-inserted forward all-gather) vs the
+    replicated ``ParallelWrapper`` (full params per device, dense
+    all-reduce) at a FIXED global batch on the same mesh — plus the
+    memory side of the trade: per-device parameter bytes, which the
+    sharded layout holds at ~1/dp of replicated (``param_bytes_ratio``).
+
+    ``train_step_traces`` carries the compile-counter delta across BOTH
+    runs: the sharded and replicated paths execute the same jitted
+    program from the process-global trace cache (sharding lives in the
+    arguments, not the trace), so the whole bench traces ONCE.  On the
+    1-core CPU rig the collectives are memcpy loops and sharding is pure
+    overhead (``vs_replicated`` > 1 is expected there); the row exists
+    to track the trajectory and the memory win, which is
+    backend-independent."""
+    import jax
+
+    from ..nn.conf.input_type import InputType
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..observability.registry import default_registry
+    from ..parallel import (ParallelWrapper, ShardedTrainer, make_mesh,
+                            param_bytes, per_device_param_bytes)
+
+    from ..parallel.mesh import DEFAULT_MIN_SHARD_SIZE
+    if min_shard_size is None:
+        # track the trainer's default so the row always measures the
+        # layout ShardedTrainer actually ships
+        min_shard_size = DEFAULT_MIN_SHARD_SIZE
+    if dp is None:
+        dp = len(jax.devices())
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=0.01)).list()
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(features)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((batch, features)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, batch)]
+
+    def traces() -> float:
+        c = default_registry().get("training_compile_total")
+        return 0.0 if c is None else c.labels("train_step").value
+
+    t_before = traces()
+    mesh = make_mesh(dp=dp, tp=1, sp=1)
+    results = {}
+    nets = []   # keep both nets alive: the shared trace-cache entry is
+    # weak-valued, so dropping the first net would free the jitted step
+    # and bill the second run a spurious retrace
+    for impl in ("replicated", "sharded"):
+        net = build()
+        nets.append(net)
+        tr = ParallelWrapper(net, mesh) if impl == "replicated" else \
+            ShardedTrainer(net, mesh, min_shard_size=min_shard_size)
+        tr.fit(iter([(x, y, None, None)] * max(1, warm)))   # compile+warm
+        t0 = monotonic_s()
+        # wrapper.fit closes on a final host sync of the score, so the
+        # clock reads device completion, not enqueue
+        tr.fit(iter([(x, y, None, None)] * steps))
+        ms = (monotonic_s() - t0) / steps * 1e3
+        results[impl] = (ms, per_device_param_bytes(net.params),
+                         param_bytes(net.params))
+    sh_ms, sh_dev_bytes, global_bytes = results["sharded"]
+    rep_ms, rep_dev_bytes, _ = results["replicated"]
+    return {
+        "metric": "sharded_step_time_ms",
+        "value": round(sh_ms, 3),
+        "unit": f"ms/step (dp={dp} ZeRO-3 sharded)",
+        "replicated_ms": round(rep_ms, 3),
+        "vs_replicated": round(sh_ms / rep_ms, 3) if rep_ms else None,
+        "dp": dp,
+        "global_batch": batch,
+        "param_bytes_per_device": int(sh_dev_bytes),
+        "replicated_param_bytes": int(rep_dev_bytes),
+        "param_bytes_ratio": round(sh_dev_bytes / rep_dev_bytes, 4)
+        if rep_dev_bytes else None,
+        "global_param_bytes": int(global_bytes),
+        "min_shard_size": int(min_shard_size),
+        "train_step_traces": int(traces() - t_before),
+        "steps": steps,
     }

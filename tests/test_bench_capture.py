@@ -4,6 +4,8 @@ retries tag rows ``invalid`` rather than shipping degraded-window numbers."""
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from bench import probe_bracketed_capture  # noqa: E402
 
@@ -144,7 +146,7 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 23
+    assert row["rules"] == 24
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
 
@@ -176,3 +178,35 @@ def test_decode_tokens_per_sec_rows():
         assert row["decode_steps"] > 0
         # the warmed two-program set held across the whole mixed run
         assert row["steady_recompiles"] == 0
+
+
+def test_sharded_step_time_ms_row():
+    """The sharded-training bench line (ISSUE 12): sharded + replicated
+    step ms at a fixed global batch, the per-device param-bytes ~1/dp
+    memory win, and the counter-verified single trace shared by both
+    paths.  Tiny CPU config — on the 1-core rig the collectives are
+    memcpy loops, so only the row contract, the bytes ratio, and the
+    trace count are stable (the ms ratio is asserted at real scale)."""
+    import jax
+
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    row = B.sharded_step_time_ms(hidden=64, features=32, classes=8,
+                                 batch=32, steps=3, warm=1,
+                                 min_shard_size=0)
+    assert row["metric"] == "sharded_step_time_ms"
+    assert row["unit"].startswith("ms/step")
+    assert row["value"] > 0 and row["replicated_ms"] > 0
+    assert row["vs_replicated"] > 0
+    assert row["dp"] == 8
+    # the ZeRO-3 memory win: with every eligible leaf sharded, the
+    # per-device bytes land well under replicated — here all four dense
+    # kernels shard, so the ratio sits near 1/dp (biases replicate)
+    assert row["param_bytes_per_device"] < row["replicated_param_bytes"]
+    assert row["param_bytes_ratio"] <= 0.25
+    assert row["global_param_bytes"] == row["replicated_param_bytes"]
+    # sharding lives in the arguments, not the trace: the replicated and
+    # sharded runs share ONE trace of the train step
+    assert row["train_step_traces"] == 1

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX023 has at least one fixture that MUST fire and one
+Every rule JX001–JX024 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1048,6 +1048,84 @@ def test_jx023_pragma_suppresses():
             for b in buckets:
                 np.asarray(model.forward(b))  # graftlint: disable=JX023  (warmup: block per compile)
     """, _SERVING_PATH)
+
+
+# ---------------------------------------------------------------- JX024
+_PARALLEL_PATH = "deeplearning4j_tpu/parallel/fix.py"
+_NN_PATH = "deeplearning4j_tpu/nn/fix.py"
+
+
+def test_jx024_positive_full_pytree_materialization_in_step_loop():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def fit(step, params, opt_state, batches):
+            for x, y in batches:
+                params, opt_state = step(params, opt_state, x, y)
+                host = np.asarray(params)        # full-model host copy
+            return params
+
+        def monitor(step, params, batches):
+            for b in batches:
+                params = step(params, b)
+                snap = jax.device_get(params)    # full-model host copy
+                print(snap)
+
+        def gathered_update(params, grads, steps):
+            i = 0
+            while i < steps:
+                full = jax.lax.all_gather(params, "data")  # resident global params
+                params = full - 0.1 * grads
+                i += 1
+            return params
+    """
+    for path in (_PARALLEL_PATH, _NN_PATH):
+        fs = lint_source(textwrap.dedent(src), path)
+        assert sum(f.rule == "JX024" for f in fs) == 3, path
+
+
+def test_jx024_negative_out_of_scope_and_boundaries():
+    # same spellings outside parallel//nn/ are other rules' territory
+    assert "JX024" not in rules_at("""
+        import numpy as np
+
+        def fit(step, params, batches):
+            for b in batches:
+                params = step(params, b)
+                np.asarray(params)
+    """, "deeplearning4j_tpu/serving/fix.py")
+    # checkpoint/serialize boundaries materialize OUTSIDE the loop, and
+    # per-batch materialization of non-params values stays legal
+    assert "JX024" not in rules_at("""
+        import jax
+        import numpy as np
+
+        def fit(step, params, batches):
+            for x, y in batches:
+                params, loss = step(params, x, y)
+                score = float(loss)
+            return np.asarray(params)            # once, at the boundary
+
+        def collect(step, params, batches):
+            out = []
+            for b in batches:
+                params, logits = step(params, b)
+                out.append(np.asarray(logits))   # activations, not params
+            return out
+    """, _PARALLEL_PATH)
+
+
+def test_jx024_pragma_suppresses():
+    assert "JX024" not in rules_at("""
+        import jax
+
+        def debug_fit(step, params, batches):
+            for b in batches:
+                params = step(params, b)
+                jax.device_get(params)  # graftlint: disable=JX024  (debug digest per step)
+    """, _PARALLEL_PATH)
 
 
 # ---------------------------------------------------------------- JX018
@@ -2104,7 +2182,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 19
+    assert len(RULES) == 20
     assert len(PROGRAM_RULES) == 4
 
 

@@ -1,0 +1,458 @@
+"""ZeRO-3 sharded SPMD training (ISSUE 12): exact parity with the
+replicated path, the 1/dp layout rules, one trace across mesh sizes,
+cross-topology checkpoint resharding, and the multi-process device_put
+placement fallback.
+
+The parity tests are BIT-FOR-BIT: at a fixed global batch on the same
+mesh, the sharded step (reduce-scatter grads, shard-local update,
+XLA-inserted forward all-gather) computes the identical program to the
+replicated step (dense all-reduce) — GSPMD derives one from the other
+purely from the argument shardings, reducing in the same order.  The
+one boundary: a TINY sharded contracting dim can make GSPMD prefer
+partial-compute + all-reduce over gather-first, which reassociates the
+reduction — pinned at reassociation tolerance in its own test below.
+"""
+import hashlib
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.faulttolerance.checkpoint import (
+    CheckpointManager, CorruptCheckpointError)
+from deeplearning4j_tpu.nn.conf.updaters import Adam
+from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                      OutputLayer)
+from deeplearning4j_tpu.observability.registry import default_registry
+from deeplearning4j_tpu.parallel import (ParallelWrapper, ShardedTrainer,
+                                         make_mesh, per_device_param_bytes,
+                                         param_bytes, shard_params,
+                                         zero3_spec)
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, place_sharded
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def mlp(seed=19, hidden=64, features=16, classes=8, lr=0.02,
+        precision=None):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Adam(learning_rate=lr)))
+    if precision is not None:
+        b = b.precision(precision)
+    lb = b.list()
+    lb.layer(DenseLayer(n_out=hidden, activation="tanh"))
+    lb.layer(DenseLayer(n_out=hidden, activation="tanh"))
+    lb.layer(OutputLayer(n_out=classes, activation="softmax",
+                         loss="mcxent"))
+    conf = lb.set_input_type(InputType.feed_forward(features)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def batch(n=64, features=16, classes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, features)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def leaves(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def digests(params):
+    out = {}
+    for lname in sorted(params):
+        for pname in sorted(params[lname]):
+            a = np.ascontiguousarray(np.array(params[lname][pname]))
+            out[f"{lname}/{pname}"] = hashlib.sha256(a.tobytes()).hexdigest()
+    return out
+
+
+def compiles(fn="train_step"):
+    c = default_registry().get("training_compile_total")
+    return 0.0 if c is None else c.labels(fn).value
+
+
+# ------------------------------------------------------------- layout rules
+def test_zero3_spec_rules():
+    from jax.sharding import PartitionSpec as P
+    # first axis divisible by dp shards; earlier indivisible axes skip
+    assert zero3_spec((16, 8), 8, 0) == P(DATA_AXIS, None)
+    assert zero3_spec((6, 16), 8, 0) == P(None, DATA_AXIS)
+    assert zero3_spec((32,), 8, 0) == P(DATA_AXIS)
+    # nothing divisible -> replicate; sub-threshold -> replicate
+    assert zero3_spec((7, 9), 8, 0) == P()
+    assert zero3_spec((16, 8), 8, 1_000_000) == P()
+    # dp=1: sharding is meaningless
+    assert zero3_spec((16, 8), 1, 0) == P()
+
+
+def test_sharded_trainer_layout_and_bytes():
+    net = mlp(seed=3)
+    mesh = make_mesh(dp=8)
+    st = ShardedTrainer(net, mesh, min_shard_size=0)
+    specs = {str(l.sharding.spec) for l in leaves(net.params)}
+    assert specs == {"PartitionSpec('data',)", "PartitionSpec('data', None)"}
+    # updater mirrors (Adam mu/nu) carry the SAME layout as their params
+    opt_specs = {str(l.sharding.spec) for l in leaves(net.opt_state)
+                 if getattr(l, "ndim", 0) > 0}
+    assert "PartitionSpec('data', None)" in opt_specs
+    # the memory win: every leaf divisible -> exactly 1/8 per device
+    assert per_device_param_bytes(net.params) * 8 == \
+        param_bytes(net.params)
+    assert st.per_device_param_bytes() == per_device_param_bytes(net.params)
+
+
+def test_min_shard_size_replicates_small_leaves():
+    net = mlp(seed=4, hidden=64)
+    # threshold above every leaf size: everything replicates (and the
+    # trainer degrades to the replicated wrapper's layout)
+    ShardedTrainer(net, make_mesh(dp=8), min_shard_size=1 << 20)
+    specs = {str(l.sharding.spec) for l in leaves(net.params)}
+    assert specs == {"PartitionSpec()"}
+    assert per_device_param_bytes(net.params) == param_bytes(net.params)
+
+
+def test_make_mesh_oversubscription_is_a_clear_error():
+    with pytest.raises(ValueError, match="oversubscribes"):
+        make_mesh(dp=16)
+    with pytest.raises(ValueError, match="oversubscribes"):
+        make_mesh(dp=4, tp=2, sp=2)  # 16 > 8
+    # an explicit dp smaller than the device count takes a sub-mesh
+    assert make_mesh(dp=2).shape[DATA_AXIS] == 2
+
+
+# ----------------------------------------------------------------- parity
+@pytest.mark.parametrize("dp", [2, 4, 8])
+def test_sharded_step_matches_replicated_bitwise(dp):
+    """The acceptance gate: sharded step == replicated step BIT-FOR-BIT
+    on the same data at a fixed global batch, for any dp size."""
+    x, y = batch()
+    net_r, net_s = mlp(seed=21), mlp(seed=21)
+    mesh = make_mesh(dp=dp)
+    pw = ParallelWrapper(net_r, mesh)
+    st = ShardedTrainer(net_s, mesh, min_shard_size=0)
+    for _ in range(4):
+        pw.fit(x, y)
+        st.fit(x, y)
+    assert net_r.get_score() == net_s.get_score()
+    for a, b in zip(leaves(net_r.params), leaves(net_s.params)):
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+    # updater state agrees too (the shard-local update is the full update)
+    for a, b in zip(leaves(net_r.opt_state), leaves(net_s.opt_state)):
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+
+
+def test_sharded_masters_bf16_matches_replicated():
+    """PrecisionPolicy composition: with bf16 compute the sharded params
+    ARE the f32 masters — sharded-master training is bitwise the
+    replicated mixed-precision run, and the masters never downcast."""
+    x, y = batch(seed=5)
+    net_r, net_s = mlp(seed=23, precision="bfloat16"), \
+        mlp(seed=23, precision="bfloat16")
+    mesh = make_mesh(dp=8)
+    pw = ParallelWrapper(net_r, mesh)
+    st = ShardedTrainer(net_s, mesh, min_shard_size=0)
+    for _ in range(3):
+        pw.fit(x, y)
+        st.fit(x, y)
+    for a, b in zip(leaves(net_r.params), leaves(net_s.params)):
+        assert a.dtype == b.dtype
+        assert a.dtype != np.dtype("bfloat16")   # masters stay full precision
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+    assert any("data" in str(l.sharding.spec)
+               for l in leaves(net_s.params))
+
+
+def test_parity_boundary_tiny_contraction_is_reassociation_tolerance():
+    """The parity contract's boundary, pinned so nobody 'fixes' it into a
+    flake: bitwise equality holds when GSPMD all-gathers the sharded
+    params before the matmul (its choice for every representative shape
+    — the tests above).  For a TINY sharded contracting dim (features=4
+    here, W0 is (4, h)) GSPMD instead partial-computes and all-reduces
+    the activations, which reassociates the reduction: parity is then
+    ~1e-6-relative (f32) — the same noise class as changing dp in any
+    data-parallel run — and must still hold to tight tolerance."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((48, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 48)]
+    net_r, net_s = mlp(seed=43, features=4, classes=3), \
+        mlp(seed=43, features=4, classes=3)
+    mesh = make_mesh(dp=4)
+    pw = ParallelWrapper(net_r, mesh)
+    st = ShardedTrainer(net_s, mesh, min_shard_size=0)
+    for _ in range(4):
+        pw.fit(x, y)
+        st.fit(x, y)
+    for a, b in zip(leaves(net_r.params), leaves(net_s.params)):
+        np.testing.assert_allclose(np.array(a), np.array(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+# ----------------------------------------------------------- compile budget
+def test_one_trace_serves_every_mesh_size():
+    """dp=2 and dp=4 runs (and the replicated wrapper) share ONE trace of
+    the train step: sharding lives in the arguments, not the jaxpr, so
+    the process-global trace cache serves every mesh size from a single
+    Python trace (each dp still lowers its own executable)."""
+    x, y = batch(seed=7)
+    before = compiles()
+    # hidden=72 keeps this topology unique to this test: the counter
+    # delta below must not be absorbed by another test's cached trace
+    nets = [mlp(seed=29, hidden=72) for _ in range(3)]
+    ShardedTrainer(nets[0], make_mesh(dp=2), min_shard_size=0).fit(x, y)
+    ShardedTrainer(nets[1], make_mesh(dp=4), min_shard_size=0).fit(x, y)
+    ParallelWrapper(nets[2], make_mesh(dp=8)).fit(x, y)
+    assert compiles() - before == 1
+
+
+# ------------------------------------------------- checkpoint resharding
+def _fit_and_save(tmp_path, dp=4, steps=3):
+    x, y = batch(seed=11)
+    net = mlp(seed=31)
+    st = ShardedTrainer(net, make_mesh(dp=dp), min_shard_size=0)
+    for _ in range(steps):
+        st.fit(x, y)
+    mgr = CheckpointManager(str(tmp_path / "store"), background=False)
+    path = mgr.save_sharded(net, cursor={"fit_epoch": 2, "batch_seq": 5},
+                            step=steps)
+    return net, mgr, path, (x, y)
+
+
+def test_cross_topology_roundtrip_digests_exact(tmp_path):
+    """Save on a dp=4 mesh, restore onto dp=2 AND dp=8: param digests
+    exactly equal (reassembly + re-placement move bytes, never
+    arithmetic), cursor intact, and training continues on the new mesh."""
+    net, mgr, path, (x, y) = _fit_and_save(tmp_path, dp=4)
+    want = digests(net.params)
+    opt_want = [np.array(l) for l in jax.tree_util.tree_leaves(
+        net.opt_state)]
+    for dp in (2, 8):
+        net2, state = mgr.restore_sharded(mesh=make_mesh(dp=dp),
+                                          min_shard_size=0)
+        assert digests(net2.params) == want
+        assert state["cursor"] == {"fit_epoch": 2, "batch_seq": 5}
+        assert net2.iteration == net.iteration
+        # updater state reshards exactly too
+        for a, b in zip(opt_want,
+                        jax.tree_util.tree_leaves(net2.opt_state)):
+            np.testing.assert_array_equal(a, np.array(b))
+        # the restored net is live: another sharded step on the NEW mesh
+        st2 = ShardedTrainer(net2, make_mesh(dp=dp), min_shard_size=0)
+        st2.fit(x, y)
+        assert np.isfinite(net2.get_score())
+
+
+def test_restore_sharded_into_existing_net_and_rng(tmp_path):
+    net, mgr, path, _ = _fit_and_save(tmp_path)
+    target = mlp(seed=31)
+    mgr.restore_sharded(path, net=target, mesh=None)
+    assert digests(target.params) == digests(net.params)
+    # RNG restored: the next key draw matches the saved net's
+    a = jax.random.split(net._rng)[1]
+    b = jax.random.split(target._rng)[1]
+    np.testing.assert_array_equal(np.array(a), np.array(b))
+
+
+def test_corrupt_shard_refuses(tmp_path):
+    _, mgr, path, _ = _fit_and_save(tmp_path)
+    shard = next(f for f in os.listdir(path) if f.endswith(".npz"))
+    with open(os.path.join(path, shard), "r+b") as f:
+        f.seek(40)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        mgr.restore_sharded(path, mesh=make_mesh(dp=2))
+
+
+def test_missing_shard_file_refuses(tmp_path):
+    _, mgr, path, _ = _fit_and_save(tmp_path)
+    shard = next(f for f in os.listdir(path) if f.endswith(".npz"))
+    os.remove(os.path.join(path, shard))
+    with pytest.raises(CorruptCheckpointError, match="missing"):
+        mgr.restore_sharded(path, mesh=make_mesh(dp=2))
+
+
+def test_multiprocess_save_refuses_without_barrier(tmp_path):
+    """A primary-only commit in a multi-process world would record
+    process_count shard files in topology.json but write one — a torn
+    checkpoint every restore refuses.  save_sharded must refuse up
+    front, for the primary too, until the staged-write barrier exists."""
+    net = mlp(seed=47)
+    ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+    mgr = CheckpointManager(str(tmp_path / "store"), background=False)
+    with pytest.raises(NotImplementedError, match="barrier"):
+        mgr.save_sharded(net, process_index=1, process_count=2)
+    with pytest.raises(NotImplementedError, match="barrier"):
+        mgr.save_sharded(net, process_index=0, process_count=2)
+
+
+def test_restore_kind_mismatch_is_a_clear_error(tmp_path):
+    net, mgr, path, _ = _fit_and_save(tmp_path)
+    # dense restore() on a sharded checkpoint: refuse (the container
+    # carries no params — a silent fresh-init restore would be wrong)
+    with pytest.raises(ValueError, match="SHARDED"):
+        mgr.restore(path)
+    # restore_sharded on a dense checkpoint: refuse symmetrically
+    dense = CheckpointManager(str(mgr.directory) + "-dense",
+                              background=False)
+    dense.save(mlp(seed=33), blocking=True)
+    with pytest.raises(ValueError, match="not a sharded"):
+        dense.restore_sharded(mesh=make_mesh(dp=2))
+    shutil.rmtree(dense.directory, ignore_errors=True)
+
+
+def test_multi_axis_sharded_leaf_refused_at_save():
+    """The shard format indexes ONE sharded dim per leaf (ZeRO-3); a
+    two-axis partition (a TP param_rule composed with dp) must refuse at
+    save time, not dedupe away the second axis and commit a store every
+    restore rejects."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.faulttolerance.checkpoint import _leaf_blocks
+    mesh = make_mesh(dp=2, tp=2)
+    leaf = jax.device_put(np.arange(64.0).reshape(8, 8),
+                          NamedSharding(mesh, P("data", "model")))
+    with pytest.raises(NotImplementedError, match="sharded over 2 axes"):
+        _leaf_blocks(leaf)
+
+
+def test_restore_into_mismatched_net_leaves_it_untouched(tmp_path):
+    """A topology mismatch mid-restore must not leave a caller's live
+    net half old-mesh, half new: params swap only after every key
+    assembled and validated."""
+    net, mgr, path, _ = _fit_and_save(tmp_path)
+    other = mlp(seed=51, hidden=32)   # different topology
+    before = digests(other.params)
+    with pytest.raises(ValueError):
+        mgr.restore_sharded(path, net=other, mesh=make_mesh(dp=2))
+    assert digests(other.params) == before
+
+
+def test_save_sharded_honors_save_updater_false(tmp_path):
+    """CheckpointManager(save_updater=False) must drop updater state on
+    the sharded path too (the dense writer honors it): no opt blocks in
+    the store, and a restore leaves the target's fresh opt_state."""
+    x, y = batch(seed=17)
+    net = mlp(seed=57)
+    ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0).fit(x, y)
+    mgr = CheckpointManager(str(tmp_path / "store"), background=False,
+                            save_updater=False)
+    path = mgr.save_sharded(net, step=1)
+    import json
+    with open(os.path.join(path, "topology.json")) as f:
+        topo = json.load(f)
+    assert topo["opt"] == []
+    net2, _ = mgr.restore_sharded(path, mesh=make_mesh(dp=2),
+                                  min_shard_size=0)
+    assert digests(net2.params) == digests(net.params)
+    # fresh updater state: every non-scalar moment leaf is zeros
+    moments = [np.array(l) for l in leaves(net2.opt_state)
+               if getattr(l, "ndim", 0) > 0]
+    assert moments and all((m == 0).all() for m in moments)
+
+
+def test_failed_updater_restore_leaves_net_untouched(tmp_path):
+    """A restore that fails in the UPDATER section (checkpoint saved
+    under a different updater config) must not have swapped params in
+    already — the live net stays fully old."""
+    net, mgr, path, _ = _fit_and_save(tmp_path)
+    # same layer topology, different updater: Sgd has fewer state leaves
+    from deeplearning4j_tpu.nn.conf.updaters import Sgd
+    b = NeuralNetConfiguration.builder().seed(31).updater(
+        Sgd(learning_rate=0.02))
+    lb = b.list()
+    lb.layer(DenseLayer(n_out=64, activation="tanh"))
+    lb.layer(DenseLayer(n_out=64, activation="tanh"))
+    lb.layer(OutputLayer(n_out=8, activation="softmax", loss="mcxent"))
+    other = MultiLayerNetwork(
+        lb.set_input_type(InputType.feed_forward(16)).build()).init()
+    before = digests(other.params)
+    opt_before = [np.array(l) for l in leaves(other.opt_state)]
+    with pytest.raises(ValueError, match="updater state mismatch"):
+        mgr.restore_sharded(path, net=other, mesh=make_mesh(dp=2))
+    assert digests(other.params) == before
+    for a, b_ in zip(opt_before, leaves(other.opt_state)):
+        np.testing.assert_array_equal(a, np.array(b_))
+
+
+def test_sharded_write_fires_chaos_stages(tmp_path):
+    """The crash-consistency harness's commit-stage hooks fire in the
+    sharded writer too (stage 1 after the container, stage 2 after the
+    shard files) — the torn-sharded-store windows stay probeable."""
+    net = mlp(seed=53)
+    ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+    mgr = CheckpointManager(str(tmp_path / "store"), background=False)
+
+    class Chaos:
+        stages = []
+
+        def on_commit_stage(self, step, stage):
+            self.stages.append((step, stage))
+
+    mgr.chaos = Chaos()
+    mgr.save_sharded(net, step=7)
+    assert mgr.chaos.stages == [(7, 1), (7, 2)]
+
+
+# --------------------------------------------- multi-process put fallback
+def test_place_sharded_falls_back_per_shard(monkeypatch):
+    """The CPU-rig regression (PR 7's note): when ``device_put`` onto a
+    NamedSharding is unimplemented, ``ParallelWrapper``/``ShardedTrainer``
+    placement must fall back to per-shard device_put +
+    ``make_array_from_single_device_arrays`` instead of crashing
+    mid-fit."""
+    from jax.sharding import Sharding
+    real_put = jax.device_put
+
+    def flaky_put(x, device=None, **kw):
+        if isinstance(device, Sharding):
+            raise RuntimeError(
+                "UNIMPLEMENTED: device_put to a multi-process sharding")
+        return real_put(x, device, **kw)
+
+    import deeplearning4j_tpu.parallel.mesh as mesh_mod
+    monkeypatch.setattr(mesh_mod.jax, "device_put", flaky_put)
+    x, y = batch(seed=13)
+    net = mlp(seed=37)
+    st = ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+    st.fit(x, y)
+    assert np.isfinite(net.get_score())
+    specs = {str(l.sharding.spec) for l in leaves(net.params)}
+    assert specs == {"PartitionSpec('data',)", "PartitionSpec('data', None)"}
+    # parity holds through the fallback placement too
+    net_ref = mlp(seed=37)
+    monkeypatch.undo()
+    ShardedTrainer(net_ref, make_mesh(dp=4), min_shard_size=0).fit(x, y)
+    for a, b in zip(leaves(net_ref.params), leaves(net.params)):
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+
+
+def test_place_sharded_reraises_when_fallback_also_fails(monkeypatch):
+    import deeplearning4j_tpu.parallel.mesh as mesh_mod
+
+    def always_fail(x, device=None, **kw):
+        raise RuntimeError("UNIMPLEMENTED: no placement at all")
+
+    monkeypatch.setattr(mesh_mod.jax, "device_put", always_fail)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(make_mesh(dp=2), P())
+    with pytest.raises(RuntimeError, match="no placement"):
+        place_sharded(np.zeros(4), sh)
+
+
+def test_shard_params_helper_shared_surface():
+    """The helper the trainer, the checkpoint reshard path and these
+    tests all share: one rule, three consumers."""
+    net = mlp(seed=41)
+    mesh = make_mesh(dp=8)
+    sh = shard_params(mesh, net.params, min_size=0)
+    flat = jax.tree_util.tree_leaves_with_path(sh)
+    assert flat and all("data" in str(s.spec) for _, s in flat)
+    placed = jax.tree_util.tree_map(place_sharded, net.params, sh)
+    for a, b in zip(leaves(net.params), leaves(placed)):
+        np.testing.assert_array_equal(np.array(a), np.array(b))

@@ -1,6 +1,6 @@
 """graftlint rule implementations.
 
-Module-local rules JX001–JX017 and JX022 are functions ``rule(info:
+Module-local rules JX001–JX017 and JX022–JX024 are functions ``rule(info:
 ModuleInfo) -> list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
 machinery in ``analysis.py`` (memoized per module, so every rule runs off
 one parse and one tree walk).  The whole-program concurrency pack
@@ -1103,6 +1103,81 @@ def jx023(info: ModuleInfo) -> List[Finding]:
                 "hottest loop — batch the materialization once per "
                 "decode-step boundary (or pragma a deliberate "
                 "warmup-blocking sync)"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX024
+# scope: the sharded-training modules, where a params-sized pytree is
+# deliberately laid out at 1/dp per device and one stray materialization
+# silently reassembles the WHOLE model on one host, every iteration
+_JX024_PATH_RE = re.compile(r"(^|[/\\])(parallel|nn)[/\\]")
+_JX024_NAME_RE = re.compile(r"(^|_)(params?|opt_state|grads?)($|_)")
+_JX024_NP_FNS = frozenset(("asarray", "array"))
+
+
+def _jx024_params_typed(node: ast.AST) -> bool:
+    """A params-typed expression: a (possibly subscripted) plain or
+    dotted name whose final component spells params/grads/opt_state
+    (``params``, ``new_params``, ``self.model.params``,
+    ``params["layer_0"]``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    name = dotted_name(node)
+    if not name:
+        return False
+    return bool(_JX024_NAME_RE.search(name.split(".")[-1]))
+
+
+@rule("JX024", "full-pytree materialization (device_get / np.asarray / "
+               "all_gather of params) inside a sharded step loop")
+def jx024(info: ModuleInfo) -> List[Finding]:
+    """Flag ``jax.device_get(...)``, ``np.asarray(...)``/``np.array(...)``
+    and unconstrained ``all_gather(...)`` applied to a params-typed name
+    inside a ``for``/``while`` body in a ``parallel/`` or ``nn/`` module.
+    The ZeRO-3 layout (``parallel/sharded.py``) holds params, grads and
+    updater state at ~1/dp bytes per device; any of these calls on a
+    params pytree in a step loop quietly reassembles the FULL model —
+    host-side for device_get/np.asarray (a device→host copy of every
+    shard plus peak global-params memory, once per iteration), on-device
+    for a hand-written ``all_gather`` (resident global params, exactly
+    what the sharding exists to avoid — the forward's gather is XLA's
+    job, inserted from the sharding constraints and freed within the
+    step).  Whole-model materializations belong at checkpoint/serialize
+    boundaries (``save_sharded`` writes per-shard blocks and never one
+    global array); a deliberate loop materialization carries a pragma
+    with its justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX024_PATH_RE.search(path):
+        return out
+    if not (info.jax_aliases or info.jnp_aliases or info.numpy_aliases):
+        return out
+    for node in info.nodes(ast.Call):
+        if not node.args or not _jx024_params_typed(node.args[0]):
+            continue
+        if not _in_loop_same_function(info, node):
+            continue
+        fname = call_name(node) or ""
+        parts = fname.split(".")
+        kind = None
+        if parts[-1] == "device_get" and (
+                len(parts) == 1 or parts[0] in info.jax_aliases):
+            kind = f"{fname}(...)"
+        elif len(parts) == 2 and parts[0] in info.numpy_aliases and \
+                parts[1] in _JX024_NP_FNS:
+            kind = f"{fname}(...)"
+        elif parts[-1] == "all_gather":
+            kind = f"{fname}(...)"
+        if kind:
+            out.append(_finding(
+                info, node, "JX024",
+                f"`{kind}` on a params-typed pytree inside a loop in a "
+                "sharded-training module: this reassembles the FULL "
+                "model (defeating the 1/dp ZeRO layout) once per "
+                "iteration — let XLA insert the forward all-gather from "
+                "the shardings, and materialize whole params only at "
+                "checkpoint/serialize boundaries (or pragma a "
+                "deliberate one)"))
     return _dedupe(out)
 
 
