@@ -56,7 +56,7 @@ recompile count — must stay 0) so serving-throughput regressions are
 driver-visible; DL4J_TPU_BENCH_SERVE=0 suppresses it.
 
 An eighth JSON line records the linter wall-time benchmark
-(``lint_time_ms``: one full-package graftlint run — 20 module rules off
+(``lint_time_ms``: one full-package graftlint run — 21 module rules off
 a shared per-file parse plus the whole-program concurrency pass
 JX018-JX021) so rule additions can't silently blow up developer-loop
 latency; DL4J_TPU_BENCH_LINT=0 suppresses it.
@@ -78,6 +78,13 @@ An eleventh JSON line records the ZeRO-3 sharded-training benchmark
 a fixed global batch on the same mesh, with per-device parameter bytes
 showing the ~1/dp memory win and the compile-counter-verified single
 trace shared by both paths); DL4J_TPU_BENCH_SHARD=0 suppresses it.
+
+A twelfth JSON line records the elastic-reshard benchmark
+(``elastic_reshard_ms``: wall time from a member loss to the first
+clean sharded train step on the survivor mesh — lease expiry, barrier
+abort, eviction, and the restore_sharded(mesh=survivors) re-placement
+all inside the measured window); DL4J_TPU_BENCH_RESHARD=0 suppresses
+it.
 """
 import json
 import os
@@ -333,6 +340,21 @@ def main():
                               "unit": "ms/step (ZeRO-3 sharded)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # elastic-reshard row (ISSUE 13): member loss -> first clean sharded
+    # step on the survivor mesh, through the multi-writer barrier store;
+    # a twelfth JSON line, opt-out DL4J_TPU_BENCH_RESHARD=0
+    if os.environ.get("DL4J_TPU_BENCH_RESHARD", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                elastic_reshard_ms
+            print(json.dumps(elastic_reshard_ms()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "elastic_reshard_ms",
+                              "value": None,
+                              "unit": "ms member loss -> first clean "
+                                      "sharded step (survivor mesh)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -449,6 +471,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # sharded training (ISSUE 12): ZeRO-3 sharded vs replicated step
         # time + the 1/dp per-device param-bytes win, single-trace-verified
         B.sharded_step_time_ms,
+        # elastic reshard (ISSUE 13): member loss -> first clean sharded
+        # step on the survivor mesh (barrier abort + eviction +
+        # restore_sharded re-placement inside the window)
+        B.elastic_reshard_ms,
     ]
     side = []
     for fn in captures:

@@ -27,16 +27,17 @@ reliability):
 from .atomic import atomic_file, atomic_write_bytes, atomic_write_json
 from .checkpoint import (CheckpointConfig, CheckpointManager,
                          CorruptCheckpointError, FitCheckpointer,
-                         resume_network)
+                         ShardBarrier, ShardBarrierError, resume_network)
 from .cluster import (ClusterCoordinator, ClusterMember, ClusterView,
-                      FileLeaseStore, shard_owner)
+                      FileLeaseStore, live_ranks, shard_owner)
 from .faults import (ChaosBroker, ChaosSchedule, FaultInjector,
                      InjectedWorkerFault, RetryPolicy)
 
 __all__ = ["atomic_file", "atomic_write_bytes", "atomic_write_json",
            "CheckpointConfig", "CheckpointManager", "CorruptCheckpointError",
-           "FitCheckpointer", "resume_network",
+           "FitCheckpointer", "ShardBarrier", "ShardBarrierError",
+           "resume_network",
            "ClusterCoordinator", "ClusterMember", "ClusterView",
-           "FileLeaseStore", "shard_owner",
+           "FileLeaseStore", "live_ranks", "shard_owner",
            "ChaosBroker", "ChaosSchedule",
            "FaultInjector", "InjectedWorkerFault", "RetryPolicy"]

@@ -153,18 +153,30 @@ def commit_dir(tmp_dir: str, final_dir: str) -> None:
 
 
 def discard_orphans(directory: str,
-                    log_warning=None) -> int:
+                    log_warning=None, min_age_s: float = 0.0) -> int:
     """Remove ``.tmp-`` staging leftovers from crashed writers.  Returns
-    the number removed; ``log_warning(path)`` observes each one."""
+    the number removed; ``log_warning(path)`` observes each one.
+    ``min_age_s`` spares staging dirs younger than that many seconds —
+    a multi-writer barrier round stages under a SHARED ``.tmp-`` name,
+    so a peer sweeping the store mid-round (an elastic rejoin) must not
+    reclaim a round that is still being written."""
+    import time
     removed = 0
     try:
         entries = os.listdir(directory)
     except OSError:
         return 0
+    now = time.time()
     for name in entries:
         if not name.startswith(TMP_PREFIX):
             continue
         path = os.path.join(directory, name)
+        if min_age_s > 0:
+            try:
+                if now - os.path.getmtime(path) < min_age_s:
+                    continue
+            except OSError:
+                continue        # vanished mid-scan: someone else's sweep
         if log_warning is not None:
             log_warning(path)
         if os.path.isdir(path):

@@ -61,9 +61,11 @@ from ..observability.registry import default_registry
 from ..observability.tracer import get_tracer
 
 __all__ = ["CheckpointManager", "CheckpointConfig", "CorruptCheckpointError",
-           "FitCheckpointer", "resume_network"]
+           "FitCheckpointer", "ShardBarrier", "ShardBarrierError",
+           "resume_network"]
 
 _SHARD_FILE_RE = re.compile(r"^shards-p(\d{2,})\.npz$")
+_BLOCK_MARKER_RE = re.compile(r"^block-p(\d{2,})\.json$")
 
 log = logging.getLogger("deeplearning4j_tpu.faulttolerance")
 
@@ -276,6 +278,48 @@ class _ShardedSnapshot:
                          "opt": topo_opt}
 
 
+class ShardBarrierError(RuntimeError):
+    """A multi-writer barrier save round aborted: a writer was evicted
+    mid-barrier or its block marker never landed within the budget.  The
+    round's shared staging dir is left as a ``.tmp-`` orphan (discovery
+    never sees it; ``sweep_orphans`` reclaims it) — the store's newest
+    COMPLETE checkpoint is unchanged."""
+
+
+@dataclass
+class ShardBarrier:
+    """Coordination contract for one multi-writer ``save_sharded`` round.
+
+    Every process of a sharded world stages its ``shards-pNN.npz`` block
+    into ONE shared staging directory — named deterministically from the
+    step and the rendezvous ``generation``, so every writer of the same
+    round agrees on it and a stale-generation writer (one that missed an
+    eviction/admission) stages into a DIFFERENT directory no primary
+    will ever commit.  After its block (and index) are durable, each
+    writer posts a generation-fenced ``block-pNN.json`` marker; the
+    primary commits manifest + rename only once every expected writer's
+    marker has landed.
+
+    - ``generation`` — the cluster view's rendezvous generation (0 for a
+      static world): the fence tag baked into the staging-dir name and
+      validated on every marker.
+    - ``timeout_s`` — the primary's bounded barrier wait; expiry aborts
+      the round with :class:`ShardBarrierError`.
+    - ``policy`` — optional :class:`~.faults.RetryPolicy` whose seeded
+      backoff paces the marker polls (``poll_s`` is the flat fallback).
+    - ``live_fn`` — optional ``() -> collection of live writer ranks``;
+      when a missing writer is no longer live (its lease expired — it
+      was evicted mid-barrier) the round aborts immediately instead of
+      waiting out the full timeout.
+    """
+
+    generation: int = 0
+    timeout_s: float = 30.0
+    poll_s: float = 0.05
+    policy: Optional[Any] = None
+    live_fn: Optional[Any] = None
+
+
 class CheckpointManager:
     """Durable on-disk checkpoint store with atomic commits, checksum
     verification, retention, and background (double-buffered) saves.
@@ -380,7 +424,8 @@ class CheckpointManager:
                      blocking: Optional[bool] = None,
                      step: Optional[int] = None,
                      process_index: Optional[int] = None,
-                     process_count: Optional[int] = None) -> str:
+                     process_count: Optional[int] = None,
+                     barrier: Optional[ShardBarrier] = None) -> str:
         """Shard-aware checkpoint of a mesh-sharded ``net`` (the ZeRO-3
         ``parallel.sharded.ShardedTrainer`` layout): the model container
         is written WITHOUT params, and every param/updater leaf is saved
@@ -390,33 +435,43 @@ class CheckpointManager:
         on one host.  Restore with :meth:`restore_sharded` — onto ANY
         mesh topology (portable resharding, arXiv:2112.01075).
 
-        Multi-host note: the format indexes ``process_count`` shard
-        files, but the single-commit flow below is the one-process (all
-        shards addressable) writer; a multi-host save needs each process
-        to stage its shard file and a barrier before the primary's
-        commit — refuse rather than silently write a torn store."""
+        Multi-writer worlds (``process_count > 1``) MUST pass a
+        :class:`ShardBarrier`: every process stages its block into the
+        round's shared generation-fenced staging dir and posts a
+        completion marker; non-primary writers return once their block
+        is durable, and the primary commits manifest + rename only after
+        every live writer's marker lands (bounded wait; an eviction or
+        timeout aborts the round cleanly — see :class:`ShardBarrier`).
+        Without a barrier a primary-only commit would record
+        ``process_count`` shard files in topology.json but write ONE — a
+        torn checkpoint every restore refuses; refuse up front."""
         import jax
         if process_index is None:
             process_index = jax.process_index()
         if process_count is None:
             process_count = jax.process_count()
-        if process_index != 0 or process_count > 1:
-            # a primary-only commit in a multi-process world would record
-            # process_count shard files in topology.json but write ONE —
-            # a torn checkpoint every restore refuses; refuse up front
+        if (process_index != 0 or process_count > 1) and barrier is None:
             raise NotImplementedError(
                 "multi-host save_sharded needs a staged-write barrier "
                 "(every process's shard file must land before the "
-                "primary commits) — route multi-process saves through "
-                "the elastic coordinator")
+                "primary commits) — pass barrier=ShardBarrier(...) or "
+                "route multi-process saves through the elastic "
+                "coordinator (ElasticTrainer over a ShardedTrainer)")
         snap = _ShardedSnapshot(net, process_index, process_count,
                                 save_updater=self.save_updater)
         if step is not None:
             snap.step = int(step)
         final = self.path_for(snap.step)
+        self.wait()                       # double-buffer: one in flight
+        if barrier is not None:
+            # barrier rounds are synchronous by construction: a
+            # background writer racing the next round's markers would
+            # tangle two generations in one staging dir
+            self._write_sharded_barrier(snap, final, cursor, metric,
+                                        barrier)
+            return final
         if blocking is None:
             blocking = not self.background
-        self.wait()                       # double-buffer: one in flight
         if blocking:
             self._write_sharded(snap, final, cursor, metric, mode="sync")
         else:
@@ -474,10 +529,13 @@ class CheckpointManager:
                         self.directory, exc_info=True)
 
     def _finish_staging(self, tmp: str, final: str, snap, cursor,
-                        metric, sharded: bool = False) -> int:
+                        metric, sharded: bool = False,
+                        pre_commit=None) -> int:
         """Write training_state.json + the checksum manifest into a staged
         checkpoint dir, then commit it with ONE rename.  Returns committed
-        bytes.  Shared by the dense and sharded writers."""
+        bytes.  Shared by the dense and sharded writers; ``pre_commit``
+        (barrier path) runs between the manifest write and the rename —
+        the crash-on-manifest probe window."""
         state = {
             "cursor": dict(cursor or {}),
             "iteration": snap.iteration,
@@ -502,6 +560,8 @@ class CheckpointManager:
         if sharded:
             manifest["sharded"] = True
         atomic_write_json(os.path.join(tmp, "manifest.json"), manifest)
+        if pre_commit is not None:
+            pre_commit()
         commit_dir(tmp, final)
         return nbytes
 
@@ -528,19 +588,7 @@ class CheckpointManager:
                 time.sleep(self._test_slow_s)
             if self.chaos is not None:
                 self.chaos.on_commit_stage(snap.step, 1)
-            arrays: Dict[str, np.ndarray] = {}
-            index: List[Dict[str, Any]] = []
-            for kind, leaf_key, dim, blocks in snap.blocks:
-                for start, block in blocks:
-                    name = f"b{len(index)}"
-                    arrays[name] = block
-                    index.append({"name": name, "kind": kind,
-                                  "leaf": leaf_key, "dim": dim,
-                                  "start": int(start)})
-            pidx = snap.process_index
-            np.savez(os.path.join(tmp, f"shards-p{pidx:02d}.npz"), **arrays)
-            atomic_write_json(os.path.join(tmp, f"shards-p{pidx:02d}.json"),
-                              index)
+            self._write_shard_block(tmp, snap)
             if self._test_slow_s:
                 time.sleep(self._test_slow_s)
             if self.chaos is not None:
@@ -553,6 +601,191 @@ class CheckpointManager:
         except OSError:
             log.warning("checkpoint retention sweep failed in %s",
                         self.directory, exc_info=True)
+
+    @staticmethod
+    def _write_shard_block(tmp: str, snap: "_ShardedSnapshot") -> None:
+        """Write THIS process's shard blocks (``shards-pNN.npz``) and
+        their index into a staging dir, fsynced — a completion marker
+        posted after this returns only ever advertises durable bytes."""
+        from .atomic import _fsync_path
+        arrays: Dict[str, np.ndarray] = {}
+        index: List[Dict[str, Any]] = []
+        for kind, leaf_key, dim, blocks in snap.blocks:
+            for start, block in blocks:
+                name = f"b{len(index)}"
+                arrays[name] = block
+                index.append({"name": name, "kind": kind,
+                              "leaf": leaf_key, "dim": dim,
+                              "start": int(start)})
+        pidx = snap.process_index
+        npz = os.path.join(tmp, f"shards-p{pidx:02d}.npz")
+        np.savez(npz, **arrays)
+        _fsync_path(npz)
+        atomic_write_json(os.path.join(tmp, f"shards-p{pidx:02d}.json"),
+                          index)
+
+    # ------------------------------------------------- multi-writer barrier
+    def barrier_staging(self, final: str, generation: int) -> str:
+        """The SHARED staging dir for one barrier round: deterministic
+        from (step, generation) so every writer of the round agrees on
+        it, ``.tmp-`` prefixed so discovery ignores it and orphan sweep
+        reclaims an aborted round, and generation-fenced so a
+        stale-generation writer stages into a directory no primary of a
+        newer round will ever commit."""
+        d, base = os.path.split(os.path.abspath(final))
+        return os.path.join(d, f"{TMP_PREFIX}barrier-{base}-"
+                               f"g{int(generation):06d}")
+
+    @staticmethod
+    def _scan_block_markers(tmp: str, generation: int) -> set:
+        """Writer indices whose generation-matching completion marker has
+        landed in ``tmp``.  A marker carrying a different generation is
+        rejected (a stale writer handed the wrong barrier object can
+        never satisfy a newer round's wait); a torn/unreadable marker is
+        ignored (markers are atomic-rename writes, so this only races a
+        concurrent sweep)."""
+        have = set()
+        try:
+            names = os.listdir(tmp)
+        except OSError:
+            return have
+        for name in names:
+            m = _BLOCK_MARKER_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(tmp, name), encoding="utf-8") as f:
+                    marker = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if int(marker.get("generation", -1)) != int(generation):
+                log.warning("ignoring stale-generation block marker %s "
+                            "(gen %s != round gen %d)", name,
+                            marker.get("generation"), int(generation))
+                continue
+            have.add(int(m.group(1)))
+        return have
+
+    def _write_sharded_barrier(self, snap: "_ShardedSnapshot", final: str,
+                               cursor, metric,
+                               barrier: ShardBarrier) -> None:
+        """One writer's side of the two-phase multi-writer commit.
+
+        Phase 1 (every writer): stage this process's shard block into
+        the round's shared staging dir, then post the generation-fenced
+        ``block-pNN.json`` marker.  Non-primary writers return here —
+        their block is durable and advertised.
+
+        Phase 2 (primary only): write the param-less container + RNG +
+        topology, wait — bounded, backoff-paced — for every expected
+        writer's marker, then commit manifest + rename.  A writer
+        evicted mid-barrier (``live_fn``) or a timeout aborts the round:
+        the staging dir is left as a ``.tmp-`` orphan for sweep and
+        :class:`ShardBarrierError` is raised — the store's newest
+        complete checkpoint is untouched."""
+        from ..utils import model_serializer
+
+        t0 = monotonic_s()
+        primary = snap.process_index == 0
+        mode = "barrier-primary" if primary else "barrier"
+        with get_tracer().span("checkpoint.write_sharded_barrier",
+                               step=snap.iteration, mode=mode,
+                               generation=int(barrier.generation)):
+            tmp = self.barrier_staging(final, barrier.generation)
+            os.makedirs(tmp, exist_ok=True)
+            if primary:
+                # param-less container + RNG + topology are the
+                # primary's to stage (replicated state, identical on
+                # every writer)
+                model_serializer.write_model(
+                    snap, os.path.join(tmp, "model.zip"),
+                    save_updater=False)
+                np.save(os.path.join(tmp, "rng.npy"), snap.rng)
+                atomic_write_json(os.path.join(tmp, "topology.json"),
+                                  snap.topology)
+                if self._test_slow_s:
+                    time.sleep(self._test_slow_s)
+                if self.chaos is not None:
+                    self.chaos.on_commit_stage(snap.step, 1)
+            self._write_shard_block(tmp, snap)
+            if self._test_slow_s:
+                time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                # stage 2 = "mid-block": the shard bytes are staged but
+                # the completion marker is NOT posted — a writer killed
+                # here never advertises, and the primary's barrier
+                # aborts instead of committing its torn block
+                self.chaos.on_commit_stage(snap.step, 2)
+            atomic_write_json(
+                os.path.join(tmp, f"block-p{snap.process_index:02d}.json"),
+                {"process_index": int(snap.process_index),
+                 "generation": int(barrier.generation),
+                 "step": int(snap.step),
+                 "complete": True})
+            if not primary:
+                self._observe_write(monotonic_s() - t0, 0, mode)
+                return
+            expected = set(range(snap.process_count))
+            deadline = monotonic_s() + float(barrier.timeout_s)
+            attempt = 0
+            while True:
+                have = self._scan_block_markers(tmp, barrier.generation)
+                missing = sorted(expected - have)
+                if not missing:
+                    break
+                if barrier.live_fn is not None:
+                    try:
+                        live = set(barrier.live_fn())
+                    except Exception:
+                        live = expected     # liveness unknown: keep waiting
+                    dead = sorted(set(missing) - live)
+                    if dead:
+                        self._abort_barrier(
+                            tmp, f"writer(s) {dead} evicted mid-barrier "
+                                 f"(round generation {barrier.generation})")
+                if monotonic_s() > deadline:
+                    self._abort_barrier(
+                        tmp, f"block marker(s) from writer(s) {missing} "
+                             f"never landed within {barrier.timeout_s:.1f}s")
+                attempt += 1
+                if barrier.policy is not None:
+                    barrier.policy.sleep(attempt,
+                                         worker=snap.process_index)
+                else:
+                    time.sleep(barrier.poll_s)
+            if self._test_slow_s:
+                time.sleep(self._test_slow_s)
+            if self.chaos is not None:
+                # stage 3 = between barrier and commit: every block
+                # landed, nothing committed — the primary dying here
+                # must leave only the staging orphan
+                self.chaos.on_commit_stage(snap.step, 3)
+            nbytes = self._finish_staging(
+                tmp, final, snap, cursor, metric, sharded=True,
+                # stage 4 = after the manifest, before the rename — the
+                # crash-on-manifest window
+                pre_commit=(None if self.chaos is None else
+                            lambda: self.chaos.on_commit_stage(
+                                snap.step, 4)))
+        self._observe_write(monotonic_s() - t0, nbytes, mode)
+        try:
+            self._apply_retention()
+        except OSError:
+            log.warning("checkpoint retention sweep failed in %s",
+                        self.directory, exc_info=True)
+
+    def _abort_barrier(self, tmp: str, detail: str):
+        """Abort a barrier round: the shared staging dir stays behind as
+        a ``.tmp-`` orphan (never a commit candidate; ``sweep_orphans``
+        reclaims it once it ages past any in-flight round)."""
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter("checkpoint_barrier_aborts_total",
+                        "Multi-writer sharded save rounds aborted before "
+                        "commit").inc()
+        log.warning("sharded barrier save aborted: %s (staging %s left "
+                    "for orphan sweep)", detail, tmp)
+        raise ShardBarrierError(f"sharded barrier save aborted: {detail}")
 
     # ---------------------------------------------------------- discovery
     @staticmethod
@@ -619,27 +852,68 @@ class CheckpointManager:
         ckpts = self.checkpoints()
         return ckpts[-1][1] if ckpts else None
 
-    def latest_complete(self, after_step: int = -1
+    def latest_complete(self, after_step: int = -1, kind: str = "any"
                         ) -> Optional[Tuple[int, str]]:
         """Newest manifest-verified checkpoint strictly newer than
         ``after_step``: ``(step, path)`` or None.  The serving tier's
         train→serve promotion poll: a watcher holding the step it already
         serves asks "is there anything newer and COMPLETE?" — corrupt or
-        still-staging directories never answer yes."""
-        ckpts = self.checkpoints()
-        if ckpts and ckpts[-1][0] > int(after_step):
-            return ckpts[-1][0], ckpts[-1][1]
+        still-staging directories never answer yes.  A corrupt shard
+        file fails its manifest checksum like any other file, so a torn
+        sharded dir is skipped the same way.
+
+        ``kind`` filters by layout: ``"any"`` (default), ``"dense"``
+        (restorable with :meth:`restore`) or ``"sharded"`` (restorable
+        with :meth:`restore_sharded`) — a consumer wired to one restore
+        path can ask only for checkpoints it can actually load."""
+        if kind not in ("any", "dense", "sharded"):
+            raise ValueError(f"kind must be any|dense|sharded, got {kind!r}")
+        for step, path, manifest in reversed(self.checkpoints()):
+            if step <= int(after_step):
+                break
+            sharded = bool(manifest.get("sharded"))
+            if kind == "dense" and sharded:
+                continue
+            if kind == "sharded" and not sharded:
+                continue
+            return step, path
         return None
 
-    def sweep_orphans(self) -> int:
-        """Remove ``.tmp-`` staging leftovers from crashed writers."""
+    def sweep_orphans(self, min_age_s: float = 0.0) -> int:
+        """Remove ``.tmp-`` staging leftovers from crashed writers.
+        ``min_age_s`` spares young staging dirs — a peer's in-flight
+        barrier round must not be reclaimed from under its writers."""
         from .atomic import discard_orphans
         return discard_orphans(
-            self.directory,
+            self.directory, min_age_s=min_age_s,
             log_warning=lambda p: log.warning(
                 "removing crashed checkpoint staging dir %s", p))
 
     # ----------------------------------------------------------- restore
+    def restore_any(self, path: Optional[str] = None, net=None, *,
+                    mesh=None, min_shard_size: Optional[int] = None,
+                    load_updater: bool = True):
+        """Restore a checkpoint of EITHER layout: a sharded dir
+        (``topology.json`` present) routes through
+        :meth:`restore_sharded` (``mesh``/``min_shard_size`` apply
+        there; ``mesh=None`` leaves leaves host-placed), a dense dir
+        through :meth:`restore`.  The single place the store's layout
+        sniff lives — consumers that must promote or resume whatever
+        the training tier wrote (serving promotion, elastic restart)
+        call this instead of re-implementing the detection."""
+        if path is None:
+            path = self.latest()
+            if path is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoint found in {self.directory}")
+        if os.path.isfile(os.path.join(path, "topology.json")):
+            kw: Dict[str, Any] = {"mesh": mesh,
+                                  "load_updater": load_updater}
+            if min_shard_size is not None:
+                kw["min_shard_size"] = min_shard_size
+            return self.restore_sharded(path=path, net=net, **kw)
+        return self.restore(path=path, net=net, load_updater=load_updater)
+
     def restore(self, path: Optional[str] = None, net=None,
                 load_updater: bool = True):
         """Restore from ``path`` (default: ``latest()``).  With ``net``
@@ -737,16 +1011,24 @@ class CheckpointManager:
             if not os.path.isfile(ipath):
                 self._count_restore("corrupt")
                 raise CorruptCheckpointError(path, f"{fname} has no index")
-            with open(ipath, encoding="utf-8") as f:
-                index = json.load(f)
-            with np.load(os.path.join(path, fname)) as z:
-                for entry in index:
-                    k = (entry["kind"], entry["leaf"])
-                    dims[k] = entry["dim"]
-                    bl = blocks.setdefault(k, [])
-                    start = int(entry["start"])
-                    if all(s != start for s, _ in bl):
-                        bl.append((start, z[entry["name"]]))
+            try:
+                with open(ipath, encoding="utf-8") as f:
+                    index = json.load(f)
+                with np.load(os.path.join(path, fname)) as z:
+                    for entry in index:
+                        k = (entry["kind"], entry["leaf"])
+                        dims[k] = entry["dim"]
+                        bl = blocks.setdefault(k, [])
+                        start = int(entry["start"])
+                        if all(s != start for s, _ in bl):
+                            bl.append((start, z[entry["name"]]))
+            except (ValueError, KeyError, OSError) as e:
+                # checksums passed, so this is a writer bug, not bit rot
+                # — still refuse with the store-level error the callers
+                # (ElasticTrainer fallback, promotion skip) understand
+                self._count_restore("corrupt")
+                raise CorruptCheckpointError(
+                    path, f"{fname} unreadable: {type(e).__name__}: {e}")
 
         def assemble(kind: str, leaf_key: str, spec: Dict[str, Any]):
             k = (kind, leaf_key)

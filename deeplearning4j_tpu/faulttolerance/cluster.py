@@ -45,7 +45,7 @@ from .atomic import atomic_write_json
 from ..observability.registry import default_registry
 
 __all__ = ["FileLeaseStore", "ClusterMember", "ClusterCoordinator",
-           "ClusterView", "shard_owner"]
+           "ClusterView", "shard_owner", "live_ranks"]
 
 _LEASE_DIR = "membership"
 _VIEW_FILE = "view.json"
@@ -59,6 +59,24 @@ def shard_owner(index: int, world_size: int) -> int:
     if world_size <= 0:
         raise ValueError(f"world_size must be positive, got {world_size}")
     return index % world_size
+
+
+def live_ranks(store: "FileLeaseStore", view: "ClusterView",
+               now: Optional[float] = None) -> set:
+    """Dense view-ranks of members whose lease is currently unexpired —
+    the ``ShardBarrier.live_fn`` any member can evaluate: it only READS
+    leases (eviction verdicts stay the coordinator's), so a barrier
+    primary on a non-coordinator host can still tell "that writer's
+    marker is missing because the writer is dead" from "still writing"
+    and abort the round instead of waiting out the full timeout."""
+    now = time.time() if now is None else now
+    out = set()
+    for wid, lease in store.all_leases().items():
+        if float(lease["expires_at"]) >= now:
+            rank = view.rank_of(wid)
+            if rank is not None:
+                out.add(rank)
+    return out
 
 
 @dataclass(frozen=True)

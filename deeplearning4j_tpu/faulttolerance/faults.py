@@ -275,8 +275,14 @@ class ChaosSchedule:
 
     def crash_in_commit(self, step: int, stage: int = 1) -> "ChaosSchedule":
         """Hard-exit the process between checkpoint staging writes of the
-        checkpoint at ``step`` (stage 1 = after model.zip, 2 = after
-        rng.npy)."""
+        checkpoint at ``step``.  Dense/single-writer sharded saves fire
+        stage 1 (after model.zip / container) and 2 (after rng.npy /
+        shard blocks).  A multi-writer BARRIER save fires 1 (primary:
+        container+topology staged), 2 (any writer: shard bytes staged,
+        completion marker NOT yet posted — "killed mid-block"), 3
+        (primary: every marker landed, nothing committed — "killed
+        between barrier and commit") and 4 (primary: manifest written,
+        rename not yet run)."""
         self._commit_crashes[int(step)] = int(stage)
         return self
 

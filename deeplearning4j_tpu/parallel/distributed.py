@@ -11,6 +11,7 @@ fast-forward.  ``ElasticTrainer`` implements that loop for any model with
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Callable, Iterable, Optional
 
@@ -20,6 +21,8 @@ from ..observability.clock import monotonic_s
 from ..observability.recorder import get_flight_recorder
 
 __all__ = ["initialize_distributed", "global_device_mesh", "ElasticTrainer"]
+
+log = logging.getLogger("deeplearning4j_tpu.parallel")
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -41,13 +44,63 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     return True
 
 
-def global_device_mesh(*, dp: Optional[int] = None, tp: int = 1, sp: int = 1):
+def global_device_mesh(*, dp: Optional[int] = None, tp: int = 1, sp: int = 1,
+                       local_fallback: bool = False):
     """Mesh over ALL processes' devices (``jax.devices()`` is global after
     ``initialize_distributed``).  Data axis is outermost so DP gradients
     reduce over DCN once per step while tp/sp collectives stay on ICI —
-    the 'collectives ride ICI' layout rule."""
+    the 'collectives ride ICI' layout rule.
+
+    ``local_fallback=True`` probes whether the backend can EXECUTE a
+    computation spanning the multi-process mesh and falls back to a
+    process-LOCAL mesh when it cannot (the CPU backend places
+    multi-process arrays through ``place_sharded``'s per-shard fallback
+    but refuses the computation itself: "Multiprocess computations
+    aren't implemented").  Under the fallback every process trains its
+    own replica on its own devices — with identical batches the SPMD
+    replicas stay byte-identical, which is exactly the posture the
+    two-process elastic tests need on the CPU rig."""
     from .mesh import make_mesh
-    return make_mesh(len(jax.devices()), dp=dp, tp=tp, sp=sp)
+    mesh = make_mesh(len(jax.devices()), dp=dp, tp=tp, sp=sp)
+    if local_fallback and jax.process_count() > 1 and \
+            not _global_compute_supported(mesh):
+        local = make_mesh(len(jax.local_devices()), tp=tp, sp=sp,
+                          devices=jax.local_devices())
+        # loud: the fallback changes semantics — per-process replicas
+        # over the LOCAL devices, and an explicit dp= (sized for the
+        # global device count) is superseded by the local device count
+        log.warning(
+            "backend cannot execute multi-process computations: falling "
+            "back from the global mesh %s to the process-local mesh %s "
+            "(independent per-process replicas%s)",
+            dict(mesh.shape), dict(local.shape),
+            f"; requested dp={dp} superseded" if dp is not None else "")
+        return local
+    return mesh
+
+
+def _global_compute_supported(mesh) -> bool:
+    """One tiny jitted add over an array placed on ``mesh``: True when the
+    backend runs multi-process computations, False when only placement
+    works.  The verdict depends on the backend alone, so every process
+    of the world agrees without coordinating."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+    from .mesh import place_sharded
+    try:
+        x = place_sharded(np.zeros((), np.float32),
+                          NamedSharding(mesh, PartitionSpec()))
+        jax.jit(lambda a: a + 1)(x).block_until_ready()  # graftlint: disable=JX004  (one-shot backend capability probe)
+        return True
+    except Exception as e:
+        # any failure means "don't trust global computation here", but
+        # the reason must be auditable — an unrelated transient (OOM,
+        # device error) silently flipping a fleet into solo replicas
+        # would otherwise look like a numerics bug
+        log.warning("multi-process computation probe failed (%s: %s) — "
+                    "treating the backend as placement-only",
+                    type(e).__name__, str(e)[:200])
+        return False
 
 
 class ElasticTrainer:
@@ -79,8 +132,10 @@ class ElasticTrainer:
 
     def __init__(self, model, checkpoint_dir: str, save_freq: int = 10,
                  keep_last: int = 2, *, manager=None, member=None,
-                 coordinator=None, background: bool = False):
+                 coordinator=None, background: bool = False,
+                 mesh_factory=None, barrier_timeout_s: float = 30.0):
         from ..faulttolerance.checkpoint import CheckpointManager
+        from ..parallel.sharded import ShardedTrainer
         self.model = model
         # A mesh wrapper (ParallelWrapper) trains, but its underlying
         # network is what serializes; after restore the wrapper re-places
@@ -98,10 +153,24 @@ class ElasticTrainer:
             checkpoint_dir, keep_last=self.keep_last, background=background)
         self.member = member
         self.coordinator = coordinator
+        # A ZeRO-3 ShardedTrainer flips the trainer into SPMD-sharded
+        # posture: checkpoints go through save_sharded (multi-writer
+        # barrier under membership), restores through
+        # restore_sharded(mesh=...), every live member trains every
+        # batch (the sharded step is collective over the mesh — the
+        # i%world data split only applies to independent replicas), and
+        # membership changes rebuild the mesh over the survivors.
+        self.sharded = isinstance(model, ShardedTrainer)
+        # mesh_factory(world_size) -> the survivor mesh after a
+        # membership change (sharded mode only).  None = keep the mesh.
+        self.mesh_factory = mesh_factory
+        self.barrier_timeout_s = float(barrier_timeout_s)
         self.last_restored_step = 0
         self.last_view = None
         self.trained_steps = 0      # batches THIS member actually fitted
         self.replayed_steps = 0     # of those, orphan re-covers (evictions)
+        self.barrier_aborts = 0     # lost barrier rounds (never lost data)
+        self.reshard_events = []    # one dict per survivor-mesh rebuild
 
     # -- checkpoint bookkeeping ------------------------------------------
     def latest_step(self) -> int:
@@ -118,25 +187,126 @@ class ElasticTrainer:
         cursor = {"batch_seq": int(step)}
         if view is not None:
             cursor["generation"] = int(view.generation)
-        self.manager.save(self._net, cursor=cursor, step=int(step),
-                          blocking=None)
+        if not self.sharded:
+            self.manager.save(self._net, cursor=cursor, step=int(step),
+                              blocking=None)
+            return
+        if view is None or self.member is None or view.world_size <= 1:
+            self.manager.save_sharded(self._net, cursor=cursor,
+                                      step=int(step), process_index=0,
+                                      process_count=1, blocking=None)
+            return
+        rank = view.rank_of(self.member.worker_id)
+        if rank is None:
+            return              # not (yet) admitted: nothing to contribute
+        from ..faulttolerance.checkpoint import ShardBarrierError
+        try:
+            self.manager.save_sharded(
+                self._net, cursor=cursor, step=int(step),
+                process_index=rank, process_count=view.world_size,
+                barrier=self._barrier_for(view))
+        except ShardBarrierError as e:
+            # a lost ROUND, never lost training: the previous complete
+            # checkpoint still stands and the next boundary retries the
+            # save under the refreshed membership view
+            self.barrier_aborts += 1
+            rec = get_flight_recorder()
+            if rec is not None:
+                rec.record("cluster", "barrier_abort", step=int(step),
+                           generation=int(view.generation), error=str(e))
+
+    def _barrier_for(self, view):
+        """The barrier contract for one multi-writer save round: the
+        view's generation fences the staging dir, lease reads supply the
+        liveness verdict, and a seeded RetryPolicy paces the primary's
+        marker polls (bounded by ``barrier_timeout_s``)."""
+        from ..faulttolerance.checkpoint import ShardBarrier
+        from ..faulttolerance.cluster import live_ranks
+        from ..faulttolerance.faults import RetryPolicy
+        store = self.member.store
+        return ShardBarrier(
+            generation=int(view.generation),
+            timeout_s=self.barrier_timeout_s,
+            policy=RetryPolicy(backoff_s=0.02, max_backoff_s=0.25,
+                               seed=int(view.generation)),
+            live_fn=lambda: live_ranks(store, view))
 
     def restore_latest(self) -> int:
         """Restore the newest complete checkpoint into the model; returns
         its global step (0 = fresh start).  A truncated/corrupt newest
         checkpoint is skipped in favor of the previous complete one, and
-        ``.tmp-`` staging orphans from a crashed writer are swept."""
-        self.manager.sweep_orphans()
+        ``.tmp-`` staging orphans from a crashed writer are swept
+        (under membership only AGED orphans go — a peer's in-flight
+        barrier round must not be reclaimed from under its writers).
+        A sharded checkpoint restores through ``restore_sharded`` onto
+        the model's CURRENT mesh — the survivor mesh at a rejoin — with
+        params, updater mirrors, RNG and cursor digest-exact."""
+        self.manager.sweep_orphans(
+            min_age_s=2.0 * self.barrier_timeout_s
+            if self.member is not None else 0.0)
         path = self.manager.latest()
         step = 0
         if path is not None:
-            _, state = self.manager.restore(path=path, net=self._net)
+            # restore_any: the manager owns the dense-vs-sharded layout
+            # sniff; a sharded dir re-places onto the model's mesh
+            _, state = self.manager.restore_any(
+                path=path, net=self._net, **self._reshard_kwargs())
             cursor = state.get("cursor") or {}
             step = int(cursor.get("batch_seq", state.get("iteration", 0)))
             if self._net is not self.model:
                 self.model._place()   # re-shard restored arrays on the mesh
         self.last_restored_step = step
         return step
+
+    def _reshard_kwargs(self, mesh=None):
+        kw = {"mesh": mesh if mesh is not None
+              else getattr(self.model, "mesh", None)}
+        mss = getattr(self.model, "min_shard_size", None)
+        if mss is not None:
+            kw["min_shard_size"] = mss
+        return kw
+
+    def _remesh(self, view, step: int) -> None:
+        """Membership changed: rebuild the mesh over the survivors and
+        route the model through ``restore_sharded(mesh=survivors)`` —
+        the boundary's just-committed barrier checkpoint re-placed under
+        the new topology (params + updater mirrors + RNG + cursor, a
+        pure byte re-placement).  When the boundary's save did NOT land
+        (an aborted barrier round), the LIVE state is re-placed instead
+        — restoring an older checkpoint here would silently rewind
+        training past batches the loop already consumed.  Either way the
+        train step keeps its single process-global trace: sharding lives
+        in the arguments, not the jaxpr."""
+        if not self.sharded or self.mesh_factory is None or view is None:
+            return
+        new_mesh = self.mesh_factory(view.world_size)
+        if new_mesh is None or new_mesh == getattr(self.model, "mesh",
+                                                   None):
+            return
+        t0 = monotonic_s()
+        ckpts = self.manager.checkpoints()
+        newest = ckpts[-1] if ckpts else None
+        via = "replace_live"
+        if newest is not None and int(newest[2].get("step", newest[0])) \
+                == int(step) and newest[2].get("sharded"):
+            self.manager.restore_sharded(
+                path=newest[1], net=self._net,
+                **self._reshard_kwargs(mesh=new_mesh))
+            via = "restore_sharded"
+        # remesh either way: re-target the wrapper and refresh
+        # replicated state + shardings (leaves restore_sharded already
+        # placed under the new layout short-circuit in place_sharded)
+        self.model.remesh(new_mesh)
+        from .mesh import DATA_AXIS
+        event = {"step": int(step), "world_size": view.world_size,
+                 "generation": int(view.generation),
+                 "dp": int(new_mesh.shape.get(DATA_AXIS, 1)),
+                 "via": via, "ms": (monotonic_s() - t0) * 1e3,
+                 "t": monotonic_s()}   # completion stamp (bench timing)
+        self.reshard_events.append(event)
+        rec = get_flight_recorder()
+        if rec is not None:
+            rec.record("cluster", "survivor_remesh", **event)
 
     # -- membership -------------------------------------------------------
     def _round_view(self, round_index: int):
@@ -164,7 +334,23 @@ class ElasticTrainer:
             # (pre-admission) trains nothing — its heartbeat gets it
             # admitted at a boundary
             return view is None or self.member is None
+        if self.sharded:
+            # SPMD posture: the sharded step is collective over the
+            # mesh, so every ADMITTED member executes every batch (the
+            # i%world data split only applies to independent replicas);
+            # membership gates admission, fencing, and barrier writes
+            return view.rank_of(self.member.worker_id) is not None
         return owner == self.member.worker_id
+
+    def _writes_checkpoint(self, view) -> bool:
+        """Who calls ``_save`` at a boundary: the primary always; under
+        a sharded multi-writer world, EVERY admitted member (each must
+        contribute its shard block before the primary can commit)."""
+        if self._is_primary(view):
+            return True
+        return (self.sharded and view is not None
+                and self.member is not None
+                and view.rank_of(self.member.worker_id) is not None)
 
     def _replay_orphans(self, old_view, new_view, window) -> None:
         """Batches owned by a member evicted between ``old_view`` and
@@ -227,6 +413,8 @@ class ElasticTrainer:
         last_saved = step
         self.trained_steps = 0
         self.replayed_steps = 0
+        self.barrier_aborts = 0
+        self.reshard_events = []
         view = self._round_view(step // self.save_freq)
         self.last_view = view
         # orphan-replay window: batches this member did NOT train, kept
@@ -236,7 +424,8 @@ class ElasticTrainer:
         # lose the dead member's last batches to a committed cursor —
         # exactly-once under compound faults needs acked rounds, which is
         # the ROADMAP follow-up.)
-        window: list = [] if self.member is not None else None
+        window: list = [] if (self.member is not None
+                              and not self.sharded) else None
         horizon_s = (2.0 * self.member.lease_ttl_s
                      if self.member is not None else 0.0)
         try:
@@ -254,11 +443,19 @@ class ElasticTrainer:
                     # that lost its place never writes the shared store
                     new_view = self._round_view(done // self.save_freq)
                     self._replay_orphans(view, new_view, window)
+                    changed = (view is not None and new_view is not None
+                               and new_view.generation != view.generation)
                     view = new_view
                     self.last_view = view
-                    if self._is_primary(view):
+                    if self._writes_checkpoint(view):
                         self._save(done, view)
                     last_saved = done
+                    if changed:
+                        # survivors rebuild the mesh AFTER the save: the
+                        # boundary checkpoint (written by the surviving
+                        # writers under the new view) reshards onto the
+                        # survivor mesh digest-exact
+                        self._remesh(view, done)
                 if self._owns(done, view):
                     self.model.fit_batch(batch)
                     self.trained_steps += 1
@@ -280,7 +477,7 @@ class ElasticTrainer:
                     self._replay_orphans(view, new_view, window)
                     view = new_view
                     self.last_view = view
-                if self._is_primary(view):
+                if self._writes_checkpoint(view):
                     self._save(done, view)
         except Exception as e:
             rec = get_flight_recorder()

@@ -113,6 +113,11 @@ def place_sharded(x, sharding: NamedSharding):
     placement, built from the primitives every backend has."""
     if x is None:
         return None
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        # already committed to exactly this layout: the elastic remesh
+        # path re-places every leaf after a restore_sharded that placed
+        # them itself — skip the redundant device_put round
+        return x
     try:
         return jax.device_put(x, sharding)
     except Exception as direct_err:

@@ -45,7 +45,13 @@ Checkpoints: ``faulttolerance.checkpoint`` grows ``save_sharded`` /
 — each process writes only its shard blocks plus a topology manifest,
 and a restore reassembles host-side and re-places onto ANY mesh (a
 4-way checkpoint resumes 8-way), which is also what lets an elastic
-rejoin re-place a sharded model onto the surviving world.
+rejoin re-place a sharded model onto the surviving world.  Multi-writer
+worlds commit through the two-phase ``ShardBarrier`` staged protocol
+(every process's block + generation-fenced marker land before the
+primary's manifest+rename), and ``ElasticTrainer`` drives the whole
+loop: barrier saves at round boundaries, membership changes rebuilding
+the mesh over survivors via ``restore_sharded(mesh=survivors)``, one
+train-step trace across topology changes.
 """
 from __future__ import annotations
 
@@ -135,7 +141,11 @@ class ShardedTrainer(ParallelWrapper):
     def save_sharded(self, manager, **kwargs) -> str:
         """Shard-aware checkpoint through a ``CheckpointManager`` — this
         process writes only its shard blocks + the topology manifest
-        (``faulttolerance.checkpoint.save_sharded``)."""
+        (``faulttolerance.checkpoint.save_sharded``).  Multi-process
+        worlds pass ``barrier=ShardBarrier(...)`` (or run under
+        ``ElasticTrainer``, which builds the barrier from the cluster
+        view): the primary commits only after every live writer's block
+        lands."""
         return manager.save_sharded(self.model, **kwargs)
 
     def average_params(self):

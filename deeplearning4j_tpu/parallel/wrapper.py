@@ -147,6 +147,16 @@ class ParallelWrapper:
             m.opt_state, param_treedef, place_param_tree,
             lambda o: place_sharded(o, repl))
 
+    def remesh(self, mesh: Mesh) -> "ParallelWrapper":
+        """Re-target the wrapper onto a different mesh and re-place all
+        device state under its layout (the elastic shrink/grow path: the
+        survivor mesh becomes the new topology).  The jitted train step
+        is untouched — sharding lives in the step's ARGUMENTS, so the
+        process-global trace serves the new mesh without retracing."""
+        self.mesh = mesh
+        self._place()
+        return self
+
     # ---- model duck-typing (EarlyStoppingTrainer & friends) ----------
     @property
     def params(self):

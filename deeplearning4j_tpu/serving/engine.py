@@ -469,7 +469,10 @@ class ServingEngine:
         if newest is None:
             return None
         step, path = newest
-        model, _ = mgr.restore(path=path)
+        # restore_any: sharded dirs (multi-writer barrier checkpoints)
+        # promote through restore_sharded(mesh=None), dense through
+        # restore — the layout sniff lives on the manager
+        model, _ = mgr.restore_any(path=path)
         self.hot_swap(model, origin=path, step=step)
         if directory == self.checkpoint_dir or self.checkpoint_dir is None:
             self.checkpoint_dir = directory

@@ -166,7 +166,7 @@ class InferenceServer(PredictCircuitMixin):
             if newest is None:
                 raise FileNotFoundError(
                     f"no complete checkpoint to promote in {path}")
-            new_model, _ = mgr.restore(path=newest[1])
+            new_model, _ = mgr.restore_any(path=newest[1])
         else:
             new_model = restore_model(path)
         old = self.inference

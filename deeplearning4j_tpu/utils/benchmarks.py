@@ -963,7 +963,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 20 module rules off the
+    through the public ``lint_paths`` API — 21 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression
@@ -1222,5 +1222,130 @@ def sharded_step_time_ms(hidden: int = 512, features: int = 256,
         "global_param_bytes": int(global_bytes),
         "min_shard_size": int(min_shard_size),
         "train_step_traces": int(traces() - t_before),
+        "steps": steps,
+    }
+
+
+def elastic_reshard_ms(hidden: int = 32, features: int = 8,
+                       classes: int = 4, n_batches: int = 16,
+                       batch: int = 8, save_freq: int = 2,
+                       lease_ttl_s: float = 0.4,
+                       step_sleep_s: float = 0.05) -> Dict:
+    """Elastic-reshard benchmark (ISSUE 13): wall time from a MEMBER
+    LOSS (its last heartbeat — the process is gone) to the FIRST clean
+    sharded train step on the survivor mesh.  The run is the real
+    elastic path end to end: a two-member view over a dp=4 ZeRO-3 mesh,
+    the dead member's in-flight barrier round aborted (never a torn
+    store), eviction at the next round boundary, the survivor mesh
+    rebuilt through ``restore_sharded(mesh=survivors)`` (params +
+    updater mirrors re-placed byte-exact at dp=2), then training
+    continues — ``restore_ms`` carries the reshard-restore slice of
+    that window, ``detect_ms`` the lease-expiry + boundary wait.  The
+    train step itself keeps its single process-global trace across the
+    topology change (re-LOWERING for the new mesh is part of the
+    measured window, as it is in production)."""
+    import tempfile
+
+    import jax
+
+    from ..faulttolerance.cluster import (ClusterCoordinator,
+                                          ClusterMember, FileLeaseStore)
+    from ..nn.conf.input_type import InputType
+    from ..nn.conf.multi_layer import NeuralNetConfiguration
+    from ..nn.conf.updaters import Adam
+    from ..nn.layers.feedforward import DenseLayer, OutputLayer
+    from ..nn.multilayer import MultiLayerNetwork
+    from ..parallel.distributed import ElasticTrainer
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sharded import ShardedTrainer
+
+    import time
+
+    if len(jax.devices()) < 4:
+        raise RuntimeError("elastic_reshard_ms needs >= 4 devices")
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(learning_rate=0.02)).list()
+                .layer(DenseLayer(n_out=hidden, activation="tanh"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)
+    batches = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((batch, features)).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[
+            rng.integers(0, classes, batch)]
+        batches.append((x, y))
+
+    # prewarm the TRACE (dp=4 executable): the member must die mid-run,
+    # not during the first step's cold compile
+    warm = build()
+    ShardedTrainer(warm, make_mesh(dp=4), min_shard_size=0).fit_batch(
+        batches[0])
+
+    workdir = tempfile.mkdtemp(prefix="dl4j-reshard-bench-")
+    try:
+        store = FileLeaseStore(workdir)
+        coord = ClusterCoordinator(store, lease_ttl_s=lease_ttl_s)
+        m0 = ClusterMember(store, 0, lease_ttl_s=10.0)
+        m0.renew_once()
+        net = build()
+        st = ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+        trainer = ElasticTrainer(
+            st, workdir, save_freq=save_freq, member=m0,
+            coordinator=coord,
+            mesh_factory=lambda w: make_mesh(dp=2 * w),
+            barrier_timeout_s=10.0)
+        # the doomed member: one lease, never renewed — its "death" is
+        # the renew timestamp, its loss is DETECTED when the lease
+        # expires under the survivor's barrier/boundary machinery
+        store.renew(1, ttl_s=lease_ttl_s)
+        t_loss = monotonic_s()
+        coord.begin_round(0)
+
+        step_done_s: list = []
+
+        class _Clock:
+            def iteration_done(self, model, iteration, epoch):
+                step_done_s.append(monotonic_s())
+
+        net.listeners.append(_Clock())
+
+        def feed():
+            for b in batches:
+                time.sleep(step_sleep_s)
+                yield b
+
+        steps = trainer.fit(feed)
+        m0.stop()
+    finally:
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    ev = trainer.reshard_events[0] if trainer.reshard_events else None
+    first_clean = None
+    if ev is not None:
+        after = [t for t in step_done_s if t > ev["t"]]
+        first_clean = after[0] if after else None
+    value = None if (ev is None or first_clean is None) \
+        else (first_clean - t_loss) * 1e3
+    return {
+        "metric": "elastic_reshard_ms",
+        "value": None if value is None else round(value, 2),
+        "unit": "ms member loss -> first clean sharded step "
+                "(survivor mesh)",
+        "restore_ms": None if ev is None else round(ev["ms"], 2),
+        "detect_ms": None if (ev is None or first_clean is None)
+        else round(value - ev["ms"], 2),
+        "dp_before": 4, "dp_after": None if ev is None else ev["dp"],
+        "world_before": 2,
+        "world_after": None if ev is None else ev["world_size"],
+        "barrier_aborts": trainer.barrier_aborts,
+        "lease_ttl_s": lease_ttl_s, "save_freq": save_freq,
         "steps": steps,
     }

@@ -77,6 +77,12 @@ _SLOW_TESTS = {
     "test_chaos_crash_mid_checkpoint_commit",
     "test_chaos_sigkill_mp_worker_mid_round",
     "test_mp_heartbeat_watchdog_evicts_wedged_worker",
+    # sharded barrier chaos (tests/test_elastic_sharded.py): two real OS
+    # processes share one store and get hard-killed mid-protocol
+    "test_shard_chaos_fault_free_barrier_store_reshards",
+    "test_shard_chaos_non_primary_dies_mid_block",
+    "test_shard_chaos_primary_dies_before_commit",
+    "test_shard_chaos_partition_during_barrier",
 }
 
 

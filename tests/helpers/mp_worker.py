@@ -49,7 +49,13 @@ def main():
             .set_input_type(InputType.feed_forward(20))
             .build())
     model = MultiLayerNetwork(conf).init()
-    mesh = global_device_mesh()          # pure DP over all processes' devices
+    # pure DP over all processes' devices — with the process-LOCAL
+    # fallback for backends that place multi-process arrays (the
+    # place_sharded per-shard path) but refuse to execute a
+    # multi-process computation (this CPU rig: "Multiprocess
+    # computations aren't implemented").  Identical batches keep the
+    # per-process replicas byte-identical either way.
+    mesh = global_device_mesh(local_fallback=True)
     pw = ParallelWrapper(model, mesh)
 
     rng = np.random.default_rng(7)       # identical batches on every process
@@ -69,14 +75,37 @@ def main():
                              save_freq=2)
     steps = trainer.fit(batches, max_steps=max_steps)
 
+    # score computed fresh (not get_score): a restart that resumes at
+    # max_steps runs zero new optimizer steps, so the running score
+    # would be nan while the restored params are perfectly healthy
     result = {"pid": pid, "steps": steps,
               "resumed_from": trainer.last_restored_step,
-              "score": model.get_score(),
+              "score": model.score(x=all_batches[-1][0],
+                                   y=all_batches[-1][1]),
               "param_sum": float(np.asarray(
                   model.params["layer_0"]["W"]).sum())}
     with open(os.path.join(outdir, f"result_p{pid}.json"), "w") as f:
         json.dump(result, f)
     print(f"[{pid}] done: {result}", flush=True)
+    if pid == 0:
+        # exit barrier: process 0 hosts the jax.distributed coordination
+        # service — exiting while a peer still trains aborts the peer.
+        # Wait (bounded) for every peer's durable result first; a
+        # crashed peer's result never comes, so the wait is capped.
+        import time
+        deadline = time.time() + 30
+        others = [i for i in range(nproc) if i != pid]
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(outdir,
+                                               f"result_p{i}.json"))
+                   for i in others):
+                break
+            time.sleep(0.2)
+    # hard-exit: the work is done and the result is durable.  A clean
+    # interpreter exit would run the jax.distributed teardown, which
+    # SIGABRTs the survivor once it notices a hard-crashed peer — the
+    # crash-recovery test needs "survivor completed" to read as rc 0
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,159 @@
+"""Multi-writer sharded-checkpoint chaos worker (launched by
+tests/test_elastic_sharded.py).
+
+One OS process of a two-member sharded training world: a ZeRO-3
+``ShardedTrainer`` over this process's LOCAL devices (the CPU backend
+executes no multi-process computation — identical batches keep the two
+replicas byte-identical, the same posture ``mp_worker.py`` uses), an
+``ElasticTrainer`` over a SHARED checkpoint store with lease membership,
+and every checkpoint a multi-writer BARRIER save: both processes stage
+``shards-pNN.npz`` blocks + generation-fenced markers, the primary
+(rank 0) commits only after both blocks land.
+
+Chaos modes (``SC_CHAOS``), all deterministic:
+
+- ``block:<step>``     — THIS writer hard-exits mid-block at the barrier
+  save of ``step`` (commit stage 2: shard bytes staged, completion
+  marker never posted — the SIGKILL-a-non-primary-mid-block fault);
+- ``precommit:<step>`` — THIS writer (run it on the primary) hard-exits
+  between the barrier and the commit (stage 3: every block landed,
+  nothing committed);
+- ``manifest:<step>``  — hard-exit between the manifest write and the
+  commit rename (stage 4: the crash_in_commit-on-the-manifest fault);
+- ``partition:<batch>:<seconds>`` — at data batch ``<batch>`` THIS
+  member stops heartbeating and stalls ``<seconds>`` (a network
+  partition: its lease expires mid-barrier, the primary aborts the round
+  and the survivors train on);
+- unset — run fault-free.
+
+Env: SC_DIR (shared store), SC_OUT (result json), SC_PID, SC_BATCHES,
+SC_SAVE_FREQ, SC_STEP_SLEEP, SC_LEASE_TTL_S, SC_BARRIER_TIMEOUT_S,
+SC_CHAOS.  The result json carries a sha256 digest over the raveled
+final params: the acceptance criterion is digest equality with the
+fault-free run — exact, because barrier rounds either commit complete
+or abort clean and resume restores params + updater + RNG + cursor.
+"""
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)   # match the test process
+
+
+def build_model():
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.multi_layer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.updaters import Adam
+    from deeplearning4j_tpu.nn.layers.feedforward import (DenseLayer,
+                                                          OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).activation("tanh").weight_init("xavier")
+            .updater(Adam(learning_rate=0.02))
+            .list()
+            .layer(DenseLayer(n_out=16))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_batches(n):
+    import numpy as np
+    rng = np.random.default_rng(7)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((8, 6)).astype(np.float32)
+        out.append((x, np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]))
+    return out
+
+
+def main():
+    import numpy as np
+
+    from deeplearning4j_tpu.faulttolerance.cluster import (
+        ClusterCoordinator, ClusterMember, FileLeaseStore)
+    from deeplearning4j_tpu.faulttolerance.faults import ChaosSchedule
+    from deeplearning4j_tpu.parallel.distributed import ElasticTrainer
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.sharded import ShardedTrainer
+
+    store_dir = os.environ["SC_DIR"]
+    out = os.environ["SC_OUT"]
+    pid = int(os.environ["SC_PID"])
+    n_batches = int(os.environ.get("SC_BATCHES", "12"))
+    save_freq = int(os.environ.get("SC_SAVE_FREQ", "4"))
+    step_sleep = float(os.environ.get("SC_STEP_SLEEP", "0"))
+    lease_ttl = float(os.environ.get("SC_LEASE_TTL_S", "2.0"))
+    barrier_timeout = float(os.environ.get("SC_BARRIER_TIMEOUT_S", "90"))
+    chaos = os.environ.get("SC_CHAOS", "")
+
+    model = build_model()
+    mesh = make_mesh(devices=jax.local_devices())   # local dp=2
+    st = ShardedTrainer(model, mesh, min_shard_size=0)
+
+    store = FileLeaseStore(store_dir)
+    member = ClusterMember(store, pid, lease_ttl_s=lease_ttl).start()
+    coordinator = None
+    if pid == 0:
+        coordinator = ClusterCoordinator(store, lease_ttl_s=lease_ttl)
+    trainer = ElasticTrainer(st, store_dir, save_freq=save_freq,
+                             keep_last=8, member=member,
+                             coordinator=coordinator,
+                             barrier_timeout_s=barrier_timeout)
+
+    partition_at = partition_s = None
+    if chaos.startswith(("block:", "precommit:", "manifest:")):
+        kind, step = chaos.split(":")
+        stage = {"block": 2, "precommit": 3, "manifest": 4}[kind]
+        trainer.manager.chaos = ChaosSchedule(seed=0).crash_in_commit(
+            int(step), stage)
+    elif chaos.startswith("partition:"):
+        _, partition_at, partition_s = chaos.split(":")
+        partition_at, partition_s = int(partition_at), float(partition_s)
+
+    batches = make_batches(n_batches)
+
+    def feed():
+        for i, b in enumerate(batches):
+            if partition_at is not None and i == partition_at:
+                # the partition: heartbeats stop (the lease will expire
+                # under the peers' feet) and this member stalls past the
+                # primary's eviction verdict
+                member._stop.set()
+                time.sleep(partition_s)
+            if step_sleep:
+                time.sleep(step_sleep)
+            yield b
+
+    steps = trainer.fit(feed)
+
+    from jax.flatten_util import ravel_pytree
+    flat, _ = ravel_pytree(model.params)
+    flat = np.asarray(flat, np.float64)
+    view = trainer.last_view
+    result = {"pid": pid, "steps": steps,
+              "resumed_from": trainer.last_restored_step,
+              "trained_steps": trainer.trained_steps,
+              "barrier_aborts": trainer.barrier_aborts,
+              "evicted": bool(view is not None
+                              and view.rank_of(pid) is None),
+              "param_digest": hashlib.sha256(flat.tobytes()).hexdigest()}
+    with open(out, "w") as f:
+        json.dump(result, f)
+    member.stop()
+    print(f"[{pid}] done: {result}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,65 @@ def test_retention_keep_last_every_n_and_best(tmp_path):
     assert steps == [3, 5, 6, 7]
 
 
+def _sharded_build_net(seed=42):
+    """build_net() laid out ZeRO-3 over a dp=4 mesh (every (4,16)/(16,)
+    kernel shards with min_shard_size=0)."""
+    from deeplearning4j_tpu.parallel import ShardedTrainer, make_mesh
+    net = build_net(seed=seed)
+    ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+    return net
+
+
+@pytest.mark.skipif("len(__import__('jax').devices()) < 4")
+def test_latest_complete_recognizes_sharded_dirs(tmp_path, live_registry):
+    """Satellite (ISSUE 13): the promotion poll and its kind filter see
+    the sharded layout — and a corrupt SHARD file makes the dir fall
+    back exactly like a torn dense checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), background=False)
+    net = build_net()
+    net.fit_batch(make_batches(1)[0])
+    mgr.save(net, step=1)                               # dense
+    snet = _sharded_build_net()
+    p2 = mgr.save_sharded(snet, step=2)                 # sharded
+    assert mgr.latest_complete() == (2, p2)
+    assert mgr.latest_complete(kind="sharded") == (2, p2)
+    step, path = mgr.latest_complete(kind="dense")
+    assert step == 1
+    assert mgr.latest_complete(after_step=2) is None
+    with pytest.raises(ValueError, match="dense"):
+        mgr.latest_complete(kind="zipped")
+    # corrupt the newest sharded dir's shard payload: the promotion
+    # path must skip it and answer the previous complete checkpoint
+    shard = next(f for f in os.listdir(p2) if f.endswith(".npz"))
+    with open(os.path.join(p2, shard), "r+b") as f:
+        f.seek(25)
+        f.write(b"\xde\xad\xbe\xef")
+    step, _ = mgr.latest_complete()
+    assert step == 1
+    assert mgr.latest_complete(kind="sharded") is None
+    c = live_registry.get("checkpoint_restore_total")
+    assert c is not None and c.labels("skipped").value >= 1
+
+
+@pytest.mark.skipif("len(__import__('jax').devices()) < 4")
+def test_retention_recognizes_sharded_dirs(tmp_path):
+    """Satellite (ISSUE 13): keep_last / keep_best retention treats
+    barrier-written sharded dirs exactly like dense ones — sweeps the
+    old, pins the best recorded metric."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=2, keep_best=1,
+                            background=False)
+    snet = _sharded_build_net()
+    metrics = {1: 5.0, 2: 0.5, 3: 4.0, 4: 3.0, 5: 2.0}
+    for step in range(1, 6):
+        mgr.save_sharded(snet, step=step, metric=metrics[step])
+    steps = [s for s, _, _ in mgr.checkpoints()]
+    # last two (4,5) plus the best metric 0.5 (2) — 1,3 swept
+    assert steps == [2, 4, 5]
+    for _, path, manifest in mgr.checkpoints():
+        assert manifest.get("sharded")
+        assert os.path.isfile(os.path.join(path, "topology.json"))
+
+
 def test_latest_skips_corrupt_and_restore_refuses(tmp_path, live_registry):
     net = build_net()
     net.fit_batch(make_batches(1)[0])

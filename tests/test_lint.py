@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX024 has at least one fixture that MUST fire and one
+Every rule JX001–JX025 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1128,6 +1128,95 @@ def test_jx024_pragma_suppresses():
     """, _PARALLEL_PATH)
 
 
+# ---------------------------------------------------------------- JX025
+_FT_PATH = "deeplearning4j_tpu/faulttolerance/fix.py"
+
+
+def test_jx025_positive_unbudgeted_rendezvous_waits():
+    src = """
+        import time
+
+        def wait_for_markers(stage, expected):
+            while True:
+                have = scan(stage)
+                if not (expected - have):
+                    break
+                time.sleep(0.05)          # no deadline, no budget
+
+        def lease_poll(store, want):
+            missing = list(want)
+            while missing:
+                live = store.all_leases()
+                missing = [w for w in want if w not in live]
+                time.sleep(0.1)
+    """
+    for path in (_FT_PATH, "deeplearning4j_tpu/parallel/fix.py"):
+        fs = lint_source(textwrap.dedent(src), path)
+        assert sum(f.rule == "JX025" for f in fs) == 2, path
+
+
+def test_jx025_negative_budgeted_and_cancellable_waits():
+    # deadline-bounded, stop-event, drain-until-empty, attempt-budgeted
+    # and out-of-scope waits all stay legal
+    assert "JX025" not in rules_at("""
+        import time
+
+        def wait_for_markers(stage, expected, timeout_s):
+            deadline = time.time() + timeout_s
+            while True:
+                if not (expected - scan(stage)):
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError("barrier timed out")
+                time.sleep(0.05)
+
+        def heartbeat(stop, interval):
+            while not stop.wait(interval):
+                renew()
+
+        def beat_with_body_check(stop, broker):
+            while True:
+                broker.publish(b"hb")
+                if stop.wait(0.5):
+                    return
+
+        def drain(sub):
+            while True:
+                payload = sub.poll(timeout=0.001)
+                if payload is None:
+                    break
+                handle(payload)
+
+        def retry(policy, worker):
+            attempt = 0
+            while attempt < policy.max_retries:
+                attempt += 1
+                policy.sleep(attempt, worker)
+    """, _FT_PATH)
+    # same spelling outside faulttolerance//parallel/ is out of scope
+    assert "JX025" not in rules_at("""
+        import time
+
+        def wait(flag):
+            while True:
+                if flag():
+                    break
+                time.sleep(0.05)
+    """, "deeplearning4j_tpu/serving/fix.py")
+
+
+def test_jx025_pragma_suppresses():
+    assert "JX025" not in rules_at("""
+        import time
+
+        def wait_forever(flag):
+            while True:
+                if flag():
+                    break
+                time.sleep(0.05)  # graftlint: disable=JX025  (test rig: the driver kills us)
+    """, _FT_PATH)
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2182,7 +2271,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 20
+    assert len(RULES) == 21
     assert len(PROGRAM_RULES) == 4
 
 

@@ -399,6 +399,48 @@ class TestServingEngine:
             eng.shutdown()
 
 
+    def test_promote_latest_handles_sharded_checkpoints(self, tmp_path):
+        """Train→serve promotion recognizes the SHARDED checkpoint
+        layout (ISSUE 13): a barrier-written dir promotes through
+        restore_sharded, and a corrupt shard file makes the dir as
+        unpromotable as any torn checkpoint — the previous complete one
+        serves."""
+        import os
+
+        import jax
+        from deeplearning4j_tpu.faulttolerance import CheckpointManager
+        from deeplearning4j_tpu.parallel import ShardedTrainer, make_mesh
+        from deeplearning4j_tpu.serving import ServingEngine
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mgr = CheckpointManager(tmp_path, background=False)
+        net_a, net_b = _small_net(1), _small_net(99)
+        ShardedTrainer(net_a, make_mesh(dp=4), min_shard_size=0)
+        ShardedTrainer(net_b, make_mesh(dp=4), min_shard_size=0)
+        mgr.save_sharded(net_a, step=1)
+        p2 = mgr.save_sharded(net_b, step=2)
+        shard = next(f for f in os.listdir(p2) if f.endswith(".npz"))
+        with open(os.path.join(p2, shard), "r+b") as f:
+            f.seek(20)
+            f.write(b"\xde\xad")
+        eng = ServingEngine(checkpoint_dir=str(tmp_path), max_batch_size=4)
+        try:
+            # corrupt-shard newest skipped: the step-1 sharded dir serves
+            assert eng.slot.step == 1
+            x = np.ones((2, 4), np.float32)
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(net_a.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            # a complete newer sharded checkpoint promotes normally
+            mgr.save_sharded(net_b, step=3)
+            assert eng.promote_latest() == 3
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(net_b.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            eng.shutdown()
+
+
 class TestServingServerHotSwapUnderLoad:
     def test_hot_swap_under_load_zero_failures_no_mixed_weights(
             self, tmp_path):

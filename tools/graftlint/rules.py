@@ -1181,6 +1181,103 @@ def jx024(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+def _jx025_bounded_exit(loop: ast.While) -> bool:
+    """True when the loop carries a bounded/cancellable exit shape: an
+    ``if`` whose test is an ``is None`` comparison (drain-until-empty)
+    or contains a ``wait``/``is_set`` call (stop-event), with a
+    ``break``/``return``/``raise`` in that branch."""
+    for sub in ast.walk(loop):
+        if not isinstance(sub, ast.If):
+            continue
+        test = sub.test
+        drains = isinstance(test, ast.Compare) and any(
+            isinstance(op, ast.Is) for op in test.ops) and any(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in test.comparators)
+        cancels = any(
+            isinstance(c, ast.Call) and (call_name(c) or "").split(
+                ".")[-1] in ("wait", "is_set")
+            for c in ast.walk(test))
+        if not (drains or cancels):
+            continue
+        if any(isinstance(s, (ast.Break, ast.Return, ast.Raise))
+               for n in sub.body for s in ast.walk(n)):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------- JX025
+# scope: the cluster-runtime modules, where an unbounded barrier /
+# rendezvous / lease-poll wait turns one dead peer into a permanently
+# wedged survivor (the fleet's liveness rests on every wait being
+# budgeted)
+_JX025_PATH_RE = re.compile(r"(^|[/\\])(faulttolerance|parallel)[/\\]")
+_JX025_SLEEP_CALLS = frozenset(("sleep", "wait", "poll", "backoff"))
+_JX025_BUDGET_NAME_RE = re.compile(
+    r"attempt|retr|tries|budget|deadline|timeout|remaining|expires",
+    re.IGNORECASE)
+
+
+@rule("JX025", "barrier/rendezvous wait loop with no timeout or "
+               "RetryPolicy budget in a cluster-runtime module")
+def jx025(info: ModuleInfo) -> List[Finding]:
+    """Flag ``while`` loops in ``faulttolerance/`` / ``parallel/``
+    modules that poll — a ``sleep``/``wait``/``poll``/``backoff`` call
+    in the loop body — with no budget evidence anywhere in the loop: no
+    comparison on a deadline/timeout/attempt/budget-style name.  These
+    are the barrier and rendezvous waits of the cluster runtime
+    (``expect_members``, lease polls, shard-block-marker waits); an
+    unbudgeted one waits forever on a peer that died mid-protocol, so
+    one SIGKILL wedges every survivor.  Bound the wait with an explicit
+    deadline, or pace it with ``faulttolerance.RetryPolicy`` under an
+    attempt budget.
+
+    Three WAITING shapes stay legal because they are bounded or
+    cancellable by construction: the stop-event loop (the wait IS the
+    test, ``while not stop.wait(interval)``, or an ``if stop.wait(..):
+    return/break`` in the body), the drain-until-empty loop (``x =
+    q.poll(..); if x is None: break/return`` — it exits the moment the
+    source is momentarily empty, the inverse of waiting for it), and
+    any loop comparing a deadline/attempt-style name."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX025_PATH_RE.search(path):
+        return out
+    for loop in info.nodes(ast.While):
+        test_calls = {id(sub) for sub in ast.walk(loop.test)
+                      if isinstance(sub, ast.Call)}
+        sleeps = [
+            sub for sub in ast.walk(loop)
+            if isinstance(sub, ast.Call) and id(sub) not in test_calls
+            and (call_name(sub) or "").split(".")[-1] in _JX025_SLEEP_CALLS
+            and _nearest_loop(info, sub) is loop]
+        if not sleeps:
+            continue
+        # stop-event pattern in the TEST: `while not stop.wait(i)` /
+        # `while not shutdown.is_set()` — cancellable per iteration
+        if any((call_name(sub) or "").split(".")[-1]
+               in ("wait", "is_set", "poll")
+               for sub in ast.walk(loop.test)
+               if isinstance(sub, ast.Call)):
+            continue
+        has_budget = any(
+            isinstance(sub, ast.Compare) and any(
+                _JX025_BUDGET_NAME_RE.search(n)
+                for n in _jx016_names_in(sub))
+            for sub in ast.walk(loop))
+        if has_budget or _jx025_bounded_exit(loop):
+            continue
+        out.append(_finding(
+            info, sleeps[0], "JX025",
+            "unbudgeted rendezvous wait: this `while` loop polls "
+            "(sleep/wait/poll) with no deadline or attempt-budget "
+            "comparison anywhere in the loop — a peer that died "
+            "mid-protocol wedges this process forever; bound the wait "
+            "with an explicit deadline, or pace it with "
+            "faulttolerance.RetryPolicy under an attempt budget"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
