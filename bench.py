@@ -85,6 +85,13 @@ clean sharded train step on the survivor mesh — lease expiry, barrier
 abort, eviction, and the restore_sharded(mesh=survivors) re-placement
 all inside the measured window); DL4J_TPU_BENCH_RESHARD=0 suppresses
 it.
+
+A thirteenth JSON line records the IR-audit benchmark
+(``audit_time_ms``: build the canonical program set through its
+production entry points + the full graftaudit run — jaxpr phase and
+the partitioned-HLO compiles — the same audit that gates tier-1 in
+tests/test_audit.py, budget 60s); DL4J_TPU_BENCH_AUDIT=0 suppresses
+it.
 """
 import json
 import os
@@ -355,6 +362,19 @@ def main():
                                       "sharded step (survivor mesh)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # IR-audit row (ISSUE 14): canonical-set build + full graftaudit wall
+    # time — the tier-1 audit gate's CI latency; a thirteenth JSON line,
+    # opt-out DL4J_TPU_BENCH_AUDIT=0
+    if os.environ.get("DL4J_TPU_BENCH_AUDIT", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import audit_time_ms
+            print(json.dumps(audit_time_ms()))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "audit_time_ms", "value": None,
+                              "unit": "ms full canonical-set IR audit "
+                                      "(build + audit)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -475,6 +495,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # step on the survivor mesh (barrier abort + eviction +
         # restore_sharded re-placement inside the window)
         B.elastic_reshard_ms,
+        # IR audit (ISSUE 14): canonical program set build + full
+        # graftaudit run (jaxpr + partitioned-HLO phases) — the tier-1
+        # audit gate's wall time, budget 60s
+        B.audit_time_ms,
     ]
     side = []
     for fn in captures:

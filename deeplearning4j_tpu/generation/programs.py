@@ -29,7 +29,10 @@ joins/vacates without a single recompile.
 
 Cache donation: the slot cache is the dominant HBM tenant; both programs
 donate it so XLA updates in place (CPU skips donation — unimplemented
-there, warns per compile).
+there, warns per compile).  graftaudit AX005 audits exactly this
+contract on the canonical program set — on CPU the skip is a justified
+manifest suppression (``tools/graftaudit/canonical.py``); on TPU a
+dropped donation is a tier-1 finding.
 """
 from __future__ import annotations
 

@@ -44,7 +44,8 @@ from ..observability.tracer import get_tracer
 
 __all__ = ["topology_signature", "shared_jit", "InstrumentedJit",
            "wire_persistent_cache", "persistent_cache_status",
-           "trace_cache_size", "clear_trace_cache"]
+           "trace_cache_size", "clear_trace_cache",
+           "iter_trace_cache", "set_audit_capture", "audit_capture_mode"]
 
 # compile wall times: sub-100ms CPU toy nets up to minutes-long TPU programs
 _COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
@@ -124,6 +125,76 @@ def topology_signature(conf: Any) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
+# ----------------------------------------------------------- audit capture
+# IR-audit spec capture (tools/graftaudit): every InstrumentedJit records
+# the abstract signature — shapes, dtypes, NamedShardings, raw Python
+# scalars — of the calls that define its compiled variants, so the
+# auditor can re-derive the jaxpr / partitioned HLO of the REAL
+# production programs without holding example arrays alive.
+#
+#   "trace" (default)  record a spec only when the call (re)traced — the
+#                      capture rides the already-slow compile path, so the
+#                      steady state pays nothing;
+#   "all"              record every distinct call signature (the audit
+#                      harness arms this while driving multi-mesh
+#                      workloads: a dp=4 call after a dp=2 call reuses the
+#                      ONE trace, so trace-time capture alone would miss
+#                      the second sharding layout);
+#   "off"              never record.
+_AUDIT_MODE = "trace"
+#: distinct specs kept per jitted function (oldest dropped beyond this) —
+#: covers a serving bucket ladder without unbounded growth
+_AUDIT_SPEC_CAP = 16
+
+
+def set_audit_capture(mode: str) -> None:
+    """Set the audit spec-capture mode: ``"trace"`` | ``"all"`` | ``"off"``."""
+    global _AUDIT_MODE
+    if mode not in ("trace", "all", "off"):
+        raise ValueError(f"unknown audit capture mode {mode!r}")
+    _AUDIT_MODE = mode
+
+
+def audit_capture_mode() -> str:
+    return _AUDIT_MODE
+
+
+def _audit_leaf(x: Any) -> Any:
+    """Abstract one call-argument leaf for later replay through ``lower``.
+
+    Arrays become ``ShapeDtypeStruct`` (keeping a ``NamedSharding`` so the
+    audit lowering runs the same GSPMD partitioning the production call
+    did); Python scalars are kept VERBATIM so the replayed trace sees the
+    identical weak-type promotion behaviour."""
+    if x is None or isinstance(x, (bool, int, float, complex, str)):
+        return x
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return x
+    sh = getattr(x, "sharding", None)
+    if sh is not None and type(sh).__name__ == "NamedSharding":
+        return jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sh)
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _leaf_descriptor(leaf: Any) -> Tuple:
+    """Hashable identity of one abstracted leaf (spec dedupe key)."""
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None:
+            mesh = sh.mesh
+            return ("sds", leaf.shape, str(leaf.dtype), str(sh.spec),
+                    tuple(mesh.shape.items()))
+        return ("sds", leaf.shape, str(leaf.dtype), None, None)
+    return ("py", type(leaf).__name__, repr(leaf))
+
+
+def _spec_key(spec: Any) -> Tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(spec)
+    return (treedef, tuple(_leaf_descriptor(l) for l in leaves))
+
+
 # ------------------------------------------------------------ shared cache
 class InstrumentedJit:
     """A jitted callable that observes its own (re)traces.
@@ -136,12 +207,21 @@ class InstrumentedJit:
     (only execution is async), so that wall time is an honest compile cost.
     """
 
-    __slots__ = ("name", "fn", "_tls", "__weakref__")
+    __slots__ = ("name", "fn", "_tls", "_fun", "_donate", "_audit_specs",
+                 "_audit_lock", "__weakref__")
 
     def __init__(self, fun: Callable, name: str,
                  donate_argnums: Tuple[int, ...] = ()):
         self.name = name
         self._tls = threading.local()
+        # audit surface (tools/graftaudit): the raw builder function and
+        # its declared donation — re-lowering goes through a FRESH
+        # jax.jit of `_fun` so an audit never ticks the compile counters
+        # the production tests pin
+        self._fun = fun
+        self._donate = tuple(donate_argnums)
+        self._audit_specs: Dict[Tuple, Tuple] = {}
+        self._audit_lock = threading.Lock()
         holder_ref = weakref.ref(self)
 
         def traced(*args, **kwargs):
@@ -165,6 +245,9 @@ class InstrumentedJit:
         self._tls.traced = False
         t0 = monotonic_s()
         out = self.fn(*args, **kwargs)
+        if _AUDIT_MODE == "all" or (_AUDIT_MODE == "trace"
+                                    and self._tls.traced):
+            self._record_spec(args, kwargs)
         if self._tls.traced:
             dt = monotonic_s() - t0
             reg = default_registry()
@@ -191,6 +274,51 @@ class InstrumentedJit:
     def lower(self, *args, **kwargs):
         """AOT lowering passthrough (memory analysis, HLO dumps)."""
         return self.fn.lower(*args, **kwargs)
+
+    # ------------------------------------------------------ audit surface
+    def _record_spec(self, args, kwargs) -> None:
+        try:
+            spec = jax.tree_util.tree_map(_audit_leaf,
+                                          (args, dict(kwargs)))
+            key = _spec_key(spec)
+        except Exception:
+            return              # unabstractable call: audit sees nothing
+        with self._audit_lock:
+            if key in self._audit_specs:
+                return
+            if len(self._audit_specs) >= _AUDIT_SPEC_CAP:
+                self._audit_specs.pop(next(iter(self._audit_specs)))
+            self._audit_specs[key] = spec
+
+    def audit_specs(self) -> "list":
+        """Recorded abstract call specs, oldest first: each is an
+        ``(args, kwargs)`` pytree of ``ShapeDtypeStruct`` / raw Python
+        scalars describing one compiled variant of this function."""
+        with self._audit_lock:
+            return list(self._audit_specs.values())
+
+    @property
+    def donate_argnums(self) -> Tuple[int, ...]:
+        """Donation the builder declared (platform branches already
+        applied) — the auditor's ground truth for AX005."""
+        return self._donate
+
+    def audit_jaxpr(self, spec):
+        """ClosedJaxpr of one recorded spec — the exact trace the
+        production call executed (same builder function, same abstract
+        arguments), produced without touching the instrumented jit."""
+        args, kwargs = spec
+        return jax.make_jaxpr(self._fun)(*args, **kwargs)
+
+    def audit_lower(self, spec):
+        """Lower one recorded spec through a FRESH un-instrumented jit of
+        the builder function: same jaxpr, same shardings, same donation —
+        but no compile-counter tick and no entry in jax's dispatch cache
+        for the production wrapper, so audits are invisible to the
+        zero-recompile contracts the tests pin."""
+        args, kwargs = spec
+        return jax.jit(self._fun,
+                       donate_argnums=self._donate).lower(*args, **kwargs)
 
 
 _TRACE_CACHE: "weakref.WeakValueDictionary[Tuple, InstrumentedJit]" = \
@@ -233,6 +361,16 @@ def shared_jit(key: Tuple, builder: Callable[[], Tuple[Callable, Tuple]],
 
 def trace_cache_size() -> int:
     return len(_TRACE_CACHE)
+
+
+def iter_trace_cache() -> "list":
+    """Snapshot of the live shared-trace-cache entries as ``(key, entry)``
+    pairs (strong refs — callers should drop the list when done).  This is
+    the IR auditor's program enumeration: every jitted kind any live
+    network compiled — train steps, serve, prefill, decode — is reachable
+    here, so the audit traverses real production programs, not fixtures."""
+    with _TRACE_LOCK:
+        return [(k, v) for k, v in _TRACE_CACHE.items() if v is not None]
 
 
 def clear_trace_cache() -> None:

@@ -110,7 +110,9 @@ def _graph_loss(conf, params, state, inputs, labels, *, train: bool, key,
     acts, new_state, mask_of = _graph_forward(
         conf, params, state, inputs, train=train, key=key, masks=masks,
         exclude_outputs=True, precision=precision)
-    total = jnp.zeros(())
+    # accumulate in the loss dtype (a dtype-defaulted zeros(()) start is
+    # f64 under x64 and would promote every head's loss — graftaudit AX001)
+    total = None
     for oi, name in enumerate(conf.network_outputs):
         v = conf.vertices[name]
         if not (isinstance(v, LayerVertex) and
@@ -132,9 +134,12 @@ def _graph_loss(conf, params, state, inputs, labels, *, train: bool, key,
                 if key is not None else None)
         variables = {"params": params.get(name, {}),
                      "state": state.get(name, {})}
-        total = total + v.compute_loss(variables, h, labels[oi],
-                                       train=train, key=lkey, mask=lm)
-    reg = jnp.zeros(())
+        l = v.compute_loss(variables, h, labels[oi], train=train,
+                           key=lkey, mask=lm)
+        total = l if total is None else total + l
+    if total is None:
+        total = jnp.zeros((), jnp.float32)
+    reg = jnp.zeros((), dtype=total.dtype)
     for name, v in conf.vertices.items():
         lp = params.get(name, {})
         if lp:
@@ -211,7 +216,7 @@ def _build_graph_train_step(conf, tx):
         grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
         gleaves = jax.tree_util.tree_leaves(grads)
         gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
-            if gleaves else jnp.zeros(())
+            if gleaves else jnp.zeros((), jnp.float32)
         glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
                                   for g in jax.tree_util.tree_leaves(v)))
                   for k, v in grads.items() if v}

@@ -73,7 +73,7 @@ class GraphVertexConf:
         return None
 
     def regularization_score(self, params) -> Array:
-        return jnp.zeros(())
+        return jnp.zeros((), jnp.float32)
 
 
 @register_serde

@@ -148,7 +148,9 @@ class CompositeReconstructionDistribution(ReconstructionDistribution):
             pi += psize
 
     def neg_log_prob(self, x, preout, average=True):
-        total = jnp.zeros(())
+        # follow the data dtype: a dtype-defaulted zeros(()) is f64 under
+        # x64 and would promote the whole pretrain loss (graftaudit AX001)
+        total = jnp.zeros((), dtype=preout.dtype)
         for (x0, x1), (p0, p1), dist in self._slices():
             total = total + dist.neg_log_prob(x[..., x0:x1],
                                               preout[..., p0:p1], average)

@@ -94,7 +94,9 @@ class LayerConf:
         return sizes
 
     def regularization_score(self, params: Dict[str, Array]) -> Array:
-        return jnp.zeros(())
+        # f32 scalar, not dtype-defaulted: zeros(()) is f64 under x64 and
+        # would promote the whole loss (graftaudit AX001)
+        return jnp.zeros((), jnp.float32)
 
     def feed_forward_mask(self, mask: Optional[Array], itype: InputType
                           ) -> Optional[Array]:
@@ -182,7 +184,7 @@ class BaseLayerConf(LayerConf):
         l2 = float(self.resolved("l2", 0.0) or 0.0)
         l1b = float(self.resolved("l1_bias", 0.0) or 0.0)
         l2b = float(self.resolved("l2_bias", 0.0) or 0.0)
-        score = jnp.zeros(())
+        score = jnp.zeros((), jnp.float32)
         for k, v in params.items():
             is_bias = k in self._BIAS_PARAMS
             a1, a2 = (l1b, l2b) if is_bias else (l1, l2)

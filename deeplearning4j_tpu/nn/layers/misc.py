@@ -74,8 +74,9 @@ class FrozenLayer(LayerConf):
 
     def regularization_score(self, params):
         # frozen params don't contribute to the loss (their l1/l2 is constant
-        # w.r.t. training and would only shift the reported score)
-        return jnp.zeros(())
+        # w.r.t. training and would only shift the reported score); f32 so
+        # x64 can't promote the loss through it (graftaudit AX001)
+        return jnp.zeros((), jnp.float32)
 
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         # train=False for the wrapped layer: a frozen layer behaves in

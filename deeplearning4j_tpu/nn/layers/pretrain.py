@@ -250,7 +250,9 @@ class VariationalAutoencoder(BaseLayerConf):
         log_var = jnp.clip(log_var, -20.0, 20.0)
         kl = 0.5 * jnp.sum(jnp.exp(log_var) + mean ** 2 - 1.0 - log_var,
                            axis=-1)
-        recon = jnp.zeros(())
+        # accumulate in the activation dtype (dtype-defaulted zeros(())
+        # is f64 under x64 — graftaudit AX001)
+        recon = jnp.zeros((), dtype=mean.dtype)
         n = max(1, self.num_samples)
         for s in range(n):
             if key is not None and train:

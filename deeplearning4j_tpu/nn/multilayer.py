@@ -312,7 +312,11 @@ def _stack_loss(conf, params, state, x, y, *, train: bool, key, mask=None,
     lm = label_mask if label_mask is not None else pmask
     loss = out_conf.compute_loss(variables, h, y, train=train, key=lkey,
                                  mask=lm)
-    reg = jnp.zeros(())
+    # accumulator follows the LOSS dtype: a dtype-defaulted zeros(())
+    # is f64 under x64 and silently promotes the whole loss output
+    # (graftaudit AX001); f64 gradient-check runs still get f64 here
+    # because their loss is already f64
+    reg = jnp.zeros((), dtype=loss.dtype)
     for i, lc in enumerate(layers):
         lp = params.get(f"layer_{i}", {})
         if lp:
@@ -343,7 +347,9 @@ def _build_stack_fn(conf, tx, kind: str):
         # with the input batch donated — the engine builds a fresh padded
         # device batch per dispatch and never rereads it, so XLA may alias
         # the buffer into activations (one less live HBM copy per batch).
-        # CPU doesn't implement donation and warns per compile; skip there.
+        # CPU doesn't implement donation and warns per compile; skip there
+        # (graftaudit AX005 audits this contract; the CPU skip is a
+        # justified manifest suppression in tools/graftaudit/canonical.py).
         def fn(params, state, x):
             return _stack_forward(conf, params, state, x, train=False,
                                   key=None)
@@ -440,7 +446,7 @@ def _build_train_step(conf, tx, with_carry: bool):
         # inside the same program so they fuse with the update
         gleaves = jax.tree_util.tree_leaves(grads)
         gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
-            if gleaves else jnp.zeros(())
+            if gleaves else jnp.zeros((), jnp.float32)
         glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
                                   for g in jax.tree_util.tree_leaves(v)))
                   for k, v in grads.items() if v}

@@ -207,7 +207,9 @@ def overflow_skip(policy: PrecisionPolicy, ls: Dict[str, Any], finite,
     new_state = sel(new_layers, old_layers)
     new_state[SCALE_STATE_KEY] = next_scale_state(policy, ls, finite)
     gstats["loss_scale"] = ls["scale"]
-    gstats["overflow"] = jnp.where(finite, 0, 1)
+    # pin the counter dtype: a weak-int where() is i64 under x64, i32
+    # without — listeners should see one output signature everywhere
+    gstats["overflow"] = jnp.where(finite, 0, 1).astype(jnp.int32)
     return new_params, new_opt, new_state, sel
 
 

@@ -52,6 +52,15 @@ primary's manifest+rename), and ``ElasticTrainer`` drives the whole
 loop: barrier saves at round boundaries, membership changes rebuilding
 the mesh over survivors via ``restore_sharded(mesh=survivors)``, one
 train-step trace across topology changes.
+
+The derived collective layout is GUARDED at the IR level: graftaudit
+(``tools/graftaudit``, rule AX003) compiles the canonical dp=2/dp=4
+sharded train steps from their recorded argument shardings and flags a
+dense all-reduce of (near-)param bytes — the pattern that appears when
+some op defeats the GSPMD scatter/gather derivation — and
+``tests/test_audit.py`` pins both censuses EXACTLY (golden collective
+signature), so a layout regression fails tier-1 instead of a profile
+review.
 """
 from __future__ import annotations
 

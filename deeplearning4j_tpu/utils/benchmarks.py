@@ -963,7 +963,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 21 module rules off the
+    through the public ``lint_paths`` API — 22 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression
@@ -1003,6 +1003,57 @@ def lint_time_ms(paths=None, runs: int = 2) -> Dict:
         "findings": len(findings),
         "runs": len(times),
         "spread_ms": round(max(times) - min(times), 1),
+    }
+
+
+def audit_time_ms(include=None) -> Dict:
+    """graftaudit wall-time benchmark (ISSUE 14): build the canonical
+    program set through its production entry points, then run the full
+    IR audit — jaxpr phase plus the partitioned-HLO compiles of every
+    program.  The audit gates tier-1 (tests/test_audit.py) exactly like
+    graftlint does, so rule/program additions that blow up its wall
+    time are a CI-latency regression this row keeps round-over-round
+    visible; the acceptance budget is the full run (build + audit)
+    under 60s on the CPU rig.  One run — the dominant cost is XLA
+    compiles, which the persistent jit caches would make a second run
+    under-report.  Coverage is EXPLICIT: canonical programs the host
+    couldn't build (a sharded dp on a single-device backend) land in
+    ``skipped`` — a row claiming the full set while silently covering
+    6 of 8 programs would hide exactly the layout regressions the
+    audit exists to catch."""
+    import sys
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # temporary path entry, same hygiene as lint_time_ms
+    added = repo_root not in sys.path
+    if added:
+        sys.path.insert(0, repo_root)
+    try:
+        from tools.graftaudit import AUDIT_RULES, audit_programs
+        from tools.graftaudit.canonical import (CANONICAL_CONFIG,
+                                                build_canonical)
+    finally:
+        if added:
+            sys.path.remove(repo_root)
+    t0 = monotonic_s()
+    cs = build_canonical(include=include)
+    build_ms = (monotonic_s() - t0) * 1e3
+    t1 = monotonic_s()
+    result = audit_programs(cs.programs, cs.suppressions,
+                            CANONICAL_CONFIG)
+    audit_ms = (monotonic_s() - t1) * 1e3
+    return {
+        "metric": "audit_time_ms",
+        "value": round(build_ms + audit_ms, 1),
+        "unit": "ms full canonical-set IR audit (build + audit)",
+        "build_ms": round(build_ms, 1),
+        "audit_ms": round(audit_ms, 1),
+        "programs": len(result.irs),
+        "skipped": sorted(cs.skipped),
+        "rules": len(AUDIT_RULES),
+        "findings": len(result.findings),
+        "suppressed": sum(result.suppressed.values()),
+        "budget_ms": 60000.0,
     }
 
 

@@ -1,0 +1,581 @@
+"""graftaudit: the IR-level audit gate (ISSUE 14).
+
+Three layers, mirroring test_lint.py's structure for graftlint:
+
+* **rule units** — each AX rule has a synthetic program that MUST fire
+  and one that MUST stay silent (fast: jaxpr phase only, no XLA
+  compiles except where the rule is about compiled HLO, which is fed a
+  hand-written HLO text).
+* **the canonical gate** — the canonical program set (dense / ZeRO-3
+  dp=2,4 / bf16 / f16 train steps, serve, prefill, decode), built
+  through the REAL production entry points, audits clean against the
+  ratcheted EMPTY baseline (justified manifest suppressions allowed,
+  none stale).
+* **the golden collective signature** — the dp=2 and dp=4 ZeRO-3
+  train-step censuses are pinned EXACTLY, so a GSPMD layout regression
+  (a dense all-reduce where the sharding implies scatter/gather) fails
+  tier-1 instead of a profile review.
+"""
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftaudit import (AUDIT_RULES, AUDIT_RULE_DOCS,  # noqa: E402
+                              AuditConfig, AuditProgram, ProgramIR,
+                              Suppression, audit_programs, build_card,
+                              card_filename, load_card)
+from tools.graftaudit.canonical import (CANONICAL_CONFIG,  # noqa: E402
+                                        build_canonical)
+from tools.graftaudit.cards import STABLE_FIELDS  # noqa: E402
+from tools.graftaudit.hlo import (census_from_ops,  # noqa: E402
+                                  parse_collectives)
+from tools.graftlint.core import Baseline  # noqa: E402
+
+from deeplearning4j_tpu.nn.compile_cache import (  # noqa: E402
+    InstrumentedJit, audit_capture_mode, set_audit_capture)
+
+BASELINE = REPO_ROOT / "tools" / "graftaudit" / "baseline.json"
+CARDS_DIR = REPO_ROOT / "tools" / "graftaudit" / "cards"
+
+#: jaxpr phase only — rule units never pay an XLA compile
+FAST = AuditConfig(compile="never", min_donate_bytes=256)
+
+
+def prog(fun, *args, name="train_step", donate=(), **kw) -> AuditProgram:
+    """Synthetic audit program: jit `fun` standalone (no shared-cache
+    pollution), run it once so the spec records, wrap for the rules."""
+    entry = InstrumentedJit(fun, name=name, donate_argnums=donate)
+    entry(*args)
+    specs = entry.audit_specs()
+    assert specs, "trace-time capture should have recorded the spec"
+    return AuditProgram(name=name, entry=entry, spec=specs[-1], **kw)
+
+
+def run_rule(code, p, config=FAST):
+    from tools.graftaudit import analyze_program
+    return AUDIT_RULES[code](analyze_program(p, config))
+
+
+# ------------------------------------------------------------ spec capture
+class TestSpecCapture:
+    def test_trace_mode_records_once_per_variant(self):
+        entry = InstrumentedJit(lambda x: x * 2, name="t")
+        entry(jnp.ones((4,)))
+        entry(jnp.ones((4,)))            # steady call: no new spec
+        assert len(entry.audit_specs()) == 1
+        entry(jnp.ones((8,)))            # new shape: new trace, new spec
+        assert len(entry.audit_specs()) == 2
+
+    def test_off_mode_records_nothing(self):
+        prev = audit_capture_mode()
+        set_audit_capture("off")
+        try:
+            entry = InstrumentedJit(lambda x: x + 1, name="t")
+            entry(jnp.ones((4,)))
+            assert entry.audit_specs() == []
+        finally:
+            set_audit_capture(prev)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            set_audit_capture("sometimes")
+
+    def test_python_scalars_replayed_verbatim(self):
+        entry = InstrumentedJit(lambda x, n: x * n, name="t")
+        entry(jnp.ones((4,)), 3)
+        (args, _kwargs) = entry.audit_specs()[0]
+        assert args[1] == 3 and isinstance(args[1], int)
+        # and the replayed jaxpr matches the production trace
+        closed = entry.audit_jaxpr(entry.audit_specs()[0])
+        assert closed.jaxpr.eqns
+
+    def test_audit_lower_does_not_tick_compile_counters(self):
+        from deeplearning4j_tpu.observability.registry import \
+            default_registry
+        entry = InstrumentedJit(lambda x: x * 3, name="audit_probe_fn")
+        entry(jnp.ones((4,)))
+        c = default_registry().get("training_compile_total")
+        before = c.labels("audit_probe_fn").value
+        entry.audit_lower(entry.audit_specs()[0]).compile()
+        assert c.labels("audit_probe_fn").value == before
+
+
+# --------------------------------------------------------------- rule units
+class TestAX001:
+    def test_escaping_f64_promotion_fires(self):
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64 for a dtype-defaulted f64")
+
+        def fn(x):
+            return jnp.sum(x) + jnp.zeros(())   # f64 joins an f32 loss
+
+        fs = run_rule("AX001", prog(fn, jnp.ones((4,), jnp.float32)))
+        assert len(fs) == 1 and fs[0].rule == "AX001"
+        assert "float64" in fs[0].message
+
+    def test_contained_scalar_f64_stays_silent(self):
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64")
+
+        def fn(x, n):
+            # optax-style weak bias correction: f64 scalar consumed
+            # straight back into f32 math — byte-free, no finding
+            corr = 1.0 - 0.9 ** n.astype(jnp.float64)
+            return x / corr.astype(jnp.float32)
+
+        fs = run_rule("AX001", prog(fn, jnp.ones((4,), jnp.float32),
+                                    jnp.asarray(3, jnp.int32)))
+        assert fs == []
+
+    def test_escape_elsewhere_does_not_drag_in_contained_scalars(self):
+        """Per-origin judgement: one real escaping promotion plus
+        contained bias-correction math must report ONLY the escaping
+        origin (the program-global-boolean design would flag both)."""
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64")
+
+        def fn(x, n):
+            corr = 1.0 - 0.9 ** n.astype(jnp.float64)   # contained
+            y = x / corr.astype(jnp.float32)
+            return jnp.sum(y) + jnp.zeros(())           # escaping
+
+        fs = run_rule("AX001", prog(fn, jnp.ones((4,), jnp.float32),
+                                    jnp.asarray(3, jnp.int32)))
+        assert len(fs) == 1
+        assert "1 `convert_element_type`" in fs[0].message
+
+    def test_f64_inputs_mean_f64_is_wanted(self):
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64")
+
+        def fn(x):
+            return jnp.sum(x) * 2.0
+
+        fs = run_rule("AX001", prog(fn, jnp.ones((4,), jnp.float64)))
+        assert fs == []
+
+    def test_non_steady_program_out_of_scope(self):
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64")
+
+        def fn(x):
+            return jnp.sum(x) + jnp.zeros(())
+
+        fs = run_rule("AX001", prog(fn, jnp.ones((4,), jnp.float32),
+                                    steady=False))
+        assert fs == []
+
+
+class TestAX002:
+    def test_f32_dot_inside_bf16_program_fires(self):
+        def fn(a, b):
+            lo = jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+            hi = jnp.dot(a, b)                   # policy leak
+            return lo.astype(jnp.float32) + hi
+
+        fs = run_rule("AX002", prog(fn, jnp.ones((4, 4), jnp.float32),
+                                    jnp.ones((4, 4), jnp.float32),
+                                    policy="bfloat16"))
+        assert any("f32 `dot_general`" in f.message for f in fs)
+
+    def test_all_bf16_dots_stay_silent(self):
+        def fn(a, b):
+            lo = jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+            return lo.astype(jnp.float32)
+
+        fs = run_rule("AX002", prog(fn, jnp.ones((4, 4), jnp.float32),
+                                    jnp.ones((4, 4), jnp.float32),
+                                    policy="bfloat16"))
+        assert [f for f in fs if "f32 `dot" in f.message] == []
+
+    def test_cast_uncast_churn_fires(self):
+        def fn(x):
+            return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+        fs = run_rule("AX002", prog(fn, jnp.ones((8,), jnp.float32)))
+        assert any("churn" in f.message and "bfloat16" in f.message
+                   for f in fs)
+
+    def test_one_way_cast_is_not_churn(self):
+        # NB `jnp.sum(x.astype(bf16))` would NOT be a valid negative
+        # here: jnp.sum upcasts sub-32-bit floats back to f32 for the
+        # accumulation — a genuine round trip the rule rightly flags
+        def fn(x):
+            return x.astype(jnp.bfloat16) * 2
+
+        fs = run_rule("AX002", prog(fn, jnp.ones((8,), jnp.float32)))
+        assert [f for f in fs if "churn" in f.message] == []
+
+
+class TestAX003:
+    def _ir(self, ops, zero3=True, param_bytes=4096,
+            name="train_step[zero3,dp=2]"):
+        return ProgramIR(
+            name=name, kind="train_step", steady=True, policy=None,
+            zero3=zero3, config=FAST, jaxpr=None, spec=None,
+            donate=(0, 1, 2), arg_bytes=[param_bytes],
+            param_bytes=param_bytes, input_dtypes=["float32"],
+            census=census_from_ops(ops), census_source="hlo",
+            collective_ops=ops)
+
+    def test_dense_gradient_all_reduce_fires(self):
+        hlo = """
+  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %grads), replica_groups={}
+"""
+        ops = parse_collectives(hlo)
+        fs = AUDIT_RULES["AX003"](self._ir(ops))       # 4096B >= 50%
+        assert len(fs) == 1 and "reduce-scatter" in fs[0].message
+
+    def test_small_all_reduce_stays_silent(self):
+        hlo = "  %ar = f32[4]{0} all-reduce(f32[4]{0} %gnorm)\n"
+        fs = AUDIT_RULES["AX003"](self._ir(parse_collectives(hlo)))
+        assert fs == []
+
+    def test_non_zero3_program_out_of_scope(self):
+        hlo = "  %ar = f32[1024]{0} all-reduce(f32[1024]{0} %g)\n"
+        fs = AUDIT_RULES["AX003"](self._ir(parse_collectives(hlo),
+                                           zero3=False))
+        assert fs == []
+
+    def test_duplicate_all_gather_fires(self):
+        hlo = """
+  %ag1 = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %param.3)
+  %ag2 = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %param.3)
+  %ag3 = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %param.9)
+"""
+        fs = AUDIT_RULES["AX003"](self._ir(parse_collectives(hlo)))
+        assert len(fs) == 1 and "all-gathered 2x" in fs[0].message
+
+    def test_parse_census_counts_and_bytes(self):
+        hlo = """
+  %ag = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %p0)
+  %rs = bf16[8,32]{1,0} reduce-scatter(bf16[32,32]{1,0} %g0), dims={0}
+  %done = f32[4]{0} all-reduce-done(f32[4]{0} %start)
+"""
+        census = census_from_ops(parse_collectives(hlo))
+        assert census == {
+            "all-gather": {"count": 1, "bytes": 64 * 32 * 4},
+            "reduce-scatter": {"count": 1, "bytes": 8 * 32 * 2},
+        }
+
+    def test_parse_async_start_counts_result_not_operand_alias(self):
+        """A `-start` LHS is a state tuple aliasing the operand (and
+        collective-permute adds u32[] context slots): only the true
+        result bytes may count, or every async census double-bills."""
+        hlo = """
+  %ags = (f32[16,32]{1,0}, f32[64,32]{1,0}) all-gather-start(f32[16,32]{1,0} %p0)
+  %agd = f32[64,32]{1,0} all-gather-done(f32[64,32]{1,0} %ags)
+  %cps = (f32[8,8]{1,0}, f32[8,8]{1,0}, u32[], u32[]) collective-permute-start(f32[8,8]{1,0} %x)
+"""
+        census = census_from_ops(parse_collectives(hlo))
+        assert census == {
+            "all-gather": {"count": 1, "bytes": 64 * 32 * 4},
+            "collective-permute": {"count": 1, "bytes": 8 * 8 * 4},
+        }
+
+
+class TestAX004:
+    def test_debug_print_in_steady_program_fires(self):
+        def fn(x):
+            jax.debug.print("loss={l}", l=jnp.sum(x))
+            return x * 2
+
+        fs = run_rule("AX004", prog(fn, jnp.ones((4,))))
+        assert len(fs) == 1 and "debug_callback" in fs[0].message
+
+    def test_pure_callback_fires(self):
+        def fn(x):
+            y = jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y + 1
+
+        fs = run_rule("AX004", prog(fn, jnp.ones((4,), jnp.float32)))
+        assert len(fs) == 1 and "pure_callback" in fs[0].message
+
+    def test_clean_program_silent_and_setup_out_of_scope(self):
+        def clean(x):
+            return x * 2
+
+        assert run_rule("AX004", prog(clean, jnp.ones((4,)))) == []
+
+        def dbg(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+
+        assert run_rule("AX004", prog(dbg, jnp.ones((4,)),
+                                      steady=False)) == []
+
+
+class TestAX005:
+    def test_large_dead_arg_not_donated_fires(self):
+        def fn(params, state, x):
+            return x @ params + 0 * jnp.sum(state)
+
+        p = prog(fn, jnp.ones((64, 64)), jnp.ones((2,)),
+                 jnp.ones((8, 64)), name="serve")
+        fs = run_rule("AX005", p)
+        assert len(fs) == 1
+        assert "arg 2" in fs[0].message
+
+    def test_donated_dead_arg_silent(self):
+        def fn(params, state, x):
+            return x @ params + 0 * jnp.sum(state)
+
+        p = prog(fn, jnp.ones((64, 64)), jnp.ones((2,)),
+                 jnp.ones((8, 64)), name="serve", donate=(2,))
+        assert run_rule("AX005", p) == []
+
+    def test_below_threshold_and_unknown_kind_silent(self):
+        def fn(params, state, x):
+            return x @ params + 0 * jnp.sum(state)
+
+        tiny = AuditConfig(compile="never", min_donate_bytes=1 << 30)
+        p = prog(fn, jnp.ones((64, 64)), jnp.ones((2,)),
+                 jnp.ones((8, 64)), name="serve")
+        assert run_rule("AX005", p, tiny) == []
+        q = prog(fn, jnp.ones((64, 64)), jnp.ones((2,)),
+                 jnp.ones((8, 64)), name="output")
+        assert run_rule("AX005", q) == []
+
+
+class TestAX006:
+    def test_oversized_materialized_broadcast_fires(self):
+        cfg = AuditConfig(compile="never", broadcast_bytes=1 << 12,
+                          broadcast_ratio=4)
+
+        def fn(x):
+            big = jnp.broadcast_to(x[:, None], (256, 256))
+            return big * 2.0      # the broadcast must survive into math
+
+        fs = run_rule("AX006", prog(fn, jnp.ones((256,), jnp.float32)),
+                      cfg)
+        assert len(fs) == 1 and "broadcast_in_dim" in fs[0].message
+
+    def test_small_broadcast_silent(self):
+        cfg = AuditConfig(compile="never", broadcast_bytes=1 << 20)
+
+        def fn(x):
+            return jnp.broadcast_to(x[:, None], (16, 16)) * 2.0
+
+        assert run_rule("AX006", prog(fn, jnp.ones((16,))), cfg) == []
+
+
+# ------------------------------------------------- suppressions + plumbing
+class TestSuppressions:
+    def test_reason_is_mandatory(self):
+        with pytest.raises(ValueError):
+            Suppression("serve", "AX005", "")
+
+    def test_unused_suppression_is_stale(self):
+        def fn(x):
+            return x * 2
+
+        p = prog(fn, jnp.ones((4,)))
+        res = audit_programs(
+            [p], [Suppression(p.name, "AX004", "no such finding")], FAST)
+        assert res.findings == []
+        assert res.stale_suppressions == [f"{p.name}::AX004"]
+
+    def test_suppression_absorbs_and_counts(self):
+        def fn(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+
+        p = prog(fn, jnp.ones((4,)))
+        res = audit_programs(
+            [p], [Suppression(p.name, "AX004",
+                              "unit fixture: deliberate callback")], FAST)
+        assert res.findings == []
+        assert res.suppressed == {f"{p.name}::AX004": 1}
+        assert res.stale_suppressions == []
+
+    def test_duplicate_program_names_rejected(self):
+        def fn(x):
+            return x
+
+        p1, p2 = prog(fn, jnp.ones((2,))), prog(fn, jnp.ones((3,)))
+        p2.name = p1.name
+        with pytest.raises(ValueError):
+            audit_programs([p1, p2], [], FAST)
+
+
+def test_rule_catalog_is_complete():
+    assert sorted(AUDIT_RULES) == [f"AX00{i}" for i in range(1, 7)]
+    assert sorted(AUDIT_RULE_DOCS) == sorted(AUDIT_RULES)
+
+
+# -------------------------------------------------------- the canonical gate
+@pytest.fixture(scope="module")
+def canonical_audit():
+    """Build + audit the full canonical program set ONCE for the gate
+    tests (a handful of tiny fits/serves/generates plus their audit
+    compiles — the expensive part of this module)."""
+    cs = build_canonical()
+    assert cs.skipped == {}, cs.skipped   # the tier-1 rig builds ALL
+    return audit_programs(cs.programs, cs.suppressions,
+                          CANONICAL_CONFIG), cs.programs
+
+
+def test_canonical_set_audits_clean_modulo_empty_baseline(canonical_audit):
+    """THE gate: every steady-state program class the framework ships
+    audits clean.  The baseline is ratcheted EMPTY — new IR-level
+    findings must be fixed or suppressed IN THE MANIFEST with a
+    justification, never silently absorbed."""
+    result, programs = canonical_audit
+    assert len(programs) >= 7, [p.name for p in programs]
+    bl = Baseline.load(str(BASELINE))
+    assert bl.allowances == {}, "graftaudit baseline must stay empty"
+    kept, stale = bl.apply(result.findings)
+    assert kept == [], "\n".join(f.format() for f in kept)
+    assert result.stale_suppressions == []
+    # the manifest's CPU donation pragmas actually absorbed something
+    if jax.default_backend() == "cpu":
+        assert set(result.suppressed) == {
+            "serve::AX005", "prefill::AX005", "decode::AX005"}
+
+
+def test_golden_zero3_collective_signature(canonical_audit):
+    """The golden collective signature (ISSUE 14 satellite): the dp=2
+    and dp=4 ZeRO-3 train steps' collective censuses, pinned EXACTLY.
+
+    What the numbers mean on this backend: GSPMD turns the gradient
+    reduction into scatter-reduce form — XLA:CPU lowers the
+    reduce-scatter of the three kernel grads as `all-to-all` + local
+    add (bytes halve from dp=2 to dp=4: each process ships 1/dp of the
+    1280-byte dp=2 volume) — while the 6 `all-gather`s are the forward/
+    backward param gathers (4512 bytes: kernels + biases in f32) and
+    the 11 small `all-reduce`s (1092 bytes) are scalar loss/gnorm/
+    bias-correction reductions.  A REGRESSION looks like: all-to-all
+    (or reduce-scatter) disappearing while all-reduce bytes jump to
+    ~param scale — the dense-gradient pattern AX003 flags — or the
+    all-gather count doubling (a lost CSE gathering a leaf twice).
+    Deterministic across processes and x64 modes (verified while
+    pinning)."""
+    result, _ = canonical_audit
+    by_name = {ir.name: ir for ir in result.irs}
+    if "train_step[zero3,dp=2]" not in by_name:
+        pytest.skip("needs >= 4 virtual devices for the sharded programs")
+    assert by_name["train_step[zero3,dp=2]"].census == {
+        "all-gather": {"count": 6, "bytes": 4512},
+        "all-reduce": {"count": 11, "bytes": 1092},
+        "all-to-all": {"count": 3, "bytes": 1280},
+    }
+    assert by_name["train_step[zero3,dp=4]"].census == {
+        "all-gather": {"count": 6, "bytes": 4512},
+        "all-reduce": {"count": 11, "bytes": 1092},
+        "all-to-all": {"count": 3, "bytes": 640},
+    }
+    for name in ("train_step[zero3,dp=2]", "train_step[zero3,dp=4]"):
+        assert by_name[name].census_source == "hlo"
+        assert by_name[name].zero3
+
+
+def test_committed_cards_match_fresh_audit(canonical_audit):
+    """Every canonical program has a committed card whose environment-
+    stable fields (collective census, donation map, kind/policy flags)
+    match a fresh audit — the PR-over-PR IR diff artifact can't drift
+    from reality.  And no ORPHANS: every committed card must name a
+    current canonical program (a renamed/removed program's card would
+    keep documenting a dead program — `--write-cards` prunes them)."""
+    from tools.graftaudit.canonical import CANONICAL_PROGRAM_NAMES
+
+    result, _ = canonical_audit
+    for ir_prog in result.irs:
+        path = CARDS_DIR / card_filename(ir_prog.name)
+        assert path.exists(), f"missing committed card {path}"
+        committed = load_card(str(path))
+        fresh = build_card(ir_prog)
+        for field in STABLE_FIELDS:
+            assert committed[field] == fresh[field], \
+                f"{ir_prog.name}: card field '{field}' drifted — " \
+                "regenerate with `python -m tools.graftaudit --write-cards`"
+    legal = {card_filename(n) for n in CANONICAL_PROGRAM_NAMES}
+    on_disk = {p.name for p in CARDS_DIR.glob("*.json")}
+    assert on_disk <= legal, f"orphan card(s): {sorted(on_disk - legal)}"
+
+
+def test_write_cards_prunes_orphans_but_keeps_skipped(canonical_audit,
+                                                      tmp_path):
+    from tools.graftaudit.cards import write_cards
+
+    result, _ = canonical_audit
+    orphan = tmp_path / "dead_program.json"
+    orphan.write_text("{}")
+    skipped = tmp_path / card_filename("train_step[zero3,dp=2]")
+    skipped.write_text("{}")
+    write_cards(result.irs[:1], str(tmp_path))          # subset: no prune
+    assert orphan.exists()
+    # full-set prune: the orphan dies, but a program this HOST merely
+    # couldn't build (keep=) is live — its committed card must survive
+    write_cards(result.irs[:1], str(tmp_path), prune=True,
+                keep={skipped.name})
+    assert not orphan.exists()
+    assert skipped.exists()
+    assert (tmp_path / card_filename(result.irs[0].name)).exists()
+
+
+def test_failed_compile_degrades_loudly_not_silently():
+    """A broken HLO phase must never 'audit clean' with an empty
+    census: census_source records the degradation (which the committed
+    -card and golden-census pins then catch) and a warning fires."""
+    from tools.graftaudit import analyze_program
+
+    def fn(x):
+        return x * 2
+
+    p = prog(fn, jnp.ones((4,)))
+
+    class BrokenBackend:
+        name = p.entry.name
+        donate_argnums = p.entry.donate_argnums
+        audit_jaxpr = staticmethod(p.entry.audit_jaxpr)
+
+        @staticmethod
+        def audit_lower(spec):
+            raise RuntimeError("backend refused")
+
+    broken = AuditProgram(p.name, BrokenBackend, p.spec)
+    with pytest.warns(RuntimeWarning, match="degraded to jaxpr"):
+        ir_prog = analyze_program(broken, AuditConfig(compile="auto"))
+    assert ir_prog.census_source.startswith("jaxpr (compile failed")
+
+
+def test_steady_train_loss_stays_f32_under_x64(canonical_audit):
+    """The sweep fix this PR landed: under x64 the train-step loss used
+    to promote to f64 through the dtype-defaulted regularization
+    accumulators (zeros(()) in _stack_loss / regularization_score).
+    Pin the output dtypes so the promotion can't quietly return."""
+    if not jax.config.jax_enable_x64:
+        pytest.skip("promotion only exists under x64")
+    result, _ = canonical_audit
+    for ir_prog in result.irs:
+        if not ir_prog.kind.startswith("train_step"):
+            continue
+        out_dtypes = {str(getattr(getattr(v, "aval", None), "dtype", None))
+                      for v in ir_prog.jaxpr.outvars}
+        assert "float64" not in out_dtypes, ir_prog.name
+
+
+def test_full_canonical_audit_wall_time(canonical_audit):
+    """Acceptance: the full canonical-set audit (build + both IR phases
+    incl. the sharded compiles) fits the CI loop — re-audit the already
+    -built set and keep the pure audit under the 60s budget with a wide
+    margin (the build itself is amortized module-wide)."""
+    import time
+
+    _, programs = canonical_audit
+    t0 = time.perf_counter()
+    audit_programs(programs, [], CANONICAL_CONFIG)
+    dt = time.perf_counter() - t0
+    assert dt < 60.0, f"canonical audit took {dt:.1f}s"

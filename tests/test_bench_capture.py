@@ -149,9 +149,33 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 25
+    assert row["rules"] == 26
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
+
+
+def test_audit_time_ms_row():
+    """The IR-audit bench line (ISSUE 14): row shape for the canonical
+    program-set build + full graftaudit wall time.  A name-filtered
+    subset keeps the test fast (the dense + bf16 train steps — no
+    sharded meshes, no generation engine); the full-set 60s acceptance
+    budget is asserted in tests/test_audit.py where the whole set is
+    built anyway."""
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    row = B.audit_time_ms(include=["train_step[dense]",
+                                   "train_step[bf16]"])
+    assert row["metric"] == "audit_time_ms"
+    assert row["unit"].startswith("ms full canonical-set")
+    assert row["value"] > 0
+    assert row["value"] == pytest.approx(
+        row["build_ms"] + row["audit_ms"], abs=0.11)
+    assert row["programs"] == 2
+    assert row["skipped"] == []      # under-coverage must be explicit
+    assert row["rules"] == 6
+    assert row["findings"] == 0       # the swept canonical set is clean
+    assert row["budget_ms"] == 60000.0
+    assert row["value"] < row["budget_ms"]
 
 
 def test_decode_tokens_per_sec_rows():

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX025 has at least one fixture that MUST fire and one
+Every rule JX001–JX026 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1217,6 +1217,87 @@ def test_jx025_pragma_suppresses():
     """, _FT_PATH)
 
 
+# ---------------------------------------------------------------- JX026
+_NN_PATH = "deeplearning4j_tpu/nn/fix.py"
+
+
+def test_jx026_positive_debug_and_callbacks_in_package_module():
+    src = """
+        import jax
+        from jax import pure_callback
+        from jax.experimental import io_callback
+
+        def step(params, x):
+            jax.debug.print("x={x}", x=x)            # leftover debug
+            jax.debug.breakpoint()                   # leftover debug
+            y = pure_callback(host_fn, spec, x)      # host round-trip
+            z = io_callback(logger, None, y)         # host round-trip
+            return jax.pure_callback(host_fn, spec, z)
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX026" for f in fs) == 5
+
+
+def test_jx026_positive_debug_module_aliases():
+    # both spellings of a jax.debug module alias must fire: the
+    # from-import and `import jax.debug as jdbg` (which binds the alias
+    # name, so the dotted jax.debug.* branch never sees it)
+    for imp, call in (("from jax import debug", "debug.print"),
+                      ("import jax.debug as jdbg", "jdbg.print")):
+        src = f"""
+            {imp}
+
+            def step(x):
+                {call}("x={{x}}", x=x)
+                return x
+        """
+        fs = lint_source(textwrap.dedent(src), _NN_PATH)
+        assert sum(f.rule == "JX026" for f in fs) == 1, imp
+
+
+def test_jx026_negative_test_modules_out_of_scope():
+    # printing tracers is what debugging a test looks like — every
+    # test-shaped path stays legal
+    src = """
+        import jax
+
+        def test_step(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+    """
+    for path in ("tests/test_step.py", "deeplearning4j_tpu/test_fix.py",
+                 "tests/conftest.py"):
+        assert "JX026" not in rules_at(src, path)
+
+
+def test_jx026_negative_unrelated_names():
+    # a user-defined pure_callback (no jax import of it) and non-debug
+    # jax attrs don't fire
+    assert "JX026" not in rules_at("""
+        import jax
+
+        def pure_callback(fn, spec, x):
+            return fn(x)
+
+        def step(x):
+            y = pure_callback(abs, None, x)
+            return jax.device_get(y)
+    """, _NN_PATH)
+
+
+def test_jx026_pragma_suppresses():
+    src = """
+        import jax
+
+        def evaluate(x):
+            jax.debug.print("eval={x}", x=x)  # graftlint: disable=JX026  (documented eval-only trace hook)
+            return x
+    """
+    assert "JX026" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _NN_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2271,7 +2352,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 21
+    assert len(RULES) == 22
     assert len(PROGRAM_RULES) == 4
 
 

@@ -1,0 +1,51 @@
+"""graftaudit — IR-level static analysis of the compiled program set.
+
+graftlint's 26 AST rules see Python source; every performance and
+correctness contract this framework actually ships — the GSPMD-derived
+reduce-scatter/all-gather layout of the ZeRO-3 step (arxiv 2004.13336),
+bf16 compute against f32 masters, donated serve/decode buffers, zero
+steady-state host syncs — lives in the *compiled program*, which no AST
+rule can see (the whole-program-IR argument of arxiv 1810.09868).
+graftaudit closes that gap with two IR phases over the REAL production
+programs, reached through the process-global trace cache
+(``nn/compile_cache``: every ``InstrumentedJit`` records the abstract
+spec of the calls that defined its compiled variants):
+
+* **jaxpr phase** (``ir.py``): the exact functional trace — dtype
+  promotion origins (AX001), precision-policy leaks and cast churn
+  (AX002), host callbacks (AX004), donation misses (AX005), oversized
+  broadcasts (AX006).
+* **partitioned-HLO phase** (``hlo.py``): collectives only exist after
+  GSPMD runs, so the census + layout guard (AX003) parses the compiled
+  executable's HLO.
+
+Conventions are graftlint's: text/json/sarif output, justified
+suppressions (the manifest's inline pragmas), a ratcheted empty
+baseline, and a canonical-program-set CI gate (``tests/test_audit.py``)
+plus committed per-program cards (``cards/``) for PR-over-PR IR diffs.
+
+Usage:
+    python -m tools.graftaudit                      # audit canonical set
+    python -m tools.graftaudit --format json|sarif
+    python -m tools.graftaudit --write-cards        # refresh cards/
+    python -m tools.graftaudit --programs zero3     # subset
+
+Library API:
+    from tools.graftaudit import (AuditProgram, AuditConfig, Suppression,
+                                  audit_programs, build_canonical)
+"""
+from __future__ import annotations
+
+from .audit import (AuditConfig, AuditProgram, AuditResult, ProgramIR,
+                    Suppression, analyze_program, audit_programs,
+                    programs_from_trace_cache)
+from .cards import build_card, card_filename, load_card, write_cards
+from .rules import AUDIT_RULES, AUDIT_RULE_DOCS, DEAD_AFTER_CALL
+
+__all__ = [
+    "AuditConfig", "AuditProgram", "AuditResult", "ProgramIR",
+    "Suppression", "analyze_program", "audit_programs",
+    "programs_from_trace_cache", "build_card", "card_filename",
+    "load_card", "write_cards", "AUDIT_RULES", "AUDIT_RULE_DOCS",
+    "DEAD_AFTER_CALL",
+]

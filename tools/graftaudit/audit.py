@@ -1,0 +1,248 @@
+"""graftaudit orchestration: program model, analysis, rule driving.
+
+``AuditProgram`` names one compiled variant of one jitted entry point:
+the live ``InstrumentedJit`` from the process-global trace cache
+(``nn/compile_cache.iter_trace_cache``) plus ONE recorded abstract call
+spec (``InstrumentedJit.audit_specs``).  ``analyze_program`` derives its
+IR views — jaxpr (always) and, per the compile policy, the
+partitioned-HLO collective census / flops / temp bytes of a fresh
+compile (``compile="auto"`` compiles every program, degrading
+gracefully to jaxpr-only when XLA refuses; ``"never"`` skips the
+compile phase for fast unit tests) — into a ``ProgramIR`` that the AX
+rules consume.
+
+Suppressions are graftaudit's inline pragmas: declared in code right
+next to the program set they apply to (``canonical.py`` for the
+canonical manifest), each carrying a MANDATORY justification.  An unused
+suppression is reported stale exactly like a stale baseline entry — an
+allowance must never lie in wait to absorb a future regression.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..graftlint.core import Finding
+from . import hlo as HLO
+from . import ir as IR
+from .rules import AUDIT_RULES
+
+__all__ = ["AuditConfig", "AuditProgram", "ProgramIR", "Suppression",
+           "AuditResult", "analyze_program", "audit_programs",
+           "programs_from_trace_cache"]
+
+
+@dataclass(frozen=True)
+class AuditConfig:
+    """Thresholds + compile policy for one audit run."""
+    #: AX005: dead-after-call args below this size are not worth donating
+    min_donate_bytes: int = 1 << 20
+    #: AX006: a broadcast result below this absolute size never fires
+    broadcast_bytes: int = 64 << 20
+    #: AX006: ... and must also be this multiple of its operand
+    broadcast_ratio: int = 8
+    #: "auto" compiles every program (census + flops + temp bytes,
+    #: degrading to jaxpr-only when XLA refuses); "never" stays at the
+    #: jaxpr phase (fast unit tests)
+    compile: str = "auto"
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One justified allowance: suppress ``rule`` on ``program``.
+
+    ``reason`` is mandatory and non-empty — the justification IS the
+    point (graftlint pragma convention); an unexplained suppression is
+    indistinguishable from a hidden regression.
+    """
+    program: str
+    rule: str
+    reason: str
+
+    def __post_init__(self):
+        if not self.reason or not self.reason.strip():
+            raise ValueError(
+                f"Suppression({self.program!r}, {self.rule!r}) needs a "
+                "non-empty justification")
+
+    @property
+    def key(self) -> str:
+        return f"{self.program}::{self.rule}"
+
+
+@dataclass
+class AuditProgram:
+    """One compiled program variant to audit."""
+    name: str                 # unique within the audited set
+    entry: Any                # InstrumentedJit
+    spec: Any                 # one recorded (args, kwargs) abstract spec
+    steady: bool = True       # steady-state program (AX001/AX004 scope)
+    policy: Optional[str] = None   # declared compute dtype, e.g. "bfloat16"
+    zero3: Optional[bool] = None   # None = auto-detect from arg shardings
+
+    @property
+    def kind(self) -> str:
+        return self.entry.name
+
+
+@dataclass
+class ProgramIR:
+    """Analyzed IR views of one program, as the rules consume them."""
+    name: str
+    kind: str
+    steady: bool
+    policy: Optional[str]
+    zero3: bool
+    config: AuditConfig
+    jaxpr: Any                          # open jaxpr (ClosedJaxpr.jaxpr)
+    spec: Any
+    donate: Tuple[int, ...]
+    arg_bytes: List[int]
+    param_bytes: int
+    input_dtypes: List[str]
+    census: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    census_source: str = "jaxpr"        # "hlo" | "jaxpr"
+    collective_ops: List[Any] = field(default_factory=list)
+    flops: Optional[float] = None
+    temp_bytes: Optional[int] = None
+
+
+def _tree_bytes(tree: Any) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += IR.aval_bytes(leaf)
+    return total
+
+
+def _leaf_sharded(leaf: Any) -> bool:
+    sh = getattr(leaf, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return False
+    spec = getattr(sh, "spec", None)
+    return spec is not None and any(ax is not None for ax in tuple(spec))
+
+
+def analyze_program(p: AuditProgram,
+                    config: Optional[AuditConfig] = None) -> ProgramIR:
+    """Derive the IR views of one program: jaxpr (exact re-trace of the
+    recorded spec) plus, per the compile policy, the partitioned-HLO
+    collective census / flops / temp bytes of a fresh compile."""
+    import jax
+
+    config = config or AuditConfig()
+    closed = p.entry.audit_jaxpr(p.spec)
+    jaxpr = closed.jaxpr
+    args, _kwargs = p.spec
+    arg_bytes = [_tree_bytes(a) for a in args]
+    zero3 = p.zero3
+    if zero3 is None:
+        zero3 = bool(args) and any(
+            _leaf_sharded(l) for l in jax.tree_util.tree_leaves(args[0]))
+    ir_prog = ProgramIR(
+        name=p.name, kind=p.kind, steady=p.steady, policy=p.policy,
+        zero3=zero3, config=config, jaxpr=jaxpr, spec=p.spec,
+        donate=tuple(p.entry.donate_argnums), arg_bytes=arg_bytes,
+        param_bytes=arg_bytes[0] if arg_bytes else 0,
+        input_dtypes=IR.invar_dtypes(jaxpr),
+        census=IR.jaxpr_collective_census(jaxpr))
+    if config.compile == "never":
+        return ir_prog
+    try:
+        lowered = p.entry.audit_lower(p.spec)
+        compiled = HLO.compile_lowered(lowered)
+        ops = HLO.parse_collectives(compiled.as_text())
+        ir_prog.collective_ops = ops
+        ir_prog.census = HLO.census_from_ops(ops)
+        ir_prog.census_source = "hlo"
+        ir_prog.flops = HLO.compiled_flops(compiled)
+        ir_prog.temp_bytes = HLO.compiled_temp_bytes(compiled)
+    except Exception as e:
+        # jaxpr-phase results stand, but NEVER silently: a failed
+        # compile of a sharded program would otherwise "audit clean"
+        # with an empty census — AX003's entire subject matter.  The
+        # degradation is recorded where the gate tests and committed
+        # cards look (census_source), so a zero3 program whose compile
+        # broke fails the census_source=="hlo" pins instead of passing.
+        import warnings
+
+        ir_prog.census_source = \
+            f"jaxpr (compile failed: {type(e).__name__})"
+        warnings.warn(
+            f"graftaudit: HLO phase of '{p.name}' degraded to jaxpr "
+            f"census — {type(e).__name__}: {e}", RuntimeWarning,
+            stacklevel=2)
+    return ir_prog
+
+
+@dataclass
+class AuditResult:
+    findings: List[Finding]             # post-suppression, pre-baseline
+    irs: List[ProgramIR]
+    suppressed: Dict[str, int]          # suppression key -> absorbed count
+    stale_suppressions: List[str]       # declared but matched nothing
+
+
+def audit_programs(programs: Sequence[AuditProgram],
+                   suppressions: Sequence[Suppression] = (),
+                   config: Optional[AuditConfig] = None,
+                   rules: Optional[Sequence[str]] = None) -> AuditResult:
+    """Analyze + rule-check a program set.
+
+    Duplicate program names are an error (they are the baseline /
+    suppression keys).  Returns findings AFTER suppression filtering —
+    baseline application is the caller's (CLI / gate test) concern, same
+    split as graftlint.
+    """
+    names = [p.name for p in programs]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ValueError(f"duplicate program name(s): {', '.join(dupes)}")
+    codes = sorted(AUDIT_RULES) if rules is None else list(rules)
+    irs = [analyze_program(p, config) for p in programs]
+    findings: List[Finding] = []
+    for ir_prog in irs:
+        for code in codes:
+            findings.extend(AUDIT_RULES[code](ir_prog))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    by_key = {s.key: s for s in suppressions}
+    if len(by_key) != len(list(suppressions)):
+        raise ValueError("duplicate suppression keys")
+    suppressed: Dict[str, int] = {}
+    kept: List[Finding] = []
+    for f in findings:
+        key = f"{f.path}::{f.rule}"
+        if key in by_key:
+            suppressed[key] = suppressed.get(key, 0) + 1
+        else:
+            kept.append(f)
+    stale = sorted(k for k in by_key if k not in suppressed)
+    return AuditResult(findings=kept, irs=irs, suppressed=suppressed,
+                       stale_suppressions=stale)
+
+
+def programs_from_trace_cache(steady_kinds: Optional[Sequence[str]] = None
+                              ) -> List[AuditProgram]:
+    """Audit programs for EVERY live trace-cache entry's recorded specs —
+    the in-process audit path (a long-lived trainer/server can audit
+    itself).  Names are ``<kind>#<i>`` per recorded spec; steady-state
+    marking defaults to the kinds graftaudit knows are per-step/request
+    programs."""
+    from deeplearning4j_tpu.nn.compile_cache import iter_trace_cache
+
+    if steady_kinds is None:
+        steady_kinds = ("train_step", "train_step_carry", "epoch_scan",
+                        "epochs_scan", "serve", "prefill", "decode")
+    out: List[AuditProgram] = []
+    seen: Dict[str, int] = {}
+    for _key, entry in iter_trace_cache():
+        for spec in entry.audit_specs():
+            i = seen.get(entry.name, 0)
+            seen[entry.name] = i + 1
+            out.append(AuditProgram(
+                name=f"{entry.name}#{i}", entry=entry, spec=spec,
+                steady=entry.name in steady_kinds
+                or entry.name.startswith("pretrain")))
+    return out

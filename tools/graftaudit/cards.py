@@ -1,0 +1,101 @@
+"""Program cards: the committed, reviewable IR summary of one program.
+
+A card is a small deterministic JSON artifact per canonical program —
+collective census, flops, peak intermediate bytes, donation map, eqn /
+dtype histograms — committed under ``tools/graftaudit/cards/`` so an
+IR-level change shows up as a reviewable diff in the PR that caused it
+(the same way a lockfile diff shows a dependency change).  A rewritten
+collective layout, a dropped donation, or a dtype drift is one `git
+diff` away instead of one profile review away.
+
+Fields that depend on the host environment's dtype defaults (the
+``dtypes``/``primitives`` histograms shift with ``jax_enable_x64``) are
+still recorded — cards are canonically (re)generated on the tier-1 rig
+(``--write-cards`` under the test environment: CPU, 8 virtual devices,
+x64) — but the gate test pins only the environment-stable fields
+(collectives, donation, kind/policy flags).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List
+
+from . import ir as IR
+from .audit import ProgramIR
+from .rules import DEAD_AFTER_CALL
+
+__all__ = ["build_card", "card_filename", "write_cards", "load_card",
+           "STABLE_FIELDS"]
+
+#: card fields the gate test compares against a fresh audit — stable
+#: across x64/backends once the program set is AX001-clean
+STABLE_FIELDS = ("program", "kind", "steady", "policy", "zero3",
+                 "collectives", "census_source", "donation")
+
+
+def build_card(ir_prog: ProgramIR) -> Dict:
+    dead = DEAD_AFTER_CALL.get(ir_prog.kind, ())
+    donation = {
+        "declared": sorted(ir_prog.donate),
+        "args": [{"argnum": i, "bytes": b,
+                  "donated": i in ir_prog.donate,
+                  "dead_after_call": i in dead}
+                 for i, b in enumerate(ir_prog.arg_bytes)],
+    }
+    jaxpr = ir_prog.jaxpr
+    return {
+        "program": ir_prog.name,
+        "kind": ir_prog.kind,
+        "steady": ir_prog.steady,
+        "policy": ir_prog.policy,
+        "zero3": ir_prog.zero3,
+        "collectives": ir_prog.census,
+        "census_source": ir_prog.census_source,
+        "donation": donation,
+        "flops": ir_prog.flops,
+        "temp_bytes": ir_prog.temp_bytes,
+        "max_eqn_out_bytes": IR.max_eqn_out_bytes(jaxpr),
+        "eqns": sum(1 for _ in IR.iter_eqns(jaxpr)),
+        "primitives": IR.primitive_histogram(jaxpr),
+        "dtypes": IR.dtype_histogram(jaxpr),
+        "input_dtypes": ir_prog.input_dtypes,
+    }
+
+
+def card_filename(program_name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", program_name) + ".json"
+
+
+def write_cards(irs: List[ProgramIR], directory: str,
+                prune: bool = False, keep: "set" = ()) -> List[str]:
+    """Write one card per program.  ``prune=True`` (the full-set CLI
+    path) also DELETES ``*.json`` cards for programs not in ``irs`` —
+    an orphan card for a renamed/removed program would keep
+    "documenting" a dead program forever, the exact stale-allowance
+    smell the suppression/baseline ratchets exist to reject.  Subset
+    runs (``--programs``) must not prune, and ``keep`` names card files
+    of programs that still EXIST but this host couldn't build (a
+    backend-skipped sharded dp) — live, never orphans."""
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    kept = set(keep)
+    for ir_prog in irs:
+        fname = card_filename(ir_prog.name)
+        kept.add(fname)
+        path = os.path.join(directory, fname)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(build_card(ir_prog), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        paths.append(path)
+    if prune:
+        for fname in sorted(os.listdir(directory)):
+            if fname.endswith(".json") and fname not in kept:
+                os.remove(os.path.join(directory, fname))
+    return paths
+
+
+def load_card(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

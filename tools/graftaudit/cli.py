@@ -1,0 +1,163 @@
+"""graftaudit command-line interface.
+
+Audits the canonical program set (builds the tiny representative
+programs through their production entry points, then rule-checks their
+jaxpr / partitioned HLO), mirroring graftlint's CLI conventions:
+text/json/sarif output, a ratcheted (empty) baseline, exit 1 on
+findings, exit 2 on stale allowances.  ``--write-cards`` commits the
+per-program IR cards that make compiled-program diffs reviewable PR
+over PR.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+DEFAULT_CARDS_DIR = os.path.join(os.path.dirname(__file__), "cards")
+
+
+def _setup_jax_env() -> None:
+    """Before jax import: virtual devices for the sharded programs; and
+    honor JAX_PLATFORMS via config (the environment's sitecustomize
+    snapshots it at interpreter start, so the env var alone is too
+    late — same workaround as tests/conftest.py)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftaudit",
+        description="IR-level static analyzer of the compiled program "
+                    "set: rules AX001-AX006 over the jaxpr + partitioned "
+                    "HLO of the canonical programs (see tools/README.md)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of accepted findings "
+                        "(default: tools/graftaudit/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "and exit")
+    p.add_argument("--write-cards", action="store_true",
+                   help="write per-program IR cards (committed artifact; "
+                        "canonical when run under the tier-1 rig: CPU, 8 "
+                        "virtual devices, x64)")
+    p.add_argument("--cards-dir", default=DEFAULT_CARDS_DIR,
+                   help="directory for --write-cards "
+                        "(default: tools/graftaudit/cards)")
+    p.add_argument("--programs", default=None,
+                   help="comma-separated name substrings: audit only "
+                        "matching canonical programs")
+    p.add_argument("--no-compile", action="store_true",
+                   help="jaxpr phase only (no XLA compiles: faster, but "
+                        "no collective census / flops)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from .rules import AUDIT_RULE_DOCS
+
+    if args.list_rules:
+        for code in sorted(AUDIT_RULE_DOCS):
+            print(f"{code}  {AUDIT_RULE_DOCS[code]}")
+        return 0
+
+    _setup_jax_env()
+    import dataclasses
+
+    from ..graftlint import to_sarif
+    from ..graftlint.core import Baseline
+    from .audit import audit_programs
+    from .canonical import CANONICAL_CONFIG, build_canonical
+    from .cards import write_cards
+
+    include = ([s.strip() for s in args.programs.split(",") if s.strip()]
+               if args.programs else None)
+    config = CANONICAL_CONFIG
+    if args.no_compile:
+        config = dataclasses.replace(config, compile="never")
+    cs = build_canonical(include=include)
+    if not cs.programs:
+        build_parser().error("no canonical programs matched --programs")
+    result = audit_programs(cs.programs, cs.suppressions, config)
+    for name, why in sorted(cs.skipped.items()):
+        print(f"graftaudit: skipped {name}: {why}", file=sys.stderr)
+
+    if args.write_cards:
+        # a full-set run owns the cards dir and prunes orphans (renamed/
+        # removed programs) — but a program this HOST merely couldn't
+        # build (cs.skipped) still exists, so its committed card is
+        # live, not an orphan; a --programs subset never prunes
+        from .cards import card_filename
+        paths = write_cards(
+            result.irs, args.cards_dir, prune=include is None,
+            keep={card_filename(n) for n in cs.skipped})
+        print(f"wrote {len(paths)} program card(s) to {args.cards_dir}")
+
+    if args.write_baseline:
+        Baseline.from_findings(result.findings).save(args.baseline)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    findings = result.findings
+    stale_bl: List[str] = []
+    if not args.no_baseline:
+        findings, stale_bl = Baseline.load(args.baseline).apply(findings)
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings, AUDIT_RULE_DOCS), indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n, np_, ns = len(findings), len(result.irs), \
+            sum(result.suppressed.values())
+        print(f"graftaudit: {n} finding(s) over {np_} program(s)"
+              + (f", {ns} suppressed" if ns else "")
+              if n else
+              f"graftaudit: clean ({np_} program(s)"
+              + (f", {ns} suppressed" if ns else "") + ")")
+
+    # the ratchet, both layers: an allowance (inline suppression OR
+    # baseline entry) matching nothing must be deleted, not left armed
+    rc = 1 if findings else 0
+    if result.stale_suppressions:
+        print("graftaudit: stale suppression(s) — remove from the "
+              "manifest:", file=sys.stderr)
+        for key in result.stale_suppressions:
+            print(f"  {key}", file=sys.stderr)
+        rc = 2
+    if stale_bl:
+        print(f"graftaudit: stale baseline entr"
+              f"{'y' if len(stale_bl) == 1 else 'ies'} (no matching "
+              f"finding — remove from {args.baseline}):", file=sys.stderr)
+        for key in stale_bl:
+            print(f"  {key}", file=sys.stderr)
+        rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

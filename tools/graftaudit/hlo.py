@@ -1,0 +1,163 @@
+"""Partitioned-HLO phase: the collective layout of a compiled program.
+
+The jaxpr of a ``jit``-compiled program over ``NamedSharding`` arguments
+contains NO collectives — GSPMD derives all-gathers / reduce-scatters /
+all-reduces from the argument shardings during XLA compilation (arxiv
+2004.13336; the whole point of the ZeRO-3 layout in
+``parallel/sharded.py`` is that the rewrite is *derived*, not written).
+So the only honest place to count them is the post-optimization HLO of
+the compiled executable.  This module lowers a recorded audit spec
+through ``InstrumentedJit.audit_lower`` (fresh jit, no counter ticks),
+compiles it, and parses the HLO text into a collective census:
+``{op: {count, bytes}}`` plus per-instruction operand identities for the
+duplicate-gather check.
+
+Parsing HLO text instead of walking a C++ module keeps the auditor
+dependency-free and version-tolerant: the instruction grammar
+(``%name = TYPE op(operands), attrs``) has been stable across every XLA
+the repo has met, and an unrecognized line simply doesn't count — the
+census can under-report on an exotic XLA, never crash the gate.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["compile_lowered", "parse_collectives", "census_from_ops",
+           "compiled_flops", "compiled_temp_bytes", "CollectiveOp"]
+
+# `%ag.1 = f32[64,32]{1,0} all-gather(f32[16,32]{1,0} %param.3), ...`
+_OP_RE = re.compile(
+    r"\b(all-gather|all-reduce|reduce-scatter|all-to-all|"
+    r"collective-permute)(-start|-done)?\(")
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OPERAND_RE = re.compile(r"%[\w.-]+")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+}
+
+
+class CollectiveOp:
+    """One collective instruction from the optimized HLO."""
+
+    __slots__ = ("op", "result_bytes", "shapes", "operands", "line")
+
+    def __init__(self, op: str, result_bytes: int,
+                 shapes: List[str], operands: Tuple[str, ...], line: str):
+        self.op = op
+        self.result_bytes = result_bytes
+        self.shapes = shapes
+        self.operands = operands
+        self.line = line
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"CollectiveOp({self.op}, {self.result_bytes}B, "
+                f"{self.shapes})")
+
+
+def compile_lowered(lowered):
+    """Compile a ``Lowered``, silencing the CPU donation warnings the
+    audit deliberately re-triggers (production skipped donation there;
+    the audit lowers the DECLARED donation, which is the contract under
+    test, not the platform workaround)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return lowered.compile()
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
+    """Collective instructions from optimized-HLO text.
+
+    ``-done`` halves of async pairs are skipped (their ``-start`` twin
+    already counted the transfer); result bytes come from the LHS shape
+    tokens (variadic collectives sum their tuple elements).  A
+    ``-start`` LHS is a state TUPLE that aliases the operand shapes
+    (``(f32[16,32], f32[64,32]) all-gather-start(f32[16,32] %p)`` — and
+    collective-permute adds u32[] context slots): counting the whole
+    tuple would double-bill, so the operand shapes (and bare context
+    scalars) are multiset-subtracted and only the true results remain.
+    """
+    out: List[CollectiveOp] = []
+    for raw in hlo_text.splitlines():
+        m = _OP_RE.search(raw)
+        if m is None or m.group(2) == "-done":
+            continue
+        op = m.group(1)
+        eq = raw.find("=")
+        lhs = raw[(eq + 1) if eq >= 0 else 0:m.start()]
+        lhs_shapes = _SHAPE_RE.findall(lhs)
+        paren = raw[m.end():]
+        depth, end = 1, 0
+        for i, ch in enumerate(paren):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        operands = tuple(_OPERAND_RE.findall(paren[:end]))
+        result_shapes = list(lhs_shapes)
+        if m.group(2) == "-start" and len(lhs_shapes) > 1:
+            remaining = list(_SHAPE_RE.findall(paren[:end]))
+            kept = []
+            for tok in lhs_shapes:
+                if tok in remaining:              # aliased operand slot
+                    remaining.remove(tok)
+                elif tok[0].startswith("u") and tok[1] == "":
+                    continue                      # u32[] context scalar
+                else:
+                    kept.append(tok)
+            if kept:
+                result_shapes = kept
+        shapes = [f"{dt}[{dims}]" for dt, dims in result_shapes]
+        result_bytes = sum(_shape_bytes(dt, dims)
+                           for dt, dims in result_shapes)
+        out.append(CollectiveOp(op, result_bytes, shapes, operands,
+                                raw.strip()))
+    return out
+
+
+def census_from_ops(ops: List[CollectiveOp]) -> Dict[str, Dict[str, int]]:
+    census: Dict[str, Dict[str, int]] = {}
+    for c in ops:
+        row = census.setdefault(c.op, {"count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += c.result_bytes
+    return dict(sorted(census.items()))
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = ca.get("flops")
+        return None if f is None else float(f)
+    except Exception:
+        return None
+
+
+def compiled_temp_bytes(compiled) -> Optional[int]:
+    """XLA's temp (intermediate) allocation for the executable — the real
+    peak-intermediate number when the backend reports it."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(ma.temp_size_in_bytes)
+    except Exception:
+        return None

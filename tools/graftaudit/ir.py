@@ -1,0 +1,264 @@
+"""jaxpr traversal utilities for graftaudit.
+
+The auditor's first IR phase works on the *jaxpr* of a compiled entry
+point — the functional trace JAX produces before XLA lowering.  Every
+rule that is about what the PROGRAM COMPUTES (dtypes, casts, callbacks,
+broadcasts) runs here: the jaxpr is cheap to produce (no XLA compile),
+exact (it is the very trace the production call executed), and stable
+across backends.  Collective layout (AX003) is the one question the
+jaxpr cannot answer — GSPMD inserts collectives from the argument
+shardings at compile time — so that phase lives in ``hlo.py``.
+
+Everything here is recursive over sub-jaxprs: ``pjit``/``closed_call``
+bodies, ``scan``/``while``/``cond`` branches, ``remat`` and custom-vjp
+call jaxprs all contribute equations (a cast hidden inside a
+scan-over-layers body is still churn).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "iter_eqns", "iter_jaxprs", "aval_bytes", "aval_dtype",
+    "primitive_histogram", "dtype_histogram", "max_eqn_out_bytes",
+    "invar_dtypes", "promotion_origins", "escaping_promotion_origins",
+    "convert_churn_chains",
+    "JAXPR_COLLECTIVES", "jaxpr_collective_census",
+]
+
+#: collective primitives that can appear at jaxpr level (shard_map/pmap
+#: programs; jit-of-sharded-args programs get theirs from GSPMD instead)
+JAXPR_COLLECTIVES = ("psum", "all_gather", "reduce_scatter", "all_to_all",
+                     "ppermute", "psum_scatter", "pmax", "pmin")
+
+
+def _sub_jaxprs(value: Any, out: List) -> None:
+    """Collect open jaxprs reachable from one eqn-params value."""
+    if value is None:
+        return
+    jx = getattr(value, "jaxpr", None)
+    if jx is not None and hasattr(jx, "eqns"):      # ClosedJaxpr
+        out.append(jx)
+        return
+    if hasattr(value, "eqns") and hasattr(value, "invars"):  # open Jaxpr
+        out.append(value)
+        return
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            _sub_jaxprs(v, out)
+
+
+def iter_jaxprs(jaxpr) -> Iterator:
+    """The jaxpr plus every sub-jaxpr reachable through eqn params,
+    depth-first (each scope yielded exactly once)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        subs: List = []
+        for v in eqn.params.values():
+            _sub_jaxprs(v, subs)
+        for sub in subs:
+            yield from iter_jaxprs(sub)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation in the program, recursively."""
+    for j in iter_jaxprs(jaxpr):
+        yield from j.eqns
+
+
+def aval_dtype(v) -> Optional[Any]:
+    aval = getattr(v, "aval", None)
+    return getattr(aval, "dtype", None)
+
+
+def aval_bytes(v) -> int:
+    """Byte size of a var/aval (0 for abstract tokens and opaque types)."""
+    aval = getattr(v, "aval", v)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):    # symbolic dim
+            return 0
+    try:
+        import numpy as np
+        return n * int(np.dtype(dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def primitive_histogram(jaxpr) -> Dict[str, int]:
+    hist: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        hist[name] = hist.get(name, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+def dtype_histogram(jaxpr) -> Dict[str, int]:
+    """Eqn-OUTPUT dtype histogram: what the program actually computes in."""
+    hist: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        for ov in eqn.outvars:
+            dt = aval_dtype(ov)
+            if dt is not None:
+                key = str(dt)
+                hist[key] = hist.get(key, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+def max_eqn_out_bytes(jaxpr) -> int:
+    """Largest single equation output — a cheap jaxpr-level proxy for the
+    peak intermediate (XLA's real temp allocation is reported separately
+    when the program was compiled)."""
+    best = 0
+    for eqn in iter_eqns(jaxpr):
+        for ov in eqn.outvars:
+            best = max(best, aval_bytes(ov))
+    return best
+
+
+def invar_dtypes(jaxpr) -> List[str]:
+    return [str(aval_dtype(v)) for v in jaxpr.invars
+            if aval_dtype(v) is not None]
+
+
+# --------------------------------------------------------------- promotion
+_WIDE = ("float64", "complex128")
+
+
+def _is_wide(dt) -> bool:
+    return dt is not None and str(dt) in _WIDE
+
+
+def promotion_origins(jaxpr) -> List[Tuple[Any, str]]:
+    """Equations that INTRODUCE a 64-bit float/complex value: output is
+    f64/c128 while no input is.  These are the true promotion points
+    (dtype-defaulted constants like ``jnp.zeros(())`` under x64, weak
+    Python-scalar promotion, an explicit astype) — everything downstream
+    of one is just contamination, so reporting origins keeps one finding
+    per bug instead of one per contaminated eqn."""
+    out: List[Tuple[Any, str]] = []
+    for eqn in iter_eqns(jaxpr):
+        if not any(_is_wide(aval_dtype(ov)) for ov in eqn.outvars):
+            continue
+        if any(_is_wide(aval_dtype(iv)) for iv in eqn.invars):
+            continue
+        wide = next(str(aval_dtype(ov)) for ov in eqn.outvars
+                    if _is_wide(aval_dtype(ov)))
+        out.append((eqn, wide))
+    return out
+
+
+def escaping_promotion_origins(jaxpr) -> List[Tuple[Any, str]]:
+    """Promotion origins whose wide value actually ESCAPES: reaches a
+    program output or a non-scalar wide value, through wide-valued
+    dataflow.  Contained scalar f64 (optax's weak-typed ``1 -
+    b1**count`` bias correction, consumed straight back into an f32
+    division) is byte-free noise and is NOT returned, even when a real
+    escape exists elsewhere in the same program — each origin is judged
+    by what ITS value reaches.
+
+    Reachability is per jaxpr scope (backward walk from the escape
+    seeds — top-level wide outvars plus any wide array — over
+    wide-dtype def-use edges).  A wide value that escapes only by
+    crossing a scan/pjit boundary is attributed to the enclosing
+    equation in the parent scope (whose wide output makes it an origin
+    there), so the finding still fires, one level up."""
+    results: List[Tuple[Any, str]] = []
+    scopes = list(iter_jaxprs(jaxpr))
+    top = scopes[0] if scopes else None
+    for scope in scopes:
+        producers: Dict[Any, Any] = {}
+        seeds = set()
+        for eqn in scope.eqns:
+            for ov in eqn.outvars:
+                producers[ov] = eqn
+                aval = getattr(ov, "aval", None)
+                if _is_wide(getattr(aval, "dtype", None)) and \
+                        len(getattr(aval, "shape", ())) >= 1:
+                    seeds.add(ov)
+        if scope is top:
+            for v in scope.outvars:
+                if _is_wide(aval_dtype(v)):
+                    seeds.add(v)
+        reached = set()
+        stack = list(seeds)
+        while stack:
+            v = stack.pop()
+            if v in reached:
+                continue
+            reached.add(v)
+            eqn = producers.get(v)
+            if eqn is None:
+                continue
+            for iv in eqn.invars:
+                if hasattr(iv, "val"):
+                    continue      # Literal: unhashable, and no producer
+                if _is_wide(aval_dtype(iv)) and iv not in reached:
+                    stack.append(iv)
+        for eqn in scope.eqns:
+            wide_outs = [ov for ov in eqn.outvars
+                         if _is_wide(aval_dtype(ov))]
+            if not wide_outs:
+                continue
+            if any(_is_wide(aval_dtype(iv)) for iv in eqn.invars):
+                continue                       # contamination, not origin
+            if any(ov in reached for ov in wide_outs):
+                results.append((eqn, str(aval_dtype(wide_outs[0]))))
+    return results
+
+
+# ------------------------------------------------------------------- churn
+def convert_churn_chains(jaxpr) -> List[Tuple[str, str, int]]:
+    """Cast–uncast ping-pong: ``x:A -> convert -> y:B -> convert -> z:A``
+    with ``A != B``.  Each round trip costs two element-wise passes over
+    the value and (for f32->bf16->f32) quietly truncates mantissa bits —
+    either the value should STAY in B (drop the second cast) or never
+    have left A (drop both).  Detected per jaxpr scope (a chain that
+    crosses a scan/pjit boundary is two different values to XLA anyway).
+    Returns ``(src_dtype, mid_dtype, count)`` aggregates."""
+    chains: Dict[Tuple[str, str], int] = {}
+    for j in iter_jaxprs(jaxpr):
+        producers: Dict[Any, Any] = {}
+        for eqn in j.eqns:
+            for ov in eqn.outvars:
+                producers[ov] = eqn
+        for eqn in j.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            mid_var = eqn.invars[0]
+            prev = producers.get(mid_var)
+            if prev is None or prev.primitive.name != "convert_element_type":
+                continue
+            src_dt = aval_dtype(prev.invars[0])
+            mid_dt = aval_dtype(mid_var)
+            out_dt = aval_dtype(eqn.outvars[0])
+            if src_dt is None or mid_dt is None or out_dt is None:
+                continue
+            # a true round trip: back where it started through a DIFFERENT
+            # dtype (same-dtype converts are weak-type canonicalization)
+            if str(src_dt) == str(out_dt) and str(mid_dt) != str(out_dt):
+                key = (str(src_dt), str(mid_dt))
+                chains[key] = chains.get(key, 0) + 1
+    return [(s, m, c) for (s, m), c in sorted(chains.items())]
+
+
+def jaxpr_collective_census(jaxpr) -> Dict[str, Dict[str, int]]:
+    """Fallback collective census for programs with no multi-device
+    sharding (shard_map/pmap bodies carry their collectives at jaxpr
+    level; plain jit programs report through the partitioned HLO in
+    ``hlo.py`` instead)."""
+    census: Dict[str, Dict[str, int]] = {}
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in JAXPR_COLLECTIVES:
+            continue
+        row = census.setdefault(name, {"count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += sum(aval_bytes(ov) for ov in eqn.outvars)
+    return dict(sorted(census.items()))

@@ -1,0 +1,290 @@
+"""graftaudit rule pack AX001–AX006.
+
+Each rule is ``rule(ir: ProgramIR) -> list[Finding]`` over the analyzed
+IR of ONE compiled program (``audit.analyze_program``), registered in
+``AUDIT_RULES``.  Findings use the program NAME as their path — the
+stable key the baseline and suppression machinery ratchets on — and the
+catalog with rationale lives in ``tools/README.md``.
+
+These are the contracts graftlint's AST rules structurally cannot see:
+they live in the traced jaxpr / partitioned HLO, not the Python source.
+A PR that turns the ZeRO-3 reduce-scatter into a dense all-reduce, leaks
+an f32 matmul into a bf16 step, or drops donation on the decode cache
+changes NO line any AST rule looks at — only the compiled program set.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..graftlint.core import Finding
+from . import ir as IR
+
+__all__ = ["AUDIT_RULES", "AUDIT_RULE_DOCS", "DEAD_AFTER_CALL"]
+
+AUDIT_RULES: Dict[str, Callable] = {}
+AUDIT_RULE_DOCS: Dict[str, str] = {}
+
+#: which positional args each jit KIND leaves dead after the call —
+#: the caller-side contract the builders in ``nn/_common`` /
+#: ``nn/multilayer`` / ``generation/programs`` encode in their
+#: ``donate_argnums``.  train-family steps return fresh
+#: params/state/opt (the old pytrees are garbage the moment the call
+#: returns); serve's padded batch is built per dispatch and never
+#: reread; the generation cache is threaded through both programs.
+DEAD_AFTER_CALL: Dict[str, tuple] = {
+    "train_step": (0, 1, 2),
+    "train_step_carry": (0, 1, 2),
+    "epoch_scan": (0, 1, 2),
+    "epochs_scan": (0, 1, 2),
+    "serve": (2,),
+    "prefill": (4,),
+    "decode": (3,),
+}
+
+_LOW_PRECISION = ("bfloat16", "float16")
+_DOT_PRIMS = ("dot_general", "conv_general_dilated")
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+
+
+def rule(code: str, doc: str):
+    def deco(fn):
+        AUDIT_RULES[code] = fn
+        AUDIT_RULE_DOCS[code] = doc
+        return fn
+    return deco
+
+
+def _finding(ir_prog, code: str, msg: str) -> Finding:
+    return Finding(path=ir_prog.name, line=0, col=0, rule=code, message=msg)
+
+
+# --------------------------------------------------------------------- AX001
+@rule("AX001", "f64/weak-type promotion introduced inside a steady-state "
+               "program whose inputs are all <=32-bit")
+def ax001(ir_prog) -> List[Finding]:
+    """Under x64 a dtype-defaulted constant (``jnp.zeros(())``) or a weak
+    Python scalar silently promotes everything downstream of it to f64 —
+    double the bytes through every fused loop of the hottest program,
+    with no Python line changed.  Flagged at the ORIGIN equations (output
+    f64/c128, no f64/c128 input), one finding per primitive, and only
+    when no program INPUT is 64-bit (a gradient-check feeding f64 data
+    wants f64 math).  Contained scalar f64 that never reaches an output
+    or an array (optax's weak-typed bias-correction arithmetic) is
+    byte-free and stays silent — each origin is judged by what ITS value
+    reaches (``escaping_promotion_origins``), so a real escape elsewhere
+    never drags the benign scalar math into the report."""
+    out: List[Finding] = []
+    if not ir_prog.steady:
+        return out
+    if any(dt in ("float64", "complex128") for dt in ir_prog.input_dtypes):
+        return out
+    by_prim: Dict[str, int] = {}
+    wide_by_prim: Dict[str, str] = {}
+    for eqn, wide in IR.escaping_promotion_origins(ir_prog.jaxpr):
+        name = eqn.primitive.name
+        by_prim[name] = by_prim.get(name, 0) + 1
+        wide_by_prim.setdefault(name, wide)
+    for name in sorted(by_prim):
+        out.append(_finding(
+            ir_prog, "AX001",
+            f"{by_prim[name]} `{name}` eqn(s) introduce "
+            f"{wide_by_prim[name]} into a steady-state program whose "
+            "inputs are all <=32-bit: a dtype-defaulted constant or weak "
+            "Python scalar is promoting the math under x64 — give the "
+            "constant the dtype of the value it joins"))
+    return out
+
+
+# --------------------------------------------------------------------- AX002
+@rule("AX002", "precision-policy violation: f32 contraction inside a "
+               "low-precision program, or convert_element_type churn")
+def ax002(ir_prog) -> List[Finding]:
+    """Two arms.  (a) In a program whose manifest DECLARES a bf16/f16
+    policy, any ``dot_general``/conv with all-f32 floating operands
+    bypassed the policy: the MXU runs it at 1/2 (or worse) throughput
+    and the activation memory doubles.  The default keep_f32 classes
+    and loss reductions are elementwise/reduce ops (no contractions),
+    but a per-name ``overrides={'layer': 'float32'}`` pinning a dense
+    layer IS a supported deliberate f32 contraction — so this arm only
+    runs on explicitly declared policies, where the declarer also knows
+    the overrides: declare ``policy=None`` for such a program, or
+    suppress with the override as the justification.  (b) Cast–uncast
+    ping-pong (``f32 -> bf16 -> f32`` on one value), any program: two
+    wasted element-wise passes and a silent mantissa truncation; either
+    stay in the narrow dtype or never leave the wide one."""
+    out: List[Finding] = []
+    dots = [e for e in IR.iter_eqns(ir_prog.jaxpr)
+            if e.primitive.name in _DOT_PRIMS]
+
+    def op_dtypes(eqn):
+        return [str(IR.aval_dtype(v)) for v in eqn.invars[:2]
+                if IR.aval_dtype(v) is not None]
+
+    if ir_prog.policy in _LOW_PRECISION:
+        f32_dots: Dict[str, int] = {}
+        for e in dots:
+            dts = op_dtypes(e)
+            if dts and all(dt == "float32" for dt in dts):
+                f32_dots[e.primitive.name] = \
+                    f32_dots.get(e.primitive.name, 0) + 1
+        for name in sorted(f32_dots):
+            out.append(_finding(
+                ir_prog, "AX002",
+                f"{f32_dots[name]} f32 `{name}` eqn(s) inside a "
+                f"declared-{ir_prog.policy} program: the contraction "
+                "bypassed the precision policy — cast its operands to "
+                "the compute dtype (default keep_f32 classes and loss "
+                "reductions have no contractions; a deliberate per-name "
+                "f32 override is the suppression justification)"))
+    for src, mid, count in IR.convert_churn_chains(ir_prog.jaxpr):
+        out.append(_finding(
+            ir_prog, "AX002",
+            f"convert_element_type churn: {count} value(s) round-trip "
+            f"{src} -> {mid} -> {src} — two wasted element-wise passes "
+            f"(and mantissa truncation when {mid} is narrower); keep the "
+            "value in one dtype across the chain"))
+    return out
+
+
+# --------------------------------------------------------------------- AX003
+@rule("AX003", "collective layout guard: dense all-reduce where the "
+               "ZeRO-3 layout implies reduce-scatter, or duplicate "
+               "per-operand all-gathers")
+def ax003(ir_prog) -> List[Finding]:
+    """The census itself (count + byte estimate per collective op) lands
+    in the program card; this rule guards the two layout regressions
+    that cost real HBM/interconnect.  (a) A ZeRO-3 program (sharded
+    param args) containing an ``all-reduce`` of (near-)full-model
+    gradient bytes: GSPMD was supposed to derive reduce-scatter + shard
+    -local update from the shardings (arxiv 2004.13336); a dense
+    all-reduce there means some op defeated the derivation and every
+    step now ships dp x the gradient bytes.  (b) The same operand
+    all-gathered twice with the same result shape — a missed CSE that
+    doubles the gather traffic for one leaf."""
+    out: List[Finding] = []
+    if ir_prog.zero3 and ir_prog.param_bytes > 0:
+        for c in ir_prog.collective_ops:
+            if c.op != "all-reduce":
+                continue
+            if c.result_bytes >= 0.5 * ir_prog.param_bytes:
+                out.append(_finding(
+                    ir_prog, "AX003",
+                    f"dense all-reduce of {c.result_bytes} bytes "
+                    f"(>= 50% of the {ir_prog.param_bytes}-byte param "
+                    "set) in a ZeRO-3 sharded program: the layout "
+                    "implies reduce-scatter grads + shard-local update; "
+                    "something (an unsharded constraint, a host-shaped "
+                    "op) defeated the GSPMD derivation"))
+    seen: Dict[tuple, int] = {}
+    for c in ir_prog.collective_ops:
+        if c.op != "all-gather" or not c.operands:
+            continue
+        key = (c.operands, tuple(c.shapes))
+        seen[key] = seen.get(key, 0) + 1
+    for (operands, shapes), n in sorted(seen.items()):
+        if n > 1:
+            out.append(_finding(
+                ir_prog, "AX003",
+                f"operand {operands[0]} is all-gathered {n}x with "
+                f"identical result {shapes}: duplicate per-leaf forward "
+                "gather — reuse the gathered value"))
+    return out
+
+
+# --------------------------------------------------------------------- AX004
+@rule("AX004", "host callback (pure_callback/io_callback/debug.print) "
+               "inside a steady-state program")
+def ax004(ir_prog) -> List[Finding]:
+    """A callback primitive stalls the device at every execution of the
+    program: the runtime must round-trip the host (on TPU, through the
+    dispatch tunnel) before the next fused region can run — the
+    zero-steady-state-host-sync contract is void while one of these is
+    in a train/serve/decode program.  ``jax.debug.print`` lowers to
+    ``debug_callback``, so a leftover debug line is caught here even
+    though the AST-side complement (JX026) already flags the source."""
+    out: List[Finding] = []
+    if not ir_prog.steady:
+        return out
+    counts: Dict[str, int] = {}
+    for eqn in IR.iter_eqns(ir_prog.jaxpr):
+        if eqn.primitive.name in _CALLBACK_PRIMS:
+            counts[eqn.primitive.name] = \
+                counts.get(eqn.primitive.name, 0) + 1
+    for name in sorted(counts):
+        out.append(_finding(
+            ir_prog, "AX004",
+            f"{counts[name]} `{name}` eqn(s) in a steady-state program: "
+            "every execution stalls the device on a host round-trip — "
+            "move the callback out of the hot program (or pragma a "
+            "deliberate one with its justification)"))
+    return out
+
+
+# --------------------------------------------------------------------- AX005
+@rule("AX005", "donation miss: a large dead-after-call argument is not "
+               "in donate_argnums")
+def ax005(ir_prog) -> List[Finding]:
+    """For the arg positions this program's KIND leaves dead after the
+    call (``DEAD_AFTER_CALL``: train steps return fresh
+    params/state/opt, serve never rereads its padded batch, the decode
+    cache is threaded), a leaf tree above the size threshold that is NOT
+    donated forces XLA to keep input and output alive simultaneously —
+    on the train step that is 2x params + 2x optimizer state of
+    avoidable HBM, exactly the headroom large-model configs run out of
+    first."""
+    out: List[Finding] = []
+    dead = DEAD_AFTER_CALL.get(ir_prog.kind)
+    if dead is None and ir_prog.kind.startswith("pretrain"):
+        dead = (0, 1)
+    if not dead:
+        return out
+    for argnum in dead:
+        if argnum >= len(ir_prog.arg_bytes):
+            continue
+        size = ir_prog.arg_bytes[argnum]
+        if size < ir_prog.config.min_donate_bytes:
+            continue
+        if argnum not in ir_prog.donate:
+            out.append(_finding(
+                ir_prog, "AX005",
+                f"arg {argnum} ({size} bytes) is dead after the call in "
+                f"kind '{ir_prog.kind}' but not in donate_argnums"
+                f"{tuple(ir_prog.donate)}: XLA must hold input and "
+                "output alive together — donate it (or pragma the "
+                "platform that cannot, with justification)"))
+    return out
+
+
+# --------------------------------------------------------------------- AX006
+@rule("AX006", "oversized broadcast intermediate materialized inside the "
+               "program")
+def ax006(ir_prog) -> List[Finding]:
+    """A ``broadcast_in_dim`` whose result is both large in absolute
+    bytes and a big multiple of its operand usually means a reduction
+    was written as materialize-then-reduce (or a mask/one-hot blew up to
+    batch x vocab x seq): XLA often fuses these away, but one that
+    survives into the jaxpr at this size is peak-memory risk worth a
+    look.  Thresholds ride the audit config so toy canonical programs
+    don't cry wolf."""
+    out: List[Finding] = []
+    cfg = ir_prog.config
+    hits = 0
+    worst = 0
+    for eqn in IR.iter_eqns(ir_prog.jaxpr):
+        if eqn.primitive.name != "broadcast_in_dim":
+            continue
+        ob = sum(IR.aval_bytes(ov) for ov in eqn.outvars)
+        ib = max([IR.aval_bytes(iv) for iv in eqn.invars] or [0])
+        if ob >= cfg.broadcast_bytes and ob >= cfg.broadcast_ratio * \
+                max(ib, 1):
+            hits += 1
+            worst = max(worst, ob)
+    if hits:
+        out.append(_finding(
+            ir_prog, "AX006",
+            f"{hits} broadcast_in_dim eqn(s) materialize >= "
+            f"{cfg.broadcast_bytes} bytes (largest {worst}) from a "
+            f">= {cfg.broadcast_ratio}x smaller operand: likely a "
+            "materialize-then-reduce — restructure to reduce without "
+            "the full intermediate"))
+    return out

@@ -1278,6 +1278,89 @@ def jx025(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX026
+# scope: every non-test package module — the AST-side complement of
+# graftaudit AX004 (the IR rule catches a callback that made it into a
+# compiled steady-state program; this one catches the source line the
+# moment it is written, wherever it would compile to)
+_JX026_TEST_PATH_RE = re.compile(
+    r"(^|[/\\])tests?([/\\]|$)|(^|[/\\])test_[^/\\]*\.py$|"
+    r"(^|[/\\])conftest\.py$")
+_JX026_DEBUG_LEAVES = frozenset(("print", "breakpoint", "callback"))
+_JX026_CALLBACKS = frozenset(("pure_callback", "io_callback"))
+
+
+@rule("JX026", "jax.debug.print/breakpoint or host callback "
+               "(pure_callback/io_callback) in a non-test package module")
+def jx026(info: ModuleInfo) -> List[Finding]:
+    """Flag ``jax.debug.print`` / ``jax.debug.breakpoint`` /
+    ``jax.debug.callback`` and ``pure_callback`` / ``io_callback``
+    (dotted through a jax alias, or imported bare from
+    ``jax``/``jax.experimental``) anywhere in a non-test package
+    module.  Inside a jitted program each lowers to a callback primitive
+    that stalls the device on a host round-trip EVERY execution — the
+    forgotten-debug-line failure mode ships straight into the
+    steady-state train/serve/decode programs, where graftaudit AX004
+    would flag the compiled result; this rule stops the line at review
+    time instead, and also outside jit scopes (a ``jax.debug.print`` in
+    eager code is still a stray debug statement).  Test modules and
+    conftest are out of scope — printing tracers is what debugging a
+    test looks like.  A deliberate callback (a documented
+    eval-time-only io_callback) carries a pragma with justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if _JX026_TEST_PATH_RE.search(path):
+        return out
+    # bare names imported from jax / jax.experimental, and jax.debug
+    # module aliases (`from jax import debug`, `import jax.debug as d`)
+    bare_callbacks: set = set()
+    debug_mods: set = set()
+    for node in info.nodes(ast.Import):
+        for alias in node.names:
+            if alias.name == "jax.debug" and alias.asname:
+                debug_mods.add(alias.asname)
+    for node in info.nodes(ast.ImportFrom):
+        mod = node.module or ""
+        if mod not in ("jax", "jax.experimental", "jax.debug"):
+            continue
+        for alias in node.names:
+            name = alias.asname or alias.name
+            if alias.name in _JX026_CALLBACKS:
+                bare_callbacks.add(name)
+            elif mod == "jax" and alias.name == "debug":
+                debug_mods.add(name)
+            elif mod == "jax.debug" and alias.name in _JX026_DEBUG_LEAVES:
+                bare_callbacks.add(name)
+    for node in info.nodes(ast.Call):
+        fname = call_name(node)
+        if not fname:
+            continue
+        parts = fname.split(".")
+        hit = None
+        if len(parts) == 1 and parts[0] in bare_callbacks:
+            hit = fname
+        elif len(parts) >= 2:
+            root, leaf = parts[0], parts[-1]
+            if root in info.jax_aliases and len(parts) >= 3 and \
+                    parts[1] == "debug" and leaf in _JX026_DEBUG_LEAVES:
+                hit = fname                      # jax.debug.print(...)
+            elif root in info.jax_aliases and leaf in _JX026_CALLBACKS:
+                hit = fname                      # jax.pure_callback(...)
+            elif root in debug_mods and len(parts) == 2 and \
+                    leaf in _JX026_DEBUG_LEAVES:
+                hit = fname                      # debug.print(...)
+        if hit:
+            out.append(_finding(
+                info, node, "JX026",
+                f"`{hit}` in a non-test package module: inside jit this "
+                "lowers to a host-callback primitive that stalls the "
+                "device every execution (graftaudit AX004 catches the "
+                "compiled form); outside jit it is a stray debug "
+                "statement — remove it, or pragma a deliberate "
+                "callback with its justification"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
