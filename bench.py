@@ -92,6 +92,16 @@ production entry points + the full graftaudit run — jaxpr phase and
 the partitioned-HLO compiles — the same audit that gates tier-1 in
 tests/test_audit.py, budget 60s); DL4J_TPU_BENCH_AUDIT=0 suppresses
 it.
+
+A fourteenth set of JSON lines records the sparse-embedding
+gradient-exchange benchmark (``embedding_grad_exchange_ms``: densified
+touched-row index/value exchange through the row-sharded
+``sparse_grad=True`` table vs the dense full-table all-reduce of the
+replicated path, swept over vocab {50k, 500k} x touched-rows fraction,
+with the counter-verified zero-recompile steady state; the acceptance
+claim is the densified path winning at vocab >= 50k with <= 10%
+touched rows, with ``word2vec_words_per_sec`` as the side-bench
+acceptance metric); DL4J_TPU_BENCH_EMBED=0 suppresses it.
 """
 import json
 import os
@@ -375,6 +385,23 @@ def main():
                                       "(build + audit)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # sparse-embedding exchange rows (ISSUE 15): densified index/value
+    # exchange (row-sharded sparse_grad table) vs dense full-table
+    # all-reduce at vocab x touched-fraction; a fourteenth set of JSON
+    # lines, opt-out DL4J_TPU_BENCH_EMBED=0
+    if os.environ.get("DL4J_TPU_BENCH_EMBED", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                embedding_grad_exchange_ms
+            for row in embedding_grad_exchange_ms():
+                print(json.dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(json.dumps({"metric": "embedding_grad_exchange_ms",
+                              "value": None,
+                              "unit": "ms/step (densified index/value "
+                                      "exchange, row-sharded table)",
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -499,6 +526,11 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # graftaudit run (jaxpr + partitioned-HLO phases) — the tier-1
         # audit gate's wall time, budget 60s
         B.audit_time_ms,
+        # sparse embedding (ISSUE 15): densified touched-row exchange
+        # (row-sharded sparse_grad table) vs dense full-table all-reduce
+        # over vocab x touched fraction; word2vec_words_per_sec above is
+        # the acceptance side metric
+        B.embedding_grad_exchange_ms,
     ]
     side = []
     for fn in captures:

@@ -33,30 +33,54 @@ def hyperparam_conf(lc: Optional[LayerConf]) -> Optional[BaseLayerConf]:
     return None
 
 
+def float_grad_leaves(tree):
+    """FLOAT gradient leaves only — the one predicate every norm/stat/
+    unscale stage shares: a ``SparseRows`` carrier (``nn/sparse``)
+    contributes its int32 row indices as pytree leaves, and reductions
+    or scaling over row ids would silently corrupt which rows the
+    update lands on."""
+    return [g for g in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(g.dtype, jnp.floating)]
+
+
+def map_float_grads(fn, grads):
+    """tree_map ``fn`` over float gradient leaves only; non-float
+    leaves (``SparseRows`` indices) pass through untouched — see
+    :func:`float_grad_leaves`."""
+    return jax.tree_util.tree_map(
+        lambda g: fn(g) if jnp.issubdtype(g.dtype, jnp.floating) else g,
+        grads)
+
+
 def apply_gradient_normalization(mode: Optional[str], threshold: float, grads):
-    """Reference BaseMultiLayerUpdater.preApply :318."""
+    """Reference BaseMultiLayerUpdater.preApply :318.
+
+    Norms reduce over float leaves only (see :func:`float_grad_leaves`);
+    for a densified-sparse gradient the coalesced values carry exactly
+    the dense gradient's nonzero entries, so every norm here equals its
+    dense counterpart."""
     if not mode or mode == "none":
         return grads
     mode = mode.lower()
-    leaves = jax.tree_util.tree_leaves(grads)
+    leaves = float_grad_leaves(grads)
     if mode == "renormalizel2perlayer":
         norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
-        return jax.tree_util.tree_map(lambda g: g / (norm + 1e-8), grads)
+        return map_float_grads(lambda g: g / (norm + 1e-8), grads)
     if mode == "renormalizel2perparamtype":
-        return jax.tree_util.tree_map(
+        return map_float_grads(
             lambda g: g / (jnp.linalg.norm(g.reshape(-1)) + 1e-8), grads)
     if mode == "clipelementwiseabsolutevalue":
-        return jax.tree_util.tree_map(
+        return map_float_grads(
             lambda g: jnp.clip(g, -threshold, threshold), grads)
     if mode == "clipl2perlayer":
         norm = jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
         scale = jnp.minimum(1.0, threshold / (norm + 1e-8))
-        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+        return map_float_grads(lambda g: g * scale, grads)
     if mode == "clipl2perparamtype":
         def clip(g):
             n = jnp.linalg.norm(g.reshape(-1))
             return g * jnp.minimum(1.0, threshold / (n + 1e-8))
-        return jax.tree_util.tree_map(clip, grads)
+        return map_float_grads(clip, grads)
     raise ValueError(f"unknown gradient normalization '{mode}'")
 
 

@@ -184,6 +184,20 @@ def _build_graph_train_step(conf, tx):
         "gradient_normalization_threshold", 1.0))
     pol = _precision.resolve(conf.defaults)
     confs = _vertex_confs(conf)
+    for name, lc in confs.items():
+        if getattr(lc, "sparse_grad", False) or \
+                getattr(getattr(lc, "layer", None), "sparse_grad", False):
+            # surfaced at build time, never a silent dense fallback: the
+            # densified pre-pass (nn/sparse) is wired into the
+            # MultiLayerNetwork train step only — a graph vertex here
+            # would quietly train with the dense [vocab, dim] cotangent
+            # the flag promises to eliminate
+            raise ValueError(
+                f"vertex '{name}': sparse_grad=True is supported on "
+                "MultiLayerNetwork (first-layer embedding) only; the "
+                "ComputationGraph train step has no densified sparse-"
+                "gradient pre-pass — drop the flag, or move the "
+                "embedding model to a MultiLayerNetwork stack")
     cast_map = {}
     if pol is not None:
         for name, v in conf.vertices.items():

@@ -178,19 +178,89 @@ class DropoutLayer(BaseLayerConf):
             variables.get("state", {})
 
 
+def _embedding_invalid(msg: str):
+    """Raise the serving stack's client-error type (a bad id batch is a
+    caller bug, distinguishable from model-internal ValueErrors — the
+    generation engine's InvalidInputError pattern)."""
+    from ...parallel.inference import InvalidInputError
+    raise InvalidInputError(msg)
+
+
+def _validate_id_dtype(x, name: str, n_in: int):
+    if not jnp.issubdtype(x.dtype, jnp.integer):
+        _embedding_invalid(
+            f"layer '{name}': embedding ids must be an integer dtype, got "
+            f"{x.dtype} — a float id batch would silently truncate; pass "
+            f"int ids, or a one-hot batch with trailing dim {n_in}")
+
+
+def _validate_id_range(idx, name: str, n_in: int):
+    """Concrete (host-visible) id batches are range-checked up front;
+    traced ids are validated by the caller before dispatch (a traced
+    gather clamps, so an in-program check could only corrupt silently)."""
+    if isinstance(idx, jax.core.Tracer):
+        return
+    lo = int(jnp.min(idx)) if idx.size else 0
+    hi = int(jnp.max(idx)) if idx.size else 0
+    if lo < 0 or hi >= n_in:
+        _embedding_invalid(
+            f"layer '{name}': embedding ids out of range [{lo}, {hi}] for "
+            f"vocabulary of {n_in} — the on-device gather would clamp "
+            "silently")
+
+
+def validate_host_ids(lc, x) -> None:
+    """Boundary (host-side) id-range validation for embedding-first
+    networks.  fit/output/score TRACE the forward, where a range check
+    cannot run (the traced gather clamps silently), so the network
+    entry points validate the concrete batch BEFORE dispatch — the
+    generation engine's validate-at-admission pattern.  Device-resident
+    batches (a ``DevicePrefetchIterator`` upstream) skip: materializing
+    them here would stall the pipeline overlap, and their producers
+    validated host-side.  Float/one-hot batches skip too — the dtype
+    contract is static and already raises at trace time."""
+    if x is None or isinstance(x, (list, tuple)) or \
+            isinstance(x, jax.core.Tracer) or isinstance(x, jax.Array):
+        return
+    import numpy as np
+    arr = np.asarray(x)
+    if arr.ndim == 0 or arr.size == 0 or \
+            not np.issubdtype(arr.dtype, np.integer):
+        return
+    lo, hi = int(arr.min()), int(arr.max())
+    if lo < 0 or hi >= lc.n_in:
+        _embedding_invalid(
+            f"layer '{lc.name}': embedding ids out of range [{lo}, {hi}] "
+            f"for vocabulary of {lc.n_in} — the on-device gather would "
+            "clamp silently")
+
+
 @register_serde
 @dataclass
 class EmbeddingLayer(BaseLayerConf):
     """Index → vector lookup (reference ``nn/conf/layers/EmbeddingLayer``).
 
-    Input: integer indices [batch] or one-hot [batch, n_in]; output
-    [batch, n_out].  Lookup is a gather — on TPU this stays on-device and
-    differentiates to a scatter-add, replacing the reference's row-view
-    update trick.
+    Input: integer indices [batch] / [batch, 1], or one-hot
+    [batch, n_in]; output [batch, n_out].  Lookup is a gather — on TPU
+    this stays on-device and differentiates to a scatter-add, replacing
+    the reference's row-view update trick.
+
+    ``sparse_grad=True`` opts the table into the densified sparse
+    gradient path (``nn/sparse``): the train step exchanges coalesced
+    touched-row index+value blocks instead of the dense ``[n_in,
+    n_out]`` cotangent, and the updater touches only those rows (lazy
+    row-sparse semantics — exact for stateless updaters; stateful
+    mirrors skip untouched-row decay).  ``sparse_grad_capacity`` pads
+    the per-step block to a fixed size (None = exact bound); a capacity
+    below the bound is refused at trace time.  Requires the layer to be
+    first in the stack (ids come straight from the batch) and no
+    l1/l2 on the table (dense decay touches every row).
     """
     n_in: int = 0
     n_out: int = 0
     has_bias: bool = True
+    sparse_grad: bool = False
+    sparse_grad_capacity: Optional[int] = None
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_in == 0 or override:
@@ -205,13 +275,39 @@ class EmbeddingLayer(BaseLayerConf):
             params["b"] = self.make_bias((self.n_out,))
         return {"params": params, "state": {}}
 
+    def decode_ids(self, x):
+        """Id view of one input batch: [batch] int32 ids, or None for a
+        one-hot batch.  Validates the id path's dtype (float ids used
+        to truncate silently via astype) and, for concrete batches, the
+        id range."""
+        if x.ndim == 2 and x.shape[-1] == self.n_in and self.n_in > 1 and \
+                not jnp.issubdtype(x.dtype, jnp.integer):
+            return None                      # one-hot input
+        if x.ndim == 2 and x.shape[-1] == 1:
+            x = x[:, 0]                      # [b, 1] id column
+        if x.ndim != 1:
+            # integer [b, n_in] with n_in > 1 is the historical int
+            # one-hot form — decode it like the float one-hot path
+            if x.ndim == 2 and x.shape[-1] == self.n_in and self.n_in > 1:
+                return None
+            _embedding_invalid(
+                f"layer '{self.name}': expected ids [batch]/[batch, 1] or "
+                f"one-hot [batch, {self.n_in}], got shape {tuple(x.shape)}")
+        _validate_id_dtype(x, self.name, self.n_in)
+        idx = x.astype(jnp.int32)
+        _validate_id_range(idx, self.name, self.n_in)
+        return idx
+
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         params = variables["params"]
-        if x.ndim == 2 and x.shape[-1] == self.n_in and self.n_in > 1:
-            idx = jnp.argmax(x, axis=-1)  # one-hot input
+        idx = self.decode_ids(x)
+        if idx is None:
+            idx = jnp.argmax(x, axis=-1)     # one-hot input
+        if self.sparse_grad:
+            from .. import sparse as _sparse
+            z = _sparse.embedding_lookup(params["W"], idx)
         else:
-            idx = x.reshape(x.shape[0]).astype(jnp.int32)
-        z = params["W"][idx]
+            z = params["W"][idx]
         if self.has_bias:
             z = z + params["b"]
         return self.act_fn(z), variables.get("state", {})
@@ -222,11 +318,27 @@ class EmbeddingLayer(BaseLayerConf):
 class EmbeddingSequenceLayer(BaseLayerConf):
     """Token-id sequence → embedding sequence: [b, t] int (or one-hot
     [b, t, n_in]) → [b, t, n_out] (reference ``EmbeddingSequenceLayer``).
-    Gather on device; backward is a scatter-add."""
+    Gather on device; backward is a scatter-add.
+
+    An exactly-one-hot-shaped [b, t, n_in] input decodes to ids
+    (argmax) and rides the same gather — the historical
+    ``x @ W`` matmul is O(b·t·n_in·n_out) dense MXU work (ruinous under
+    a bf16 policy at real vocab sizes) for what is a lookup.  Callers
+    that feed SOFT distributions over the vocabulary (expected
+    embeddings, a semantic the matmul computes and argmax does not) opt
+    back in with ``one_hot_matmul=True``.
+
+    ``sparse_grad`` / ``sparse_grad_capacity``: see
+    :class:`EmbeddingLayer` — same densified-gradient contract over the
+    [b, t] id path.
+    """
     INPUT_KIND = "rnn"
 
     n_in: int = 0     # vocabulary size
     n_out: int = 0    # embedding dim
+    one_hot_matmul: bool = False
+    sparse_grad: bool = False
+    sparse_grad_capacity: Optional[int] = None
 
     def set_n_in(self, itype: InputType, override: bool = False) -> None:
         if self.n_in == 0 or override:
@@ -240,10 +352,41 @@ class EmbeddingSequenceLayer(BaseLayerConf):
                                                  (self.n_in, self.n_out))},
                 "state": {}}
 
+    def decode_ids(self, x):
+        """Id view of one input batch: [b, t] int32 ids, or None when
+        the batch must ride the one-hot matmul (``one_hot_matmul=True``,
+        or a 3-D input that is not one-hot-shaped)."""
+        if x.ndim == 3:
+            if self.n_in > 0 and x.shape[-1] != self.n_in:
+                # a stale tokenizer / vocab-size mismatch would otherwise
+                # surface as a cryptic dot_general shape error deep in
+                # the trace
+                _embedding_invalid(
+                    f"layer '{self.name}': 3-D input has trailing dim "
+                    f"{x.shape[-1]} but the vocabulary is {self.n_in} — "
+                    f"expected one-hot [batch, time, {self.n_in}] (or "
+                    "integer ids [batch, time])")
+            if self.one_hot_matmul or self.n_in <= 0:
+                return None
+            return jnp.argmax(x, axis=-1)
+        if x.ndim != 2:
+            _embedding_invalid(
+                f"layer '{self.name}': expected ids [batch, time] or "
+                f"one-hot [batch, time, {self.n_in}], got shape "
+                f"{tuple(x.shape)}")
+        _validate_id_dtype(x, self.name, self.n_in)
+        idx = x.astype(jnp.int32)
+        _validate_id_range(idx, self.name, self.n_in)
+        return idx
+
     def apply(self, variables, x, *, train=False, key=None, mask=None):
         W = variables["params"]["W"]
-        if x.ndim == 3:           # one-hot [b, t, v]: matmul keeps the MXU
+        idx = self.decode_ids(x)
+        if idx is None:           # explicit opt-in (soft distributions)
             z = x.astype(W.dtype) @ W
+        elif self.sparse_grad:
+            from .. import sparse as _sparse
+            z = _sparse.embedding_lookup(W, idx)
         else:
-            z = W[x.astype(jnp.int32)]
+            z = W[idx]
         return self.act_fn(z), variables.get("state", {})

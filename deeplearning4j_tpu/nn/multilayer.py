@@ -32,9 +32,11 @@ import optax
 
 from . import precision as _precision
 from . import scan_layers as _scan_layers
+from . import sparse as _sparse
 from ._common import (_cast_floats, apply_constraints_all,
                       apply_gradient_norm_all, apply_gradient_normalization,
-                      build_tx, fit_on_device_epochs, hyperparam_conf)
+                      build_tx, fit_on_device_epochs, float_grad_leaves,
+                      hyperparam_conf)
 from .compile_cache import shared_jit, topology_signature
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
@@ -385,11 +387,48 @@ def _build_stack_fn(conf, tx, kind: str):
     raise KeyError(kind)
 
 
+def _sparse_embedding_conf(conf):
+    """The stack's sparse-gradient embedding layer, or None.
+
+    Only the FIRST layer is eligible: the sparse pre-pass coalesces the
+    raw batch ids before the traced stack runs, and only layer_0's ids
+    ARE the batch input.  A ``sparse_grad=True`` anywhere else is a
+    config error surfaced at build time, not a silent dense fallback.
+    """
+    from .layers.feedforward import EmbeddingLayer, EmbeddingSequenceLayer
+    found = None
+    # scan the WHOLE stack before returning: a flag on a later layer
+    # must fail even when layer_0 is itself valid
+    for i, lc in enumerate(conf.layers):
+        if not getattr(lc, "sparse_grad", False):
+            continue
+        if i != 0:
+            raise ValueError(
+                f"layer '{lc.name}': sparse_grad=True requires the "
+                "embedding to be the first layer (its ids must be the "
+                "batch input for the densified pre-pass); position "
+                f"{i} gets dense gradients — drop the flag there")
+        if not isinstance(lc, (EmbeddingLayer, EmbeddingSequenceLayer)):
+            raise ValueError(
+                f"layer '{lc.name}': sparse_grad is an embedding-layer "
+                "contract")
+        if float(lc.resolved("l1", 0.0) or 0.0) or \
+                float(lc.resolved("l2", 0.0) or 0.0):
+            raise ValueError(
+                f"layer '{lc.name}': sparse_grad=True with l1/l2 on the "
+                "table is unsupported — dense weight decay touches every "
+                "row, defeating the touched-rows-only exchange; drop the "
+                "regularization or the flag")
+        found = lc
+    return found
+
+
 def _build_train_step(conf, tx, with_carry: bool):
     gn_mode = conf.defaults.get("gradient_normalization")
     gn_thr = float(conf.defaults.get("gradient_normalization_threshold", 1.0))
     pol = _precision.resolve(conf.defaults)
     confs = _layer_confs(conf)
+    sparse_emb = _sparse_embedding_conf(conf)
     # per-layer compute dtypes, resolved once at build time (keep_f32
     # classes and per-name overrides stay f32 — their params are never
     # downcast, and _stack_forward casts activations to match)
@@ -406,6 +445,42 @@ def _build_train_step(conf, tx, with_carry: bool):
             # floating inputs only: integer token ids must reach the
             # embedding gather exact (a bf16 cast quantizes ids > 256)
             x = _cast_act(x, pol.compute_dtype)
+        # sparse-embedding pre-pass (nn/sparse): coalesce the batch's
+        # touched table rows OUTSIDE the differentiated function and
+        # substitute (table -> gathered rows, ids -> row slots), so the
+        # table's cotangent is [capacity, dim] — the dense [vocab, dim]
+        # cotangent never exists in this program.  All decisions here
+        # are trace-time static (dtype/shape/conf), so the compiled
+        # program is fixed per batch signature: zero steady recompiles.
+        ctx = None
+        if sparse_emb is not None:
+            W0 = params["layer_0"]["W"]
+            ids = sparse_emb.decode_ids(x)
+            if ids is None:
+                # never a silent dense fallback: falling through here
+                # would quietly restore the O(vocab·dim) exchange the
+                # flag exists to remove
+                raise ValueError(
+                    f"layer '{sparse_emb.name}': sparse_grad=True needs "
+                    "an integer id batch for the densified pre-pass, but "
+                    f"this input (shape {tuple(x.shape)}, dtype "
+                    f"{x.dtype}) rides the one-hot path — feed ids "
+                    "(argmax the one-hots upstream), or drop sparse_grad")
+            if not _sparse.table_is_unambiguous(params, W0.shape):
+                raise ValueError(
+                    f"layer '{sparse_emb.name}': another parameter leaf "
+                    f"shares the table's exact shape {tuple(W0.shape)} — "
+                    "the row-space mirror walk is shape-keyed and cannot "
+                    "disambiguate the updater mirrors; resize/split the "
+                    "twin parameter or drop sparse_grad")
+            ctx = _sparse.RowContext(
+                W0, ids, sparse_emb.sparse_grad_capacity)
+        if ctx is not None:
+            params_in = {**params, "layer_0": dict(params["layer_0"],
+                                                   W=ctx.rows_ext)}
+            x_in = ctx.x_sub
+        else:
+            params_in, x_in = params, x
         ls = state.get(_precision.SCALE_STATE_KEY) \
             if pol is not None and pol.scaled else None
         scale = ls["scale"] if ls is not None else None
@@ -423,35 +498,66 @@ def _build_train_step(conf, tx, with_carry: bool):
                 cs = dict(jax.tree_util.tree_map(jax.lax.stop_gradient,
                                                  carries))
                 loss, new_state = _stack_loss(
-                    conf, p, state, x, y, train=True, key=key, mask=mask,
-                    label_mask=label_mask, carries=cs, precision=pol)
+                    conf, p, state, x_in, y, train=True, key=key,
+                    mask=mask, label_mask=label_mask, carries=cs,
+                    precision=pol)
             else:
                 cs = None
                 loss, new_state = _stack_loss(
-                    conf, p, state, x, y, train=True, key=key, mask=mask,
-                    label_mask=label_mask, precision=pol)
+                    conf, p, state, x_in, y, train=True, key=key,
+                    mask=mask, label_mask=label_mask, precision=pol)
             # loss scaling happens on the objective so the whole backward
             # pass sees scaled gradients (fp16 underflow protection); the
             # reported loss stays unscaled
             obj = loss * scale if scale is not None else loss
             return obj, (loss, new_state, cs)
         (_obj, (loss, new_state, new_carries)), grads = \
-            jax.value_and_grad(loss_fn, has_aux=True)(params)
+            jax.value_and_grad(loss_fn, has_aux=True)(params_in)
+        if ctx is not None:
+            # the densified carrier: coalesced row indices + values (the
+            # custom-vjp lookup's segment-summed cotangent), in place of
+            # a dense table gradient
+            grads = dict(grads)
+            grads["layer_0"] = dict(grads["layer_0"],
+                                    W=ctx.wrap_grad(grads["layer_0"]["W"]))
         finite = None
         if scale is not None:
             grads, finite = _precision.unscale_and_check(grads, scale)
         grads = apply_gradient_norm_all(grads, confs, gn_mode, gn_thr)
         # per-iteration gradient stats for listeners (reference
         # ParamAndGradientIterationListener / StatsListener): computed
-        # inside the same program so they fuse with the update
-        gleaves = jax.tree_util.tree_leaves(grads)
+        # inside the same program so they fuse with the update.  Float
+        # leaves only (_common.float_grad_leaves): SparseRows carries
+        # int32 indices, and coalesced values give the SAME norm the
+        # dense gradient would.
+        gleaves = float_grad_leaves(grads)
         gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in gleaves)) \
             if gleaves else jnp.zeros((), jnp.float32)
         glayer = {k: jnp.sqrt(sum(jnp.sum(g * g)
-                                  for g in jax.tree_util.tree_leaves(v)))
+                                  for g in float_grad_leaves(v)))
                   for k, v in grads.items() if v}
-        updates, new_opt = tx.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        if ctx is not None:
+            # lazy row-space update: the SAME optax transform runs on
+            # [capacity, dim] views — touched rows of the table and of
+            # every param-shaped mirror leaf (mu/nu/trace) — then only
+            # those rows scatter back.  Untouched rows and mirrors keep
+            # their pre-step bytes.
+            g_upd = dict(grads)
+            g_upd["layer_0"] = dict(g_upd["layer_0"],
+                                    W=g_upd["layer_0"]["W"].values)
+            p_upd = {**params, "layer_0": dict(params["layer_0"],
+                                               W=ctx.rows)}
+            opt_upd = _sparse.gather_rows_tree(opt_state, ctx)
+        else:
+            g_upd, p_upd, opt_upd = grads, params, opt_state
+        updates, new_opt = tx.update(g_upd, opt_upd, p_upd)
+        new_params = optax.apply_updates(p_upd, updates)
+        if ctx is not None:
+            new_params = {**new_params, "layer_0": dict(
+                new_params["layer_0"],
+                W=ctx.scatter_rows(params["layer_0"]["W"],
+                                   new_params["layer_0"]["W"]))}
+            new_opt = _sparse.scatter_rows_tree(opt_state, new_opt, ctx)
         new_params = apply_constraints_all(new_params, confs)
         if pol is not None:
             # keep running state (BN statistics) in f32 so the step's
@@ -459,6 +565,11 @@ def _build_train_step(conf, tx, with_carry: bool):
             new_state = _cast_floats(new_state, jnp.float32,
                                      only=pol.compute_dtype)
         gstats = {"global_norm": gnorm, "layer_norms": glayer}
+        if ctx is not None:
+            # observability: how many real table rows this step exchanged
+            # (vs the static capacity) — the densification win, visible
+            # to listeners without a host sync
+            gstats["embedding_rows_touched"] = ctx.touched()
         if ls is not None:
             new_params, new_opt, new_state, sel = _precision.overflow_skip(
                 pol, ls, finite, params, new_params, opt_state, new_opt,
@@ -527,6 +638,9 @@ class MultiLayerNetwork:
         self.shape_policy = default_shape_policy()
         self._rnn_carries = None
         self._rnn_carry_batch = -1
+        # embedding-first boundary validation cache: None = undecided,
+        # False = no id layer, else the layer conf
+        self._id_layer = None
         # did the most recent train step (re)trace?  Read from the shared
         # InstrumentedJit after each step: the metrics split
         # (training_step_seconds{phase=compile|steady}) keys off the REAL
@@ -598,6 +712,7 @@ class MultiLayerNetwork:
         sliced off the result (row-wise inference programs make this
         value-preserving; ``train=True`` skips padding — stochastic draws
         and BN batch statistics are shape-dependent)."""
+        self._validate_input_ids(x)
         x = jnp.asarray(x)
         pol = self.shape_policy
         n = -1
@@ -632,6 +747,7 @@ class MultiLayerNetwork:
             return float(self._score)   # device scalar mid-fit_on_device
         if dataset is not None:
             x, y, _, _ = self._normalize_batch(dataset)
+        self._validate_input_ids(x)
         x, y = jnp.asarray(x), jnp.asarray(y)
         lm = None
         pol = self.shape_policy
@@ -659,6 +775,7 @@ class MultiLayerNetwork:
         self._jit_cache = {}
         self._topo_sig = None
         self._pad_safe = None
+        self._id_layer = None
         return self
 
     def _get_jitted(self, kind: str):
@@ -673,6 +790,23 @@ class MultiLayerNetwork:
                 name=kind)
             self._jit_cache[kind] = fn
         return fn
+
+    def _validate_input_ids(self, x):
+        """Host-side id-range validation for embedding-first networks
+        at the fit/output/score boundary (the traced gather clamps
+        out-of-range ids silently; see ``feedforward.validate_host_ids``
+        — device-resident and float/one-hot batches pass through)."""
+        lc0 = self._id_layer
+        if lc0 is None:
+            from .layers.feedforward import (EmbeddingLayer,
+                                             EmbeddingSequenceLayer)
+            lc = self.layers[0] if self.layers else None
+            lc0 = lc if isinstance(
+                lc, (EmbeddingLayer, EmbeddingSequenceLayer)) else False
+            self._id_layer = lc0
+        if lc0:
+            from .layers.feedforward import validate_host_ids
+            validate_host_ids(lc0, x)
 
     def _pad_flags(self):
         if self._pad_safe is None:
@@ -967,6 +1101,7 @@ class MultiLayerNetwork:
         configuration.  ``tbptt_back_length`` is accepted for config parity.
         """
         del step_fn  # tbptt uses the carry-aware step
+        self._validate_input_ids(x)
         step = self._get_jitted("train_step_carry")
         pol = self.shape_policy
         pad_on = pol is not None and pol.enabled and self._pad_train_safe()
@@ -1124,6 +1259,7 @@ class MultiLayerNetwork:
 
     def _fit_one(self, x, y, m, lm) -> float:
         """One train step (shared by fit's inner loop and fit_batch)."""
+        self._validate_input_ids(x)
         step_fn = self._get_jitted("train_step")
         pol = self.shape_policy
         if pol is not None and pol.enabled and self._pad_train_safe():

@@ -172,13 +172,16 @@ def init_scale_state(policy: Optional[PrecisionPolicy]):
 
 def unscale_and_check(grads, scale):
     """Undo the loss scale on the gradient tree and report whether every
-    leaf is finite — traced into the step."""
-    import jax
+    leaf is finite — traced into the step.  Float leaves only
+    (``_common.float_grad_leaves``): a ``SparseRows`` gradient carrier
+    (``nn/sparse``) holds int32 row indices that must neither be scaled
+    nor finiteness-checked."""
     import jax.numpy as jnp
+
+    from ._common import float_grad_leaves, map_float_grads
     inv = 1.0 / scale
-    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-    checks = [jnp.all(jnp.isfinite(g))
-              for g in jax.tree_util.tree_leaves(grads)]
+    grads = map_float_grads(lambda g: g * inv, grads)
+    checks = [jnp.all(jnp.isfinite(g)) for g in float_grad_leaves(grads)]
     finite = jnp.stack(checks).all() if checks else jnp.asarray(True)
     return grads, finite
 

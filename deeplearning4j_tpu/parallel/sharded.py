@@ -53,6 +53,24 @@ loop: barrier saves at round boundaries, membership changes rebuilding
 the mesh over survivors via ``restore_sharded(mesh=survivors)``, one
 train-step trace across topology changes.
 
+Sparse embedding tables ride the same layout: a ``sparse_grad=True``
+embedding table is simply the first VERY large parameter this rule
+row-shards (``zero3_spec`` puts the vocab axis over ``data``, its
+optax mirrors included), so vocabulary size is no longer capped by one
+device's HBM.  The train step's densified pre-pass (``nn/sparse``)
+then makes the per-step exchange O(touched rows): GSPMD derives, from
+these same argument shardings, a ragged touched-row lookup — the
+replicated id blocks gather shard-locally and an O(capacity·dim)
+all-reduce returns the requested rows to every requester — and the
+backward's coalesced index+value blocks reduce back to their owner
+shards the same way, while the row scatter-update (params and
+mirrors) stays shard-local.  No hand-written collectives, no second
+trace: a dp=2 and dp=8 sparse run still share the ONE train-step
+trace, and checkpoints reshard through the same
+``save_sharded``/``restore_sharded`` per-leaf block format (the table
+is just a big leaf; dp=4 → dp=2 restores digest-exact, pinned in
+tests/test_sparse_embedding.py).
+
 The derived collective layout is GUARDED at the IR level: graftaudit
 (``tools/graftaudit``, rule AX003) compiles the canonical dp=2/dp=4
 sharded train steps from their recorded argument shardings and flags a
@@ -60,7 +78,9 @@ dense all-reduce of (near-)param bytes — the pattern that appears when
 some op defeats the GSPMD scatter/gather derivation — and
 ``tests/test_audit.py`` pins both censuses EXACTLY (golden collective
 signature), so a layout regression fails tier-1 instead of a profile
-review.
+review.  The sparse-table program has its own canonical pin:
+``train_step[embedding_zero3]``'s committed card must contain no
+collective carrying O(vocab·dim) bytes.
 """
 from __future__ import annotations
 

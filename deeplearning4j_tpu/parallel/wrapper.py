@@ -191,6 +191,10 @@ class ParallelWrapper:
         if trimmed is None:    # sub-shard batch: nothing to step on
             return m._score
         x, y, mk, lmk = trimmed
+        if hasattr(m, "_validate_input_ids"):
+            # embedding-first boundary validation (the traced gather
+            # clamps out-of-range ids silently)
+            m._validate_input_ids(x)
         put = self._put
         m._rng, key = jax.random.split(m._rng)
         m.params, m.state, m.opt_state, loss, m._last_grad_stats = \
@@ -301,6 +305,8 @@ class ParallelWrapper:
                     if trimmed is None:
                         continue
                     x, y, mk, lmk = trimmed
+                    if hasattr(m, "_validate_input_ids"):
+                        m._validate_input_ids(x)
                     m._rng, key = jax.random.split(m._rng)
                     m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
                         m.params, m.state, m.opt_state, key,

@@ -149,7 +149,7 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 26
+    assert row["rules"] == 27
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
 
@@ -231,6 +231,41 @@ def test_elastic_reshard_ms_row():
     assert row["dp_before"] == 4 and row["dp_after"] == 2
     assert row["world_before"] == 2 and row["world_after"] == 1
     assert row["steps"] == 12
+
+
+def test_embedding_grad_exchange_ms_rows():
+    """The sparse-embedding bench line (ISSUE 15): one row per
+    (vocab, touched-fraction) with the densified-exchange and
+    dense-all-reduce step times, the vs_dense ratio, and the
+    counter-verified zero-recompile steady state.  Tiny CPU config —
+    the densified-wins acceptance gate is asserted at the real bench
+    scale (vocab >= 50k, where the dense path ships a multi-MB
+    all-reduce per step); at toy vocab only the row contract and the
+    recompile counter are stable."""
+    import jax
+
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rows = B.embedding_grad_exchange_ms(vocabs=(2048,),
+                                        touched_fracs=(0.1,), dim=8,
+                                        batch=64, steps=2, warm=1)
+    assert [r["metric"] for r in rows] == [
+        "embedding_grad_exchange_ms[v=2048,t=0.1]"]
+    row = rows[0]
+    assert row["unit"].startswith("ms/step")
+    assert row["value"] > 0 and row["dense_all_reduce_ms"] > 0
+    assert row["vs_dense"] == pytest.approx(
+        row["value"] / row["dense_all_reduce_ms"], abs=2e-3)
+    assert row["densified_wins"] == (row["value"]
+                                     < row["dense_all_reduce_ms"])
+    # the exchange block is the exact static bound min(batch, vocab)
+    assert row["capacity"] == 64
+    assert row["touched_rows_max"] == 204   # 0.1 * 2048, the id pool
+    assert row["vocab"] == 2048 and row["dp"] == 8
+    # both programs compiled during warmup; the timed windows added none
+    assert row["steady_recompiles"] == 0
 
 
 def test_sharded_step_time_ms_row():

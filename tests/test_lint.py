@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX026 has at least one fixture that MUST fire and one
+Every rule JX001–JX027 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1298,6 +1298,99 @@ def test_jx026_pragma_suppresses():
                                                 _NN_PATH)}
 
 
+# ---------------------------------------------------------------- JX027
+def test_jx027_positive_one_hot_matmul_lookup():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.nn import one_hot
+
+        def lookup(ids, W, vocab):
+            a = jax.nn.one_hot(ids, vocab) @ W          # dense lookup
+            b = one_hot(ids, vocab).T @ W               # transposed form
+            c = W.T @ jax.nn.one_hot(ids, vocab)        # right operand
+            return a + b.T + c.T
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX027" for f in fs) == 3
+
+
+def test_jx027_positive_full_vocab_zeros_scatter():
+    src = """
+        import jax.numpy as jnp
+
+        def dense_grad(rows, idx, n_in, dim):
+            direct = jnp.zeros((n_in, dim)).at[idx].add(rows)
+            buf = jnp.zeros((vocab_size, dim))
+            hop = buf.at[idx].set(rows)                 # one-hop name
+            return direct + hop
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX027" for f in fs) == 2
+
+
+def test_jx027_positive_module_scope_and_jax_nn_import():
+    # the two coverage gaps a review closed: `from jax import nn`
+    # spells the same dense lookup, and a module/class-level scatter
+    # is as dense as a function-local one
+    src = """
+        import jax.numpy as jnp
+        from jax import nn
+
+        DENSE = jnp.zeros((vocab_size, 16)).at[IDX].add(ROWS)
+
+        class Table:
+            cache = jnp.zeros((n_in, 8)).at[IDS].set(VALS)
+
+        def lookup(ids, W, vocab):
+            return nn.one_hot(ids, vocab) @ W
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX027" for f in fs) == 3
+
+
+def test_jx027_negative_gather_and_small_buffers():
+    # the gather path, a non-vocab zeros scatter, a one_hot without a
+    # matmul, and a named one-hot matmul (kmeans' deliberate MXU
+    # centroid sum) all stay legal
+    assert "JX027" not in rules_at("""
+        import jax
+        import jax.numpy as jnp
+
+        def ok(ids, W, points, bins, batch):
+            z = W[ids]                                   # gather lookup
+            hist = jnp.zeros((bins,)).at[ids].add(1.0)   # not vocab-sized
+            oh = jax.nn.one_hot(ids, 4)                  # no matmul
+            sums = oh.T @ points                         # named operand
+            return z, hist, sums
+    """, _NN_PATH)
+
+
+def test_jx027_negative_test_modules_out_of_scope():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def test_dense_reference(ids, W, vocab):
+            return jax.nn.one_hot(ids, vocab) @ W
+    """
+    for path in ("tests/test_embed.py", "tests/conftest.py"):
+        assert "JX027" not in rules_at(src, path)
+
+
+def test_jx027_pragma_suppresses():
+    src = """
+        import jax.numpy as jnp
+
+        def to_dense(rows, idx, n_rows, dim):
+            dense = jnp.zeros((n_rows, dim))
+            return dense.at[idx].add(rows)  # graftlint: disable=JX027  (documented host-side interop densification)
+    """
+    assert "JX027" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _NN_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2352,7 +2445,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 22
+    assert len(RULES) == 23
     assert len(PROGRAM_RULES) == 4
 
 

@@ -1,0 +1,516 @@
+"""Sparse embedding gradients (ISSUE 15): the densified row exchange.
+
+Four layers of coverage:
+
+* **carrier units** — ``SparseRows`` pytree round-trip, the int32
+  coalesce (sorted unique + slot map, x64-stable), the custom-vjp
+  lookup whose backward is one segment-sum, the capacity contract.
+* **parity** — the sparse path is BIT-IDENTICAL to the dense path on
+  the replicated trainer (params AND updater state after N steps), and
+  the lazy row-space updater's one deliberate deviation (untouched-row
+  mirrors keep their bytes instead of decaying) is pinned explicitly.
+* **sharded** — replicated-sparse == sharded-sparse bitwise at a fixed
+  global batch, the row-sharded table + mirrors round-trip
+  ``save_sharded``/``restore_sharded`` across dp=4 → dp=2 with exact
+  digests, and ONE trace serves every mesh size with zero steady-state
+  recompiles (the counter half of the ISSUE 15 acceptance line; the
+  IR half — no O(vocab·dim) collective — is pinned in test_audit.py).
+* **layer contract** — the id-path validation satellites: float ids
+  raise ``InvalidInputError`` instead of truncating, concrete
+  out-of-range ids are refused, and the sequence layer's one-hot input
+  decodes to a gather with the matmul as an explicit opt-in.
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.faulttolerance.checkpoint import CheckpointManager
+from deeplearning4j_tpu.nn import sparse as S
+from deeplearning4j_tpu.nn.conf.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.layers.feedforward import (EmbeddingLayer,
+                                                      EmbeddingSequenceLayer,
+                                                      OutputLayer)
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.observability.registry import default_registry
+from deeplearning4j_tpu.parallel import (ParallelWrapper, ShardedTrainer,
+                                         make_mesh)
+from deeplearning4j_tpu.parallel.inference import InvalidInputError
+
+VOCAB, DIM, CLASSES = 48, 8, 4
+
+
+def embed_net(sparse=True, updater=None, vocab=VOCAB, cap=None, seed=7,
+              l2=None):
+    lb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(updater or Sgd(learning_rate=0.1)).list())
+    lb.layer(EmbeddingLayer(n_in=vocab, n_out=DIM, sparse_grad=sparse,
+                            sparse_grad_capacity=cap, l2=l2))
+    lb.layer(OutputLayer(n_out=CLASSES, activation="softmax",
+                         loss="mcxent"))
+    return MultiLayerNetwork(lb.build()).init()
+
+
+def seq_net(sparse=True, seed=9, timesteps=6, vocab=VOCAB):
+    lb = (NeuralNetConfiguration.builder().seed(seed)
+          .updater(Sgd(learning_rate=0.1)).list())
+    lb.layer(EmbeddingSequenceLayer(n_in=vocab, n_out=DIM,
+                                    sparse_grad=sparse))
+    lb.layer(RnnOutputLayer(n_out=CLASSES, activation="softmax",
+                            loss="mcxent"))
+    conf = lb.set_input_type(
+        InputType.recurrent(vocab, timesteps)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def batch(n=16, vocab=VOCAB, seed=0, dupes=True):
+    rng = np.random.default_rng(seed)
+    hi = vocab // 3 if dupes else vocab   # a third of the vocab: dupes
+    idx = rng.integers(0, hi, (n, 1)).astype(np.int32)
+    y = np.eye(CLASSES, dtype=np.float32)[idx[:, 0] % CLASSES]
+    return idx, y
+
+
+def leaves(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def assert_trees_equal(a, b):
+    la, lb = leaves(a), leaves(b)
+    assert len(la) == len(lb)
+    for x, z in zip(la, lb):
+        np.testing.assert_array_equal(np.array(x), np.array(z))
+
+
+def digests(params):
+    out = {}
+    for lname in sorted(params):
+        for pname in sorted(params[lname]):
+            a = np.ascontiguousarray(np.array(params[lname][pname]))
+            out[f"{lname}/{pname}"] = \
+                hashlib.sha256(a.tobytes()).hexdigest()
+    return out
+
+
+def compiles():
+    c = default_registry().get("training_compile_total")
+    return 0.0 if c is None else c.labels("train_step").value
+
+
+# ------------------------------------------------------------ carrier units
+def test_sparse_rows_pytree_and_to_dense():
+    sr = S.SparseRows(jnp.array([1, 3, 8], jnp.int32),
+                      jnp.arange(6.0, dtype=jnp.float32).reshape(3, 2),
+                      n_rows=8)   # index 8 == n_rows: a fill slot
+    flat, treedef = jax.tree_util.tree_flatten(sr)
+    assert len(flat) == 2                      # indices + values
+    back = jax.tree_util.tree_unflatten(treedef, flat)
+    assert back.n_rows == 8 and back.capacity == 3 and back.dim == 2
+    dense = np.array(sr.to_dense())
+    assert dense.shape == (8, 2)
+    np.testing.assert_array_equal(dense[1], [0.0, 1.0])
+    np.testing.assert_array_equal(dense[3], [2.0, 3.0])
+    assert dense.sum() == pytest.approx(0 + 1 + 2 + 3)   # fill dropped
+    assert int(sr.touched()) == 2
+
+
+def test_coalesce_sorts_dedupes_and_maps_every_position():
+    ids = jnp.array([[9, 2], [9, 5], [2, 2]], jnp.int32)
+    uniq, inv = S.coalesce(ids, capacity=5, n_rows=16)
+    np.testing.assert_array_equal(np.array(uniq), [2, 5, 9, 16, 16])
+    assert uniq.dtype == jnp.int32 and inv.dtype == jnp.int32
+    assert inv.shape == ids.shape
+    np.testing.assert_array_equal(np.array(uniq)[np.array(inv)],
+                                  np.array(ids))
+
+
+def test_embedding_lookup_backward_is_the_dense_gather_grad():
+    rng = np.random.default_rng(3)
+    W = jnp.asarray(rng.standard_normal((10, 4)).astype(np.float32))
+    idx = jnp.array([7, 1, 7, 0], jnp.int32)
+    ct = jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32))
+
+    def via_custom(w):
+        return jnp.sum(S.embedding_lookup(w, idx) * ct)
+
+    def via_gather(w):
+        return jnp.sum(w[idx] * ct)
+
+    np.testing.assert_allclose(np.array(jax.grad(via_custom)(W)),
+                               np.array(jax.grad(via_gather)(W)),
+                               rtol=0, atol=0)
+
+
+def test_effective_capacity_contract():
+    assert S.effective_capacity(16, 1000) == 16        # n_ids bound
+    assert S.effective_capacity(5000, 48) == 48        # vocab bound
+    assert S.effective_capacity(16, 1000, 64) == 64    # pad up: fine
+    assert S.effective_capacity(16, 48, 64) == 48      # clamped to vocab
+    with pytest.raises(ValueError, match="sparse_grad_capacity"):
+        S.effective_capacity(16, 1000, 8)              # undersized: refuse
+
+
+# ------------------------------------------------------------------ parity
+@pytest.mark.parametrize("updater", [Sgd(learning_rate=0.1),
+                                     Adam(learning_rate=0.05)])
+def test_sparse_matches_dense_bitwise_on_replicated_trainer(updater):
+    """The acceptance parity: same seed, same batches (with duplicate
+    ids), N steps — params AND updater state bit-identical to the dense
+    path.  (Adam stays exact here because the touched set is constant
+    across steps; the varying-touch lazy deviation is pinned below.)"""
+    idx, y = batch(seed=11)
+    import copy
+    a = embed_net(sparse=False, updater=copy.deepcopy(updater))
+    b = embed_net(sparse=True, updater=copy.deepcopy(updater))
+    for _ in range(4):
+        a.fit(idx, y)
+        b.fit(idx, y)
+    assert a.get_score() == b.get_score()
+    assert_trees_equal(a.params, b.params)
+    assert_trees_equal(a.opt_state, b.opt_state)
+
+
+def test_sequence_layer_sparse_matches_dense_bitwise():
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, VOCAB, (8, 6)).astype(np.int32)
+    y = np.eye(CLASSES, dtype=np.float32)[
+        rng.integers(0, CLASSES, (8, 6))].astype(np.float32)
+    a, b = seq_net(sparse=False), seq_net(sparse=True)
+    for _ in range(3):
+        a.fit(ids, y)
+        b.fit(ids, y)
+    assert_trees_equal(a.params, b.params)
+
+
+def test_lazy_updater_semantics_pinned():
+    """The ONE deliberate deviation from dense updater math: a row's
+    Adam mirrors decay every dense step even with zero gradient, but
+    the lazy row-space update leaves untouched rows' mirrors
+    bit-untouched.  Pinned so the trade is explicit, not accidental."""
+    def table_mirrors(net):
+        return [l for l in leaves(net.opt_state)
+                if getattr(l, "shape", None) == (VOCAB, DIM)]
+
+    touch_0 = np.zeros((4, 1), np.int32)          # row 0 only
+    touch_1 = np.ones((4, 1), np.int32)           # row 1 only
+    y = np.eye(CLASSES, dtype=np.float32)[np.zeros(4, np.int64)]
+    dense = embed_net(sparse=False, updater=Adam(learning_rate=0.05),
+                      seed=21)
+    lazy = embed_net(sparse=True, updater=Adam(learning_rate=0.05),
+                     seed=21)
+    for net in (dense, lazy):
+        net.fit(touch_0, y)                       # row 0 gets real mu/nu
+    after_first = [np.array(m) for m in table_mirrors(lazy)]
+    assert any(np.abs(m[0]).sum() > 0 for m in after_first)
+    for net in (dense, lazy):
+        net.fit(touch_1, y)                       # row 0 now untouched
+    for before, after in zip(after_first, table_mirrors(lazy)):
+        np.testing.assert_array_equal(before[0], np.array(after)[0])
+    # ...while dense Adam decayed row 0's first moment
+    dense_mu = [np.array(m) for m in table_mirrors(dense)]
+    lazy_mu = [np.array(m) for m in table_mirrors(lazy)]
+    assert any(np.abs(d[0] - l[0]).max() > 0
+               for d, l in zip(dense_mu, lazy_mu))
+
+
+def test_rows_touched_stat_rides_gstats():
+    idx = np.array([[3], [3], [5], [9]], np.int32)
+    y = np.eye(CLASSES, dtype=np.float32)[np.zeros(4, np.int64)]
+    net = embed_net(sparse=True)
+    net.fit(idx, y)
+    assert int(net._last_grad_stats["embedding_rows_touched"]) == 3
+
+
+def test_traced_invalid_ids_never_corrupt_other_rows():
+    """Device-resident batches bypass the host boundary validation (a
+    prefetch pipeline's producer validates; materializing here would
+    stall the overlap), so the coalesce must defang invalid ids on the
+    traced path too: a negative id must NOT wrap into a write of the
+    last row, and an id >= vocab must not un-sort the slot map and
+    misattribute gradient.  Pinned behavior: invalid positions read the
+    clamp row forward and shed their gradient — only validly-touched
+    rows change."""
+    vocab = 10
+    net = embed_net(sparse=True, vocab=vocab)
+    W0 = np.array(jax.device_get(net.params["layer_0"]["W"]))
+    # jnp array = device-resident: skips the host boundary check, so
+    # the invalid ids genuinely reach the compiled step
+    ids = jnp.asarray([[-1], [vocab + 2], [3]], jnp.int32)
+    y = np.eye(CLASSES, dtype=np.float32)[np.zeros(3, np.int64)]
+    net.fit(ids, y)
+    W1 = np.array(jax.device_get(net.params["layer_0"]["W"]))
+    changed = [r for r in range(vocab)
+               if np.abs(W1[r] - W0[r]).max() > 0]
+    assert changed == [3]     # not row 9 (wrap), not row 0 (clamp)
+    assert int(net._last_grad_stats["embedding_rows_touched"]) == 1
+
+
+def test_scatter_rows_tree_leaves_integer_table_shaped_state_alone():
+    """With capacity == vocab the row-block shape equals the table
+    shape; a table-shaped INTEGER state leaf that gather_rows_tree
+    passed through must come back from scatter_rows_tree untouched,
+    not row-permuted through uniq."""
+    W = jnp.arange(12.0, dtype=jnp.float32).reshape(6, 2)
+    ids = jnp.array([5, 1, 5, 0, 2, 3], jnp.int32)
+    ctx = S.RowContext(W, ids, configured_capacity=6)   # cap == vocab
+    assert ctx.capacity == 6
+    tree = {"mu": jnp.ones((6, 2), jnp.float32),
+            "steps": jnp.arange(12, dtype=jnp.int32).reshape(6, 2)}
+    row_view = S.gather_rows_tree(tree, ctx)
+    np.testing.assert_array_equal(np.array(row_view["steps"]),
+                                  np.array(tree["steps"]))
+    back = S.scatter_rows_tree(tree, row_view, ctx)
+    np.testing.assert_array_equal(np.array(back["steps"]),
+                                  np.array(tree["steps"]))
+    np.testing.assert_array_equal(np.array(back["mu"]),
+                                  np.array(tree["mu"]))
+
+
+# ---------------------------------------------------------------- capacity
+def test_undersized_capacity_refused_at_trace_time():
+    idx, y = batch()
+    net = embed_net(sparse=True, cap=4)           # 16 ids > 4 slots
+    with pytest.raises(ValueError, match="sparse_grad_capacity"):
+        net.fit(idx, y)
+
+
+def test_padded_capacity_matches_exact_capacity_bitwise():
+    idx, y = batch(seed=13)
+    auto = embed_net(sparse=True)                 # cap = min(n_ids, vocab)
+    padded = embed_net(sparse=True, cap=VOCAB)    # padded block
+    for _ in range(3):
+        auto.fit(idx, y)
+        padded.fit(idx, y)
+    assert_trees_equal(auto.params, padded.params)
+
+
+def test_sparse_grad_off_first_layer_is_a_clear_error():
+    lb = (NeuralNetConfiguration.builder().seed(3)
+          .updater(Sgd(learning_rate=0.1)).list())
+    lb.layer(EmbeddingLayer(n_in=8, n_out=4))
+    lb.layer(EmbeddingLayer(n_in=8, n_out=4, sparse_grad=True))
+    lb.layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+    net = MultiLayerNetwork(lb.build()).init()
+    with pytest.raises(ValueError, match="first layer"):
+        net.fit(np.zeros((4, 1), np.int32),
+                np.eye(2, dtype=np.float32)[np.zeros(4, np.int64)])
+
+
+def test_sparse_grad_on_later_layer_rejected_even_with_sparse_layer0():
+    """The whole stack is scanned: a valid sparse layer_0 must not let
+    a later layer's flag slip through to a silent dense fallback."""
+    lb = (NeuralNetConfiguration.builder().seed(3)
+          .updater(Sgd(learning_rate=0.1)).list())
+    lb.layer(EmbeddingLayer(n_in=16, n_out=4, sparse_grad=True))
+    lb.layer(EmbeddingLayer(n_in=4, n_out=8, sparse_grad=True))
+    lb.layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+    net = MultiLayerNetwork(lb.build()).init()
+    with pytest.raises(ValueError, match="first layer"):
+        net.fit(np.zeros((4, 1), np.int32),
+                np.eye(2, dtype=np.float32)[np.zeros(4, np.int64)])
+
+
+def test_out_of_range_ids_refused_at_every_entry_point():
+    """The range contract is reachable from the REAL entry points — not
+    just eager layer.apply: fit / output / score / the parallel wrapper
+    all validate concrete host batches before dispatch (the traced
+    gather would clamp silently), for dense and sparse tables alike."""
+    bad = np.array([[3], [77]], np.int32)
+    y = np.eye(CLASSES, dtype=np.float32)[np.zeros(2, np.int64)]
+    for sparse in (False, True):
+        net = embed_net(sparse=sparse, vocab=10)
+        with pytest.raises(InvalidInputError, match="out of range"):
+            net.fit(bad, y)
+        with pytest.raises(InvalidInputError, match="out of range"):
+            net.output(bad)
+        with pytest.raises(InvalidInputError, match="out of range"):
+            net.score(x=bad, y=y)
+    pw = ParallelWrapper(embed_net(sparse=True, vocab=10),
+                         make_mesh(dp=2))
+    with pytest.raises(InvalidInputError, match="out of range"):
+        pw.fit(bad, y)
+
+
+def test_sparse_grad_on_computation_graph_is_a_clear_error():
+    """No silent dense fallback on the graph runtime either: the
+    densified pre-pass is wired into the MLN train step only, so a
+    graph vertex with sparse_grad=True must refuse at build time."""
+    from deeplearning4j_tpu.nn.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.computation_graph import GraphBuilder
+
+    g = GraphBuilder({"updater": Sgd(learning_rate=0.1)})
+    g.add_inputs("ids").set_input_types(InputType.feed_forward(1))
+    g.add_layer("emb", EmbeddingLayer(n_in=16, n_out=4,
+                                      sparse_grad=True), "ids")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "emb")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    with pytest.raises(ValueError, match="MultiLayerNetwork"):
+        net.fit([np.zeros((4, 1), np.int32)],
+                [np.eye(2, dtype=np.float32)[np.zeros(4, np.int64)]])
+
+
+def test_sparse_grad_one_hot_input_is_a_clear_error():
+    """A sparse_grad table fed one-hot batches must refuse, not quietly
+    train dense (the O(vocab·dim) exchange the flag removes)."""
+    net = embed_net(sparse=True, vocab=8)
+    oh = np.eye(8, dtype=np.float32)[np.zeros(4, np.int64)]
+    y = np.eye(CLASSES, dtype=np.float32)[np.zeros(4, np.int64)]
+    with pytest.raises(ValueError, match="integer id batch"):
+        net.fit(oh, y)
+
+
+def test_sparse_grad_with_l2_is_a_clear_error():
+    net = embed_net(sparse=True, l2=1e-4)
+    idx, y = batch()
+    with pytest.raises(ValueError, match="l1/l2"):
+        net.fit(idx, y)
+
+
+# ------------------------------------------------------------------ sharded
+needs_devices = pytest.mark.skipif(len(jax.devices()) < 8,
+                                   reason="needs 8 virtual devices")
+
+
+@needs_devices
+@pytest.mark.parametrize("dp", [2, 4])
+def test_sharded_sparse_matches_replicated_sparse_bitwise(dp):
+    idx, y = batch(seed=17)
+    a = embed_net(sparse=True, updater=Adam(learning_rate=0.05), seed=31)
+    b = embed_net(sparse=True, updater=Adam(learning_rate=0.05), seed=31)
+    mesh = make_mesh(dp=dp)
+    pw = ParallelWrapper(a, mesh)
+    st = ShardedTrainer(b, mesh, min_shard_size=0)
+    for _ in range(3):
+        pw.fit(idx, y)
+        st.fit(idx, y)
+    assert_trees_equal(a.params, b.params)
+    assert_trees_equal(a.opt_state, b.opt_state)
+    # the table really is row-sharded, not replicated
+    spec = str(b.params["layer_0"]["W"].sharding.spec)
+    assert "data" in spec
+
+
+@needs_devices
+def test_sharded_table_and_mirrors_reshard_across_dp(tmp_path):
+    """save_sharded on dp=4, restore onto dp=2: the row-sharded table
+    AND its Adam mirrors round-trip with exact digests, and training
+    continues on the new mesh (the issue's checkpoint satellite)."""
+    idx, y = batch(seed=19)
+    net = embed_net(sparse=True, updater=Adam(learning_rate=0.05),
+                    seed=37)
+    st = ShardedTrainer(net, make_mesh(dp=4), min_shard_size=0)
+    for _ in range(3):
+        st.fit(idx, y)
+    mgr = CheckpointManager(str(tmp_path / "store"), background=False)
+    mgr.save_sharded(net, step=3)
+    want = digests(net.params)
+    opt_want = [np.array(l) for l in leaves(net.opt_state)]
+    net2, _ = mgr.restore_sharded(mesh=make_mesh(dp=2), min_shard_size=0)
+    assert digests(net2.params) == want
+    for a, b in zip(opt_want, leaves(net2.opt_state)):
+        np.testing.assert_array_equal(a, np.array(b))
+    st2 = ShardedTrainer(net2, make_mesh(dp=2), min_shard_size=0)
+    st2.fit(idx, y)
+    assert np.isfinite(net2.get_score())
+
+
+@needs_devices
+def test_one_trace_zero_steady_recompiles_across_mesh_sizes():
+    """The counter half of the ISSUE 15 acceptance: the sparse train
+    step traces ONCE (sharding lives in the arguments) and steady-state
+    fitting — replicated and sharded, any dp — adds zero recompiles."""
+    idx, y = batch(seed=23)
+    before = compiles()
+    nets = [embed_net(sparse=True, seed=41, vocab=64) for _ in range(3)]
+    ShardedTrainer(nets[0], make_mesh(dp=2), min_shard_size=0).fit(idx, y)
+    ShardedTrainer(nets[1], make_mesh(dp=4), min_shard_size=0).fit(idx, y)
+    ParallelWrapper(nets[2], make_mesh(dp=8)).fit(idx, y)
+    assert compiles() - before == 1
+    steady = compiles()
+    for _ in range(4):
+        ShardedTrainer(nets[1], make_mesh(dp=4),
+                       min_shard_size=0).fit(idx, y)
+    assert compiles() - steady == 0
+
+
+# ----------------------------------------------------------- layer contract
+def test_embedding_layer_float_ids_raise_not_truncate():
+    lc = EmbeddingLayer(n_in=8, n_out=4, name="emb")
+    v = lc.init(jax.random.PRNGKey(0), None)
+    with pytest.raises(InvalidInputError, match="integer"):
+        lc.apply(v, jnp.asarray([[1.7], [2.2]], jnp.float32))
+
+
+def test_embedding_layer_out_of_range_concrete_ids_refused():
+    lc = EmbeddingLayer(n_in=8, n_out=4, name="emb")
+    v = lc.init(jax.random.PRNGKey(0), None)
+    with pytest.raises(InvalidInputError, match="out of range"):
+        lc.apply(v, jnp.asarray([[3], [8]], jnp.int32))
+    with pytest.raises(InvalidInputError, match="out of range"):
+        lc.apply(v, jnp.asarray([[-1], [2]], jnp.int32))
+
+
+def test_embedding_layer_id_column_and_one_hot_still_work():
+    lc = EmbeddingLayer(n_in=8, n_out=4, name="emb", has_bias=False)
+    v = lc.init(jax.random.PRNGKey(0), None)
+    ids = jnp.asarray([[3], [5]], jnp.int32)
+    by_id, _ = lc.apply(v, ids)
+    one_hot = jax.nn.one_hot(ids[:, 0], 8, dtype=jnp.float32)
+    by_oh, _ = lc.apply(v, one_hot)
+    np.testing.assert_array_equal(np.array(by_id), np.array(by_oh))
+    # n_in == 1 with a [b, 1] float column: the historically ambiguous
+    # shape now fails loudly instead of truncating float "ids"
+    amb = EmbeddingLayer(n_in=1, n_out=4, name="amb")
+    va = amb.init(jax.random.PRNGKey(1), None)
+    with pytest.raises(InvalidInputError, match="integer"):
+        amb.apply(va, jnp.asarray([[0.9], [0.1]], jnp.float32))
+
+
+def test_embedding_sequence_layer_validates_ids():
+    lc = EmbeddingSequenceLayer(n_in=8, n_out=4, name="seq")
+    v = lc.init(jax.random.PRNGKey(0), None)
+    with pytest.raises(InvalidInputError, match="integer"):
+        lc.apply(v, jnp.asarray([[0.5, 1.5]], jnp.float32))
+    with pytest.raises(InvalidInputError, match="out of range"):
+        lc.apply(v, jnp.asarray([[1, 9]], jnp.int32))
+
+
+def test_embedding_sequence_vocab_mismatch_is_a_clear_error():
+    """A 3-D input whose trailing dim disagrees with the vocabulary
+    (stale tokenizer / wrong vocab size) fails at the API boundary, not
+    as a cryptic dot_general shape error deep in the trace."""
+    lc = EmbeddingSequenceLayer(n_in=48, n_out=4, name="seq")
+    v = lc.init(jax.random.PRNGKey(0), None)
+    bad = jnp.zeros((2, 5, 47), jnp.float32)
+    with pytest.raises(InvalidInputError, match="vocabulary is 48"):
+        lc.apply(v, bad)
+    mm = EmbeddingSequenceLayer(n_in=48, n_out=4, name="mm",
+                                one_hot_matmul=True)
+    with pytest.raises(InvalidInputError, match="vocabulary is 48"):
+        mm.apply(v, bad)
+
+
+def test_embedding_sequence_one_hot_decodes_to_gather():
+    """Satellite: an exactly-one-hot [b, t, v] input rides the gather
+    (bit-equal to the id path in f32), and the dense matmul survives
+    only as the explicit one_hot_matmul opt-in — where it computes the
+    same values for exact one-hots."""
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 8, (3, 5)).astype(np.int32)
+    oh = np.eye(8, dtype=np.float32)[ids]
+    gather_lc = EmbeddingSequenceLayer(n_in=8, n_out=4, name="g")
+    matmul_lc = EmbeddingSequenceLayer(n_in=8, n_out=4, name="m",
+                                       one_hot_matmul=True)
+    v = gather_lc.init(jax.random.PRNGKey(0), None)
+    by_ids, _ = gather_lc.apply(v, jnp.asarray(ids))
+    by_oh, _ = gather_lc.apply(v, jnp.asarray(oh))
+    by_mm, _ = matmul_lc.apply(v, jnp.asarray(oh))
+    np.testing.assert_array_equal(np.array(by_ids), np.array(by_oh))
+    np.testing.assert_array_equal(np.array(by_oh), np.array(by_mm))

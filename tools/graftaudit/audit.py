@@ -41,6 +41,11 @@ class AuditConfig:
     broadcast_bytes: int = 64 << 20
     #: AX006: ... and must also be this multiple of its operand
     broadcast_ratio: int = 8
+    #: AX003(b): duplicate all-gathers below this result size are noise
+    #: (XLA re-gathers tiny index blocks inside separate fusions rather
+    #: than CSE'ing across them — e.g. the sparse-embedding id blocks);
+    #: the arm targets duplicated PARAM-leaf gathers, which dwarf this
+    dup_gather_bytes: int = 1024
     #: "auto" compiles every program (census + flops + temp bytes,
     #: degrading to jaxpr-only when XLA refuses); "never" stays at the
     #: jaxpr phase (fast unit tests)

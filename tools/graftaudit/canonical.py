@@ -34,9 +34,14 @@ CANONICAL_CONFIG = AuditConfig(min_donate_bytes=256)
 CANONICAL_PROGRAM_NAMES = (
     "train_step[dense]", "train_step[zero3,dp=2]", "train_step[zero3,dp=4]",
     "train_step[bf16]", "train_step[f16]", "serve", "prefill", "decode",
+    "train_step[embedding_zero3]",
 )
 
 _FEATURES, _CLASSES, _HIDDEN, _BATCH = 16, 8, 32, 8
+#: the sparse-embedding canonical program's table: big enough that a
+#: dense [vocab, dim] collective would dwarf every legitimate
+#: touched-rows block (the no-dense-exchange pin in tests/test_audit.py)
+EMBED_VOCAB, EMBED_DIM = 256, 8
 
 
 def _mlp(precision: Optional[str] = None, seed: int = 19):
@@ -182,6 +187,46 @@ def build_canonical(include: Optional[Sequence[str]] = None,
                         "builder deliberately skips donate_argnums there "
                         "(nn/multilayer._build_stack_fn 'serve' branch) — "
                         "on TPU the padded batch IS donated"))
+        if want("train_step[embedding_zero3]"):
+            # the first structurally-sparse parameter: a sparse_grad
+            # embedding table row-sharded over dp=2 — the program whose
+            # card pins that NO collective carries O(vocab·dim) bytes
+            # (the densified touched-rows exchange, arxiv 1905.04035,
+            # derived by GSPMD from the zero3 argument shardings)
+            if len(jax.devices()) < 2:
+                skipped["train_step[embedding_zero3]"] = \
+                    f"needs >= 2 devices, have {len(jax.devices())}"
+            else:
+                import numpy as np
+
+                from deeplearning4j_tpu import (InputType,
+                                                MultiLayerNetwork,
+                                                NeuralNetConfiguration)
+                from deeplearning4j_tpu.nn.conf.updaters import Adam
+                from deeplearning4j_tpu.nn.layers.feedforward import (
+                    EmbeddingLayer, OutputLayer)
+                from deeplearning4j_tpu.parallel import (ShardedTrainer,
+                                                         make_mesh)
+
+                lb = (NeuralNetConfiguration.builder().seed(23)
+                      .updater(Adam(learning_rate=0.02)).list())
+                lb.layer(EmbeddingLayer(n_in=EMBED_VOCAB, n_out=EMBED_DIM,
+                                        sparse_grad=True))
+                lb.layer(OutputLayer(n_out=_CLASSES,
+                                     activation="softmax", loss="mcxent"))
+                net_e = MultiLayerNetwork(lb.build()).init()
+                rng = np.random.default_rng(7)
+                ids = rng.integers(0, EMBED_VOCAB,
+                                   (_BATCH, 1)).astype(np.int32)
+                ye = np.eye(_CLASSES, dtype=np.float32)[
+                    rng.integers(0, _CLASSES, _BATCH)]
+                st_e = ShardedTrainer(net_e, make_mesh(dp=2),
+                                      min_shard_size=0)
+                st_e.fit(ids, ye)
+                entry_e = net_e._get_jitted("train_step")
+                programs.append(AuditProgram(
+                    "train_step[embedding_zero3]", entry_e,
+                    _pick_spec(entry_e, 2), zero3=True))
         # the two low-precision variants: bf16 (no scaling) and f16
         # (dynamic loss scaling — its traced unscale/overflow-skip path
         # is where cast churn would live)

@@ -179,6 +179,10 @@ def ax003(ir_prog) -> List[Finding]:
     for c in ir_prog.collective_ops:
         if c.op != "all-gather" or not c.operands:
             continue
+        if c.result_bytes < ir_prog.config.dup_gather_bytes:
+            # tiny re-gathered index blocks (XLA skips cross-fusion CSE
+            # on them) are not the duplicated-param-gather regression
+            continue
         key = (c.operands, tuple(c.shapes))
         seen[key] = seen.get(key, 0) + 1
     for (operands, shapes), n in sorted(seen.items()):

@@ -1,6 +1,6 @@
 """graftlint rule implementations.
 
-Module-local rules JX001–JX017 and JX022–JX024 are functions ``rule(info:
+Module-local rules JX001–JX017 and JX022–JX027 are functions ``rule(info:
 ModuleInfo) -> list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
 machinery in ``analysis.py`` (memoized per module, so every rule runs off
 one parse and one tree walk).  The whole-program concurrency pack
@@ -1358,6 +1358,141 @@ def jx026(info: ModuleInfo) -> List[Finding]:
                 "compiled form); outside jit it is a stray debug "
                 "statement — remove it, or pragma a deliberate "
                 "callback with its justification"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX027
+# scope: every non-test package module — the AST-side complement of the
+# nn/sparse densified embedding-gradient path: both source spellings of
+# a dense-materialized embedding gradient.  The IR-side pin is the
+# graftaudit `train_step[embedding_zero3]` card (no O(vocab·dim)
+# collective); this rule stops the source line at review time.
+_JX027_VOCAB_NAME_RE = re.compile(
+    r"(^|_)(n_in|vocab|vocab_size|n_rows|num_embeddings|table_size|"
+    r"n_tokens)$", re.IGNORECASE)
+_JX027_SCATTER_METHS = frozenset(("add", "set"))
+
+
+def _jx027_is_one_hot_call(info: ModuleInfo, node: ast.AST,
+                           bare: set, nn_mods: set) -> bool:
+    """Is ``node`` a call to jax's one_hot (dotted through a jax/jnp
+    alias or a ``jax.nn`` module alias, or imported bare from jax.nn),
+    possibly behind a transpose (``one_hot(...).T``)?"""
+    if isinstance(node, ast.Attribute) and node.attr in ("T", "mT"):
+        node = node.value
+    if not isinstance(node, ast.Call):
+        return False
+    fname = call_name(node)
+    if not fname:
+        return False
+    parts = fname.split(".")
+    if len(parts) == 1:
+        return parts[0] in bare
+    return parts[-1] == "one_hot" and \
+        parts[0] in (info.jax_aliases | info.jnp_aliases | nn_mods)
+
+
+def _jx027_vocabish_zeros(info: ModuleInfo, node: ast.AST) -> bool:
+    """Is ``node`` a ``zeros((vocabish, ...))`` call — a jnp/np zeros
+    whose FIRST shape element is a name spelled like a vocabulary size
+    (n_in / vocab / num_embeddings / ...)?"""
+    if not isinstance(node, ast.Call) or not node.args:
+        return False
+    fname = call_name(node)
+    if not fname:
+        return False
+    parts = fname.split(".")
+    if parts[-1] != "zeros" or len(parts) < 2 or parts[0] not in (
+            info.jnp_aliases | info.numpy_aliases | info.jax_aliases):
+        return False
+    shape = node.args[0]
+    first = shape.elts[0] if isinstance(shape, (ast.Tuple, ast.List)) \
+        and shape.elts else shape
+    name = dotted_name(first)
+    if not name:
+        return False
+    return bool(_JX027_VOCAB_NAME_RE.search(name.split(".")[-1]))
+
+
+@rule("JX027", "dense-materialized embedding gradient: one_hot(...) @ W "
+               "lookup, or a full-vocab zeros scatter target, in a "
+               "non-test package module")
+def jx027(info: ModuleInfo) -> List[Finding]:
+    """Both source spellings that materialize an O(vocab·dim) dense
+    tensor for what is a row-sparse lookup/gradient: (a) an embedding
+    lookup written as ``jax.nn.one_hot(ids, vocab) @ W`` — the matmul
+    is O(batch·vocab·dim) MXU work AND its backward builds the dense
+    one-hot cotangent, where a gather is O(batch·dim) and the sparse
+    path exchanges only touched rows; (b) a gradient/update accumulated
+    by scattering into a full-vocab ``jnp.zeros((n_in, ...))`` buffer
+    (direct chain or a one-hop assigned name) — exactly the dense
+    cotangent ``nn/sparse`` exists to avoid.  Use the embedding layers'
+    gather path (``sparse_grad=True`` for the densified exchange);
+    a deliberate dense materialization (a host-side test/interop
+    conversion like ``SparseRows.to_dense``) carries a pragma with its
+    justification.  Test modules are out of scope."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if _JX026_TEST_PATH_RE.search(path):
+        return out
+    bare_one_hot: set = set()
+    nn_mods: set = set()
+    for node in info.nodes(ast.ImportFrom):
+        mod = node.module or ""
+        if mod in ("jax.nn", "jax.experimental.nn"):
+            for alias in node.names:
+                if alias.name == "one_hot":
+                    bare_one_hot.add(alias.asname or alias.name)
+        elif mod == "jax":
+            for alias in node.names:
+                if alias.name == "nn":          # from jax import nn
+                    nn_mods.add(alias.asname or alias.name)
+    # (a) one_hot(...) @ W  /  W @ one_hot(...)  /  one_hot(...).T @ W
+    for node in info.nodes(ast.BinOp):
+        if not isinstance(node.op, ast.MatMult):
+            continue
+        if _jx027_is_one_hot_call(info, node.left, bare_one_hot,
+                                  nn_mods) or \
+                _jx027_is_one_hot_call(info, node.right, bare_one_hot,
+                                       nn_mods):
+            out.append(_finding(
+                info, node, "JX027",
+                "one_hot(...) @ table: a dense O(batch*vocab*dim) matmul "
+                "(and a dense one-hot cotangent on the backward) for what "
+                "is a row gather — index the table (EmbeddingLayer id "
+                "path; sparse_grad=True for the densified touched-rows "
+                "exchange)"))
+    # (b) full-vocab zeros scatter targets, direct or one-hop — TWO
+    # module-wide phases (not per-function), so module- and class-level
+    # scatters are covered too; the one-hop name map is module-global,
+    # a deliberate over-approximation the pragma escape covers
+    zeros_names: set = set()
+    for node in info.nodes(ast.Assign):
+        if len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                _jx027_vocabish_zeros(info, node.value):
+            zeros_names.add(node.targets[0].id)
+    for node in info.nodes(ast.Call):
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _JX027_SCATTER_METHS:
+            continue
+        sub = node.func.value
+        if not isinstance(sub, ast.Subscript) or \
+                not isinstance(sub.value, ast.Attribute) or \
+                sub.value.attr != "at":
+            continue
+        target = sub.value.value
+        hit = _jx027_vocabish_zeros(info, target) or (
+            isinstance(target, ast.Name) and target.id in zeros_names)
+        if hit:
+            out.append(_finding(
+                info, node, "JX027",
+                "scatter into a full-vocab zeros buffer materializes "
+                "the dense [vocab, dim] gradient every step — carry "
+                "coalesced row indices + values instead (nn/sparse "
+                "SparseRows; the train step's densified exchange), or "
+                "pragma a deliberate host-side densification with its "
+                "justification"))
     return _dedupe(out)
 
 
