@@ -89,8 +89,9 @@ it.
 A thirteenth JSON line records the IR-audit benchmark
 (``audit_time_ms``: build the canonical program set through its
 production entry points + the full graftaudit run — jaxpr phase and
-the partitioned-HLO compiles — the same audit that gates tier-1 in
-tests/test_audit.py, budget 60s); DL4J_TPU_BENCH_AUDIT=0 suppresses
+the partitioned-HLO compiles — + the budgets.json differential gate,
+the same audit that gates tier-1 in tests/test_audit.py and
+test_audit_diff.py, budget 60s); DL4J_TPU_BENCH_AUDIT=0 suppresses
 it.
 
 A fourteenth set of JSON lines records the sparse-embedding

@@ -49,7 +49,7 @@ class ClusteringOptimizationType(Enum):
     MINIMIZE_PER_CLUSTER_POINT_COUNT = "point_count"
 
 
-@functools.partial(jax.jit, static_argnames=("metric",))
+@functools.partial(jax.jit, static_argnames=("metric",))  # graftlint: disable=JX028  (clustering analytics kernel; outside the audited train/serve program set)
 def _classify_and_refresh(points, centers, prev_assign, metric: str):
     """One full reference iteration (classifyPoints + refreshClustersCenters +
     computeClusterSetInfo) as a single fused program: the shared Lloyd core

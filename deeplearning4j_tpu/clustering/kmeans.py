@@ -39,7 +39,7 @@ def _assign_refresh(points, centers, metric: str):
     return d, assign, one_hot, counts, new_centers
 
 
-@functools.partial(jax.jit, static_argnames=("metric",))
+@functools.partial(jax.jit, static_argnames=("metric",))  # graftlint: disable=JX028  (clustering analytics kernel; outside the audited train/serve program set)
 def _lloyd_step(points, centers, metric: str):
     d, assign, _, counts, new_centers = _assign_refresh(points, centers, metric)
     cost = jnp.sum(jnp.min(d, axis=1))

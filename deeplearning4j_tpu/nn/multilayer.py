@@ -75,13 +75,20 @@ class _StepForensics:
     ``grad_check_every``-th step, a *reference* to the still-on-device
     grad stats — the host fetch is deferred too) and :meth:`flush`
     drains the buffer through ``record()``/``observe_step()`` in a tight
-    warm loop every ``FLUSH_EVERY`` steps.  A non-finite loss flushes
-    IMMEDIATELY, so NaN detection and its checkpoint/stop reaction keep
-    per-step latency; the statistical detectors see the identical
-    stream a few steps late.  Every dump path flushes first: the fit
-    loops flush on exception and in their ``finally``, and the
-    checkpointer's preemption dump calls the ``pre_dump`` hook this
-    helper installs — buffered steps can never miss an artifact."""
+    warm loop every ``FLUSH_EVERY`` steps.
+
+    The loss is only materialized per step (``float`` = host sync) when
+    a health MONITOR is armed: its NaN/stop/checkpoint reaction is
+    contractually same-step, so that configuration pays the sync it
+    always paid, and a non-finite loss still flushes IMMEDIATELY.
+    Recorder-only forensics buffer the still-async device scalar and
+    materialize at flush time — by then the value has long computed, so
+    the D2H copy no longer stalls the dispatch pipeline (the lifetime
+    audit's host-sync sweep; see tools/graftaudit).  Every dump path
+    flushes first: the fit loops flush on exception and in their
+    ``finally``, and the checkpointer's preemption dump calls the
+    ``pre_dump`` hook this helper installs — buffered steps can never
+    miss an artifact."""
 
     FLUSH_EVERY = 16
     __slots__ = ("net", "rec", "ring", "mon", "ckpt", "pol", "_buf",
@@ -113,6 +120,11 @@ class _StepForensics:
         ``stop_training`` policy says to halt the fit."""
         net = self.net
         loss = net._score
+        mon = self.mon
+        if mon is not None:
+            # the monitor's same-step NaN reaction needs the value NOW;
+            # recorder-only runs keep the device scalar async
+            loss = float(loss)
         every = self._grad_every
         pol = self.pol
         buf = self._buf
@@ -123,8 +135,10 @@ class _StepForensics:
              if every > 0 and net.iteration % every == 0 else None,
              pol.last_pad_ratio if pol is not None else None))
         # loss - loss is 0.0 for finite loss, NaN for nan/±inf: the
-        # non-finite check without a function call
-        if len(buf) >= self.FLUSH_EVERY or loss - loss != 0.0:
+        # non-finite check without a function call (monitor-armed only —
+        # on the async path the check itself would be the host sync)
+        if len(buf) >= self.FLUSH_EVERY or \
+                (mon is not None and loss - loss != 0.0):
             return self.flush()
         return False
 
@@ -139,6 +153,11 @@ class _StepForensics:
         rec, ckpt, ring = self.rec, self.ckpt, self.ring
         wall0 = self._wall0
         for t_end, it, ep, seq, bs, loss, dt, comp, gref, pad in buf:
+            # recorder-only steps buffered the async device scalar; one
+            # cheap D2H each at drain time (the value computed steps ago).
+            # NOT exception-guarded: this float() is where deferred
+            # device-side failures first surface, and they must propagate
+            loss = float(loss)
             if ring is not None:
                 # literal-dict append onto the hoisted ring: same record
                 # shape record() builds, minus the wrapper overhead
@@ -1004,6 +1023,11 @@ class MultiLayerNetwork:
                         break
                 if stop:
                     break
+                # ONE materialization per epoch (fit_on_device's sync
+                # convention): steps pipelined async all epoch; epoch-end
+                # listeners (MetricsListener score/grad-norm) see a host
+                # float without forcing their own sync
+                self._score = float(self._score)
                 for lst in self.listeners:
                     lst.on_epoch_end(self)
                 self.epoch += 1
@@ -1037,6 +1061,11 @@ class MultiLayerNetwork:
                     pass
             if ckpt is not None:
                 ckpt.close()
+        # ONE materialization for the whole fit: _fit_one keeps _score
+        # as the async device scalar so steps pipeline.  NOT
+        # exception-guarded: this float() is where deferred device-side
+        # failures first surface, and they must propagate
+        self._score = float(self._score)
         if obs and steady_s > 0:
             # steady-state throughput: the compile-dominated first step
             # is excluded (same convention as utils/benchmarks.py)
@@ -1234,10 +1263,13 @@ class MultiLayerNetwork:
                 self._rng, key = jax.random.split(self._rng)
                 p_i, opt, loss = step(p_i, opt, key, jnp.asarray(batch),
                                       frozen, self.state)
-                self._score = float(loss)
+                # device scalar in-loop (steps pipeline); one sync below
+                self._score = loss
                 self.iteration += 1
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration, self.epoch)
+        # NOT exception-guarded: deferred device failures surface here
+        self._score = float(self._score)
         self.params[lname] = p_i
         # rebuild optimizer state so supervised fine-tuning starts clean
         self.opt_state = self._tx.init(self.params)
@@ -1257,8 +1289,16 @@ class MultiLayerNetwork:
         for b in data:
             yield b if hasattr(b, "shape") else self._normalize_batch(b)[0]
 
-    def _fit_one(self, x, y, m, lm) -> float:
-        """One train step (shared by fit's inner loop and fit_batch)."""
+    def _fit_one(self, x, y, m, lm):
+        """One train step (shared by fit's inner loop and fit_batch).
+
+        Returns (and leaves in ``_score``) the still-ASYNC device loss
+        scalar: the per-step ``float()`` here was the last unconditional
+        host sync in the hot fit loop — it stalled the dispatch pipeline
+        once per step for a value nothing reads until a listener or
+        forensics flush asks (the lifetime audit's host-sync sweep).
+        ``fit_batch``/``get_score`` materialize on demand; the fit loop
+        materializes once at the end."""
         self._validate_input_ids(x)
         step_fn = self._get_jitted("train_step")
         pol = self.shape_policy
@@ -1271,7 +1311,7 @@ class MultiLayerNetwork:
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
             self.params, self.state, self.opt_state, key,
             _on_device(x), _on_device(y), _on_device(m), _on_device(lm))
-        self._score = float(loss)
+        self._score = loss
         self._last_grad_stats = gstats
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
                                               False))
@@ -1285,7 +1325,7 @@ class MultiLayerNetwork:
         EarlyStoppingTrainer, which owns the epoch loop)."""
         if self.params == {}:
             self.init()
-        return self._fit_one(*self._normalize_batch(batch))
+        return float(self._fit_one(*self._normalize_batch(batch)))
 
     # ------------------------------------------------------ stateful RNN API
     def rnn_time_step(self, x) -> Array:

@@ -5,13 +5,15 @@ Per iteration (at ``frequency`` granularity): score, iteration/examples
 throughput, gradient global norm, device memory.
 
 Sync discipline: the listener NEVER forces a device sync on its own.
-On the plain ``fit`` path the loss is already a host float when the hook
-runs (``_fit_one`` materialized it), so score — and, with it, the
-grad-norm fetch — are recorded.  On the ``ParallelWrapper`` path the
-score stays a device scalar mid-fit (that's the wrapper's pipelining
-design); the listener detects this and SKIPS score/grad-norm rather than
-blocking the step queue — pass ``force_device_sync=True`` to collect
-them there anyway at one host sync per ``frequency`` iterations.
+Mid-fit the score is a still-async device scalar on every pipelined
+path — plain ``fit`` (the graftaudit host-sync sweep: one
+materialization per epoch, at the boundary) and ``ParallelWrapper``
+alike — so per-iteration hooks SKIP score/grad-norm rather than
+blocking the step queue, and record them in ``on_epoch_end`` where the
+fit loop has already materialized the epoch's final loss.  A caller
+that materializes per step (``fit_batch``) gets per-iteration score
+for free, and ``force_device_sync=True`` opts in to one host sync per
+``frequency`` iterations anywhere.
 
 A disabled registry turns ``iteration_done`` into a single bool check:
 no clocks, no fetches, no syncs.
@@ -164,10 +166,22 @@ class MetricsListener(TrainingListener):
     def on_epoch_end(self, model) -> None:
         if not self.registry.enabled:
             return
-        self._instruments()["epochs"].inc()
+        ins = self._instruments()
+        ins["epochs"].inc()
+        # the fit loops materialize the epoch's final loss right before
+        # this hook (one sync per epoch), so a host-float score — and
+        # the grad-norm fetch behind the then-drained queue — is free
+        # here; a still-device scalar (a custom loop) is skipped unless
+        # force_device_sync, same rule as iteration_done
+        raw = getattr(model, "_score", None)
+        score = raw if isinstance(raw, float) else (
+            float(model.get_score()) if self.force_device_sync else None)
+        if score is not None:
+            ins["score"].set(score)
+            if self.collect_grad_norms:
+                gstats = getattr(model, "_last_grad_stats", None)
+                if gstats is not None:
+                    ins["gnorm"].set(float(gstats["global_norm"]))
         if self.event_log is not None:
-            raw = getattr(model, "_score", None)
-            score = raw if isinstance(raw, float) else (
-                float(model.get_score()) if self.force_device_sync else None)
             self.event_log.emit("epoch_end", epoch=getattr(model, "epoch", -1),
                                 score=score)

@@ -86,7 +86,7 @@ def _apply_kernel(x_ref, sc_ref, sh_ref, o_ref, *, relu: bool):
     o_ref[...] = y
 
 
-@functools.partial(jax.jit, static_argnames=("relu", "interpret"))
+@functools.partial(jax.jit, static_argnames=("relu", "interpret"))  # graftlint: disable=JX028  (static-argnames Pallas kernel wrapper; nests under the outer InstrumentedJit program)
 def _apply(x2, scale, shift, relu: bool, interpret: bool):
     """y = act(x2 * scale + shift) over the [M', C'] lane-tiled view."""
     m, c = x2.shape

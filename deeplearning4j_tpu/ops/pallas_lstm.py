@@ -76,7 +76,7 @@ def _kernel(xz_ref, u_ref, h0_ref, c0_ref, ys_ref, hT_ref, cT_ref,
         cT_ref[:] = c_s[:]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret",))  # graftlint: disable=JX028  (static-argnames Pallas kernel wrapper; nests under the outer InstrumentedJit program)
 def _run(xz_p, u_p, h0_p, c0_p, interpret: bool = False):
     t, b, h4 = xz_p.shape
     h = h4 // 4

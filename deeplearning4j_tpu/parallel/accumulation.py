@@ -32,7 +32,7 @@ __all__ = ["threshold_encode", "threshold_decode", "bitmap_encode",
            "bitmap_decode", "EncodingHandler", "EncodedGradientsAccumulator"]
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(jax.jit, static_argnames=("k",))  # graftlint: disable=JX028  (gradient-codec kernel on the host exchange path; not a model program)
 def _threshold_encode_flat(flat, threshold, k: int):
     """Top-k thresholded sparsification.  Returns (idx[k], signs[k], count,
     residual).  Entries beyond ``count`` are padding (idx == -1)."""
@@ -71,7 +71,7 @@ def threshold_decode(msg: Dict[str, Any]) -> jnp.ndarray:
     return jnp.asarray(out)
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=JX028  (gradient-codec kernel on the host exchange path; not a model program)
 def _bitmap_encode_flat(flat, threshold):
     """2-bit dense codes (0 none, 1 +t, 2 -t) packed 4/byte."""
     codes = jnp.where(flat >= threshold, 1,

@@ -90,7 +90,7 @@ def _global_compute_supported(mesh) -> bool:
     try:
         x = place_sharded(np.zeros((), np.float32),
                           NamedSharding(mesh, PartitionSpec()))
-        jax.jit(lambda a: a + 1)(x).block_until_ready()  # graftlint: disable=JX004  (one-shot backend capability probe)
+        jax.jit(lambda a: a + 1)(x).block_until_ready()  # graftlint: disable=JX004,JX028  (one-shot backend capability probe)
         return True
     except Exception as e:
         # any failure means "don't trust global computation here", but

@@ -170,7 +170,7 @@ class _BaseFullBatchOptimizer:
         # methods and bakes the (single, full) batch in as a constant, so a
         # per-optimize() trace is the program — there is no steady-state
         # step to share across instances
-        @jax.jit
+        @jax.jit  # graftlint: disable=JX028  (cold per-optimize() program — see the JX013 note below)
         def step(flat, f, g, opt_state):  # graftlint: disable=JX013  (cold path, per-call program)
             d, opt_state = self.direction(g, opt_state)
             d = sign * d

@@ -29,7 +29,7 @@ __all__ = ["StatsListener", "StatsReport", "array_stats"]
 _N_BINS = 20
 
 
-@functools.partial(jax.jit, static_argnames=("bins",))
+@functools.partial(jax.jit, static_argnames=("bins",))  # graftlint: disable=JX028  (static-argnames histogram kernel for the stats listener; diagnostic path)
 def _stats_one(x, bins: int = _N_BINS):
     x = x.reshape(-1).astype(jnp.float32)
     lo, hi = jnp.min(x), jnp.max(x)

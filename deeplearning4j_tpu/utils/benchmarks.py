@@ -667,7 +667,7 @@ def tunnel_probe(n: int = 5) -> Dict:
     import jax
     import jax.numpy as jnp
 
-    f = jax.jit(lambda x: x + 1.0)
+    f = jax.jit(lambda x: x + 1.0)  # graftlint: disable=JX028  (microbenchmark probe; measures raw dispatch, deliberately bypasses the cache)
     x = jnp.zeros((1, 128), jnp.float32)
     float(np.asarray(f(x))[0, 0])                    # compile + settle
     lats = []
@@ -675,7 +675,7 @@ def tunnel_probe(n: int = 5) -> Dict:
         t0 = monotonic_s()
         float(np.asarray(f(x))[0, 0])
         lats.append(monotonic_s() - t0)
-    g = jax.jit(lambda a: a @ a)
+    g = jax.jit(lambda a: a @ a)  # graftlint: disable=JX028  (microbenchmark probe; measures raw dispatch, deliberately bypasses the cache)
     a = jnp.eye(1024, dtype=jnp.bfloat16)            # stable under chaining
     float(np.asarray(g(a)[0, 0]))                    # compile + settle
     blocks = []
@@ -699,7 +699,7 @@ def tunnel_probe(n: int = 5) -> Dict:
     # skipped and `healthy` gates on the dispatch probes alone.
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        h = jax.jit(lambda a: jax.lax.scan(
+        h = jax.jit(lambda a: jax.lax.scan(  # graftlint: disable=JX028  (microbenchmark probe; measures raw dispatch, deliberately bypasses the cache)
             lambda c, _: (jnp.tanh(c @ c), None), a, None, length=1000)[0])
         c = (jnp.eye(2048, dtype=jnp.bfloat16) * 0.99
              + jnp.full((2048, 2048), 1e-3, jnp.bfloat16))
@@ -963,7 +963,7 @@ def recovery_time_ms(hidden: int = 24, features: int = 8, classes: int = 3,
 
 def lint_time_ms(paths=None, runs: int = 2) -> Dict:
     """graftlint wall-time benchmark (ISSUE 9): one full-package run
-    through the public ``lint_paths`` API — 23 module rules off the
+    through the public ``lint_paths`` API — 24 module rules off the
     shared per-file parse plus the whole-program concurrency pass
     (JX018–JX021).  The linter gates tier-1 and the developer loop, so a
     rule addition that blows up its wall time is a latency regression
@@ -1007,10 +1007,12 @@ def lint_time_ms(paths=None, runs: int = 2) -> Dict:
 
 
 def audit_time_ms(include=None) -> Dict:
-    """graftaudit wall-time benchmark (ISSUE 14): build the canonical
-    program set through its production entry points, then run the full
-    IR audit — jaxpr phase plus the partitioned-HLO compiles of every
-    program.  The audit gates tier-1 (tests/test_audit.py) exactly like
+    """graftaudit wall-time benchmark (ISSUE 14; diff slice ISSUE 16):
+    build the canonical program set through its production entry
+    points, then run the full IR audit — jaxpr phase plus the
+    partitioned-HLO compiles of every program — then the differential
+    gate's budgets.json ceiling checks.  The audit gates tier-1
+    (tests/test_audit.py, test_audit_diff.py) exactly like
     graftlint does, so rule/program additions that blow up its wall
     time are a CI-latency regression this row keeps round-over-round
     visible; the acceptance budget is the full run (build + audit)
@@ -1030,8 +1032,10 @@ def audit_time_ms(include=None) -> Dict:
         sys.path.insert(0, repo_root)
     try:
         from tools.graftaudit import AUDIT_RULES, audit_programs
-        from tools.graftaudit.canonical import (CANONICAL_CONFIG,
+        from tools.graftaudit.canonical import (BUDGETS_PATH,
+                                                CANONICAL_CONFIG,
                                                 build_canonical)
+        from tools.graftaudit.diff import check_budgets, load_budgets
     finally:
         if added:
             sys.path.remove(repo_root)
@@ -1042,16 +1046,35 @@ def audit_time_ms(include=None) -> Dict:
     result = audit_programs(cs.programs, cs.suppressions,
                             CANONICAL_CONFIG)
     audit_ms = (monotonic_s() - t1) * 1e3
+    # the differential-gate slice (ISSUE 16): the budgets.json ceiling
+    # checks --diff-cards adds on top of the audit (AX010 card drift is
+    # already inside audit_ms — CANONICAL_CONFIG arms it)
+    t2 = monotonic_s()
+    budgets = load_budgets(BUDGETS_PATH)
+    # an include subset leaves non-matching budgeted programs
+    # un-audited, not stale (same rule as the CLI's --programs)
+    skipped_for_diff = dict(cs.skipped)
+    if include is not None:
+        audited = {ir_prog.name for ir_prog in result.irs}
+        for name in budgets.get("programs", {}):
+            if name not in audited and \
+                    not any(s in name for s in include):
+                skipped_for_diff.setdefault(name, "include subset")
+    diff_findings, stale = check_budgets(
+        result.irs, budgets, skipped_for_diff)
+    diff_ms = (monotonic_s() - t2) * 1e3
     return {
         "metric": "audit_time_ms",
-        "value": round(build_ms + audit_ms, 1),
-        "unit": "ms full canonical-set IR audit (build + audit)",
+        "value": round(build_ms + audit_ms + diff_ms, 1),
+        "unit": "ms full canonical-set IR audit (build + audit + diff)",
         "build_ms": round(build_ms, 1),
         "audit_ms": round(audit_ms, 1),
+        "diff_ms": round(diff_ms, 1),
         "programs": len(result.irs),
         "skipped": sorted(cs.skipped),
         "rules": len(AUDIT_RULES),
-        "findings": len(result.findings),
+        "findings": len(result.findings) + len(diff_findings),
+        "stale_budgets": sorted(stale),
         "suppressed": sum(result.suppressed.values()),
         "budget_ms": 60000.0,
     }

@@ -39,7 +39,7 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6,
     x = jnp.asarray(x, jnp.float64)
     y = jnp.asarray(y, jnp.float64)
 
-    @jax.jit
+    @jax.jit  # graftlint: disable=JX028  (f64 finite-difference probe; cold diagnostic path, never steady-state)
     def loss_fn(p):
         # train=False: dropout/noise off; BN uses batch stats only if training,
         # reference gradient checks also disable stochastic regularization.

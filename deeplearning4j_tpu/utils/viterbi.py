@@ -16,7 +16,7 @@ import numpy as np
 __all__ = ["Viterbi", "viterbi_decode"]
 
 
-@jax.jit
+@jax.jit  # graftlint: disable=JX028  (viterbi decode kernel; NLP host path outside the audited program set)
 def _decode(log_emissions: jax.Array, log_transitions: jax.Array,
             log_prior: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """log_emissions [t, s]; log_transitions [s, s] (row=from, col=to);
@@ -71,7 +71,7 @@ class Viterbi:
             np.fill_diagonal(transitions, 0.75)
         self.transitions = np.asarray(transitions, np.float32)
         self.prior = prior
-        self._batched = jax.jit(jax.vmap(_decode, in_axes=(0, None, None)))
+        self._batched = jax.jit(jax.vmap(_decode, in_axes=(0, None, None)))  # graftlint: disable=JX028  (viterbi decode kernel; NLP host path outside the audited program set)
 
     def decode(self, emissions) -> Tuple[np.ndarray, float]:
         """[t, s] emissions → (labels [t], log-prob)."""

@@ -1,0 +1,396 @@
+"""graftaudit differential gate (ISSUE 16): the lifetime/donation
+solver rules (AX007–AX010) and the ``--diff-cards`` budget gate.
+
+Three layers:
+
+* **rule units** — AX007's exact donation set (donatable positive,
+  aliased-shape-mismatch negative, live-after-call veto), AX008's
+  peak-live ceiling, AX009's scalar-variant churn, AX010's card drift.
+* **the injected-regression suite** — the four classic silent IR
+  regressions are synthetically introduced (an f64 escape, a dropped
+  donation, a grown collective, a new ``pure_callback``) and each MUST
+  fail the gate with the rule that names the bug; a stale budget entry
+  MUST exit 2.  A gate that cannot fail is decoration.
+* **the tier-1 gate** — ``--diff-cards`` semantics over the real
+  canonical set against the committed ``budgets.json`` + ``cards/``:
+  green on the tier-1 rig, every program budgeted, nothing skipped
+  silently.
+"""
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftaudit import (AUDIT_RULES, AuditConfig,  # noqa: E402
+                              AuditProgram, analyze_program,
+                              audit_programs, write_cards)
+from tools.graftaudit.canonical import (BUDGETS_PATH,  # noqa: E402
+                                        CANONICAL_CONFIG, CARDS_DIR,
+                                        build_canonical)
+from tools.graftaudit.cli import main as audit_cli  # noqa: E402
+from tools.graftaudit.diff import (budget_entry,  # noqa: E402
+                                   check_budgets, load_budgets)
+
+from deeplearning4j_tpu.nn.compile_cache import InstrumentedJit  # noqa: E402
+
+FAST = AuditConfig(compile="never", min_donate_bytes=256)
+
+
+def prog(fun, *args, name="train_step", donate=(), **kw) -> AuditProgram:
+    entry = InstrumentedJit(fun, name=name, donate_argnums=donate)
+    entry(*args)
+    specs = entry.audit_specs()
+    assert specs, "trace-time capture should have recorded the spec"
+    return AuditProgram(name=name, entry=entry, spec=specs[-1], **kw)
+
+
+def run_rule(code, p, config=FAST):
+    return AUDIT_RULES[code](analyze_program(p, config))
+
+
+# ------------------------------------------------------------- AX007 units
+class TestAX007ExactSet:
+    def test_dead_arg_with_aliasable_output_fires(self):
+        # params is big, observed dead (the fixture drops its only
+        # binding), and the output aliases its shape/dtype exactly —
+        # the maximal set must contain it, the declaration doesn't
+        def fn(params, x):
+            return params * 0.9 + jnp.sum(x)
+
+        fs = run_rule("AX007", prog(fn, jnp.ones((64, 64), jnp.float32),
+                                    jnp.ones((8,), jnp.float32)))
+        assert len(fs) == 1 and "arg 0" in fs[0].message
+        assert "maximal safe donation set" in fs[0].message
+
+    def test_declared_donation_is_silent(self):
+        def fn(params, x):
+            return params * 0.9 + jnp.sum(x)
+
+        p = prog(fn, jnp.ones((64, 64), jnp.float32),
+                 jnp.ones((8,), jnp.float32), donate=(0,))
+        assert run_rule("AX007", p) == []
+
+    def test_no_aliasable_output_is_silent(self):
+        # every arg is dead but the program only returns a scalar:
+        # donation buys nothing (no shape/dtype-compatible output
+        # leaf), and unlike AX005's heuristic the solver must stay quiet
+        def fn(params, state, x):
+            return jnp.sum(params) + jnp.sum(state) + jnp.sum(x)
+
+        args = (jnp.ones((64, 64), jnp.float32),
+                jnp.ones((8,), jnp.float32),
+                jnp.ones((64, 64), jnp.float32))
+        assert run_rule("AX007", prog(fn, *args)) == []
+        # ... while AX005's kind-contract threshold heuristic DOES cry
+        # wolf on serve's dead batch (arg 2) — exactly the imprecision
+        # AX007 supersedes
+        assert run_rule("AX005", prog(fn, *args, name="serve")) != []
+
+    def test_observed_live_arg_vetoes_the_contract(self):
+        # the caller demonstrably still holds the binding, so even
+        # though the train_step contract says arg 0 is dead after the
+        # call, the observation wins and AX007 must not fire
+        def fn(params, x):
+            return params * 0.9 + jnp.sum(x)
+
+        held = jnp.ones((64, 64), jnp.float32)
+        entry = InstrumentedJit(fn, name="train_step", donate_argnums=())
+        entry(held, jnp.ones((8,), jnp.float32))
+        p = AuditProgram(name="train_step", entry=entry,
+                         spec=entry.audit_specs()[-1])
+        ir_prog = analyze_program(p, FAST)
+        assert ir_prog.lifetime.args[0].caller == "live"
+        assert AUDIT_RULES["AX007"](ir_prog) == []
+        del held
+
+    def test_below_threshold_is_silent(self):
+        def fn(params, x):
+            return params * 0.9 + jnp.sum(x)
+
+        cfg = AuditConfig(compile="never", min_donate_bytes=1 << 30)
+        fs = run_rule("AX007", prog(fn, jnp.ones((64, 64), jnp.float32),
+                                    jnp.ones((8,), jnp.float32)), cfg)
+        assert fs == []
+
+
+# ------------------------------------------------------- AX008/AX009/AX010
+class TestAX008PeakLive:
+    def test_over_ceiling_fires_and_under_is_silent(self):
+        def fn(x):
+            return x @ x + x
+
+        tight = AuditConfig(compile="never",
+                            peak_live_budgets={"train_step": 1})
+        fs = run_rule("AX008", prog(fn, jnp.ones((16, 16))), tight)
+        assert len(fs) == 1 and "peak-live-bytes" in fs[0].message
+        roomy = AuditConfig(compile="never",
+                            peak_live_budgets={"train_step": 1 << 30})
+        assert run_rule("AX008", prog(fn, jnp.ones((16, 16))), roomy) == []
+
+    def test_unbudgeted_program_is_silent(self):
+        def fn(x):
+            return x @ x
+
+        cfg = AuditConfig(compile="never",
+                          peak_live_budgets={"some_other_program": 1})
+        assert run_rule("AX008", prog(fn, jnp.ones((16, 16))), cfg) == []
+
+
+class TestAX009VariantChurn:
+    def test_python_scalar_value_churn_fires(self):
+        # capture "all" (the canonical-gate mode): each raw-scalar value
+        # lands its own spec in the audit ring, all collapsing onto one
+        # program once the value is erased — the churn AX009 names
+        from deeplearning4j_tpu.nn.compile_cache import (
+            audit_capture_mode, set_audit_capture)
+
+        prev = audit_capture_mode()
+        set_audit_capture("all")
+        try:
+            entry = InstrumentedJit(lambda x, t: x * t, name="decode")
+            entry(jnp.ones((4,)), 0.7)
+            entry(jnp.ones((4,)), 0.9)
+        finally:
+            set_audit_capture(prev)
+        assert len(entry.audit_specs()) == 2
+        p = AuditProgram(name="decode", entry=entry,
+                         spec=entry.audit_specs()[-1])
+        fs = AUDIT_RULES["AX009"](analyze_program(p, FAST))
+        assert len(fs) == 1 and "2 captured call specs" in fs[0].message
+
+    def test_committed_scalar_is_one_variant(self):
+        from deeplearning4j_tpu.nn.compile_cache import (
+            audit_capture_mode, set_audit_capture)
+
+        prev = audit_capture_mode()
+        set_audit_capture("all")
+        try:
+            entry = InstrumentedJit(lambda x, t: x * t, name="decode")
+            entry(jnp.ones((4,)), np.float32(0.7))
+            entry(jnp.ones((4,)), np.float32(0.9))   # same committed spec
+        finally:
+            set_audit_capture(prev)
+        assert len(entry.audit_specs()) == 1
+        p = AuditProgram(name="decode", entry=entry,
+                         spec=entry.audit_specs()[-1])
+        assert AUDIT_RULES["AX009"](analyze_program(p, FAST)) == []
+
+
+class TestAX010CardDrift:
+    def _ir(self, tmp_path, name="gate_probe"):
+        def fn(x):
+            return x * 2
+
+        p = prog(fn, jnp.ones((4,)), name=name)
+        cfg = AuditConfig(compile="never", cards_dir=str(tmp_path))
+        return analyze_program(p, cfg)
+
+    def test_missing_card_fires(self, tmp_path):
+        fs = AUDIT_RULES["AX010"](self._ir(tmp_path))
+        assert len(fs) == 1 and "no committed card" in fs[0].message
+
+    def test_matching_card_is_silent_and_drift_fires(self, tmp_path):
+        ir_prog = self._ir(tmp_path)
+        [path] = write_cards([ir_prog], str(tmp_path))
+        assert AUDIT_RULES["AX010"](ir_prog) == []
+        card = json.loads(Path(path).read_text())
+        card["donation"]["declared"] = [0]          # stable-field edit
+        Path(path).write_text(json.dumps(card))
+        fs = AUDIT_RULES["AX010"](ir_prog)
+        assert len(fs) == 1 and "'donation' drifted" in fs[0].message
+
+    def test_unarmed_config_is_silent(self):
+        def fn(x):
+            return x * 2
+
+        assert AUDIT_RULES["AX010"](
+            analyze_program(prog(fn, jnp.ones((4,))), FAST)) == []
+
+
+# ------------------------------------------------- injected regressions
+# Each of the four classic silent IR regressions is synthetically
+# introduced and MUST produce the finding the gate exits 1 on, with the
+# rule code that names the bug (the cli returns 1 on any finding).
+class TestInjectedRegressions:
+    def test_injected_f64_escape_fails_as_ax001(self):
+        if not jax.config.jax_enable_x64:
+            pytest.skip("needs x64 for a dtype-defaulted f64")
+
+        def fn(x):
+            return jnp.sum(x) + jnp.zeros(())    # injected f64 join
+
+        res = audit_programs([prog(fn, jnp.ones((4,), jnp.float32))],
+                             [], FAST)
+        assert [f.rule for f in res.findings] == ["AX001"]
+
+    def test_injected_dropped_donation_fails_as_ax007(self):
+        # the program's reviewed budget row says arg 0 is donated;
+        # the fresh build dropped it — donation_min catches it even if
+        # the caller-side liveness probe sees nothing
+        def fn(params, x):
+            return params * 0.9 + jnp.sum(x)
+
+        ir_prog = analyze_program(
+            prog(fn, jnp.ones((64, 64), jnp.float32),
+                 jnp.ones((8,), jnp.float32), donate=(0,)), FAST)
+        row = budget_entry(ir_prog)
+        assert row["donation_min"] == [0]
+        dropped = dataclasses.replace(ir_prog, donate=())
+        findings, stale = check_budgets(
+            [dropped], {"programs": {ir_prog.name: row}})
+        assert stale == []
+        assert [f.rule for f in findings] == ["AX007"]
+        assert "budgeted donation dropped" in findings[0].message
+
+    def test_injected_grown_collective_fails_as_ax008(self):
+        # a census 2x over the reviewed ceiling (the grown-all-reduce
+        # shape of a lost reduce-scatter) breaches collective_bytes
+        def fn(x):
+            return x * 2
+
+        ir_prog = analyze_program(prog(fn, jnp.ones((4,))), FAST)
+        grown = dataclasses.replace(
+            ir_prog,
+            census={"all-reduce": {"count": 12, "bytes": 9000}})
+        findings, _ = check_budgets(
+            [grown], {"programs": {ir_prog.name: {
+                "collective_bytes": 4500, "collective_count": 11}}})
+        assert sorted(f.rule for f in findings) == ["AX008", "AX008"]
+        assert any("collective bytes 9000" in f.message for f in findings)
+        assert any("collective count 12" in f.message for f in findings)
+
+    def test_injected_callback_fails_as_ax004_and_breaches_budget(self):
+        def fn(x):
+            y = jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y + 1
+
+        p = prog(fn, jnp.ones((4,), jnp.float32))
+        res = audit_programs([p], [], FAST)
+        assert "AX004" in [f.rule for f in res.findings]
+        # and the budget's callback ceiling fails closed independently
+        ir_prog = analyze_program(p, FAST)
+        findings, _ = check_budgets(
+            [ir_prog], {"programs": {p.name: {"callbacks": 0}}})
+        assert [f.rule for f in findings] == ["AX008"]
+        assert "host callback eqns" in findings[0].message
+
+    def test_stale_budget_entry_is_exit2_class(self):
+        # a budgeted program that no longer exists (and is not an
+        # explicit host skip) must surface as stale, never be ignored
+        findings, stale = check_budgets(
+            [], {"programs": {"ghost_program": {"callbacks": 0}}})
+        assert findings == [] and stale == ["ghost_program"]
+        # ... unless the host explicitly could not build it
+        findings, stale = check_budgets(
+            [], {"programs": {"ghost_program": {"callbacks": 0}}},
+            skipped={"ghost_program": "needs 8 devices"})
+        assert findings == [] and stale == []
+
+    def test_budgets_file_must_exist_and_parse(self, tmp_path):
+        with pytest.raises(OSError):
+            load_budgets(str(tmp_path / "nope.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        with pytest.raises(ValueError):
+            load_budgets(str(bad))
+
+
+# ------------------------------------------------------- the tier-1 gate
+@pytest.fixture(scope="module")
+def gate():
+    """ONE full gate pipeline run shared by the gate tests: canonical
+    build, audit under CANONICAL_CONFIG (AX008 ceilings + AX010 card
+    drift armed), budget checks against the committed budgets.json."""
+    cs = build_canonical()
+    result = audit_programs(cs.programs, cs.suppressions,
+                            CANONICAL_CONFIG)
+    budgets = load_budgets(str(BUDGETS_PATH))
+    findings, stale = check_budgets(result.irs, budgets, cs.skipped)
+    return cs, result, budgets, findings, stale
+
+
+def test_diff_gate_is_green_on_the_tier1_rig(gate):
+    """THE gate: the committed budgets + cards describe the canonical
+    set as built — zero findings, zero stale rows, and coverage is
+    EXPLICIT: the tier-1 rig builds every program (skipped must be
+    empty, so a quietly-unbuildable program can never fake green)."""
+    cs, result, budgets, findings, stale = gate
+    assert cs.skipped == {}, cs.skipped
+    assert result.findings == [], \
+        "\n".join(f.format() for f in result.findings)
+    assert result.stale_suppressions == []
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert stale == []
+    # every canonical program is budgeted — no unguarded program rides
+    # along, and no budget row outlives its program
+    assert set(budgets["programs"]) == {ir.name for ir in result.irs}
+
+
+def test_sweep_acceptance_no_undeclared_donatable_args(gate):
+    """ISSUE 16 acceptance: after the donation sweep, the solver's
+    maximal safe donation set matches the declaration on every
+    canonical train program — AX007 has nothing left to say there (the
+    CPU-only serve/prefill/decode skips are justified manifest
+    suppressions, pinned in test_audit.py)."""
+    _, result, _, _, _ = gate
+    for ir_prog in result.irs:
+        if not ir_prog.kind.startswith(("train_step", "pretrain")):
+            continue
+        assert ir_prog.lifetime is not None, ir_prog.name
+        undeclared = [a for a in ir_prog.lifetime.maximal_donation
+                      if a not in ir_prog.donate]
+        assert undeclared == [], \
+            f"{ir_prog.name}: solver says donate {undeclared} too"
+
+
+def test_every_budget_row_is_ratchet_tight(gate):
+    """The committed ceilings actually bite: each exact metric
+    (collective bytes/count, callbacks, dtype histogram) equals the
+    current value — the ratchet has zero slack to absorb a regression —
+    and the jittery metrics (temp, peak-live) carry only their
+    documented headroom."""
+    _, result, budgets, _, _ = gate
+    for ir_prog in result.irs:
+        row = budgets["programs"][ir_prog.name]
+        fresh = budget_entry(ir_prog)
+        for k in ("collective_bytes", "collective_count", "callbacks",
+                  "dtypes", "donation_min"):
+            assert row[k] == fresh[k], (ir_prog.name, k)
+
+
+def test_cli_diff_gate_exit_codes(gate, tmp_path, capsys):
+    """End-to-end exit-code wiring on a one-program subset (cheap):
+    0 = clean against the committed artifacts, 1 = a ceiling breach,
+    2 = a stale budget entry; a missing budgets file refuses to run."""
+    assert audit_cli(["--diff-cards", "--programs", "serve"]) == 0
+
+    budgets = json.loads(Path(BUDGETS_PATH).read_text())
+    breach = {"programs": {"serve": dict(budgets["programs"]["serve"],
+                                         temp_bytes=0)}}
+    bpath = tmp_path / "budgets.json"
+    bpath.write_text(json.dumps(breach))
+    assert audit_cli(["--diff-cards", "--programs", "serve",
+                      "--budgets", str(bpath)]) == 1
+    out = capsys.readouterr().out
+    assert "AX008" in out and "XLA temp bytes" in out
+
+    stale = {"programs": {"serve": budgets["programs"]["serve"],
+                          "ghost_program": {"callbacks": 0}}}
+    bpath.write_text(json.dumps(stale))
+    assert audit_cli(["--diff-cards", "--programs", "serve,ghost",
+                      "--budgets", str(bpath)]) == 2
+
+    assert audit_cli(["--diff-cards", "--programs", "serve",
+                      "--budgets", str(tmp_path / "missing.json")]) == 2

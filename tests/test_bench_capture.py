@@ -149,18 +149,19 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 27
+    assert row["rules"] == 28
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
 
 
 def test_audit_time_ms_row():
-    """The IR-audit bench line (ISSUE 14): row shape for the canonical
-    program-set build + full graftaudit wall time.  A name-filtered
-    subset keeps the test fast (the dense + bf16 train steps — no
-    sharded meshes, no generation engine); the full-set 60s acceptance
-    budget is asserted in tests/test_audit.py where the whole set is
-    built anyway."""
+    """The IR-audit bench line (ISSUE 14; diff slice ISSUE 16): row
+    shape for the canonical program-set build + full graftaudit wall
+    time + the budgets.json differential gate.  A name-filtered subset
+    keeps the test fast (the dense + bf16 train steps — no sharded
+    meshes, no generation engine); the full-set 60s acceptance budget
+    is asserted in tests/test_audit.py where the whole set is built
+    anyway, and the full diff gate in tests/test_audit_diff.py."""
     from deeplearning4j_tpu.utils import benchmarks as B
 
     row = B.audit_time_ms(include=["train_step[dense]",
@@ -169,11 +170,12 @@ def test_audit_time_ms_row():
     assert row["unit"].startswith("ms full canonical-set")
     assert row["value"] > 0
     assert row["value"] == pytest.approx(
-        row["build_ms"] + row["audit_ms"], abs=0.11)
+        row["build_ms"] + row["audit_ms"] + row["diff_ms"], abs=0.16)
     assert row["programs"] == 2
     assert row["skipped"] == []      # under-coverage must be explicit
-    assert row["rules"] == 6
+    assert row["rules"] == 10
     assert row["findings"] == 0       # the swept canonical set is clean
+    assert row["stale_budgets"] == []  # subset rows count as skipped
     assert row["budget_ms"] == 60000.0
     assert row["value"] < row["budget_ms"]
 

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX027 has at least one fixture that MUST fire and one
+Every rule JX001–JX028 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1391,6 +1391,71 @@ def test_jx027_pragma_suppresses():
                                                 _NN_PATH)}
 
 
+# ---------------------------------------------------------------- JX028
+def test_jx028_positive_every_stray_jit_spelling():
+    # the four spellings the package sweep found: bare decorator,
+    # functools.partial decorator, direct call, and the bare import
+    src = """
+        import functools
+        import jax
+        from jax import pmap
+
+        @jax.jit
+        def f(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def g(x, k):
+            return x
+
+        h = jax.jit(lambda x: x + 1)
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX028" for f in fs) == 4
+
+
+def test_jx028_negative_compile_cache_and_tests_exempt():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x
+    """
+    for path in ("deeplearning4j_tpu/nn/compile_cache.py",
+                 "tests/test_fix.py", "tests/conftest.py"):
+        assert "JX028" not in rules_at(src, path)
+
+
+def test_jx028_negative_unrelated_jit_attributes():
+    # a non-jax object's .jit attr and a user function named jit don't
+    # fire; neither does routing through the sanctioned wrapper
+    assert "JX028" not in rules_at("""
+        from ..nn.compile_cache import InstrumentedJit
+
+        def jit(fn):
+            return fn
+
+        def build(engine, step):
+            prog = engine.jit(step)
+            wrapped = jit(step)
+            return InstrumentedJit(step, donate_argnums=(0,)), prog, wrapped
+    """, _NN_PATH)
+
+
+def test_jx028_pragma_suppresses():
+    src = """
+        import jax
+
+        @jax.jit  # graftlint: disable=JX028  (one-shot capability probe)
+        def probe(x):
+            return x
+    """
+    assert "JX028" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _NN_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2445,7 +2510,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 23
+    assert len(RULES) == 24
     assert len(PROGRAM_RULES) == 4
 
 

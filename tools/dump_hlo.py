@@ -1,19 +1,30 @@
-import jax, jax.numpy as jnp
+"""Dump the bench model's optimized train-step HLO + cost summary.
+
+Run from the repo root: ``python -m tools.dump_hlo``.  Writes the HLO
+text to /tmp/hlo_opt.txt and prints the backend cost rows.
+
+The HLO comes through the ONE extraction path
+(``tools/graftaudit/extract.py``): fit() populates the trace cache, and
+the recorded train-step call is re-lowered via ``audit_lower`` — the
+program production actually ran, with its declared donation, not a
+hand-reconstructed ``.lower()`` with a fresh RNG key.
+"""
+import json
+
+import jax.numpy as jnp
+
 from deeplearning4j_tpu.models import available_bench_model
+from tools.graftaudit.extract import iter_trace_cache_hlo
 
 model, (x, y) = available_bench_model(batch=256, image=224)
 x, y = jnp.asarray(x), jnp.asarray(y)
-model.fit(x, y)
-step = model._get_jitted("train_step")
-model._rng, key = jax.random.split(model._rng)
-lowered = step.lower(model.params, model.state, model.opt_state, key,
-                     [x], [y], None, None)
-compiled = lowered.compile()
+model.fit(x, y)                       # records the real train-step call
+exs = list(iter_trace_cache_hlo(kinds=("train_step",)))
+assert exs, "no train_step in the trace cache after fit()"
+ex = exs[-1]
 with open("/tmp/hlo_opt.txt", "w") as f:
-    f.write(compiled.as_text())
-ca = compiled.cost_analysis()
-if isinstance(ca, list): ca = ca[0]
-import json
+    f.write(ex.hlo_text)
+ca = ex.cost_analysis()
 flops = ca.get("flops", 0)
 print(json.dumps({k: v for k, v in ca.items()
                   if k in ("flops", "bytes accessed", "optimal_seconds",

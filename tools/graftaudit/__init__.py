@@ -1,6 +1,6 @@
 """graftaudit — IR-level static analysis of the compiled program set.
 
-graftlint's 26 AST rules see Python source; every performance and
+graftlint's AST rules see Python source; every performance and
 correctness contract this framework actually ships — the GSPMD-derived
 reduce-scatter/all-gather layout of the ZeRO-3 step (arxiv 2004.13336),
 bf16 compute against f32 masters, donated serve/decode buffers, zero
@@ -40,12 +40,14 @@ from .audit import (AuditConfig, AuditProgram, AuditResult, ProgramIR,
                     Suppression, analyze_program, audit_programs,
                     programs_from_trace_cache)
 from .cards import build_card, card_filename, load_card, write_cards
+from .extract import ExtractedHLO, extract_hlo, iter_trace_cache_hlo
 from .rules import AUDIT_RULES, AUDIT_RULE_DOCS, DEAD_AFTER_CALL
 
 __all__ = [
     "AuditConfig", "AuditProgram", "AuditResult", "ProgramIR",
     "Suppression", "analyze_program", "audit_programs",
     "programs_from_trace_cache", "build_card", "card_filename",
-    "load_card", "write_cards", "AUDIT_RULES", "AUDIT_RULE_DOCS",
+    "load_card", "write_cards", "ExtractedHLO", "extract_hlo",
+    "iter_trace_cache_hlo", "AUDIT_RULES", "AUDIT_RULE_DOCS",
     "DEAD_AFTER_CALL",
 ]

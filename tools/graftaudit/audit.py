@@ -23,9 +23,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..graftlint.core import Finding
+from . import extract as EX
 from . import hlo as HLO
 from . import ir as IR
-from .rules import AUDIT_RULES
+from . import lifetime as LT
+from .rules import AUDIT_RULES, DEAD_AFTER_CALL
 
 __all__ = ["AuditConfig", "AuditProgram", "ProgramIR", "Suppression",
            "AuditResult", "analyze_program", "audit_programs",
@@ -50,6 +52,14 @@ class AuditConfig:
     #: degrading to jaxpr-only when XLA refuses); "never" stays at the
     #: jaxpr phase (fast unit tests)
     compile: str = "auto"
+    #: AX008: per-program peak-live-bytes ceilings (program name -> int,
+    #: usually the "peak_live_bytes" entries of budgets.json); None
+    #: disables the rule entirely, and a program absent from the map is
+    #: unbudgeted (silent) — budgets are opt-in per program
+    peak_live_budgets: Optional[Any] = None
+    #: AX010: directory of committed program cards to diff the fresh
+    #: audit against (stable fields only); None disables the rule
+    cards_dir: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -110,6 +120,16 @@ class ProgramIR:
     collective_ops: List[Any] = field(default_factory=list)
     flops: Optional[float] = None
     temp_bytes: Optional[int] = None
+    #: lifetime/donation solver output (lifetime.LifetimeInfo) — None
+    #: only when the solver itself failed (recorded in the name-keyed
+    #: warning, never silently)
+    lifetime: Optional[Any] = None
+    peak_live_bytes: Optional[int] = None
+    #: captured-spec variant churn (lifetime.spec_variant_group): how
+    #: many of the entry's recorded specs collapse onto this spec once
+    #: Python-scalar values / weak-typed 0-d leaves are erased
+    variant_count: int = 1
+    variant_churn: List[str] = field(default_factory=list)
 
 
 def _tree_bytes(tree: Any) -> int:
@@ -153,17 +173,32 @@ def analyze_program(p: AuditProgram,
         param_bytes=arg_bytes[0] if arg_bytes else 0,
         input_dtypes=IR.invar_dtypes(jaxpr),
         census=IR.jaxpr_collective_census(jaxpr))
+    contract = DEAD_AFTER_CALL.get(p.kind)
+    if contract is None and p.kind.startswith("pretrain"):
+        contract = (0, 1)
+    try:
+        ir_prog.lifetime = LT.solve_lifetime(
+            jaxpr, p.spec, donate=ir_prog.donate, entry=p.entry,
+            contract_dead=contract or ())
+        ir_prog.peak_live_bytes = ir_prog.lifetime.peak_live_bytes
+    except Exception as e:           # solver failure must be loud
+        import warnings
+
+        warnings.warn(
+            f"graftaudit: lifetime solve of '{p.name}' failed — "
+            f"{type(e).__name__}: {e}", RuntimeWarning, stacklevel=2)
+    count, churn = LT.spec_variant_group(p.entry, p.spec)
+    ir_prog.variant_count, ir_prog.variant_churn = count, churn
     if config.compile == "never":
         return ir_prog
     try:
-        lowered = p.entry.audit_lower(p.spec)
-        compiled = HLO.compile_lowered(lowered)
-        ops = HLO.parse_collectives(compiled.as_text())
+        ex = EX.extract_hlo(p.entry, p.spec, name=p.name)
+        ops = HLO.parse_collectives(ex.hlo_text)
         ir_prog.collective_ops = ops
         ir_prog.census = HLO.census_from_ops(ops)
         ir_prog.census_source = "hlo"
-        ir_prog.flops = HLO.compiled_flops(compiled)
-        ir_prog.temp_bytes = HLO.compiled_temp_bytes(compiled)
+        ir_prog.flops = ex.flops
+        ir_prog.temp_bytes = ex.temp_bytes
     except Exception as e:
         # jaxpr-phase results stand, but NEVER silently: a failed
         # compile of a sharded program would otherwise "audit clean"

@@ -18,18 +18,44 @@ fire, the allowance is never declared and can never go stale-but-armed.
 """
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .audit import AuditConfig, AuditProgram, Suppression
 
 __all__ = ["CANONICAL_CONFIG", "CanonicalSet", "build_canonical",
-           "CANONICAL_PROGRAM_NAMES"]
+           "CANONICAL_PROGRAM_NAMES", "BUDGETS_PATH", "CARDS_DIR"]
+
+#: the checked-in per-program IR budgets (AX008 + the --diff-cards
+#: gate) and the committed card directory (AX010)
+BUDGETS_PATH = os.path.join(os.path.dirname(__file__), "budgets.json")
+CARDS_DIR = os.path.join(os.path.dirname(__file__), "cards")
+
+
+def _peak_budgets() -> Optional[Dict[str, int]]:
+    """``peak_live_bytes`` ceilings from budgets.json (AX008's input);
+    None (rule disabled) when the file is absent/unreadable — the
+    --diff-cards gate separately refuses to run without budgets, so a
+    deleted budgets file cannot silently green the gate."""
+    try:
+        with open(BUDGETS_PATH, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return {name: int(row["peak_live_bytes"])
+                for name, row in data.get("programs", {}).items()
+                if row.get("peak_live_bytes") is not None}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
 
 #: the canonical set audits TOY programs, so the donation-threshold
 #: teeth come from a low floor (the serve batch is ~512 bytes; at the
-#: default 1 MiB nothing toy-sized would ever exercise AX005)
-CANONICAL_CONFIG = AuditConfig(min_donate_bytes=256)
+#: default 1 MiB nothing toy-sized would ever exercise AX005/AX007);
+#: the committed budgets/cards arm AX008/AX010 on every canonical audit
+CANONICAL_CONFIG = AuditConfig(min_donate_bytes=256,
+                               peak_live_budgets=_peak_budgets(),
+                               cards_dir=CARDS_DIR)
 
 CANONICAL_PROGRAM_NAMES = (
     "train_step[dense]", "train_step[zero3,dp=2]", "train_step[zero3,dp=4]",
@@ -271,6 +297,12 @@ def build_canonical(include: Optional[Sequence[str]] = None,
                         "generation/programs.build_generation_fn skips "
                         "donating the slot cache there — on TPU both "
                         "generation programs donate it"))
+                    sups.append(Suppression(
+                        "prefill", "AX007",
+                        "same CPU no-donation skip, exact-solver form: "
+                        "the lifetime solver proves the threaded slot "
+                        "cache (arg 4) donatable, and on TPU it IS "
+                        "donated — CPU cannot alias buffers"))
             if want("decode"):
                 dec = lm._get_jitted("decode")
                 programs.append(AuditProgram(
@@ -282,6 +314,12 @@ def build_canonical(include: Optional[Sequence[str]] = None,
                         "generation/programs.build_generation_fn skips "
                         "donating the slot cache there — on TPU both "
                         "generation programs donate it"))
+                    sups.append(Suppression(
+                        "decode", "AX007",
+                        "same CPU no-donation skip, exact-solver form: "
+                        "the lifetime solver proves the threaded slot "
+                        "cache (arg 3) donatable, and on TPU it IS "
+                        "donated — CPU cannot alias buffers"))
     finally:
         cc.set_audit_capture(prev_mode)
     return CanonicalSet(programs, sups, skipped)

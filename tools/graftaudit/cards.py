@@ -44,6 +44,17 @@ def build_card(ir_prog: ProgramIR) -> Dict:
                   "dead_after_call": i in dead}
                  for i, b in enumerate(ir_prog.arg_bytes)],
     }
+    # the lifetime solver's verdict (ISSUE 16): recorded like flops —
+    # reviewable PR over PR, but NOT in STABLE_FIELDS (the caller
+    # observation rides process GC timing; the pinned proof lives in
+    # tests/test_audit_diff.py and budgets.json instead)
+    lt = ir_prog.lifetime
+    lifetime = None if lt is None else {
+        "maximal_donation": sorted(lt.maximal_donation),
+        "undeclared_donatable": sorted(
+            set(lt.maximal_donation) - set(ir_prog.donate)),
+        "peak_live_bytes": ir_prog.peak_live_bytes,
+    }
     jaxpr = ir_prog.jaxpr
     return {
         "program": ir_prog.name,
@@ -54,6 +65,7 @@ def build_card(ir_prog: ProgramIR) -> Dict:
         "collectives": ir_prog.census,
         "census_source": ir_prog.census_source,
         "donation": donation,
+        "lifetime": lifetime,
         "flops": ir_prog.flops,
         "temp_bytes": ir_prog.temp_bytes,
         "max_eqn_out_bytes": IR.max_eqn_out_bytes(jaxpr),

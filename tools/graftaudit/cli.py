@@ -6,7 +6,9 @@ jaxpr / partitioned HLO), mirroring graftlint's CLI conventions:
 text/json/sarif output, a ratcheted (empty) baseline, exit 1 on
 findings, exit 2 on stale allowances.  ``--write-cards`` commits the
 per-program IR cards that make compiled-program diffs reviewable PR
-over PR.
+over PR; ``--diff-cards`` is the differential gate — rebuild, audit
+(AX010 card drift armed), check the budgets.json ceilings — that turns
+every silent IR regression into a CI failure.
 """
 from __future__ import annotations
 
@@ -18,6 +20,7 @@ from typing import List, Optional
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 DEFAULT_CARDS_DIR = os.path.join(os.path.dirname(__file__), "cards")
+DEFAULT_BUDGETS = os.path.join(os.path.dirname(__file__), "budgets.json")
 
 
 def _setup_jax_env() -> None:
@@ -43,7 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftaudit",
         description="IR-level static analyzer of the compiled program "
-                    "set: rules AX001-AX006 over the jaxpr + partitioned "
+                    "set: rules AX001-AX010 over the jaxpr + partitioned "
                     "HLO of the canonical programs (see tools/README.md)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
                    default="text")
@@ -62,6 +65,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cards-dir", default=DEFAULT_CARDS_DIR,
                    help="directory for --write-cards "
                         "(default: tools/graftaudit/cards)")
+    p.add_argument("--diff-cards", action="store_true",
+                   help="differential gate: rebuild the canonical set, "
+                        "diff the fresh audit against the committed "
+                        "cards (AX010) and the budgets.json ceilings "
+                        "(AX007/AX008); exit 1 on any breach, exit 2 on "
+                        "stale budget entries")
+    p.add_argument("--budgets", default=DEFAULT_BUDGETS,
+                   help="per-program IR budgets JSON for --diff-cards "
+                        "(default: tools/graftaudit/budgets.json)")
+    p.add_argument("--write-budgets", action="store_true",
+                   help="write ratchet-tight budget rows for the "
+                        "current audit to --budgets and exit (edit the "
+                        "file to keep a raise justified)")
     p.add_argument("--programs", default=None,
                    help="comma-separated name substrings: audit only "
                         "matching canonical programs")
@@ -96,12 +112,76 @@ def main(argv: Optional[List[str]] = None) -> int:
     config = CANONICAL_CONFIG
     if args.no_compile:
         config = dataclasses.replace(config, compile="never")
+    if args.diff_cards:
+        # the gate always diffs against the cards dir it was pointed at
+        config = dataclasses.replace(config, cards_dir=args.cards_dir)
+    budgets = None
+    if args.diff_cards or args.write_budgets:
+        from .diff import check_budgets, load_budgets
+        if args.diff_cards:
+            try:
+                budgets = load_budgets(args.budgets)
+            except (OSError, ValueError) as e:
+                # a gate without budgets is not a clean gate
+                print(f"graftaudit: cannot load budgets "
+                      f"({type(e).__name__}: {e}) — the diff gate "
+                      "refuses to run budget-less", file=sys.stderr)
+                return 2
     cs = build_canonical(include=include)
     if not cs.programs:
         build_parser().error("no canonical programs matched --programs")
     result = audit_programs(cs.programs, cs.suppressions, config)
     for name, why in sorted(cs.skipped.items()):
         print(f"graftaudit: skipped {name}: {why}", file=sys.stderr)
+
+    stale_budgets: List[str] = []
+    if budgets is not None:
+        # a --programs subset run leaves the NON-matching budgeted
+        # programs un-audited, not dead — but a row that matches the
+        # filter and still produced no program is as stale as ever
+        skipped_for_diff = dict(cs.skipped)
+        if include is not None:
+            audited = {ir_prog.name for ir_prog in result.irs}
+            for name in budgets.get("programs", {}):
+                if name not in audited and \
+                        not any(s in name for s in include):
+                    skipped_for_diff.setdefault(name, "--programs subset")
+        diff_findings, stale_budgets = check_budgets(
+            result.irs, budgets, skipped_for_diff)
+        result.findings = sorted(
+            result.findings + diff_findings,
+            key=lambda f: (f.path, f.rule, f.message))
+
+    if args.write_budgets:
+        from .diff import budget_entry
+        rows = {}
+        if os.path.exists(args.budgets):
+            try:
+                with open(args.budgets, "r", encoding="utf-8") as fh:
+                    rows = json.load(fh).get("programs", {})
+            except (OSError, ValueError):
+                rows = {}
+        # subset/skipped-host runs keep the other programs' rows (same
+        # rule as card pruning: reduced coverage is not deletion)
+        kept = {n: r for n, r in rows.items()
+                if include is not None or n in cs.skipped}
+        for ir_prog in result.irs:
+            kept[ir_prog.name] = budget_entry(ir_prog)
+        payload = {
+            "comment": "graftaudit per-program IR budgets "
+                       "(--diff-cards). Ceilings only RATCHET down "
+                       "automatically (--write-budgets records current "
+                       "values); raising one is a reviewed edit with a "
+                       "justifying comment, like a suppression. Stale "
+                       "entries (program gone) fail the gate with "
+                       "exit 2 — delete them.",
+            "programs": dict(sorted(kept.items())),
+        }
+        with open(args.budgets, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        print(f"wrote {len(result.irs)} budget row(s) to {args.budgets}")
+        return 0
 
     if args.write_cards:
         # a full-set run owns the cards dir and prunes orphans (renamed/
@@ -155,6 +235,14 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"finding — remove from {args.baseline}):", file=sys.stderr)
         for key in stale_bl:
             print(f"  {key}", file=sys.stderr)
+        rc = 2
+    if stale_budgets:
+        print(f"graftaudit: stale budget entr"
+              f"{'y' if len(stale_budgets) == 1 else 'ies'} (program no "
+              f"longer exists — remove from {args.budgets}):",
+              file=sys.stderr)
+        for name in stale_budgets:
+            print(f"  {name}", file=sys.stderr)
         rc = 2
     return rc
 

@@ -1,0 +1,145 @@
+"""The differential gate: fresh audit vs committed budgets.
+
+``python -m tools.graftaudit --diff-cards`` rebuilds the canonical set,
+audits it under ``CANONICAL_CONFIG`` (which arms AX010 card-drift
+against ``cards/`` and AX008 peak-live ceilings), and then runs THIS
+module's budget checks: per-program ceilings from ``budgets.json`` on
+collective bytes/counts, XLA temp bytes, dtype-histogram hazard
+entries, host-callback count, and the minimum donation map.  Every
+breach is a finding (AX008 for numeric ceilings, AX007 for a dropped
+budgeted donation) so the four classic silent IR regressions — an f64
+escape, a lost donation, a grown all-reduce, a new ``pure_callback`` —
+each fail the gate with the rule that names the bug.
+
+Ratchet semantics mirror the graftlint baseline: ceilings may only be
+raised in a PR that justifies the raise (budgets.json carries the
+comment), and a budget entry for a program that no longer exists (and
+is not an explicit host skip) is STALE — exit 2, delete it — so an
+allowance never lies in wait to absorb a future regression.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..graftlint.core import Finding
+from . import ir as IR
+from .audit import ProgramIR
+from .rules import _CALLBACK_PRIMS
+
+__all__ = ["load_budgets", "check_budgets", "budget_entry"]
+
+
+def load_budgets(path: str) -> Dict:
+    """Parse budgets.json; raises (never returns empty) on a missing or
+    malformed file — the gate must fail loudly, not run budget-less."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data.get("programs"), dict) or not data["programs"]:
+        raise ValueError(f"{path}: no 'programs' budget map")
+    return data
+
+
+def _finding(name: str, code: str, msg: str) -> Finding:
+    return Finding(path=name, line=0, col=0, rule=code, message=msg)
+
+
+def _census_totals(ir_prog: ProgramIR) -> Tuple[int, int]:
+    by = sum(int(row.get("bytes", 0)) for row in ir_prog.census.values())
+    ct = sum(int(row.get("count", 0)) for row in ir_prog.census.values())
+    return by, ct
+
+
+def _callback_count(ir_prog: ProgramIR) -> int:
+    return sum(1 for e in IR.iter_eqns(ir_prog.jaxpr)
+               if e.primitive.name in _CALLBACK_PRIMS)
+
+
+def _check_one(ir_prog: ProgramIR, row: Dict) -> List[Finding]:
+    out: List[Finding] = []
+    name = ir_prog.name
+
+    def over(metric: str, value, ceiling) -> None:
+        out.append(_finding(
+            name, "AX008",
+            f"budget breach: {metric} {value} exceeds the ceiling "
+            f"{ceiling} in budgets.json — fix the regression or raise "
+            "the ceiling with a justifying comment (ratchet: raises "
+            "need review, never silence)"))
+
+    cbytes, ccount = _census_totals(ir_prog)
+    if row.get("collective_bytes") is not None and \
+            cbytes > int(row["collective_bytes"]):
+        over("collective bytes", cbytes, int(row["collective_bytes"]))
+    if row.get("collective_count") is not None and \
+            ccount > int(row["collective_count"]):
+        over("collective count", ccount, int(row["collective_count"]))
+    if row.get("temp_bytes") is not None and \
+            ir_prog.temp_bytes is not None and \
+            ir_prog.temp_bytes > int(row["temp_bytes"]):
+        over("XLA temp bytes", ir_prog.temp_bytes, int(row["temp_bytes"]))
+    if row.get("callbacks") is not None:
+        n = _callback_count(ir_prog)
+        if n > int(row["callbacks"]):
+            over("host callback eqns", n, int(row["callbacks"]))
+    dtype_ceilings = row.get("dtypes") or {}
+    if dtype_ceilings:
+        hist = IR.dtype_histogram(ir_prog.jaxpr)
+        for dt, ceiling in sorted(dtype_ceilings.items()):
+            n = int(hist.get(dt, 0))
+            if n > int(ceiling):
+                over(f"'{dt}' eqn outputs", n, int(ceiling))
+    for argnum in row.get("donation_min", ()):
+        if int(argnum) not in ir_prog.donate:
+            out.append(_finding(
+                name, "AX007",
+                f"budgeted donation dropped: arg {argnum} is in "
+                "budgets.json donation_min but no longer in "
+                f"donate_argnums{tuple(ir_prog.donate)} — the input/"
+                "output aliasing this program was reviewed with is gone"))
+    return out
+
+
+def check_budgets(irs: Sequence[ProgramIR], budgets: Dict,
+                  skipped: Optional[Dict[str, str]] = None
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Budget checks over a fresh audit.  Returns ``(findings,
+    stale_budget_names)``; a budgeted program absent from ``irs`` is
+    stale UNLESS it is in ``skipped`` (the host explicitly could not
+    build it — reduced coverage, recorded, not a dead entry)."""
+    skipped = skipped or {}
+    by_name = {ir_prog.name: ir_prog for ir_prog in irs}
+    findings: List[Finding] = []
+    stale: List[str] = []
+    for name, row in sorted(budgets.get("programs", {}).items()):
+        ir_prog = by_name.get(name)
+        if ir_prog is None:
+            if name not in skipped:
+                stale.append(name)
+            continue
+        findings.extend(_check_one(ir_prog, row))
+    findings.sort(key=lambda f: (f.path, f.rule, f.message))
+    return findings, stale
+
+
+def budget_entry(ir_prog: ProgramIR) -> Dict:
+    """A fresh ratchet-tight budget row for one program — what
+    ``--write-budgets`` records: current values as ceilings (collective
+    exactness comes free — the census is deterministic per version),
+    with headroom only where the metric legitimately jitters across
+    hosts (peak-live scalars under x64, XLA temp allocation)."""
+    cbytes, ccount = _census_totals(ir_prog)
+    peak = ir_prog.peak_live_bytes
+    hist = IR.dtype_histogram(ir_prog.jaxpr)
+    return {
+        "collective_bytes": cbytes,
+        "collective_count": ccount,
+        "temp_bytes": None if ir_prog.temp_bytes is None
+        else int(ir_prog.temp_bytes * 2),
+        "callbacks": _callback_count(ir_prog),
+        "dtypes": {dt: int(hist.get(dt, 0))
+                   for dt in ("float64", "complex128")},
+        "donation_min": sorted(ir_prog.donate),
+        "peak_live_bytes": None if peak is None else int(peak * 1.25),
+    }

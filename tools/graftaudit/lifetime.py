@@ -1,0 +1,300 @@
+"""Buffer-lifetime / donation solver: the exact answer AX005 estimates.
+
+AX005's size-threshold heuristic asks "is this dead-after-call argument
+big enough to care about?".  This module computes what is *actually*
+safe and useful to donate, per compiled program, from three independent
+sources of truth:
+
+1. **jaxpr def-use** (reusing ``ir.py``'s walkers): per-argument
+   last-use over the top-level equation order — an argument consumed
+   only by equation 3 of 40 is garbage for the remaining 37, whether or
+   not anyone declared it donatable.
+2. **Output aliasing compatibility**: donation only pays when XLA can
+   alias the donated input buffer to an output of identical
+   shape/dtype (the train step's fresh params reuse the old params'
+   buffers leaf for leaf).  The solver injectively matches each
+   candidate argument's array leaves against the program's unclaimed
+   output leaves; an argument with no full match (serve's padded batch:
+   no output shares its shape) is dead but not *usefully* donatable.
+3. **Observed caller liveness** (``InstrumentedJit.audit_liveness``):
+   weakref probes recorded at call time show whether the caller's
+   bindings were still alive at audit time.  ``"dead"`` upgrades an
+   argument into the candidate set even without a kind contract;
+   ``"live"`` vetoes donation even when the contract says dead (a
+   device-resident dataset re-fed every epoch must never be donated);
+   ``"unknown"`` falls back to the ``DEAD_AFTER_CALL`` kind contract.
+
+The intersection — caller-dead AND fully alias-matched — is the
+*maximal safe donation set*, AX007's exact yardstick against
+``donate_argnums``.  The same def-use pass yields a peak-live-bytes
+estimate (live-range interval sweep over the eqn order, sub-jaxpr
+scopes contributing their internal peaks at the enclosing equation —
+scan carries included), AX008's subject.
+
+Sharding note: jaxpr avals carry no sharding, so leaf matching is on
+(shape, dtype).  For the programs this runs on, params/opt-state in-
+and outputs share their shardings by construction (the same
+NamedSharding tree threads through), so (shape, dtype) equality is the
+honest portable criterion; a sharding-mismatched alias would surface as
+a compile-time donation warning long before this analysis.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import ir as IR
+
+__all__ = ["ArgLifetime", "LifetimeInfo", "solve_lifetime",
+           "peak_live_bytes", "spec_variant_group"]
+
+
+@dataclass(frozen=True)
+class ArgLifetime:
+    """Lifetime facts for ONE positional argument of one program."""
+    argnum: int
+    bytes: int                  # total array-leaf bytes of the binding
+    leaves: int                 # array leaf count (0 = pure scalar arg)
+    last_use: int               # top-level eqn index of the last read;
+                                # -1 = never read, len(eqns) = returned
+    returned: bool              # some leaf IS a program output (alias)
+    matched: bool               # every array leaf found a compatible
+                                # unclaimed output leaf (donation pays)
+    caller: str                 # "dead" | "live" | "unknown" (observed)
+    contract_dead: bool         # the kind contract says dead-after-call
+    donatable: bool             # in the maximal safe donation set
+
+
+@dataclass(frozen=True)
+class LifetimeInfo:
+    args: Tuple[ArgLifetime, ...]
+    maximal_donation: Tuple[int, ...]
+    peak_live_bytes: int
+
+
+def _arg_leaf_avals(jaxpr, spec) -> List[List[Any]]:
+    """Invar avals grouped per positional argument.
+
+    ``make_jaxpr`` flattens ``(args, kwargs)`` in order, so the first
+    ``len(tree_leaves(args[i]))`` invars belong to arg 0, and so on;
+    kwargs leaves (if any) trail and are not donation candidates
+    (jax only donates positional argnums)."""
+    import jax
+
+    args, _kwargs = spec
+    groups: List[List[Any]] = []
+    pos = 0
+    invars = list(jaxpr.invars)
+    for a in args:
+        n = len(jax.tree_util.tree_leaves(a))
+        groups.append([v.aval for v in invars[pos:pos + n]])
+        pos += n
+    return groups
+
+
+def _aval_key(aval) -> Optional[Tuple]:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    return (tuple(shape), str(dtype))
+
+
+def _last_uses(jaxpr) -> Dict[Any, int]:
+    """Top-level last-use position per var: eqn index, or ``len(eqns)``
+    for vars read by the program's outputs.  Sub-jaxpr reads count at
+    their enclosing equation (the operand list of the scan/pjit eqn)."""
+    last: Dict[Any, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for iv in eqn.invars:
+            if hasattr(iv, "val"):
+                continue                      # Literal
+            last[iv] = i
+    n = len(jaxpr.eqns)
+    for ov in jaxpr.outvars:
+        if hasattr(ov, "val"):
+            continue
+        last[ov] = n
+    return last
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Estimated peak of simultaneously-live buffer bytes over the
+    top-level equation order: each var's bytes are live from its
+    defining equation through its last use; a sub-jaxpr (scan body,
+    pjit call, cond branch) contributes its own internal peak at the
+    enclosing equation's position, so scan carries and loop-internal
+    temporaries count where they actually coexist with the outer live
+    set.  An estimate — XLA's fusion/rematerialization moves the real
+    number both ways — but a *monotone* one: a change that doubles the
+    live params or forgets a donation moves it the same direction at
+    both fidelities."""
+    return _scope_peak(jaxpr, count_invars=True)
+
+
+def _scope_peak(jaxpr, count_invars: bool) -> int:
+    eqns = list(jaxpr.eqns)
+    last = _last_uses(jaxpr)
+    add: Dict[int, int] = {}
+    remove: Dict[int, int] = {}
+
+    def _alloc(v, def_pos: int) -> None:
+        b = IR.aval_bytes(v)
+        if b <= 0:
+            return
+        add[def_pos] = add.get(def_pos, 0) + b
+        # never-read vars die where they were defined
+        remove[last.get(v, def_pos)] = \
+            remove.get(last.get(v, def_pos), 0) + b
+
+    if count_invars:
+        for v in jaxpr.invars:
+            _alloc(v, -1)
+    for v in getattr(jaxpr, "constvars", ()):
+        _alloc(v, -1)
+    for i, eqn in enumerate(eqns):
+        for ov in eqn.outvars:
+            _alloc(ov, i)
+
+    live = add.get(-1, 0)
+    peak = live
+    for i, eqn in enumerate(eqns):
+        live += add.get(i, 0)
+        sub_extra = 0
+        subs: List = []
+        for v in eqn.params.values():
+            IR._sub_jaxprs(v, subs)
+        for sub in subs:
+            # sub invars map to outer operands already counted here
+            sub_extra = max(sub_extra,
+                            _scope_peak(sub, count_invars=False))
+        peak = max(peak, live + sub_extra)
+        live -= remove.get(i, 0)
+    return peak
+
+
+def solve_lifetime(jaxpr, spec, donate: Sequence[int] = (),
+                   entry: Any = None,
+                   contract_dead: Sequence[int] = ()) -> LifetimeInfo:
+    """Solve per-argument lifetimes and the maximal safe donation set
+    for one program (see module docstring for the three fact sources).
+
+    ``entry`` is the program's ``InstrumentedJit`` (or anything with an
+    ``audit_liveness(spec)``); ``contract_dead`` the kind contract
+    (``rules.DEAD_AFTER_CALL``) used when no liveness was observed."""
+    groups = _arg_leaf_avals(jaxpr, spec)
+    last = _last_uses(jaxpr)
+    out_ids = {id(v) for v in jaxpr.outvars if not hasattr(v, "val")}
+
+    liveness: Tuple[str, ...] = ()
+    if entry is not None:
+        try:
+            liveness = tuple(entry.audit_liveness(spec))
+        except Exception:
+            liveness = ()
+
+    # output leaf pool for aliasing compatibility (multiset of
+    # shape/dtype keys; each output leaf claimable once)
+    pool: Counter = Counter()
+    for ov in jaxpr.outvars:
+        if hasattr(ov, "val"):
+            continue
+        key = _aval_key(getattr(ov, "aval", None))
+        if key is not None:
+            pool[key] += 1
+
+    # provisional per-arg facts, then greedy matching biggest-first so
+    # the params tree claims its outputs before a same-shaped small arg
+    facts: List[Dict[str, Any]] = []
+    invar_pos = 0
+    invars = list(jaxpr.invars)
+    for argnum, avals in enumerate(groups):
+        my_invars = invars[invar_pos:invar_pos + len(avals)]
+        invar_pos += len(avals)
+        arr_keys = [k for k in (_aval_key(a) for a in avals)
+                    if k is not None]
+        size = sum(IR.aval_bytes(a) for a in avals)
+        uses = [last.get(v, -1) for v in my_invars]
+        status = liveness[argnum] if argnum < len(liveness) else "unknown"
+        in_contract = argnum in tuple(contract_dead)
+        facts.append({
+            "argnum": argnum, "bytes": size, "leaves": len(arr_keys),
+            "last_use": max(uses) if uses else -1,
+            "returned": any(id(v) in out_ids for v in my_invars),
+            "need": Counter(arr_keys),
+            "caller": status, "contract_dead": in_contract,
+            "dead": status == "dead"
+            or (status == "unknown" and in_contract),
+        })
+
+    for f in sorted(facts, key=lambda f: -f["bytes"]):
+        need = f["need"]
+        f["matched"] = bool(need) and f["dead"] and \
+            all(pool[k] >= c for k, c in need.items())
+        if f["matched"]:
+            pool -= need
+
+    args = tuple(ArgLifetime(
+        argnum=f["argnum"], bytes=f["bytes"], leaves=f["leaves"],
+        last_use=f["last_use"], returned=f["returned"],
+        matched=bool(f.get("matched")), caller=f["caller"],
+        contract_dead=f["contract_dead"],
+        donatable=f["dead"] and bool(f.get("matched")) and f["bytes"] > 0,
+    ) for f in facts)
+    return LifetimeInfo(
+        args=args,
+        maximal_donation=tuple(a.argnum for a in args if a.donatable),
+        peak_live_bytes=peak_live_bytes(jaxpr))
+
+
+# ------------------------------------------------------- variant grouping
+def _variant_key(spec) -> Tuple:
+    """Spec identity with Python-scalar values and weak-typed 0-d leaves
+    erased: two captured specs with equal variant keys but distinct
+    capture keys compile (or at least re-dispatch) the SAME program
+    modulo a scalar's value/weak-type — the avoidable variant explosion
+    AX009 exists to flag.  0-d ShapeDtypeStructs collapse into the same
+    bucket as raw Python scalars so ``1.0`` vs ``np.float32(1.0)``
+    (a genuine retrace: weak vs committed dtype) is caught too."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(spec)
+    norm: List[Tuple] = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.ShapeDtypeStruct) and \
+                tuple(leaf.shape) != ():
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None:
+                norm.append(("sds", tuple(leaf.shape), str(leaf.dtype),
+                             str(sh.spec),
+                             tuple(sh.mesh.shape.items())))
+            else:
+                norm.append(("sds", tuple(leaf.shape), str(leaf.dtype),
+                             None, None))
+        else:
+            norm.append(("scalar",))
+    return (treedef, tuple(norm))
+
+
+def spec_variant_group(entry, spec) -> Tuple[int, List[str]]:
+    """How many of ``entry``'s captured specs differ from ``spec`` only
+    by Python-scalar value / weak-typed 0-d leaves, and the repr of the
+    churning leaves (for the finding message).  ``(1, [])`` = no churn."""
+    import jax
+
+    try:
+        mine = _variant_key(spec)
+        variants = [s for s in entry.audit_specs()
+                    if _variant_key(s) == mine]
+    except Exception:
+        return (1, [])
+    if len(variants) <= 1:
+        return (1, [])
+    churn: List[str] = []
+    rows = [jax.tree_util.tree_flatten(s)[0] for s in variants]
+    for pos in range(min(len(r) for r in rows)):
+        vals = {repr(r[pos]) for r in rows}
+        if len(vals) > 1:
+            churn.append(f"arg leaf {pos}: {sorted(vals)[:4]}")
+    return (len(variants), churn)

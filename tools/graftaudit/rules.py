@@ -1,4 +1,4 @@
-"""graftaudit rule pack AX001–AX006.
+"""graftaudit rule pack AX001–AX010.
 
 Each rule is ``rule(ir: ProgramIR) -> list[Finding]`` over the analyzed
 IR of ONE compiled program (``audit.analyze_program``), registered in
@@ -291,4 +291,135 @@ def ax006(ir_prog) -> List[Finding]:
             f">= {cfg.broadcast_ratio}x smaller operand: likely a "
             "materialize-then-reduce — restructure to reduce without "
             "the full intermediate"))
+    return out
+
+
+# --------------------------------------------------------------------- AX007
+@rule("AX007", "declared-donation incompleteness: the lifetime solver's "
+               "maximal safe donation set exceeds donate_argnums")
+def ax007(ir_prog) -> List[Finding]:
+    """The exact form of AX005's threshold heuristic (which stays as the
+    cheap pre-filter): the lifetime solver proved these arguments are
+    (a) dead after the call — the caller's bindings were observed
+    collected/donated, or the kind contract says so and no observation
+    contradicts it — and (b) *usefully* donatable: every array leaf has
+    a shape/dtype-compatible unclaimed output leaf for XLA to alias
+    into.  Each one not in ``donate_argnums`` keeps input AND output
+    alive across the execution for no reason — on a train step that is
+    a whole extra params+opt-state of HBM.  Unlike AX005 this cannot
+    cry wolf on an argument donation would not help (no aliasable
+    output) or one the caller actually re-reads (observed live)."""
+    out: List[Finding] = []
+    lt = ir_prog.lifetime
+    if lt is None:
+        return out
+    for a in lt.args:
+        if not a.donatable or a.argnum in ir_prog.donate:
+            continue
+        if a.bytes < ir_prog.config.min_donate_bytes:
+            continue
+        out.append(_finding(
+            ir_prog, "AX007",
+            f"arg {a.argnum} ({a.bytes} bytes, caller {a.caller}"
+            f"{', contract-dead' if a.contract_dead else ''}) is in the "
+            f"maximal safe donation set but not donate_argnums"
+            f"{tuple(ir_prog.donate)}: every leaf has an aliasable "
+            "output — donate it (or suppress for the platform that "
+            "cannot, with justification)"))
+    return out
+
+
+# --------------------------------------------------------------------- AX008
+@rule("AX008", "per-program IR budget exceeded: peak-live-bytes (this "
+               "rule) or a collective/temp/dtype/callback ceiling (the "
+               "--diff-cards gate, same code)")
+def ax008(ir_prog) -> List[Finding]:
+    """The lifetime solver's peak-live-bytes estimate (live-range
+    intervals over the eqn order, scan carries included) checked
+    against a per-program ceiling — the ``peak_live_bytes`` entries of
+    ``budgets.json``, threaded through ``AuditConfig``.  An unbudgeted
+    program is silent (budgets are opt-in); a budgeted one that grew
+    past its ceiling fails, because a silent 2x in live bytes is
+    exactly how an OOM ships: no Python line changed, only the compiled
+    program's live set."""
+    out: List[Finding] = []
+    budgets = ir_prog.config.peak_live_budgets
+    if not budgets or ir_prog.peak_live_bytes is None:
+        return out
+    ceiling = budgets.get(ir_prog.name)
+    if ceiling is None or ir_prog.peak_live_bytes <= int(ceiling):
+        return out
+    out.append(_finding(
+        ir_prog, "AX008",
+        f"peak-live-bytes estimate {ir_prog.peak_live_bytes} exceeds "
+        f"the budget ceiling {int(ceiling)}: the program's live set "
+        "grew — find the new/longer-lived buffer (lost donation, new "
+        "mirror, wider dtype) or raise the ceiling in budgets.json "
+        "with a justifying comment"))
+    return out
+
+
+# --------------------------------------------------------------------- AX009
+@rule("AX009", "recompile-hazard call variants: captured specs differing "
+               "only by Python-scalar value / weak-typed 0-d leaf")
+def ax009(ir_prog) -> List[Finding]:
+    """Multiple captured call specs of this entry collapse onto ONE
+    program once Python-scalar values and weak-typed 0-d leaves are
+    erased: the call sites are feeding raw Python scalars (or mixing
+    ``1.0`` with ``np.float32(1.0)``) where a committed dtype belongs.
+    Each variant is at best a redundant dispatch-cache entry crowding
+    the audit spec ring, at worst a full retrace (weak-type flips, int
+    vs float) — the classic \"temperature knob retraces the decode
+    step\" bug.  Commit the scalar at the call boundary
+    (``np.float32(x)``) so every value rides one compiled program."""
+    out: List[Finding] = []
+    if ir_prog.variant_count <= 1:
+        return out
+    detail = "; ".join(ir_prog.variant_churn[:3]) or "0-d leaves"
+    out.append(_finding(
+        ir_prog, "AX009",
+        f"{ir_prog.variant_count} captured call specs differ only by "
+        f"Python-scalar value / weak-typed 0-d leaves ({detail}): "
+        "commit the scalar to a fixed np dtype at the call boundary so "
+        "one compiled variant serves every value"))
+    return out
+
+
+# --------------------------------------------------------------------- AX010
+@rule("AX010", "committed-card drift: fresh audit disagrees with the "
+               "checked-in program card on a stable field")
+def ax010(ir_prog) -> List[Finding]:
+    """The committed cards under ``tools/graftaudit/cards/`` are the
+    reviewed IR record of each canonical program; this rule is the
+    enforcement arm: any stable-field disagreement between the FRESH
+    audit and the committed card (collective census, donation map,
+    kind/policy flags) — or a missing card — is a finding, so an IR
+    regression must either be fixed or land as a reviewable card diff
+    (``--write-cards``), never as silent drift.  Only runs when
+    ``AuditConfig.cards_dir`` is set (the canonical/gate path)."""
+    out: List[Finding] = []
+    cards_dir = ir_prog.config.cards_dir
+    if not cards_dir:
+        return out
+    import os
+
+    from .cards import STABLE_FIELDS, build_card, card_filename, load_card
+
+    path = os.path.join(cards_dir, card_filename(ir_prog.name))
+    if not os.path.exists(path):
+        out.append(_finding(
+            ir_prog, "AX010",
+            f"no committed card at {path}: run --write-cards and commit "
+            "the new program's card"))
+        return out
+    committed = load_card(path)
+    fresh = build_card(ir_prog)
+    for fld in STABLE_FIELDS:
+        if fresh.get(fld) != committed.get(fld):
+            out.append(_finding(
+                ir_prog, "AX010",
+                f"stable field '{fld}' drifted from the committed card: "
+                f"card has {committed.get(fld)!r}, fresh audit has "
+                f"{fresh.get(fld)!r} — fix the regression or commit the "
+                "reviewed card diff (--write-cards)"))
     return out

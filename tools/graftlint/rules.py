@@ -1,6 +1,6 @@
 """graftlint rule implementations.
 
-Module-local rules JX001–JX017 and JX022–JX027 are functions ``rule(info:
+Module-local rules JX001–JX017 and JX022–JX028 are functions ``rule(info:
 ModuleInfo) -> list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
 machinery in ``analysis.py`` (memoized per module, so every rule runs off
 one parse and one tree walk).  The whole-program concurrency pack
@@ -1493,6 +1493,68 @@ def jx027(info: ModuleInfo) -> List[Finding]:
                 "SparseRows; the train step's densified exchange), or "
                 "pragma a deliberate host-side densification with its "
                 "justification"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX028
+# scope: every non-test package module EXCEPT nn/compile_cache.py — the
+# one module allowed to touch jax.jit directly, because it is the
+# counted/recorded/auditable compile path everything else must route
+# through.  A stray jax.jit elsewhere compiles programs graftaudit
+# never sees: no compile counters, no captured call specs (so no
+# caller-liveness for the AX007 donation solver), no cards.
+_JX028_COMPILE_CACHE_RE = re.compile(r"(^|[/\\])nn[/\\]compile_cache\.py$")
+_JX028_WRAPPERS = frozenset(("jit", "pmap"))
+
+
+@rule("JX028", "stray jax.jit/jax.pmap outside nn/compile_cache.py in a "
+               "non-test package module")
+def jx028(info: ModuleInfo) -> List[Finding]:
+    """Flag every reference to ``jax.jit`` / ``jax.pmap`` (dotted
+    through a jax alias — covering direct calls, bare ``@jax.jit``
+    decorators, and ``functools.partial(jax.jit, ...)`` — and the bare
+    ``from jax import jit/pmap`` import) in any non-test package module
+    other than ``nn/compile_cache.py``.  All steady-state program
+    construction must go through ``InstrumentedJit``/``audit_lower``:
+    that is where compiles are counted (AX006 churn), call specs are
+    recorded (the AX007 caller-liveness probe), and the trace cache the
+    IR audit + cards walk is populated.  A raw ``jax.jit`` is an
+    invisible second compile cache — its programs never reach the
+    differential gate.  Deliberate exceptions (a one-shot capability
+    probe, a static-argnames kernel wrapper InstrumentedJit does not
+    support yet) carry a pragma with the justification; test modules
+    are out of scope."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if _JX026_TEST_PATH_RE.search(path) or \
+            _JX028_COMPILE_CACHE_RE.search(path):
+        return out
+    for node in info.nodes(ast.ImportFrom):
+        if (node.module or "") != "jax":
+            continue
+        for alias in node.names:
+            if alias.name in _JX028_WRAPPERS:
+                out.append(_finding(
+                    info, node, "JX028",
+                    f"`from jax import {alias.name}`: route program "
+                    "construction through nn/compile_cache "
+                    "(InstrumentedJit) — a raw jit/pmap is an unaudited "
+                    "compile path (no counters, no call specs, no IR "
+                    "cards)"))
+    for node in info.nodes(ast.Attribute):
+        name = dotted_name(node)
+        if not name:
+            continue
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] in info.jax_aliases and \
+                parts[1] in _JX028_WRAPPERS:
+            out.append(_finding(
+                info, node, "JX028",
+                f"`{name}` outside nn/compile_cache.py: this compiles a "
+                "program graftaudit never sees (no compile counters, no "
+                "recorded call specs for the donation solver, no card) "
+                "— use InstrumentedJit, or pragma a deliberate "
+                "exception with its justification"))
     return _dedupe(out)
 
 

@@ -1,10 +1,38 @@
-import gzip, json, re, sys
+"""Attribute the busiest device track's fusions to HLO computations.
+
+Usage: ``python -m tools.trace_top_ops TRACE.json.gz [HLO_PATH|bench]``
+
+``bench`` (the default when HLO_PATH is omitted) re-extracts the bench
+train-step HLO through the one extraction path
+(``tools/graftaudit/extract.py`` — the same ``iter_trace_cache`` +
+``audit_lower`` pair dump_hlo and the graftaudit HLO phase use), so the
+computation names match the program the profiled process compiled.
+"""
+import gzip
+import json
+import re
+import sys
 from collections import defaultdict
 
-trace_path, hlo_path = sys.argv[1], sys.argv[2]
+
+def _load_hlo(arg: str) -> str:
+    if arg != "bench":
+        return open(arg).read()
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import available_bench_model
+    from tools.graftaudit.extract import iter_trace_cache_hlo
+
+    model, (x, y) = available_bench_model(batch=256, image=224)
+    model.fit(jnp.asarray(x), jnp.asarray(y))
+    exs = list(iter_trace_cache_hlo(kinds=("train_step",)))
+    assert exs, "no train_step in the trace cache after fit()"
+    return exs[-1].hlo_text
+
+
+trace_path = sys.argv[1]
+hlo = _load_hlo(sys.argv[2] if len(sys.argv) > 2 else "bench")
 with gzip.open(trace_path, "rt") as f:
     events = json.load(f)["traceEvents"]
-hlo = open(hlo_path).read()
 comps = {}
 for m in re.finditer(r"^(?:ENTRY )?%?([\w.\-]+)(?: \([^)]*\))? -> ([^\n{]+)\{\n(.*?)^\}", hlo, re.M | re.S):
     comps[m.group(1)] = (m.group(2), m.group(3))
