@@ -103,6 +103,18 @@ with the counter-verified zero-recompile steady state; the acceptance
 claim is the densified path winning at vocab >= 50k with <= 10%
 touched rows, with ``word2vec_words_per_sec`` as the side-bench
 acceptance metric); DL4J_TPU_BENCH_EMBED=0 suppresses it.
+
+A fifteenth JSON line records the step-profiler overhead benchmark
+(``profiler_overhead_ms``: steady per-step train time with the default-on
+StepProfiler armed vs ``DL4J_TPU_STEPPROF=0``, paired-arm design, plus
+the fully-fenced phase-attribution coverage check — the profiler's own
+<2% claim, measured not asserted); DL4J_TPU_BENCH_STEPPROF=0 suppresses
+it.
+
+Every printed row carries an ``env`` provenance block (cpu count,
+at-start load average, jax/jaxlib versions, x64 flag, DL4J_TPU_*
+overrides in effect) so round-over-round comparisons can separate
+framework regressions from environment drift.
 """
 import json
 import os
@@ -118,6 +130,25 @@ BASELINE_EXAMPLES_PER_SEC = 2055.4
 FAIL_THRESHOLD = 0.95
 
 
+def _stamp(row):
+    """Attach the host/runtime provenance block (ISSUE 17 satellite) to a
+    bench row in place: cpu count, at-start load average, jax/jaxlib
+    versions, the x64 flag, and every DL4J_TPU_* override in effect —
+    the facts that separate framework regressions from environment
+    drift.  Best-effort: a row must never be lost to its fingerprint."""
+    try:
+        from deeplearning4j_tpu.utils.benchmarks import env_fingerprint
+        row.setdefault("env", env_fingerprint())
+    except Exception:
+        pass
+    return row
+
+
+def _dumps(row) -> str:
+    """One stamped bench JSON line (every printed row goes through here)."""
+    return json.dumps(_stamp(row))
+
+
 def _wait_for_tpu(max_wait_s: float = 600.0, probe_timeout_s: float = 90.0):
     """A killed chip process can wedge the axon relay, after which any
     jax init HANGS (BENCH_NOTES "tunnel health") — probe in a subprocess
@@ -130,7 +161,7 @@ def _wait_for_tpu(max_wait_s: float = 600.0, probe_timeout_s: float = 90.0):
     last_err = ""
 
     def bail(error: str, detail: str) -> bool:
-        print(json.dumps({
+        print(_dumps({
             "metric": "train_examples_per_sec", "value": None,
             "unit": "examples/sec", "vs_baseline": None,
             "error": error, "detail": detail}))
@@ -208,7 +239,7 @@ def main():
 
     examples_per_sec = float(np.median(rates))
     vs_baseline = examples_per_sec / BASELINE_EXAMPLES_PER_SEC
-    print(json.dumps({
+    print(_dumps({
         "metric": "train_examples_per_sec",
         "value": round(examples_per_sec, 2),
         "unit": "examples/sec",
@@ -231,9 +262,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import \
                 input_pipeline_examples_per_sec
-            print(json.dumps(input_pipeline_examples_per_sec()))
+            print(_dumps(input_pipeline_examples_per_sec()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "input_pipeline_examples_per_sec",
+            print(_dumps({"metric": "input_pipeline_examples_per_sec",
                               "value": None, "unit": "examples/sec",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -242,9 +273,9 @@ def main():
     if os.environ.get("DL4J_TPU_BENCH_COMPILE", "1") != "0":
         try:
             from deeplearning4j_tpu.utils.benchmarks import compile_reuse
-            print(json.dumps(compile_reuse()))
+            print(_dumps(compile_reuse()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "compile_reuse", "value": None,
+            print(_dumps({"metric": "compile_reuse", "value": None,
                               "unit": "x cold/clone first-step",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -254,9 +285,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import \
                 checkpoint_overhead
-            print(json.dumps(checkpoint_overhead()))
+            print(_dumps(checkpoint_overhead()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "checkpoint_overhead", "value": None,
+            print(_dumps({"metric": "checkpoint_overhead", "value": None,
                               "unit": "ms/save async stall (idle writer)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -268,9 +299,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import step_time_ms
             for row in step_time_ms():
-                print(json.dumps(row))
+                print(_dumps(row))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "step_time_ms", "value": None,
+            print(_dumps({"metric": "step_time_ms", "value": None,
                               "unit": "ms/step (auto policy)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -280,9 +311,9 @@ def main():
     if os.environ.get("DL4J_TPU_BENCH_RECOVERY", "1") != "0":
         try:
             from deeplearning4j_tpu.utils.benchmarks import recovery_time_ms
-            print(json.dumps(recovery_time_ms()))
+            print(_dumps(recovery_time_ms()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "recovery_time_ms", "value": None,
+            print(_dumps({"metric": "recovery_time_ms", "value": None,
                               "unit": "ms kill -> first post-recovery step "
                                       "(sync retry)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
@@ -294,9 +325,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import serve_latency_ms
             for row in serve_latency_ms():
-                print(json.dumps(row))
+                print(_dumps(row))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "serve_latency_ms", "value": None,
+            print(_dumps({"metric": "serve_latency_ms", "value": None,
                               "unit": "ms p50",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -307,9 +338,9 @@ def main():
     if os.environ.get("DL4J_TPU_BENCH_LINT", "1") != "0":
         try:
             from deeplearning4j_tpu.utils.benchmarks import lint_time_ms
-            print(json.dumps(lint_time_ms()))
+            print(_dumps(lint_time_ms()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "lint_time_ms", "value": None,
+            print(_dumps({"metric": "lint_time_ms", "value": None,
                               "unit": "ms full-package graftlint",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -323,9 +354,9 @@ def main():
             # leftover heap can't inflate the paired deltas via LLC
             # pressure (the claim is about the forensics layer, not
             # this process's memory state)
-            print(json.dumps(obs_overhead_ms(isolate=True)))
+            print(_dumps(obs_overhead_ms(isolate=True)))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "obs_overhead_ms", "value": None,
+            print(_dumps({"metric": "obs_overhead_ms", "value": None,
                               "unit": "ms/step recorder+monitor enabled",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -338,9 +369,9 @@ def main():
             from deeplearning4j_tpu.utils.benchmarks import \
                 decode_tokens_per_sec
             for row in decode_tokens_per_sec():
-                print(json.dumps(row))
+                print(_dumps(row))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "decode_tokens_per_sec",
+            print(_dumps({"metric": "decode_tokens_per_sec",
                               "value": None, "unit": "tokens/sec",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
 
@@ -351,9 +382,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import \
                 sharded_step_time_ms
-            print(json.dumps(sharded_step_time_ms()))
+            print(_dumps(sharded_step_time_ms()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "sharded_step_time_ms",
+            print(_dumps({"metric": "sharded_step_time_ms",
                               "value": None,
                               "unit": "ms/step (ZeRO-3 sharded)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
@@ -365,9 +396,9 @@ def main():
         try:
             from deeplearning4j_tpu.utils.benchmarks import \
                 elastic_reshard_ms
-            print(json.dumps(elastic_reshard_ms()))
+            print(_dumps(elastic_reshard_ms()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "elastic_reshard_ms",
+            print(_dumps({"metric": "elastic_reshard_ms",
                               "value": None,
                               "unit": "ms member loss -> first clean "
                                       "sharded step (survivor mesh)",
@@ -379,9 +410,9 @@ def main():
     if os.environ.get("DL4J_TPU_BENCH_AUDIT", "1") != "0":
         try:
             from deeplearning4j_tpu.utils.benchmarks import audit_time_ms
-            print(json.dumps(audit_time_ms()))
+            print(_dumps(audit_time_ms()))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "audit_time_ms", "value": None,
+            print(_dumps({"metric": "audit_time_ms", "value": None,
                               "unit": "ms full canonical-set IR audit "
                                       "(build + audit)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
@@ -395,13 +426,28 @@ def main():
             from deeplearning4j_tpu.utils.benchmarks import \
                 embedding_grad_exchange_ms
             for row in embedding_grad_exchange_ms():
-                print(json.dumps(row))
+                print(_dumps(row))
         except Exception as e:  # never let the side row break the headline
-            print(json.dumps({"metric": "embedding_grad_exchange_ms",
+            print(_dumps({"metric": "embedding_grad_exchange_ms",
                               "value": None,
                               "unit": "ms/step (densified index/value "
                                       "exchange, row-sharded table)",
                               "error": f"{type(e).__name__}: {e}"[:300]}))
+
+    # step-profiler overhead row (ISSUE 17): StepProfiler armed vs
+    # DL4J_TPU_STEPPROF=0, paired arms + phase-coverage honesty check;
+    # a fifteenth JSON line, opt-out DL4J_TPU_BENCH_STEPPROF=0
+    if os.environ.get("DL4J_TPU_BENCH_STEPPROF", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                profiler_overhead_ms
+            # isolate=True for the same reason as obs_overhead_ms: the
+            # headline run's heap must not inflate the paired deltas
+            print(_dumps(profiler_overhead_ms(isolate=True)))
+        except Exception as e:  # never let the side row break the headline
+            print(_dumps({"metric": "profiler_overhead_ms", "value": None,
+                          "unit": "ms/step stepprof enabled",
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
 
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
@@ -532,16 +578,20 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # over vocab x touched fraction; word2vec_words_per_sec above is
         # the acceptance side metric
         B.embedding_grad_exchange_ms,
+        # step profiler (ISSUE 17): StepProfiler on vs off paired arms +
+        # the fully-fenced phase-coverage check — the profiler's own <2%
+        # overhead claim; isolated like obs_overhead_ms
+        lambda: B.profiler_overhead_ms(isolate=True),
     ]
     side = []
     for fn in captures:
-        side += capture(fn)
+        side += [_stamp(r) for r in capture(fn)]
         # write after every capture so a killed run still leaves a
         # readable (partial) artifact
         with open(path, "w") as f:
             json.dump(side, f, indent=1)
     for row in side:
-        print(json.dumps(row))
+        print(_dumps(row))
 
 
 if __name__ == "__main__":

@@ -696,6 +696,7 @@ class GenerationEngine:
         call — the token must reach the host to stream/EOS-check."""
         ring = self.ring
         L = len(history)
+        t_form = clock.monotonic_s()
         bucket = next(b for b in self.buckets if L <= b)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :L] = history
@@ -716,6 +717,11 @@ class GenerationEngine:
             reg.histogram("generation_prefill_seconds",
                           "Prefill program wall time per request",
                           buckets=_STEP_BUCKETS).observe(dt)
+        # stepprof slices: prompt padding/ladder formation vs the fenced
+        # prefill execute (the int() above is the per-call sync)
+        from ..observability.profiler import record_slices
+        record_slices("prefill", batch_form_s=round(t0 - t_form, 7),
+                      execute_s=round(dt, 7), bucket=bucket)
         return tok
 
     def _decode_guarded(self, slot_obj) -> bool:
@@ -739,6 +745,7 @@ class GenerationEngine:
             return False
         model = self._model_of(slot_obj)
         S = self.config.max_slots
+        t_form = clock.monotonic_s()
         toks = np.zeros((S,), np.int32)
         keys = np.zeros((S, 2), np.uint32)
         temp = np.zeros((S,), np.float32)
@@ -772,6 +779,12 @@ class GenerationEngine:
             rec.record("decode", "step", active=len(occupants),
                        step_s=round(dt, 6), version=slot_obj.version,
                        free=ring.free_slots)
+        # stepprof slices: slot-batch formation (the host-side gather of
+        # last tokens/keys/sampler params) vs the fenced decode execute
+        # (the batched np.asarray above is the ONE step sync)
+        from ..observability.profiler import record_slices
+        record_slices("decode", batch_form_s=round(t0 - t_form, 7),
+                      execute_s=round(dt, 7), active=len(occupants))
         for slot, req in sorted(occupants.items()):
             self._emit(req, int(out[slot]), slot_obj.version, slot)
         self._set_active_gauge()

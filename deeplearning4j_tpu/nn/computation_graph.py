@@ -268,6 +268,9 @@ class ComputationGraph:
         self._score = float("nan")
         self._last_grad_stats = None
         self._last_step_traced = False
+        # per-fit StepProfiler (see MultiLayerNetwork): _fit_one credits
+        # its h2d/listener slices through it when a fit attaches one
+        self._stepprof = None
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
         # instance view over the process-global trace cache (compile_cache)
@@ -450,12 +453,17 @@ class ComputationGraph:
         Leaves ``_score`` as the ASYNC device loss scalar — see
         ``MultiLayerNetwork._fit_one`` (the host-sync sweep); the fit
         loop materializes once at the end, ``fit_batch`` on return."""
+        prof = self._stepprof
+        if prof is not None:
+            _t = monotonic_s()
         xs = [jnp.asarray(x) for x in xs]
         ys = [jnp.asarray(y) for y in ys]
         ms = None if ms is None else [
             None if m is None else jnp.asarray(m) for m in _as_list(ms)]
         lms = None if lms is None else [
             None if m is None else jnp.asarray(m) for m in _as_list(lms)]
+        if prof is not None:
+            prof.mark("h2d", monotonic_s() - _t)
         self.last_batch_size = int(xs[0].shape[0])
         pol = self.shape_policy
         if pol is not None and pol.enabled and ms is None and \
@@ -472,8 +480,14 @@ class ComputationGraph:
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
                                               False))
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        if prof is None:
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, self.epoch)
+        else:
+            _t = monotonic_s()
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, self.epoch)
+            prof.mark("listener", monotonic_s() - _t)
         return self._score
 
     def fit_batch(self, batch) -> float:
@@ -526,6 +540,7 @@ class ComputationGraph:
             from ..faulttolerance.checkpoint import FitCheckpointer
             ckpt = FitCheckpointer(self, checkpoint, resume_from)
         from ..observability.health import get_health_monitor
+        from ..observability.profiler import step_profiler_for
         from ..observability.recorder import get_flight_recorder
         from .multilayer import _StepForensics
         rec = get_flight_recorder()
@@ -533,6 +548,10 @@ class ComputationGraph:
         mon = get_health_monitor()
         forensics = _StepForensics(self, rec, mon, ckpt) \
             if (rec_on or mon is not None) else None
+        # per-step phase attribution with a sampled device fence (see
+        # MultiLayerNetwork.fit / observability/profiler.py)
+        prof = step_profiler_for("train_step")
+        self._stepprof = prof
         start_epoch = ckpt.start_epoch if ckpt is not None else 0
         stop = False
         try:
@@ -549,16 +568,27 @@ class ComputationGraph:
                         seq += 1
                         continue
                     t_step = monotonic_s()
+                    if prof is not None:
+                        prof.begin(t_step)
                     self._fit_one(*batch)
+                    if prof is not None:
+                        prof.dispatched(self._score)
                     seq += 1
                     t_end = monotonic_s()
                     if forensics is not None and forensics.step(
                             ep, seq, self._last_step_traced,
                             t_end - t_step, t_end):
                         stop = True   # opt-in health stop: clean return
-                        break
-                    if ckpt is not None and ckpt.after_batch(ep, seq):
+                    if prof is not None:
+                        prof.lap("forensics")
+                    if not stop and ckpt is not None and \
+                            ckpt.after_batch(ep, seq):
                         stop = True   # SIGTERM: final save taken
+                    if prof is not None:
+                        if ckpt is not None:
+                            prof.lap("checkpoint")
+                        prof.end(self.iteration, self._last_step_traced)
+                    if stop:
                         break
                 if stop:
                     break
@@ -567,6 +597,8 @@ class ComputationGraph:
                 # listeners (MetricsListener score/grad-norm) see a host
                 # float without forcing their own sync
                 self._score = float(self._score)
+                if prof is not None:
+                    prof.materialized()
                 for lst in self.listeners:
                     lst.on_epoch_end(self)
                 self.epoch += 1
@@ -595,6 +627,12 @@ class ComputationGraph:
                     forensics.flush()
                 except Exception:
                     pass
+            if prof is not None:
+                self._stepprof = None
+                try:
+                    prof.flush()
+                except Exception:
+                    pass   # profile telemetry must not mask the real error
             if ckpt is not None:
                 ckpt.close()
         # ONE materialization for the whole fit (async steps pipeline).

@@ -666,6 +666,9 @@ class MultiLayerNetwork:
         # trace events, so a clone's cache-hit first step reads steady and
         # a mid-fit retrace (new shape/treedef) reads compile
         self._last_step_traced = False
+        # per-fit StepProfiler, attached by fit() so _fit_one can credit
+        # its h2d/listener slices; None outside a profiled fit
+        self._stepprof = None
 
     # ------------------------------------------------------------------ init
     def init(self) -> "MultiLayerNetwork":
@@ -943,12 +946,18 @@ class MultiLayerNetwork:
         # window for crash dumps; the health monitor (when installed)
         # watches the step signals for NaNs/spikes/throughput collapse
         from ..observability.health import get_health_monitor
+        from ..observability.profiler import step_profiler_for
         from ..observability.recorder import get_flight_recorder
         rec = get_flight_recorder()
         rec_on = rec is not None and rec.enabled
         mon = get_health_monitor()
         forensics = _StepForensics(self, rec, mon, ckpt) \
             if (rec_on or mon is not None) else None
+        # per-step phase attribution (etl/h2d/dispatch/device/listener/
+        # forensics/checkpoint) with a SAMPLED device fence — steady
+        # unsampled steps stay fully async (the host-sync sweep holds)
+        prof = step_profiler_for("train_step")
+        self._stepprof = prof
         if obs:
             steps_c = reg.counter("training_steps_total",
                                   "Optimizer steps taken")
@@ -994,12 +1003,16 @@ class MultiLayerNetwork:
                     x, y, m, lm = batch
                     self.last_batch_size = int(getattr(x, "shape", (0,))[0])
                     t_step = monotonic_s()
+                    if prof is not None:
+                        prof.begin(t_step, self.last_etl_ms * 1e-3)
                     if self.conf.backprop_type == "tbptt" and \
                             getattr(x, "ndim", 2) == 3 and \
                             x.shape[1] > self.conf.tbptt_fwd_length:
                         self._fit_tbptt(step_fn, x, y, m, lm)
                     else:
                         self._fit_one(x, y, m, lm)
+                    if prof is not None:
+                        prof.dispatched(self._score)
                     compile_step = self._last_step_traced
                     t_end = monotonic_s()
                     dt = t_end - t_step
@@ -1017,9 +1030,16 @@ class MultiLayerNetwork:
                             forensics.step(ep, seq, compile_step, dt,
                                            t_end):
                         stop = True   # opt-in health stop: clean return
-                        break
-                    if ckpt is not None and ckpt.after_batch(ep, seq):
+                    if prof is not None:
+                        prof.lap("forensics")
+                    if not stop and ckpt is not None and \
+                            ckpt.after_batch(ep, seq):
                         stop = True   # SIGTERM: final save taken — return
+                    if prof is not None:
+                        if ckpt is not None:
+                            prof.lap("checkpoint")
+                        prof.end(self.iteration, compile_step)
+                    if stop:
                         break
                 if stop:
                     break
@@ -1028,6 +1048,8 @@ class MultiLayerNetwork:
                 # listeners (MetricsListener score/grad-norm) see a host
                 # float without forcing their own sync
                 self._score = float(self._score)
+                if prof is not None:
+                    prof.materialized()
                 for lst in self.listeners:
                     lst.on_epoch_end(self)
                 self.epoch += 1
@@ -1059,6 +1081,12 @@ class MultiLayerNetwork:
                     forensics.flush()
                 except Exception:
                     pass
+            if prof is not None:
+                self._stepprof = None
+                try:
+                    prof.flush()
+                except Exception:
+                    pass   # profile telemetry must not mask the real error
             if ckpt is not None:
                 ckpt.close()
         # ONE materialization for the whole fit: _fit_one keeps _score
@@ -1308,16 +1336,28 @@ class MultiLayerNetwork:
             # step is numerically the unpadded one (data/shapes.py)
             x, y, m, lm = pol.pad_train_batch(x, y, m, lm)
         self._rng, key = jax.random.split(self._rng)
+        prof = self._stepprof
+        if prof is not None:
+            _t = monotonic_s()
+        x, y, m, lm = (_on_device(x), _on_device(y), _on_device(m),
+                       _on_device(lm))
+        if prof is not None:
+            prof.mark("h2d", monotonic_s() - _t)
         self.params, self.state, self.opt_state, loss, gstats = step_fn(
-            self.params, self.state, self.opt_state, key,
-            _on_device(x), _on_device(y), _on_device(m), _on_device(lm))
+            self.params, self.state, self.opt_state, key, x, y, m, lm)
         self._score = loss
         self._last_grad_stats = gstats
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
                                               False))
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, self.epoch)
+        if prof is None:
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, self.epoch)
+        else:
+            _t = monotonic_s()
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, self.epoch)
+            prof.mark("listener", monotonic_s() - _t)
         return self._score
 
     def fit_batch(self, batch) -> float:

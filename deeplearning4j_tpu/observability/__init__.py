@@ -26,9 +26,14 @@ OpProfiler, UI stats storage — SURVEY §5):
   checksummed JSON artifacts on crashes, preemptions, evictions, and
   SLO breaches (``/debug/flightrecorder`` on both HTTP servers);
 - :mod:`health` — streaming anomaly detection (NaN loss/grads, EWMA
-  spike, throughput regression, padding drift, serving p99/shed-rate)
-  that flips ``/health`` to ``degraded``, can trigger an immediate
-  checkpoint save, and (opt-in) stops training.
+  spike, throughput regression, MFU regression, padding drift, serving
+  p99/shed-rate) that flips ``/health`` to ``degraded``, can trigger an
+  immediate checkpoint save, and (opt-in) stops training;
+- :mod:`profiler` — the step profiler: per-step phase attribution
+  (etl/h2d/dispatch/device/listener/forensics/checkpoint) with a
+  SAMPLED device fence, dispatch-depth gauge, card-derived MFU,
+  live-bytes watermarks vs the AX008 budgets, and Chrome-trace export
+  (``/debug/profile`` on both HTTP servers).
 
 Cost model: METRICS are on by default (the registry is plain host
 arithmetic — serving ``/metrics`` and the training counters work out of
@@ -45,6 +50,9 @@ from .exposition import CONTENT_TYPE, escape_label_value, render_text
 from .health import (Detection, HealthConfig, HealthMonitor,
                      HealthTermination, get_health_monitor,
                      set_health_monitor)
+from .profiler import (StepProfiler, chrome_trace, dump_chrome_trace,
+                       load_chrome_trace, phase_summary, record_slices,
+                       step_profiler_for, stepprof_enabled)
 from .quantiles import LatencyWindow, bucket_quantile
 from .recorder import (FlightRecorder, get_flight_recorder, load_dump,
                        set_flight_recorder)
@@ -58,13 +66,16 @@ __all__ = [
     "FlightRecorder", "Gauge", "HealthConfig", "HealthMonitor",
     "HealthTermination", "Histogram", "LatencyWindow", "MetricsListener",
     "MetricsRegistry", "Span",
-    "SpanContext", "Tracer", "bucket_quantile", "configure_event_log",
-    "default_registry",
+    "SpanContext", "StepProfiler", "Tracer", "bucket_quantile",
+    "chrome_trace", "configure_event_log",
+    "default_registry", "dump_chrome_trace",
     "emit_event", "escape_label_value", "get_event_log",
-    "get_flight_recorder", "get_health_monitor", "get_tracer", "load_dump",
-    "monotonic_s", "render_text", "set_default_registry",
+    "get_flight_recorder", "get_health_monitor", "get_tracer",
+    "load_chrome_trace", "load_dump",
+    "monotonic_s", "phase_summary", "record_slices", "render_text",
+    "set_default_registry",
     "set_default_tracer", "set_flight_recorder", "set_health_monitor",
-    "wall_s",
+    "step_profiler_for", "stepprof_enabled", "wall_s",
 ]
 
 

@@ -591,7 +591,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     # async dispatch returns before the averaging runs; sync
                     # so the recorded time measures the reduction, not its
                     # dispatch
-                    jax.block_until_ready(avg)
+                    jax.block_until_ready(avg)  # graftlint: disable=JX029  (deliberate: once per AVERAGING ROUND, not per step — the timing sync that makes the recorded aggregation time honest)
                 self.stats.record("aggregation", monotonic_s() - t_agg)
             rnd += 1
 

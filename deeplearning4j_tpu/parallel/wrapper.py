@@ -293,6 +293,11 @@ class ParallelWrapper:
                                   "Optimizer steps taken")
             examples_c = reg.counter("training_examples_total",
                                      "Training examples consumed")
+        # phase attribution with a SAMPLED fence (observability/profiler):
+        # unsampled steps keep the zero-per-step-sync contract above —
+        # only every sample_every-th step pays one block_until_ready
+        from ..observability.profiler import step_profiler_for
+        prof = step_profiler_for("train_step")
         n_examples = 0
         t_fit = monotonic_s()
         with get_tracer().span("wrapper.fit", epochs=epochs,
@@ -307,29 +312,47 @@ class ParallelWrapper:
                     x, y, mk, lmk = trimmed
                     if hasattr(m, "_validate_input_ids"):
                         m._validate_input_ids(x)
+                    if prof is not None:
+                        prof.begin(monotonic_s())
+                        _t = monotonic_s()
+                    xd, yd, mkd, lmkd = put(x), put(y), put(mk), put(lmk)
+                    if prof is not None:
+                        prof.mark("h2d", monotonic_s() - _t)
                     m._rng, key = jax.random.split(m._rng)
                     m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
                         m.params, m.state, m.opt_state, key,
-                        put(x), put(y), put(mk), put(lmk))
+                        xd, yd, mkd, lmkd)
                     # device scalar inside the batch loop (a float() here
                     # would host-sync every step); get_score() materializes
                     # on demand
                     m._score = loss
                     m.iteration += 1
+                    if prof is not None:
+                        prof.dispatched(loss)
                     if obs:
                         steps_c.inc()
                         xb = x[0] if isinstance(x, (list, tuple)) else x
                         bs = int(getattr(xb, "shape", (0,))[0])
                         examples_c.inc(bs)
                         n_examples += bs
-                    for lst in m.listeners:
-                        lst.iteration_done(m, m.iteration, m.epoch)
+                    if prof is None:
+                        for lst in m.listeners:
+                            lst.iteration_done(m, m.iteration, m.epoch)
+                    else:
+                        _t = monotonic_s()
+                        for lst in m.listeners:
+                            lst.iteration_done(m, m.iteration, m.epoch)
+                        prof.mark("listener", monotonic_s() - _t)
+                        prof.end(m.iteration)
                 for lst in m.listeners:
                     lst.on_epoch_end(m)
                 m.epoch += 1
             # one final sync: "fit returned" still means "training finished",
             # and deferred device failures surface here instead of downstream
             m._score = float(m._score)
+            if prof is not None:
+                prof.materialized()
+                prof.flush()
         if obs and n_examples:
             # whole-fit throughput, fetch-closed by the score sync above
             dt = max(monotonic_s() - t_fit, 1e-9)

@@ -663,15 +663,29 @@ class ServingEngine:
         try:
             if slot is None:
                 raise RuntimeError("no model installed")
+            t_form = clock.monotonic_s()
             rows = np.stack([r.row for r in pending])
             n = len(rows)
             bucket = next(b for b in self.buckets if n <= b)
             batch = _pad_rows_np(rows, bucket)
             last_traced = getattr(slot.fn, "last_call_traced", None)
+            t_exec = clock.monotonic_s()
             out = np.asarray(slot.forward(batch))[:n]
+            t_done = clock.monotonic_s()
             traced = bool(slot.fn.last_call_traced) \
                 if last_traced is not None else False
             self._note_batch(n, bucket, traced)
+            # stepprof serve slices: queue wait (oldest coalesced row),
+            # batch formation (stack+pad), execute — one record per
+            # BATCH, into the bounded profile channel
+            from ..observability.profiler import record_slices
+            record_slices(
+                "serve",
+                queue_wait_s=round(
+                    t_form - min(r.t_enqueue for r in pending), 7),
+                batch_form_s=round(t_exec - t_form, 7),
+                execute_s=round(t_done - t_exec, 7),
+                batch=n, bucket=bucket, compile=traced)
             for req, row in zip(pending, out):
                 if not req.future.done():
                     req.future.set_result((row, slot.version))

@@ -33,6 +33,8 @@ class _PredictHandler(JsonHandler):
             return
         if self._serve_flightrecorder():
             return
+        if self._serve_profile():
+            return
         if self.path.rstrip("/") == "/health":
             return self._json(self.server_ref.health())
         return self._json({"error": "not found"}, 404)

@@ -33,6 +33,10 @@ class _NNHandler(JsonHandler):
     def do_GET(self):
         if self._serve_metrics():
             return
+        if self._serve_flightrecorder():
+            return
+        if self._serve_profile():
+            return
         if self.path.rstrip("/") == "/health":
             return self._json(self.server_ref.health())
         return self._json({"error": "not found"}, 404)

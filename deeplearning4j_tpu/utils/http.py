@@ -150,6 +150,41 @@ class MetricsEndpointMixin:
         self._json(rec.view())
         return True
 
+    def _serve_profile(self) -> bool:
+        """Answer ``GET /debug/profile``; returns False when the path is
+        not the step-profiler endpoint (caller continues its own
+        routing).  Plain GET returns the live ``profile``-channel window
+        (per-step phase records, serve/decode slices) plus the phase
+        summary; ``?dump=1`` additionally commits a checksummed
+        Chrome-trace artifact (``chrome://tracing`` / Perfetto loadable)
+        and returns the path.  ONE implementation on the mixin — both
+        servers expose identical profiling forensics."""
+        base, _, query = self.path.partition("?")
+        if base.rstrip("/") != "/debug/profile":
+            return False
+        from ..observability import profiler as stepprof
+        from ..observability.recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        if rec is None or not rec.enabled:
+            self._json({"enabled": False,
+                        "error": "no flight recorder installed"}, 503)
+            return True
+        # dump only on an affirmative value (side effect: writes a file)
+        dump_vals = parse_qs(query).get("dump", [])
+        if dump_vals and dump_vals[-1].lower() not in ("0", "false", "no", ""):
+            try:
+                path = stepprof.dump_chrome_trace(recorder=rec)
+            except Exception as e:
+                self._json({"ok": False, "error": str(e)}, 500)
+                return True
+            self._json({"ok": True, "path": path})
+            return True
+        records = rec.channel(stepprof.CHANNEL).items()
+        self._json({"enabled": stepprof.stepprof_enabled(),
+                    "records": records,
+                    "summary": stepprof.phase_summary(records)})
+        return True
+
     def _serve_metrics(self) -> bool:
         """Answer ``GET /metrics``; returns False when the path is not the
         metrics endpoint (caller continues its own routing)."""

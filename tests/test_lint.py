@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX028 has at least one fixture that MUST fire and one
+Every rule JX001–JX029 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1456,6 +1456,68 @@ def test_jx028_pragma_suppresses():
                                                 _NN_PATH)}
 
 
+# ---------------------------------------------------------------- JX029
+def test_jx029_positive_fence_spellings_in_loops():
+    # the three spellings: dotted through the jax alias, bare import,
+    # and the array-method form — all inside for/while bodies
+    src = """
+        import jax
+        from jax import block_until_ready
+
+        def fit(batches, step):
+            for x in batches:
+                loss = step(x)
+                jax.block_until_ready(loss)
+
+        def drain(handles):
+            while handles:
+                block_until_ready(handles.pop())
+
+        def decode(tokens, out):
+            for t in tokens:
+                out = out.block_until_ready()
+            return out
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX029" for f in fs) == 3
+
+
+def test_jx029_negative_outside_loop_profiler_and_tests():
+    # a one-shot fence (no loop) never fires anywhere
+    src_once = """
+        import jax
+
+        def probe(x):
+            jax.block_until_ready(x)
+            return x
+    """
+    assert "JX029" not in rules_at(src_once, _NN_PATH)
+    # the sampled fence in the profiler, and test modules, are exempt
+    src_loop = """
+        import jax
+
+        def fence_all(handles):
+            for h in handles:
+                jax.block_until_ready(h)
+    """
+    for path in ("deeplearning4j_tpu/observability/profiler.py",
+                 "tests/test_fix.py", "tests/conftest.py"):
+        assert "JX029" not in rules_at(src_loop, path)
+
+
+def test_jx029_pragma_suppresses():
+    src = """
+        import jax
+
+        def average(rounds):
+            for avg in rounds:
+                jax.block_until_ready(avg)  # graftlint: disable=JX029  (deliberate once-per-round timing sync)
+    """
+    assert "JX029" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _NN_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2510,7 +2572,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 24
+    assert len(RULES) == 25
     assert len(PROGRAM_RULES) == 4
 
 

@@ -1558,6 +1558,63 @@ def jx028(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX029
+# the ONE module licensed to fence inside a loop: the step profiler's
+# SAMPLED block_until_ready is the honest-device-slice measurement, paid
+# every sample_every-th step by design and counted in stepprof_fences_total
+_JX029_PROFILER_RE = re.compile(
+    r"(^|[/\\])observability[/\\]profiler\.py$")
+
+
+@rule("JX029", "block_until_ready inside a for/while loop in a non-test "
+               "package module (unsampled fence in a hot path)")
+def jx029(info: ModuleInfo) -> List[Finding]:
+    """Flag ``jax.block_until_ready(...)`` (dotted through a jax alias),
+    the bare ``from jax import block_until_ready`` form, and
+    ``.block_until_ready()`` method calls inside a ``for``/``while``
+    body in any non-test package module outside
+    ``observability/profiler.py``.  A fence in a loop serializes host
+    and device every iteration — exactly the per-step sync the fit
+    loops' async-dispatch design (and the PR 16 host-sync sweep) removed;
+    one such line reintroduces the dispatch round-trip (~24 ms behind
+    this environment's tunnel) per step and pins the profiler's
+    dispatch-depth gauge at 0.  The step profiler's own fence is legal
+    because it is SAMPLED (every ``sample_every``-th step, counted in
+    ``stepprof_fences_total``) — which is why profiler.py is the one
+    path-exempt module.  A deliberate loop fence elsewhere (a benchmark
+    timing an aggregation round) carries a pragma with justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if _JX026_TEST_PATH_RE.search(path) or _JX029_PROFILER_RE.search(path):
+        return out
+    bare: set = set()
+    for node in info.nodes(ast.ImportFrom):
+        if (node.module or "") == "jax":
+            for alias in node.names:
+                if alias.name == "block_until_ready":
+                    bare.add(alias.asname or alias.name)
+    for node in info.nodes(ast.Call):
+        if not _in_loop_same_function(info, node):
+            continue
+        fn = node.func
+        name = dotted_name(fn)
+        dotted = bool(name) and name.split(".")[0] in info.jax_aliases \
+            and name.endswith(".block_until_ready")
+        is_bare = isinstance(fn, ast.Name) and fn.id in bare
+        method = isinstance(fn, ast.Attribute) \
+            and fn.attr == "block_until_ready" and not dotted
+        if dotted or is_bare or method:
+            out.append(_finding(
+                info, node, "JX029",
+                f"`{name or 'block_until_ready'}` inside a loop: an "
+                "every-iteration fence serializes the async dispatch "
+                "pipeline (the host-sync class the fit loops removed) — "
+                "sample it like observability/profiler.py's fence, hoist "
+                "it past the loop, or pragma a deliberate timing sync "
+                "with its justification"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
