@@ -111,6 +111,14 @@ the fully-fenced phase-attribution coverage check — the profiler's own
 <2% claim, measured not asserted); DL4J_TPU_BENCH_STEPPROF=0 suppresses
 it.
 
+A sixteenth JSON line records the bounded-dispatch pipeline benchmark
+(``dispatch_pipeline_ms``: steady per-step train time at
+``DL4J_TPU_DISPATCH_DEPTH=1`` — the fully serial per-step-sync loop —
+vs the windowed depths 2 and 4, paired-arm alternating-order design on
+a dispatch-bound tiny model and a compute-bound one, with the
+compile-counter-verified proof that flipping the host-only depth knob
+never retraces); DL4J_TPU_BENCH_PIPELINE_DEPTH=0 suppresses it.
+
 Every printed row carries an ``env`` provenance block (cpu count,
 at-start load average, jax/jaxlib versions, x64 flag, DL4J_TPU_*
 overrides in effect) so round-over-round comparisons can separate
@@ -449,6 +457,22 @@ def main():
                           "unit": "ms/step stepprof enabled",
                           "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # bounded-dispatch pipeline row (ISSUE 18): depth=1 serial loop vs
+    # windowed depths 2/4 on dispatch-bound + compute-bound arms, with
+    # the zero-retrace proof for the depth flip; a sixteenth JSON line,
+    # opt-out DL4J_TPU_BENCH_PIPELINE_DEPTH=0
+    if os.environ.get("DL4J_TPU_BENCH_PIPELINE_DEPTH", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import \
+                dispatch_pipeline_ms
+            # isolate=True: the paired ratios are sub-millisecond host
+            # timings, the most heap-sensitive rows in the file
+            print(_dumps(dispatch_pipeline_ms(isolate=True)))
+        except Exception as e:  # never let the side row break the headline
+            print(_dumps({"metric": "dispatch_pipeline_ms", "value": None,
+                          "unit": "ms/step dispatch-bound arm",
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -582,6 +606,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # the fully-fenced phase-coverage check — the profiler's own <2%
         # overhead claim; isolated like obs_overhead_ms
         lambda: B.profiler_overhead_ms(isolate=True),
+        # dispatch pipeline (ISSUE 18): serial depth=1 vs windowed 2/4
+        # on dispatch-bound + compute-bound arms, zero-retrace-verified;
+        # isolated — the ratios are sub-ms host timings
+        lambda: B.dispatch_pipeline_ms(isolate=True),
     ]
     side = []
     for fn in captures:

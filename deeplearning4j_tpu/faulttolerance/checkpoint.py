@@ -1304,6 +1304,18 @@ class FitCheckpointer:
         self._last_saved_iter = int(self.net.iteration)
         return path
 
+    def due(self) -> bool:
+        """Would :meth:`after_batch` save right now?  Side-effect-free
+        preview for the pipelined fit loop (ISSUE 18): a checkpoint
+        boundary must drain the bounded dispatch window BEFORE the save
+        runs, so the checkpoint captures a fully materialized step and
+        mid-window resume stays digest-exact."""
+        if self.manager is None:
+            return False
+        n = self.config.save_every_n_iterations
+        return bool(self._preempted or (
+            n and int(self.net.iteration) - self._last_saved_iter >= n))
+
     def after_batch(self, fit_epoch: int, batch_seq: int) -> bool:
         """Call after each fitted batch (``batch_seq`` = batches consumed
         so far this epoch).  Saves on the iteration trigger; returns True

@@ -234,18 +234,23 @@ def _fit_on_device_epochs(model, xs, ys, batch_size, epochs, shuffle,
             def epoch_fn(params, state, opt_state, key, xd, yd, perm_steps):
                 def body(carry, idx):
                     p, s, o, k = carry
-                    k, sub = jax.random.split(k)
                     bx = [a[idx] for a in xd]  # one minibatch gather per step
                     by = [a[idx] for a in yd]
-                    p, s, o, loss, gstats = call_step(p, s, o, sub, bx, by)
+                    # the fused-RNG step splits its key internally and
+                    # returns the successor — the split that used to live
+                    # here, so the key sequence is bit-identical
+                    p, s, o, k, loss, gstats = call_step(p, s, o, k, bx, by)
                     return (p, s, o, k), (loss, gstats)
 
-                (p, s, o, _), (losses, gstats) = jax.lax.scan(
+                (p, s, o, k), (losses, gstats) = jax.lax.scan(
                     body, (params, state, opt_state, key), perm_steps)
                 # listeners see the final step's gradient norms
                 gstats = jax.tree_util.tree_map(lambda a: a[-1], gstats)
-                return p, s, o, losses, gstats
-            return epoch_fn, (0, 1, 2)
+                # the final key is returned (and discarded by the caller)
+                # so the key ARGUMENT has an alias-matched output and can
+                # be donated like the rest of the training carry
+                return p, s, o, k, losses, gstats
+            return epoch_fn, (0, 1, 2, 3)
 
         # shared across equal-topology networks (replicas): call_step only
         # closes over the model's shared jitted step, never the model
@@ -280,7 +285,6 @@ def _fit_on_device_epochs(model, xs, ys, batch_size, epochs, shuffle,
 
                     def body(c, idx):
                         p_, s_, o_, k_ = c
-                        k_, sub = jax.random.split(k_)
                         bx = [a[idx] for a in xd]
                         by = [a[idx] for a in yd]
                         # gstats are DISCARDED inside the traced program:
@@ -288,28 +292,30 @@ def _fit_on_device_epochs(model, xs, ys, batch_size, epochs, shuffle,
                         # them, and dropping them from the outputs lets XLA
                         # dead-code-eliminate the per-step gradient-norm
                         # reductions (~2 full passes over every gradient
-                        # leaf per step on a large model)
-                        p_, s_, o_, loss, _g = call_step(
-                            p_, s_, o_, sub, bx, by)
+                        # leaf per step on a large model).  The fused-RNG
+                        # step splits k_ internally (bit-identical to the
+                        # split that used to live here).
+                        p_, s_, o_, k_, loss, _g = call_step(
+                            p_, s_, o_, k_, bx, by)
                         return (p_, s_, o_, k_), loss
 
                     (p, s, o, _), losses = jax.lax.scan(
                         body, (p, s, o, ek), perm)
                     return (p, s, o, k), losses[-1]
 
-                (p, s, o, _), last_losses = jax.lax.scan(
+                (p, s, o, k), last_losses = jax.lax.scan(
                     epoch_body, (params, state, opt_state, key), None,
                     length=epochs)
-                return p, s, o, last_losses
+                return p, s, o, k, last_losses
 
             fused = shared_jit((type(model).__name__, sig) + fused_key,
-                               lambda: (epochs_fn, (0, 1, 2)),
+                               lambda: (epochs_fn, (0, 1, 2, 3)),
                                name="epochs_scan")
             model._jit_cache[fused_key] = fused
     try:
         if fuse:
             model._rng, key = jax.random.split(model._rng)
-            (model.params, model.state, model.opt_state,
+            (model.params, model.state, model.opt_state, _k,
              last_losses) = fused(model.params, model.state,
                                   model.opt_state, key, xs, ys)
             model.iteration += nb * epochs
@@ -350,7 +356,7 @@ def _fit_epochs(model, xs, ys, epochs, n, nb, used, batch_size, shuffle,
         perm = (jax.random.permutation(pk, n) if shuffle
                 else jnp.arange(n))
         perm_steps = perm[:used].reshape(nb, batch_size)
-        (model.params, model.state, model.opt_state, losses,
+        (model.params, model.state, model.opt_state, _k, losses,
          gstats) = fn(model.params, model.state, model.opt_state, key,
                       xs, ys, perm_steps)
         model.iteration += nb

@@ -174,7 +174,10 @@ def _build_graph_fn(conf, tx, kind: str):
                                key=None, label_masks=label_masks)
         return fn, ()
     if kind == "train_step":
-        return _build_graph_train_step(conf, tx), (0, 1, 2)
+        # maximal donation (graftaudit AX007): the fused-RNG step returns
+        # the successor key as an alias-matched output, so the key buffer
+        # donates and recycles in place with the training carry
+        return _build_graph_train_step(conf, tx), (0, 1, 2, 3)
     raise KeyError(kind)
 
 
@@ -206,6 +209,10 @@ def _build_graph_train_step(conf, tx):
                 cast_map[name] = dt
 
     def step(params, state, opt_state, key, xs, ys, masks, label_masks):
+        # fused RNG succession (see nn/multilayer._build_train_step): the
+        # host-side split moves into the program — bit-identical key
+        # sequence, one less dispatch, and the key becomes donatable
+        new_rng, key = jax.random.split(key)
         if pol is not None:
             xs = [_cast_act(x, pol.compute_dtype) for x in xs]
         ls = state.get(_precision.SCALE_STATE_KEY) \
@@ -247,7 +254,7 @@ def _build_graph_train_step(conf, tx):
                 _precision.overflow_skip(
                     pol, ls, finite, params, new_params, opt_state,
                     new_opt, state, new_state, gstats)
-        return new_params, new_state, new_opt, loss, gstats
+        return new_params, new_state, new_opt, new_rng, loss, gstats
 
     return step
 
@@ -266,6 +273,10 @@ class ComputationGraph:
         self.last_batch_size = 0
         self.listeners: List[TrainingListener] = []
         self._score = float("nan")
+        # drain-boundary telemetry (nn/dispatch.DispatchWindow): see
+        # MultiLayerNetwork.__init__
+        self.last_drained_score = float("nan")
+        self.last_drained_iteration = -1
         self._last_grad_stats = None
         self._last_step_traced = False
         # per-fit StepProfiler (see MultiLayerNetwork): _fit_one credits
@@ -472,9 +483,12 @@ class ComputationGraph:
             # rows carry a zero label mask on EVERY output head
             xs, ys, lms = pol.pad_multi_batch(xs, ys, lms, path="train")
         step_fn = self._get_jitted("train_step")
-        self._rng, key = jax.random.split(self._rng)
-        self.params, self.state, self.opt_state, loss, gstats = step_fn(
-            self.params, self.state, self.opt_state, key, xs, ys, ms, lms)
+        # fused-RNG step: splits the key inside the program (bit-identical
+        # to the host split it replaces) and returns the successor
+        (self.params, self.state, self.opt_state, self._rng, loss,
+         gstats) = step_fn(
+            self.params, self.state, self.opt_state, self._rng, xs, ys,
+            ms, lms)
         self._score = loss
         self._last_grad_stats = gstats
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",
@@ -552,6 +566,18 @@ class ComputationGraph:
         # MultiLayerNetwork.fit / observability/profiler.py)
         prof = step_profiler_for("train_step")
         self._stepprof = prof
+
+        # bounded async dispatch (ISSUE 18; see MultiLayerNetwork.fit):
+        # up to DL4J_TPU_DISPATCH_DEPTH steps in flight, drained at epoch
+        # ends and checkpoint boundaries, NaN-checked per drained token
+        from .dispatch import DispatchWindow
+
+        def _nan_at_drain(iteration, value):
+            if rec_on:
+                rec.record("train", "nan_at_drain", score=value,
+                           iteration=int(iteration))
+        win = DispatchWindow(owner=self, profiler=prof,
+                             on_nan=_nan_at_drain)
         start_epoch = ckpt.start_epoch if ckpt is not None else 0
         stop = False
         try:
@@ -572,7 +598,7 @@ class ComputationGraph:
                         prof.begin(t_step)
                     self._fit_one(*batch)
                     if prof is not None:
-                        prof.dispatched(self._score)
+                        prof.dispatched(self._score, window=win)
                     seq += 1
                     t_end = monotonic_s()
                     if forensics is not None and forensics.step(
@@ -581,21 +607,29 @@ class ComputationGraph:
                         stop = True   # opt-in health stop: clean return
                     if prof is not None:
                         prof.lap("forensics")
-                    if not stop and ckpt is not None and \
-                            ckpt.after_batch(ep, seq):
-                        stop = True   # SIGTERM: final save taken
+                    if not stop and ckpt is not None:
+                        if ckpt.due():
+                            # checkpoint boundary drains the window first
+                            # (mid-window resume stays digest-exact)
+                            win.drain()
+                        if ckpt.after_batch(ep, seq):
+                            stop = True   # SIGTERM: final save taken
                     if prof is not None:
                         if ckpt is not None:
                             prof.lap("checkpoint")
                         prof.end(self.iteration, self._last_step_traced)
                     if stop:
                         break
+                    # admit this step into the in-flight window (bounded-
+                    # pipeline backpressure point)
+                    win.push(self._score, self.iteration)
                 if stop:
                     break
                 # ONE materialization per epoch (fit_on_device's sync
                 # convention): steps pipelined async all epoch; epoch-end
                 # listeners (MetricsListener score/grad-norm) see a host
                 # float without forcing their own sync
+                win.drain()
                 self._score = float(self._score)
                 if prof is not None:
                     prof.materialized()
@@ -605,7 +639,12 @@ class ComputationGraph:
                 if ckpt is not None and ckpt.after_epoch(ep):
                     stop = True
                     break
+            # stop-path exits break before the epoch-end drain
+            win.drain()
         except Exception as e:
+            # never block on in-flight work while unwinding (the final
+            # un-guarded float(_score) still surfaces deferred failures)
+            win.abandon()
             if rec_on:   # crash forensics before the exception propagates
                 if forensics is not None:
                     try:

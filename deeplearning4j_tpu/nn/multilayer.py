@@ -38,6 +38,7 @@ from ._common import (_cast_floats, apply_constraints_all,
                       build_tx, fit_on_device_epochs, float_grad_leaves,
                       hyperparam_conf)
 from .compile_cache import shared_jit, topology_signature
+from .dispatch import DispatchWindow
 from .conf.multi_layer import MultiLayerConfiguration
 from .conf.schedules import resolve as resolve_schedule
 from .conf.updaters import Sgd, UpdaterConf
@@ -392,9 +393,16 @@ def _build_stack_fn(conf, tx, kind: str):
                                   key=None, carries=carries)
             return y, carries
         return fn, ()
-    if kind in ("train_step", "train_step_carry"):
-        return _build_train_step(conf, tx, kind == "train_step_carry"), \
-            (0, 1, 2)
+    if kind == "train_step":
+        # maximal donation (graftaudit AX007): params/state/opt-state AND
+        # the RNG key are dead after the call — the fused-RNG step returns
+        # the successor key as an alias-matched output, so the 8-byte key
+        # buffer recycles in place like the training carry does
+        return _build_train_step(conf, tx, False), (0, 1, 2, 3)
+    if kind == "train_step_carry":
+        # tBPTT additionally donates the recurrent carries (argnum 8):
+        # each chunk's carries are consumed by exactly one step
+        return _build_train_step(conf, tx, True), (0, 1, 2, 3, 8)
     if kind in ("prefill", "decode"):
         # autoregressive generation programs (bucketed prompt prefill +
         # fixed-shape slot-batch decode): built in generation/programs.py,
@@ -460,6 +468,12 @@ def _build_train_step(conf, tx, with_carry: bool):
 
     def step(params, state, opt_state, key, x, y, mask, label_mask,
              carries=None):
+        # fused RNG succession: the split that used to run host-side
+        # (``self._rng, key = jax.random.split(self._rng)``) happens
+        # inside the program — bit-identical key sequence, one less
+        # device dispatch per step, and the key argument gains an
+        # alias-matched output (``new_rng``) so it can be donated
+        new_rng, key = jax.random.split(key)
         if pol is not None:
             # floating inputs only: integer token ids must reach the
             # embedding gather exact (a bf16 cast quantizes ids > 256)
@@ -600,9 +614,9 @@ def _build_train_step(conf, tx, with_carry: bool):
                 # the sequence
                 new_carries = sel(new_carries, carries)
         if with_carry:
-            return (new_params, new_state, new_opt, loss, gstats,
+            return (new_params, new_state, new_opt, new_rng, loss, gstats,
                     new_carries)
-        return new_params, new_state, new_opt, loss, gstats
+        return new_params, new_state, new_opt, new_rng, loss, gstats
 
     return step
 
@@ -614,6 +628,10 @@ def _build_pretrain_step(conf, tx, i: int):
     lc = conf.layers[i]
 
     def step(p_i, opt_state, key, x, frozen, state):
+        # fused RNG succession (see _build_train_step): the host-side
+        # split moves into the program; the successor key is returned
+        new_rng, key = jax.random.split(key)
+
         def loss_fn(pp):
             feats = x
             if i > 0:
@@ -626,7 +644,7 @@ def _build_pretrain_step(conf, tx, i: int):
             return lc.pretrain_loss(variables, feats, key=key, train=True)
         loss, grads = jax.value_and_grad(loss_fn)(p_i)
         updates, new_opt = tx.update(grads, opt_state, p_i)
-        return optax.apply_updates(p_i, updates), new_opt, loss
+        return optax.apply_updates(p_i, updates), new_opt, new_rng, loss
 
     return step
 
@@ -646,6 +664,11 @@ class MultiLayerNetwork:
         self.last_batch_size = 0
         self.listeners: List[TrainingListener] = []
         self._score = float("nan")
+        # drain-boundary telemetry (nn/dispatch.DispatchWindow): the last
+        # materialized step's score/iteration — what rate/score listeners
+        # read mid-fit without forcing their own host sync
+        self.last_drained_score = float("nan")
+        self.last_drained_iteration = -1
         self._tx = None
         self._rng = jax.random.PRNGKey(conf.seed)
         # instance view over the process-global trace cache: holds strong
@@ -958,6 +981,20 @@ class MultiLayerNetwork:
         # unsampled steps stay fully async (the host-sync sweep holds)
         prof = step_profiler_for("train_step")
         self._stepprof = prof
+
+        # bounded async dispatch (ISSUE 18): the host may run up to
+        # DL4J_TPU_DISPATCH_DEPTH (default 2) steps ahead of the device,
+        # overlapping step N+1's ETL/padding/h2d/bookkeeping with step
+        # N's execution.  Drains at epoch ends and checkpoint boundaries
+        # keep exact-resume parity; every drained token is NaN-checked
+        # with ITS OWN iteration so deferred device failures surface
+        # within the window bound, correctly attributed.
+        def _nan_at_drain(iteration, value):
+            if rec_on:
+                rec.record("train", "nan_at_drain", score=value,
+                           iteration=int(iteration))
+        win = DispatchWindow(owner=self, profiler=prof,
+                             on_nan=_nan_at_drain)
         if obs:
             steps_c = reg.counter("training_steps_total",
                                   "Optimizer steps taken")
@@ -1012,7 +1049,7 @@ class MultiLayerNetwork:
                     else:
                         self._fit_one(x, y, m, lm)
                     if prof is not None:
-                        prof.dispatched(self._score)
+                        prof.dispatched(self._score, window=win)
                     compile_step = self._last_step_traced
                     t_end = monotonic_s()
                     dt = t_end - t_step
@@ -1032,21 +1069,31 @@ class MultiLayerNetwork:
                         stop = True   # opt-in health stop: clean return
                     if prof is not None:
                         prof.lap("forensics")
-                    if not stop and ckpt is not None and \
-                            ckpt.after_batch(ep, seq):
-                        stop = True   # SIGTERM: final save taken — return
+                    if not stop and ckpt is not None:
+                        if ckpt.due():
+                            # checkpoint boundary: materialize the whole
+                            # window so the save captures finished steps
+                            # and mid-window resume stays digest-exact
+                            win.drain()
+                        if ckpt.after_batch(ep, seq):
+                            stop = True   # SIGTERM: final save — return
                     if prof is not None:
                         if ckpt is not None:
                             prof.lap("checkpoint")
                         prof.end(self.iteration, compile_step)
                     if stop:
                         break
+                    # admit this step into the in-flight window (blocks on
+                    # the oldest step once the window is full — the
+                    # bounded-pipeline backpressure point)
+                    win.push(self._score, self.iteration)
                 if stop:
                     break
                 # ONE materialization per epoch (fit_on_device's sync
                 # convention): steps pipelined async all epoch; epoch-end
                 # listeners (MetricsListener score/grad-norm) see a host
                 # float without forcing their own sync
+                win.drain()
                 self._score = float(self._score)
                 if prof is not None:
                     prof.materialized()
@@ -1056,7 +1103,15 @@ class MultiLayerNetwork:
                 if ckpt is not None and ckpt.after_epoch(ep):
                     stop = True
                     break
+            # stop-path exits (health stop, SIGTERM) break before the
+            # epoch-end drain; materialize what's still in flight so the
+            # drained-score bookkeeping is consistent on clean returns
+            win.drain()
         except Exception as e:
+            # never block on in-flight work while unwinding — the final
+            # un-guarded float(_score) convention still surfaces deferred
+            # device failures for callers that catch and continue
+            win.abandon()
             # unhandled fit exception: commit the flight-recorder window
             # BEFORE propagating — the artifact that explains the crash
             # must exist even if the process dies on the way up
@@ -1209,10 +1264,9 @@ class MultiLayerNetwork:
                 x_chunk = xc
             else:
                 x_chunk = x[:, sl]
-            self._rng, key = jax.random.split(self._rng)
-            (self.params, self.state, self.opt_state, loss, gstats,
-             carries) = step(
-                self.params, self.state, self.opt_state, key,
+            (self.params, self.state, self.opt_state, self._rng, loss,
+             gstats, carries) = step(
+                self.params, self.state, self.opt_state, self._rng,
                 x_chunk, yc, xm, ym, carries)
             traced = traced or step.last_call_traced
             # device scalar inside the chunk loop: a float() here would
@@ -1273,7 +1327,7 @@ class MultiLayerNetwork:
         if step is None:
             step = shared_jit(
                 (type(self).__name__, self._topology_sig(), "pretrain", i),
-                lambda: (_build_pretrain_step(self.conf, tx, i), ()),
+                lambda: (_build_pretrain_step(self.conf, tx, i), (0, 1, 2)),
                 name=f"pretrain_{i}")
             self._jit_cache[f"pretrain_{i}"] = step
         p_i = self.params[lname]
@@ -1288,9 +1342,12 @@ class MultiLayerNetwork:
             data = list(data)
         for _ in range(epochs):
             for batch in self._pretrain_batches(data):
-                self._rng, key = jax.random.split(self._rng)
-                p_i, opt, loss = step(p_i, opt, key, jnp.asarray(batch),
-                                      frozen, self.state)
+                # fused-RNG step: splits the key inside the program
+                # (bit-identical to the host split it replaces) and
+                # returns the successor; key + p_i + opt donate in place
+                p_i, opt, self._rng, loss = step(
+                    p_i, opt, self._rng, jnp.asarray(batch), frozen,
+                    self.state)
                 # device scalar in-loop (steps pipeline); one sync below
                 self._score = loss
                 self.iteration += 1
@@ -1335,7 +1392,6 @@ class MultiLayerNetwork:
             # already-compiled bucket; padded rows are loss-masked so the
             # step is numerically the unpadded one (data/shapes.py)
             x, y, m, lm = pol.pad_train_batch(x, y, m, lm)
-        self._rng, key = jax.random.split(self._rng)
         prof = self._stepprof
         if prof is not None:
             _t = monotonic_s()
@@ -1343,8 +1399,12 @@ class MultiLayerNetwork:
                        _on_device(lm))
         if prof is not None:
             prof.mark("h2d", monotonic_s() - _t)
-        self.params, self.state, self.opt_state, loss, gstats = step_fn(
-            self.params, self.state, self.opt_state, key, x, y, m, lm)
+        # fused-RNG step: the key split happens inside the program and the
+        # successor key comes back as an output (bit-identical sequence to
+        # the host-side split this replaces; one less dispatch per step)
+        (self.params, self.state, self.opt_state, self._rng, loss,
+         gstats) = step_fn(
+            self.params, self.state, self.opt_state, self._rng, x, y, m, lm)
         self._score = loss
         self._last_grad_stats = gstats
         self._last_step_traced = bool(getattr(step_fn, "last_call_traced",

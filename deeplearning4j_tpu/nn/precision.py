@@ -35,6 +35,19 @@ reserved ``"__precision__"`` key (a dict of three scalars), so it is
 donated through the step, checkpointed, and restored like every other
 piece of training state.
 
+**Donation and the fused step** (PR 18): the scale/unscale/skip logic
+is traced into the SAME program as the optimizer application and the
+fused RNG succession, so the canonical train step's donation set —
+params, state, updater state, and the RNG key (argnums ``(0, 1, 2,
+3)``, AX007-maximal, floored by ``donation_min`` in
+``tools/graftaudit/budgets.json``) — covers every buffer this policy
+touches.  Two consequences worth keeping true: the unscaled-gradient
+temporaries alias the donated master buffers rather than extending
+peak-live, and the skip-update branch must keep returning the donated
+params/state/updater values *positionally unchanged* — a skip that
+rebuilt them as fresh outputs would silently break the alias match and
+cost a full extra copy of the master weights every overflow step.
+
 **Sharded masters** (ZeRO-3, ``parallel/sharded.py``): because the
 masters are simply the param pytree, laying params out with a
 ``NamedSharding`` over the data axis makes them *sharded* masters with

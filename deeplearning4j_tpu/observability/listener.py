@@ -106,15 +106,25 @@ class MetricsListener(TrainingListener):
         if iteration % self.frequency != 0:
             return
         # score: free when the fit path already materialized it (plain
-        # fit); a DEVICE scalar (ParallelWrapper mid-fit) is skipped
-        # unless force_device_sync — never stall the step queue silently
+        # fit); a DEVICE scalar (pipelined/wrapper mid-fit) reads the
+        # window-drain boundary instead — the most recently drained
+        # step's host value, stale by at most the dispatch depth, no
+        # sync.  force_device_sync remains the only path that stalls
+        # the step queue.
         raw_score = getattr(model, "_score", None)
         score_is_host = isinstance(raw_score, float)
+        drained_at = getattr(model, "last_drained_iteration", -1)
         score = None
         if score_is_host:
             score = raw_score
         elif self.force_device_sync:
             score = float(model.get_score())
+        elif isinstance(drained_at, int) and drained_at >= 0:
+            # NOTE: deliberately does NOT flip score_is_host — the
+            # grad-norm fetch below must keep gating on a truly drained
+            # step queue, and with a boundary read the CURRENT step's
+            # gstats are still in flight
+            score = getattr(model, "last_drained_score", None)
         if score is not None:
             ins["score"].set(score)
         if self._last_mono is not None and self._last_iter is not None \

@@ -218,8 +218,8 @@ class StepProfiler:
                  "peak_flops", "last_mfu", "last_achieved_flops",
                  "_registry", "_monitor", "_wall0", "_buf", "_t0", "_last",
                  "_etl", "_h2d", "_listener", "_dispatch", "_device",
-                 "_forensics", "_checkpoint", "_sampled", "_live",
-                 "_ratio", "_mfu", "_ach")
+                 "_forensics", "_checkpoint", "_sampled", "_drained_wait",
+                 "_live", "_ratio", "_mfu", "_ach")
 
     def __init__(self, program: str = "train_step", *,
                  sample_every: Optional[int] = None,
@@ -254,6 +254,7 @@ class StepProfiler:
         self._dispatch = self._forensics = self._checkpoint = 0.0
         self._device: Optional[float] = None
         self._sampled = False
+        self._drained_wait = 0.0
         self._live: Optional[int] = None
         self._ratio: Optional[float] = None
         self._mfu: Optional[float] = None
@@ -274,6 +275,7 @@ class StepProfiler:
         self._dispatch = self._forensics = self._checkpoint = 0.0
         self._device = None
         self._sampled = False
+        self._drained_wait = 0.0
 
     def mark(self, phase: str, seconds: float) -> None:
         """Credit an inner slice measured by the step body (h2d device
@@ -284,10 +286,16 @@ class StepProfiler:
         elif phase == "listener":
             self._listener += seconds
 
-    def dispatched(self, handle=None) -> None:
+    def dispatched(self, handle=None, window=None) -> None:
         """The async step dispatch returned.  Every ``sample_every``-th
         step additionally fences on ``handle`` to measure the device
-        slice honestly (the ONLY profiler-added sync; counted)."""
+        slice honestly (the ONLY profiler-added sync; counted).
+
+        ``window``: the fit loop's bounded :class:`~..nn.dispatch.
+        DispatchWindow` (or None).  A sampled fence first drains it,
+        attributing each drained step's device slice individually by
+        completion spacing — without this, the device time of steps still
+        in flight would be billed to the fenced step's slice."""
         now = monotonic_s()
         self._dispatch = now - self._last - self._h2d - self._listener
         self._last = now
@@ -297,7 +305,14 @@ class StepProfiler:
         if depth > self.max_depth:
             self.max_depth = depth
         if handle is not None and self.steps % self.sample_every == 0:
-            self._fence(handle, now)
+            self._fence(handle, now, window)
+
+    def drained(self, k: int = 1) -> None:
+        """The dispatch window materialized ``k`` in-flight steps: the
+        pipeline shortened — keep the depth gauge tracking real window
+        occupancy (steady state: ``max_depth`` == configured depth)."""
+        d = self.dispatch_depth - k
+        self.dispatch_depth = d if d > 0 else 0
 
     def lap(self, phase: str) -> None:
         """Close a bookkeeping slice (forensics / checkpoint) at now."""
@@ -309,14 +324,21 @@ class StepProfiler:
         self._last = now
 
     def end(self, iteration: int, compile_step: bool = False) -> None:
-        """Seal the step record (wall = etl + everything since begin)."""
-        wall = self._etl + (monotonic_s() - self._t0)
-        self._buf.append((
+        """Seal the step record (wall = etl + everything since begin).
+        A LIST, not a tuple: a later pipeline-aware fence may patch the
+        device slice in once the step's in-flight token drains."""
+        # the fence's wait on EARLIER steps' in-flight tokens is billed
+        # to those steps' records (_patch_device), so it is excluded from
+        # this step's wall — the coverage contract (phase sum == wall on
+        # sampled steps) holds at every dispatch depth, nothing is
+        # counted twice
+        wall = self._etl + (monotonic_s() - self._t0) - self._drained_wait
+        self._buf.append([
             self._wall0 + self._t0 - self._etl, iteration, wall,
             self._etl, self._h2d, self._dispatch, self._device,
             self._listener, self._forensics, self._checkpoint,
             self._sampled, compile_step, self.dispatch_depth,
-            self._live, self._ratio, self._mfu, self._ach))
+            self._live, self._ratio, self._mfu, self._ach])
         if len(self._buf) >= self.FLUSH_EVERY:
             self.flush()
 
@@ -327,16 +349,47 @@ class StepProfiler:
         self.dispatch_depth = 0
 
     # ------------------------------------------------- fence (cold, 1/N)
-    def _fence(self, handle, t_disp: float) -> None:
+    def _patch_device(self, iteration: int, seconds: float) -> None:
+        """Attribute a drained in-flight step's device slice to ITS OWN
+        buffered record (found by iteration; the record may already have
+        flushed — a miss just leaves that slice unattributed, never
+        mis-billed).  A fence-measured device value is never overwritten."""
+        for rec in reversed(self._buf):
+            if rec[1] == iteration:
+                if rec[6] is None:
+                    rec[6] = seconds
+                return
+
+    def _fence(self, handle, t_disp: float, window=None) -> None:
         import jax
+        # pipeline-aware: drain the bounded window FIRST, attributing each
+        # drained step's device slice by completion spacing, so the fenced
+        # step's slice below is its own marginal device time — not the
+        # queued tail of every step still in flight
+        t_prev = t_disp
+        if window is not None and len(window):
+            for iteration, t_done in window.drain_timed():
+                self._patch_device(iteration, t_done - t_prev)
+                t_prev = t_done
+            self._drained_wait = t_prev - t_disp
         jax.block_until_ready(handle)
         now = monotonic_s()
-        device = now - t_disp
+        device = now - t_prev
         self._device = device
         self._last = now
         self._sampled = True
         self.fences += 1
-        self.dispatch_depth = 0   # materialization point: pipeline drained
+        if window is None:
+            # no bounded window feeding drained(): the fence is the only
+            # materialization point, so it resets the occupancy itself
+            self.dispatch_depth = 0
+        # with a window, the books already balance: the drain above
+        # retired every EARLIER step's slot via drained(), and the
+        # fenced step's own slot — counted by its dispatched() — is
+        # retired by its own pop when the loop pushes its token.  A
+        # hard reset here would make that pop a double decrement and
+        # pin the steady-state gauge at depth-1 instead of the
+        # configured depth.
         live = live_device_bytes()
         self._live = live
         if live is not None and live > self.live_bytes_watermark:
@@ -406,6 +459,11 @@ class StepProfiler:
             rec = {"ts": ts, "type": "step", "program": prog,
                    "iteration": it, "wall_s": round(wall, 7),
                    "sampled": sampled, "compile": comp, "depth": depth,
+                   # a device slice on an UNSAMPLED record came from a
+                   # later fence draining this step's in-flight token —
+                   # honest timing, but attributed after the fact
+                   **({"drained": True}
+                      if (not sampled and dev is not None) else {}),
                    "phases": {
                        "etl_wait": round(etl, 7),
                        "h2d": round(h2d, 7),

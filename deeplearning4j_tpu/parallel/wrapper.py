@@ -125,6 +125,11 @@ class ParallelWrapper:
         repl = NamedSharding(mesh, P())
         m.state = jax.tree_util.tree_map(
             lambda a: place_sharded(a, repl), m.state)
+        # the RNG key rides the fused-RNG step (in and out), so it must
+        # start mesh-replicated: the step returns the successor key with
+        # this sharding, and a first-call mismatch would cost one extra
+        # executable lowering
+        m._rng = place_sharded(m._rng, repl)
         # optimizer state: subtrees shaped like params (optax mu/nu/trace...)
         # get the param sharding; everything else (counts) is replicated
         param_treedef = jax.tree_util.tree_structure(m.params)
@@ -196,9 +201,11 @@ class ParallelWrapper:
             # clamps out-of-range ids silently)
             m._validate_input_ids(x)
         put = self._put
-        m._rng, key = jax.random.split(m._rng)
-        m.params, m.state, m.opt_state, loss, m._last_grad_stats = \
-            self._get_step()(m.params, m.state, m.opt_state, key,
+        # fused-RNG step: splits the key inside the program (bit-identical
+        # to the host split it replaces) and returns the successor
+        m.params, m.state, m.opt_state, m._rng, loss, \
+            m._last_grad_stats = \
+            self._get_step()(m.params, m.state, m.opt_state, m._rng,
                              put(x), put(y), put(mk), put(lmk))
         m._score = float(loss)
         m.iteration += 1
@@ -298,6 +305,12 @@ class ParallelWrapper:
         # only every sample_every-th step pays one block_until_ready
         from ..observability.profiler import step_profiler_for
         prof = step_profiler_for("train_step")
+        # bounded async dispatch (ISSUE 18; see MultiLayerNetwork.fit):
+        # the host runs up to DL4J_TPU_DISPATCH_DEPTH steps ahead of the
+        # mesh — on a ZeRO-3 layout this is what lets the NEXT step's
+        # host work overlap the in-flight step's all-gather + compute
+        from ..nn.dispatch import DispatchWindow
+        win = DispatchWindow(owner=m, profiler=prof)
         n_examples = 0
         t_fit = monotonic_s()
         with get_tracer().span("wrapper.fit", epochs=epochs,
@@ -318,9 +331,11 @@ class ParallelWrapper:
                     xd, yd, mkd, lmkd = put(x), put(y), put(mk), put(lmk)
                     if prof is not None:
                         prof.mark("h2d", monotonic_s() - _t)
-                    m._rng, key = jax.random.split(m._rng)
-                    m.params, m.state, m.opt_state, loss, m._last_grad_stats = step(
-                        m.params, m.state, m.opt_state, key,
+                    # fused-RNG step: key split happens in the program;
+                    # the successor key comes back as an output
+                    (m.params, m.state, m.opt_state, m._rng, loss,
+                     m._last_grad_stats) = step(
+                        m.params, m.state, m.opt_state, m._rng,
                         xd, yd, mkd, lmkd)
                     # device scalar inside the batch loop (a float() here
                     # would host-sync every step); get_score() materializes
@@ -328,7 +343,7 @@ class ParallelWrapper:
                     m._score = loss
                     m.iteration += 1
                     if prof is not None:
-                        prof.dispatched(loss)
+                        prof.dispatched(loss, window=win)
                     if obs:
                         steps_c.inc()
                         xb = x[0] if isinstance(x, (list, tuple)) else x
@@ -344,6 +359,11 @@ class ParallelWrapper:
                             lst.iteration_done(m, m.iteration, m.epoch)
                         prof.mark("listener", monotonic_s() - _t)
                         prof.end(m.iteration)
+                    # bounded-pipeline backpressure point
+                    win.push(m._score, m.iteration)
+                # epoch boundary drains the window (one-sync-per-epoch
+                # listener cadence, same as the single-device fit)
+                win.drain()
                 for lst in m.listeners:
                     lst.on_epoch_end(m)
                 m.epoch += 1

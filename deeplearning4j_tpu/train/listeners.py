@@ -16,6 +16,27 @@ from ..observability.clock import monotonic_s
 log = logging.getLogger("deeplearning4j_tpu.train")
 
 
+def boundary_score(model):
+    """The latest host-visible score WITHOUT forcing a device sync.
+
+    Returns ``(score, drained_at)``.  A plain loop materializes
+    ``_score`` per step (host float — use it, ``drained_at`` None).
+    The pipelined fit loops keep ``_score`` a device scalar and publish
+    the most recently DRAINED step's value at the window boundary
+    (``last_drained_score`` / ``last_drained_iteration``, written by
+    ``nn.dispatch.DispatchWindow``): read that — stale by at most the
+    dispatch depth, never a host sync.  Only when neither exists (a
+    custom loop before anything drained) fall back to a real
+    ``get_score()`` sync."""
+    raw = getattr(model, "_score", None)
+    if isinstance(raw, float):
+        return raw, None
+    drained_at = getattr(model, "last_drained_iteration", -1)
+    if isinstance(drained_at, int) and drained_at >= 0:
+        return getattr(model, "last_drained_score", float("nan")), drained_at
+    return float(model.get_score()), None
+
+
 class TrainingListener:
     """Base callback; all hooks optional (reference TrainingListener.java)."""
 
@@ -46,7 +67,12 @@ class ScoreIterationListener(TrainingListener):
 
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.print_iterations == 0:
-            log.info("Score at iteration %d is %s", iteration, model.get_score())
+            score, drained_at = boundary_score(model)
+            if drained_at is not None and drained_at != iteration:
+                log.info("Score at iteration %d is %s (drained @ %d)",
+                         iteration, score, drained_at)
+            else:
+                log.info("Score at iteration %d is %s", iteration, score)
 
 
 class PerformanceListener(TrainingListener):
@@ -97,7 +123,9 @@ class PerformanceListener(TrainingListener):
             if etl is not None:
                 msg += f"; ETL: {etl:.1f} ms"
             if self.report_score:
-                msg += f"; score: {model.get_score()}"
+                # window-drain boundary read: rate reporting must not
+                # re-serialize the pipeline it is measuring
+                msg += f"; score: {boundary_score(model)[0]}"
             log.info(msg)
             self._last_time = now
             self._last_iter = iteration

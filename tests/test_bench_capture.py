@@ -149,7 +149,7 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 29
+    assert row["rules"] == 30
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
 
@@ -325,6 +325,32 @@ def test_profiler_overhead_ms_row():
         "etl_wait", "h2d", "dispatch", "device", "listener", "forensics",
         "checkpoint"}
     assert row["steps"] == 12 and row["runs"] == 2
+
+
+def test_dispatch_pipeline_ms_row():
+    """The bounded-dispatch pipeline bench line (ISSUE 18): row shape
+    for the paired depth=1-vs-windowed measurement on both arms.  A
+    tiny run keeps the test fast; the >=1.3x headline claim is a
+    full-bench property, but the structural guarantees — both arms
+    report every depth, ratios are finite, and flipping the host-only
+    depth knob never retraces — ARE asserted here."""
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    row = B.dispatch_pipeline_ms(depths=(2,), n_batches=6, runs=2)
+    assert row["metric"] == "dispatch_pipeline_ms"
+    assert row["unit"].startswith("ms/step")
+    assert row["depths"] == [2]
+    for arm in ("dispatch_bound", "compute_bound"):
+        sub = row[arm]
+        assert sub["depth1_ms_vs2"] > 0
+        assert sub["depth2_ms"] > 0
+        assert sub["speedup_depth2"] > 0
+    assert row["value"] == row["dispatch_bound"]["depth2_ms"]
+    # the depth knob lives host-side: two arms, two one-time compiles,
+    # zero retraces across every depth flip
+    assert row["train_step_traces_total"] <= 2
+    assert row["steady_recompiles"] == 0
+    assert row["steps"] == 6 and row["runs"] == 2
 
 
 def test_env_fingerprint_on_every_row():

@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX029 has at least one fixture that MUST fire and one
+Every rule JX001–JX030 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1518,6 +1518,90 @@ def test_jx029_pragma_suppresses():
                                                 _NN_PATH)}
 
 
+# ---------------------------------------------------------------- JX030
+def test_jx030_positive_tree_calls_and_comprehensions_in_loops():
+    # dotted tree_util call, jax.tree short form, bare import, and the
+    # params-like dict-comprehension rebuild — all inside loop bodies
+    src = """
+        import jax
+        from jax.tree_util import tree_map
+
+        def fit(batches, step, params):
+            for x in batches:
+                params = jax.tree_util.tree_map(lambda p: p, params)
+
+        def drain(handles, grads):
+            while handles:
+                handles.pop()
+                flat = jax.tree.leaves(grads)
+
+        def refresh(workers, params):
+            for w in workers:
+                w.params = tree_map(lambda p: p + 0, params)
+
+        def rebuild(batches, params):
+            for x in batches:
+                params = {k: v * 2 for k, v in params.items()}
+    """
+    fs = lint_source(textwrap.dedent(src), _NN_PATH)
+    assert sum(f.rule == "JX030" for f in fs) == 4
+
+
+def test_jx030_negative_header_once_per_fit_and_paths():
+    # a loop HEADER traversal runs once — for x in tree_leaves(p) is the
+    # canonical bytes-accounting idiom, not a per-step rebuild
+    src_header = """
+        import jax
+
+        def nbytes(params):
+            total = 0
+            for l in jax.tree_util.tree_leaves(params):
+                total += l.size
+            return total
+    """
+    assert "JX030" not in rules_at(src_header, _NN_PATH)
+    # outside a loop: placement happens once per fit
+    src_once = """
+        import jax
+
+        def place(params, sharding):
+            return jax.tree_util.tree_map(lambda p: p, params)
+    """
+    assert "JX030" not in rules_at(src_once, _NN_PATH)
+    # hot-path scoping: the same loop body is legal outside nn//parallel/
+    src_loop = """
+        import jax
+
+        def fold(rounds, params):
+            for r in rounds:
+                params = jax.tree_util.tree_map(lambda p: p, params)
+    """
+    for path in ("deeplearning4j_tpu/utils/fix.py",
+                 "deeplearning4j_tpu/observability/fix.py",
+                 "tests/test_fix.py"):
+        assert "JX030" not in rules_at(src_loop, path)
+    # a comprehension over a non-tree name stays silent
+    src_other = """
+        def fold(rounds, rows):
+            for r in rounds:
+                out = [c * 2 for c in rows]
+    """
+    assert "JX030" not in rules_at(src_other, _NN_PATH)
+
+
+def test_jx030_pragma_suppresses():
+    src = """
+        import jax
+
+        def average(rounds, params):
+            for r in rounds:
+                params = jax.tree_util.tree_map(lambda p: p, params)  # graftlint: disable=JX030  (once per averaging round, not per step)
+    """
+    assert "JX030" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _NN_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2572,7 +2656,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 25
+    assert len(RULES) == 26
     assert len(PROGRAM_RULES) == 4
 
 

@@ -66,7 +66,11 @@ def test_save_restore_exact_resume(tmp_path):
     restored = model_serializer.restore_multi_layer_network(p)
     # continue both nets one step — must match bit-for-bit-ish (momentum
     # buffers restored; only rng for dropout could differ, none here)
-    net._rng = restored._rng  # align rng streams
+    # align rng streams — as an OWNED copy: the fused-RNG train step
+    # donates the key, so sharing one buffer between two nets would hand
+    # the second fit a deleted buffer
+    import jax.numpy as jnp
+    net._rng = jnp.array(restored._rng)
     net.fit(x, y)
     restored.fit(x, y)
     np.testing.assert_allclose(np.asarray(net.output(x)),

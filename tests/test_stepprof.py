@@ -48,7 +48,7 @@ from deeplearning4j_tpu.observability.recorder import (FlightRecorder,
                                                        set_flight_recorder)
 from deeplearning4j_tpu.observability.registry import default_registry
 
-CARD_FLOPS = 43351.0          # committed tools/graftaudit/cards value
+CARD_FLOPS = 43446.0          # committed tools/graftaudit/cards value
 
 
 def tiny_net(seed=42):
@@ -104,10 +104,15 @@ class TestPhaseAttribution:
         sampled = [r for r in recs if r["sampled"]]
         unsampled = [r for r in recs if not r["sampled"]]
         assert len(sampled) == 6 and unsampled
-        # device slice: honest float on fenced steps, None (never an
-        # estimate) on unfenced ones
+        # device slice: honest float on fenced steps; on unfenced steps
+        # None — unless a later pipeline-aware fence drained the step's
+        # in-flight token and attributed its slice ("drained" marker)
         assert all(r["phases"]["device"] > 0 for r in sampled)
-        assert all(r["phases"]["device"] is None for r in unsampled)
+        for r in unsampled:
+            if r.get("drained"):
+                assert r["phases"]["device"] >= 0
+            else:
+                assert r["phases"]["device"] is None
         # the acceptance contract: on fenced steps the phase breakdown
         # sums to the step wall within 5%
         cov = phase_summary(recs)["sampled_coverage"]

@@ -1,0 +1,71 @@
+"""One-shot fit-loop throughput probe for the r18 pipelining sweep.
+
+Run in a FRESH interpreter per measurement (heap/cache isolation —
+same rationale as ``obs_overhead_ms(isolate=True)``):
+
+    python tools/bench_sweep_r18.py <dispatch|compute> [fits]
+
+Prints one JSON line: median steady examples/sec over ``fits`` fit()
+calls after a 2-batch warm.  The dispatch-bound arm is the tiny-MLP
+geometry where the step is microseconds and the loop pays host work;
+the compute-bound arm is the MLP-256 geometry where the device math
+dominates.  The depth knob under test rides the normal
+``DL4J_TPU_DISPATCH_DEPTH`` env var, read by the fit loop itself.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# resolve the package from the CURRENT working tree, not this file's
+# location — the r18 sweep runs a /tmp copy of this script against
+# stashed (pre-PR) and unstashed (post-PR) checkouts of the same repo
+sys.path.insert(0, os.getcwd())
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu import (InputType, MultiLayerNetwork,  # noqa: E402
+                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+
+
+def main():
+    arm = sys.argv[1] if len(sys.argv) > 1 else "dispatch"
+    fits = int(sys.argv[2]) if len(sys.argv) > 2 else 9
+    if arm == "dispatch":
+        hidden, features, classes, batch, nb = 16, 16, 4, 16, 200
+    else:
+        hidden, features, classes, batch, nb = 256, 128, 10, 128, 60
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(Adam(learning_rate=0.01)).list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(features)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(13)
+    batches = [(rng.standard_normal((batch, features)).astype(np.float32),
+                np.eye(classes, dtype=np.float32)[
+                    rng.integers(0, classes, batch)])
+               for _ in range(nb)]
+    net.fit(iter(batches[:2]), epochs=1)          # compile + warm
+    rates = []
+    for _ in range(fits):
+        t0 = time.perf_counter()
+        net.fit(iter(batches), epochs=1)
+        rates.append(nb * batch / (time.perf_counter() - t0))
+    print(json.dumps({
+        "arm": arm,
+        "depth_env": os.environ.get("DL4J_TPU_DISPATCH_DEPTH"),
+        "examples_per_sec": round(float(np.median(rates)), 1),
+        "spread": round((max(rates) - min(rates)) / float(np.median(rates)),
+                        3),
+        "fits": fits, "batches_per_fit": nb, "batch": batch,
+    }))
+
+
+if __name__ == "__main__":
+    main()

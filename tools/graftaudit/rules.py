@@ -32,10 +32,13 @@ AUDIT_RULE_DOCS: Dict[str, str] = {}
 #: returns); serve's padded batch is built per dispatch and never
 #: reread; the generation cache is threaded through both programs.
 DEAD_AFTER_CALL: Dict[str, tuple] = {
-    "train_step": (0, 1, 2),
-    "train_step_carry": (0, 1, 2),
-    "epoch_scan": (0, 1, 2),
-    "epochs_scan": (0, 1, 2),
+    # arg 3 is the RNG key: the fused-RNG step splits it in-program and
+    # returns the successor, so the caller's key is dead after the call
+    # (the fit loops thread `new_rng` straight back in)
+    "train_step": (0, 1, 2, 3),
+    "train_step_carry": (0, 1, 2, 3, 8),
+    "epoch_scan": (0, 1, 2, 3),
+    "epochs_scan": (0, 1, 2, 3),
     "serve": (2,),
     "prefill": (4,),
     "decode": (3,),
@@ -239,7 +242,7 @@ def ax005(ir_prog) -> List[Finding]:
     out: List[Finding] = []
     dead = DEAD_AFTER_CALL.get(ir_prog.kind)
     if dead is None and ir_prog.kind.startswith("pretrain"):
-        dead = (0, 1)
+        dead = (0, 1, 2)    # layer params, opt state, RNG key (fused split)
     if not dead:
         return out
     for argnum in dead:

@@ -1,6 +1,6 @@
 """graftlint rule implementations.
 
-Module-local rules JX001–JX017 and JX022–JX028 are functions ``rule(info:
+Module-local rules JX001–JX017 and JX022–JX030 are functions ``rule(info:
 ModuleInfo) -> list[Finding]`` registered in ``RULES``; they share the jit-scope + taint
 machinery in ``analysis.py`` (memoized per module, so every rule runs off
 one parse and one tree walk).  The whole-program concurrency pack
@@ -1612,6 +1612,118 @@ def jx029(info: ModuleInfo) -> List[Finding]:
                 "sample it like observability/profiler.py's fence, hoist "
                 "it past the loop, or pragma a deliberate timing sync "
                 "with its justification"))
+    return _dedupe(out)
+
+
+# --------------------------------------------------------------------- JX030
+# the per-step host work the dispatch pipeline must fit inside one device
+# step: a pytree rebuild in a fit/step loop is O(leaves) of Python per
+# iteration, the dominant term on the dispatch-bound arm
+_JX030_HOT_PATH_RE = re.compile(r"(^|[/\\])(nn|parallel)[/\\]")
+_JX030_TREE_FNS = frozenset((
+    "tree_map", "tree_flatten", "tree_unflatten", "tree_leaves",
+    "tree_structure", "tree_map_with_path", "tree_all", "tree_reduce"))
+_JX030_TREE_SHORT = frozenset((   # the jax.tree.* spellings
+    "map", "flatten", "unflatten", "leaves", "structure", "all", "reduce"))
+_JX030_PYTREE_NAME_RE = re.compile(
+    r"param|grad|state|opt|update|mu\b|nu\b", re.IGNORECASE)
+
+
+def _jx030_in_loop_body(info: ModuleInfo, node: ast.AST) -> bool:
+    """Like ``_in_loop_same_function`` but a call in a loop HEADER
+    (``for x in tree_leaves(p):`` / ``while tree_all(p):``... the
+    ``for`` form runs once, and header position marks intent either
+    way) does not count that loop — only code the loop body re-executes
+    per iteration is a per-step rebuild."""
+    prev: ast.AST = node
+    cur = info.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.Module)):
+            return False
+        if isinstance(cur, (ast.For, ast.AsyncFor)):
+            if prev is not cur.iter:
+                return True
+        elif isinstance(cur, ast.While):
+            if prev is not cur.test:
+                return True
+        prev = cur
+        cur = info.parent(cur)
+    return False
+
+
+@rule("JX030", "pytree rebuild (tree_map/tree_flatten/... or a dict/list "
+               "comprehension over a params-like tree) inside a for/while "
+               "loop in an nn// or parallel/ hot path")
+def jx030(info: ModuleInfo) -> List[Finding]:
+    """Flag per-iteration pytree traversal in the packages that own the
+    train loops: ``jax.tree_util.tree_map``/``tree_flatten``/... (any
+    jax alias, ``jax.tree.*`` short forms, and bare ``from jax.tree_util
+    import tree_map`` included) inside a ``for``/``while`` body in a
+    non-test ``nn/`` or ``parallel/`` module, plus dict/list
+    comprehensions rebuilding a params-like tree (an iterable named
+    param*/grad*/state/opt*/update*) in the same position.  The bounded
+    dispatch pipeline only overlaps host work with device execution
+    while the host's per-step cost stays under the device step time —
+    an O(n_leaves) Python traversal per iteration is exactly the term
+    that breaks that on real models (thousands of leaves, every step).
+    Hoist the traversal out of the loop (trace it into the step program,
+    or restructure so placement/flattening happens once per fit), or
+    pragma a deliberate per-iteration rebuild with its justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if _JX026_TEST_PATH_RE.search(path) or \
+            not _JX030_HOT_PATH_RE.search(path):
+        return out
+    bare: set = set()
+    for node in info.nodes(ast.ImportFrom):
+        if (node.module or "") in ("jax.tree_util", "jax.tree"):
+            for alias in node.names:
+                if alias.name in _JX030_TREE_FNS | _JX030_TREE_SHORT:
+                    bare.add(alias.asname or alias.name)
+    for node in info.nodes(ast.Call):
+        if not _jx030_in_loop_body(info, node):
+            continue
+        fn = node.func
+        name = dotted_name(fn)
+        dotted = False
+        if name:
+            parts = name.split(".")
+            if parts[0] in info.jax_aliases:
+                dotted = parts[-1] in _JX030_TREE_FNS or (
+                    len(parts) >= 2 and parts[-2] == "tree"
+                    and parts[-1] in _JX030_TREE_SHORT)
+        is_bare = isinstance(fn, ast.Name) and fn.id in bare
+        if dotted or is_bare:
+            out.append(_finding(
+                info, node, "JX030",
+                f"`{name or fn.id}` inside a loop in a train-loop "
+                "package: an O(n_leaves) pytree traversal per iteration "
+                "is host work the bounded dispatch pipeline cannot hide "
+                "— hoist it out of the loop (or into the jitted step), "
+                "or pragma a deliberate per-iteration rebuild with its "
+                "justification"))
+    for node in list(info.nodes(ast.DictComp)) + list(info.nodes(ast.ListComp)):
+        if not _jx030_in_loop_body(info, node):
+            continue
+        for gen in node.generators:
+            it = gen.iter
+            base = it
+            if isinstance(it, ast.Call) and \
+                    isinstance(it.func, ast.Attribute) and \
+                    it.func.attr in ("items", "values", "keys"):
+                base = it.func.value
+            name = dotted_name(base)
+            if name and _JX030_PYTREE_NAME_RE.search(name.split(".")[-1]):
+                out.append(_finding(
+                    info, node, "JX030",
+                    f"dict/list comprehension over `{name}` inside a "
+                    "loop in a train-loop package: a per-iteration "
+                    "rebuild of a params-like tree is O(n_leaves) host "
+                    "work the dispatch pipeline cannot hide — hoist it, "
+                    "or pragma a deliberate rebuild with its "
+                    "justification"))
+                break
     return _dedupe(out)
 
 
