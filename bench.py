@@ -119,6 +119,15 @@ a dispatch-bound tiny model and a compute-bound one, with the
 compile-counter-verified proof that flipping the host-only depth knob
 never retraces); DL4J_TPU_BENCH_PIPELINE_DEPTH=0 suppresses it.
 
+A seventeenth set of JSON lines records the time-to-first-token
+benchmark (``ttft_ms[arm]``: p50/p99 TTFT on a shared-prefix-heavy
+admission mix across three arms — the deprecated dense ring, the paged
+cache cold, and the paged cache with the content-hash prefix registry —
+with prefill tokens saved and the shared-vs-cold ratio; the
+``decode_tokens_per_sec`` set additionally carries ``cache_bytes`` /
+``slots_per_gb`` columns and a ``slot_capacity`` row pinning the
+4x-slots-at-dense-bytes claim); DL4J_TPU_BENCH_TTFT=0 suppresses it.
+
 Every printed row carries an ``env`` provenance block (cpu count,
 at-start load average, jax/jaxlib versions, x64 flag, DL4J_TPU_*
 overrides in effect) so round-over-round comparisons can separate
@@ -473,6 +482,20 @@ def main():
                           "unit": "ms/step dispatch-bound arm",
                           "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # TTFT rows (ISSUE 19): shared-prefix-heavy admission mix through
+    # the paged KV cache — dense ring vs paged cold vs paged shared,
+    # prefill tokens saved + shared-vs-cold ratio; a seventeenth set of
+    # JSON lines, opt-out DL4J_TPU_BENCH_TTFT=0
+    if os.environ.get("DL4J_TPU_BENCH_TTFT", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import ttft_ms
+            for row in ttft_ms():
+                print(_dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(_dumps({"metric": "ttft_ms", "value": None,
+                          "unit": "ms",
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -610,6 +633,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # on dispatch-bound + compute-bound arms, zero-retrace-verified;
         # isolated — the ratios are sub-ms host timings
         lambda: B.dispatch_pipeline_ms(isolate=True),
+        # paged KV cache (ISSUE 19): shared-prefix TTFT across the
+        # ring/paged-cold/paged-shared arms; the slot-capacity and
+        # cache-bytes columns ride decode_tokens_per_sec above
+        B.ttft_ms,
     ]
     side = []
     for fn in captures:

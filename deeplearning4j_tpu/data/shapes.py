@@ -57,7 +57,7 @@ from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["ShapePolicy", "default_shape_policy", "next_pow2",
-           "serving_buckets", "prefill_buckets"]
+           "serving_buckets", "prefill_buckets", "suffix_prefill_buckets"]
 
 # padded/real element ratios: 1.0 = no padding, right tail = pathological
 _RATIO_BUCKETS = (1.0, 1.05, 1.1, 1.25, 1.5, 2.0, 3.0, 4.0, 8.0, 16.0)
@@ -128,6 +128,24 @@ def prefill_buckets(max_len: int,
         out.append(b)
         b <<= 1
     return out + [int(max_len)]
+
+
+def suffix_prefill_buckets(max_len: int, block_size: int,
+                           ladder: Optional[Sequence[int]] = None) -> list:
+    """Prefill ladder for the PAGED engine, bucketing the *unshared
+    suffix* length rather than the whole prompt: a shared-prefix
+    admission runs only its suffix through the prefill program, so short
+    suffixes should ride small buckets instead of padding up to the full
+    prompt bucket.  The floor is the KV block size (a matched prefix
+    always ends on a block or COW boundary, so suffixes shorter than one
+    block are common); the top stays ``max_len`` because a cold prompt —
+    or a hot-swap migration re-prefilling a full history — is just a
+    suffix of length L with nothing shared.
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    return prefill_buckets(max_len, ladder,
+                           min_bucket=min(8, int(block_size)))
 
 
 def _pad_rows(a, pad: int, zero: bool = False):

@@ -8,14 +8,19 @@ carry primitives (``nn/layers/attention.py``: ``init_carry`` /
 generation.  Design pillars (the TensorFlow-paper bar, PAPERS.md
 1605.08695 — a small fixed program set with all dynamism as data):
 
-- **Slot ring KV cache** (:mod:`.cache`): one preallocated carry pytree
-  per layer, slot-batched ``[max_slots, ..., max_seq, ...]``; requests
-  borrow a slot for their lifetime and vacate it mid-flight.
+- **Paged KV cache** (:mod:`.cache`): one preallocated block pool
+  ``[n_blocks, heads, block_size, head_dim]`` per attention layer with
+  per-slot block tables as host DATA — decode memory scales with tokens
+  actually written, and content-hashed prompt-prefix blocks are shared
+  read-only across slots (copy-on-write on append).  The dense
+  ``SlotRing`` (``[max_slots, ..., max_seq, ...]`` per layer) remains
+  selectable via ``DL4J_TPU_KV_PAGED=0`` for one release (deprecated).
 - **Two steady-state programs** (:mod:`.programs`): bucketed *prefill*
-  (one request, prompt padded onto the ``data/shapes.prefill_buckets``
-  ladder, KV installed into its slot) and a fixed-shape one-token
-  *decode* step over the full slot batch with per-slot positions — new
-  ``"prefill"``/``"decode"`` kinds in the process-global trace cache,
+  (one request, suffix padded onto the ``data/shapes`` ladder, KV
+  written through the slot's block table) and a fixed-shape one-token
+  *decode* step over the full slot batch with per-slot tables/positions
+  — ``"paged_prefill"``/``"paged_decode"`` (and the dense
+  ``"prefill"``/``"decode"``) kinds in the process-global trace cache,
   zero recompiles after warmup.
 - **Traced sampling** (:mod:`.sampling`): greedy / temperature / top-k /
   top-p as data inside the programs, with per-slot RNG streams keyed by

@@ -38,24 +38,25 @@ decode inter-token p99 inside its SLO.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import numpy as np
 
-from ..data.shapes import prefill_buckets
+from ..data.shapes import prefill_buckets, suffix_prefill_buckets
 from ..observability import clock
 from ..observability.health import get_health_monitor
 from ..observability.quantiles import LatencyWindow
 from ..observability.recorder import get_flight_recorder
 from ..observability.registry import default_registry
 from ..parallel.inference import InvalidInputError
-from .cache import SlotRing
+from .cache import PagedKV, SlotRing
 
 __all__ = ["GenerationConfig", "GenerationEngine", "GenerationResult",
            "StaticSlotSource"]
@@ -86,6 +87,17 @@ class GenerationConfig:
     itl_slo_ms: Optional[float] = None  # decode SLO for readiness
     slo_window: int = 256
     slo_min_samples: int = 16
+    # paged-KV knobs (cache.PagedKV): tokens per physical block, pool
+    # size (None = full provision: max_slots * ceil(max_seq/block_size)
+    # + trash — size it DOWN to the expected actual-length workload to
+    # realize the memory win), and the prefix-sharing registry toggle.
+    block_size: int = 16
+    n_blocks: Optional[int] = None
+    prefix_sharing: bool = True
+    # None resolves from DL4J_TPU_KV_PAGED (default on); paged=False /
+    # DL4J_TPU_KV_PAGED=0 keeps the dense SlotRing selectable for one
+    # release (deprecated — it prices every slot at max_seq)
+    paged: Optional[bool] = None
 
 
 @dataclass
@@ -198,9 +210,26 @@ class GenerationEngine:
         self._slot_source = slot_source
         self._registry = registry
         self._health = health
-        self.buckets = prefill_buckets(self.config.max_seq,
-                                       self.config.prefill_ladder)
-        self.ring: Optional[SlotRing] = None
+        self._paged = (self.config.paged if self.config.paged is not None
+                       else os.environ.get("DL4J_TPU_KV_PAGED", "1")
+                       != "0")
+        if self._paged:
+            # suffix ladder: shared-prefix admissions prefill only their
+            # unshared tail, so short suffixes need small buckets (floor
+            # min(8, block_size)); the top bucket stays max_seq so
+            # migration re-prefill of a full history always fits
+            self.buckets = suffix_prefill_buckets(
+                self.config.max_seq, self.config.block_size,
+                self.config.prefill_ladder)
+        else:
+            log.warning(
+                "dense SlotRing KV selected (DL4J_TPU_KV_PAGED=0 / "
+                "paged=False): deprecated — every slot is priced at "
+                "max_seq; the paged block-pool cache becomes the only "
+                "organization next release")
+            self.buckets = prefill_buckets(self.config.max_seq,
+                                           self.config.prefill_ladder)
+        self.ring: Optional[Union[SlotRing, PagedKV]] = None
         self._ring_sig: Optional[str] = None
         self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=self.config.queue_limit)
@@ -286,7 +315,7 @@ class GenerationEngine:
                 "decode engine needs a framework network (_get_jitted)")
         return model
 
-    def _ensure_ring(self, model) -> SlotRing:
+    def _ensure_ring(self, model):
         """(Re)build the slot cache for the served topology.  A
         same-topology hot-swap keeps the ring (weights changed, shapes
         did not); a different topology rebuilds it — active sequences
@@ -305,10 +334,18 @@ class GenerationEngine:
                     "generation needs at least one carry-capable layer "
                     "(attention/transformer/RNN) — a pure feed-forward "
                     "stack has nothing to cache")
-            self.ring = SlotRing(model.conf, self.config.max_slots,
-                                 self.config.max_seq)
+            self.ring = self._new_ring(model.conf)
             self._ring_sig = sig
         return self.ring
+
+    def _new_ring(self, conf):
+        if self._paged:
+            return PagedKV(conf, self.config.max_slots,
+                           self.config.max_seq,
+                           block_size=self.config.block_size,
+                           n_blocks=self.config.n_blocks,
+                           prefix_sharing=self.config.prefix_sharing)
+        return SlotRing(conf, self.config.max_slots, self.config.max_seq)
 
     # -------------------------------------------------------------- warmup
     def warmup(self) -> int:
@@ -329,26 +366,50 @@ class GenerationEngine:
             # KV/pos) — trace against a scratch ring instead: identical
             # shapes, so the compiles land in the same trace cache
             live = ring.active_slots > 0
-            caches = SlotRing(model.conf, self.config.max_slots,
-                              self.config.max_seq).caches if live \
+            caches = self._new_ring(model.conf).caches if live \
                 else ring.caches
             warmed = 0
-            pf = model._get_jitted("prefill")
-            for b in self.buckets:
-                toks = np.zeros((1, b), np.int32)
-                mask = np.ones((1, b), np.float32)
-                _, caches = pf(
-                    model.params, model.state, toks, mask, caches,
-                    np.int32(0), np.int32(b), np.zeros((2,), np.uint32),
-                    np.float32(0.0), np.int32(0), np.float32(1.0))
-                warmed += 1
-            dec = model._get_jitted("decode")
             S = self.config.max_slots
-            out, caches = dec(
-                model.params, model.state, np.zeros((S,), np.int32),
-                caches, np.zeros((S, 2), np.uint32),
-                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-                np.ones((S,), np.float32))
+            if self._paged:
+                # warm every suffix bucket against an all-trash table
+                # (writes land in block 0, mask-dead) + the one decode
+                pf = model._get_jitted("paged_prefill")
+                nb = ring.blocks_per_slot
+                trow = np.zeros((nb,), np.int32)
+                for b in self.buckets:
+                    toks = np.zeros((1, b), np.int32)
+                    mask = np.ones((1, b), np.float32)
+                    _, caches = pf(
+                        model.params, model.state, toks, mask, caches,
+                        trow, np.int32(0), np.int32(0), np.int32(b),
+                        np.int32(0), np.int32(0),
+                        np.zeros((2,), np.uint32), np.float32(0.0),
+                        np.int32(0), np.float32(1.0))
+                    warmed += 1
+                dec = model._get_jitted("paged_decode")
+                out, caches = dec(
+                    model.params, model.state, np.zeros((S,), np.int32),
+                    caches, np.zeros((S, nb), np.int32),
+                    np.zeros((S,), np.int32), np.zeros((S, 2), np.uint32),
+                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                    np.ones((S,), np.float32))
+            else:
+                pf = model._get_jitted("prefill")
+                for b in self.buckets:
+                    toks = np.zeros((1, b), np.int32)
+                    mask = np.ones((1, b), np.float32)
+                    _, caches = pf(
+                        model.params, model.state, toks, mask, caches,
+                        np.int32(0), np.int32(b),
+                        np.zeros((2,), np.uint32), np.float32(0.0),
+                        np.int32(0), np.float32(1.0))
+                    warmed += 1
+                dec = model._get_jitted("decode")
+                out, caches = dec(
+                    model.params, model.state, np.zeros((S,), np.int32),
+                    caches, np.zeros((S, 2), np.uint32),
+                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                    np.ones((S,), np.float32))
             np.asarray(out)      # block until the compile fully lands
             warmed += 1
             if not live:
@@ -503,6 +564,9 @@ class GenerationEngine:
             "tick_failures": tick_failures,
             "steady_recompiles": steady,
             "warm": self._warm,
+            "kv_paged": self._paged,
+            "kv": (ring.stats() if isinstance(ring, PagedKV) else None),
+            "cache_bytes": None if ring is None else ring.cache_bytes,
         }
 
     # ---------------------------------------------------------- decode loop
@@ -555,6 +619,10 @@ class GenerationEngine:
                     # nothing to migrate: adopt the version; admission
                     # resolves/validates the model per request, so a
                     # bad slot fails requests instead of wedging ticks
+                    if isinstance(self.ring, PagedKV):
+                        # registered prefix blocks hold OLD-version K/V:
+                        # a new-version request must never adopt them
+                        self.ring.invalidate_shared()
                     self._serving_version = slot_obj.version
                 else:
                     # commit the version only AFTER the migration
@@ -594,6 +662,10 @@ class GenerationEngine:
             # where a stack-validation failure is attributed to the
             # request it affects instead of wedging the whole tick
             return False
+        if isinstance(old_ring, PagedKV):
+            # the prefix registry holds prev-version K/V — flush it
+            # before any re-prefill can publish/adopt under the new one
+            old_ring.invalidate_shared()
         ring = self._ensure_ring(model)
         rec = get_flight_recorder()
         for slot, req in sorted(occupants.items()):
@@ -604,6 +676,11 @@ class GenerationEngine:
                 old_ring.release(slot)
                 slot = ring.acquire(req)
                 req.slot = slot
+            elif isinstance(ring, PagedKV):
+                # same pool, new weights: drop the slot's stale blocks
+                # (occupant stays) — the re-prefill below allocates and
+                # writes fresh ones through the ordinary paged path
+                ring.reset_slot(slot)
             ring.note("migrate", slot, req.id, pos=len(req.history()),
                       from_version=prev, to_version=slot_obj.version)
             if rec is not None:
@@ -694,6 +771,8 @@ class GenerationEngine:
         prompt ladder, run it into ``slot``, return the first sampled
         token.  The single ``int()`` materialization is the point of the
         call — the token must reach the host to stream/EOS-check."""
+        if self._paged:
+            return self._prefill_paged(model, req, slot, history)
         ring = self.ring
         L = len(history)
         t_form = clock.monotonic_s()
@@ -724,6 +803,87 @@ class GenerationEngine:
                       execute_s=round(dt, 7), bucket=bucket)
         return tok
 
+    def _prefill_paged(self, model, req: _GenRequest, slot: int,
+                       history: List[int]) -> int:
+        """Paged admission: match the longest registered prompt prefix,
+        adopt its blocks by reference (COW for a partial tail), allocate
+        private blocks for the rest, and run ONE suffix-bucketed
+        paged-prefill program call that writes only the unshared tail.
+        Cold prompts and migration re-prefills are the same call with
+        ``start = 0``."""
+        kv: PagedKV = self.ring
+        L = len(history)
+        t_form = clock.monotonic_s()
+        full, partial = kv.match_prefix(history)
+        # largest shareable start whose padded suffix still fits the
+        # virtual axis (suffix writes run [start, start + bucket))
+        plans = ([(len(full), partial)] if partial else []) + \
+            [(nf, None) for nf in range(len(full), -1, -1)]
+        for nf, pt in plans:
+            start = nf * kv.block_size + (pt[1] if pt else 0)
+            suffix = L - start
+            bucket = next(b for b in self.buckets if suffix <= b)
+            if start + bucket <= kv.virtual_seq:
+                break
+        kv.adopt(slot, req.id, full[:nf])
+        cow_src = cow_dst = 0
+        if pt is not None:
+            dst = kv.cow_begin(slot, req.id, pt[0])
+            if dst is None:
+                raise RuntimeError(
+                    f"KV block pool exhausted admitting {req.id} (COW): "
+                    f"{kv.n_blocks} blocks, 0 free/evictable")
+            cow_src, cow_dst = pt[0], dst
+        try:
+            if not kv.ensure_blocks(slot, req.id, L):
+                raise RuntimeError(
+                    f"KV block pool exhausted admitting {req.id}: needs "
+                    f"{-(-L // kv.block_size)} blocks, pool of "
+                    f"{kv.n_blocks} has {kv.blocks_free} free")
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :suffix] = history[start:]
+            mask = np.zeros((1, bucket), np.float32)
+            mask[0, :suffix] = 1.0
+            key = np.array([req.seed, len(req.out_tokens)], np.uint32)
+            fn = model._get_jitted("paged_prefill")
+            t0 = clock.monotonic_s()
+            tok_dev, kv.caches = fn(
+                model.params, model.state, toks, mask, kv.caches,
+                kv.tables[slot].copy(), np.int32(slot), np.int32(start),
+                np.int32(suffix), np.int32(cow_src), np.int32(cow_dst),
+                key, np.float32(req.temperature), np.int32(req.top_k),
+                np.float32(req.top_p))
+            self._note_trace(fn)
+            tok = int(tok_dev)
+        finally:
+            if cow_dst:
+                kv.cow_end(cow_src)
+        kv.pos[slot] = L
+        reg = self._reg()
+        if start > 0:
+            kv.note_shared_hit(slot, req.id, start)
+            if reg.enabled:
+                reg.counter("generation_prefix_hits_total",
+                            "Admissions that adopted registered shared-"
+                            "prefix KV blocks").inc()
+                reg.counter("generation_prefix_tokens_saved_total",
+                            "Prompt tokens NOT prefilled thanks to "
+                            "shared-prefix adoption").inc(start)
+        kv.register_prefix(slot, req.prompt)
+        dt = clock.monotonic_s() - t0
+        if reg.enabled:
+            reg.histogram("generation_prefill_seconds",
+                          "Prefill program wall time per request",
+                          buckets=_STEP_BUCKETS).observe(dt)
+            reg.gauge("generation_blocks_free",
+                      "Free physical KV blocks in the paged pool"
+                      ).set(kv.blocks_free)
+        from ..observability.profiler import record_slices
+        record_slices("prefill", batch_form_s=round(t0 - t_form, 7),
+                      execute_s=round(dt, 7), bucket=bucket,
+                      shared_tokens=start)
+        return tok
+
     def _decode_guarded(self, slot_obj) -> bool:
         try:
             return self._decode_step(slot_obj)
@@ -743,6 +903,29 @@ class GenerationEngine:
         if not occupants:
             self._set_active_gauge()
             return False
+        if self._paged:
+            # grow each slot's table across its next block boundary (an
+            # aggregated host-side allocation, no device work) and
+            # enforce the COW invariant before any write can alias a
+            # shared block; a slot the pool cannot grow fails alone
+            starved = [(slot, req) for slot, req in
+                       sorted(occupants.items())
+                       if not ring.ensure_blocks(slot, req.id,
+                                                 int(ring.pos[slot]) + 1)]
+            for slot, req in starved:
+                del occupants[slot]
+                pos = int(ring.pos[slot])
+                ring.release(slot)
+                ring.note("vacate", slot, req.id,
+                          reason="blocks_exhausted")
+                self._fail(req, RuntimeError(
+                    f"KV block pool exhausted mid-decode for {req.id} at "
+                    f"pos {pos}: raise n_blocks (pool={ring.n_blocks})"))
+            if not occupants:
+                self._set_active_gauge()
+                return bool(starved)
+            for slot in occupants:
+                ring.check_writable(slot)
         model = self._model_of(slot_obj)
         S = self.config.max_slots
         t_form = clock.monotonic_s()
@@ -758,10 +941,18 @@ class GenerationEngine:
             temp[slot] = req.temperature
             top_k[slot] = req.top_k
             top_p[slot] = req.top_p
-        fn = model._get_jitted("decode")
         t0 = clock.monotonic_s()
-        out_dev, ring.caches = fn(model.params, model.state, toks,
-                                  ring.caches, keys, temp, top_k, top_p)
+        if self._paged:
+            fn = model._get_jitted("paged_decode")
+            out_dev, ring.caches = fn(model.params, model.state, toks,
+                                      ring.caches, ring.tables.copy(),
+                                      ring.pos.copy(), keys, temp, top_k,
+                                      top_p)
+        else:
+            fn = model._get_jitted("decode")
+            out_dev, ring.caches = fn(model.params, model.state, toks,
+                                      ring.caches, keys, temp, top_k,
+                                      top_p)
         self._note_trace(fn)
         # ONE materialization per STEP for the whole slot batch — the
         # per-token host syncs JX023 exists to kill live here, batched
@@ -785,6 +976,12 @@ class GenerationEngine:
         from ..observability.profiler import record_slices
         record_slices("decode", batch_form_s=round(t0 - t_form, 7),
                       execute_s=round(dt, 7), active=len(occupants))
+        if self._paged:
+            # the step wrote one token per active slot — advance the
+            # host position mirrors BEFORE emission (a finishing request
+            # releases its slot inside _emit, which resets its mirror)
+            for slot in occupants:
+                ring.pos[slot] += 1
         for slot, req in sorted(occupants.items()):
             self._emit(req, int(out[slot]), slot_obj.version, slot)
         self._set_active_gauge()
@@ -907,6 +1104,10 @@ class GenerationEngine:
             reg.gauge("generation_active_slots",
                       "Generation slots currently occupied by live "
                       "sequences").set(self.ring.active_slots)
+            if isinstance(self.ring, PagedKV):
+                reg.gauge("generation_blocks_free",
+                          "Free physical KV blocks in the paged pool"
+                          ).set(self.ring.blocks_free)
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from .sampling import sample_tokens
 
 __all__ = ["build_generation_fn", "fresh_carries", "install_carry",
-           "carried_layers"]
+           "carried_layers", "paged_layout"]
 
 # log-prob floor for softmax-headed models: keeps log() finite on exact
 # zeros without perturbing the sampling order of reachable tokens
@@ -83,6 +83,32 @@ def _fresh_carry(lc, batch: int, max_len: int):
 def fresh_carries(conf, batch: int, max_len: int) -> dict:
     return {name: _fresh_carry(lc, batch, max_len)
             for name, lc in carried_layers(conf).items()}
+
+
+def paged_layout(conf) -> dict:
+    """Classify every carried layer for the paged cache by its carry
+    schema (probed shape-only via ``eval_shape`` — no allocation):
+
+    - ``"attn"``: KV-style carry (``k``/``v``/``pos``) — K/V move into
+      the shared block pool, positions become engine data.
+    - ``"pos"``: position-only carry (positional encodings) — nothing
+      persisted; the per-slot position is reconstructed from engine data
+      at every call.
+    - ``"rnn"``: anything else (recurrent ``h``/``c`` state) — stays a
+      dense per-slot row; it is O(features), not O(tokens), so paging it
+      buys nothing and prefix sharing is disabled for such stacks
+      (recurrent state is not reconstructible from a suffix).
+    """
+    out = {}
+    for name, lc in carried_layers(conf).items():
+        probe = jax.eval_shape(lambda lc=lc: _fresh_carry(lc, 1, 8))
+        if isinstance(probe, dict) and {"k", "v", "pos"} <= set(probe):
+            out[name] = "attn"
+        elif isinstance(probe, dict) and set(probe) == {"pos"}:
+            out[name] = "pos"
+        else:
+            out[name] = "rnn"
+    return out
 
 
 def install_carry(cache: dict, carry: dict, slot, length):
@@ -173,5 +199,92 @@ def build_generation_fn(conf, kind: str):
             toks = sample_tokens(logp, keys, temp, top_k, top_p)
             return toks, carries
         return decode, (() if jax.default_backend() == "cpu" else (3,))
+
+    if kind == "paged_prefill":
+        layout = paged_layout(conf)
+        carried = carried_layers(conf)
+
+        def paged_prefill(params, state, tokens, mask, caches, table_row,
+                          slot, start, length, cow_src, cow_dst, key,
+                          temp, top_k, top_p):
+            """Suffix prefill through the block pool.  ``tokens``
+            [1, T] are the UNSHARED suffix ids (T = suffix bucket),
+            ``mask`` [1, T] marks the true suffix ``length``,
+            ``table_row`` [NB] int32 is this slot's block table (shared
+            prefix blocks + freshly-allocated private suffix blocks),
+            ``start`` is the first suffix position (== tokens adopted
+            from the registry), ``cow_src``/``cow_dst`` name a
+            copy-on-write block pair materialized in every pool before
+            the walk (0, 0 = no-op: block 0 is the trash block).
+            Samples the token after position ``start + length - 1`` and
+            row-installs any dense RNN carries at ``slot``.  Returns
+            (first sampled token (), new caches)."""
+            T = tokens.shape[1]
+            carries = {}
+            for name, kv_kind in layout.items():
+                if kv_kind == "attn":
+                    pool = {k2: v2.at[cow_dst].set(v2[cow_src])
+                            for k2, v2 in caches[name].items()}
+                    carries[name] = dict(pool, table=table_row, pos=start)
+                elif kv_kind == "pos":
+                    carries[name] = {"pos": start}
+                else:
+                    carries[name] = _fresh_carry(carried[name], 1, T)
+            probs, _ = _stack_forward(conf, params, state, tokens,
+                                      train=False, key=None, mask=mask,
+                                      carries=carries)
+            last = jnp.take(probs[0], length - 1, axis=0)        # [V]
+            logp = _head_logp(conf, last)
+            tok = sample_tokens(logp[None], key[None], temp[None],
+                                top_k[None], top_p[None])[0]
+            new_caches = {}
+            for name, kv_kind in layout.items():
+                if kv_kind == "attn":
+                    c = carries[name]
+                    new_caches[name] = {k2: c[k2] for k2 in caches[name]}
+                elif kv_kind == "rnn":
+                    new_caches[name] = install_carry(
+                        caches[name], carries[name], slot,
+                        start + length)
+            return tok, new_caches
+        return paged_prefill, (() if jax.default_backend() == "cpu"
+                               else (4,))
+
+    if kind == "paged_decode":
+        layout = paged_layout(conf)
+
+        def paged_decode(params, state, tokens, caches, tables, pos,
+                         keys, temp, top_k, top_p):
+            """One token per slot through the block pool.  ``tables``
+            [S, NB] int32 and ``pos`` [S] int32 are DATA — any slot/block
+            mix runs the same compile.  Inactive lanes (pos 0, all-trash
+            table) scatter their garbage write into block 0 and read
+            nothing (written-prefix mask).  Returns (next tokens [S],
+            new caches)."""
+            carries = {}
+            for name, kv_kind in layout.items():
+                if kv_kind == "attn":
+                    carries[name] = dict(caches[name], table=tables,
+                                         pos=pos)
+                elif kv_kind == "pos":
+                    carries[name] = {"pos": pos}
+                else:
+                    c = caches[name]
+                    carries[name] = dict(c) if isinstance(c, dict) else c
+            probs, _ = _stack_forward(conf, params, state, tokens[:, None],
+                                      train=False, key=None,
+                                      carries=carries)
+            logp = _head_logp(conf, probs[:, -1, :])             # [S, V]
+            toks = sample_tokens(logp, keys, temp, top_k, top_p)
+            new_caches = {}
+            for name, kv_kind in layout.items():
+                if kv_kind == "attn":
+                    c = carries[name]
+                    new_caches[name] = {k2: c[k2] for k2 in caches[name]}
+                elif kv_kind == "rnn":
+                    new_caches[name] = carries[name]
+            return toks, new_caches
+        return paged_decode, (() if jax.default_backend() == "cpu"
+                              else (3,))
 
     raise KeyError(kind)

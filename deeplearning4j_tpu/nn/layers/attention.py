@@ -112,6 +112,15 @@ def _run_attention(q, k, v, *, impl: str, causal: bool, mask, seq_axis: str,
     return sdpa_reference(q, k, v, mask=mask, causal=causal)
 
 
+def _kv_quantize(x):
+    """Per-(row, head) absmax int8 quantization of a ``[..., d]`` K/V
+    write: returns (q int8, scale f32 ``[...]``) with q*scale ≈ x."""
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = (jnp.maximum(amax, 1e-8) / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 @register_serde
 @dataclass
 class MultiHeadAttention(BaseLayerConf):
@@ -241,8 +250,14 @@ class MultiHeadAttention(BaseLayerConf):
         where every slot sits at its own sequence offset).  The vector
         form supports single-token steps only (t == 1): causality then
         reduces to the written-prefix mask, so one fixed-shape decode
-        program serves every slot mix."""
+        program serves every slot mix.
+
+        A carry holding ``kp`` (a paged block pool) dispatches to
+        :meth:`_attend_paged` instead — same contract, K/V gathered
+        through a block table."""
         from ...ops.attention import sdpa_reference
+        if isinstance(carry, dict) and "kp" in carry:
+            return self._attend_paged(p, x, carry, mask=mask)
         q = self._heads(x, p, "Wq", "bq")                 # [b,h,t,d]
         k_new = self._heads(x, p, "Wk", "bk")
         v_new = self._heads(x, p, "Wv", "bv")
@@ -293,6 +308,108 @@ class MultiHeadAttention(BaseLayerConf):
         if mask is not None:   # zero outputs at padded query steps
             y = y * mask.astype(y.dtype)[:, :, None]
         return y, {"k": k, "v": v, "m": m, "pos": pos + t}
+
+    @staticmethod
+    def _gather_pool(pool, scales, table, dtype):
+        """Materialize ``[S, h, V, d]`` keys/values by gathering pool
+        blocks through an ``[S, NB]`` block table (V = NB * block_size;
+        virtual position == token position).  int8 pools dequantize
+        against their ``[n_blocks, h, block]`` scales here — quantized
+        storage, full-precision math."""
+        g = pool[table]                            # [S, NB, h, blk, d]
+        if scales is not None:
+            g = g.astype(jnp.float32) * scales[table][..., None]
+        s_, nb, h, blk, d = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(s_, h, nb * blk,
+                                                  d).astype(dtype)
+
+    def _attend_paged(self, p, x, carry, *, mask=None):
+        """Gather-through-table attention over the paged KV block pool
+        (``generation/cache.PagedKV``).  Carry schema: ``kp``/``vp``
+        ``[n_blocks, h, block, d]`` pools (int8 pools add ``ksc``/``vsc``
+        ``[n_blocks, h, block]`` scales) plus the block ``table`` and
+        ``pos`` — ``[S, NB]`` table with vector ``[S]`` positions for the
+        fixed-shape decode step, ``[NB]`` row with a scalar suffix start
+        for shared-prefix prefill.  Tables and positions are DATA, never
+        shapes, so every slot/block mix rides one compiled program.
+
+        Writes land at ``table[pos // block], pos % block``; padded and
+        inactive lanes redirect to physical block 0 (the trash block —
+        reserved, never allocated, mask-dead).  Reads gather the full
+        virtual axis ``V = NB * block`` with virtual position == token
+        position, so the written-prefix mask is exactly the dense ring's
+        mask and the softmax sees the same finite entries in the same
+        order — masked tail entries contribute exact zeros, which is
+        what makes paged-vs-dense token streams bit-identical on
+        sequential-reduction backends."""
+        from ...ops.attention import sdpa_reference
+        q = self._heads(x, p, "Wq", "bq")                 # [b,h,t,d]
+        k_new = self._heads(x, p, "Wk", "bk")
+        v_new = self._heads(x, p, "Wv", "bv")
+        kp, vp = carry["kp"], carry["vp"]
+        table, pos = carry["table"], carry["pos"]
+        quant = kp.dtype == jnp.int8
+        blk = kp.shape[2]
+        t = q.shape[2]
+        b_ = x.shape[0]
+        chunk_valid = (jnp.ones((b_, t), jnp.float32) if mask is None
+                       else mask.astype(jnp.float32))
+        new_carry = dict(carry)
+        if getattr(pos, "ndim", 0) == 1:
+            # decode: one token per slot, per-slot positions, [S, NB]
+            if t != 1:
+                raise ValueError(
+                    "per-slot vector pos supports single-token decode "
+                    f"only (t=1), got a {t}-step chunk")
+            nb = table.shape[1]
+            bidx = jnp.clip(pos // blk, 0, nb - 1)
+            phys = jnp.take_along_axis(table, bidx[:, None], axis=1)[:, 0]
+            off = pos % blk
+            kw = k_new[:, :, 0, :]                        # [S, h, d]
+            vw = v_new[:, :, 0, :]
+            tab2 = table
+            written = (jnp.arange(nb * blk)[None, :]
+                       < (pos + t)[:, None]).astype(jnp.float32)
+            causal, q_offset = False, 0
+        else:
+            # shared-prefix prefill: batch 1, t suffix steps from `pos`
+            nb = table.shape[0]
+            p_j = pos + jnp.arange(t, dtype=jnp.int32)
+            bidx = jnp.clip(p_j // blk, 0, nb - 1)
+            phys = jnp.where(chunk_valid[0] > 0, table[bidx], 0)
+            off = p_j % blk
+            kw = k_new[0].transpose(1, 0, 2)              # [t, h, d]
+            vw = v_new[0].transpose(1, 0, 2)
+            tab2 = table[None, :]
+            v_ax = nb * blk
+            prefix = (jnp.arange(v_ax, dtype=jnp.int32)
+                      < pos).astype(jnp.float32)
+            chunk_m = jax.lax.dynamic_update_slice(
+                jnp.zeros((v_ax,), jnp.float32), chunk_valid[0], (pos,))
+            written = jnp.clip(prefix + chunk_m, 0.0, 1.0)[None, :]
+            causal, q_offset = self.causal, pos
+        if quant:
+            kq, ks = _kv_quantize(kw)
+            vq, vs = _kv_quantize(vw)
+            kp = kp.at[phys, :, off, :].set(kq)
+            vp = vp.at[phys, :, off, :].set(vq)
+            new_carry["ksc"] = carry["ksc"].at[phys, :, off].set(ks)
+            new_carry["vsc"] = carry["vsc"].at[phys, :, off].set(vs)
+        else:
+            kp = kp.at[phys, :, off, :].set(kw.astype(kp.dtype))
+            vp = vp.at[phys, :, off, :].set(vw.astype(vp.dtype))
+        k = self._gather_pool(kp, new_carry.get("ksc"), tab2, q.dtype)
+        v = self._gather_pool(vp, new_carry.get("vsc"), tab2, q.dtype)
+        o = sdpa_reference(q, k, v, mask=written, causal=causal,
+                           q_offset=q_offset)
+        new_carry.update(kp=kp, vp=vp, pos=pos + t)
+        o = o.transpose(0, 2, 1, 3).reshape(b_, t, -1)
+        y = o @ p["Wo"]
+        if self.has_bias:
+            y = y + p["bo"]
+        if mask is not None:   # zero outputs at padded query steps
+            y = y * mask.astype(y.dtype)[:, :, None]
+        return y, new_carry
 
     def apply_with_carry(self, variables, x, carry, *, train=False,
                          key=None, mask=None):

@@ -99,10 +99,25 @@ class PrecisionPolicy:
     growth_interval: int = 200
     keep_f32: Tuple[str, ...] = ("BatchNormalization",)
     overrides: Optional[Dict[str, str]] = None   # layer name -> dtype
+    # KV-cache storage dtype for the paged generation cache (ROADMAP 2d):
+    # None/float32 stores K/V as written; "int8" quantizes blocks at the
+    # cache write (per-token, per-head absmax scale) and dequantizes at
+    # the attention gather.  Lives on the policy — and therefore in the
+    # compile-cache topology signature — so an int8-cache net and an f32
+    # one can never false-share a trace.
+    kv_dtype: Optional[str] = None
 
     def __post_init__(self):
         self.compute_dtype = _canon_dtype(self.compute_dtype)
         self.param_dtype = _canon_dtype(self.param_dtype) or "float32"
+        if self.kv_dtype is not None:
+            kd = str(self.kv_dtype).lower()
+            kd = {"i8": "int8", "int8": "int8"}.get(kd, _canon_dtype(kd))
+            if kd not in ("int8", "float32"):
+                raise ValueError(
+                    f"kv_dtype must be None, 'float32' or 'int8', got "
+                    f"{self.kv_dtype!r}")
+            self.kv_dtype = None if kd == "float32" else kd
 
     # ----------------------------------------------------------- queries
     @property
@@ -167,6 +182,18 @@ def resolve(defaults: Dict[str, Any]) -> Optional[PrecisionPolicy]:
         # the only safe default
         p = dataclasses.replace(p, loss_scale="dynamic")
     return p
+
+
+def kv_cache_dtype(defaults: Dict[str, Any]) -> Optional[str]:
+    """KV-cache storage dtype for a conf's ``defaults``: ``"int8"`` when
+    the precision policy requests a quantized cache, else None (store as
+    written).  Unlike :func:`resolve` this reads the policy even when
+    compute runs full precision — an f32 net can still carry an int8
+    cache (the cache is storage, not math)."""
+    p = defaults.get("precision")
+    if isinstance(p, str):
+        p = named_policy(p)
+    return getattr(p, "kv_dtype", None)
 
 
 # ------------------------------------------------------------- step helpers

@@ -375,8 +375,15 @@ class ServingEngine:
         if generation is not None:
             from ..generation.engine import (GenerationConfig,
                                              GenerationEngine)
-            cfg = generation if isinstance(generation, GenerationConfig) \
-                else GenerationConfig()
+            if isinstance(generation, GenerationConfig):
+                cfg = generation
+            elif isinstance(generation, dict):
+                # config-file plumbing: {"max_slots": ..., "block_size":
+                # ..., "n_blocks": ..., "prefix_sharing": ...} straight
+                # from JSON — the paged-KV sizing knobs included
+                cfg = GenerationConfig(**generation)
+            else:
+                cfg = GenerationConfig()
             self.generation = GenerationEngine(lambda: self.slot, cfg,
                                                registry=registry)
 

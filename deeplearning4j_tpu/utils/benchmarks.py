@@ -16,6 +16,7 @@ rows and span histograms are directly comparable.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -656,6 +657,8 @@ def decode_tokens_per_sec(model=None, max_slots: int = 8,
                                 queue_limit=4096))
     try:
         engine.warmup()
+        cache_bytes = engine.ring.cache_bytes
+        slots_per_gb = round(max_slots / (cache_bytes / 2**30), 1)
         naive_tokens([1], 1)                 # compile the naive shape too
         for mix, n_requests, prompt_len, new_tokens in mixes:
             prompts = [rng.integers(0, vocab, prompt_len).tolist()
@@ -683,9 +686,152 @@ def decode_tokens_per_sec(model=None, max_slots: int = 8,
                 "vs_naive": round(tps / naive_tps, 2) if naive_tps else None,
                 "steady_recompiles": engine.steady_recompiles,
                 "decode_steps": engine.decode_steps,
+                "cache_bytes": cache_bytes,
+                "slots_per_gb": slots_per_gb,
             })
     finally:
         engine.shutdown()
+    rows.append(_slot_capacity_row(model, max_slots, max_seq))
+    return rows
+
+
+def _slot_capacity_row(model, max_slots: int, max_seq: int) -> Dict:
+    """The paged-KV memory claim as a pinned number: at the DENSE ring's
+    cache-byte budget, how many slots can decode CONCURRENTLY on a
+    short-actual-length workload (each sequence fits ONE block — at the
+    bench default, prompt 8 + 8 generated = 16 tokens vs a dense slot
+    priced at ``max_seq=128``)?  The paged pool is sized to the dense
+    ring's block count (trash block included), the paged engine to 4x
+    the slots, and the row verifies the whole fleet was simultaneously
+    resident (``peak_active``) with zero steady recompiles."""
+    from ..generation import GenerationConfig, GenerationEngine
+
+    rng = np.random.default_rng(7)
+    vocab = model.conf.layers[-1].n_out
+    # one block per sequence, 8 blocks per dense-slot-equivalent: the
+    # short-actual-length geometry scales with max_seq so toy configs
+    # exercise the same row contract the real bench scale pins
+    block = max(2, max_seq // 8)
+    dense = GenerationEngine.for_model(
+        model, GenerationConfig(max_slots=max_slots, max_seq=max_seq,
+                                paged=False))
+    try:
+        dense.warmup()
+        dense_bytes = dense.ring.cache_bytes
+    finally:
+        dense.shutdown()
+    paged_slots = 4 * max_slots
+    # the dense ring's K/V byte budget expressed in blocks (trash block
+    # INCLUDED — the pool must not exceed the dense bytes it replaces)
+    n_blocks = max_slots * (max_seq // block)
+    paged = GenerationEngine.for_model(
+        model, GenerationConfig(max_slots=paged_slots, max_seq=max_seq,
+                                paged=True, block_size=block,
+                                n_blocks=n_blocks, queue_limit=4096))
+    try:
+        paged.warmup()
+        paged_bytes = paged.ring.cache_bytes
+        # queue the whole fleet before a tick can admit any of it: ticks
+        # serialize on the engine step lock, so holding it across the
+        # submits makes admission one batch and the simultaneous-
+        # residency claim deterministic (short requests would otherwise
+        # finish before the submit loop does)
+        with paged._step_lock:
+            reqs = [paged.submit(
+                        rng.integers(0, vocab, block // 2).tolist(),
+                        max_new_tokens=block - block // 2)
+                    for _ in range(paged_slots)]
+        results = [r.future.result(timeout=600) for r in reqs]
+        assert all(r.finish == "length" for r in results)
+        peak = paged.ring.peak_active
+        return {
+            "metric": "decode_tokens_per_sec[slot_capacity]",
+            "value": round(paged_slots / max_slots, 2),
+            "unit": "x_dense_slots",
+            "dense_slots": max_slots, "paged_slots": paged_slots,
+            "peak_active": peak, "block_size": block,
+            "n_blocks": n_blocks, "max_seq": max_seq,
+            "cache_bytes": paged_bytes, "dense_cache_bytes": dense_bytes,
+            "bytes_vs_dense": round(paged_bytes / dense_bytes, 3),
+            "slots_per_gb": round(paged_slots / (paged_bytes / 2**30), 1),
+            "dense_slots_per_gb": round(
+                max_slots / (dense_bytes / 2**30), 1),
+            "steady_recompiles": paged.steady_recompiles,
+        }
+    finally:
+        paged.shutdown()
+
+
+def ttft_ms(model=None, max_slots: int = 4, max_seq: int = 128,
+            n_requests: int = 16, prefix_len: int = 64,
+            suffix_len: int = 8, new_tokens: int = 4) -> List[Dict]:
+    """Time-to-first-token under a shared-prefix-heavy admission mix
+    (ISSUE 19): every request carries the same ``prefix_len``-token
+    system/few-shot header plus a unique ``suffix_len`` tail — the
+    workload prefix sharing exists for.  Three arms, identical requests:
+
+    - ``ring``: the dense SlotRing (deprecated) — every admission
+      prefills its full prompt;
+    - ``paged_cold``: paged cache, sharing disabled — the paged-gather
+      overhead in isolation;
+    - ``paged_shared``: paged cache with the content-hash prefix
+      registry — after the first request registers the header blocks,
+      every later admission adopts them and prefills only its suffix.
+
+    Requests run SEQUENTIALLY (TTFT here isolates the prefill path, not
+    queueing).  Rows carry p50/p99 TTFT, prefill tokens saved, the
+    shared-vs-cold ratio on the shared arm (the >= 2x acceptance gate),
+    and the steady-recompile counter (the suffix ladder must absorb
+    every suffix shape at warmup)."""
+    from ..generation import GenerationConfig, GenerationEngine
+    from ..models import TransformerLM
+
+    if model is None:
+        model = TransformerLM(vocab_size=64, seq_len=max_seq, embed=64,
+                              n_layers=2, n_heads=4).init()
+    rng = np.random.default_rng(3)
+    vocab = model.conf.layers[-1].n_out
+    prefix = rng.integers(0, vocab, prefix_len).tolist()
+    prompts = [prefix + rng.integers(0, vocab, suffix_len).tolist()
+               for _ in range(n_requests)]
+
+    arms = (("ring", dict(paged=False)),
+            ("paged_cold", dict(paged=True, prefix_sharing=False)),
+            ("paged_shared", dict(paged=True, prefix_sharing=True)))
+    rows: List[Dict] = []
+    cold_p50 = None
+    for arm, cfg_kw in arms:
+        engine = GenerationEngine.for_model(
+            model, GenerationConfig(max_slots=max_slots, max_seq=max_seq,
+                                    **cfg_kw))
+        try:
+            engine.warmup()
+            ttfts = []
+            for p in prompts:
+                req = engine.submit(p, max_new_tokens=new_tokens)
+                req.future.result(timeout=600)
+                ttfts.append((req.t_first - req.t_submit) * 1e3)
+            stats = engine.status().get("kv") or {}
+            p50 = float(np.percentile(ttfts, 50))
+            if arm == "paged_cold":
+                cold_p50 = p50
+            row = {
+                "metric": f"ttft_ms[{arm}]",
+                "value": round(p50, 3), "unit": "ms", "arm": arm,
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(float(np.percentile(ttfts, 99)), 3),
+                "requests": n_requests, "prefix_len": prefix_len,
+                "suffix_len": suffix_len, "new_tokens": new_tokens,
+                "prefill_tokens_saved": stats.get("prefix_tokens_saved",
+                                                  0),
+                "prefix_hits": stats.get("prefix_hits", 0),
+                "steady_recompiles": engine.steady_recompiles,
+            }
+            if arm == "paged_shared" and cold_p50:
+                row["vs_cold"] = round(cold_p50 / p50, 2)
+            rows.append(row)
+        finally:
+            engine.shutdown()
     return rows
 
 

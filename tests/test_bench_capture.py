@@ -149,7 +149,7 @@ def test_lint_time_ms_row():
     assert row["unit"].startswith("ms")
     assert row["value"] > 0
     assert row["files"] >= 3          # serving/ has engine + 2 servers
-    assert row["rules"] == 30
+    assert row["rules"] == 31
     assert row["findings"] == 0       # the swept package stays clean
     assert row["runs"] == 1
 
@@ -198,15 +198,59 @@ def test_decode_tokens_per_sec_rows():
                                           ("prefill_heavy", 3, 20, 3)))
     assert [r["metric"] for r in rows] == [
         "decode_tokens_per_sec[decode_heavy]",
-        "decode_tokens_per_sec[prefill_heavy]"]
-    for row in rows:
+        "decode_tokens_per_sec[prefill_heavy]",
+        "decode_tokens_per_sec[slot_capacity]"]
+    for row in rows[:2]:
         assert row["unit"] == "tokens/sec"
         assert row["value"] > 0 and row["naive_tokens_per_sec"] > 0
         assert row["vs_naive"] > 0
         assert row["tokens"] == row["requests"] * row["new_tokens"]
         assert row["decode_steps"] > 0
+        # paged-KV sizing columns (ISSUE 19)
+        assert row["cache_bytes"] > 0
+        assert row["slots_per_gb"] > 0
         # the warmed two-program set held across the whole mixed run
         assert row["steady_recompiles"] == 0
+    cap = rows[2]
+    assert cap["unit"] == "x_dense_slots"
+    # the whole 4x fleet was simultaneously resident inside the dense
+    # ring's K/V byte budget with the steady program set intact
+    assert cap["value"] == 4.0
+    assert cap["peak_active"] == cap["paged_slots"] == 4 * cap["dense_slots"]
+    assert cap["bytes_vs_dense"] <= 1.0
+    assert cap["slots_per_gb"] > cap["dense_slots_per_gb"]
+    assert cap["steady_recompiles"] == 0
+
+
+def test_ttft_ms_rows():
+    """The time-to-first-token bench line (ISSUE 19): one row per arm
+    (dense ring / paged cold / paged shared-prefix) with p50/p99 TTFT,
+    the shared arm's prefix-hit accounting, and the counter-verified
+    zero-recompile steady state.  Tiny CPU config — the >= 2x
+    shared-vs-cold acceptance gate is asserted at the real bench scale
+    where the shared prefix is 64 of 72 prompt tokens; at this toy
+    scale only the row contract, the hit counters, and the recompile
+    counter are stable."""
+    from deeplearning4j_tpu.models import TransformerLM
+    from deeplearning4j_tpu.utils import benchmarks as B
+
+    lm = TransformerLM(vocab_size=17, seq_len=32, embed=16, n_layers=2,
+                       n_heads=2).init()
+    rows = B.ttft_ms(model=lm, max_slots=2, max_seq=32, n_requests=4,
+                     prefix_len=16, suffix_len=4, new_tokens=2)
+    assert [r["metric"] for r in rows] == [
+        "ttft_ms[ring]", "ttft_ms[paged_cold]", "ttft_ms[paged_shared]"]
+    for row in rows:
+        assert row["unit"] == "ms"
+        assert row["value"] > 0 and row["p99_ms"] >= row["value"]
+        assert row["requests"] == 4
+        assert row["steady_recompiles"] == 0
+    # only the shared arm re-uses registered prefix blocks: every
+    # request after the first skips the shared 16-token prefix
+    assert rows[0]["prefix_hits"] == rows[1]["prefix_hits"] == 0
+    assert rows[2]["prefix_hits"] == 3
+    assert rows[2]["prefill_tokens_saved"] > 0
+    assert rows[2]["vs_cold"] > 0
 
 
 def test_elastic_reshard_ms_row():

@@ -358,7 +358,7 @@ class TestGenerationEngine:
 
         def patched(kind):
             fn = orig(kind)
-            if kind == "decode" and fail.is_set():
+            if kind in ("decode", "paged_decode") and fail.is_set():
                 def boom(*a, **k):
                     raise RuntimeError("injected decode fault")
                 return boom

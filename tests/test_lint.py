@@ -1,7 +1,7 @@
 """graftlint: per-rule positive/negative fixtures + the tier-1 gate that
 keeps ``deeplearning4j_tpu/`` clean modulo the checked-in baseline.
 
-Every rule JX001–JX030 has at least one fixture that MUST fire and one
+Every rule JX001–JX031 has at least one fixture that MUST fire and one
 that MUST stay silent; the whole-program concurrency pass (JX018–JX021)
 additionally unit-tests its thread-entry / guarded-by / lock-order
 inference layers.  The gate test makes every future PR re-lint the whole
@@ -1602,6 +1602,88 @@ def test_jx030_pragma_suppresses():
                                                 _NN_PATH)}
 
 
+# ---------------------------------------------------------------- JX031
+def test_jx031_positive_per_block_transfers():
+    # per-block device traffic in all three spellings: .item() per table
+    # entry, device_put per block of a table-iterating loop, and
+    # device_get subscripting the table inside a while loop
+    src = """
+        import jax
+        import numpy as np
+
+        def gather(tables, slot, n, kv):
+            out = []
+            for i in range(n):
+                out.append(kv[tables[slot, i].item()])
+            return out
+
+        def upload(table_row, pool):
+            for blk in table_row:
+                jax.device_put(blk)
+
+        def drain(tables, pending):
+            while pending:
+                pending.pop()
+                row = jax.device_get(tables[0])
+    """
+    fs = lint_source(textwrap.dedent(src), _GENERATION_PATH)
+    assert sum(f.rule == "JX031" for f in fs) == 3
+
+
+def test_jx031_negative_whole_table_bookkeeping_and_paths():
+    # the engine's contract: the WHOLE table ships once per program call
+    # (outside any loop), and host-side allocator bookkeeping loops over
+    # tables never touch the device — both stay silent
+    src_ok = """
+        import jax
+        import numpy as np
+
+        def step(fn, caches, tables, pos):
+            return fn(caches, tables.copy(), pos.copy())
+
+        def release(tables, slot, refs):
+            for blk in tables[slot]:
+                refs[int(blk)] -= 1
+    """
+    assert "JX031" not in rules_at(src_ok, _GENERATION_PATH)
+    # a .item() in a loop NOT touching a table is JX023's business
+    src_item = """
+        import jax
+
+        def emit(toks):
+            for t in toks:
+                yield t.item()
+    """
+    assert "JX031" not in rules_at(src_item, _GENERATION_PATH)
+    # path scoping: identical per-block code outside generation/ (and in
+    # generation tests) is out of scope
+    src_loop = """
+        import jax
+
+        def upload(table_row):
+            for blk in table_row:
+                jax.device_put(blk)
+    """
+    for path in ("deeplearning4j_tpu/nn/fix.py",
+                 "tests/test_generation.py"):
+        assert "JX031" not in rules_at(src_loop, path)
+
+
+def test_jx031_pragma_suppresses():
+    src = """
+        import jax
+
+        def dump(tables, slot):
+            rows = []
+            for i in range(tables.shape[1]):
+                rows.append(tables[slot, i].item())  # graftlint: disable=JX031  (debug dump tool, not the request path)
+            return rows
+    """
+    assert "JX031" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _GENERATION_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2656,7 +2738,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 26
+    assert len(RULES) == 27
     assert len(PROGRAM_RULES) == 4
 
 

@@ -2,7 +2,8 @@
 
 One tiny representative per steady-state program class the framework
 ships — dense / ZeRO-3-sharded (dp=2, dp=4) / bf16 train steps, the
-serving forward, and the two generation programs — driven through the
+serving forward, and the generation programs (the deprecated dense
+ring's prefill/decode pair AND the paged-KV pair) — driven through the
 REAL production entry points (``fit``, ``ShardedTrainer.fit``, the
 ``serve`` jit, ``GenerationEngine.warmup``), so the audited jaxprs are
 the very traces production executes, not hand-built fixtures.  The
@@ -60,7 +61,7 @@ CANONICAL_CONFIG = AuditConfig(min_donate_bytes=256,
 CANONICAL_PROGRAM_NAMES = (
     "train_step[dense]", "train_step[zero3,dp=2]", "train_step[zero3,dp=4]",
     "train_step[bf16]", "train_step[f16]", "serve", "prefill", "decode",
-    "train_step[embedding_zero3]",
+    "paged_prefill", "paged_decode", "train_step[embedding_zero3]",
 )
 
 _FEATURES, _CLASSES, _HIDDEN, _BATCH = 16, 8, 32, 8
@@ -265,13 +266,14 @@ def build_canonical(include: Optional[Sequence[str]] = None,
             entry_p = net_p._get_jitted("train_step")
             programs.append(AuditProgram(
                 name, entry_p, _pick_spec(entry_p, 1), policy=prec))
-        if want("prefill") or want("decode"):
+        gen_names = ("prefill", "decode", "paged_prefill", "paged_decode")
+        if any(want(n) for n in gen_names):
             try:
                 from deeplearning4j_tpu.generation import (
                     GenerationConfig, GenerationEngine)
                 from deeplearning4j_tpu.models import TransformerLM
             except ImportError as e:
-                for name in ("prefill", "decode"):
+                for name in gen_names:
                     if want(name):
                         skipped[name] = \
                             f"generation/model extras unavailable: {e}"
@@ -279,13 +281,27 @@ def build_canonical(include: Optional[Sequence[str]] = None,
 
             lm = TransformerLM(vocab_size=17, seq_len=16, embed=16,
                                n_layers=2, n_heads=2).init()
-            eng = GenerationEngine.for_model(
-                lm, GenerationConfig(max_slots=2, max_seq=16))
-            try:
-                eng.warmup()
-                eng.generate([3, 1, 4], max_new_tokens=2)
-            finally:
-                eng.shutdown()
+            # the dense ring (deprecated, DL4J_TPU_KV_PAGED=0) and the
+            # paged cache are BOTH steady program classes until the ring
+            # is removed — each engine records its own pair's specs
+            if want("prefill") or want("decode"):
+                eng = GenerationEngine.for_model(
+                    lm, GenerationConfig(max_slots=2, max_seq=16,
+                                         paged=False))
+                try:
+                    eng.warmup()
+                    eng.generate([3, 1, 4], max_new_tokens=2)
+                finally:
+                    eng.shutdown()
+            if want("paged_prefill") or want("paged_decode"):
+                eng_p = GenerationEngine.for_model(
+                    lm, GenerationConfig(max_slots=2, max_seq=16,
+                                         paged=True, block_size=4))
+                try:
+                    eng_p.warmup()
+                    eng_p.generate([3, 1, 4], max_new_tokens=2)
+                finally:
+                    eng_p.shutdown()
             if want("prefill"):
                 pf = lm._get_jitted("prefill")
                 programs.append(AuditProgram(
@@ -319,6 +335,40 @@ def build_canonical(include: Optional[Sequence[str]] = None,
                         "same CPU no-donation skip, exact-solver form: "
                         "the lifetime solver proves the threaded slot "
                         "cache (arg 3) donatable, and on TPU it IS "
+                        "donated — CPU cannot alias buffers"))
+            if want("paged_prefill"):
+                ppf = lm._get_jitted("paged_prefill")
+                programs.append(AuditProgram(
+                    "paged_prefill", ppf, _pick_largest_prefill(ppf)))
+                if cpu:
+                    sups.append(Suppression(
+                        "paged_prefill", "AX005",
+                        "CPU implements no buffer donation; "
+                        "generation/programs.build_generation_fn skips "
+                        "donating the block pool there — on TPU both "
+                        "paged programs donate it"))
+                    sups.append(Suppression(
+                        "paged_prefill", "AX007",
+                        "same CPU no-donation skip, exact-solver form: "
+                        "the lifetime solver proves the threaded block "
+                        "pool (arg 4) donatable, and on TPU it IS "
+                        "donated — CPU cannot alias buffers"))
+            if want("paged_decode"):
+                pdec = lm._get_jitted("paged_decode")
+                programs.append(AuditProgram(
+                    "paged_decode", pdec, pdec.audit_specs()[-1]))
+                if cpu:
+                    sups.append(Suppression(
+                        "paged_decode", "AX005",
+                        "CPU implements no buffer donation; "
+                        "generation/programs.build_generation_fn skips "
+                        "donating the block pool there — on TPU both "
+                        "paged programs donate it"))
+                    sups.append(Suppression(
+                        "paged_decode", "AX007",
+                        "same CPU no-donation skip, exact-solver form: "
+                        "the lifetime solver proves the threaded block "
+                        "pool (arg 3) donatable, and on TPU it IS "
                         "donated — CPU cannot alias buffers"))
     finally:
         cc.set_audit_capture(prev_mode)

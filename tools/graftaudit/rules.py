@@ -42,6 +42,10 @@ DEAD_AFTER_CALL: Dict[str, tuple] = {
     "serve": (2,),
     "prefill": (4,),
     "decode": (3,),
+    # the paged pair threads the BLOCK POOL (tables/pos ride along as
+    # host-mirrored data args and are rebuilt per call, never donated)
+    "paged_prefill": (4,),
+    "paged_decode": (3,),
 }
 
 _LOW_PRECISION = ("bfloat16", "float16")

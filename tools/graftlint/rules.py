@@ -1727,6 +1727,111 @@ def jx030(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX031
+# scope: the paged-KV request path — block tables are fixed-shape int32
+# DATA passed whole to the two steady programs; per-block Python on the
+# host side is the O(blocks)-dispatches pattern paging must not reintroduce
+_JX031_PATH_RE = re.compile(r"(^|[/\\])generation[/\\]")
+_JX031_TABLE_RE = re.compile(
+    r"(^|_)(block_)?(tables?|table_rows?)($|_)|(^|_)block_ids($|_)")
+_JX031_XFER = frozenset(("device_put", "device_get"))
+
+
+def _jx031_table_named(node: ast.AST) -> bool:
+    """A block-table-typed expression: a (possibly subscripted) plain or
+    dotted name whose final component spells a table (``tables``,
+    ``table_row``, ``self.ring.tables[slot]``, ``block_ids``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    name = dotted_name(node)
+    if not name:
+        return False
+    return bool(_JX031_TABLE_RE.search(name.split(".")[-1]))
+
+
+def _jx031_subscripts_table(node: ast.AST) -> bool:
+    """True when the expression subscripts (or IS) a block-table-named
+    value — ``tables[slot, i]``, ``row[i]`` where row spells a table."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript) and _jx031_table_named(sub):
+            return True
+    return False
+
+
+def _jx031_xfer_kind(info: ModuleInfo, node: ast.Call) -> Optional[str]:
+    """Classify a per-block transfer/sync call: ``jax.device_put`` /
+    ``jax.device_get`` (any jax alias or bare import) or ``.item()``."""
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+            and not node.args:
+        return ".item()"
+    name = call_name(node) or ""
+    parts = name.split(".")
+    if parts[-1] in _JX031_XFER and (
+            len(parts) == 1 or parts[0] in info.jax_aliases):
+        return f"{name}(...)"
+    return None
+
+
+@rule("JX031", "per-block host iteration over a KV block table "
+               "(device_put/device_get/.item() per block) in a "
+               "generation/ loop body")
+def jx031(info: ModuleInfo) -> List[Finding]:
+    """Flag per-block device traffic on the paged-KV request path: a
+    ``jax.device_put``/``jax.device_get``/``.item()`` call inside a
+    ``for`` loop iterating over a block-table-named value, or such a
+    call subscripting a table-named value inside any loop body, in a
+    non-test ``generation/`` module.  The paged cache's contract is
+    that block tables are fixed-shape int32 DATA shipped whole once per
+    program call (``paged_prefill`` takes the slot's full table row,
+    ``paged_decode`` the whole ``[slots, blocks]`` matrix) and every
+    gather happens inside the traced program; Python iterating the
+    table and touching the device per BLOCK turns one dispatch into
+    O(blocks_per_slot) round-trips per step — at 16-token blocks and
+    2k-token sequences that is 128 dispatches where the design pays
+    one, and it grows with sequence length exactly the way paging
+    exists to prevent.  Host-side bookkeeping loops over tables
+    (allocator refcounts, numpy mirror updates) are fine — only the
+    per-block device transfer is the defect.  JX023 catches generic
+    per-token syncs; this rule catches the per-BLOCK shape specific to
+    the paged layout.  A deliberate per-block transfer (a debug dump
+    tool) carries a pragma with its justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX031_PATH_RE.search(path) or _JX026_TEST_PATH_RE.search(path):
+        return out
+    if not (info.jax_aliases or info.jnp_aliases or info.numpy_aliases):
+        return out
+    table_loops: List[ast.AST] = [
+        loop for loop in list(info.nodes(ast.For)) +
+        list(info.nodes(ast.AsyncFor))
+        if _jx031_table_named(loop.iter) or (
+            isinstance(loop.iter, ast.Call) and
+            isinstance(loop.iter.func, ast.Attribute) and
+            loop.iter.func.attr in ("tolist", "items", "values") and
+            _jx031_table_named(loop.iter.func.value))]
+    for node in info.nodes(ast.Call):
+        kind = _jx031_xfer_kind(info, node)
+        if kind is None:
+            continue
+        in_table_loop = any(
+            node in ast.walk(loop) and node is not loop.iter
+            for loop in table_loops)
+        per_block_arg = _in_loop_same_function(info, node) and (
+            _jx031_subscripts_table(node.func) or
+            any(_jx031_subscripts_table(a) for a in node.args))
+        if in_table_loop or per_block_arg:
+            out.append(_finding(
+                info, node, "JX031",
+                f"`{kind}` per block of a KV block table inside a loop "
+                "in a generation/ module: the table is fixed-shape "
+                "int32 data the steady programs take WHOLE — per-block "
+                "host transfers turn one dispatch into O(blocks) "
+                "round-trips per step and scale with sequence length; "
+                "ship the full table as a program argument and gather "
+                "inside the trace (or pragma a deliberate debug dump)"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
