@@ -128,6 +128,15 @@ with prefill tokens saved and the shared-vs-cold ratio; the
 ``slots_per_gb`` columns and a ``slot_capacity`` row pinning the
 4x-slots-at-dense-bytes claim); DL4J_TPU_BENCH_TTFT=0 suppresses it.
 
+An eighteenth set of JSON lines records the serving-fleet benchmark
+(``serve_fleet[predict,r=N]`` / ``serve_fleet[decode,r=N]``: closed-loop
+req/s and decode tokens/s through the replicated ``ServingFleet`` at 1,
+2, and 4 device-paced replicas with ``vs_one_replica`` scaling ratios,
+plus a ``serve_fleet[recovery]`` chaos row — kill one replica mid-decode
+and report the worst migrated session's kill-to-first-survivor-token gap
+— with ``steady_recompiles`` on every row); DL4J_TPU_BENCH_FLEET=0
+suppresses it.
+
 Every printed row carries an ``env`` provenance block (cpu count,
 at-start load average, jax/jaxlib versions, x64 flag, DL4J_TPU_*
 overrides in effect) so round-over-round comparisons can separate
@@ -496,6 +505,21 @@ def main():
                           "unit": "ms",
                           "error": f"{type(e).__name__}: {e}"[:300]}))
 
+    # serving fleet rows (ISSUE 20): replicated engines behind one
+    # admission tier — predict req/s + decode tokens/s at 1/2/4 paced
+    # replicas with vs_one_replica ratios and a kill-one-replica
+    # recovery_ms chaos row; an eighteenth set of JSON lines, opt-out
+    # DL4J_TPU_BENCH_FLEET=0
+    if os.environ.get("DL4J_TPU_BENCH_FLEET", "1") != "0":
+        try:
+            from deeplearning4j_tpu.utils.benchmarks import serve_fleet
+            for row in serve_fleet():
+                print(_dumps(row))
+        except Exception as e:  # never let the side row break the headline
+            print(_dumps({"metric": "serve_fleet", "value": None,
+                          "unit": "req/s",
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+
     # side metrics run even on regressed runs — they're the diagnosis data
     if os.environ.get("DL4J_TPU_BENCH_SIDE"):
         side_metrics()
@@ -637,6 +661,10 @@ def side_metrics(path: str = "BENCH_SIDE.json"):
         # ring/paged-cold/paged-shared arms; the slot-capacity and
         # cache-bytes columns ride decode_tokens_per_sec above
         B.ttft_ms,
+        # serving fleet (ISSUE 20): replicated engines behind one
+        # admission tier — req/s + decode tokens/s scaling at 1/2/4
+        # device-paced replicas, kill-one-replica recovery_ms chaos row
+        B.serve_fleet,
     ]
     side = []
     for fn in captures:

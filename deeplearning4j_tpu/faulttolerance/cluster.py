@@ -45,7 +45,7 @@ from .atomic import atomic_write_json
 from ..observability.registry import default_registry
 
 __all__ = ["FileLeaseStore", "ClusterMember", "ClusterCoordinator",
-           "ClusterView", "shard_owner", "live_ranks"]
+           "ClusterView", "LeaseView", "shard_owner", "live_ranks"]
 
 _LEASE_DIR = "membership"
 _VIEW_FILE = "view.json"
@@ -61,6 +61,34 @@ def shard_owner(index: int, world_size: int) -> int:
     return index % world_size
 
 
+class LeaseView:
+    """Read-only liveness over a :class:`FileLeaseStore`: who holds an
+    unexpired lease *right now*, with payloads.  Reusable by any tier
+    that needs membership without the coordinator's rank/generation
+    machinery — the serving fleet's replica health rides this (a
+    replica whose heartbeat stops simply falls out of :meth:`live` when
+    its lease deadline passes; no eviction protocol needed)."""
+
+    def __init__(self, store: "FileLeaseStore"):
+        self.store = store
+
+    def live(self, now: Optional[float] = None) -> Dict[int, Dict]:
+        """Unexpired leases keyed by worker id (payloads included)."""
+        now = time.time() if now is None else now
+        return {wid: lease
+                for wid, lease in self.store.all_leases().items()
+                if float(lease["expires_at"]) >= now}
+
+    def live_ids(self, now: Optional[float] = None) -> set:
+        return set(self.live(now))
+
+    def is_live(self, worker_id: int,
+                now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        lease = self.store.read(int(worker_id))
+        return lease is not None and float(lease["expires_at"]) >= now
+
+
 def live_ranks(store: "FileLeaseStore", view: "ClusterView",
                now: Optional[float] = None) -> set:
     """Dense view-ranks of members whose lease is currently unexpired —
@@ -69,13 +97,11 @@ def live_ranks(store: "FileLeaseStore", view: "ClusterView",
     primary on a non-coordinator host can still tell "that writer's
     marker is missing because the writer is dead" from "still writing"
     and abort the round instead of waiting out the full timeout."""
-    now = time.time() if now is None else now
     out = set()
-    for wid, lease in store.all_leases().items():
-        if float(lease["expires_at"]) >= now:
-            rank = view.rank_of(wid)
-            if rank is not None:
-                out.add(rank)
+    for wid in LeaseView(store).live_ids(now):
+        rank = view.rank_of(wid)
+        if rank is not None:
+            out.add(rank)
     return out
 
 

@@ -1,4 +1,4 @@
-"""Autoregressive generation subsystem: ring KV cache, two-program
+"""Autoregressive generation subsystem: paged KV cache, two-program
 prefill/decode, iteration-level continuous batching.
 
 The training side runs a transformer LM at full tilt; this package is the
@@ -12,16 +12,13 @@ generation.  Design pillars (the TensorFlow-paper bar, PAPERS.md
   ``[n_blocks, heads, block_size, head_dim]`` per attention layer with
   per-slot block tables as host DATA — decode memory scales with tokens
   actually written, and content-hashed prompt-prefix blocks are shared
-  read-only across slots (copy-on-write on append).  The dense
-  ``SlotRing`` (``[max_slots, ..., max_seq, ...]`` per layer) remains
-  selectable via ``DL4J_TPU_KV_PAGED=0`` for one release (deprecated).
+  read-only across slots (copy-on-write on append).
 - **Two steady-state programs** (:mod:`.programs`): bucketed *prefill*
   (one request, suffix padded onto the ``data/shapes`` ladder, KV
   written through the slot's block table) and a fixed-shape one-token
   *decode* step over the full slot batch with per-slot tables/positions
-  — ``"paged_prefill"``/``"paged_decode"`` (and the dense
-  ``"prefill"``/``"decode"``) kinds in the process-global trace cache,
-  zero recompiles after warmup.
+  — the ``"paged_prefill"``/``"paged_decode"`` kinds in the
+  process-global trace cache, zero recompiles after warmup.
 - **Traced sampling** (:mod:`.sampling`): greedy / temperature / top-k /
   top-p as data inside the programs, with per-slot RNG streams keyed by
   (request seed, token index) — a request's tokens are bit-identical

@@ -1,15 +1,10 @@
-"""KV caches for the generation engine: the dense slot ring and the
-paged block pool, plus their host-side allocators.
+"""Paged KV cache for the generation engine, plus its host-side
+allocator.  (The original dense ``SlotRing`` — one ``[max_slots, heads,
+max_seq, head_dim]`` carry per layer, every slot priced at worst-case
+sequence length — was removed after its deprecation release; the paged
+pool is the only cache organization.)
 
-Two cache organizations share one slot-allocator/occupancy-trail base:
-
-**SlotRing** (dense, the original): ONE carry pytree per carried layer —
-attention layers hold ``k``/``v`` ``[max_slots, heads, max_seq,
-head_dim]`` plus validity/position vectors — so every slot is priced at
-worst-case sequence length.  Kept selectable for one release behind
-``DL4J_TPU_KV_PAGED=0`` (deprecated: the paged cache is the default).
-
-**PagedKV** (the default): one preallocated block pool
+**PagedKV**: one preallocated block pool
 ``[n_blocks, heads, block_size, head_dim]`` per attention-carried layer,
 with per-slot **block tables** (host int32 ``[max_slots,
 max_blocks_per_slot]`` mirrors passed to the programs as DATA, never
@@ -35,11 +30,10 @@ stay resident as reuse candidates and are evicted LRU-first under
 allocation pressure.  The registry is invalidated wholesale on a weight
 version change (old-version K/V must never satisfy a new-version match).
 
-Host side both share: a free-list allocator that always hands out the
-LOWEST free slot/block index (deterministic allocation order makes
-engine tests and forensic dumps reproducible) and an **occupancy
-trail** — a bounded ring of install/vacate/migrate events, which the
-paged cache extends with block_alloc/block_release/cow/shared_hit
+Host side: a free-list allocator that always hands out the LOWEST free
+slot/block index (deterministic allocation order makes engine tests and
+forensic dumps reproducible) and an **occupancy trail** — a bounded
+ring of install/vacate/migrate/block_alloc/block_release/cow/shared_hit
 events — exactly what a decode-step exception dump needs to reconstruct
 "who was in which slot with how much context" at the moment of death.
 """
@@ -58,12 +52,12 @@ import numpy as np
 from ..observability.clock import monotonic_s, wall_s
 from .programs import _fresh_carry, carried_layers, paged_layout
 
-__all__ = ["SlotRing", "PagedKV"]
+__all__ = ["PagedKV"]
 
 
 class _SlotAllocatorBase:
-    """Lowest-free-slot allocator + occupancy trail shared by both cache
-    organizations."""
+    """Lowest-free-slot allocator + occupancy trail for the paged
+    cache."""
 
     def __init__(self, max_slots: int, trail_len: int = 256):
         if max_slots < 1:
@@ -173,34 +167,6 @@ class _SlotAllocatorBase:
         """Total device bytes held by the cache pytree."""
         return sum(int(getattr(x, "nbytes", 0))
                    for x in jax.tree_util.tree_leaves(self.caches))
-
-
-class SlotRing(_SlotAllocatorBase):
-    """Dense device cache pytree + free-slot bookkeeping for one engine.
-
-    Every slot owns ``[heads, max_seq, head_dim]`` K/V rows regardless of
-    how many tokens it actually holds.  Nothing is ever reallocated or
-    zeroed wholesale: a slot is *reused* by overwriting its position,
-    validity row, and (lazily, as decoding writes) its KV — stale bytes
-    from the previous occupant are mask-dead by construction
-    (``programs.install_carry``).
-    """
-
-    def __init__(self, conf, max_slots: int, max_seq: int,
-                 trail_len: int = 256):
-        super().__init__(max_slots, trail_len)
-        if max_seq < 2:
-            raise ValueError(f"max_seq must be >= 2, got {max_seq}")
-        self.max_seq = int(max_seq)
-        self.caches: Dict[str, Any] = {}
-        for name, lc in carried_layers(conf).items():
-            carry = _fresh_carry(lc, self.max_slots, self.max_seq)
-            if isinstance(carry, dict) and "pos" in carry and \
-                    getattr(carry["pos"], "ndim", 0) == 0:
-                # vectorize the stream position: one entry per slot
-                carry = dict(carry, pos=jnp.zeros((self.max_slots,),
-                                                  jnp.int32))
-            self.caches[name] = carry
 
 
 class PagedKV(_SlotAllocatorBase):

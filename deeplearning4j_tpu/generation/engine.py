@@ -38,25 +38,24 @@ decode inter-token p99 inside its SLO.
 from __future__ import annotations
 
 import logging
-import os
 import queue
 import threading
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 
-from ..data.shapes import prefill_buckets, suffix_prefill_buckets
+from ..data.shapes import suffix_prefill_buckets
 from ..observability import clock
 from ..observability.health import get_health_monitor
 from ..observability.quantiles import LatencyWindow
 from ..observability.recorder import get_flight_recorder
 from ..observability.registry import default_registry
 from ..parallel.inference import InvalidInputError
-from .cache import PagedKV, SlotRing
+from .cache import PagedKV
 
 __all__ = ["GenerationConfig", "GenerationEngine", "GenerationResult",
            "StaticSlotSource"]
@@ -94,10 +93,6 @@ class GenerationConfig:
     block_size: int = 16
     n_blocks: Optional[int] = None
     prefix_sharing: bool = True
-    # None resolves from DL4J_TPU_KV_PAGED (default on); paged=False /
-    # DL4J_TPU_KV_PAGED=0 keeps the dense SlotRing selectable for one
-    # release (deprecated — it prices every slot at max_seq)
-    paged: Optional[bool] = None
 
 
 @dataclass
@@ -150,6 +145,19 @@ class _GenRequest:
         """Prompt + everything generated so far — what a weight migration
         re-prefills."""
         return self.prompt + self.out_tokens
+
+    def export_state(self) -> dict:
+        """Host-only session snapshot a peer engine can
+        :meth:`GenerationEngine.import_session`: because sampling keys
+        are ``(seed, token_index)``, history + sampling knobs ARE the
+        complete decode state — no device KV ever crosses replicas."""
+        return {"request_id": self.id, "prompt": list(self.prompt),
+                "tokens": list(self.out_tokens),
+                "versions": list(self.versions),
+                "max_new_tokens": self.max_new_tokens,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed,
+                "eos_id": self.eos_id}
 
     def push_event(self, ev: dict) -> None:
         try:
@@ -210,26 +218,14 @@ class GenerationEngine:
         self._slot_source = slot_source
         self._registry = registry
         self._health = health
-        self._paged = (self.config.paged if self.config.paged is not None
-                       else os.environ.get("DL4J_TPU_KV_PAGED", "1")
-                       != "0")
-        if self._paged:
-            # suffix ladder: shared-prefix admissions prefill only their
-            # unshared tail, so short suffixes need small buckets (floor
-            # min(8, block_size)); the top bucket stays max_seq so
-            # migration re-prefill of a full history always fits
-            self.buckets = suffix_prefill_buckets(
-                self.config.max_seq, self.config.block_size,
-                self.config.prefill_ladder)
-        else:
-            log.warning(
-                "dense SlotRing KV selected (DL4J_TPU_KV_PAGED=0 / "
-                "paged=False): deprecated — every slot is priced at "
-                "max_seq; the paged block-pool cache becomes the only "
-                "organization next release")
-            self.buckets = prefill_buckets(self.config.max_seq,
-                                           self.config.prefill_ladder)
-        self.ring: Optional[Union[SlotRing, PagedKV]] = None
+        # suffix ladder: shared-prefix admissions prefill only their
+        # unshared tail, so short suffixes need small buckets (floor
+        # min(8, block_size)); the top bucket stays max_seq so
+        # migration re-prefill of a full history always fits
+        self.buckets = suffix_prefill_buckets(
+            self.config.max_seq, self.config.block_size,
+            self.config.prefill_ladder)
+        self.ring: Optional[PagedKV] = None
         self._ring_sig: Optional[str] = None
         self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=self.config.queue_limit)
@@ -268,6 +264,13 @@ class GenerationEngine:
             else get_health_monitor()
 
     @property
+    def queue_depth(self) -> int:
+        """Join-queue depth — the fleet router's cheap decode-load
+        signal (``status()`` is the full payload; routing needs one
+        integer)."""
+        return self._pending.qsize()
+
+    @property
     def steady_recompiles(self) -> int:
         with self._stats_lock:
             return self._steady_recompiles
@@ -296,12 +299,12 @@ class GenerationEngine:
                         "XLA traces observed after warmup — should stay 0 "
                         "(a novel shape escaped the bucket ladder)").inc()
 
-    def _shed(self, reason: str) -> None:
+    def _shed(self, reason: str, tenant: str = "-") -> None:
         reg = self._reg()
         if reg.enabled:
             reg.counter("serving_shed_total",
                         "Requests shed by admission control",
-                        ("reason",)).labels(reason).inc()
+                        ("reason", "tenant")).labels(reason, tenant).inc()
         mon = self._mon()
         if mon is not None:
             mon.observe_request(shed=True)
@@ -339,13 +342,11 @@ class GenerationEngine:
         return self.ring
 
     def _new_ring(self, conf):
-        if self._paged:
-            return PagedKV(conf, self.config.max_slots,
-                           self.config.max_seq,
-                           block_size=self.config.block_size,
-                           n_blocks=self.config.n_blocks,
-                           prefix_sharing=self.config.prefix_sharing)
-        return SlotRing(conf, self.config.max_slots, self.config.max_seq)
+        return PagedKV(conf, self.config.max_slots,
+                       self.config.max_seq,
+                       block_size=self.config.block_size,
+                       n_blocks=self.config.n_blocks,
+                       prefix_sharing=self.config.prefix_sharing)
 
     # -------------------------------------------------------------- warmup
     def warmup(self) -> int:
@@ -370,46 +371,28 @@ class GenerationEngine:
                 else ring.caches
             warmed = 0
             S = self.config.max_slots
-            if self._paged:
-                # warm every suffix bucket against an all-trash table
-                # (writes land in block 0, mask-dead) + the one decode
-                pf = model._get_jitted("paged_prefill")
-                nb = ring.blocks_per_slot
-                trow = np.zeros((nb,), np.int32)
-                for b in self.buckets:
-                    toks = np.zeros((1, b), np.int32)
-                    mask = np.ones((1, b), np.float32)
-                    _, caches = pf(
-                        model.params, model.state, toks, mask, caches,
-                        trow, np.int32(0), np.int32(0), np.int32(b),
-                        np.int32(0), np.int32(0),
-                        np.zeros((2,), np.uint32), np.float32(0.0),
-                        np.int32(0), np.float32(1.0))
-                    warmed += 1
-                dec = model._get_jitted("paged_decode")
-                out, caches = dec(
-                    model.params, model.state, np.zeros((S,), np.int32),
-                    caches, np.zeros((S, nb), np.int32),
-                    np.zeros((S,), np.int32), np.zeros((S, 2), np.uint32),
-                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-                    np.ones((S,), np.float32))
-            else:
-                pf = model._get_jitted("prefill")
-                for b in self.buckets:
-                    toks = np.zeros((1, b), np.int32)
-                    mask = np.ones((1, b), np.float32)
-                    _, caches = pf(
-                        model.params, model.state, toks, mask, caches,
-                        np.int32(0), np.int32(b),
-                        np.zeros((2,), np.uint32), np.float32(0.0),
-                        np.int32(0), np.float32(1.0))
-                    warmed += 1
-                dec = model._get_jitted("decode")
-                out, caches = dec(
-                    model.params, model.state, np.zeros((S,), np.int32),
-                    caches, np.zeros((S, 2), np.uint32),
-                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-                    np.ones((S,), np.float32))
+            # warm every suffix bucket against an all-trash table
+            # (writes land in block 0, mask-dead) + the one decode
+            pf = model._get_jitted("paged_prefill")
+            nb = ring.blocks_per_slot
+            trow = np.zeros((nb,), np.int32)
+            for b in self.buckets:
+                toks = np.zeros((1, b), np.int32)
+                mask = np.ones((1, b), np.float32)
+                _, caches = pf(
+                    model.params, model.state, toks, mask, caches,
+                    trow, np.int32(0), np.int32(0), np.int32(b),
+                    np.int32(0), np.int32(0),
+                    np.zeros((2,), np.uint32), np.float32(0.0),
+                    np.int32(0), np.float32(1.0))
+                warmed += 1
+            dec = model._get_jitted("paged_decode")
+            out, caches = dec(
+                model.params, model.state, np.zeros((S,), np.int32),
+                caches, np.zeros((S, nb), np.int32),
+                np.zeros((S,), np.int32), np.zeros((S, 2), np.uint32),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                np.ones((S,), np.float32))
             np.asarray(out)      # block until the compile fully lands
             warmed += 1
             if not live:
@@ -479,6 +462,89 @@ class GenerationEngine:
                     retry_after_s=self.config.retry_after_s)
         self._wake.set()
         return req
+
+    def import_session(self, state: dict) -> _GenRequest:
+        """Re-home a session exported from (or mirrored off) another
+        engine: builds a request with its generated-so-far tokens
+        pre-seeded and enqueues it for ordinary admission — which
+        re-prefills the FULL history (the hot-swap migration path,
+        cross-replica) and continues the ``(seed, token_index)`` RNG
+        schedule at the next index, so the continued stream is
+        bit-identical to the one the original replica would have
+        produced."""
+        from ..serving.engine import ShedError
+        try:
+            prompt = [int(t) for t in state["prompt"]]
+            tokens = [int(t) for t in state.get("tokens", ())]
+            mnt = int(state["max_new_tokens"])
+            seed = int(state["seed"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise InvalidInputError(f"malformed session state: {e}")
+        if not prompt:
+            raise InvalidInputError("empty prompt in imported session")
+        if len(tokens) >= mnt:
+            raise InvalidInputError(
+                f"imported session already finished "
+                f"({len(tokens)}/{mnt} tokens)")
+        if len(prompt) + mnt > self.config.max_seq:
+            raise InvalidInputError(
+                f"imported session needs {len(prompt) + mnt} cache rows, "
+                f"exceeds max_seq={self.config.max_seq}")
+        with self._submit_lock:
+            if self._shutdown.is_set():
+                raise RuntimeError("GenerationEngine shut down")
+            self._req_counter += 1
+            rid = state.get("request_id") or f"gen-{self._req_counter}"
+            req = _GenRequest(rid, prompt, mnt,
+                              state.get("temperature", 0.0),
+                              state.get("top_k", 0),
+                              state.get("top_p", 1.0), seed,
+                              state.get("eos_id"))
+            req.out_tokens = tokens
+            vers = [int(v) for v in state.get("versions", ())]
+            # one version per already-emitted token: a mirror that lost
+            # them pads with 0 ("unknown origin version"), never guesses
+            req.versions = (vers + [0] * len(tokens))[:len(tokens)]
+            try:
+                self._pending.put_nowait(req)
+            except queue.Full:
+                self._shed("no_slots")
+                raise ShedError(
+                    f"no free generation slots for imported session "
+                    f"(queue at {self.config.queue_limit})", status=429,
+                    retry_after_s=self.config.retry_after_s)
+        self._wake.set()
+        return req
+
+    def export_sessions(self) -> List[dict]:
+        """Detach every live session (active slots AND the join queue)
+        as importable host-only state — the drain/eject half of
+        cross-replica migration.  Local handles fail with a marker
+        error (no client may silently hang on a drained replica); the
+        caller re-homes the states via a peer's
+        :meth:`import_session`."""
+        states: List[dict] = []
+        err = RuntimeError("session exported for cross-replica migration")
+        with self._step_lock:
+            ring = self.ring
+            if ring is not None:
+                for slot, req in sorted(ring.occupants().items()):
+                    ring.release(slot)
+                    ring.note("vacate", slot, req.id, reason="exported")
+                    states.append(req.export_state())
+                    self._fail(req, err)
+            while True:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req.cancelled.is_set():
+                    self._finish(req, None, "cancelled")
+                    continue
+                states.append(req.export_state())
+                self._fail(req, err)
+        self._set_active_gauge()
+        return states
 
     def generate(self, tokens, timeout: Optional[float] = 60.0,
                  **kw) -> GenerationResult:
@@ -564,8 +630,8 @@ class GenerationEngine:
             "tick_failures": tick_failures,
             "steady_recompiles": steady,
             "warm": self._warm,
-            "kv_paged": self._paged,
-            "kv": (ring.stats() if isinstance(ring, PagedKV) else None),
+            "kv_paged": True,
+            "kv": (None if ring is None else ring.stats()),
             "cache_bytes": None if ring is None else ring.cache_bytes,
         }
 
@@ -619,7 +685,7 @@ class GenerationEngine:
                     # nothing to migrate: adopt the version; admission
                     # resolves/validates the model per request, so a
                     # bad slot fails requests instead of wedging ticks
-                    if isinstance(self.ring, PagedKV):
+                    if self.ring is not None:
                         # registered prefix blocks hold OLD-version K/V:
                         # a new-version request must never adopt them
                         self.ring.invalidate_shared()
@@ -662,10 +728,9 @@ class GenerationEngine:
             # where a stack-validation failure is attributed to the
             # request it affects instead of wedging the whole tick
             return False
-        if isinstance(old_ring, PagedKV):
-            # the prefix registry holds prev-version K/V — flush it
-            # before any re-prefill can publish/adopt under the new one
-            old_ring.invalidate_shared()
+        # the prefix registry holds prev-version K/V — flush it before
+        # any re-prefill can publish/adopt under the new one
+        old_ring.invalidate_shared()
         ring = self._ensure_ring(model)
         rec = get_flight_recorder()
         for slot, req in sorted(occupants.items()):
@@ -676,7 +741,7 @@ class GenerationEngine:
                 old_ring.release(slot)
                 slot = ring.acquire(req)
                 req.slot = slot
-            elif isinstance(ring, PagedKV):
+            else:
                 # same pool, new weights: drop the slot's stale blocks
                 # (occupant stays) — the re-prefill below allocates and
                 # writes fresh ones through the ordinary paged path
@@ -742,7 +807,10 @@ class GenerationEngine:
                 self._requeue_or_fail(req)
                 break
             try:
-                tok = self._prefill_into(model, req, slot, req.prompt)
+                # history(), not prompt: a fresh request's history IS its
+                # prompt, while an imported session re-prefills its
+                # already-generated tokens too and continues mid-stream
+                tok = self._prefill_into(model, req, slot, req.history())
             except Exception as e:
                 ring.release(slot)
                 ring.note("prefill_error", slot, req.id, error=str(e))
@@ -752,7 +820,7 @@ class GenerationEngine:
                     break      # ring dropped: re-admit onto a fresh one
                 continue
             req.slot = slot
-            ring.note("install", slot, req.id, pos=len(req.prompt),
+            ring.note("install", slot, req.id, pos=len(req.history()),
                       version=slot_obj.version)
             self._emit(req, tok, slot_obj.version, slot)
             worked = True
@@ -767,44 +835,6 @@ class GenerationEngine:
 
     def _prefill_into(self, model, req: _GenRequest, slot: int,
                       history: List[int]) -> int:
-        """One bucketed prefill program call: pad ``history`` onto the
-        prompt ladder, run it into ``slot``, return the first sampled
-        token.  The single ``int()`` materialization is the point of the
-        call — the token must reach the host to stream/EOS-check."""
-        if self._paged:
-            return self._prefill_paged(model, req, slot, history)
-        ring = self.ring
-        L = len(history)
-        t_form = clock.monotonic_s()
-        bucket = next(b for b in self.buckets if L <= b)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :L] = history
-        mask = np.zeros((1, bucket), np.float32)
-        mask[0, :L] = 1.0
-        key = np.array([req.seed, len(req.out_tokens)], np.uint32)
-        fn = model._get_jitted("prefill")
-        t0 = clock.monotonic_s()
-        tok_dev, ring.caches = fn(
-            model.params, model.state, toks, mask, ring.caches,
-            np.int32(slot), np.int32(L), key, np.float32(req.temperature),
-            np.int32(req.top_k), np.float32(req.top_p))
-        self._note_trace(fn)
-        tok = int(tok_dev)
-        dt = clock.monotonic_s() - t0
-        reg = self._reg()
-        if reg.enabled:
-            reg.histogram("generation_prefill_seconds",
-                          "Prefill program wall time per request",
-                          buckets=_STEP_BUCKETS).observe(dt)
-        # stepprof slices: prompt padding/ladder formation vs the fenced
-        # prefill execute (the int() above is the per-call sync)
-        from ..observability.profiler import record_slices
-        record_slices("prefill", batch_form_s=round(t0 - t_form, 7),
-                      execute_s=round(dt, 7), bucket=bucket)
-        return tok
-
-    def _prefill_paged(self, model, req: _GenRequest, slot: int,
-                       history: List[int]) -> int:
         """Paged admission: match the longest registered prompt prefix,
         adopt its blocks by reference (COW for a partial tail), allocate
         private blocks for the rest, and run ONE suffix-bucketed
@@ -903,29 +933,28 @@ class GenerationEngine:
         if not occupants:
             self._set_active_gauge()
             return False
-        if self._paged:
-            # grow each slot's table across its next block boundary (an
-            # aggregated host-side allocation, no device work) and
-            # enforce the COW invariant before any write can alias a
-            # shared block; a slot the pool cannot grow fails alone
-            starved = [(slot, req) for slot, req in
-                       sorted(occupants.items())
-                       if not ring.ensure_blocks(slot, req.id,
-                                                 int(ring.pos[slot]) + 1)]
-            for slot, req in starved:
-                del occupants[slot]
-                pos = int(ring.pos[slot])
-                ring.release(slot)
-                ring.note("vacate", slot, req.id,
-                          reason="blocks_exhausted")
-                self._fail(req, RuntimeError(
-                    f"KV block pool exhausted mid-decode for {req.id} at "
-                    f"pos {pos}: raise n_blocks (pool={ring.n_blocks})"))
-            if not occupants:
-                self._set_active_gauge()
-                return bool(starved)
-            for slot in occupants:
-                ring.check_writable(slot)
+        # grow each slot's table across its next block boundary (an
+        # aggregated host-side allocation, no device work) and
+        # enforce the COW invariant before any write can alias a
+        # shared block; a slot the pool cannot grow fails alone
+        starved = [(slot, req) for slot, req in
+                   sorted(occupants.items())
+                   if not ring.ensure_blocks(slot, req.id,
+                                             int(ring.pos[slot]) + 1)]
+        for slot, req in starved:
+            del occupants[slot]
+            pos = int(ring.pos[slot])
+            ring.release(slot)
+            ring.note("vacate", slot, req.id,
+                      reason="blocks_exhausted")
+            self._fail(req, RuntimeError(
+                f"KV block pool exhausted mid-decode for {req.id} at "
+                f"pos {pos}: raise n_blocks (pool={ring.n_blocks})"))
+        if not occupants:
+            self._set_active_gauge()
+            return bool(starved)
+        for slot in occupants:
+            ring.check_writable(slot)
         model = self._model_of(slot_obj)
         S = self.config.max_slots
         t_form = clock.monotonic_s()
@@ -942,17 +971,11 @@ class GenerationEngine:
             top_k[slot] = req.top_k
             top_p[slot] = req.top_p
         t0 = clock.monotonic_s()
-        if self._paged:
-            fn = model._get_jitted("paged_decode")
-            out_dev, ring.caches = fn(model.params, model.state, toks,
-                                      ring.caches, ring.tables.copy(),
-                                      ring.pos.copy(), keys, temp, top_k,
-                                      top_p)
-        else:
-            fn = model._get_jitted("decode")
-            out_dev, ring.caches = fn(model.params, model.state, toks,
-                                      ring.caches, keys, temp, top_k,
-                                      top_p)
+        fn = model._get_jitted("paged_decode")
+        out_dev, ring.caches = fn(model.params, model.state, toks,
+                                  ring.caches, ring.tables.copy(),
+                                  ring.pos.copy(), keys, temp, top_k,
+                                  top_p)
         self._note_trace(fn)
         # ONE materialization per STEP for the whole slot batch — the
         # per-token host syncs JX023 exists to kill live here, batched
@@ -976,12 +999,11 @@ class GenerationEngine:
         from ..observability.profiler import record_slices
         record_slices("decode", batch_form_s=round(t0 - t_form, 7),
                       execute_s=round(dt, 7), active=len(occupants))
-        if self._paged:
-            # the step wrote one token per active slot — advance the
-            # host position mirrors BEFORE emission (a finishing request
-            # releases its slot inside _emit, which resets its mirror)
-            for slot in occupants:
-                ring.pos[slot] += 1
+        # the step wrote one token per active slot — advance the host
+        # position mirrors BEFORE emission (a finishing request releases
+        # its slot inside _emit, which resets its mirror)
+        for slot in occupants:
+            ring.pos[slot] += 1
         for slot, req in sorted(occupants.items()):
             self._emit(req, int(out[slot]), slot_obj.version, slot)
         self._set_active_gauge()
@@ -1104,10 +1126,9 @@ class GenerationEngine:
             reg.gauge("generation_active_slots",
                       "Generation slots currently occupied by live "
                       "sequences").set(self.ring.active_slots)
-            if isinstance(self.ring, PagedKV):
-                reg.gauge("generation_blocks_free",
-                          "Free physical KV blocks in the paged pool"
-                          ).set(self.ring.blocks_free)
+            reg.gauge("generation_blocks_free",
+                      "Free physical KV blocks in the paged pool"
+                      ).set(self.ring.blocks_free)
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self) -> None:

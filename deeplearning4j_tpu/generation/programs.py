@@ -1,31 +1,32 @@
-"""The two generation programs: bucketed prefill + fixed-shape decode.
+"""The two generation programs: bucketed paged prefill + fixed-shape
+paged decode.
 
 Both are built by ``nn/multilayer._build_stack_fn`` delegation (jit kinds
-``"prefill"`` and ``"decode"`` in the process-global trace cache), so they
-ride the same infrastructure as every other compiled entry point:
-value-keyed topology signatures (equal-topology hot-swaps reuse the
-compiled programs — a weight swap costs zero compiles), ``InstrumentedJit``
-trace counters (``training_compile_total{fn=prefill|decode}``), and
+``"paged_prefill"`` and ``"paged_decode"`` in the process-global trace
+cache), so they ride the same infrastructure as every other compiled
+entry point: value-keyed topology signatures (equal-topology hot-swaps
+reuse the compiled programs — a weight swap costs zero compiles),
+``InstrumentedJit`` trace counters
+(``training_compile_total{fn=paged_prefill|paged_decode}``), and
 instance ``_jit_cache`` lifetime.
 
-**Prefill** (one request per call, prompt padded onto the
-``data/shapes.prefill_buckets`` ladder): runs the full layer stack with
-fresh length-T carries (``_stack_forward``'s carry walk — the same code
-path tBPTT and ``rnn_time_step`` use), samples the first token from the
-last *real* prompt position, and installs the carries into the caller's
-slot-batched cache at row ``slot`` with the slot's position set to the
-TRUE prompt length (padded tail entries stay mask-invalid, so the next
-decode write lands exactly where the prompt ends).  One compile per
-prompt bucket, all taken at warmup.
+**Paged prefill** (one request per call, unshared prompt suffix padded
+onto the ``data/shapes.suffix_prefill_buckets`` ladder): runs the full
+layer stack through the block pool with the slot's table row (shared
+prefix blocks adopted by reference + private suffix blocks), samples
+the first token from the last *real* prompt position, and row-installs
+any dense RNN carries at ``slot`` (padded tail entries stay
+mask-invalid, so the next decode write lands exactly where the prompt
+ends).  One compile per suffix bucket, all taken at warmup.
 
-**Decode** (fixed shape, the whole slot batch every step): one token per
-slot through the stack with the slot-batched carries (vector per-slot
-positions — see ``MultiHeadAttention.attend_cached``), traced sampling,
-returns next tokens + updated caches.  ONE compile, ever: slot count,
-cache capacity and every sampling knob are shapes or data.  Inactive
-slots compute garbage rows that touch nothing (row-independent stacks
-only — the engine gates on that), which is what buys mid-flight
-joins/vacates without a single recompile.
+**Paged decode** (fixed shape, the whole slot batch every step): one
+token per slot through the stack with the block tables and per-slot
+positions passed as DATA (see ``MultiHeadAttention.attend_cached``),
+traced sampling, returns next tokens + updated caches.  ONE compile,
+ever: slot count, pool capacity and every sampling knob are shapes or
+data.  Inactive slots compute garbage rows that touch nothing
+(row-independent stacks only — the engine gates on that), which is what
+buys mid-flight joins/vacates without a single recompile.
 
 Cache donation: the slot cache is the dominant HBM tenant; both programs
 donate it so XLA updates in place (CPU skips donation — unimplemented
@@ -161,44 +162,6 @@ def build_generation_fn(conf, kind: str):
     programs live in the process-global trace cache and serve every
     equal-topology slot (hot-swapped checkpoints included)."""
     from ..nn.multilayer import _stack_forward
-
-    if kind == "prefill":
-        def prefill(params, state, tokens, mask, caches, slot, length,
-                    key, temp, top_k, top_p):
-            """tokens [1, T] ids (T = prompt bucket), mask [1, T] validity,
-            slot/length scalars, key [2] uint32, sampling knobs scalars.
-            Returns (first sampled token (), new caches)."""
-            T = tokens.shape[1]
-            carries = fresh_carries(conf, 1, T)
-            probs, _ = _stack_forward(conf, params, state, tokens,
-                                      train=False, key=None, mask=mask,
-                                      carries=carries)
-            # distribution for the token AFTER the last real prompt token
-            last = jnp.take(probs[0], length - 1, axis=0)        # [V]
-            logp = _head_logp(conf, last)
-            tok = sample_tokens(logp[None], key[None], temp[None],
-                                top_k[None], top_p[None])[0]
-            new_caches = {name: install_carry(caches[name], carries[name],
-                                              slot, length)
-                          for name in caches}
-            return tok, new_caches
-        return prefill, (() if jax.default_backend() == "cpu" else (4,))
-
-    if kind == "decode":
-        def decode(params, state, tokens, caches, keys, temp, top_k,
-                   top_p):
-            """tokens [S] (each slot's newest token), caches the
-            slot-batched carry pytree (vector ``pos``), keys [S, 2],
-            sampling knobs [S].  Returns (next tokens [S], new caches)."""
-            carries = {name: (dict(c) if isinstance(c, dict) else c)
-                       for name, c in caches.items()}
-            probs, _ = _stack_forward(conf, params, state, tokens[:, None],
-                                      train=False, key=None,
-                                      carries=carries)
-            logp = _head_logp(conf, probs[:, -1, :])             # [S, V]
-            toks = sample_tokens(logp, keys, temp, top_k, top_p)
-            return toks, carries
-        return decode, (() if jax.default_backend() == "cpu" else (3,))
 
     if kind == "paged_prefill":
         layout = paged_layout(conf)

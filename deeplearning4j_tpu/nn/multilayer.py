@@ -403,11 +403,11 @@ def _build_stack_fn(conf, tx, kind: str):
         # tBPTT additionally donates the recurrent carries (argnum 8):
         # each chunk's carries are consumed by exactly one step
         return _build_train_step(conf, tx, True), (0, 1, 2, 3, 8)
-    if kind in ("prefill", "decode", "paged_prefill", "paged_decode"):
-        # autoregressive generation programs (bucketed prompt prefill +
-        # fixed-shape slot-batch decode, dense-ring and paged-block-pool
-        # variants): built in generation/programs.py, registered here so
-        # they ride the same process-global trace cache, instance
+    if kind in ("paged_prefill", "paged_decode"):
+        # autoregressive generation programs (bucketed prompt-suffix
+        # prefill + fixed-shape slot-batch decode through the paged
+        # block pool): built in generation/programs.py, registered here
+        # so they ride the same process-global trace cache, instance
         # _jit_cache lifetime, and compile counters as every other entry
         # point
         from ..generation.programs import build_generation_fn

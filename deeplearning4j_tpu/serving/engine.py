@@ -141,12 +141,12 @@ class AdmissionController:
         return self._health if self._health is not None \
             else get_health_monitor()
 
-    def _count_shed(self, reason: str) -> None:
+    def _count_shed(self, reason: str, tenant: str = "-") -> None:
         reg = self._reg()
         if reg.enabled:
             reg.counter("serving_shed_total",
                         "Requests shed by admission control",
-                        ("reason",)).labels(reason).inc()
+                        ("reason", "tenant")).labels(reason, tenant).inc()
         mon = self._mon()
         if mon is not None:
             mon.observe_request(shed=True)
@@ -166,13 +166,15 @@ class AdmissionController:
         return ShedError(detail, status=503,
                          retry_after_s=self.retry_after_s)
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, priority: str = "interactive") -> None:
         self._window.observe(seconds)
         reg = self._reg()
         if reg.enabled:
             reg.histogram("serving_request_seconds",
                           "Engine request latency, enqueue to result",
-                          buckets=_LATENCY_BUCKETS).observe(seconds)
+                          ("priority",),
+                          buckets=_LATENCY_BUCKETS).labels(
+                              priority).observe(seconds)
         mon = self._mon()
         if mon is not None:
             mon.observe_request(seconds=seconds)
@@ -430,6 +432,13 @@ class ServingEngine:
                         "(a novel shape escaped the bucket ladder)").inc()
 
     # ---------------------------------------------------------- model slot
+    @property
+    def queue_depth(self) -> int:
+        """Live request-queue depth — the cheap load signal the fleet
+        router's least-loaded pick reads (stats() walks readiness and
+        SLO windows; a routing decision only needs this integer)."""
+        return self._queue.qsize()
+
     @property
     def slot(self) -> Optional[_ModelSlot]:
         with self._slot_lock:

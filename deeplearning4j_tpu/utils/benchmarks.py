@@ -695,14 +695,41 @@ def decode_tokens_per_sec(model=None, max_slots: int = 8,
     return rows
 
 
+def _dense_cache_bytes(model, max_slots: int, max_seq: int) -> int:
+    """Byte cost of the REMOVED dense slot ring at this geometry — the
+    baseline the capacity row is measured against, computed analytically
+    (``jax.eval_shape`` of exactly the per-layer carries the ring used
+    to allocate: K/V ``[max_slots, heads, max_seq, head_dim]`` plus
+    validity/position rows), so the comparison survives the ring's
+    deletion without a dense engine to measure."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..generation.programs import _fresh_carry, carried_layers
+
+    total = 0
+    for lc in carried_layers(model.conf).values():
+        shapes = jax.eval_shape(
+            lambda lc=lc: _fresh_carry(lc, max_slots, max_seq))
+        if isinstance(shapes, dict) and "pos" in shapes and \
+                getattr(shapes["pos"], "ndim", 0) == 0:
+            # the ring vectorized scalar stream positions per slot
+            shapes = dict(shapes, pos=jax.ShapeDtypeStruct(
+                (max_slots,), jnp.int32))
+        total += sum(int(np.prod(s.shape)) * s.dtype.itemsize
+                     for s in jax.tree_util.tree_leaves(shapes))
+    return total
+
+
 def _slot_capacity_row(model, max_slots: int, max_seq: int) -> Dict:
-    """The paged-KV memory claim as a pinned number: at the DENSE ring's
-    cache-byte budget, how many slots can decode CONCURRENTLY on a
-    short-actual-length workload (each sequence fits ONE block — at the
-    bench default, prompt 8 + 8 generated = 16 tokens vs a dense slot
-    priced at ``max_seq=128``)?  The paged pool is sized to the dense
-    ring's block count (trash block included), the paged engine to 4x
-    the slots, and the row verifies the whole fleet was simultaneously
+    """The paged-KV memory claim as a pinned number: at the dense ring's
+    cache-byte budget (computed analytically — the ring itself is gone),
+    how many slots can decode CONCURRENTLY on a short-actual-length
+    workload (each sequence fits ONE block — at the bench default,
+    prompt 8 + 8 generated = 16 tokens vs a dense slot priced at
+    ``max_seq=128``)?  The paged pool is sized to the dense-equivalent
+    block count (trash block included), the paged engine to 4x the
+    slots, and the row verifies the whole fleet was simultaneously
     resident (``peak_active``) with zero steady recompiles."""
     from ..generation import GenerationConfig, GenerationEngine
 
@@ -712,21 +739,14 @@ def _slot_capacity_row(model, max_slots: int, max_seq: int) -> Dict:
     # short-actual-length geometry scales with max_seq so toy configs
     # exercise the same row contract the real bench scale pins
     block = max(2, max_seq // 8)
-    dense = GenerationEngine.for_model(
-        model, GenerationConfig(max_slots=max_slots, max_seq=max_seq,
-                                paged=False))
-    try:
-        dense.warmup()
-        dense_bytes = dense.ring.cache_bytes
-    finally:
-        dense.shutdown()
+    dense_bytes = _dense_cache_bytes(model, max_slots, max_seq)
     paged_slots = 4 * max_slots
     # the dense ring's K/V byte budget expressed in blocks (trash block
     # INCLUDED — the pool must not exceed the dense bytes it replaces)
     n_blocks = max_slots * (max_seq // block)
     paged = GenerationEngine.for_model(
         model, GenerationConfig(max_slots=paged_slots, max_seq=max_seq,
-                                paged=True, block_size=block,
+                                block_size=block,
                                 n_blocks=n_blocks, queue_limit=4096))
     try:
         paged.warmup()
@@ -768,12 +788,10 @@ def ttft_ms(model=None, max_slots: int = 4, max_seq: int = 128,
     """Time-to-first-token under a shared-prefix-heavy admission mix
     (ISSUE 19): every request carries the same ``prefix_len``-token
     system/few-shot header plus a unique ``suffix_len`` tail — the
-    workload prefix sharing exists for.  Three arms, identical requests:
+    workload prefix sharing exists for.  Two arms, identical requests:
 
-    - ``ring``: the dense SlotRing (deprecated) — every admission
+    - ``paged_cold``: paged cache, sharing disabled — every admission
       prefills its full prompt;
-    - ``paged_cold``: paged cache, sharing disabled — the paged-gather
-      overhead in isolation;
     - ``paged_shared``: paged cache with the content-hash prefix
       registry — after the first request registers the header blocks,
       every later admission adopts them and prefills only its suffix.
@@ -795,9 +813,8 @@ def ttft_ms(model=None, max_slots: int = 4, max_seq: int = 128,
     prompts = [prefix + rng.integers(0, vocab, suffix_len).tolist()
                for _ in range(n_requests)]
 
-    arms = (("ring", dict(paged=False)),
-            ("paged_cold", dict(paged=True, prefix_sharing=False)),
-            ("paged_shared", dict(paged=True, prefix_sharing=True)))
+    arms = (("paged_cold", dict(prefix_sharing=False)),
+            ("paged_shared", dict(prefix_sharing=True)))
     rows: List[Dict] = []
     cold_p50 = None
     for arm, cfg_kw in arms:
@@ -1991,3 +2008,238 @@ def dispatch_pipeline_ms(depths=(2, 4), n_batches: int = 24,
         "steps": n_batches,
         "runs": max(1, runs),
     }
+
+
+# ------------------------------------------------------------------ fleet
+class _DevicePacedFn:
+    """One compiled program with a fixed per-call pace appended.
+
+    The sleep stands in for the device-step time of a real accelerator:
+    on a TPU the host enqueues and goes idle while the device computes,
+    so N replicas' steps overlap even on one host core.  On the 1-core
+    CPU rig the XLA step occupies the host itself, which would make a
+    fleet bench measure core contention instead of the routing tier —
+    the pace (a GIL-releasing sleep, zero CPU) restores the
+    host-async timing profile the fleet is designed for.  The wrapped
+    program still runs for real (outputs stay bit-exact, traces still
+    count), and attribute reads (``last_call_traced``) pass through."""
+
+    def __init__(self, fn, pace_s: float):
+        self._fn = fn
+        self._pace_s = float(pace_s)
+
+    def __call__(self, *args, **kw):
+        out = self._fn(*args, **kw)
+        time.sleep(self._pace_s)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class _DevicePacedModel:
+    """Model proxy whose compiled programs carry a fixed device pace.
+
+    Intercepts ``_get_jitted`` (the single seam both the serving slot
+    and the generation engine compile through) and returns cached
+    :class:`_DevicePacedFn` wrappers — cached so program identity stays
+    stable for the engines' trace accounting.  Everything else
+    (``params``/``state``/``conf``/``output``/...) forwards to the real
+    model."""
+
+    def __init__(self, model, pace_s: float):
+        self._model = model
+        self._pace_s = float(pace_s)
+        self._paced: Dict[str, _DevicePacedFn] = {}
+
+    def _get_jitted(self, kind: str):
+        fn = self._paced.get(kind)
+        if fn is None:
+            fn = _DevicePacedFn(self._model._get_jitted(kind),
+                                self._pace_s)
+            self._paced[kind] = fn
+        return fn
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+
+def serve_fleet(replica_counts=(1, 2, 4), *, model=None, lm=None,
+                pace_ms: float = 12.0, concurrency: int = 32,
+                n_requests: int = 384, max_batch: int = 4,
+                max_slots: int = 2, new_tokens: int = 24,
+                kill_tokens: int = 48, max_seq: int = 64) -> List[Dict]:
+    """Serving-fleet bench (ISSUE 20): closed-loop ``/predict`` req/s and
+    ``/generate`` decode tokens/s through :class:`serving.ServingFleet`
+    at each replica count, with ``vs_one_replica`` ratios (the
+    acceptance gate: near-linear — >= 3x at 4 replicas), plus a
+    kill-one-replica chaos row whose ``recovery_ms`` is the worst
+    migrated session's gap from ``kill()`` to its first token on a
+    survivor.  Every replica is device-paced (see
+    :class:`_DevicePacedFn`): per-replica throughput is bounded by the
+    paced step cadence, not host FLOPs, so the rows measure what the
+    fleet tier adds — routing, affinity, migration — at the timing
+    profile of real accelerator replicas.  ``steady_recompiles`` rides
+    every row (warmed replicas + the process-shared trace cache must
+    keep it 0 — including after the kill-phase rejoinless migration)."""
+    import threading
+
+    from ..generation import GenerationConfig
+    from ..models import LeNet, TransformerLM
+    from ..observability import MetricsRegistry
+    from ..serving.fleet import ServingFleet
+
+    pace_s = pace_ms / 1e3
+    counts = sorted(int(r) for r in replica_counts)
+    rows: List[Dict] = []
+
+    # ---- stateless /predict: least-loaded routing over paced replicas
+    if model is None:
+        model = LeNet().init()
+    probe = np.random.default_rng(0).standard_normal(
+        _probe_shape(model)).astype(np.float32)
+    paced = _DevicePacedModel(model, pace_s)
+    base_rps = None
+    for r in counts:
+        fleet = ServingFleet(paced, n_replicas=r,
+                             engine_kw=dict(max_batch_size=max_batch,
+                                            queue_limit=1024),
+                             registry=MetricsRegistry())
+        try:
+            fleet.warmup()
+            lats, wall, errs = _closed_loop(
+                lambda: fleet.predict(probe), concurrency, n_requests)
+            lats_ms = np.asarray(lats) * 1e3
+            rps = round(len(lats) / wall, 1)
+            row = {
+                "metric": f"serve_fleet[predict,r={r}]",
+                "value": rps, "unit": "req/s", "replicas": r,
+                "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+                "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+                "requests": len(lats), "errors": errs,
+                "concurrency": concurrency, "max_batch": max_batch,
+                "pace_ms": pace_ms,
+                "steady_recompiles": fleet.stats()["steady_recompiles"],
+            }
+        finally:
+            fleet.shutdown()
+        if base_rps is None:
+            base_rps = rps
+        else:
+            row["vs_one_replica"] = round(rps / base_rps, 2) \
+                if base_rps else None
+        rows.append(row)
+
+    # ---- session-affine /generate: decode tokens/s + kill-one chaos
+    if lm is None:
+        lm = TransformerLM(vocab_size=64, seq_len=max_seq, embed=32,
+                           n_layers=2, n_heads=2).init()
+    paced_lm = _DevicePacedModel(lm, pace_s)
+    vocab = lm.conf.layers[-1].n_out
+    rng = np.random.default_rng(1)
+    sessions = max_slots * counts[-1]     # fills every slot at max r
+    prompts = [rng.integers(1, vocab, 6).tolist() for _ in range(sessions)]
+    base_tps = None
+    fleet = None
+    for r in counts:
+        fleet = ServingFleet(
+            paced_lm, n_replicas=r,
+            generation=GenerationConfig(max_slots=max_slots,
+                                        max_seq=max_seq,
+                                        queue_limit=4096),
+            registry=MetricsRegistry())
+        try:
+            for rep in fleet.replicas:
+                rep.engine.generation.warmup()
+            results = [None] * sessions
+
+            def _gen(i):
+                results[i] = fleet.generate(
+                    prompts[i], max_new_tokens=new_tokens,
+                    temperature=0.0, timeout=300.0)
+
+            threads = [threading.Thread(target=_gen, args=(i,))
+                       for i in range(sessions)]
+            t0 = monotonic_s()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = monotonic_s() - t0
+            total = sum(len(res.tokens) for res in results
+                        if res is not None)
+            tps = round(total / wall, 1)
+            row = {
+                "metric": f"serve_fleet[decode,r={r}]",
+                "value": tps, "unit": "tokens/sec", "replicas": r,
+                "sessions": sessions, "new_tokens": new_tokens,
+                "tokens": total, "max_slots": max_slots,
+                "pace_ms": pace_ms,
+                "steady_recompiles": fleet.stats()["steady_recompiles"],
+            }
+            if base_tps is None:
+                base_tps = tps
+            else:
+                row["vs_one_replica"] = round(tps / base_tps, 2) \
+                    if base_tps else None
+            rows.append(row)
+        finally:
+            if r != counts[-1]:
+                fleet.shutdown()
+
+    # ---- chaos: kill one replica mid-decode on the widest fleet
+    try:
+        router = fleet.router
+        handles = [router.open_session(p, max_new_tokens=kill_tokens,
+                                       temperature=0.0)
+                   for p in prompts]
+        tok_times = [[] for _ in handles]
+        stream_errs: List[str] = []
+
+        def _consume(i, sess):
+            for ev in router.events(sess, timeout=120.0):
+                if "token" in ev:
+                    tok_times[i].append(monotonic_s())
+                if "error" in ev:
+                    stream_errs.append(str(ev["error"]))
+
+        threads = [threading.Thread(target=_consume, args=(i, s))
+                   for i, s in enumerate(handles)]
+        for t in threads:
+            t.start()
+        deadline = monotonic_s() + 60.0
+        while monotonic_s() < deadline:
+            if all(len(s.mirror["tokens"]) >= 1 for s in handles):
+                break
+            time.sleep(0.002)
+        victim = handles[0].replica.id
+        t_kill = monotonic_s()
+        fleet.kill(victim)
+        for t in threads:
+            t.join(timeout=180)
+        migrated = [i for i, s in enumerate(handles) if s.epoch > 0]
+        recovery_ms = None
+        if migrated:
+            recovery_ms = round(max(
+                next(t for t in tok_times[i] if t > t_kill) - t_kill
+                for i in migrated
+                if any(t > t_kill for t in tok_times[i])) * 1e3, 1)
+        rows.append({
+            "metric": "serve_fleet[recovery]",
+            "value": recovery_ms, "unit": "ms kill->first survivor token",
+            "replicas": counts[-1], "killed": victim,
+            "migrated": len(migrated), "sessions": sessions,
+            "completed": sum(len(ts) == kill_tokens for ts in tok_times),
+            "errors": len(stream_errs),
+            "steady_recompiles": fleet.stats()["steady_recompiles"],
+        })
+    finally:
+        fleet.shutdown()
+    return rows
+
+
+def _probe_shape(model):
+    try:
+        return tuple(model.conf.input_type.shape(-1)[1:])
+    except Exception:
+        return (784,)

@@ -447,7 +447,9 @@ def test_canonical_set_audits_clean_modulo_empty_baseline(canonical_audit):
     findings must be fixed or suppressed IN THE MANIFEST with a
     justification, never silently absorbed."""
     result, programs = canonical_audit
-    assert len(programs) >= 11, [p.name for p in programs]
+    # 9 since the dense SlotRing removal: paged_prefill/paged_decode are
+    # the only generation pair
+    assert len(programs) >= 9, [p.name for p in programs]
     bl = Baseline.load(str(BASELINE))
     assert bl.allowances == {}, "graftaudit baseline must stay empty"
     kept, stale = bl.apply(result.findings)
@@ -455,14 +457,14 @@ def test_canonical_set_audits_clean_modulo_empty_baseline(canonical_audit):
     assert result.stale_suppressions == []
     # the manifest's CPU donation pragmas actually absorbed something
     # (AX005 threshold-heuristic pragmas for every request path — the
-    # dense ring pair AND the paged pair — plus the exact-solver AX007
-    # twins where the lifetime solver proves the threaded cache/pool
-    # donatable — serve has no AX007 pragma: its batch output aliases
-    # nothing, so the solver is rightly silent)
+    # paged pair is the only generation pair since the dense SlotRing
+    # removal — plus the exact-solver AX007 twins where the lifetime
+    # solver proves the threaded pool donatable — serve has no AX007
+    # pragma: its batch output aliases nothing, so the solver is
+    # rightly silent)
     if jax.default_backend() == "cpu":
         assert set(result.suppressed) == {
-            "serve::AX005", "prefill::AX005", "decode::AX005",
-            "prefill::AX007", "decode::AX007",
+            "serve::AX005",
             "paged_prefill::AX005", "paged_decode::AX005",
             "paged_prefill::AX007", "paged_decode::AX007"}
 

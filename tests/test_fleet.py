@@ -1,0 +1,236 @@
+"""Serving-fleet failure matrix (ISSUE 20): least-loaded routing skew,
+tenant-quota shed isolation, replica kill mid-decode with bit-matching
+session migration, canary auto-rollback/auto-promote with monotonic
+versions, and ejected-replica rejoin at zero steady recompiles.
+
+The decode oracle is the same single-replica greedy re-forward
+``tests/test_generation.py`` pins everything else against: a migrated
+session's client-visible stream must be indistinguishable from a stream
+that never left its first replica."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.generation import GenerationConfig
+from deeplearning4j_tpu.models import LeNet, TransformerLM
+from deeplearning4j_tpu.observability import MetricsRegistry
+from deeplearning4j_tpu.serving import (CanaryConfig, ServingFleet,
+                                        ShedError, TenantAdmission,
+                                        TenantQuota)
+
+VOCAB = 17
+GEN = dict(max_slots=2, max_seq=32, block_size=4)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM(vocab_size=VOCAB, seq_len=32, embed=16,
+                         n_layers=2, n_heads=2).init()
+
+
+def naive_greedy(net, history, n):
+    """The solo oracle: full greedy re-forward, no engine, no fleet."""
+    hist = [int(t) for t in history]
+    out = []
+    for _ in range(n):
+        probs = np.asarray(net.output(np.asarray([hist], np.int32)))
+        tok = int(probs[0, len(hist) - 1].argmax())
+        out.append(tok)
+        hist.append(tok)
+    return out
+
+
+def gen_fleet(lm, reg, n_replicas=2, **kw):
+    return ServingFleet(lm, n_replicas=n_replicas,
+                        generation=GenerationConfig(**GEN),
+                        registry=reg, **kw)
+
+
+# --------------------------------------------------------------- routing
+def test_least_loaded_skew_routes_around_busy_replica():
+    """An imbalanced fleet must not round-robin: with replica 0 visibly
+    loaded (inflight pinned high), every /predict goes to replica 1,
+    and the routed counter + routing trail both say so."""
+    reg = MetricsRegistry()
+    fleet = ServingFleet(LeNet().init(), n_replicas=2, registry=reg)
+    try:
+        probe = np.zeros((784,), np.float32)
+        fleet.predict(probe)                    # compile outside the skew
+        busy = fleet.replicas[0]
+        for _ in range(8):
+            busy.begin()                        # 8 phantom inflight
+        for _ in range(5):
+            fleet.predict(probe)
+        routed = reg.get("fleet_routed_total")
+        assert routed.labels("predict", "1").value == 5
+        # the trail records the same routing decisions for forensics
+        tail = [t for t in fleet.router.trail if t["route"] == "predict"]
+        assert all(t["replica"] == 1 for t in tail[-5:])
+        for _ in range(8):
+            busy.end()
+        fleet.predict(probe)                    # balance restored: 0 wins
+        assert routed.labels("predict", "0").value >= 2
+    finally:
+        fleet.shutdown()
+
+
+# --------------------------------------------------------------- tenancy
+def test_tenant_quota_shed_isolation(lm):
+    """The noisy tenant 429s against ITS bucket; the polite tenant's
+    requests all succeed with oracle-exact tokens — one tenant's burst
+    never becomes everyone's shed."""
+    reg = MetricsRegistry()
+    tenants = TenantAdmission({"noisy": TenantQuota(rate=0.01, burst=2.0)},
+                              registry=reg)
+    fleet = gen_fleet(lm, reg, tenants=tenants)
+    try:
+        shed = 0
+        retry_after = None
+        for _ in range(5):
+            try:
+                fleet.generate([1, 2], max_new_tokens=2, tenant="noisy",
+                               temperature=0.0)
+            except ShedError as e:
+                assert e.status == 429
+                retry_after = e.retry_after_s
+                shed += 1
+        assert shed >= 3                      # burst=2 admits two at most
+        assert retry_after > 0                # Retry-After rides the 429
+        # polite tenant is untouched while noisy is at deficit
+        for _ in range(3):
+            res = fleet.generate([1, 2], max_new_tokens=2,
+                                 tenant="polite", temperature=0.0)
+            assert res.tokens == naive_greedy(lm, [1, 2], 2)
+        c = reg.get("serving_shed_total")
+        assert c.labels("tenant_quota", "noisy").value == shed
+        # unknown tenants are hash-bucketed, never a label explosion
+        anon = [lab for lab in (tenants.label(f"rando-{i}")
+                                for i in range(64))]
+        assert all(a.startswith("anon-") for a in anon)
+        assert len(set(anon)) <= 16
+    finally:
+        fleet.shutdown()
+
+
+# ----------------------------------------------------------------- chaos
+def test_replica_kill_mid_decode_migrates_bit_exact(lm):
+    """Kill the replica holding a mid-decode session: the client stream
+    continues on a survivor and the full token sequence bit-matches the
+    single-replica greedy oracle — no drop, no repeat, no hang; then
+    the dead replica rejoins warm (zero steady recompiles)."""
+    reg = MetricsRegistry()
+    fleet = gen_fleet(lm, reg)
+    try:
+        for r in fleet.replicas:
+            r.engine.generation.warmup()       # arm the recompile alarm
+        done = {}
+
+        def run_stream(prompt, n):
+            toks = []
+            for ev in fleet.stream(prompt, max_new_tokens=n,
+                                   temperature=0.0, timeout=60.0):
+                if "token" in ev:
+                    toks.append(ev["token"])
+                if "error" in ev:
+                    done["s"] = ("error", ev["error"])
+                    return
+            done["s"] = ("ok", toks)
+
+        t = threading.Thread(target=run_stream, args=([7, 8, 9], 25))
+        t.start()
+        victim = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sess = next(iter(fleet.router._sessions.values()), None)
+            if sess is not None and sess.mirror["tokens"]:
+                victim = sess.replica.id
+                break
+            time.sleep(0.0005)
+        assert victim is not None, "never caught a mid-decode session"
+        fleet.kill(victim)
+        t.join(timeout=60)
+        assert not t.is_alive(), "stream hung after replica kill"
+        status, toks = done["s"]
+        assert status == "ok", done["s"]
+        assert toks == naive_greedy(lm, [7, 8, 9], 25)
+        mig = reg.get("fleet_migrations_total")
+        assert mig.labels("killed").value >= 1
+        assert fleet.health()["live_replicas"] == 1
+        # rejoin: same topology, process-shared trace cache -> no
+        # steady-state compile anywhere in the fleet
+        r = fleet.rejoin(victim)
+        assert r.state == "live"
+        assert fleet.health()["live_replicas"] == 2
+        res = fleet.generate([4, 5], max_new_tokens=4, temperature=0.0)
+        assert res.tokens == naive_greedy(lm, [4, 5], 4)
+        assert fleet.stats()["steady_recompiles"] == 0
+    finally:
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------- canary
+class _Broken:
+    """Candidate that fails every request (serving falls back to
+    ``output`` for non-framework models)."""
+
+    def output(self, x):
+        raise RuntimeError("broken candidate")
+
+
+def test_canary_auto_rollback_on_error_rate():
+    """A fault-injected candidate rolls back within the controller
+    window: clients never see an error (the stable arm absorbs the
+    retry), the canary replica swaps FORWARD to the stable weights, and
+    no replica's version ever decreases."""
+    reg = MetricsRegistry()
+    model = LeNet().init()
+    fleet = ServingFleet(
+        model, n_replicas=2, registry=reg,
+        canary_config=CanaryConfig(min_samples=50, max_error_rate=0.1))
+    try:
+        probe = np.zeros((784,), np.float32)
+        fleet.predict(probe)
+        before = {r.id: r.engine.model_version for r in fleet.replicas}
+        ids = fleet.canary(_Broken(), fraction=0.5, n_replicas=1)
+        for _ in range(30):
+            # every request succeeds: canary-arm failures retry stable
+            fleet.predict(probe)
+            if fleet._canary is None:
+                break
+        assert fleet._canary is None, "canary never resolved"
+        assert fleet.canary_controller.status()["decision"] == "rollback"
+        after = {r.id: r.engine.model_version for r in fleet.replicas}
+        assert all(after[i] >= before[i] for i in before)
+        assert after[ids[0]] == before[ids[0]] + 2   # canary + rollback
+        assert all(r.arm == "stable" for r in fleet.replicas)
+        # rolled back to the STABLE weights: predictions still healthy
+        fleet.predict(probe)
+    finally:
+        fleet.shutdown()
+
+
+def test_canary_auto_promote_fleet_wide(lm):
+    """A healthy candidate (same weights re-installed) promotes to every
+    replica once the sample window fills; versions move forward on all
+    replicas and the decision sticks."""
+    reg = MetricsRegistry()
+    fleet = gen_fleet(lm, reg,
+                      canary_config=CanaryConfig(min_samples=8))
+    try:
+        before = {r.id: r.engine.model_version for r in fleet.replicas}
+        fleet.canary(lm, fraction=0.5, n_replicas=1)
+        for _ in range(30):
+            res = fleet.generate([1, 2], max_new_tokens=2,
+                                 temperature=0.0)
+            assert res.tokens == naive_greedy(lm, [1, 2], 2)
+            if fleet._canary is None:
+                break
+        assert fleet._canary is None, "canary never resolved"
+        assert fleet.canary_controller.status()["decision"] == "promote"
+        after = {r.id: r.engine.model_version for r in fleet.replicas}
+        assert all(after[i] > before[i] for i in before)
+        assert all(r.arm == "stable" for r in fleet.replicas)
+    finally:
+        fleet.shutdown()

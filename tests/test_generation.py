@@ -192,7 +192,7 @@ class TestGenerationEngine:
             # exactly two steady-state program KINDS: one prefill per
             # bucket (8/16/32) plus ONE decode over the full slot batch
             assert warmed == len(eng.buckets) + 1
-            pf0, dec0 = compiles("prefill"), compiles("decode")
+            pf0, dec0 = compiles("paged_prefill"), compiles("paged_decode")
             steady0 = reg.get("serving_steady_recompiles_total")
             steady0 = 0.0 if steady0 is None else steady0.value
             rng = np.random.default_rng(0)
@@ -209,8 +209,8 @@ class TestGenerationEngine:
             results = [r.future.result(timeout=60) for r in reqs]
             assert all(r.finish in ("eos", "length") for r in results)
             assert eng.steady_recompiles == 0
-            assert compiles("prefill") == pf0
-            assert compiles("decode") == dec0
+            assert compiles("paged_prefill") == pf0
+            assert compiles("paged_decode") == dec0
             steady = reg.get("serving_steady_recompiles_total")
             assert (0.0 if steady is None else steady.value) == steady0
             assert eng.tokens_generated == sum(len(r.tokens)
@@ -301,7 +301,8 @@ class TestGenerationEngine:
             assert ei.value.status == 429
             assert ei.value.retry_after_s > 0
             shed = reg.get("serving_shed_total")
-            assert shed is not None and shed.labels("no_slots").value == 1
+            assert shed is not None and \
+                shed.labels("no_slots", "-").value == 1
         finally:
             eng.shutdown()
 
@@ -313,7 +314,8 @@ class TestGenerationEngine:
             with pytest.raises(ShedError) as ei:
                 eng.submit([1])
             assert ei.value.status == 503
-            assert reg.get("serving_shed_total").labels("unready").value == 1
+            assert reg.get("serving_shed_total") \
+                .labels("unready", "-").value == 1
         finally:
             eng.shutdown()
         eng = GenerationEngine.for_model(
@@ -358,7 +360,7 @@ class TestGenerationEngine:
 
         def patched(kind):
             fn = orig(kind)
-            if kind in ("decode", "paged_decode") and fail.is_set():
+            if kind == "paged_decode" and fail.is_set():
                 def boom(*a, **k):
                     raise RuntimeError("injected decode fault")
                 return boom

@@ -1684,6 +1684,80 @@ def test_jx031_pragma_suppresses():
                                                 _GENERATION_PATH)}
 
 
+# ---------------------------------------------------------------- JX032
+def test_jx032_positive_lock_held_dispatch():
+    # three dispatch classes under three lock spellings: engine entry
+    # point under self._lock, fleet-wide swap under a dotted fleet
+    # lock, HTTP client verb under a session lock
+    src = """
+        class Router:
+            def route(self, x):
+                with self._lock:
+                    return self.best.engine.predict(x)
+
+            def roll(self, model):
+                with self.fleet._fleet_lock:
+                    for r in self.fleet.replicas:
+                        r.engine.hot_swap(model)
+
+            def relay(self, sess, body):
+                with sess.lock:
+                    return sess.client.post("/generate", body)
+    """
+    fs = lint_source(textwrap.dedent(src), _SERVING_PATH)
+    assert sum(f.rule == "JX032" for f in fs) == 3
+
+
+def test_jx032_negative_snapshot_then_dispatch_and_paths():
+    # the fleet idiom: pick the replica under the lock, dispatch
+    # outside it — and O(1) bookkeeping under the lock stays legal
+    src_ok = """
+        class Router:
+            def route(self, x):
+                with self._lock:
+                    target = min(self.replicas, key=lambda r: r.load())
+                    target.inflight += 1
+                return target.engine.predict(x)
+
+            def migrate(self, sess, state):
+                with sess.lock:
+                    sess.epoch += 1
+                    sess.replica.engine.import_session(state)
+    """
+    assert "JX032" not in rules_at(src_ok, _SERVING_PATH)
+    # a with block that is not a lock (file handle) is out of scope
+    src_file = """
+        class Snap:
+            def dump(self, path):
+                with open(path) as fh:
+                    return self.engine.predict(fh.read())
+    """
+    assert "JX032" not in rules_at(src_file, _SERVING_PATH)
+    # path scoping: identical code outside serving/ (and in serving
+    # tests) is out of scope
+    src_held = """
+        class Router:
+            def route(self, x):
+                with self._lock:
+                    return self.best.engine.predict(x)
+    """
+    for path in ("deeplearning4j_tpu/generation/fix.py",
+                 "tests/test_serving.py"):
+        assert "JX032" not in rules_at(src_held, path)
+
+
+def test_jx032_pragma_suppresses():
+    src = """
+        class Router:
+            def drain(self, x):
+                with self._lock:
+                    return self.solo.engine.predict(x)  # graftlint: disable=JX032  (single-replica drain mode, fleet already quiesced)
+    """
+    assert "JX032" not in {f.rule
+                           for f in lint_source(textwrap.dedent(src),
+                                                _SERVING_PATH)}
+
+
 # ---------------------------------------------------------------- JX018
 def test_jx018_positive_unguarded_increment_from_thread():
     got = findings("""
@@ -2738,7 +2812,7 @@ def test_cli_changed_only_lints_only_changed_files(tmp_path):
 def test_every_rule_has_docs():
     assert set(RULES) | set(PROGRAM_RULES) == set(RULE_DOCS)
     assert not set(RULES) & set(PROGRAM_RULES)
-    assert len(RULES) == 27
+    assert len(RULES) == 28
     assert len(PROGRAM_RULES) == 4
 
 

@@ -1,10 +1,14 @@
-"""Paged KV cache + shared-prefix prefill (ISSUE 19).
+"""Paged KV cache + shared-prefix prefill (ISSUE 19; the dense
+``SlotRing`` and its ``DL4J_TPU_KV_PAGED=0`` escape hatch were removed
+in ISSUE 20, so the dense-vs-paged parity pins live on as paged-only
+regressions).
 
 The acceptance spine:
 
-* bit parity: token streams through the paged block-pool cache are
-  IDENTICAL to the dense SlotRing's — greedy and sampled, multi-request
-  — and to the per-version greedy oracles across a mid-flight hot-swap
+* bit parity: greedy token streams through the paged block-pool cache
+  are IDENTICAL to the naive full-forward oracle, the whole mixed
+  greedy+sampled workload is invariant to block geometry, and streams
+  match the per-version greedy oracles across a mid-flight hot-swap
   migration (re-prefilled through the paged path);
 * the two-slot COW aliasing regression: a request appending into a
   partially-filled shared prefix block copies first — a later request
@@ -15,7 +19,8 @@ The acceptance spine:
 * int8 KV (``PrecisionPolicy.kv_dtype``): greedy parity within
   tolerance at roughly half the cache bytes;
 * zero steady recompiles across a mixed paged workload, and the
-  ``DL4J_TPU_KV_PAGED=0`` escape hatch still building the dense ring.
+  retired ``DL4J_TPU_KV_PAGED`` env var being ignored (paged is the
+  only cache organization).
 """
 import threading
 import time
@@ -27,7 +32,7 @@ from deeplearning4j_tpu.data.shapes import suffix_prefill_buckets
 from deeplearning4j_tpu.generation import (GenerationConfig,
                                            GenerationEngine,
                                            StaticSlotSource)
-from deeplearning4j_tpu.generation.cache import PagedKV, SlotRing
+from deeplearning4j_tpu.generation.cache import PagedKV
 from deeplearning4j_tpu.models import TransformerLM
 
 VOCAB = 17
@@ -78,27 +83,31 @@ REQUESTS = [
 
 # ----------------------------------------------------------- bit parity
 class TestPagedParity:
-    def test_paged_matches_dense_bitwise_greedy_and_sampled(self, lm):
-        """THE tentpole gate: same requests, same seeds — the paged
-        engine's streams are bit-identical to the dense ring's, greedy
-        AND sampled, across enough concurrent requests to exercise
-        block allocation, trash-lane padding and the written-prefix
-        mask tail."""
-        dense = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=4, max_seq=32, paged=False))
+    def test_paged_streams_pin_oracle_and_block_geometry(self, lm):
+        """THE parity gate, paged-only since the dense ring's removal:
+        greedy streams are bit-identical to the naive full-forward
+        oracle, and the whole mixed greedy+sampled workload is invariant
+        to block geometry (block size / slot count change WHERE K/V
+        lives, never the tokens) across enough concurrent requests to
+        exercise block allocation, trash-lane padding and the
+        written-prefix mask tail."""
+        a = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=4, max_seq=32, block_size=4))
         try:
-            want = run_requests(dense, REQUESTS)
-            assert dense.steady_recompiles == 0
+            want = run_requests(a, REQUESTS)
+            assert a.steady_recompiles == 0
         finally:
-            dense.shutdown()
-        paged = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=4, max_seq=32, paged=True,
-                                 block_size=4))
+            a.shutdown()
+        for (prompt, kw), toks in zip(REQUESTS, want):
+            if not kw.get("temperature"):          # greedy requests
+                assert toks == naive_greedy(lm, prompt, len(toks))
+        b = GenerationEngine.for_model(
+            lm, GenerationConfig(max_slots=2, max_seq=32, block_size=8))
         try:
-            got = run_requests(paged, REQUESTS)
-            assert paged.steady_recompiles == 0
+            got = run_requests(b, REQUESTS)
+            assert b.steady_recompiles == 0
         finally:
-            paged.shutdown()
+            b.shutdown()
         assert got == want
 
     def test_prefix_sharing_streams_stay_bit_identical(self, lm):
@@ -111,14 +120,14 @@ class TestPagedParity:
                                      seed=100 + i))
                 for i, tail in enumerate(([7], [8, 2], [9, 9, 1], [4]))]
         cold = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
+            lm, GenerationConfig(max_slots=2, max_seq=32,
                                  block_size=4, prefix_sharing=False))
         try:
             want = [cold.generate(p, **kw).tokens for p, kw in reqs]
         finally:
             cold.shutdown()
         shared = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
+            lm, GenerationConfig(max_slots=2, max_seq=32,
                                  block_size=4, prefix_sharing=True))
         try:
             got = [shared.generate(p, **kw).tokens for p, kw in reqs]
@@ -145,14 +154,14 @@ class TestPagedParity:
             (prompt_a + [8], dict(max_new_tokens=6, seed=4)),
         ]
         cold = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
+            lm, GenerationConfig(max_slots=2, max_seq=32,
                                  block_size=4, prefix_sharing=False))
         try:
             want = [cold.generate(p, **kw).tokens for p, kw in reqs]
         finally:
             cold.shutdown()
         shared = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
+            lm, GenerationConfig(max_slots=2, max_seq=32,
                                  block_size=4, prefix_sharing=True))
         try:
             got = [shared.generate(p, **kw).tokens for p, kw in reqs]
@@ -179,8 +188,7 @@ class TestPagedParity:
                                               net_b.params)
         src = StaticSlotSource(lm)
         eng = GenerationEngine(
-            src, GenerationConfig(max_slots=2, max_seq=32, paged=True,
-                                  block_size=4))
+            src, GenerationConfig(max_slots=2, max_seq=32, block_size=4))
         # deterministic mid-flight swap: park the engine INSIDE its 3rd
         # v1 decode step, swap while it's parked, then let the step
         # finish (still old weights — the engine resolved the model at
@@ -280,8 +288,7 @@ class TestPagedAllocator:
 class TestPagedEngine:
     def test_mixed_workload_zero_steady_recompiles(self, lm):
         eng = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=4, max_seq=32, paged=True,
-                                 block_size=4))
+            lm, GenerationConfig(max_slots=4, max_seq=32, block_size=4))
         try:
             run_requests(eng, REQUESTS)
             run_requests(eng, list(reversed(REQUESTS)))
@@ -293,15 +300,18 @@ class TestPagedEngine:
         finally:
             eng.shutdown()
 
-    def test_env_escape_hatch_builds_dense_ring(self, lm, monkeypatch):
+    def test_retired_env_escape_hatch_is_ignored(self, lm, monkeypatch):
+        """The ``DL4J_TPU_KV_PAGED=0`` hatch went with the dense ring:
+        the env var does nothing and every engine builds the paged
+        pool."""
         monkeypatch.setenv("DL4J_TPU_KV_PAGED", "0")
         eng = GenerationEngine.for_model(
             lm, GenerationConfig(max_slots=2, max_seq=32), start=False)
         try:
             eng.warmup()
-            assert isinstance(eng.ring, SlotRing)
-            assert eng.status()["kv_paged"] is False
-            assert eng.status()["kv"] is None
+            assert isinstance(eng.ring, PagedKV)
+            assert eng.status()["kv_paged"] is True
+            assert eng.status()["kv"] is not None
         finally:
             eng.shutdown()
 
@@ -311,9 +321,8 @@ class TestPagedEngine:
         already-satisfied requests finish, and the freed blocks serve
         the next request normally."""
         eng = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
-                                 block_size=8, n_blocks=5,
-                                 prefix_sharing=False))
+            lm, GenerationConfig(max_slots=2, max_seq=32, block_size=8,
+                                 n_blocks=5, prefix_sharing=False))
         try:
             # 4 usable 8-token blocks: each request wants 4+14=18 tokens
             # (3 blocks) — together they exceed the pool mid-decode
@@ -362,8 +371,7 @@ class TestPagedEngine:
 
         monkeypatch.setattr(lm, "_get_jitted", patched)
         eng = GenerationEngine.for_model(
-            lm, GenerationConfig(max_slots=2, max_seq=32, paged=True,
-                                 block_size=4))
+            lm, GenerationConfig(max_slots=2, max_seq=32, block_size=4))
         try:
             req = eng.submit([1, 2, 3], max_new_tokens=6, seed=9)
             with pytest.raises(RuntimeError, match="injected paged"):
@@ -428,7 +436,7 @@ class TestInt8KV:
                         jax.tree_util.tree_leaves(i8.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8]]
-        cfg = dict(max_slots=2, max_seq=32, paged=True, block_size=4)
+        cfg = dict(max_slots=2, max_seq=32, block_size=4)
         e32 = GenerationEngine.for_model(f32, GenerationConfig(**cfg))
         try:
             want = [e32.generate(p, max_new_tokens=8, timeout=60).tokens

@@ -274,8 +274,8 @@ def programs_from_trace_cache(steady_kinds: Optional[Sequence[str]] = None
 
     if steady_kinds is None:
         steady_kinds = ("train_step", "train_step_carry", "epoch_scan",
-                        "epochs_scan", "serve", "prefill", "decode",
-                        "paged_prefill", "paged_decode")
+                        "epochs_scan", "serve", "paged_prefill",
+                        "paged_decode")
     out: List[AuditProgram] = []
     seen: Dict[str, int] = {}
     for _key, entry in iter_trace_cache():

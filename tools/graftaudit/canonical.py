@@ -2,8 +2,8 @@
 
 One tiny representative per steady-state program class the framework
 ships — dense / ZeRO-3-sharded (dp=2, dp=4) / bf16 train steps, the
-serving forward, and the generation programs (the deprecated dense
-ring's prefill/decode pair AND the paged-KV pair) — driven through the
+serving forward, and the generation programs (the paged-KV
+prefill/decode pair) — driven through the
 REAL production entry points (``fit``, ``ShardedTrainer.fit``, the
 ``serve`` jit, ``GenerationEngine.warmup``), so the audited jaxprs are
 the very traces production executes, not hand-built fixtures.  The
@@ -60,7 +60,7 @@ CANONICAL_CONFIG = AuditConfig(min_donate_bytes=256,
 
 CANONICAL_PROGRAM_NAMES = (
     "train_step[dense]", "train_step[zero3,dp=2]", "train_step[zero3,dp=4]",
-    "train_step[bf16]", "train_step[f16]", "serve", "prefill", "decode",
+    "train_step[bf16]", "train_step[f16]", "serve",
     "paged_prefill", "paged_decode", "train_step[embedding_zero3]",
 )
 
@@ -266,7 +266,7 @@ def build_canonical(include: Optional[Sequence[str]] = None,
             entry_p = net_p._get_jitted("train_step")
             programs.append(AuditProgram(
                 name, entry_p, _pick_spec(entry_p, 1), policy=prec))
-        gen_names = ("prefill", "decode", "paged_prefill", "paged_decode")
+        gen_names = ("paged_prefill", "paged_decode")
         if any(want(n) for n in gen_names):
             try:
                 from deeplearning4j_tpu.generation import (
@@ -281,61 +281,15 @@ def build_canonical(include: Optional[Sequence[str]] = None,
 
             lm = TransformerLM(vocab_size=17, seq_len=16, embed=16,
                                n_layers=2, n_heads=2).init()
-            # the dense ring (deprecated, DL4J_TPU_KV_PAGED=0) and the
-            # paged cache are BOTH steady program classes until the ring
-            # is removed — each engine records its own pair's specs
-            if want("prefill") or want("decode"):
-                eng = GenerationEngine.for_model(
-                    lm, GenerationConfig(max_slots=2, max_seq=16,
-                                         paged=False))
-                try:
-                    eng.warmup()
-                    eng.generate([3, 1, 4], max_new_tokens=2)
-                finally:
-                    eng.shutdown()
             if want("paged_prefill") or want("paged_decode"):
                 eng_p = GenerationEngine.for_model(
                     lm, GenerationConfig(max_slots=2, max_seq=16,
-                                         paged=True, block_size=4))
+                                         block_size=4))
                 try:
                     eng_p.warmup()
                     eng_p.generate([3, 1, 4], max_new_tokens=2)
                 finally:
                     eng_p.shutdown()
-            if want("prefill"):
-                pf = lm._get_jitted("prefill")
-                programs.append(AuditProgram(
-                    "prefill", pf, _pick_largest_prefill(pf)))
-                if cpu:
-                    sups.append(Suppression(
-                        "prefill", "AX005",
-                        "CPU implements no buffer donation; "
-                        "generation/programs.build_generation_fn skips "
-                        "donating the slot cache there — on TPU both "
-                        "generation programs donate it"))
-                    sups.append(Suppression(
-                        "prefill", "AX007",
-                        "same CPU no-donation skip, exact-solver form: "
-                        "the lifetime solver proves the threaded slot "
-                        "cache (arg 4) donatable, and on TPU it IS "
-                        "donated — CPU cannot alias buffers"))
-            if want("decode"):
-                dec = lm._get_jitted("decode")
-                programs.append(AuditProgram(
-                    "decode", dec, dec.audit_specs()[-1]))
-                if cpu:
-                    sups.append(Suppression(
-                        "decode", "AX005",
-                        "CPU implements no buffer donation; "
-                        "generation/programs.build_generation_fn skips "
-                        "donating the slot cache there — on TPU both "
-                        "generation programs donate it"))
-                    sups.append(Suppression(
-                        "decode", "AX007",
-                        "same CPU no-donation skip, exact-solver form: "
-                        "the lifetime solver proves the threaded slot "
-                        "cache (arg 3) donatable, and on TPU it IS "
-                        "donated — CPU cannot alias buffers"))
             if want("paged_prefill"):
                 ppf = lm._get_jitted("paged_prefill")
                 programs.append(AuditProgram(

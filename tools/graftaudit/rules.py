@@ -40,8 +40,6 @@ DEAD_AFTER_CALL: Dict[str, tuple] = {
     "epoch_scan": (0, 1, 2, 3),
     "epochs_scan": (0, 1, 2, 3),
     "serve": (2,),
-    "prefill": (4,),
-    "decode": (3,),
     # the paged pair threads the BLOCK POOL (tables/pos ride along as
     # host-mirrored data args and are rebuilt per call, never donated)
     "paged_prefill": (4,),

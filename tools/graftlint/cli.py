@@ -18,7 +18,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="AST-based JAX/TPU correctness linter: module rules "
-                    "JX001-JX017, JX022-JX031 + whole-program "
+                    "JX001-JX017, JX022-JX032 + whole-program "
                     "concurrency rules JX018-JX021 (see tools/README.md)")
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument("--format", choices=("text", "json", "sarif"),

@@ -1832,6 +1832,90 @@ def jx031(info: ModuleInfo) -> List[Finding]:
     return _dedupe(out)
 
 
+# --------------------------------------------------------------------- JX032
+# scope: the serving tier — admission/routing locks are metadata locks;
+# holding one across an engine dispatch or HTTP client call serializes
+# the whole replica fleet behind a single request
+_JX032_PATH_RE = re.compile(r"(^|[/\\])serving[/\\]")
+_JX032_LOCK_RE = re.compile(r"(lock|mutex)\d*$")
+# blocking dispatch surfaces: engine request entry points, fleet-wide
+# swaps, and the JSON/HTTP client verbs (import_session/put_nowait-style
+# enqueues are O(1) bookkeeping and stay legal under a lock)
+_JX032_DISPATCH = frozenset((
+    "submit", "generate", "predict", "predict_versioned", "stream",
+    "hot_swap", "promote_latest", "warmup", "post", "get_text",
+    "stream_lines"))
+
+
+def _jx032_lock_item(item: ast.withitem) -> bool:
+    """A ``with`` item whose context expression spells a lock: a plain
+    or dotted name ending in lock/mutex (``self._lock``,
+    ``sess.lock``, ``self._fleet_lock``)."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):      # with self._lock.acquire_timeout(...)
+        expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+    name = dotted_name(expr)
+    if not name:
+        return False
+    return bool(_JX032_LOCK_RE.search(name.split(".")[-1].lower()))
+
+
+@rule("JX032", "engine dispatch or HTTP client call while holding a "
+               "lock in a serving/ module")
+def jx032(info: ModuleInfo) -> List[Finding]:
+    """Flag a blocking dispatch — an engine request entry point
+    (``submit``/``generate``/``predict``/``predict_versioned``/
+    ``stream``), a fleet-wide swap (``hot_swap``/``promote_latest``/
+    ``warmup``), or a JSON client verb (``post``/``get_text``/
+    ``stream_lines``) — made INSIDE a ``with <lock>:`` body in a
+    non-test ``serving/`` module.  Serving-tier locks (router state,
+    session tables, slot pointers) are metadata locks: they exist to
+    make a handful of pointer reads/writes atomic and are taken on
+    EVERY request.  A dispatch held under one turns the lock's
+    nanosecond critical section into the full engine round-trip (queue
+    wait + device step + possibly an HTTP hop), so every other request
+    — including requests bound for perfectly idle replicas — convoys
+    behind it, and a wedged replica holding the dispatch wedges the
+    entire admission front with it.  The fleet pattern is
+    snapshot-then-dispatch: copy the routing decision out under the
+    lock, release it, dispatch outside.  O(1) bookkeeping
+    (``import_session`` enqueue, queue puts, counter bumps) stays legal
+    under a lock; a deliberate lock-held dispatch carries a pragma with
+    its justification."""
+    out: List[Finding] = []
+    path = info.path.replace("\\", "/")
+    if not _JX032_PATH_RE.search(path) or _JX026_TEST_PATH_RE.search(path):
+        return out
+    lock_withs = [
+        w for w in list(info.nodes(ast.With)) +
+        list(info.nodes(ast.AsyncWith))
+        if any(_jx032_lock_item(item) for item in w.items)]
+    if not lock_withs:
+        return out
+    for node in info.nodes(ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _JX032_DISPATCH):
+            continue
+        held = any(
+            any(node in ast.walk(stmt) for stmt in w.body)
+            for w in lock_withs)
+        if not held:
+            continue
+        recv = dotted_name(node.func.value) or "?"
+        out.append(_finding(
+            info, node, "JX032",
+            f"`{recv}.{node.func.attr}(...)` while holding a lock in a "
+            "serving/ module: routing/session locks are metadata locks "
+            "taken on every request — a dispatch held under one convoys "
+            "the whole fleet behind a single engine round-trip (and a "
+            "wedged replica wedges the admission front); snapshot the "
+            "routing decision under the lock, release it, dispatch "
+            "outside (or pragma a deliberate O(1)-bounded call)"))
+    return _dedupe(out)
+
+
 # ===================================================================== #
 # Whole-program concurrency pack (JX018-JX021): these run ONCE over the  #
 # ProgramModel built from every linted module — see program.py for the   #
